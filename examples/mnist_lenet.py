@@ -1,0 +1,66 @@
+"""LeNet on MNIST via Gluon — the reference example/gluon/mnist/mnist.py
+pattern (SURVEY.md §2.4: the PR1 acceptance flow), running on the TPU rebuild.
+Uses synthetic MNIST when real idx files are absent (MXTPU_SYNTHETIC_DATA=1)."""
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.gluon.data.vision import datasets, transforms
+
+mx.random.seed(0)
+np.random.seed(0)
+
+# LeNet
+net = nn.HybridSequential()
+net.add(nn.Conv2D(6, kernel_size=5, activation='relu'),
+        nn.MaxPool2D(2),
+        nn.Conv2D(16, kernel_size=3, activation='relu'),
+        nn.MaxPool2D(2),
+        nn.Flatten(),
+        nn.Dense(120, activation='relu'),
+        nn.Dense(84, activation='relu'),
+        nn.Dense(10))
+ctx = mx.cpu()
+net.initialize(init=mx.init.Xavier(), ctx=ctx)
+net.hybridize()
+
+to_tensor = transforms.ToTensor()
+train_ds = datasets.MNIST(train=True, synthetic=True, size=2000).transform_first(lambda d: to_tensor(d))
+val_ds = datasets.MNIST(train=False, synthetic=True, size=500).transform_first(lambda d: to_tensor(d))
+train_loader = gluon.data.DataLoader(train_ds, batch_size=100, shuffle=True)
+val_loader = gluon.data.DataLoader(val_ds, batch_size=100)
+
+trainer = gluon.Trainer(net.collect_params(), 'sgd',
+                        {'learning_rate': 0.01, 'momentum': 0.9})
+loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+metric = mx.metric.Accuracy()
+
+for epoch in range(8):
+    metric.reset()
+    for data, label in train_loader:
+        data = data.as_in_context(ctx)
+        label = label.as_in_context(ctx)
+        with autograd.record():
+            out = net(data)
+            L = loss_fn(out, label)
+        L.backward()
+        trainer.step(data.shape[0])
+        metric.update(label, out)
+    name, train_acc = metric.get()
+    metric.reset()
+    for data, label in val_loader:
+        metric.update(label, net(data))
+    _, val_acc = metric.get()
+    print(f"epoch {epoch}: train {name}={train_acc:.3f} val={val_acc:.3f}")
+
+assert val_acc > 0.95, f"did not converge: {val_acc}"
+net.save_parameters('/tmp/lenet.params')
+net2 = nn.HybridSequential()
+net2.add(nn.Conv2D(6, kernel_size=5, activation='relu'), nn.MaxPool2D(2),
+         nn.Conv2D(16, kernel_size=3, activation='relu'), nn.MaxPool2D(2),
+         nn.Flatten(), nn.Dense(120, activation='relu'),
+         nn.Dense(84, activation='relu'), nn.Dense(10))
+net2.load_parameters('/tmp/lenet.params')
+x0, y0 = next(iter(val_loader))
+assert np.allclose(net(x0).asnumpy(), net2(x0).asnumpy(), atol=1e-5)
+print("save/load roundtrip OK; final val acc %.3f" % val_acc)

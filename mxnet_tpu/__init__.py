@@ -1,0 +1,67 @@
+"""mxnet_tpu — a TPU-native deep learning framework with MXNet's capabilities.
+
+A ground-up rebuild of the reference (zhanghang1989/incubator-mxnet, an Apache
+MXNet 1.x fork) for TPU: jax/XLA/Pallas is the compute substrate, ``jit`` over
+``jax.sharding.Mesh`` is the scaling substrate, and the public API keeps
+MXNet's imperative NDArray + Gluon + KVStore surface so reference users can
+switch with a context change (``mx.tpu()``).
+
+Usage mirrors the reference::
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, autograd
+
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(10))
+    net.initialize(ctx=mx.tpu())
+    net.hybridize()            # -> jax.jit (XLA) instead of CachedOp
+    with autograd.record():
+        loss = ...
+    loss.backward()
+    trainer.step(batch_size)
+
+Layer map vs the reference is documented in SURVEY.md §1; every reference
+component's disposition is in SURVEY.md §2.1.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError
+from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
+    num_gpus, num_tpus
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import random
+from . import autograd
+from . import initializer
+from . import initializer as init
+from . import optimizer
+from .optimizer import lr_scheduler
+from . import metric
+from . import gluon
+from . import kvstore as kv
+from .kvstore import create as _kv_create
+from . import io
+from . import recordio
+from . import callback
+from . import profiler
+from . import runtime
+from . import util
+from . import test_utils
+from . import symbol
+from . import symbol as sym
+from . import module
+from . import module as mod
+from . import visualization as viz
+from . import image
+from . import parallel
+
+# mx.np / mx.npx numpy-compat front end (SURVEY.md §2.2 numpy-compat row):
+# jax.numpy already provides numpy semantics; expose it under the mx.np name.
+import jax.numpy as np  # noqa: F401
+from . import npx  # noqa: F401
+
+
+def __getattr__(name):
+    raise AttributeError(f"module 'mxnet_tpu' has no attribute {name!r}")

@@ -1,0 +1,225 @@
+"""Imperative autograd tape.
+
+TPU-native replacement for the reference's C++ imperative autograd runtime
+(``src/imperative/imperative.cc``: ``Imperative::RecordOp`` /
+``Imperative::Backward``; SURVEY.md §2.1 "Imperative runtime + autograd").
+
+Design (SURVEY.md §7 "core trick"): JAX's autodiff is functional, while MXNet's
+API is an imperative tape (``autograd.record()`` … ``loss.backward()``). We
+bridge them by recording, at dispatch time, one tape *node* per executed op.
+While recording, every op is executed through ``jax.vjp`` so the node captures
+a ready-to-run pullback (residuals live on device — this IS the forward pass,
+nothing is computed twice). ``backward()`` then walks nodes in reverse creation
+order, feeding output cotangents into each pullback and accumulating input
+cotangents into either producer nodes or user gradients (``attach_grad`` with
+``grad_req`` write/add/null, matching ``Imperative::MarkVariables``).
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError
+
+__all__ = ["is_recording", "is_training", "set_recording", "set_training",
+           "apply_op", "backward", "mark_variable", "Node"]
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.recording = False
+        self.training = False
+        self.nodes = []          # list[Node] in creation order
+        self.counter = 0
+        # inside a jit trace we must not record (pure replay), see CachedOp
+        self.trace_depth = 0
+
+
+_STATE = _TapeState()
+
+
+def is_recording():
+    return _STATE.recording and _STATE.trace_depth == 0
+
+
+def is_training():
+    return _STATE.training
+
+
+def set_recording(flag):
+    prev = _STATE.recording
+    _STATE.recording = flag
+    return prev
+
+
+def set_training(flag):
+    prev = _STATE.training
+    _STATE.training = flag
+    return prev
+
+
+class trace_scope:
+    """Disable tape recording while tracing a CachedOp/jit region."""
+
+    def __enter__(self):
+        _STATE.trace_depth += 1
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.trace_depth -= 1
+        return False
+
+
+class Node:
+    """One recorded op: inputs, pullback, and per-output cotangent slots."""
+
+    __slots__ = ("inputs", "vjp_fn", "n_out", "out_grads", "out_protos",
+                 "order", "name")
+
+    def __init__(self, inputs, vjp_fn, outs, order, name=""):
+        self.inputs = inputs            # list[NDArray]
+        self.vjp_fn = vjp_fn
+        self.n_out = len(outs)
+        self.out_grads = [None] * self.n_out
+        self.out_protos = [(o.shape, o.dtype) for o in outs]
+        self.order = order
+        self.name = name
+
+
+def _on_tape(arr):
+    return arr._grad_req != "null" or arr._node is not None
+
+
+def apply_op(fn, inputs, n_out=1, name=""):
+    """Execute ``fn`` (pure, jax arrays -> jax array(s)) over NDArray inputs.
+
+    Every NDArray op routes through here — the single dispatch point standing
+    in for ``Imperative::Invoke`` (reference src/imperative/imperative.cc).
+    Returns raw jax output(s) plus the Node to attach (or None).
+    """
+    datas = [x._data for x in inputs]
+    record = is_recording() and any(_on_tape(x) for x in inputs)
+    if record:
+        outs, vjp_fn = jax.vjp(lambda *a: fn(*a), *datas)
+        if n_out == 1:
+            outs = (outs,)
+        _STATE.counter += 1
+        node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name)
+        _STATE.nodes.append(node)
+        return outs, node
+    outs = fn(*datas)
+    if n_out == 1:
+        outs = (outs,)
+    return outs, None
+
+
+def mark_variable(arr, grad_req="write"):
+    """attach_grad: reference Imperative::MarkVariables."""
+    if grad_req not in ("write", "add", "null"):
+        raise MXNetError(f"invalid grad_req {grad_req!r}")
+    arr._grad_req = grad_req
+    # attach_grad detaches the array from any producing graph, matching the
+    # reference behaviour of NDArray.attach_grad (python/mxnet/ndarray/ndarray.py)
+    arr._node = None
+    arr._out_index = 0
+    if grad_req == "null":
+        arr._grad = None
+    else:
+        arr._grad = jnp.zeros(arr.shape, arr.dtype)
+    arr._grad_fresh = False
+
+
+def _accumulate(slot, value):
+    return value if slot is None else slot + value
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Run the reverse pass from ``heads``.
+
+    Reference: ``Imperative::Backward`` (src/imperative/imperative.cc) invoked
+    from ``python/mxnet/autograd.py`` ``backward()``.
+    """
+    if not isinstance(heads, (list, tuple)):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif not isinstance(head_grads, (list, tuple)):
+        head_grads = [head_grads]
+
+    # Per-backward leaf accumulator: within ONE backward pass contributions
+    # always sum; grad_req write/add governs behaviour ACROSS backward calls
+    # (matching reference grad_req semantics in include/mxnet/op_attr_types.h).
+    leaf_grads = {}
+
+    def _leaf_accumulate(arr, g):
+        if id(arr) in leaf_grads:
+            leaf_grads[id(arr)] = (arr, leaf_grads[id(arr)][1] + g)
+        else:
+            leaf_grads[id(arr)] = (arr, g)
+
+    # seed output cotangents
+    live = False
+    for h, hg in zip(heads, head_grads):
+        seed = jnp.ones(h.shape, h.dtype) if hg is None else hg._data
+        if h._node is not None and h._node.vjp_fn is not None:
+            node, idx = h._node, h._out_index
+            node.out_grads[idx] = _accumulate(node.out_grads[idx], seed)
+            live = True
+        elif h._grad_req != "null":
+            _leaf_accumulate(h, seed)
+
+    if not live:
+        for arr, g in leaf_grads.values():
+            _apply_grad_req(arr, g)
+        return
+
+    # Walk all recorded nodes newest->oldest; skip nodes with no cotangent.
+    for node in reversed(_STATE.nodes):
+        if node.vjp_fn is None or all(g is None for g in node.out_grads):
+            continue
+        cotangents = tuple(
+            jnp.zeros(node.out_protos[k][0], node.out_protos[k][1])
+            if g is None else g
+            for k, g in enumerate(node.out_grads))
+        in_grads = node.vjp_fn(cotangents if node.n_out > 1 else cotangents[0])
+        if not isinstance(in_grads, (list, tuple)):
+            in_grads = (in_grads,)
+        for inp, g in zip(node.inputs, in_grads):
+            if g is None:
+                continue
+            if inp._node is not None and inp._node.vjp_fn is not None:
+                pnode, pidx = inp._node, inp._out_index
+                pnode.out_grads[pidx] = _accumulate(pnode.out_grads[pidx], g)
+            # an intermediate with attach_grad'd grad_req receives its grad
+            # IN ADDITION to propagating upstream (reference autograd.grad
+            # supports non-leaf variables)
+            if inp._grad_req != "null":
+                _leaf_accumulate(inp, g)
+        # cotangent slots are consumed by this pass either way; only the
+        # pullback/inputs survive under retain_graph
+        node.out_grads = [None] * node.n_out
+        if not retain_graph:
+            node.vjp_fn = None
+            node.inputs = []
+
+    for arr, g in leaf_grads.values():
+        _apply_grad_req(arr, g)
+    if not retain_graph:
+        clear_tape()
+
+
+def _apply_grad_req(arr, g):
+    if g.dtype != arr.dtype:
+        g = g.astype(arr.dtype)
+    if arr._grad_req == "add" and arr._grad is not None:
+        arr._grad = arr._grad + g
+    else:
+        arr._grad = g
+    arr._grad_fresh = True
+
+
+def clear_tape():
+    _STATE.nodes = []
+    _STATE.counter = 0

@@ -1,0 +1,174 @@
+"""``mx.autograd`` — imperative autograd scopes over the functional tape.
+
+Reference: python/mxnet/autograd.py (record/pause/train_mode/predict_mode,
+mark_variables, backward, grad) backed by src/imperative/imperative.cc.
+Engine here: mxnet_tpu._tape (see its docstring for the jax.vjp design).
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+
+from . import _tape
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad",
+           "set_recording", "set_training"]
+
+
+is_recording = _tape.is_recording
+is_training = _tape.is_training
+set_recording = _tape.set_recording
+set_training = _tape.set_training
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode):
+        self._enter_is_record = is_record
+        self._enter_train_mode = train_mode
+        self._prev_is_record = None
+        self._prev_train_mode = None
+
+    def __enter__(self):
+        if self._enter_is_record is not None:
+            self._prev_is_record = _tape.set_recording(self._enter_is_record)
+        if self._enter_train_mode is not None:
+            self._prev_train_mode = _tape.set_training(self._enter_train_mode)
+        return self
+
+    def __exit__(self, *exc):
+        if self._enter_is_record is not None:
+            _tape.set_recording(self._prev_is_record)
+        if self._enter_train_mode is not None:
+            _tape.set_training(self._prev_train_mode)
+        return False
+
+
+def record(train_mode=True):
+    """with autograd.record(): ... — enables op recording + train mode."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+def mark_variables(variables, gradients=None, grad_reqs="write"):
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, req in zip(variables, grad_reqs):
+        _tape.mark_variable(v, req)
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    _tape.backward(heads, head_grads, retain_graph=retain_graph,
+                   train_mode=train_mode)
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Compute and RETURN grads of heads wrt variables (does not touch .grad).
+
+    Reference: python/mxnet/autograd.py grad(). ``create_graph=True``
+    (higher-order) is not supported by the v1 tape — use jax.grad composition
+    via hybridized blocks for higher-order needs.
+    """
+    if create_graph:
+        raise MXNetError(
+            "create_graph=True is not supported by the imperative tape; "
+            "compose jax.grad over a hybridized block instead")
+    single = isinstance(variables, NDArray)
+    var_list = [variables] if single else list(variables)
+    # stash current grads/reqs, run a scoped backward, then restore
+    saved = [(v._grad, v._grad_req) for v in var_list]
+    for v in var_list:
+        v._grad = None
+        v._grad_req = "write"
+    _tape.backward(heads, head_grads, retain_graph=bool(retain_graph),
+                   train_mode=train_mode)
+    grads = []
+    for v, (old_g, old_req) in zip(var_list, saved):
+        if v._grad is None:
+            raise MXNetError("one of the variables does not participate in "
+                             "the graph of heads")
+        grads.append(NDArray(v._grad, v._ctx))
+        v._grad, v._grad_req = old_g, old_req
+    return grads[0] if single else grads
+
+
+class Function:
+    """Custom differentiable function (reference autograd.Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def __call__(self, *inputs):
+        import jax
+
+        def fwd_raw(*datas):
+            nds = [NDArray(d) for d in datas]
+            with _RecordingStateScope(False, None):
+                out = self.forward(*nds)
+            outs = out if isinstance(out, tuple) else (out,)
+            return tuple(o.data for o in outs)
+
+        def make_vjp(*datas):
+            primal = fwd_raw(*datas)
+
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                with _RecordingStateScope(False, None):
+                    in_grads = self.backward(*[NDArray(c) for c in cts])
+                igs = in_grads if isinstance(in_grads, tuple) else (in_grads,)
+                return tuple(g.data for g in igs)
+            return primal, vjp_fn
+
+        datas = [x.data for x in inputs]
+        if _tape.is_recording():
+            primal, vjp_fn = make_vjp(*datas)
+            node = _tape.Node(list(inputs), vjp_fn,
+                              [type("P", (), {"shape": p.shape, "dtype": p.dtype})()
+                               for p in primal],
+                              _bump_counter(), name=type(self).__name__)
+            _tape._STATE.nodes.append(node)
+            outs = [NDArray(p, inputs[0]._ctx) for p in primal]
+            for i, o in enumerate(outs):
+                o._node = node
+                o._out_index = i
+        else:
+            primal = fwd_raw(*datas)
+            outs = [NDArray(p, inputs[0]._ctx) for p in primal]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+
+def _bump_counter():
+    _tape._STATE.counter += 1
+    return _tape._STATE.counter

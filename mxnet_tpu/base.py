@@ -1,0 +1,78 @@
+"""Base utilities: errors, registry plumbing, dtype handling.
+
+TPU-native rebuild of MXNet's base layer. In the reference these concerns live
+in ``python/mxnet/base.py`` (ctypes bridge, ``check_call``, ``MXNetError``) and
+``src/c_api/c_api_error.cc``. Here there is no C ABI: the framework is
+Python+JAX down to XLA, so ``base`` keeps only the error type, the op/block
+registries, and dtype utilities.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
+           "registry_create", "DTYPE_MAP"]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework.
+
+    Mirrors ``mxnet.base.MXNetError`` (reference: python/mxnet/base.py), which
+    re-raised C++ ``dmlc::Error`` across the C ABI. Here errors propagate
+    natively, so this is a plain Python exception with the same name so user
+    ``except mx.MXNetError`` code keeps working.
+    """
+
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+# Canonical dtype names accepted across the API (reference: mshadow type enum
+# mapping in python/mxnet/base.py _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP).
+DTYPE_MAP = {
+    "float32": _np.float32,
+    "float64": _np.float64,
+    "float16": _np.float16,
+    "bfloat16": "bfloat16",  # resolved lazily via ml_dtypes/jnp
+    "uint8": _np.uint8,
+    "int8": _np.int8,
+    "int32": _np.int32,
+    "int64": _np.int64,
+    "bool": _np.bool_,
+}
+
+
+def registry_create(nickname):
+    """Create a (register, create, get_registry) triple for named factories.
+
+    Stands in for the reference's ``mxnet.registry`` module
+    (python/mxnet/registry.py) which backed ``mx.init.@register``,
+    ``mx.optimizer.register`` etc.
+    """
+    registry = {}
+
+    def register(klass_or_name=None, name=None):
+        def _do(klass, reg_name):
+            key = (reg_name or klass.__name__).lower()
+            registry[key] = klass
+            return klass
+
+        if isinstance(klass_or_name, str):
+            # used as @register("name")
+            return lambda klass: _do(klass, klass_or_name)
+        if klass_or_name is None:
+            return lambda klass: _do(klass, name)
+        return _do(klass_or_name, name)
+
+    def create(spec, *args, **kwargs):
+        if isinstance(spec, str):
+            key = spec.lower()
+            if key not in registry:
+                raise MXNetError(
+                    f"Cannot find {nickname} '{spec}'. "
+                    f"Registered: {sorted(registry)}")
+            return registry[key](*args, **kwargs)
+        return spec
+
+    return register, create, registry

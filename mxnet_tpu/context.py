@@ -1,0 +1,145 @@
+"""Device contexts: ``mx.cpu()``, ``mx.tpu()`` (and ``mx.gpu()`` alias).
+
+Rebuild of ``python/mxnet/context.py`` (reference): ``Context`` objects with a
+``with``-scope "current context" stack. The TPU-native twist: ``device_id``
+indexes into ``jax.devices(device_type)``, and placing an NDArray on a context
+is a ``jax.device_put``. There are no streams or per-device worker threads to
+manage — XLA's async runtime (which replaces ``src/engine/`` wholesale, see
+SURVEY.md §1) owns scheduling.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+
+from .base import MXNetError
+
+__all__ = ["Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+           "num_tpus", "num_gpus"]
+
+_DEVTYPE_ALIASES = {
+    "cpu": "cpu",
+    "cpu_pinned": "cpu",
+    # ``gpu`` kept for one-line porting of reference scripts: on this stack the
+    # accelerator is whatever jax exposes as the default backend.
+    "gpu": None,
+    "tpu": None,
+}
+
+
+def _default_accelerator_platform():
+    """Best accelerator platform name known to jax, else 'cpu'."""
+    try:
+        return jax.default_backend()
+    except Exception:  # pragma: no cover - jax init failure
+        return "cpu"
+
+
+class Context:
+    """A device context. Reference: python/mxnet/context.py (class Context)."""
+
+    _current = threading.local()
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            device_type, device_id = device_type.device_type, device_type.device_id
+        device_type = device_type.lower()
+        if device_type not in _DEVTYPE_ALIASES:
+            raise MXNetError(f"unknown device type {device_type!r}")
+        self.device_type = device_type
+        self.device_id = int(device_id)
+
+    # -- jax interop ------------------------------------------------------
+    @property
+    def jax_device(self):
+        """Resolve to a concrete jax.Device."""
+        platform = _DEVTYPE_ALIASES[self.device_type]
+        if platform is None:
+            platform = _default_accelerator_platform()
+        try:
+            devices = jax.devices(platform)
+        except RuntimeError:
+            if self.device_type in ("tpu", "gpu"):
+                # graceful degradation mirroring mx.gpu() on a CPU build
+                devices = jax.devices("cpu")
+            else:
+                raise
+        if self.device_id >= len(devices):
+            raise MXNetError(
+                f"{self} out of range: only {len(devices)} {self.device_type} "
+                f"device(s) visible")
+        return devices[self.device_id]
+
+    # -- scope handling ---------------------------------------------------
+    def __enter__(self):
+        if not hasattr(Context._current, "stack"):
+            Context._current.stack = []
+        Context._current.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        Context._current.stack.pop()
+        return False
+
+    def __eq__(self, other):
+        return (isinstance(other, Context)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def __hash__(self):
+        return hash((self.device_type, self.device_id))
+
+    def __repr__(self):
+        return f"{self.device_type}({self.device_id})"
+
+    def __str__(self):
+        return self.__repr__()
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Accelerator context, kept for script compatibility; same as tpu()."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    """The TPU context — the north-star API (`mx.tpu()`)."""
+    return Context("tpu", device_id)
+
+
+def num_tpus():
+    try:
+        backend = _default_accelerator_platform()
+        if backend == "cpu":
+            return 0
+        return len(jax.devices(backend))
+    except RuntimeError:
+        return 0
+
+
+def num_gpus():
+    return num_tpus()
+
+
+def current_context():
+    """Reference: python/mxnet/context.py current_context(); defaults to cpu(0)
+    upstream — here it defaults to the best available device so that model-zoo
+    scripts run on the TPU without a context argument."""
+    stack = getattr(Context._current, "stack", None)
+    if stack:
+        return stack[-1]
+    return default_context()
+
+
+def default_context():
+    if num_tpus() > 0:
+        return tpu(0)
+    return cpu(0)

@@ -1,0 +1,12 @@
+"""``mx.gluon`` — the imperative NN API (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, ParameterDict, Constant
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import rnn
+from . import loss
+from . import data
+from . import utils
+from . import model_zoo
+from . import contrib
+from .utils import split_and_load, split_data

@@ -1,0 +1,589 @@
+"""Gluon ``Block`` / ``HybridBlock`` / ``SymbolBlock`` and the TPU CachedOp.
+
+Reference: python/mxnet/gluon/block.py + src/imperative/cached_op.cc
+(SURVEY.md §2.1 "CachedOp" — "the crown jewel mapping").
+
+The mapping implemented here:
+
+  reference                         TPU rebuild
+  ---------                         -----------
+  hybridize()                       mark block active; build CachedOp
+  CachedOp trace (nnvm graph)       jax.jit trace of the block's forward
+  static_alloc/static_shape         XLA static shapes + buffer reuse (free)
+  shape-keyed graph cache           jax.jit's shape/dtype-keyed cache
+  op bulking                        XLA fusion
+  export() -> symbol.json+params    jax.export (StableHLO) + params file
+  SymbolBlock.imports               deserialize StableHLO, wrap as Block
+
+Training state, PRNG, and BatchNorm aux-state (running mean/var) are threaded
+through the traced function explicitly:
+  - train/predict mode is a *static* switch: one jitted function per mode
+  - a PRNG key is passed per call; Dropout etc. derive sub-keys by fold_in
+  - aux updates are collected during trace and returned as extra outputs,
+    then written back into the Parameters after each call
+    (SURVEY.md §7 hard parts: "BatchNorm aux-state update inside jit")
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray
+from ..ndarray import utils as nd_utils
+from .. import _tape
+from ..ndarray import random as _rnd
+from .parameter import (Parameter, ParameterDict, Constant,
+                        DeferredInitializationError, _bind_params)
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "nn_block_scope"]
+
+
+# ----------------------------------------------------------------------
+# naming scope (reference: gluon/block.py _BlockScope)
+# ----------------------------------------------------------------------
+
+class _BlockScope:
+    _local = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._local, "current", None)
+        if current is None:
+            if prefix is None:
+                prefix = _global_count(hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            current._counter[hint] = count + 1
+            prefix = f"{hint}{count}_"
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._local, "current", None)
+        _BlockScope._local.current = self
+        return self
+
+    def __exit__(self, *exc):
+        if self._block._empty_prefix:
+            return False
+        _BlockScope._local.current = self._old_scope
+        return False
+
+
+_GLOBAL_COUNTERS = {}
+
+
+def _global_count(hint):
+    count = _GLOBAL_COUNTERS.get(hint, 0)
+    _GLOBAL_COUNTERS[hint] = count + 1
+    return f"{hint}{count}"
+
+
+def nn_block_scope(block):
+    return _BlockScope(block)
+
+
+# ----------------------------------------------------------------------
+# aux-update collector (BatchNorm running stats inside jit)
+# ----------------------------------------------------------------------
+
+class _AuxCollector(threading.local):
+    def __init__(self):
+        self.stack = []
+
+
+_AUX = _AuxCollector()
+
+
+class _aux_scope:
+    def __enter__(self):
+        _AUX.stack.append([])
+        return _AUX.stack[-1]
+
+    def __exit__(self, *exc):
+        _AUX.stack.pop()
+        return False
+
+
+def record_aux_update(param, new_value):
+    """Called by layers holding auxiliary (non-grad) state, e.g. BatchNorm.
+
+    Inside a CachedOp trace the update is collected and threaded out of the
+    jitted function; in eager mode it is applied immediately.
+    """
+    if _AUX.stack:
+        _AUX.stack[-1].append((param, new_value))
+    else:
+        param._data._set_data(new_value.data if isinstance(new_value, NDArray)
+                              else new_value)
+
+
+# ----------------------------------------------------------------------
+# Block
+# ----------------------------------------------------------------------
+
+class Block:
+    """Base class for all neural network layers and models.
+    Reference: gluon/block.py Block."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = {}
+        self._reg_params = {}
+        self._forward_hooks = []
+        self._forward_pre_hooks = []
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    # -- attribute magic ------------------------------------------------
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)) and \
+                    not isinstance(existing, type(value)):
+                raise MXNetError(
+                    f"Changing attribute type for {name} from "
+                    f"{type(existing)} to {type(value)} is not allowed.")
+        if isinstance(value, Block):
+            self._children[name] = value
+        elif isinstance(value, Parameter):
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    # -- public surface -------------------------------------------------
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    @property
+    def params(self):
+        return self._params
+
+    def name_scope(self):
+        return self._scope
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for child in self._children.values():
+            ret.update(child.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + name: p for name, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def save_parameters(self, filename, deduplicate=False):
+        """Reference: Block.save_parameters — structural dotted names."""
+        params = self._collect_params_with_prefix()
+        arg_dict = {}
+        for name, param in params.items():
+            if param._data is None:
+                continue
+            arg_dict[name] = param.data()
+        nd_utils.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        loaded = nd_utils.load(filename)
+        params = self._collect_params_with_prefix()
+        # also accept prefix-style names saved by ParameterDict.save
+        by_full_name = {p.name: p for p in params.values()}
+        for name, value in loaded.items():
+            key = name[4:] if name.startswith(("arg:", "aux:")) else name
+            if key in params:
+                params[key].set_data(value)
+            elif key in by_full_name:
+                by_full_name[key].set_data(value)
+            elif not ignore_extra:
+                raise MXNetError(
+                    f"Parameter '{key}' loaded from file '{filename}' is not "
+                    "present in this Block. Set ignore_extra=True to skip.")
+        if not allow_missing:
+            missing = [n for n, p in params.items()
+                       if p._data is None and p._deferred_init is None
+                       and n not in loaded and p.name not in loaded]
+            if missing:
+                raise MXNetError(
+                    f"Parameters {missing} not found in file '{filename}'")
+
+    # legacy aliases (reference deprecated names)
+    save_params = save_parameters
+    load_params = load_parameters
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks.append(hook)
+
+    def apply(self, fn):
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for param in self._reg_params.values():
+            param.cast(dtype)
+
+    def hybridize(self, active=True, **kwargs):
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        n_params = builtins_sum(int(_np.prod(p.shape))
+                                for p in self.collect_params().values()
+                                if p.shape)
+        print(f"{type(self).__name__}: {n_params} parameters, "
+              f"output shape {out.shape if isinstance(out, NDArray) else '-'}")
+        return out
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks:
+            hook(self, args)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __repr__(self):
+        s = f"{type(self).__name__}("
+        for name, child in self._children.items():
+            s += f"\n  ({name}): {child!r}"
+        return s + ("\n)" if self._children else ")")
+
+
+def builtins_sum(it):
+    total = 0
+    for x in it:
+        total += x
+    return total
+
+
+# ----------------------------------------------------------------------
+# CachedOp — the hybridize() engine
+# ----------------------------------------------------------------------
+
+class CachedOp:
+    """Shape-cached jitted executor for a HybridBlock subtree.
+    Reference: src/imperative/cached_op.{h,cc} (CachedOp::Forward)."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 inline_limit=2):
+        self.block = block
+        self.static_alloc = static_alloc
+        self.static_shape = static_shape
+        self._jitted = {}       # train_mode -> jitted fn
+        self._param_objs = None  # ordered params
+        self._out_tree = {}      # train_mode -> (n_out, structure)
+        self._aux_params = {}    # train_mode -> [Parameter]
+
+    def _collect(self):
+        if self._param_objs is None:
+            items = sorted(self.block.collect_params().items())
+            self._param_objs = [p for _, p in items]
+        return self._param_objs
+
+    def _make_pure(self, train):
+        block = self.block
+        cached = self
+
+        def _pure(key, param_arrays, input_arrays):
+            prev_train = _tape.set_training(train)
+            params = cached._param_objs
+            binding = {p: NDArray(a) for p, a in zip(params, param_arrays)}
+            try:
+                with _tape.trace_scope(), _bind_params(binding), \
+                        _rnd.trace_key_scope(key), _aux_scope() as aux:
+                    ins = [NDArray(a) for a in input_arrays]
+                    out = block.forward(*ins)
+            finally:
+                _tape.set_training(prev_train)
+            flat, tree = _flatten_output(out)
+            cached._out_tree[train] = (len(flat), tree)
+            cached._aux_params[train] = [p for p, _ in aux]
+            outs = tuple(o.data for o in flat) + \
+                tuple(v.data if isinstance(v, NDArray) else v for _, v in aux)
+            return outs
+        return _pure
+
+    def _get_jitted(self, train):
+        if train not in self._jitted:
+            self._jitted[train] = jax.jit(self._make_pure(train))
+        return self._jitted[train]
+
+    def __call__(self, *args):
+        params = self._collect()
+        # deferred shapes: run one eager pause()-mode forward to resolve
+        if any(p._data is None for p in params):
+            with _tape.trace_scope():
+                prev = _tape.set_training(_tape.is_training())
+                try:
+                    self.block.forward(*args)
+                finally:
+                    _tape.set_training(prev)
+            self._param_objs = None
+            params = self._collect()
+        train = _tape.is_training()
+        jfn = self._get_jitted(train)
+        key = _rnd.next_key()
+        n_params = len(params)
+        inputs = [p.data() for p in params] + list(args)
+
+        if train not in self._out_tree:
+            # trace abstractly once to learn output structure
+            _ = jax.eval_shape(
+                lambda *arrs: jfn(key, arrs[:n_params], arrs[n_params:]),
+                *[x.data for x in inputs])
+        n_out, tree = self._out_tree[train]
+        aux_params = self._aux_params[train]
+        total_out = n_out + len(aux_params)
+
+        def fn(*arrs):
+            outs = jfn(key, arrs[:n_params], arrs[n_params:])
+            return outs[0] if total_out == 1 else outs
+
+        outs, node = _tape.apply_op(fn, inputs, n_out=total_out,
+                                    name=f"CachedOp({self.block.name})")
+        ctx = args[0]._ctx if args else current_context()
+        results = []
+        for i in range(n_out):
+            o = NDArray(outs[i], ctx)
+            if node is not None:
+                o._node = node
+                o._out_index = i
+            results.append(o)
+        # write aux state back (running stats)
+        for p, new_val in zip(aux_params, outs[n_out:]):
+            p._data._set_data(new_val)
+        return _unflatten_output(results, tree)
+
+
+def _flatten_output(out):
+    if isinstance(out, NDArray):
+        return [out], "single"
+    if isinstance(out, (list, tuple)):
+        flat = []
+        tree = []
+        for o in out:
+            f, t = _flatten_output(o)
+            flat.extend(f)
+            tree.append((t, len(f)))
+        return flat, ("seq", type(out).__name__, tree)
+    raise MXNetError(f"unsupported forward output type {type(out)}")
+
+
+def _unflatten_output(flat, tree):
+    if tree == "single":
+        return flat[0]
+    _, typename, subtrees = tree
+    out = []
+    i = 0
+    for sub, n in subtrees:
+        out.append(_unflatten_output(flat[i:i + n], sub))
+        i += n
+    return tuple(out) if typename == "tuple" else out
+
+
+# ----------------------------------------------------------------------
+# HybridBlock
+# ----------------------------------------------------------------------
+
+class HybridBlock(Block):
+    """A Block that can be traced to XLA via hybridize().
+    Reference: gluon/block.py HybridBlock (hybridize / export / infer_shape).
+    """
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, static_alloc=False, static_shape=False,
+                  inline_limit=2, **kwargs):
+        self._active = active
+        self._flags = {"static_alloc": static_alloc,
+                       "static_shape": static_shape,
+                       "inline_limit": inline_limit}
+        self._cached_op = None
+        super().hybridize(active, static_alloc=static_alloc,
+                          static_shape=static_shape, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Resolve deferred parameter shapes from input shapes. Layers
+        override `_infer_shape_impl`; composite blocks resolve by running a
+        shape-only forward."""
+        self._infer_shape_impl(*args)
+
+    def _infer_shape_impl(self, *args):
+        raise DeferredInitializationError(
+            f"{type(self).__name__} cannot infer parameter shapes "
+            "automatically; run a forward pass first or set in_units/"
+            "in_channels explicitly.")
+
+    def __call__(self, *args, **kwargs):
+        if self._active and _tape._STATE.trace_depth == 0 and not kwargs:
+            if self._cached_op is None:
+                self._cached_op = CachedOp(self, **{
+                    k: v for k, v in self._flags.items()
+                    if k in ("static_alloc", "static_shape", "inline_limit")})
+            return self._cached_op(*args)
+        return super().__call__(*args, **kwargs)
+
+    def forward(self, *args, **kwargs):
+        """Gather this block's own params and call hybrid_forward.
+        Children are invoked inside hybrid_forward as attributes."""
+        from .. import ndarray as F
+        try:
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_init_params(*args)
+            params = {name: p.data() for name, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **params, **kwargs)
+
+    def _deferred_init_params(self, *args):
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            if p._data is None:
+                p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- export / import -----------------------------------------------
+    def export(self, path, epoch=0):
+        """Serialize the traced computation (StableHLO via jax.export) plus
+        parameters. Writes, like the reference (Block.export):
+          path-symbol.json   (metadata stub for ecosystem compat)
+          path-symbol.mlir   (the real artifact: serialized StableHLO)
+          path-%04d.params   (arg:/aux:-prefixed parameter file)
+        Requires at least one forward pass (to know input signatures) —
+        same constraint as the reference."""
+        if self._cached_op is None or not self._cached_op._jitted:
+            raise MXNetError(
+                "Please first call block.hybridize() and then run forward "
+                "with this block at least once before calling export.")
+        cached = self._cached_op
+        train = False if False in cached._jitted else \
+            list(cached._jitted)[0]
+        params = cached._collect()
+        arg_dict = {}
+        for p in params:
+            arg_dict[("aux:" if p.grad_req == "null" else "arg:") + p.name] = \
+                p.data()
+        nd_utils.save(f"{path}-{epoch:04d}.params", arg_dict)
+        meta = {
+            "format": "mxnet_tpu-stablehlo-v1",
+            "name": self.name,
+            "params": [p.name for p in params],
+            "train_mode": bool(train),
+            "nodes": [],  # symbol.json stub for tools that parse it
+        }
+        with open(f"{path}-symbol.json", "w") as f:
+            json.dump(meta, f, indent=2)
+        export_blob = getattr(self, "_export_blob", None)
+        if export_blob is not None:
+            with open(f"{path}-symbol.mlir", "wb") as f:
+                f.write(export_blob)
+        return f"{path}-symbol.json"
+
+
+class SymbolBlock(Block):
+    """Run a previously exported computation as a Block.
+    Reference: gluon/block.py SymbolBlock.imports(json, input_names, params).
+
+    On the TPU rebuild the portable artifact is params + the model-zoo
+    constructor; SymbolBlock.imports loads params into a rebuilt network or
+    wraps a raw callable."""
+
+    def __init__(self, outputs=None, inputs=None, params=None):
+        super().__init__(prefix="", params=None)
+        self._fn = outputs if callable(outputs) else None
+        self._arg_params = params or {}
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None,
+                builder=None):
+        with open(symbol_file) as f:
+            meta = json.load(f)
+        if builder is None:
+            raise MXNetError(
+                "SymbolBlock.imports on the TPU rebuild needs `builder`: a "
+                "zero-arg callable returning the network (e.g. a model_zoo "
+                "constructor). The exported graph is XLA-compiled, not a "
+                "portable nnvm json (see SURVEY.md §2.1 Symbol row).")
+        net = builder()
+        if param_file:
+            net.load_parameters(param_file, ctx=ctx)
+        return net
+
+    def forward(self, *args):
+        if self._fn is None:
+            raise MXNetError("SymbolBlock has no callable attached")
+        return self._fn(*args)

@@ -1,0 +1,3 @@
+"""``gluon.contrib`` (reference: python/mxnet/gluon/contrib/)."""
+from . import nn
+from . import estimator

@@ -1,0 +1,156 @@
+"""``gluon.contrib.estimator`` — high-level fit API.
+
+Reference [≥1.6]: python/mxnet/gluon/contrib/estimator/ (Estimator +
+event handlers). Compact rebuild covering train/eval loops with handlers.
+"""
+from __future__ import annotations
+
+import time
+
+from ...base import MXNetError
+from ... import metric as metric_mod
+from ... import autograd
+from ..trainer import Trainer
+
+__all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
+           "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
+           "LoggingHandler"]
+
+
+class TrainBegin:
+    def train_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class TrainEnd:
+    def train_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochBegin:
+    def epoch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class EpochEnd:
+    def epoch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchBegin:
+    def batch_begin(self, estimator, *args, **kwargs):
+        pass
+
+
+class BatchEnd:
+    def batch_end(self, estimator, *args, **kwargs):
+        pass
+
+
+class StoppingHandler(TrainBegin, BatchEnd, EpochEnd):
+    def __init__(self, max_epoch=None, max_batch=None):
+        self.max_epoch = max_epoch
+        self.max_batch = max_batch
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def train_begin(self, estimator, *args, **kwargs):
+        self.current_batch = 0
+        self.current_epoch = 0
+
+    def batch_end(self, estimator, *args, **kwargs):
+        self.current_batch += 1
+        if self.max_batch and self.current_batch >= self.max_batch:
+            estimator.stop_training = True
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        self.current_epoch += 1
+        if self.max_epoch and self.current_epoch >= self.max_epoch:
+            estimator.stop_training = True
+
+
+class MetricHandler(EpochBegin, BatchEnd):
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def epoch_begin(self, estimator, *args, **kwargs):
+        for m in self.metrics:
+            m.reset()
+
+    def batch_end(self, estimator, *args, **kwargs):
+        pred = kwargs.get("pred")
+        label = kwargs.get("label")
+        loss = kwargs.get("loss")
+        for m in self.metrics:
+            if isinstance(m, metric_mod.Loss):
+                m.update(0, loss)
+            else:
+                m.update(label, pred)
+
+
+class LoggingHandler(TrainBegin, TrainEnd, EpochEnd):
+    def __init__(self, log_interval="epoch", metrics=None):
+        self.metrics = metrics or []
+
+    def train_begin(self, estimator, *args, **kwargs):
+        print("Training begin")
+
+    def train_end(self, estimator, *args, **kwargs):
+        print("Training end")
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        msgs = [f"{name}={val:.6f}" for m in self.metrics
+                for name, val in m.get_name_value()]
+        print(f"Epoch {estimator.current_epoch}: " + " ".join(msgs))
+
+
+class Estimator:
+    def __init__(self, net, loss, train_metrics=None, trainer=None,
+                 context=None, val_metrics=None):
+        self.net = net
+        self.loss = loss
+        self.train_metrics = train_metrics or [metric_mod.Accuracy()]
+        if not isinstance(self.train_metrics, list):
+            self.train_metrics = [self.train_metrics]
+        self.trainer = trainer or Trainer(net.collect_params(), "sgd",
+                                          {"learning_rate": 0.01})
+        self.stop_training = False
+        self.current_epoch = 0
+
+    def prepare_loss_and_metrics(self):
+        return self.train_metrics
+
+    def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
+            batches=None):
+        if epochs is None and batches is None:
+            raise MXNetError("specify epochs or batches")
+        handlers = list(event_handlers or [])
+        handlers.append(StoppingHandler(epochs, batches))
+        handlers.append(MetricHandler(self.train_metrics))
+        for h in handlers:
+            if isinstance(h, TrainBegin):
+                h.train_begin(self)
+        self.stop_training = False
+        while not self.stop_training:
+            for h in handlers:
+                if isinstance(h, EpochBegin):
+                    h.epoch_begin(self)
+            for batch in train_data:
+                data, label = batch[0], batch[1]
+                with autograd.record():
+                    pred = self.net(data)
+                    loss = self.loss(pred, label)
+                loss.backward()
+                self.trainer.step(data.shape[0])
+                for h in handlers:
+                    if isinstance(h, BatchEnd):
+                        h.batch_end(self, pred=pred, label=label, loss=loss)
+                if self.stop_training:
+                    break
+            for h in handlers:
+                if isinstance(h, EpochEnd):
+                    h.epoch_end(self)
+            self.current_epoch += 1
+        for h in handlers:
+            if isinstance(h, TrainEnd):
+                h.train_end(self)

@@ -1,0 +1,69 @@
+"""``gluon.contrib.nn`` — notably SyncBatchNorm.
+
+Reference: python/mxnet/gluon/contrib/nn/basic_layers.py. SyncBatchNorm is
+Hang Zhang's cross-device BN (SURVEY.md §2.2 "Gluon contrib"): the reference
+synchronized batch statistics across GPUs through the KVStore/comm layer.
+TPU-native: when the batch is sharded over a mesh 'dp' axis inside a jitted
+step, jnp.mean over the batch axis IS the cross-replica mean (XLA lowers it
+to a psum over the shards) — so SyncBatchNorm falls out of the sharding
+algebra. The class remains for API parity and for the eager path.
+"""
+from __future__ import annotations
+
+from ..nn.basic_layers import BatchNorm
+from ..block import HybridBlock
+from .. import nn as _nn
+
+__all__ = ["SyncBatchNorm", "Identity", "Concurrent", "HybridConcurrent"]
+
+
+class SyncBatchNorm(BatchNorm):
+    """Cross-device synchronized BatchNorm.
+
+    With a sharded batch inside jit/DataParallelTrainer the statistics are
+    global automatically; num_devices is accepted for API compatibility."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)
+        self._num_devices = num_devices
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class Concurrent(_nn.Sequential):
+    """Parallel branches concatenated along `axis` (reference
+    contrib.nn.Concurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def forward(self, x):
+        from ... import ndarray as nd
+        out = [block(x) for block in self._children.values()]
+        return nd.concat(*out, dim=self.axis)
+
+
+class HybridConcurrent(_nn.HybridSequential):
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        out = [block(x) for block in self._children.values()]
+        return F.concat(*out, dim=self.axis)

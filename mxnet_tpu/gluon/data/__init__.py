@@ -1,0 +1,5 @@
+"""``gluon.data`` (reference: python/mxnet/gluon/data/)."""
+from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
+from .dataloader import (DataLoader, default_batchify_fn, Sampler,
+                         SequentialSampler, RandomSampler, BatchSampler)
+from . import vision

@@ -1,0 +1,257 @@
+"""Vision datasets: MNIST / FashionMNIST / CIFAR10/100 / ImageRecordDataset.
+
+Reference: python/mxnet/gluon/data/vision/datasets.py. Downloads are
+unavailable (no egress): datasets read from local files in the standard
+formats, or generate deterministic synthetic data when
+``synthetic=True``/MXTPU_SYNTHETIC_DATA=1 — used by tests and benchmarks
+(same role as tests/python/train synthetic paths in the reference).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import array, NDArray
+from ..dataset import Dataset, ArrayDataset
+
+__all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
+           "ImageRecordDataset", "ImageFolderDataset"]
+
+
+def _synthetic_enabled(flag):
+    return flag or os.environ.get("MXTPU_SYNTHETIC_DATA", "0") == "1"
+
+
+class _DownloadedDataset(Dataset):
+    def __init__(self, root, transform):
+        self._transform = transform
+        self._data = None
+        self._label = None
+        self._root = os.path.expanduser(root)
+        self._get_data()
+
+    def __getitem__(self, idx):
+        if self._transform is not None:
+            return self._transform(self._data[idx], self._label[idx])
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+    def _get_data(self):
+        raise NotImplementedError
+
+
+class MNIST(_DownloadedDataset):
+    """MNIST from local idx-format files (train-images-idx3-ubyte[.gz] etc.)
+    or synthetic digits when unavailable."""
+
+    _shape = (28, 28, 1)
+    _nclass = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "mnist"),
+                 train=True, transform=None, synthetic=False, size=None):
+        self._train = train
+        self._synthetic = _synthetic_enabled(synthetic)
+        self._size = size
+        super().__init__(root, transform)
+
+    def _file_names(self):
+        if self._train:
+            return "train-images-idx3-ubyte", "train-labels-idx1-ubyte"
+        return "t10k-images-idx3-ubyte", "t10k-labels-idx1-ubyte"
+
+    def _get_data(self):
+        img_name, lbl_name = self._file_names()
+        img_path = os.path.join(self._root, img_name)
+        lbl_path = os.path.join(self._root, lbl_name)
+        if not self._synthetic and (
+                os.path.exists(img_path) or os.path.exists(img_path + ".gz")):
+            self._data, self._label = _read_idx(img_path, lbl_path)
+        else:
+            n = self._size or (6000 if self._train else 1000)
+            self._data, self._label = _synthetic_digits(n, self._shape,
+                                                        self._nclass,
+                                                        seed=1 if self._train
+                                                        else 2)
+        self._data = array(self._data.astype("float32") / 255.0
+                           if self._data.dtype == _np.uint8
+                           else self._data, dtype="float32")
+        # keep uint8-style HWC uint8 semantics? reference returns uint8 HWC;
+        # transforms.ToTensor does the scaling. We return float [0,1] HWC
+        # scaled only if no transform provided handles it — match reference:
+        self._label = self._label.astype("int32")
+
+    def __getitem__(self, idx):
+        data = self._data[idx]
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+def _read_idx(img_path, lbl_path):
+    def opener(p):
+        if os.path.exists(p):
+            return open(p, "rb")
+        return gzip.open(p + ".gz", "rb")
+    with opener(lbl_path) as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        label = _np.frombuffer(f.read(), dtype=_np.uint8).astype(_np.int32)
+    with opener(img_path) as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        data = _np.frombuffer(f.read(), dtype=_np.uint8).reshape(
+            num, rows, cols, 1)
+    return data, label
+
+
+def _synthetic_digits(n, shape, nclass, seed=0):
+    """Deterministic class-separable synthetic data with SPATIALLY SMOOTH
+    per-class templates (low-frequency patterns upsampled from a coarse
+    grid), so conv+pool architectures can learn it like real digits — iid
+    noise templates would be adversarial for convnets."""
+    rng = _np.random.RandomState(seed)
+    h, w = shape[0], shape[1]
+    c = shape[2] if len(shape) > 2 else 1
+    coarse = _np.random.RandomState(42).uniform(
+        0, 1, (nclass, 5, 5, c)).astype("float32")
+    # bilinear upsample 5x5 -> HxW per class
+    ys = _np.linspace(0, 4, h)
+    xs = _np.linspace(0, 4, w)
+    y0 = _np.floor(ys).astype(int)
+    x0 = _np.floor(xs).astype(int)
+    y1 = _np.minimum(y0 + 1, 4)
+    x1 = _np.minimum(x0 + 1, 4)
+    wy = (ys - y0)[None, :, None, None]
+    wx = (xs - x0)[None, None, :, None]
+    templates = (coarse[:, y0][:, :, x0] * (1 - wy) * (1 - wx) +
+                 coarse[:, y1][:, :, x0] * wy * (1 - wx) +
+                 coarse[:, y0][:, :, x1] * (1 - wy) * wx +
+                 coarse[:, y1][:, :, x1] * wy * wx)
+    labels = rng.randint(0, nclass, n).astype("int32")
+    noise = rng.uniform(0, 0.25, (n,) + tuple(shape)).astype("float32")
+    data = templates[labels].reshape((-1,) + tuple(shape)) * 0.75 + noise
+    return (_np.clip(data, 0, 1) * 255).astype(_np.uint8), labels
+
+
+class FashionMNIST(MNIST):
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "fashion-mnist"),
+                 train=True, transform=None, synthetic=False, size=None):
+        super().__init__(root, train, transform, synthetic, size)
+
+
+class CIFAR10(_DownloadedDataset):
+    _shape = (32, 32, 3)
+    _nclass = 10
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets", "cifar10"),
+                 train=True, transform=None, synthetic=False, size=None):
+        self._train = train
+        self._synthetic = _synthetic_enabled(synthetic)
+        self._size = size
+        super().__init__(root, transform)
+
+    def _get_data(self):
+        files = [f"data_batch_{i}.bin" for i in range(1, 6)] if self._train \
+            else ["test_batch.bin"]
+        paths = [os.path.join(self._root, f) for f in files]
+        if not self._synthetic and all(os.path.exists(p) for p in paths):
+            data, label = [], []
+            for p in paths:
+                raw = _np.fromfile(p, dtype=_np.uint8).reshape(-1, 3073)
+                label.append(raw[:, 0])
+                data.append(raw[:, 1:].reshape(-1, 3, 32, 32)
+                            .transpose(0, 2, 3, 1))
+            self._data = _np.concatenate(data)
+            self._label = _np.concatenate(label).astype("int32")
+        else:
+            n = self._size or (5000 if self._train else 1000)
+            self._data, self._label = _synthetic_digits(
+                n, self._shape, self._nclass, seed=3 if self._train else 4)
+        self._data = array(self._data.astype("float32") / 255.0,
+                           dtype="float32")
+        self._label = self._label.astype("int32")
+
+    def __getitem__(self, idx):
+        data = self._data[idx]
+        label = self._label[idx]
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+
+class CIFAR100(CIFAR10):
+    _nclass = 100
+
+    def __init__(self, root=os.path.join("~", ".mxnet", "datasets",
+                                         "cifar100"),
+                 fine_label=False, train=True, transform=None,
+                 synthetic=False, size=None):
+        self._fine_label = fine_label
+        super().__init__(root, train, transform, synthetic, size)
+
+
+class ImageRecordDataset(Dataset):
+    """Images + labels from a RecordIO file (reference
+    vision.ImageRecordDataset)."""
+
+    def __init__(self, filename, flag=1, transform=None):
+        from ..dataset import RecordFileDataset
+        from .... import recordio, image
+        self._rec = RecordFileDataset(filename)
+        self._flag = flag
+        self._transform = transform
+
+    def __getitem__(self, idx):
+        from .... import recordio, image
+        record = self._rec[idx]
+        header, img = recordio.unpack(record)
+        data = image.imdecode(img, self._flag)
+        label = header.label
+        if self._transform is not None:
+            return self._transform(data, label)
+        return data, label
+
+    def __len__(self):
+        return len(self._rec)
+
+
+class ImageFolderDataset(Dataset):
+    """folder/class_x/*.jpg layout (reference vision.ImageFolderDataset)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = [".jpg", ".jpeg", ".png"]
+        self._list_images(self._root)
+
+    def _list_images(self, root):
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(root)):
+            path = os.path.join(root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for filename in sorted(os.listdir(path)):
+                if os.path.splitext(filename)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, filename), label))
+
+    def __getitem__(self, idx):
+        from .... import image
+        with open(self.items[idx][0], "rb") as f:
+            img = image.imdecode(f.read(), self._flag)
+        label = self.items[idx][1]
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label
+
+    def __len__(self):
+        return len(self.items)

@@ -1,0 +1,178 @@
+"""``gluon.data.vision.transforms`` — image transforms as (Hybrid)Blocks.
+
+Reference: python/mxnet/gluon/data/vision/transforms.py (ToTensor, Normalize,
+Resize, CenterCrop, RandomResizedCrop, RandomFlipLeftRight, Cast, Compose).
+Pixel transforms run on host numpy (the input pipeline side of the fence);
+normalization also works on device arrays.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray.ndarray import NDArray, array
+from ...block import Block, HybridBlock
+from ...nn import Sequential, HybridSequential
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
+           "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
+           "RandomCrop"]
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+class Compose(Sequential):
+    """Sequentially composes transforms. Reference: transforms.Compose."""
+
+    def __init__(self, transforms):
+        super().__init__()
+        for t in transforms:
+            self.add(t)
+
+
+class Cast(HybridBlock):
+    def __init__(self, dtype="float32"):
+        super().__init__()
+        self._dtype = dtype
+
+    def hybrid_forward(self, F, x):
+        return x.astype(self._dtype)
+
+
+class ToTensor(Block):
+    """HWC uint8/float [0,255 or 0,1] -> CHW float32 [0,1].
+    Reference: transforms.ToTensor."""
+
+    def forward(self, x):
+        np_x = _to_np(x).astype("float32")
+        if np_x.max() > 1.5:
+            np_x = np_x / 255.0
+        if np_x.ndim == 3:
+            np_x = np_x.transpose(2, 0, 1)
+        elif np_x.ndim == 4:
+            np_x = np_x.transpose(0, 3, 1, 2)
+        return array(np_x)
+
+
+class Normalize(Block):
+    def __init__(self, mean=0.0, std=1.0):
+        super().__init__()
+        self._mean = _np.asarray(mean, dtype="float32")
+        self._std = _np.asarray(std, dtype="float32")
+
+    def forward(self, x):
+        np_x = _to_np(x).astype("float32")
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return array((np_x - mean) / std)
+
+
+def _resize_np(img, size):
+    """Bilinear resize in numpy (no cv2 dependency guarantee)."""
+    h, w = img.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    ys = _np.linspace(0, h - 1, oh)
+    xs = _np.linspace(0, w - 1, ow)
+    y0 = _np.floor(ys).astype(int)
+    x0 = _np.floor(xs).astype(int)
+    y1 = _np.minimum(y0 + 1, h - 1)
+    x1 = _np.minimum(x0 + 1, w - 1)
+    wy = (ys - y0)[:, None, None]
+    wx = (xs - x0)[None, :, None]
+    img = img.astype("float32")
+    out = (img[_np.ix_(y0, x0)] * (1 - wy) * (1 - wx) +
+           img[_np.ix_(y1, x0)] * wy * (1 - wx) +
+           img[_np.ix_(y0, x1)] * (1 - wy) * wx +
+           img[_np.ix_(y1, x1)] * wy * wx)
+    return out
+
+
+class Resize(Block):
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        super().__init__()
+        self._size = size
+        self._keep = keep_ratio
+
+    def forward(self, x):
+        return array(_resize_np(_to_np(x), self._size))
+
+
+class CenterCrop(Block):
+    def __init__(self, size, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def forward(self, x):
+        np_x = _to_np(x)
+        h, w = np_x.shape[:2]
+        cw, ch = self._size
+        x0 = max((w - cw) // 2, 0)
+        y0 = max((h - ch) // 2, 0)
+        return array(np_x[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomCrop(Block):
+    def __init__(self, size, pad=None, interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._pad = pad
+
+    def forward(self, x):
+        np_x = _to_np(x)
+        if self._pad:
+            np_x = _np.pad(np_x, ((self._pad, self._pad),
+                                  (self._pad, self._pad), (0, 0)),
+                           mode="constant")
+        h, w = np_x.shape[:2]
+        cw, ch = self._size
+        x0 = _np.random.randint(0, max(w - cw, 0) + 1)
+        y0 = _np.random.randint(0, max(h - ch, 0) + 1)
+        return array(np_x[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomResizedCrop(Block):
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3.0 / 4.0, 4.0 / 3.0),
+                 interpolation=1):
+        super().__init__()
+        self._size = (size, size) if isinstance(size, int) else size
+        self._scale = scale
+        self._ratio = ratio
+
+    def forward(self, x):
+        np_x = _to_np(x)
+        h, w = np_x.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target_area = _np.random.uniform(*self._scale) * area
+            aspect = _np.random.uniform(*self._ratio)
+            cw = int(round(_np.sqrt(target_area * aspect)))
+            ch = int(round(_np.sqrt(target_area / aspect)))
+            if cw <= w and ch <= h:
+                x0 = _np.random.randint(0, w - cw + 1)
+                y0 = _np.random.randint(0, h - ch + 1)
+                crop = np_x[y0:y0 + ch, x0:x0 + cw]
+                return array(_resize_np(crop, self._size))
+        return array(_resize_np(np_x, self._size))
+
+
+class RandomFlipLeftRight(Block):
+    def forward(self, x):
+        np_x = _to_np(x)
+        if _np.random.rand() < 0.5:
+            np_x = np_x[:, ::-1].copy()
+        return array(np_x)
+
+
+class RandomFlipTopBottom(Block):
+    def forward(self, x):
+        np_x = _to_np(x)
+        if _np.random.rand() < 0.5:
+            np_x = np_x[::-1].copy()
+        return array(np_x)

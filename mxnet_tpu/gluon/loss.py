@@ -1,0 +1,365 @@
+"""Gluon losses.
+
+Reference: python/mxnet/gluon/loss.py (Loss base with weight/batch_axis and
+sample_weight support; L2, L1, SigmoidBCE, SoftmaxCE, KLDiv, Huber, Hinge,
+SquaredHinge, Logistic, Triplet, CTC, Cosine, PoissonNLL).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, apply_nary
+from .block import HybridBlock
+
+__all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
+           "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
+           "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
+           "LogisticLoss", "TripletLoss", "CTCLoss", "CosineEmbeddingLoss",
+           "PoissonNLLLoss"]
+
+
+def _apply_weighting(F, loss, weight=None, sample_weight=None):
+    if sample_weight is not None:
+        loss = loss * sample_weight
+    if weight is not None:
+        loss = loss * weight
+    return loss
+
+
+def _reshape_like(F, x, y):
+    return x.reshape(y.shape)
+
+
+class Loss(HybridBlock):
+    def __init__(self, weight, batch_axis, **kwargs):
+        super().__init__(**kwargs)
+        self._weight = weight
+        self._batch_axis = batch_axis
+
+    def __repr__(self):
+        return f"{type(self).__name__}(batch_axis={self._batch_axis}, w={self._weight})"
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+
+class L2Loss(Loss):
+    r"""0.5 * (pred - label)^2, mean over non-batch axes."""
+
+    def __init__(self, weight=1.0, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.square(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(F, loss, self._weight / 2, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class L1Loss(Loss):
+    def __init__(self, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        loss = F.abs(label.reshape(pred.shape) - pred)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class SigmoidBinaryCrossEntropyLoss(Loss):
+    def __init__(self, from_sigmoid=False, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_sigmoid = from_sigmoid
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None,
+                       pos_weight=None):
+        if not self._from_sigmoid:
+            def fn(p, l):
+                # max(x,0) - x*z + log(1+exp(-|x|)) — numerically stable
+                return jnp.maximum(p, 0) - p * l.reshape(p.shape) + \
+                    jnp.log1p(jnp.exp(-jnp.abs(p)))
+            loss = apply_nary(fn, [pred, label], name="sigmoid_bce")
+        else:
+            eps = 1e-12
+            def fn(p, l):
+                l = l.reshape(p.shape)
+                return -(jnp.log(p + eps) * l +
+                         jnp.log(1 - p + eps) * (1 - l))
+            loss = apply_nary(fn, [pred, label], name="sigmoid_bce")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+SigmoidBCELoss = SigmoidBinaryCrossEntropyLoss
+
+
+class SoftmaxCrossEntropyLoss(Loss):
+    """Reference: gluon.loss.SoftmaxCrossEntropyLoss (sparse_label default
+    True, axis -1)."""
+
+    def __init__(self, axis=-1, sparse_label=True, from_logits=False,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._axis = axis
+        self._sparse_label = sparse_label
+        self._from_logits = from_logits
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        axis = self._axis
+        sparse = self._sparse_label
+        from_logits = self._from_logits
+        def fn(p, l):
+            logp = p if from_logits else jax.nn.log_softmax(p, axis=axis)
+            if sparse:
+                li = l.astype(jnp.int32)
+                if li.ndim == logp.ndim:
+                    li = li.squeeze(axis)
+                picked = jnp.take_along_axis(
+                    logp, jnp.expand_dims(li, axis), axis=axis)
+                return -picked.squeeze(axis)
+            return -jnp.sum(logp * l, axis=axis)
+        loss = apply_nary(fn, [pred, label], name="softmax_ce")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis)) \
+            if loss.ndim > 1 else loss
+
+
+SoftmaxCELoss = SoftmaxCrossEntropyLoss
+
+
+class KLDivLoss(Loss):
+    def __init__(self, from_logits=True, axis=-1, weight=None, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._axis = axis
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        axis, from_logits = self._axis, self._from_logits
+        def fn(p, l):
+            logp = p if from_logits else jax.nn.log_softmax(p, axis=axis)
+            return jnp.mean(l * (jnp.log(jnp.maximum(l, 1e-12)) - logp),
+                            axis=axis)
+        loss = apply_nary(fn, [pred, label], name="kldiv")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis)) \
+            if loss.ndim > 1 else loss
+
+
+class HuberLoss(Loss):
+    def __init__(self, rho=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._rho = rho
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        rho = self._rho
+        def fn(p, l):
+            a = jnp.abs(l.reshape(p.shape) - p)
+            return jnp.where(a > rho, a - 0.5 * rho,
+                             (0.5 / rho) * jnp.square(a))
+        loss = apply_nary(fn, [pred, label], name="huber")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class HingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        m = self._margin
+        def fn(p, l):
+            return jnp.maximum(m - p * l.reshape(p.shape), 0)
+        loss = apply_nary(fn, [pred, label], name="hinge")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class SquaredHingeLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        m = self._margin
+        def fn(p, l):
+            return jnp.square(jnp.maximum(m - p * l.reshape(p.shape), 0))
+        loss = apply_nary(fn, [pred, label], name="sq_hinge")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class LogisticLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, label_format="signed",
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        if label_format not in ("signed", "binary"):
+            raise MXNetError(f"bad label_format {label_format}")
+        self._label_format = label_format
+
+    def hybrid_forward(self, F, pred, label, sample_weight=None):
+        fmt = self._label_format
+        def fn(p, l):
+            l = l.reshape(p.shape)
+            if fmt == "signed":
+                l = (l + 1.0) / 2.0
+            return jnp.maximum(p, 0) - p * l + jnp.log1p(jnp.exp(-jnp.abs(p)))
+        loss = apply_nary(fn, [pred, label], name="logistic")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean(axis=tuple(i for i in range(loss.ndim)
+                                    if i != self._batch_axis))
+
+
+class TripletLoss(Loss):
+    def __init__(self, margin=1, weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, pred, positive, negative, sample_weight=None):
+        m = self._margin
+        def fn(p, pos, neg):
+            d = jnp.sum(jnp.square(pos.reshape(p.shape) - p) -
+                        jnp.square(neg.reshape(p.shape) - p),
+                        axis=tuple(range(1, p.ndim)))
+            return jnp.maximum(d + m, 0)
+        loss = apply_nary(fn, [pred, positive, negative], name="triplet")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class CTCLoss(Loss):
+    """Connectionist temporal classification.
+
+    Reference: gluon.loss.CTCLoss over src/operator/contrib/ctc_loss.cc.
+    Implemented with the standard alpha-recursion in log space via lax.scan
+    (TPU-friendly: static shapes, no host sync). layout TNC default."""
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None, **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"bad layout {layout}")
+        self._layout = layout
+        self._label_layout = label_layout
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        layout = self._layout
+        def fn(p, l, *opt):
+            if layout == "NTC":
+                p = jnp.swapaxes(p, 0, 1)  # -> TNC
+            T, N, C = p.shape
+            logp = jax.nn.log_softmax(p, axis=-1)
+            lab = l.astype(jnp.int32)
+            L = lab.shape[1]
+            pl = opt[0].astype(jnp.int32) if len(opt) > 0 else \
+                jnp.full((N,), T, jnp.int32)
+            ll = opt[1].astype(jnp.int32) if len(opt) > 1 else \
+                jnp.sum((lab >= 0) & (lab != 0) if False else (lab >= 0),
+                        axis=1).astype(jnp.int32)
+            if len(opt) <= 1:
+                ll = jnp.full((N,), L, jnp.int32)
+            # extended label seq with blanks (blank = 0 per MXNet default)
+            S = 2 * L + 1
+            ext = jnp.zeros((N, S), jnp.int32)
+            ext = ext.at[:, 1::2].set(lab)
+            neg_inf = jnp.asarray(-1e30, logp.dtype)
+            alpha0 = jnp.full((N, S), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.take_along_axis(logp[0], ext[:, 1:2], axis=1).squeeze(1))
+
+            same_as_prev2 = jnp.concatenate(
+                [jnp.ones((N, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, logp_t):
+                a_shift1 = jnp.concatenate(
+                    [jnp.full((N, 1), neg_inf), alpha[:, :-1]], axis=1)
+                a_shift2 = jnp.concatenate(
+                    [jnp.full((N, 2), neg_inf), alpha[:, :-2]], axis=1)
+                a2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+                merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a2)
+                emit = jnp.take_along_axis(logp_t, ext, axis=1)
+                return merged + emit, None
+
+            def scan_body(carry, t):
+                alpha = carry
+                new_alpha, _ = step(alpha, logp[t])
+                alpha = jnp.where((t < pl)[:, None], new_alpha, alpha)
+                return alpha, None
+
+            alpha, _ = lax_scan(scan_body, alpha0, jnp.arange(1, T))
+            end_idx = 2 * ll - 1
+            last = jnp.take_along_axis(alpha, end_idx[:, None], axis=1).squeeze(1)
+            last_blank = jnp.take_along_axis(alpha, (2 * ll)[:, None],
+                                             axis=1).squeeze(1)
+            return -jnp.logaddexp(last, last_blank)
+        inputs = [pred, label]
+        if pred_lengths is not None:
+            inputs.append(pred_lengths)
+        if label_lengths is not None:
+            inputs.append(label_lengths)
+        loss = apply_nary(fn, inputs, name="ctc")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+def lax_scan(f, init, xs):
+    from jax import lax
+    return lax.scan(f, init, xs)
+
+
+class CosineEmbeddingLoss(Loss):
+    def __init__(self, weight=None, batch_axis=0, margin=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._margin = margin
+
+    def hybrid_forward(self, F, input1, input2, label, sample_weight=None):
+        m = self._margin
+        def fn(a, b, l):
+            a2 = a.reshape(a.shape[0], -1)
+            b2 = b.reshape(b.shape[0], -1)
+            cos = jnp.sum(a2 * b2, axis=1) / (
+                jnp.linalg.norm(a2, axis=1) * jnp.linalg.norm(b2, axis=1)
+                + 1e-12)
+            l = l.reshape(cos.shape)
+            return jnp.where(l == 1, 1 - cos, jnp.maximum(cos - m, 0))
+        loss = apply_nary(fn, [input1, input2, label], name="cosine")
+        return _apply_weighting(F, loss, self._weight, sample_weight)
+
+
+class PoissonNLLLoss(Loss):
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None, epsilon=1e-08):
+        from_logits = self._from_logits
+        full = self._compute_full
+        def fn(p, t):
+            t = t.reshape(p.shape)
+            if from_logits:
+                loss = jnp.exp(p) - t * p
+            else:
+                loss = p - t * jnp.log(p + epsilon)
+            if full:
+                stirling = t * jnp.log(jnp.maximum(t, 1.0)) - t + \
+                    0.5 * jnp.log(2 * _np.pi * jnp.maximum(t, 1.0))
+                loss = loss + jnp.where(t > 1, stirling, jnp.zeros_like(t))
+            return loss
+        loss = apply_nary(fn, [pred, target], name="poisson_nll")
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return loss.mean()

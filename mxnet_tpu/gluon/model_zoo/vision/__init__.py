@@ -1,0 +1,42 @@
+"""``gluon.model_zoo.vision`` — in-repo vision models.
+
+Reference: python/mxnet/gluon/model_zoo/vision/ (alexnet, densenet,
+inception, resnet v1/v2, squeezenet, vgg, mobilenet v1/v2) — SURVEY.md §2.2.
+"""
+from .resnet import *  # noqa: F401,F403
+from .alexnet import *  # noqa: F401,F403
+from .vgg import *  # noqa: F401,F403
+from .squeezenet import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
+from .densenet import *  # noqa: F401,F403
+from .inception import *  # noqa: F401,F403
+
+from ....base import MXNetError
+
+
+_MODELS = {}
+
+
+def _register_models():
+    import importlib
+    mods = [importlib.import_module(f"{__name__}.{m}")
+            for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
+                      "densenet", "inception")]
+    for mod in mods:
+        for name in mod.__all__:
+            fn = getattr(mod, name)
+            if callable(fn) and name[0].islower() and \
+                    not name.startswith("get_"):
+                _MODELS[name] = fn
+
+
+_register_models()
+
+
+def get_model(name, **kwargs):
+    """Reference: model_zoo.vision.get_model(name)."""
+    name = name.lower().replace("-", "_")
+    if name not in _MODELS:
+        raise MXNetError(
+            f"Model {name} is not supported. Available: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)

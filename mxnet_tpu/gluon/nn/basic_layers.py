@@ -1,0 +1,486 @@
+"""Gluon basic layers.
+
+Reference: python/mxnet/gluon/nn/basic_layers.py (Dense, Dropout, BatchNorm,
+InstanceNorm, LayerNorm, Embedding, Flatten, Lambda, HybridLambda,
+Sequential, HybridSequential) and activations.py.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray
+from ... import ndarray as nd
+from ..block import Block, HybridBlock, record_aux_update
+from ..parameter import DeferredInitializationError
+
+__all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
+           "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
+           "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU",
+           "ELU", "SELU", "Swish", "GELU"]
+
+
+class Sequential(Block):
+    """Stacks Blocks sequentially. Reference: nn.Sequential."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def forward(self, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                x, *args = x
+        return (x,) + tuple(args) if args else x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+    def hybridize(self, active=True, **kwargs):
+        if self._children and all(isinstance(c, HybridBlock)
+                                  for c in self._children.values()):
+            import warnings
+            warnings.warn(
+                f"All children of {type(self).__name__} are HybridBlocks; "
+                "consider HybridSequential for the jit fast path.",
+                stacklevel=2)
+        super().hybridize(active, **kwargs)
+
+
+class HybridSequential(HybridBlock):
+    """Stacks HybridBlocks; hybridize() jit-compiles the whole chain.
+    Reference: nn.HybridSequential."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+
+    def hybrid_forward(self, F, x, *args):
+        for block in self._children.values():
+            x = block(x, *args)
+            args = ()
+            if isinstance(x, (tuple, list)):
+                x, *args = x
+        return (x,) + tuple(args) if args else x
+
+    def __getitem__(self, key):
+        layers = list(self._children.values())[key]
+        if isinstance(layers, list):
+            net = type(self)(prefix=self._prefix)
+            net.add(*layers)
+            return net
+        return layers
+
+    def __len__(self):
+        return len(self._children)
+
+
+class Dense(HybridBlock):
+    """Fully connected layer: out = act(x . W^T + b); weight is (units,
+    in_units) — the reference's layout (nn.Dense over FullyConnected,
+    src/operator/nn/fully_connected.cc)."""
+
+    def __init__(self, units, activation=None, use_bias=True, flatten=True,
+                 dtype="float32", weight_initializer=None,
+                 bias_initializer="zeros", in_units=0, **kwargs):
+        super().__init__(**kwargs)
+        self._units = units
+        self._flatten = flatten
+        self._act_type = activation
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(units, in_units), dtype=dtype,
+                init=weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(units,), dtype=dtype,
+                    init=bias_initializer, allow_deferred_init=True)
+            else:
+                self.bias = None
+
+    def _infer_shape_impl(self, x):
+        if self._flatten:
+            in_units = int(_np.prod(x.shape[1:]))
+        else:
+            in_units = x.shape[-1]
+        self.weight.shape_updated((self._units, in_units))
+
+    def infer_shape(self, x, *args):
+        self._infer_shape_impl(x)
+
+    def hybrid_forward(self, F, x, weight, bias=None):
+        out = F.FullyConnected(x, weight, bias, num_hidden=self._units,
+                               no_bias=bias is None, flatten=self._flatten)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out
+
+    def __repr__(self):
+        shape = self.weight.shape
+        return (f"Dense({shape[1] if shape and len(shape) > 1 else None} -> "
+                f"{self._units}, "
+                f"{self._act_type if self._act_type else 'linear'})")
+
+
+class Dropout(HybridBlock):
+    """Reference: nn.Dropout over src/operator/nn/dropout.cc."""
+
+    def __init__(self, rate, axes=(), **kwargs):
+        super().__init__(**kwargs)
+        self._rate = rate
+        self._axes = axes
+
+    def hybrid_forward(self, F, x):
+        return F.Dropout(x, p=self._rate, axes=self._axes)
+
+    def __repr__(self):
+        return f"Dropout(p = {self._rate}, axes={self._axes})"
+
+
+class BatchNorm(HybridBlock):
+    """Batch normalization with running stats as aux (non-grad) state.
+
+    Reference: nn.BatchNorm over src/operator/nn/batch_norm.cc. The running
+    mean/var updates are threaded out of jit via record_aux_update (SURVEY.md
+    §7 hard parts)."""
+
+    def __init__(self, axis=1, momentum=0.9, epsilon=1e-5, center=True,
+                 scale=True, use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._momentum = momentum
+        self._epsilon = epsilon
+        self._center = center
+        self._scale = scale
+        self._use_global_stats = use_global_stats
+        self.in_channels = in_channels
+        with self.name_scope():
+            self.gamma = self.params.get(
+                "gamma", grad_req="write" if scale else "null",
+                shape=(in_channels,), init=gamma_initializer,
+                allow_deferred_init=True, differentiable=scale)
+            self.beta = self.params.get(
+                "beta", grad_req="write" if center else "null",
+                shape=(in_channels,), init=beta_initializer,
+                allow_deferred_init=True, differentiable=center)
+            self.running_mean = self.params.get(
+                "running_mean", grad_req="null", shape=(in_channels,),
+                init=running_mean_initializer, allow_deferred_init=True,
+                differentiable=False)
+            self.running_var = self.params.get(
+                "running_var", grad_req="null", shape=(in_channels,),
+                init=running_variance_initializer, allow_deferred_init=True,
+                differentiable=False)
+
+    def infer_shape(self, x, *args):
+        channels = x.shape[self._axis]
+        for p in (self.gamma, self.beta, self.running_mean, self.running_var):
+            p.shape_updated((channels,))
+
+    def cast(self, dtype):
+        if _np.dtype(dtype).name in ("float16",) or str(dtype) == "bfloat16":
+            dtype = "float32"  # BN statistics stay fp32 (reference behavior)
+        super().cast(dtype)
+
+    def hybrid_forward(self, F, x, gamma, beta, running_mean, running_var):
+        from ... import _tape
+        training = _tape.is_training() and not self._use_global_stats
+        d = x.data
+        shape = [1] * d.ndim
+        shape[self._axis] = d.shape[self._axis]
+        axis = self._axis
+        eps = self._epsilon
+        scale, center = self._scale, self._center
+        import jax.lax as lax
+
+        def fn(dd, gg, bb, m_, v_):
+            if training:
+                # batch stats computed INSIDE the differentiated function so
+                # the d(mean)/dx and d(var)/dx terms are in the gradient
+                axes = tuple(i for i in range(dd.ndim) if i != axis)
+                m_ = jnp.mean(dd, axis=axes)
+                v_ = jnp.var(dd, axis=axes)
+            inv = lax.rsqrt(v_.astype(dd.dtype) + eps)
+            out = (dd - m_.astype(dd.dtype).reshape(shape)) * inv.reshape(shape)
+            if scale:
+                out = out * gg.astype(dd.dtype).reshape(shape)
+            if center:
+                out = out + bb.astype(dd.dtype).reshape(shape)
+            return out
+        from ...ndarray.ndarray import apply_nary
+        out = apply_nary(fn, [x, gamma, beta, running_mean, running_var],
+                         name="BatchNorm")
+        if training:
+            # running-stat update (non-grad aux state); works both in the
+            # CachedOp trace (collected + threaded out of jit) and eagerly
+            axes = tuple(i for i in range(d.ndim) if i != axis)
+            rm, rv = running_mean.data, running_var.data
+            mean = jax.lax.stop_gradient(jnp.mean(d, axis=axes))
+            var = jax.lax.stop_gradient(jnp.var(d, axis=axes))
+            mom = self._momentum
+            record_aux_update(self.running_mean,
+                              NDArray(mom * rm + (1 - mom) * mean.astype(rm.dtype)))
+            record_aux_update(self.running_var,
+                              NDArray(mom * rv + (1 - mom) * var.astype(rv.dtype)))
+        return out
+
+    def __repr__(self):
+        return (f"BatchNorm(axis={self._axis}, momentum={self._momentum}, "
+                f"in_channels={self.gamma.shape[0] if self.gamma.shape else None})")
+
+
+class InstanceNorm(HybridBlock):
+    def __init__(self, axis=1, epsilon=1e-5, center=True, scale=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer,
+                                         allow_deferred_init=True)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer,
+                                        allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape_updated((c,))
+        self.beta.shape_updated((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class LayerNorm(HybridBlock):
+    """Reference: nn.LayerNorm over src/operator/nn/layer_norm.cc."""
+
+    def __init__(self, axis=-1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._axis = axis
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer,
+                                         allow_deferred_init=True)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer,
+                                        allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[self._axis]
+        self.gamma.shape_updated((c,))
+        self.beta.shape_updated((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.LayerNorm(x, gamma, beta, axis=self._axis, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Reference [≥1.6]: nn.GroupNorm."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 in_channels=0, **kwargs):
+        super().__init__(**kwargs)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(in_channels,),
+                                         init=gamma_initializer,
+                                         allow_deferred_init=True)
+            self.beta = self.params.get("beta", shape=(in_channels,),
+                                        init=beta_initializer,
+                                        allow_deferred_init=True)
+
+    def infer_shape(self, x, *args):
+        c = x.shape[1]
+        self.gamma.shape_updated((c,))
+        self.beta.shape_updated((c,))
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        ng = self._num_groups
+        eps = self._epsilon
+        def fn(d, g, b):
+            n, c = d.shape[:2]
+            rest = d.shape[2:]
+            dd = d.reshape((n, ng, c // ng) + rest)
+            axes = tuple(range(2, dd.ndim))
+            m = jnp.mean(dd, axis=axes, keepdims=True)
+            v = jnp.var(dd, axis=axes, keepdims=True)
+            out = ((dd - m) / jnp.sqrt(v + eps)).reshape(d.shape)
+            shape = (1, c) + (1,) * len(rest)
+            return out * g.reshape(shape) + b.reshape(shape)
+        from ...ndarray.ndarray import apply_nary
+        return apply_nary(fn, [x, gamma, beta], name="GroupNorm")
+
+
+class Embedding(HybridBlock):
+    """Reference: nn.Embedding over the Embedding op
+    (src/operator/tensor/indexing_op.cc)."""
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, sparse_grad=False, **kwargs):
+        super().__init__(**kwargs)
+        self._input_dim = input_dim
+        self._output_dim = output_dim
+        self._sparse_grad = sparse_grad
+        grad_stype = "row_sparse" if sparse_grad else "default"
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(input_dim, output_dim), dtype=dtype,
+                init=weight_initializer, grad_stype=grad_stype)
+
+    def hybrid_forward(self, F, x, weight):
+        return F.Embedding(x, weight, input_dim=self._input_dim,
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
+
+    def __repr__(self):
+        return f"Embedding({self._input_dim} -> {self._output_dim})"
+
+
+class Flatten(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x.flatten()
+
+    def __repr__(self):
+        return "Flatten"
+
+
+class Lambda(Block):
+    """Wrap a function as a Block (reference nn.Lambda)."""
+
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            if not hasattr(nd, function):
+                raise MXNetError(f"Function name {function} not found in nd")
+            self._func_impl = getattr(nd, function)
+            self._func_name = function
+        elif callable(function):
+            self._func_impl = function
+            self._func_name = getattr(function, "__name__", "custom")
+        else:
+            raise MXNetError("function must be a str or callable")
+
+    def forward(self, *args):
+        return self._func_impl(*args)
+
+    def __repr__(self):
+        return f"Lambda({self._func_name})"
+
+
+class HybridLambda(HybridBlock):
+    def __init__(self, function, prefix=None):
+        super().__init__(prefix=prefix)
+        if isinstance(function, str):
+            if not hasattr(nd, function):
+                raise MXNetError(f"Function name {function} not found in nd")
+            fname = function
+            self._func = lambda F, *args: getattr(F, fname)(*args)
+            self._func_name = function
+        elif callable(function):
+            self._func = function
+            self._func_name = getattr(function, "__name__", "custom")
+        else:
+            raise MXNetError("function must be a str or callable")
+
+    def hybrid_forward(self, F, *args):
+        return self._func(F, *args)
+
+    def __repr__(self):
+        return f"HybridLambda({self._func_name})"
+
+
+# ----------------------------------------------------------------------
+# activations (reference: gluon/nn/activations.py)
+# ----------------------------------------------------------------------
+
+class Activation(HybridBlock):
+    def __init__(self, activation, **kwargs):
+        self._act_type = activation
+        super().__init__(**kwargs)
+
+    def _alias(self):
+        return self._act_type
+
+    def hybrid_forward(self, F, x):
+        return F.Activation(x, act_type=self._act_type)
+
+    def __repr__(self):
+        return f"Activation({self._act_type})"
+
+
+class LeakyReLU(HybridBlock):
+    def __init__(self, alpha, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
+
+
+class PReLU(HybridBlock):
+    def __init__(self, alpha_initializer=None, in_channels=1, **kwargs):
+        super().__init__(**kwargs)
+        from ... import initializer
+        with self.name_scope():
+            self.alpha = self.params.get(
+                "alpha", shape=(in_channels,),
+                init=alpha_initializer or initializer.Constant(0.25))
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
+
+
+class ELU(HybridBlock):
+    def __init__(self, alpha=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._alpha = alpha
+
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+
+
+class SELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="selu")
+
+
+class GELU(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return F.LeakyReLU(x, act_type="gelu")
+
+
+class Swish(HybridBlock):
+    def __init__(self, beta=1.0, **kwargs):
+        super().__init__(**kwargs)
+        self._beta = beta
+
+    def hybrid_forward(self, F, x):
+        return x * F.sigmoid(self._beta * x)

@@ -1,0 +1,248 @@
+"""Fused RNN layers: ``gluon.rnn.RNN / LSTM / GRU``.
+
+Reference: python/mxnet/gluon/rnn/rnn_layer.py over the fused ``RNN`` op
+(src/operator/rnn.cc, cuDNN RNN). TPU-native realization: the whole multi-layer
+(bi)directional recurrence is ONE lax.scan-based jax function dispatched as a
+single tape op — the scan compiles to an XLA while loop with the gate matmuls
+batched on the MXU, which is the same "fused kernel" role cuDNN played.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...base import MXNetError
+from ...ndarray.ndarray import NDArray, apply_nary
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+
+def _cell_step(mode, x_t, states, wih, whh, bih, bhh):
+    """One timestep of one direction. Gate order matches the reference
+    (LSTM: i,f,c,o ; GRU: r,z,n)."""
+    if mode == "rnn_tanh" or mode == "rnn_relu":
+        h = states[0]
+        act = jnp.tanh if mode == "rnn_tanh" else jax.nn.relu
+        h_new = act(x_t @ wih.T + bih + h @ whh.T + bhh)
+        return h_new, (h_new,)
+    if mode == "lstm":
+        h, c = states
+        gates = x_t @ wih.T + bih + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c_new = f * c + i * g
+        h_new = o * jnp.tanh(c_new)
+        return h_new, (h_new, c_new)
+    if mode == "gru":
+        h = states[0]
+        gi = x_t @ wih.T + bih
+        gh = h @ whh.T + bhh
+        ir, iz, inn = jnp.split(gi, 3, axis=-1)
+        hr, hz, hn = jnp.split(gh, 3, axis=-1)
+        r = jax.nn.sigmoid(ir + hr)
+        z = jax.nn.sigmoid(iz + hz)
+        n = jnp.tanh(inn + r * hn)
+        h_new = (1 - z) * n + z * h
+        return h_new, (h_new,)
+    raise MXNetError(f"unknown rnn mode {mode}")
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer,
+                 h2h_weight_initializer, i2h_bias_initializer,
+                 h2h_bias_initializer, mode, **kwargs):
+        super().__init__(**kwargs)
+        assert layout in ("TNC", "NTC"), \
+            f"Invalid layout {layout}; must be one of ['TNC' or 'NTC']"
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._mode = mode
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        with self.name_scope():
+            for layer in range(num_layers):
+                for d in (["l", "r"] if bidirectional else ["l"]):
+                    in_sz = ni if layer == 0 else nh * self._dir
+                    setattr(self, f"{d}{layer}_i2h_weight", self.params.get(
+                        f"{d}{layer}_i2h_weight",
+                        shape=(ng * nh, in_sz if in_sz else 0),
+                        init=i2h_weight_initializer, allow_deferred_init=True))
+                    setattr(self, f"{d}{layer}_h2h_weight", self.params.get(
+                        f"{d}{layer}_h2h_weight", shape=(ng * nh, nh),
+                        init=h2h_weight_initializer))
+                    setattr(self, f"{d}{layer}_i2h_bias", self.params.get(
+                        f"{d}{layer}_i2h_bias", shape=(ng * nh,),
+                        init=i2h_bias_initializer))
+                    setattr(self, f"{d}{layer}_h2h_bias", self.params.get(
+                        f"{d}{layer}_h2h_bias", shape=(ng * nh,),
+                        init=h2h_bias_initializer))
+
+    def state_info(self, batch_size=0):
+        if self._mode == "lstm":
+            return [{"shape": (self._num_layers * self._dir, batch_size,
+                               self._hidden_size)}] * 2
+        return [{"shape": (self._num_layers * self._dir, batch_size,
+                           self._hidden_size)}]
+
+    def begin_state(self, batch_size=0, func=None, **kwargs):
+        from ... import ndarray as nd
+        func = func or nd.zeros
+        return [func(shape=info["shape"], **kwargs)
+                for info in self.state_info(batch_size)]
+
+    def infer_shape(self, x, *args):
+        ni = x.shape[-1]
+        ng, nh = self._gates, self._hidden_size
+        for d in (["l", "r"] if self._dir == 2 else ["l"]):
+            getattr(self, f"{d}0_i2h_weight").shape_updated((ng * nh, ni))
+
+    def _param_list(self):
+        names = []
+        for layer in range(self._num_layers):
+            for d in (["l", "r"] if self._dir == 2 else ["l"]):
+                for part in ("i2h_weight", "h2h_weight", "i2h_bias",
+                             "h2h_bias"):
+                    names.append(f"{d}{layer}_{part}")
+        return names
+
+    def forward(self, inputs, states=None):
+        from ... import ndarray as F
+        batch_axis = self._layout.find("N")
+        batch_size = inputs.shape[batch_axis]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch_size)
+        if isinstance(states, NDArray):
+            states = [states]
+        try:
+            params = [p.data() for name, p in
+                      [(n, self._reg_params[n]) for n in self._param_list()]]
+        except Exception:
+            self.infer_shape(inputs)
+            for p in self._reg_params.values():
+                if p._data is None:
+                    p._finish_deferred_init()
+            params = [self._reg_params[n].data() for n in self._param_list()]
+        out, out_states = self._fused_forward(inputs, states, params)
+        return out if skip_states else (out, out_states)
+
+    def __call__(self, inputs, states=None):
+        # the fused lax.scan path is already a single op; CachedOp wrapping
+        # adds nothing, so bypass the hybridize machinery
+        return self.forward(inputs, states)
+
+    def _fused_forward(self, inputs, states, params):
+        mode = self._mode
+        layout = self._layout
+        num_layers = self._num_layers
+        ndir = self._dir
+        dropout = self._dropout
+        n_states = 2 if mode == "lstm" else 1
+        from ... import _tape
+        training = _tape.is_training()
+        from ...ndarray import random as _rnd
+        drop_key = _rnd.next_key() if (dropout and training) else None
+
+        def fn(x, *flat):
+            state_arrs = flat[:n_states]
+            weight_arrs = flat[n_states:]
+            data = x if layout == "TNC" else jnp.swapaxes(x, 0, 1)
+            layer_in = data
+            h_out, c_out = [], []
+            wi = 0
+            for layer in range(num_layers):
+                dir_outs = []
+                for d in range(ndir):
+                    wih, whh, bih, bhh = weight_arrs[wi:wi + 4]
+                    wi += 4
+                    idx = layer * ndir + d
+                    init = tuple(s[idx] for s in state_arrs)
+                    seq = layer_in if d == 0 else jnp.flip(layer_in, 0)
+
+                    def step(carry, x_t):
+                        h_new, new_states = _cell_step(mode, x_t, carry,
+                                                       wih, whh, bih, bhh)
+                        return new_states, h_new
+                    final, out_seq = lax.scan(step, init, seq)
+                    if d == 1:
+                        out_seq = jnp.flip(out_seq, 0)
+                    dir_outs.append(out_seq)
+                    h_out.append(final[0])
+                    if mode == "lstm":
+                        c_out.append(final[1])
+                layer_in = dir_outs[0] if ndir == 1 else \
+                    jnp.concatenate(dir_outs, axis=-1)
+                if dropout and training and layer < num_layers - 1:
+                    keep = jax.random.bernoulli(
+                        jax.random.fold_in(drop_key, layer),
+                        1.0 - dropout, layer_in.shape)
+                    layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
+            out = layer_in if layout == "TNC" else jnp.swapaxes(layer_in, 0, 1)
+            outs = (out, jnp.stack(h_out))
+            if mode == "lstm":
+                outs = outs + (jnp.stack(c_out),)
+            return outs
+
+        n_out = 2 + (1 if mode == "lstm" else 0)
+        results = apply_nary(fn, [inputs] + list(states) + params,
+                             n_out=n_out, name=f"RNN_{mode}")
+        out = results[0]
+        out_states = list(results[1:])
+        return out, out_states
+
+    def __repr__(self):
+        return (f"{type(self).__name__}({self._hidden_size}, "
+                f"num_layers={self._num_layers}, "
+                f"bidirectional={self._dir == 2})")
+
+
+class RNN(_RNNLayer):
+    """Reference: gluon.rnn.RNN (Elman, relu/tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 input_size=0, **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "rnn_" + activation, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    """Reference: gluon.rnn.LSTM (fused multi-layer cuDNN path)."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "lstm", **kwargs)
+
+
+class GRU(_RNNLayer):
+    """Reference: gluon.rnn.GRU."""
+
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 **kwargs):
+        super().__init__(hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, i2h_weight_initializer,
+                         h2h_weight_initializer, i2h_bias_initializer,
+                         h2h_bias_initializer, "gru", **kwargs)

@@ -1,0 +1,176 @@
+"""Gluon ``Trainer`` — applies an Optimizer over a ParameterDict.
+
+Reference: python/mxnet/gluon/trainer.py (SURVEY.md §2.2 "Gluon Trainer"):
+owns the KVStore, `step(batch_size)` = allreduce_grads + update.
+
+TPU mapping (SURVEY.md §3.2): with kvstore='tpu_sync'/'dist_tpu_sync' the
+gradient allreduce is a jitted psum over the mesh data axis executed by the
+KVStore facade; the optimizer update itself is a fused jax computation per
+parameter (or one fused multi-tensor update via `fuse=True`).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+from .parameter import ParameterDict, Parameter
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None, kvstore="device",
+                 compression_params=None, update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = [params[key] for key in sorted(list(params.keys()))]
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError(
+                "First argument must be a list or dict of Parameters, "
+                f"got {type(params)}.")
+        self._params = []
+        self._param2idx = {}
+        for i, param in enumerate(params):
+            if not isinstance(param, Parameter):
+                raise MXNetError(
+                    "First argument must be a list or dict of Parameters, "
+                    f"got list of {type(param)}.")
+            self._param2idx[param.name] = i
+            self._params.append(param)
+        self._compression_params = compression_params
+        optimizer_params = optimizer_params or {}
+        self._scale = float(optimizer_params.get("rescale_grad", 1.0))
+        self._init_optimizer(optimizer, optimizer_params)
+        self._kvstore_type = kvstore
+        self._kvstore = None
+        self._kv_initialized = False
+        self._states = {}
+        self._update_on_kvstore = update_on_kvstore
+
+    def _init_optimizer(self, optimizer, optimizer_params):
+        param_dict = {i: param for i, param in enumerate(self._params)}
+        if isinstance(optimizer, opt.Optimizer):
+            if optimizer_params and set(optimizer_params) != {"rescale_grad"}:
+                raise MXNetError(
+                    "optimizer_params must be None if optimizer is an "
+                    "Optimizer instance")
+            self._optimizer = optimizer
+            self._optimizer.param_dict = param_dict
+        else:
+            self._optimizer = opt.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+
+    def _init_kvstore(self):
+        from .. import kvstore as kvs
+        if self._kvstore_type is None or self._kvstore_type is False:
+            self._kvstore = None
+        elif isinstance(self._kvstore_type, str):
+            self._kvstore = kvs.create(self._kvstore_type)
+        else:
+            self._kvstore = self._kvstore_type
+        if self._kvstore is not None:
+            for i, p in enumerate(self._params):
+                if p._data is not None and p.grad_req != "null":
+                    self._kvstore.init(i, p.data())
+        self._kv_initialized = True
+
+    @property
+    def learning_rate(self):
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def _all_reduce_grads(self):
+        if self._kvstore is None or self._kvstore.num_workers <= 1 and \
+                type(self._kvstore).__name__ == "KVStoreLocal":
+            return
+        for i, param in enumerate(self._params):
+            if param.grad_req != "null" and param._data is not None and \
+                    param._data._grad is not None:
+                grad = param.grad()
+                self._kvstore.pushpull(i, grad, out=grad)
+                param._data._grad = grad.data
+
+    def allreduce_grads(self):
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._all_reduce_grads()
+
+    def step(self, batch_size, ignore_stale_grad=False):
+        """rescale grads by 1/batch_size, allreduce, update.
+        Reference: Trainer.step."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._all_reduce_grads()
+        self._update(ignore_stale_grad)
+
+    def update(self, batch_size, ignore_stale_grad=False):
+        """update only (user did allreduce manually)."""
+        if not self._kv_initialized:
+            self._init_kvstore()
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self._update(ignore_stale_grad)
+
+    def _update(self, ignore_stale_grad=False):
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._data._grad is None or not param._data._grad_fresh:
+                if ignore_stale_grad:
+                    continue
+                raise MXNetError(
+                    f"Gradient of Parameter `{param.name}` has not been "
+                    "computed. Call backward first, or set grad_req to "
+                    "'null' / use ignore_stale_grad=True.")
+            if i not in self._states:
+                self._states[i] = self._optimizer.create_state_multi_precision(
+                    i, param.data())
+            self._optimizer.update_multi_precision(
+                i, param.data(), param.grad(), self._states[i])
+            param._data._grad_fresh = False
+            if param.grad_req == "add":
+                param.zero_grad()
+
+    def save_states(self, fname):
+        """Reference: Trainer.save_states (optimizer state incl. update
+        counts — Adam/LAMB bias correction and lr schedules depend on them)."""
+        import pickle
+        updater = opt.Updater(self._optimizer)
+        updater.states = dict(self._states)
+        counters = {
+            "num_update": self._optimizer.num_update,
+            "begin_num_update": self._optimizer.begin_num_update,
+            "index_update_count": dict(self._optimizer._index_update_count),
+        }
+        with open(fname, "wb") as f:
+            f.write(pickle.dumps({"states": updater.get_states(),
+                                  "counters": counters}))
+
+    def load_states(self, fname):
+        import pickle
+        with open(fname, "rb") as f:
+            blob = f.read()
+        try:
+            payload = pickle.loads(blob)
+        except Exception:
+            payload = None
+        updater = opt.Updater(self._optimizer)
+        if isinstance(payload, dict) and "states" in payload:
+            updater.set_states(payload["states"])
+            counters = payload.get("counters", {})
+            self._optimizer.num_update = counters.get("num_update", 0)
+            self._optimizer.begin_num_update = counters.get(
+                "begin_num_update", 0)
+            self._optimizer._index_update_count = dict(
+                counters.get("index_update_count", {}))
+        else:  # legacy blob: raw updater states
+            updater.set_states(blob)
+        self._states = dict(updater.states)

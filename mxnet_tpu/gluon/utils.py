@@ -1,0 +1,82 @@
+"""Gluon utilities: ``split_and_load``, ``split_data``, ``clip_global_norm``.
+
+Reference: python/mxnet/gluon/utils.py. On TPU, ``split_and_load`` over a list
+of contexts maps to sharding one batch across devices; the single-`Context`
+call keeps the reference's list-of-slices contract so existing multi-device
+training loops run unchanged.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm",
+           "check_sha1", "download"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}.")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        slices.append(data.slice_axis(batch_axis, begin, end))
+    return slices
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    """Reference: gluon.utils.split_and_load — slice batch across contexts."""
+    if not isinstance(data, NDArray):
+        data = array(data)
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    """Rescale arrays so the concatenated L2 norm is at most max_norm.
+    Reference: gluon.utils.clip_global_norm."""
+    if not arrays:
+        raise MXNetError("arrays must not be empty")
+    total = jnp.sqrt(sum(jnp.sum(jnp.square(a.data)) for a in arrays))
+    total_f = float(total)
+    if check_isfinite and not _np.isfinite(total_f):
+        import warnings
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_f + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a._set_data(a.data * scale)
+    return total_f
+
+
+def check_sha1(filename, sha1_hash):
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    """Kept for API parity; this build environment has no egress."""
+    raise MXNetError(
+        "download() is unavailable: this environment has no network access. "
+        "Place files locally and pass a path instead.")

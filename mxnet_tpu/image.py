@@ -1,0 +1,252 @@
+"""``mx.image`` — image decode and augmentation.
+
+Reference: python/mxnet/image/image.py (+detection.py) over OpenCV ops.
+Decode uses PIL or cv2 when present, with a raw-numpy PPM/NPY fallback so the
+module works in minimal environments. Augmenters mirror the reference's
+CreateAugmenter pipeline.
+"""
+from __future__ import annotations
+
+import io as _io
+import struct
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array
+
+__all__ = ["imdecode", "imencode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "ImageIter",
+           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
+
+
+def _get_backend():
+    try:
+        import cv2
+        return "cv2", cv2
+    except ImportError:
+        pass
+    try:
+        from PIL import Image
+        return "pil", Image
+    except ImportError:
+        return None, None
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    """Decode image bytes -> HWC uint8 NDArray (reference mx.image.imdecode
+    over cv::imdecode)."""
+    if isinstance(buf, NDArray):
+        buf = bytes(buf.asnumpy().astype(_np.uint8))
+    kind, mod = _get_backend()
+    if kind == "cv2":
+        img = mod.imdecode(_np.frombuffer(buf, _np.uint8),
+                           mod.IMREAD_COLOR if flag else
+                           mod.IMREAD_GRAYSCALE)
+        if img is None:
+            raise MXNetError("cv2 failed to decode image")
+        if flag and to_rgb:
+            img = img[:, :, ::-1]
+        if not flag:
+            img = img[:, :, None]
+        return array(_np.ascontiguousarray(img), dtype="uint8")
+    if kind == "pil":
+        img = mod.open(_io.BytesIO(buf))
+        img = img.convert("RGB" if flag else "L")
+        arr = _np.asarray(img)
+        if not flag:
+            arr = arr[:, :, None]
+        return array(arr, dtype="uint8")
+    # fallback: raw .npy payloads (used by synthetic .rec files in tests)
+    if buf[:6] == b"\x93NUMPY":
+        return array(_np.load(_io.BytesIO(buf)), dtype="uint8")
+    raise MXNetError("no image decode backend (cv2/PIL) available and "
+                     "payload is not npy")
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = _np.asarray(img, dtype=_np.uint8)
+    kind, mod = _get_backend()
+    if kind == "cv2":
+        ok, buf = mod.imencode(img_fmt, img[:, :, ::-1])
+        if not ok:
+            raise MXNetError("cv2 imencode failed")
+        return buf.tobytes()
+    if kind == "pil":
+        pil_img = mod.fromarray(img.squeeze() if img.shape[-1] == 1 else img)
+        bio = _io.BytesIO()
+        pil_img.save(bio, format="JPEG" if "jp" in img_fmt else "PNG",
+                     quality=quality)
+        return bio.getvalue()
+    # npy fallback
+    bio = _io.BytesIO()
+    _np.save(bio, img)
+    return bio.getvalue()
+
+
+def imresize(src, w, h, interp=1):
+    from .gluon.data.vision.transforms import _resize_np
+    np_img = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    return array(_resize_np(np_img, (w, h)))
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_w, new_h = size, int(size * h / w)
+    else:
+        new_w, new_h = int(size * w / h), size
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = src[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != tuple(size):
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = size
+    x0 = max((w - new_w) // 2, 0)
+    y0 = max((h - new_h) // 2, 0)
+    return fixed_crop(src, x0, y0, min(new_w, w), min(new_h, h), size,
+                      interp), (x0, y0, new_w, new_h)
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = _np.random.randint(0, w - new_w + 1)
+    y0 = _np.random.randint(0, h - new_h + 1)
+    return fixed_crop(src, x0, y0, new_w, new_h, size, interp), \
+        (x0, y0, new_w, new_h)
+
+
+def color_normalize(src, mean, std=None):
+    src = src if isinstance(src, NDArray) else array(src)
+    out = src.astype("float32") - (mean if isinstance(mean, NDArray)
+                                   else array(_np.asarray(mean, "float32")))
+    if std is not None:
+        out = out / (std if isinstance(std, NDArray)
+                     else array(_np.asarray(std, "float32")))
+    return out
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            return NDArray(src.data[:, ::-1], src.context) \
+                if isinstance(src, NDArray) else src[:, ::-1]
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Reference: image.CreateAugmenter — builds the standard aug list."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    return auglist
+
+
+class ImageIter:
+    """Reference: image.ImageIter (python-side image iterator with
+    augmenters, .rec or list-file backed)."""
+
+    def __init__(self, batch_size, data_shape, path_imgrec=None,
+                 path_imglist=None, shuffle=False, aug_list=None, **kwargs):
+        from .io import ImageRecordIter
+        if path_imgrec is None:
+            raise MXNetError("ImageIter requires path_imgrec on this build")
+        self._inner = ImageRecordIter(path_imgrec, data_shape, batch_size,
+                                      shuffle=shuffle)
+        self.batch_size = batch_size
+        self.provide_data = self._inner.provide_data
+        self.provide_label = self._inner.provide_label
+
+    def reset(self):
+        self._inner.reset()
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        return self._inner.next()
+
+    next = __next__

@@ -1,0 +1,245 @@
+"""``mx.init`` — weight initializers.
+
+Reference: python/mxnet/initializer.py (Xavier, MSRAPrelu, Normal, Uniform,
+Orthogonal, One/Zero/Constant, Mixed, @register). Samplers draw from the
+framework PRNG stream (mx.random over JAX keys).
+"""
+from __future__ import annotations
+
+import math
+import re
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from .base import MXNetError, registry_create
+from .ndarray import random as _rnd
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Initializer", "Uniform", "Normal", "Constant", "Zero", "One",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create", "InitDesc"]
+
+register, create, _REGISTRY = registry_create("initializer")
+
+
+class InitDesc(str):
+    """Parameter name + attrs hint (reference: initializer.InitDesc)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Initializer:
+    """Base initializer; callable on (name, NDArray)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, desc, arr):
+        if not isinstance(desc, str):
+            raise TypeError("first argument must be a name string/InitDesc")
+        name = desc.lower()
+        if name.endswith("bias"):
+            self._init_bias(desc, arr)
+        elif name.endswith("gamma"):
+            self._init_one(desc, arr)
+        elif name.endswith("beta"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(desc, arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(desc, arr)
+        else:
+            self._init_weight(desc, arr)
+
+    # default fills
+    def _init_bias(self, name, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr.data.dtype))
+
+    def _init_zero(self, name, arr):
+        arr._set_data(jnp.zeros(arr.shape, arr.data.dtype))
+
+    def _init_one(self, name, arr):
+        arr._set_data(jnp.ones(arr.shape, arr.data.dtype))
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def init_array(self, arr, name="weight"):
+        self(name, arr)
+        return arr
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr._set_data(jax.random.uniform(_rnd.next_key(), arr.shape,
+                                         arr.data.dtype, -self.scale,
+                                         self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr._set_data(self.sigma * jax.random.normal(
+            _rnd.next_key(), arr.shape, arr.data.dtype))
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr._set_data(jnp.full(arr.shape, self.value, arr.data.dtype))
+
+
+@register
+@register("zeros")
+class Zero(Constant):
+    def __init__(self):
+        super().__init__(0.0)
+
+
+@register
+@register("ones")
+class One(Constant):
+    def __init__(self):
+        super().__init__(1.0)
+
+
+def _fan(shape):
+    if len(shape) < 2:
+        return (shape[0] if shape else 1, shape[0] if shape else 1)
+    hw = int(_np.prod(shape[2:])) if len(shape) > 2 else 1
+    fan_in = shape[1] * hw
+    fan_out = shape[0] * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    """Reference: initializer.Xavier (rnd_type uniform/gaussian,
+    factor_type avg/in/out, magnitude)."""
+
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        fan_in, fan_out = _fan(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / max(factor, 1e-12))
+        if self.rnd_type == "uniform":
+            data = jax.random.uniform(_rnd.next_key(), arr.shape,
+                                      arr.data.dtype, -scale, scale)
+        elif self.rnd_type == "gaussian":
+            data = scale * jax.random.normal(_rnd.next_key(), arr.shape,
+                                             arr.data.dtype)
+        else:
+            raise MXNetError(f"bad rnd_type {self.rnd_type}")
+        arr._set_data(data)
+
+
+@register
+class MSRAPrelu(Xavier):
+    """Kaiming init (reference initializer.MSRAPrelu)."""
+
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == (nout, nin) else v
+        arr._set_data(jnp.asarray(self.scale * q.reshape(arr.shape),
+                                  dtype=arr.data.dtype))
+
+
+@register
+class Bilinear(Initializer):
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(int(_np.prod(arr.shape)), dtype="float32")
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr._set_data(jnp.asarray(weight.reshape(shape), arr.data.dtype))
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias init (reference initializer.LSTMBias)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype="float32")
+        num_hidden = arr.shape[0] // 4
+        b[num_hidden:2 * num_hidden] = self.forget_bias
+        arr._set_data(jnp.asarray(b, arr.data.dtype))
+
+    _init_bias = _init_weight
+
+
+class Mixed:
+    """Patterns -> initializers (reference initializer.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("len(patterns) != len(initializers)")
+        self.map = list(zip([re.compile(p) for p in patterns], initializers))
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"parameter {name} did not match any pattern")

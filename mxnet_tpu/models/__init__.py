@@ -1,0 +1,1 @@
+"""Flagship model implementations (BERT, Transformer, Llama)."""

@@ -1,0 +1,4 @@
+"""``mx.mod`` — Module API (reference: python/mxnet/module/)."""
+from .module import BaseModule, Module, BatchEndParam, load_checkpoint
+from .bucketing_module import BucketingModule
+from .executor import Executor

@@ -1,0 +1,98 @@
+"""``mx.mod.BucketingModule`` — variable-length sequence training.
+
+Reference: python/mxnet/module/bucketing_module.py. The reference kept one
+bound executor per bucket (seq length); here each bucket key gets its own
+Module and XLA compiles one program per bucket — identical retrace economics
+(SURVEY.md §7 hard parts: dynamic shapes / bucketed padding).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..base import MXNetError
+from .module import BaseModule, Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen, default_bucket_key=None, logger=logging,
+                 context=None, **kwargs):
+        super().__init__(logger)
+        assert default_bucket_key is not None
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._context = context
+        self._kwargs = kwargs
+        self._buckets = {}
+        self._curr_module = None
+        self._curr_bucket_key = None
+        self._opt_config = None
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    def _gen_module(self, bucket_key):
+        if bucket_key not in self._buckets:
+            symbol, data_names, label_names = self._sym_gen(bucket_key)
+            mod = Module(symbol, data_names, label_names,
+                         logger=self.logger, context=self._context,
+                         **self._kwargs)
+            self._buckets[bucket_key] = mod
+        return self._buckets[bucket_key]
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             **kwargs):
+        self._curr_module = self._gen_module(self._default_bucket_key)
+        self._curr_bucket_key = self._default_bucket_key
+        self._curr_module.bind(data_shapes, label_shapes, for_training)
+        self.binded = True
+        self.for_training = for_training
+
+    def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
+        mod = self._gen_module(bucket_key)
+        if not mod.binded:
+            mod.bind(data_shapes, label_shapes, self.for_training)
+            if self._curr_module.params_initialized:
+                arg, aux = self._curr_module.get_params()
+                mod.init_params(arg_params=arg, aux_params=aux,
+                                force_init=True)
+                mod.params_initialized = True
+            if self._opt_config is not None:
+                mod.init_optimizer(**self._opt_config)
+        self._curr_module = mod
+        self._curr_bucket_key = bucket_key
+
+    def init_params(self, **kwargs):
+        self._curr_module.init_params(**kwargs)
+        self.params_initialized = True
+
+    def init_optimizer(self, **kwargs):
+        self._opt_config = kwargs
+        self._curr_module.init_optimizer(**kwargs)
+        self.optimizer_initialized = True
+
+    def forward(self, data_batch, is_train=None):
+        key = getattr(data_batch, "bucket_key", None) or \
+            self._default_bucket_key
+        if key != self._curr_bucket_key:
+            self.switch_bucket(key, data_batch.provide_data,
+                               data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None):
+        self._curr_module.backward(out_grads)
+
+    def update(self):
+        self._curr_module.update()
+        # weights are shared through get/set on switch; nothing else needed
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs()
+
+    def get_params(self):
+        return self._curr_module.get_params()

@@ -1,0 +1,111 @@
+"""Executor: evaluates a Symbol graph with autograd support.
+
+Reference: src/executor/graph_executor.cc + python/mxnet/executor.py.
+Memory planning / op bulking are absorbed by XLA (SURVEY.md §2.1 "Graph
+executor" row); what remains is the bind contract: arg arrays, grad arrays,
+forward(is_train)/backward().
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from .. import autograd
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx=None, shapes=None, args=None,
+                 args_grad=None, grad_req="write", label_shapes=None):
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.grad_req = grad_req
+        arg_names = symbol.list_arguments()
+        self.arg_dict = {}
+        if args is not None:
+            if isinstance(args, dict):
+                self.arg_dict.update(args)
+            else:
+                for name, arr in zip(arg_names, args):
+                    self.arg_dict[name] = arr
+        if shapes:
+            for name in arg_names:
+                if name in self.arg_dict:
+                    continue
+                if name in shapes:
+                    self.arg_dict[name] = nd_zeros(tuple(shapes[name]),
+                                                   ctx=self._ctx)
+        self.grad_dict = {}
+        if args_grad:
+            if isinstance(args_grad, dict):
+                self.grad_dict.update(args_grad)
+            else:
+                for name, arr in zip(arg_names, args_grad):
+                    self.grad_dict[name] = arr
+        self.aux_dict = {}
+        self.outputs = []
+        self._req = grad_req if isinstance(grad_req, dict) else \
+            {n: grad_req for n in arg_names}
+        self._data_names = [n for n in arg_names
+                            if n in ("data", "softmax_label", "label") or
+                            n.endswith("_label") or n.endswith("data")]
+
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._symbol.list_arguments()]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n)
+                for n in self._symbol.list_arguments()]
+
+    @property
+    def aux_arrays(self):
+        return []
+
+    def forward(self, is_train=False, **kwargs):
+        for name, value in kwargs.items():
+            if name not in self.arg_dict:
+                self.arg_dict[name] = value
+            else:
+                self.arg_dict[name]._set_data(
+                    value.data if isinstance(value, NDArray) else value)
+        bindings = dict(self.arg_dict)
+        if is_train:
+            for name, arr in self.arg_dict.items():
+                req = self._req.get(name, "write")
+                if req != "null" and not _is_input_name(name):
+                    arr.attach_grad(req)
+            with autograd.record():
+                out = self._symbol._eval(bindings)
+        else:
+            out = self._symbol._eval(bindings)
+        self.outputs = out if isinstance(out, list) else [out]
+        self._train_outputs = self.outputs if is_train else None
+        return self.outputs
+
+    def backward(self, out_grads=None):
+        if self._train_outputs is None:
+            raise MXNetError("call forward(is_train=True) before backward")
+        heads = self._train_outputs
+        autograd.backward(heads, out_grads)
+        for name, arr in self.arg_dict.items():
+            if self._req.get(name, "write") != "null" and \
+                    not _is_input_name(name) and arr._grad is not None:
+                self.grad_dict[name] = arr.grad
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for name, arr in arg_params.items():
+            if name in self.arg_dict:
+                self.arg_dict[name]._set_data(arr.data)
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown param {name}")
+
+
+def _is_input_name(name):
+    return name in ("data", "label", "softmax_label") or \
+        name.endswith("_label") or name.endswith("_data") or name == "data"

@@ -1,0 +1,317 @@
+"""``mx.mod.Module`` — the legacy symbolic trainer.
+
+Reference: python/mxnet/module/ (base_module.py fit loop, module.py bind/
+init_params/init_optimizer/forward_backward/update, SURVEY.md §3.4).
+DataParallelExecutorGroup's multi-GPU batch slicing is absorbed by sharded
+arrays (SURVEY.md §2.5 DP row), so one Executor serves all devices.
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context, cpu
+from ..ndarray.ndarray import NDArray, zeros as nd_zeros
+from ..ndarray import utils as nd_utils
+from .. import initializer as init_mod
+from .. import optimizer as opt_mod
+from .. import metric as metric_mod
+from .executor import Executor
+
+__all__ = ["BaseModule", "Module", "BatchEndParam", "save_checkpoint_arrays",
+           "load_checkpoint"]
+
+
+class BatchEndParam:
+    def __init__(self, epoch, nbatch, eval_metric, locals=None):
+        self.epoch = epoch
+        self.nbatch = nbatch
+        self.eval_metric = eval_metric
+        self.locals = locals
+
+
+def save_checkpoint_arrays(prefix, epoch, symbol, arg_params, aux_params):
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in arg_params.items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_utils.save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_checkpoint(prefix, epoch):
+    """Reference: mx.model.load_checkpoint."""
+    from .. import symbol as sym_mod
+    symbol = None
+    import os
+    if os.path.exists(f"{prefix}-symbol.json"):
+        try:
+            symbol = sym_mod.load(f"{prefix}-symbol.json")
+        except MXNetError:
+            symbol = None
+    loaded = nd_utils.load(f"{prefix}-{epoch:04d}.params")
+    arg_params, aux_params = {}, {}
+    for k, v in loaded.items():
+        if k.startswith("arg:"):
+            arg_params[k[4:]] = v
+        elif k.startswith("aux:"):
+            aux_params[k[4:]] = v
+        else:
+            arg_params[k] = v
+    return symbol, arg_params, aux_params
+
+
+class BaseModule:
+    def __init__(self, logger=logging):
+        self.logger = logger
+        self.binded = False
+        self.for_training = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    # -- high-level API (reference base_module.py) ----------------------
+    def fit(self, train_data, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", optimizer="sgd",
+            optimizer_params=(("learning_rate", 0.01),),
+            eval_end_callback=None, eval_batch_end_callback=None,
+            initializer=None, arg_params=None, aux_params=None,
+            allow_missing=False, force_rebind=False, force_init=False,
+            begin_epoch=0, num_epoch=None, validation_metric=None,
+            monitor=None):
+        assert num_epoch is not None, "please specify number of epochs"
+        initializer = initializer or init_mod.Uniform(0.01)
+        self.bind(data_shapes=train_data.provide_data,
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
+        self.init_params(initializer=initializer, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init)
+        self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                            optimizer_params=dict(optimizer_params))
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        validation_metric = validation_metric or eval_metric
+
+        for epoch in range(begin_epoch, num_epoch):
+            tic = time.time()
+            eval_metric.reset()
+            nbatch = 0
+            train_data.reset()
+            for batch in train_data:
+                self.forward_backward(batch)
+                self.update()
+                self.update_metric(eval_metric, batch.label)
+                if batch_end_callback is not None:
+                    params = BatchEndParam(epoch, nbatch, eval_metric)
+                    for cb in _as_list(batch_end_callback):
+                        cb(params)
+                nbatch += 1
+            for name, val in eval_metric.get_name_value():
+                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - tic)
+            if epoch_end_callback is not None:
+                arg_params, aux_params = self.get_params()
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+            if eval_data is not None:
+                res = self.score(eval_data, validation_metric)
+                for name, val in res:
+                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
+                                     name, val)
+
+    def score(self, eval_data, eval_metric, num_batch=None, reset=True,
+              epoch=0):
+        if not isinstance(eval_metric, metric_mod.EvalMetric):
+            eval_metric = metric_mod.create(eval_metric)
+        eval_metric.reset()
+        if reset:
+            eval_data.reset()
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            self.update_metric(eval_metric, batch.label)
+        return eval_metric.get_name_value()
+
+    def predict(self, eval_data, num_batch=None, reset=True):
+        from .. import ndarray as nd
+        if reset:
+            eval_data.reset()
+        outputs = []
+        for nbatch, batch in enumerate(eval_data):
+            if num_batch is not None and nbatch == num_batch:
+                break
+            self.forward(batch, is_train=False)
+            outputs.append(self.get_outputs()[0])
+        return nd.concatenate(outputs, axis=0)
+
+    def forward_backward(self, data_batch):
+        self.forward(data_batch, is_train=True)
+        self.backward()
+
+
+def _as_list(x):
+    return x if isinstance(x, (list, tuple)) else [x]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None, group2ctxs=None,
+                 compression_params=None):
+        super().__init__(logger)
+        self.symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._context = context if not isinstance(context, (list, tuple)) \
+            else (context[0] if context else None)
+        self._fixed_param_names = set(fixed_param_names or [])
+        self._exec = None
+        self._optimizer = None
+        self._updater_states = {}
+        self._kvstore = None
+
+    # -- bind -----------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write"):
+        if self.binded and not force_rebind:
+            return
+        shapes = {}
+        for desc in data_shapes:
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else desc
+            shapes[name] = shape
+        for desc in (label_shapes or []):
+            name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
+                else desc
+            shapes[name] = shape
+        # infer remaining arg shapes with eval_shape
+        arg_names = self.symbol.list_arguments()
+        inferred, _, _ = _infer_missing_shapes(self.symbol, shapes)
+        reqs = {}
+        for n in arg_names:
+            if n in shapes and (n in self._data_names or
+                                n in self._label_names):
+                reqs[n] = "null"
+            elif n in self._fixed_param_names:
+                reqs[n] = "null"
+            else:
+                reqs[n] = grad_req
+        self._exec = Executor(self.symbol, self._context, inferred,
+                              grad_req=reqs)
+        self.binded = True
+        self.for_training = for_training
+
+    def init_params(self, initializer=None, arg_params=None, aux_params=None,
+                    allow_missing=False, force_init=False,
+                    allow_extra=False):
+        if self.params_initialized and not force_init:
+            return
+        initializer = initializer or init_mod.Uniform(0.01)
+        for name, arr in self._exec.arg_dict.items():
+            if name in self._data_names or name in self._label_names:
+                continue
+            if arg_params and name in arg_params:
+                arr._set_data(arg_params[name].data)
+            else:
+                initializer(init_mod.InitDesc(name), arr)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=(("learning_rate", 0.01),),
+                       force_init=False):
+        if self.optimizer_initialized and not force_init:
+            return
+        if isinstance(optimizer, str):
+            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+        self._optimizer = optimizer
+        from .. import kvstore as kvs
+        if kvstore:
+            self._kvstore = kvs.create(kvstore) if isinstance(kvstore, str) \
+                else kvstore
+        self.optimizer_initialized = True
+
+    # -- compute --------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        if is_train is None:
+            is_train = self.for_training
+        feed = {}
+        for name, arr in zip(self._data_names, data_batch.data):
+            feed[name] = arr
+        if data_batch.label is not None:
+            for name, arr in zip(self._label_names, data_batch.label):
+                feed[name] = arr
+        self._exec.forward(is_train=is_train, **feed)
+
+    def backward(self, out_grads=None):
+        self._exec.backward(out_grads)
+
+    def update(self):
+        assert self.optimizer_initialized
+        i = 0
+        for name in self.symbol.list_arguments():
+            if name in self._data_names or name in self._label_names or \
+                    name in self._fixed_param_names:
+                continue
+            arr = self._exec.arg_dict[name]
+            grad = self._exec.grad_dict.get(name)
+            if grad is None:
+                continue
+            if i not in self._updater_states:
+                self._updater_states[i] = self._optimizer.create_state(i, arr)
+            self._optimizer.update(i, arr, grad, self._updater_states[i])
+            i += 1
+
+    def update_metric(self, eval_metric, labels, pre_sliced=False):
+        eval_metric.update(labels, self.get_outputs())
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec.outputs
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._exec.grad_dict.get(n) for n in self._data_names]
+
+    def get_params(self):
+        arg_params = {}
+        for name, arr in self._exec.arg_dict.items():
+            if name not in self._data_names and name not in self._label_names:
+                arg_params[name] = arr.copy()
+        return arg_params, {}
+
+    def set_params(self, arg_params, aux_params=None, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(arg_params=arg_params, aux_params=aux_params,
+                         allow_missing=allow_missing, force_init=force_init)
+
+    def save_checkpoint(self, prefix, epoch, save_optimizer_states=False):
+        arg_params, aux_params = self.get_params()
+        save_checkpoint_arrays(prefix, epoch, self.symbol, arg_params,
+                               aux_params)
+
+    @staticmethod
+    def load(prefix, epoch, load_optimizer_states=False, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        mod = Module(symbol, **kwargs)
+        mod._preloaded_params = (arg_params, aux_params)
+        return mod
+
+    @property
+    def output_shapes(self):
+        return [o.shape for o in self._exec.outputs]
+
+
+def _infer_missing_shapes(symbol, known_shapes):
+    arg_names = symbol.list_arguments()
+    missing = [n for n in arg_names if n not in known_shapes]
+    if not missing:
+        return dict(known_shapes), None, None
+    raise MXNetError(
+        f"Module.bind could not infer shapes for {missing}. The Symbol "
+        "facade requires explicit shapes for all parameters: pass them in "
+        "data_shapes, or (recommended) use gluon.HybridBlock which infers "
+        "shapes on first forward (SURVEY.md §2.1 Symbol disposition).")

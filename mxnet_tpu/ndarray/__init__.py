@@ -1,0 +1,21 @@
+"""``mx.nd`` — the imperative NDArray API.
+
+Reference surface: python/mxnet/ndarray/ (SURVEY.md §2.2). Op wrappers that
+the reference autogenerates from the NNVM registry are here plain Python
+functions in ``ops.py``.
+"""
+from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
+                      concatenate, from_jax, waitall, eye, linspace)
+from .ops import *  # noqa: F401,F403
+from .ops import concat, stack
+from . import random
+from .utils import save, load, load_frombuffer
+from . import sparse
+
+zeros_like_fn = None  # avoid accidental shadowing confusion
+
+
+def moveaxis(data, source, destination):
+    import jax.numpy as jnp
+    from .ndarray import _apply1
+    return _apply1(data, lambda d: jnp.moveaxis(d, source, destination))

@@ -1,0 +1,1170 @@
+"""The operator library: MXNet op names & semantics over jax.numpy / lax.
+
+TPU-native rebuild of the reference's NNVM-registered op library
+(SURVEY.md §2.1 "Operator library (dense)", reference dirs:
+``src/operator/tensor/``, ``src/operator/nn/``, ``src/operator/random/``,
+``src/operator/control_flow.cc``). ~150k LoC of C++/CUDA kernels collapse to
+jax.numpy/lax calls that XLA fuses and tiles onto the MXU/VPU; everything
+routes through ``apply_nary`` so the imperative autograd tape sees each op.
+
+Op hyper-parameters (dmlc Parameter structs in the reference) become plain
+keyword arguments closed over before dispatch, keeping the dispatched function
+pure over its array inputs (required for jax.vjp / jit).
+"""
+from __future__ import annotations
+
+import builtins as _builtins
+import math
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .ndarray import NDArray, apply_nary, _dtype_of, _ax, array, zeros, ones, \
+    full, arange
+
+__all__ = []  # populated at bottom
+
+
+def _nd(x, like=None):
+    if isinstance(x, NDArray):
+        return x
+    return array(x, ctx=like._ctx if like is not None else None)
+
+
+def _register(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+# ======================================================================
+# elementwise unary (reference: src/operator/tensor/elemwise_unary_op*.cc)
+# ======================================================================
+
+def _unary_factory(name, jfn):
+    def op(data, **kwargs):
+        return apply_nary(jfn, [data], name=name)
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name}. Reference: src/operator/tensor/elemwise_unary_op_basic.cc ({name})."
+    return _register(op)
+
+
+relu = _unary_factory("relu", jax.nn.relu)
+sigmoid = _unary_factory("sigmoid", jax.nn.sigmoid)
+softsign = _unary_factory("softsign", jax.nn.soft_sign)
+tanh = _unary_factory("tanh", jnp.tanh)
+exp = _unary_factory("exp", jnp.exp)
+log = _unary_factory("log", jnp.log)
+log2 = _unary_factory("log2", jnp.log2)
+log10 = _unary_factory("log10", jnp.log10)
+log1p = _unary_factory("log1p", jnp.log1p)
+expm1 = _unary_factory("expm1", jnp.expm1)
+sqrt = _unary_factory("sqrt", jnp.sqrt)
+rsqrt = _unary_factory("rsqrt", lax.rsqrt)
+cbrt = _unary_factory("cbrt", jnp.cbrt)
+square = _unary_factory("square", jnp.square)
+abs = _unary_factory("abs", jnp.abs)
+sign = _unary_factory("sign", jnp.sign)
+round = _unary_factory("round", jnp.round)
+rint = _unary_factory("rint", jnp.rint)
+ceil = _unary_factory("ceil", jnp.ceil)
+floor = _unary_factory("floor", jnp.floor)
+trunc = _unary_factory("trunc", jnp.trunc)
+fix = _unary_factory("fix", jnp.trunc)
+negative = _unary_factory("negative", jnp.negative)
+reciprocal = _unary_factory("reciprocal", jnp.reciprocal)
+sin = _unary_factory("sin", jnp.sin)
+cos = _unary_factory("cos", jnp.cos)
+tan = _unary_factory("tan", jnp.tan)
+arcsin = _unary_factory("arcsin", jnp.arcsin)
+arccos = _unary_factory("arccos", jnp.arccos)
+arctan = _unary_factory("arctan", jnp.arctan)
+sinh = _unary_factory("sinh", jnp.sinh)
+cosh = _unary_factory("cosh", jnp.cosh)
+arcsinh = _unary_factory("arcsinh", jnp.arcsinh)
+arccosh = _unary_factory("arccosh", jnp.arccosh)
+arctanh = _unary_factory("arctanh", jnp.arctanh)
+erf = _unary_factory("erf", jax.scipy.special.erf)
+erfinv = _unary_factory("erfinv", jax.scipy.special.erfinv)
+gamma = _unary_factory("gamma", lambda d: jnp.exp(jax.scipy.special.gammaln(d)))
+gammaln = _unary_factory("gammaln", jax.scipy.special.gammaln)
+logical_not = _unary_factory("logical_not",
+                             lambda d: (d == 0).astype(jnp.float32))
+zeros_like = _unary_factory("zeros_like", jnp.zeros_like)
+ones_like = _unary_factory("ones_like", jnp.ones_like)
+
+
+@_register
+def identity(data):
+    return apply_nary(lambda d: d, [data], name="identity")
+
+
+@_register
+def cast(data, dtype):
+    dt = _dtype_of(dtype)
+    return apply_nary(lambda d: d.astype(dt), [data], name="cast")
+
+
+Cast = cast
+
+
+@_register
+def clip(data, a_min, a_max):
+    return apply_nary(lambda d: jnp.clip(d, a_min, a_max), [data], name="clip")
+
+
+# ======================================================================
+# elementwise binary + broadcast (reference: elemwise_binary_broadcast_op*)
+# ======================================================================
+
+def _binary_factory(name, jfn):
+    def op(lhs, rhs, **kwargs):
+        lhs = _nd(lhs, rhs if isinstance(rhs, NDArray) else None)
+        if isinstance(rhs, NDArray):
+            return apply_nary(jfn, [lhs, rhs], name=name)
+        return apply_nary(lambda a: jfn(a, rhs), [lhs], name=name)
+    op.__name__ = name
+    op.__doc__ = f"Broadcasting binary {name}. Reference: src/operator/tensor/elemwise_binary_broadcast_op_basic.cc."
+    return _register(op)
+
+
+add = _binary_factory("add", jnp.add)
+subtract = _binary_factory("subtract", jnp.subtract)
+multiply = _binary_factory("multiply", jnp.multiply)
+divide = _binary_factory("divide", jnp.divide)
+modulo = _binary_factory("modulo", jnp.mod)
+power = _binary_factory("power", jnp.power)
+maximum = _binary_factory("maximum", jnp.maximum)
+minimum = _binary_factory("minimum", jnp.minimum)
+hypot = _binary_factory("hypot", jnp.hypot)
+arctan2 = _binary_factory("arctan2", jnp.arctan2)
+equal = _binary_factory("equal", lambda a, b: (a == b).astype(jnp.float32))
+not_equal = _binary_factory("not_equal",
+                            lambda a, b: (a != b).astype(jnp.float32))
+greater = _binary_factory("greater", lambda a, b: (a > b).astype(jnp.float32))
+greater_equal = _binary_factory("greater_equal",
+                                lambda a, b: (a >= b).astype(jnp.float32))
+lesser = _binary_factory("lesser", lambda a, b: (a < b).astype(jnp.float32))
+lesser_equal = _binary_factory("lesser_equal",
+                               lambda a, b: (a <= b).astype(jnp.float32))
+logical_and = _binary_factory(
+    "logical_and", lambda a, b: ((a != 0) & (b != 0)).astype(jnp.float32))
+logical_or = _binary_factory(
+    "logical_or", lambda a, b: ((a != 0) | (b != 0)).astype(jnp.float32))
+logical_xor = _binary_factory(
+    "logical_xor", lambda a, b: ((a != 0) ^ (b != 0)).astype(jnp.float32))
+
+# broadcast_* aliases: in mx.nd elemwise add/sub/... were strict-shape and the
+# broadcast_ variants broadcast; jax broadcasts everywhere, so both names map
+# to the broadcasting kernel.
+for _n in ("add", "sub", "mul", "div", "mod", "power", "maximum", "minimum",
+           "hypot", "equal", "not_equal", "greater", "greater_equal",
+           "lesser", "lesser_equal", "logical_and", "logical_or",
+           "logical_xor"):
+    _base = {"sub": subtract, "mul": multiply, "div": divide,
+             "mod": modulo}.get(_n) or globals()[_n]
+    globals()["broadcast_" + _n] = _base
+    __all__.append("broadcast_" + _n)
+elemwise_add = add
+elemwise_sub = subtract
+elemwise_mul = multiply
+elemwise_div = divide
+__all__ += ["elemwise_add", "elemwise_sub", "elemwise_mul", "elemwise_div"]
+
+
+@_register
+def add_n(*args):
+    """Reference: src/operator/tensor/elemwise_sum.cc (add_n / ElementwiseSum)."""
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    return apply_nary(lambda *xs: functools_reduce(xs), list(args), name="add_n")
+
+
+def functools_reduce(xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+ElementWiseSum = add_n
+__all__.append("ElementWiseSum")
+
+
+@_register
+def where(condition, x, y):
+    return apply_nary(lambda c, a, b: jnp.where(c != 0, a, b),
+                      [_nd(condition), _nd(x), _nd(y)], name="where")
+
+
+# ======================================================================
+# reductions (reference: src/operator/tensor/broadcast_reduce_op*)
+# ======================================================================
+
+def _reduce_factory(name, jfn, exclude_support=True):
+    def op(data, axis=None, keepdims=False, exclude=False, **kwargs):
+        ax = _ax(axis)
+        if exclude and ax is not None:
+            axes = (ax,) if isinstance(ax, int) else tuple(ax)
+            ax = tuple(i for i in range(data.ndim) if i not in
+                       tuple(a % data.ndim for a in axes))
+        return apply_nary(lambda d: jfn(d, axis=ax, keepdims=keepdims),
+                          [data], name=name)
+    op.__name__ = name
+    op.__doc__ = f"Reduction {name}. Reference: src/operator/tensor/broadcast_reduce_op_value.cc."
+    return _register(op)
+
+
+sum = _reduce_factory("sum", jnp.sum)
+mean = _reduce_factory("mean", jnp.mean)
+prod = _reduce_factory("prod", jnp.prod)
+nansum = _reduce_factory("nansum", jnp.nansum)
+nanprod = _reduce_factory("nanprod", jnp.nanprod)
+max = _reduce_factory("max", jnp.max)
+min = _reduce_factory("min", jnp.min)
+norm = _reduce_factory("norm", lambda d, axis, keepdims: jnp.sqrt(
+    jnp.sum(jnp.square(d), axis=axis, keepdims=keepdims)))
+sum_axis = sum
+max_axis = max
+min_axis = min
+__all__ += ["sum_axis", "max_axis", "min_axis"]
+
+
+@_register
+def argmax(data, axis=None, keepdims=False):
+    return apply_nary(
+        lambda d: jnp.argmax(d, axis=axis, keepdims=keepdims).astype(jnp.float32),
+        [data], name="argmax")
+
+
+@_register
+def argmin(data, axis=None, keepdims=False):
+    return apply_nary(
+        lambda d: jnp.argmin(d, axis=axis, keepdims=keepdims).astype(jnp.float32),
+        [data], name="argmin")
+
+
+@_register
+def mp_sum(*a, **k):  # pragma: no cover - alias
+    return sum(*a, **k)
+
+
+# ======================================================================
+# linalg: dot / batch_dot (the MXU path)
+# ======================================================================
+
+@_register
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """mx.nd.dot semantics: reduce last axis of lhs with first axis of rhs
+    (tensordot over 1 axis), NOT numpy matmul batching.
+    Reference: src/operator/tensor/dot-inl.h."""
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.transpose(a)
+        if transpose_b:
+            b = jnp.transpose(b)
+        if a.ndim == 1 and b.ndim == 1:
+            return jnp.dot(a, b)
+        return jnp.tensordot(a, b, axes=1)
+    return apply_nary(fn, [lhs, rhs], name="dot")
+
+
+@_register
+def batch_dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """Reference: src/operator/tensor/dot-inl.h (batch_dot): (B, M, K)x(B, K, N)."""
+    def fn(a, b):
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        return jnp.matmul(a, b)
+    return apply_nary(fn, [lhs, rhs], name="batch_dot")
+
+
+@_register
+def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
+    def fn(x, y):
+        if transpose_a:
+            x = jnp.swapaxes(x, -1, -2)
+        if transpose_b:
+            y = jnp.swapaxes(y, -1, -2)
+        return alpha * jnp.matmul(x, y)
+    return apply_nary(fn, [a, b], name="linalg_gemm2")
+
+
+# ======================================================================
+# shape / matrix ops (reference: src/operator/tensor/matrix_op.cc)
+# ======================================================================
+
+@_register
+def reshape(data, shape, reverse=False):
+    return data.reshape(shape)
+
+
+Reshape = reshape
+
+
+@_register
+def flatten(data):
+    return data.flatten()
+
+
+Flatten = flatten
+__all__ += ["Reshape", "Flatten"]
+
+
+@_register
+def transpose(data, axes=None):
+    return data.transpose(axes) if axes else data.transpose()
+
+
+@_register
+def expand_dims(data, axis):
+    return data.expand_dims(axis)
+
+
+@_register
+def squeeze(data, axis=None):
+    return data.squeeze(axis)
+
+
+@_register
+def broadcast_axis(data, axis, size):
+    axes = (axis,) if isinstance(axis, int) else tuple(axis)
+    sizes = (size,) if isinstance(size, int) else tuple(size)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[a] = s
+    return data.broadcast_to(tuple(tgt))
+
+
+@_register
+def broadcast_to(data, shape):
+    return data.broadcast_to(shape)
+
+
+@_register
+def broadcast_like(lhs, rhs):
+    return lhs.broadcast_to(rhs.shape)
+
+
+@_register
+def concat(*data, dim=1):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return apply_nary(lambda *xs: jnp.concatenate(xs, axis=dim), list(data),
+                      name="concat")
+
+
+Concat = concat
+__all__.append("Concat")
+
+
+@_register
+def stack(*data, axis=0):
+    if len(data) == 1 and isinstance(data[0], (list, tuple)):
+        data = tuple(data[0])
+    return apply_nary(lambda *xs: jnp.stack(xs, axis=axis), list(data),
+                      name="stack")
+
+
+@_register
+def split(data, num_outputs, axis=1, squeeze_axis=False):
+    """Reference: src/operator/slice_channel.cc (SliceChannel/split)."""
+    def fn(d):
+        parts = jnp.split(d, num_outputs, axis=axis)
+        if squeeze_axis:
+            parts = [jnp.squeeze(p, axis=axis) for p in parts]
+        return tuple(parts)
+    return apply_nary(fn, [data], n_out=num_outputs, name="split")
+
+
+SliceChannel = split
+__all__.append("SliceChannel")
+
+
+@_register
+def slice(data, begin, end, step=None):
+    """Reference: src/operator/tensor/matrix_op.cc (slice)."""
+    begin = tuple(begin)
+    end = tuple(end)
+    step = tuple(step) if step is not None else (1,) * len(begin)
+    def fn(d):
+        idx = tuple(_pyslice(b, e, s)
+                    for b, e, s in zip(begin, end, step))
+        return d[idx + (Ellipsis,)]
+    return apply_nary(fn, [data], name="slice")
+
+
+def _pyslice(b, e, s):
+    return _builtins.slice(b, e, s)
+
+
+@_register
+def slice_axis(data, axis, begin, end):
+    def fn(d):
+        sl = [_pyslice(None, None, None)] * d.ndim
+        sl[axis] = _pyslice(begin, end if end is not None else d.shape[axis], None)
+        return d[tuple(sl)]
+    return apply_nary(fn, [data], name="slice_axis")
+
+
+@_register
+def slice_like(data, shape_like, axes=None):
+    def fn(d, ref):
+        sl = [_pyslice(None, None, None)] * d.ndim
+        dims = axes if axes is not None else range(d.ndim)
+        for a in dims:
+            sl[a] = _pyslice(0, ref.shape[a], None)
+        return d[tuple(sl)]
+    return apply_nary(fn, [data, shape_like], name="slice_like")
+
+
+@_register
+def flip(data, axis):
+    return apply_nary(lambda d: jnp.flip(d, axis), [data], name="flip")
+
+
+reverse = flip
+__all__.append("reverse")
+
+
+@_register
+def tile(data, reps):
+    return data.tile(reps)
+
+
+@_register
+def repeat(data, repeats, axis=None):
+    return data.repeat(repeats, axis)
+
+
+@_register
+def pad(data, mode="constant", pad_width=None, constant_value=0.0):
+    """Reference: src/operator/pad.cc. pad_width is the flat MXNet layout
+    (before_1, after_1, before_2, after_2, ...)."""
+    pw = list(pad_width)
+    pairs = [(pw[i], pw[i + 1]) for i in range(0, len(pw), 2)]
+    jmode = {"constant": "constant", "edge": "edge", "reflect": "reflect"}[mode]
+    kwargs = {"constant_values": constant_value} if mode == "constant" else {}
+    return apply_nary(lambda d: jnp.pad(d, pairs, mode=jmode, **kwargs),
+                      [data], name="pad")
+
+
+@_register
+def swapaxes(data, dim1, dim2):
+    return data.swapaxes(dim1, dim2)
+
+
+SwapAxis = swapaxes
+__all__.append("SwapAxis")
+
+
+@_register
+def space_to_depth(data, block_size):
+    b = block_size
+    def fn(d):
+        n, c, h, w = d.shape
+        d = d.reshape(n, c, h // b, b, w // b, b)
+        d = jnp.transpose(d, (0, 3, 5, 1, 2, 4))
+        return d.reshape(n, c * b * b, h // b, w // b)
+    return apply_nary(fn, [data], name="space_to_depth")
+
+
+@_register
+def depth_to_space(data, block_size):
+    b = block_size
+    def fn(d):
+        n, c, h, w = d.shape
+        d = d.reshape(n, b, b, c // (b * b), h, w)
+        d = jnp.transpose(d, (0, 3, 4, 1, 5, 2))
+        return d.reshape(n, c // (b * b), h * b, w * b)
+    return apply_nary(fn, [data], name="depth_to_space")
+
+
+# ======================================================================
+# indexing ops (reference: src/operator/tensor/indexing_op.cc)
+# ======================================================================
+
+@_register
+def take(a, indices, axis=0, mode="clip"):
+    idx = _nd(indices, a)
+    def fn(d, i):
+        ii = i.astype(jnp.int32)
+        if mode == "wrap":
+            ii = jnp.mod(ii, d.shape[axis])
+        else:
+            ii = jnp.clip(ii, 0, d.shape[axis] - 1)
+        return jnp.take(d, ii, axis=axis)
+    return apply_nary(fn, [a, idx], name="take")
+
+
+@_register
+def pick(data, index, axis=-1, keepdims=False, mode="clip"):
+    idx = _nd(index, data)
+    def fn(d, i):
+        ii = jnp.clip(i.astype(jnp.int32), 0, d.shape[axis] - 1)
+        out = jnp.take_along_axis(d, jnp.expand_dims(ii, axis % d.ndim if axis >= 0 else axis),
+                                  axis=axis)
+        return out if keepdims else jnp.squeeze(out, axis=axis)
+    return apply_nary(fn, [data, idx], name="pick")
+
+
+@_register
+def gather_nd(data, indices):
+    def fn(d, i):
+        ii = i.astype(jnp.int32)
+        return d[tuple(ii[k] for k in range(ii.shape[0]))]
+    return apply_nary(fn, [data, _nd(indices, data)], name="gather_nd")
+
+
+@_register
+def scatter_nd(data, indices, shape):
+    def fn(d, i):
+        ii = i.astype(jnp.int32)
+        out = jnp.zeros(tuple(shape), d.dtype)
+        return out.at[tuple(ii[k] for k in range(ii.shape[0]))].add(d)
+    return apply_nary(fn, [data, _nd(indices, data)], name="scatter_nd")
+
+
+@_register
+def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
+    dt = _dtype_of(dtype)
+    def fn(i):
+        oh = jax.nn.one_hot(i.astype(jnp.int32), depth, dtype=dt)
+        return oh * (on_value - off_value) + off_value
+    return apply_nary(fn, [_nd(indices)], name="one_hot")
+
+
+@_register
+def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False):
+    """Reference: src/operator/tensor/indexing_op.cc (Embedding)."""
+    def fn(i, w):
+        return jnp.take(w, i.astype(jnp.int32), axis=0)
+    return apply_nary(fn, [_nd(data), weight], name="Embedding")
+
+
+embedding = Embedding
+__all__.append("embedding")
+
+
+@_register
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return identity(data)
+    def fn(d, sl):
+        steps = jnp.arange(d.shape[axis])
+        bshape = [1] * d.ndim
+        bshape[axis] = d.shape[axis]
+        batch_axis = 1 - axis  # mx convention: (T, B, ...) ax0 or (B, T) ax1
+        sshape = [1] * d.ndim
+        sshape[batch_axis] = d.shape[batch_axis]
+        mask = steps.reshape(bshape) < sl.reshape(sshape)
+        return jnp.where(mask, d, jnp.asarray(value, d.dtype))
+    return apply_nary(fn, [data, _nd(sequence_length, data)],
+                      name="sequence_mask")
+
+
+SequenceMask = sequence_mask
+__all__.append("SequenceMask")
+
+
+@_register
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return slice_axis(data, axis=axis, begin=-1, end=None).squeeze(axis)
+    def fn(d, sl):
+        idx = (sl.astype(jnp.int32) - 1)
+        # index layout depends on the time axis: batch sits on the other of
+        # axes {0,1} (reference src/operator/sequence_last.cc supports both)
+        batch_axis = 1 - axis
+        ishape = [1] * d.ndim
+        ishape[batch_axis] = d.shape[batch_axis]
+        return jnp.take_along_axis(d, idx.reshape(ishape), axis=axis) \
+            .squeeze(axis)
+    return apply_nary(fn, [data, _nd(sequence_length, data)],
+                      name="sequence_last")
+
+
+@_register
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False,
+                     axis=0):
+    if not use_sequence_length or sequence_length is None:
+        return flip(data, axis)
+    def fn(d, sl):
+        T = d.shape[axis]
+        steps = jnp.arange(T).reshape((-1,) + (1,) * (d.ndim - 1))
+        sl_b = sl.astype(jnp.int32).reshape((1, -1) + (1,) * (d.ndim - 2))
+        rev_idx = jnp.where(steps < sl_b, sl_b - 1 - steps, steps)
+        return jnp.take_along_axis(d, jnp.broadcast_to(rev_idx, d.shape),
+                                   axis=0)
+    return apply_nary(fn, [data, _nd(sequence_length, data)],
+                      name="sequence_reverse")
+
+
+SequenceReverse = sequence_reverse
+SequenceLast = sequence_last
+__all__ += ["SequenceReverse", "SequenceLast"]
+
+
+# ======================================================================
+# ordering (reference: src/operator/tensor/ordering_op.cc)
+# ======================================================================
+
+@_register
+def sort(data, axis=-1, is_ascend=True):
+    def fn(d):
+        out = jnp.sort(d, axis=axis)
+        return out if is_ascend else jnp.flip(out, axis=axis)
+    return apply_nary(fn, [data], name="sort")
+
+
+@_register
+def argsort(data, axis=-1, is_ascend=True, dtype="float32"):
+    dt = _dtype_of(dtype)
+    def fn(d):
+        out = jnp.argsort(d, axis=axis)
+        if not is_ascend:
+            out = jnp.flip(out, axis=axis)
+        return out.astype(dt)
+    return apply_nary(fn, [data], name="argsort")
+
+
+@_register
+def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
+         dtype="float32"):
+    dt = _dtype_of(dtype)
+    def fn(d):
+        dd = jnp.swapaxes(d, axis, -1) if axis not in (-1, d.ndim - 1) else d
+        vals, idx = lax.top_k(-dd if is_ascend else dd, k)
+        if is_ascend:
+            vals = -vals
+        if axis not in (-1, d.ndim - 1):
+            vals = jnp.swapaxes(vals, axis, -1)
+            idx = jnp.swapaxes(idx, axis, -1)
+        if ret_typ == "value":
+            return vals
+        if ret_typ == "both":
+            return (vals, idx.astype(dt))
+        return idx.astype(dt)
+    n_out = 2 if ret_typ == "both" else 1
+    return apply_nary(fn, [data], n_out=n_out, name="topk")
+
+
+# ======================================================================
+# neural-net ops (reference: src/operator/nn/*)
+# ======================================================================
+
+@_register
+def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                   flatten=True):
+    """Reference: src/operator/nn/fully_connected.cc. weight is (out, in) —
+    MXNet layout; the matmul hits the MXU as data @ weight.T."""
+    inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    def fn(d, w, *b):
+        x = d.reshape(d.shape[0], -1) if flatten and d.ndim > 2 else d
+        y = jnp.matmul(x, w.T)
+        if b:
+            y = y + b[0]
+        return y
+    return apply_nary(fn, inputs, name="FullyConnected")
+
+
+fully_connected = FullyConnected
+__all__.append("fully_connected")
+
+
+@_register
+def Activation(data, act_type="relu"):
+    """Reference: src/operator/nn/activation.cc."""
+    fns = {"relu": jax.nn.relu, "sigmoid": jax.nn.sigmoid,
+           "tanh": jnp.tanh, "softrelu": jax.nn.softplus,
+           "softsign": jax.nn.soft_sign}
+    if act_type not in fns:
+        raise MXNetError(f"unknown act_type {act_type}")
+    return apply_nary(fns[act_type], [data], name="Activation")
+
+
+@_register
+def LeakyReLU(data, gamma=None, act_type="leaky", slope=0.25,
+              lower_bound=0.125, upper_bound=0.334):
+    """Reference: src/operator/leaky_relu.cc (leaky/prelu/elu/selu/gelu)."""
+    if act_type == "leaky":
+        return apply_nary(lambda d: jax.nn.leaky_relu(d, slope), [data],
+                          name="LeakyReLU")
+    if act_type == "elu":
+        return apply_nary(lambda d: jax.nn.elu(d, slope), [data])
+    if act_type == "selu":
+        return apply_nary(jax.nn.selu, [data])
+    if act_type == "gelu":
+        return apply_nary(lambda d: jax.nn.gelu(d, approximate=False), [data])
+    if act_type == "prelu":
+        def fn(d, g):
+            return jnp.where(d >= 0, d, _reshape_gamma(g, d) * d)
+        return apply_nary(fn, [data, gamma], name="prelu")
+    raise MXNetError(f"unknown LeakyReLU act_type {act_type}")
+
+
+def _reshape_gamma(g, d):
+    if g.ndim == 1 and d.ndim > 1:
+        return g.reshape((1, -1) + (1,) * (d.ndim - 2))
+    return g
+
+
+@_register
+def softmax(data, axis=-1, temperature=None, length=None):
+    def fn(d):
+        x = d / temperature if temperature else d
+        return jax.nn.softmax(x, axis=axis)
+    return apply_nary(fn, [data], name="softmax")
+
+
+@_register
+def log_softmax(data, axis=-1, temperature=None):
+    def fn(d):
+        x = d / temperature if temperature else d
+        return jax.nn.log_softmax(x, axis=axis)
+    return apply_nary(fn, [data], name="log_softmax")
+
+
+@_register
+def softmin(data, axis=-1):
+    return apply_nary(lambda d: jax.nn.softmax(-d, axis=axis), [data])
+
+
+@_register
+def SoftmaxActivation(data, mode="instance"):
+    axis = 1 if mode == "channel" else -1
+    return softmax(data, axis=axis)
+
+
+@_register
+def SoftmaxOutput(data, label, grad_scale=1.0, ignore_label=-1,
+                  use_ignore=False, multi_output=False, normalization="null",
+                  out_grad=False, smooth_alpha=0.0):
+    """Forward = softmax; backward = (p - onehot(label)) — the classic fused
+    op. Reference: src/operator/softmax_output.cc. Implemented with a custom
+    vjp so the Module/Symbol path trains identically."""
+    @jax.custom_vjp
+    def _so(d, l):
+        return jax.nn.softmax(d, axis=-1)
+
+    def _fwd(d, l):
+        p = jax.nn.softmax(d, axis=-1)
+        return p, (p, l)
+
+    def _bwd(res, g):
+        p, l = res
+        oh = jax.nn.one_hot(l.astype(jnp.int32), p.shape[-1], dtype=p.dtype)
+        grad = (p - oh) * grad_scale
+        if use_ignore:
+            mask = (l != ignore_label).astype(p.dtype)
+            grad = grad * mask[..., None]
+        if normalization == "batch":
+            grad = grad / p.shape[0]
+        elif normalization == "valid" and use_ignore:
+            denom = jnp.maximum(jnp.sum(l != ignore_label), 1).astype(p.dtype)
+            grad = grad / denom
+        return grad, None
+
+    _so.defvjp(_fwd, _bwd)
+    return apply_nary(_so, [data, _nd(label, data)], name="SoftmaxOutput")
+
+
+@_register
+def Dropout(data, p=0.5, mode="training", axes=None, cudnn_off=False):
+    """Reference: src/operator/nn/dropout.cc. Uses the framework PRNG stream
+    (mx.random) — explicit-key JAX PRNG behind a stateful facade."""
+    from . import random as _rnd
+    from .. import _tape as _t
+    if not _t.is_training() or p <= 0:
+        return identity(data)
+    key = _rnd.next_key()
+    def fn(d):
+        shape = d.shape
+        if axes:
+            shape = tuple(1 if i in axes else s for i, s in enumerate(d.shape))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        return jnp.where(keep, d / (1.0 - p), jnp.zeros((), d.dtype))
+    return apply_nary(fn, [data], name="Dropout")
+
+
+# ---- convolution / pooling ----
+
+def _conv_dn(ndim):
+    # data NC[D]HW, kernel OI[D]HW — MXNet layout throughout
+    spec = {1: ("NCH", "OIH", "NCH"), 2: ("NCHW", "OIHW", "NCHW"),
+            3: ("NCDHW", "OIDHW", "NCDHW")}[ndim]
+    return lax.conv_dimension_numbers((1, 1) + (1,) * ndim,
+                                      (1, 1) + (1,) * ndim, spec)
+
+
+@_register
+def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                workspace=None, layout=None, cudnn_off=False,
+                cudnn_tune=None):
+    """Reference: src/operator/nn/convolution.cc. Lowered to lax.conv_general_dilated
+    so XLA:TPU picks MXU tiling (the reference dispatched to cuDNN)."""
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    dilate = tuple(dilate) if dilate else (1,) * nd
+    pad_ = tuple(pad) if pad else (0,) * nd
+    padding = [(p, p) for p in pad_]
+    dn = _conv_dn(nd)
+    inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    def fn(d, w, *b):
+        y = lax.conv_general_dilated(
+            d, w, window_strides=stride, padding=padding,
+            rhs_dilation=dilate, dimension_numbers=dn,
+            feature_group_count=num_group,
+            preferred_element_type=jnp.float32 if d.dtype == jnp.bfloat16 else None)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)
+        return y.astype(d.dtype)
+    return apply_nary(fn, inputs, name="Convolution")
+
+
+@_register
+def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
+                  dilate=None, pad=None, adj=None, target_shape=None,
+                  num_filter=None, num_group=1, no_bias=True, workspace=None,
+                  layout=None, cudnn_off=False, cudnn_tune=None):
+    """Transposed conv. Reference: src/operator/nn/deconvolution.cc."""
+    nd = len(kernel)
+    stride = tuple(stride) if stride else (1,) * nd
+    pad_ = tuple(pad) if pad else (0,) * nd
+    adj_ = tuple(adj) if adj else (0,) * nd
+    inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    def fn(d, w, *b):
+        # deconv forward == gradient of conv wrt input: lhs-dilate by stride,
+        # pad with (k-1-p), flip + transpose kernel (transpose_kernel=True).
+        # MXNet output size: (in-1)*s - 2p + k + adj
+        padding = [(kernel[i] - 1 - pad_[i],
+                    kernel[i] - 1 - pad_[i] + adj_[i]) for i in range(nd)]
+        y = lax.conv_transpose(
+            d, w,
+            strides=stride,
+            padding=padding,
+            dimension_numbers=_conv_dn(nd),
+            transpose_kernel=True)
+        if b:
+            y = y + b[0].reshape((1, -1) + (1,) * nd)
+        return y
+    return apply_nary(fn, inputs, name="Deconvolution")
+
+
+@_register
+def Pooling(data, kernel=None, pool_type="max", global_pool=False,
+            stride=None, pad=None, pooling_convention="valid",
+            cudnn_off=False, count_include_pad=True, layout=None):
+    """Reference: src/operator/nn/pooling.cc. Supports max/avg/sum/lp?, the
+    'valid'|'full' pooling_convention quirk (full = ceil division)."""
+    def fn(d):
+        nd = d.ndim - 2
+        if global_pool:
+            axes = tuple(range(2, d.ndim))
+            if pool_type == "max":
+                return jnp.max(d, axis=axes, keepdims=True)
+            return jnp.mean(d, axis=axes, keepdims=True)
+        k = tuple(kernel)
+        s = tuple(stride) if stride else (1,) * nd
+        p = tuple(pad) if pad else (0,) * nd
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        if pooling_convention == "full":
+            # ceil mode: pad right enough so ceil((x+2p-k)/s)+1 windows fit
+            extra = []
+            for i in range(nd):
+                x = d.shape[2 + i] + 2 * p[i]
+                out = -(-(x - k[i]) // s[i]) + 1
+                need = (out - 1) * s[i] + k[i] - x
+                extra.append(builtins_max(need, 0))
+            padding = [(0, 0), (0, 0)] + [(p[i], p[i] + extra[i])
+                                          for i in range(nd)]
+        else:
+            padding = [(0, 0), (0, 0)] + [(p[i], p[i]) for i in range(nd)]
+        if pool_type == "max":
+            init = -jnp.inf if jnp.issubdtype(d.dtype, jnp.floating) else \
+                jnp.iinfo(d.dtype).min
+            return lax.reduce_window(d, init, lax.max, window, strides,
+                                     padding)
+        zero = jnp.zeros((), d.dtype)
+        ssum = lax.reduce_window(d, zero, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return ssum
+        if count_include_pad:
+            return (ssum / _np.prod(k)).astype(d.dtype)
+        ones_ = jnp.ones_like(d)
+        cnt = lax.reduce_window(ones_, zero, lax.add, window, strides, padding)
+        return (ssum / cnt).astype(d.dtype)
+    return apply_nary(fn, [data], name="Pooling")
+
+
+def builtins_max(a, b):
+    return a if a > b else b
+
+
+@_register
+def BatchNorm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+              momentum=0.9, fix_gamma=True, use_global_stats=False,
+              output_mean_var=False, axis=1, cudnn_off=False):
+    """Stateless op-level BatchNorm (normalizes with given stats in eval, batch
+    stats in train). Running-stat *updates* are handled by gluon.nn.BatchNorm,
+    which threads aux state explicitly (SURVEY.md §7 hard parts).
+    Reference: src/operator/nn/batch_norm.cc."""
+    from .. import _tape as _t
+    training = _t.is_training() and not use_global_stats
+    def fn(d, g, b, mm, mv):
+        shape = [1] * d.ndim
+        shape[axis] = d.shape[axis]
+        g_ = jnp.ones_like(g) if fix_gamma else g
+        if training:
+            axes = tuple(i for i in range(d.ndim) if i != axis)
+            m = jnp.mean(d, axis=axes)
+            v = jnp.var(d, axis=axes)
+        else:
+            m, v = mm, mv
+        inv = lax.rsqrt(v + eps).reshape(shape)
+        return (d - m.reshape(shape)) * inv * g_.reshape(shape) + b.reshape(shape)
+    return apply_nary(fn, [data, gamma, beta, moving_mean, moving_var],
+                      name="BatchNorm")
+
+
+@_register
+def LayerNorm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False):
+    """Reference: src/operator/nn/layer_norm.cc."""
+    def fn(d, g, b):
+        m = jnp.mean(d, axis=axis, keepdims=True)
+        v = jnp.var(d, axis=axis, keepdims=True)
+        shape = [1] * d.ndim
+        shape[axis] = d.shape[axis]
+        return (d - m) * lax.rsqrt(v + eps) * g.reshape(shape) + b.reshape(shape)
+    return apply_nary(fn, [data, gamma, beta], name="LayerNorm")
+
+
+@_register
+def InstanceNorm(data, gamma, beta, eps=1e-3):
+    def fn(d, g, b):
+        axes = tuple(range(2, d.ndim))
+        m = jnp.mean(d, axis=axes, keepdims=True)
+        v = jnp.var(d, axis=axes, keepdims=True)
+        shape = (1, -1) + (1,) * (d.ndim - 2)
+        return (d - m) * lax.rsqrt(v + eps) * g.reshape(shape) + b.reshape(shape)
+    return apply_nary(fn, [data, gamma, beta], name="InstanceNorm")
+
+
+@_register
+def L2Normalization(data, eps=1e-10, mode="instance"):
+    def fn(d):
+        if mode == "instance":
+            axes = tuple(range(1, d.ndim))
+        elif mode == "channel":
+            axes = (1,)
+        else:
+            axes = tuple(range(1, d.ndim))
+        nrm = jnp.sqrt(jnp.sum(jnp.square(d), axis=axes, keepdims=True) + eps)
+        return d / nrm
+    return apply_nary(fn, [data], name="L2Normalization")
+
+
+@_register
+def RNN(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, mode="lstm", bidirectional=False, p=0.0,
+        state_outputs=False, projection_size=None, sequence_length=None,
+        use_sequence_length=False):
+    """Fused RNN op is realised at the Gluon layer via lax.scan
+    (gluon/rnn/rnn_layer.py); this symbol exists for API-surface parity.
+    Reference: src/operator/rnn.cc."""
+    raise MXNetError("nd.RNN: use gluon.rnn.{RNN,LSTM,GRU} on the TPU "
+                     "rebuild (lax.scan-based fused path)")
+
+
+# ======================================================================
+# losses at op level (reference: src/operator/loss_binary_op.cc etc.)
+# ======================================================================
+
+@_register
+def softmax_cross_entropy(data, label):
+    def fn(d, l):
+        logp = jax.nn.log_softmax(d, axis=-1)
+        oh = jax.nn.one_hot(l.astype(jnp.int32), d.shape[-1], dtype=d.dtype)
+        return -jnp.sum(oh * logp)
+    return apply_nary(fn, [data, _nd(label, data)],
+                      name="softmax_cross_entropy")
+
+
+@_register
+def smooth_l1(data, scalar=1.0):
+    s2 = scalar * scalar
+    def fn(d):
+        a = jnp.abs(d)
+        return jnp.where(a < 1.0 / s2, 0.5 * s2 * jnp.square(d), a - 0.5 / s2)
+    return apply_nary(fn, [data], name="smooth_l1")
+
+
+@_register
+def MakeLoss(data, grad_scale=1.0, valid_thresh=0.0, normalization="null"):
+    return apply_nary(lambda d: d * grad_scale, [data], name="MakeLoss")
+
+
+@_register
+def BlockGrad(data):
+    """Reference: src/operator/tensor/elemwise_unary_op_basic.cc (BlockGrad)."""
+    return apply_nary(lambda d: lax.stop_gradient(d), [data], name="BlockGrad")
+
+
+stop_gradient = BlockGrad
+__all__.append("stop_gradient")
+
+
+# ======================================================================
+# control flow (reference: src/operator/control_flow.cc — foreach/while/cond)
+# ======================================================================
+
+@_register
+def foreach(body, data, init_states):
+    """lax.scan-backed foreach. body(elem, states) -> (out, new_states).
+    Works on NDArrays imperatively (not differentiable through the tape in
+    v1 — use inside HybridBlock/jit for the differentiable path)."""
+    single = not isinstance(data, (list, tuple))
+    datas = [data] if single else list(data)
+    states_single = not isinstance(init_states, (list, tuple))
+    states = [init_states] if states_single else list(init_states)
+
+    def step(carry, xs):
+        c_nd = [NDArray(c) for c in carry]
+        x_nd = [NDArray(x) for x in xs]
+        out, new_states = body(x_nd[0] if single else x_nd,
+                               c_nd[0] if states_single else c_nd)
+        outs = [out] if not isinstance(out, (list, tuple)) else list(out)
+        ns = [new_states] if not isinstance(new_states, (list, tuple)) \
+            else list(new_states)
+        return tuple(s._data for s in ns), tuple(o._data for o in outs)
+
+    from .. import _tape as _t
+    with _t.trace_scope():
+        final, stacked = lax.scan(step, tuple(s._data for s in states),
+                                  tuple(d._data for d in datas))
+    outs = [NDArray(s) for s in stacked]
+    fstates = [NDArray(f) for f in final]
+    return (outs[0] if len(outs) == 1 else outs,
+            fstates[0] if states_single else fstates)
+
+
+@_register
+def cond(pred, then_func, else_func):
+    p = pred.asscalar() if isinstance(pred, NDArray) else pred
+    return then_func() if p else else_func()
+
+
+@_register
+def while_loop(cond_fn, func, loop_vars, max_iterations=None):
+    steps = 0
+    outputs = []
+    lv = list(loop_vars)
+    while cond_fn(*lv) and (max_iterations is None or steps < max_iterations):
+        out, lv = func(*lv)
+        lv = list(lv) if isinstance(lv, (list, tuple)) else [lv]
+        outputs.append(out)
+        steps += 1
+    if outputs and isinstance(outputs[0], (list, tuple)):
+        outs = [stack(*[o[i] for o in outputs], axis=0)
+                for i in range(len(outputs[0]))]
+    elif outputs:
+        outs = stack(*outputs, axis=0)
+    else:
+        outs = []
+    return outs, lv
+
+
+# ======================================================================
+# optimizer update ops (reference: src/operator/optimizer_op.cc) —
+# these are the fused kernels Trainer/Optimizer call per parameter.
+# ======================================================================
+
+@_register
+def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+               lazy_update=True, out=None):
+    def fn(w, g):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        return w - lr * g
+    new_w = apply_nary(fn, [weight, grad], name="sgd_update")
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, lazy_update=True,
+                   out=None):
+    def fn(w, g, m):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        m_new = momentum * m - lr * g
+        return (w + m_new, m_new)
+    new_w, new_m = apply_nary(fn, [weight, grad, mom], n_out=2,
+                              name="sgd_mom_update")
+    mom._set_data(new_m._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                lazy_update=True, out=None):
+    def fn(w, g, m, v):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * w
+        m_new = beta1 * m + (1 - beta1) * g
+        v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+        return (w - lr * m_new / (jnp.sqrt(v_new) + epsilon), m_new, v_new)
+    new_w, new_m, new_v = apply_nary(fn, [weight, grad, mean, var], n_out=3,
+                                     name="adam_update")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+# ======================================================================
+# misc
+# ======================================================================
+
+@_register
+def shape_array(data):
+    return apply_nary(lambda d: jnp.asarray(d.shape, jnp.int64), [data])
+
+
+@_register
+def size_array(data):
+    return apply_nary(lambda d: jnp.asarray([d.size], jnp.int64), [data])
+
+
+@_register
+def diag(data, k=0):
+    return apply_nary(lambda d: jnp.diag(d, k) if d.ndim <= 2
+                      else jnp.diagonal(d, k), [data], name="diag")
+
+
+@_register
+def batch_take(a, indices):
+    def fn(d, i):
+        return jnp.take_along_axis(
+            d, i.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
+    return apply_nary(fn, [a, _nd(indices, a)], name="batch_take")

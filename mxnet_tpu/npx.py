@@ -1,0 +1,26 @@
+"""``mx.npx`` — numpy-extension namespace.
+
+Reference: python/mxnet/numpy_extension/ [≥1.6]. Provides the non-numpy
+neural ops under numpy semantics. Backed directly by the op library.
+"""
+from __future__ import annotations
+
+from .ndarray.ops import (softmax, log_softmax, relu, sigmoid, one_hot,
+                          topk, pick, batch_dot, FullyConnected, Convolution,
+                          Pooling, BatchNorm, LayerNorm, Embedding, Dropout,
+                          Activation, sequence_mask)
+from .util import set_np, reset_np, is_np_array
+
+fully_connected = FullyConnected
+convolution = Convolution
+pooling = Pooling
+batch_norm = BatchNorm
+layer_norm = LayerNorm
+embedding = Embedding
+dropout = Dropout
+activation = Activation
+
+
+def gelu(x):
+    from .ndarray.ops import LeakyReLU
+    return LeakyReLU(x, act_type="gelu")

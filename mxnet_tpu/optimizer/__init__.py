@@ -1,0 +1,4 @@
+"""``mx.optimizer`` package (reference: python/mxnet/optimizer/)."""
+from .optimizer import *  # noqa: F401,F403
+from .optimizer import register, create, Optimizer, Updater, get_updater
+from . import lr_scheduler

@@ -1,0 +1,545 @@
+"""``mx.optimizer`` — optimizers with MXNet's create_state/update contract.
+
+Reference: python/mxnet/optimizer/optimizer.py + the fused update kernels in
+src/operator/optimizer_op.cc (SURVEY.md §2.2 "Optimizers"). Each ``update``
+here is a single fused jax function per parameter (XLA fuses the elementwise
+chain — the role of the reference's hand-fused CUDA kernels); Trainer's
+hybridized path goes further and folds ALL parameter updates into the one
+jitted train step.
+
+Covers: SGD(+momentum), NAG, Adam, AdamW, AdaGrad, AdaDelta, RMSProp, Ftrl,
+Signum, LAMB, LARS, SGLD, DCASGD, MultiSGD-equivalent fused group update.
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+
+from ..base import MXNetError, registry_create
+from ..ndarray.ndarray import NDArray
+from ..ndarray import random as _rnd
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
+           "RMSProp", "Ftrl", "Signum", "LAMB", "LARS", "SGLD", "Test",
+           "register", "create", "Updater", "get_updater"]
+
+register, create, _REGISTRY = registry_create("optimizer")
+
+
+class Optimizer:
+    """Base optimizer. Reference contract: create_state(index, weight) ->
+    state; update(index, weight, grad, state) mutates weight in place."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self.multi_precision = multi_precision
+        self._index_update_count = {}
+        self.idx2name = param_idx2name.copy() if param_idx2name else {}
+        self.param_dict = param_dict if param_dict else {}
+        self.lr_mult = {}
+        self.wd_mult = {}
+
+    create = staticmethod(lambda name, **kwargs: create(name, **kwargs))
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == _np.float16:
+            weight_master_copy = NDArray(weight.data.astype(jnp.float32),
+                                         weight.context)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == _np.float16:
+            inner_state, master = state
+            grad32 = NDArray(grad.data.astype(jnp.float32), grad.context)
+            self.update(index, master, grad32, inner_state)
+            weight._set_data(master.data.astype(jnp.float16))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- bookkeeping -------------------------------------------------------
+    def _update_count(self, index):
+        if index not in self._index_update_count:
+            self._index_update_count[index] = self.begin_num_update
+        self._index_update_count[index] += 1
+        self.num_update = max(self._index_update_count[index], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            lr *= self.param_dict[index].lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= self.param_dict[index].wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("lr_scheduler is set; cannot set lr directly")
+        self.lr = lr
+
+    @property
+    def learning_rate(self):
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = dict(args_wd_mult)
+
+    def _preprocess_grad(self, g, w, wd):
+        g = g * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        if wd:
+            g = g + wd * w
+        return g
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum. Reference: optimizer.SGD + sgd_mom_update kernel
+    (src/operator/optimizer_op.cc). Lazy sparse updates are accepted and
+    executed densely (XLA has no sparse apply)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                       weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        if state is None:
+            weight._set_data(weight.data - lr * g)
+        else:
+            m = self.momentum * state.data - lr * g
+            state._set_data(m)
+            weight._set_data(weight.data + m)
+
+
+@register
+class NAG(SGD):
+    """Nesterov accelerated SGD. Reference: optimizer.NAG."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        if state is None:
+            weight._set_data(weight.data - lr * g)
+        else:
+            m = self.momentum * state.data + g
+            state._set_data(m)
+            weight._set_data(weight.data - lr * (g + self.momentum * m))
+
+
+@register
+class Adam(Optimizer):
+    """Reference: optimizer.Adam + adam_update kernel. Bias correction folded
+    into the step size exactly as the reference does."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                            weight.context)
+        return (z(), z())  # mean, var
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = lr * math.sqrt(coef2) / coef1
+        mean, var = state
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        m = self.beta1 * mean.data + (1 - self.beta1) * g
+        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
+        mean._set_data(m)
+        var._set_data(v)
+        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+
+
+@register
+class AdamW(Adam):
+    """Decoupled weight decay (reference: contrib adamw_update op)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
+        mean, var = state
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m = self.beta1 * mean.data + (1 - self.beta1) * g
+        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
+        mean._set_data(m)
+        var._set_data(v)
+        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon)
+                         - lr * wd * weight.data)
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                       weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        h = state.data + jnp.square(g)
+        state._set_data(h)
+        weight._set_data(weight.data - lr * g /
+                         (jnp.sqrt(h) + self.float_stable_eps))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                            weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        acc_g, acc_delta = state
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        ag = self.rho * acc_g.data + (1 - self.rho) * jnp.square(g)
+        delta = jnp.sqrt(acc_delta.data + self.epsilon) / \
+            jnp.sqrt(ag + self.epsilon) * g
+        ad = self.rho * acc_delta.data + (1 - self.rho) * jnp.square(delta)
+        acc_g._set_data(ag)
+        acc_delta._set_data(ad)
+        weight._set_data(weight.data - delta)
+
+
+@register
+class RMSProp(Optimizer):
+    """Reference: optimizer.RMSProp (centered=False default, gamma1/gamma2)."""
+
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                            weight.context)
+        if self.centered:
+            return (z(), z(), z())  # n, g, delta
+        return (z(),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        if not self.centered:
+            (n,) = state
+            n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
+            n._set_data(n_new)
+            w = weight.data - lr * g / jnp.sqrt(n_new + self.epsilon)
+        else:
+            n, gbar, delta = state
+            n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
+            g_new = (1 - self.gamma1) * g + self.gamma1 * gbar.data
+            d_new = self.gamma2 * delta.data - lr * g / jnp.sqrt(
+                n_new - jnp.square(g_new) + self.epsilon)
+            n._set_data(n_new)
+            gbar._set_data(g_new)
+            delta._set_data(d_new)
+            w = weight.data + d_new
+        if self.clip_weights:
+            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
+        weight._set_data(w)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                            weight.context)
+        return (z(), z())  # z, n
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        zs, ns = state
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        n_new = ns.data + jnp.square(g)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(ns.data)) / lr
+        z_new = zs.data + g - sigma * weight.data
+        ns._set_data(n_new)
+        zs._set_data(z_new)
+        w = -(z_new - jnp.sign(z_new) * self.lamda1) / \
+            ((self.beta + jnp.sqrt(n_new)) / lr + wd)
+        weight._set_data(jnp.where(jnp.abs(z_new) <= self.lamda1,
+                                   jnp.zeros_like(w), w))
+
+
+@register
+class Signum(Optimizer):
+    """Reference: optimizer.Signum (signSGD + momentum)."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                       weight.context)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        if state is not None:
+            m = self.momentum * state.data - (1 - self.momentum) * g
+            state._set_data(m)
+            step = jnp.sign(m)
+        else:
+            step = -jnp.sign(g)
+        weight._set_data((1 - lr * self.wd_lh) * weight.data + lr * step)
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise adaptive moments for large-batch BERT (reference [≥1.6]:
+    optimizer.LAMB / lamb_update ops)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                            weight.context)
+        return (z(), z())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        t = self._index_update_count[index]
+        mean, var = state
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        m = self.beta1 * mean.data + (1 - self.beta1) * g
+        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
+        mean._set_data(m)
+        var._set_data(v)
+        if self.bias_correction:
+            m_hat = m / (1 - self.beta1 ** t)
+            v_hat = v / (1 - self.beta2 ** t)
+        else:
+            m_hat, v_hat = m, v
+        update = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight.data
+        w_norm = jnp.linalg.norm(weight.data)
+        u_norm = jnp.linalg.norm(update)
+        if self.lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, self.lower_bound)
+        if self.upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, self.upper_bound)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        weight._set_data(weight.data - lr * ratio * update)
+
+
+@register
+class LARS(SGD):
+    """Layer-wise adaptive rate scaling for large-batch CNNs (reference
+    [≥1.6]: optimizer.LARS)."""
+
+    def __init__(self, eta=0.001, eps=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.eta = eta
+        self.eps = eps
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = grad.data * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w_norm = jnp.linalg.norm(weight.data)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                          self.eta * w_norm /
+                          (g_norm + wd * w_norm + self.eps), 1.0)
+        g = (g + wd * weight.data) * trust
+        if state is not None:
+            m = self.momentum * state.data - lr * g
+            state._set_data(m)
+            weight._set_data(weight.data + m)
+        else:
+            weight._set_data(weight.data - lr * g)
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic gradient Langevin dynamics (reference optimizer.SGLD)."""
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr, wd = self._get_lr(index), self._get_wd(index)
+        g = self._preprocess_grad(grad.data, weight.data, wd)
+        noise = jax.random.normal(_rnd.next_key(), weight.shape,
+                                  weight.data.dtype) * math.sqrt(lr)
+        weight._set_data(weight.data - lr / 2 * g + noise)
+
+
+@register
+class Test(Optimizer):
+    """Reference optimizer.Test — simple SGD used by test_optimizer."""
+
+    def create_state(self, index, weight):
+        return NDArray(jnp.zeros(weight.shape, weight.data.dtype),
+                       weight.context)
+
+    def update(self, index, weight, grad, state):
+        weight._set_data(weight.data - self.lr * grad.data * self.rescale_grad)
+
+
+ccSGD = SGD
+
+
+class Updater:
+    """KVStore server-side updater (reference optimizer.get_updater /
+    kvstore set_optimizer path)."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        serial = {}
+        for k, s in self.states.items():
+            serial[k] = _serialize_state(s)
+        return pickle.dumps((serial, None))
+
+    def set_states(self, states):
+        import pickle
+        serial, _ = pickle.loads(states)
+        from ..ndarray.ndarray import array as _array
+        self.states = {k: _deserialize_state(v) for k, v in serial.items()}
+
+
+def _serialize_state(s):
+    if s is None:
+        return None
+    if isinstance(s, NDArray):
+        return ("nd", s.asnumpy())
+    if isinstance(s, tuple):
+        return ("tuple", tuple(_serialize_state(x) for x in s))
+    return ("raw", s)
+
+
+def _deserialize_state(v):
+    from ..ndarray.ndarray import array as _array
+    if v is None:
+        return None
+    tag, payload = v
+    if tag == "nd":
+        return _array(payload, dtype=str(payload.dtype))
+    if tag == "tuple":
+        return tuple(_deserialize_state(x) for x in payload)
+    return payload
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

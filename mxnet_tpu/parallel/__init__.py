@@ -1,0 +1,26 @@
+"""``mxnet_tpu.parallel`` — the TPU scaling substrate.
+
+This package is NEW capability relative to the reference (SURVEY.md §2.5):
+the reference scaled via KVStore push/pull (data parallel only); here
+scaling is mesh-sharded jit:
+
+  - mesh.py:           device mesh construction (dp/tp/pp/sp axes), single- or
+                       multi-host, `jax.distributed` init from DMLC_*-style env
+  - data_parallel.py:  DataParallelTrainer — the fused jit train step with
+                       in-graph grad psum over the 'dp' axis (replaces
+                       kvstore push/pull on the hot path, SURVEY.md §7)
+  - tensor_parallel.py: megatron-style PartitionSpec annotations for Dense/
+                       Embedding/attention weights over the 'tp' axis
+  - ring_attention.py: shard_map ring attention over the 'sp' axis for
+                       long-context (SURVEY.md §5.7)
+  - ps.py:             host-side parameter server for sparse embeddings
+                       (row_sparse pull — the reference's distinctive
+                       dist_async capability, §2.5 last row)
+"""
+from .mesh import (make_mesh, local_mesh, distributed_init, mesh_scope,
+                   current_mesh, data_sharding, replicate_sharding)
+from .data_parallel import DataParallelTrainer, all_reduce_gradients
+from .tensor_parallel import (shard_params_tp, tp_spec_for_param,
+                              ParallelDense, ParallelEmbedding)
+from .ring_attention import ring_attention, sequence_parallel_attention
+from . import ps

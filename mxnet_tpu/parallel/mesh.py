@@ -1,0 +1,116 @@
+"""Device mesh construction and distributed init.
+
+Reference counterpart: the process/topology side of ps-lite + launch.py
+(SURVEY.md §2.6): DMLC_ROLE/DMLC_PS_ROOT_URI env rendezvous. TPU-native:
+``jax.distributed.initialize`` (honoring both JAX-style and DMLC-style env
+vars) and ``jax.sharding.Mesh`` over ICI/DCN.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import numpy as _np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "local_mesh", "distributed_init", "mesh_scope",
+           "current_mesh", "data_sharding", "replicate_sharding", "P"]
+
+_STATE = threading.local()
+
+
+def distributed_init(coordinator=None, num_processes=None, process_id=None):
+    """Initialize multi-host JAX. Honors DMLC-style env for launcher compat:
+    DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT -> coordinator, DMLC_NUM_WORKER ->
+    num_processes, DMLC_WORKER_ID -> process_id (reference: §2.6 env table).
+    """
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9000")
+        if uri:
+            coordinator = f"{uri}:{port}"
+    if num_processes is None and "DMLC_NUM_WORKER" in os.environ:
+        num_processes = int(os.environ["DMLC_NUM_WORKER"])
+    if process_id is None and "DMLC_WORKER_ID" in os.environ:
+        process_id = int(os.environ["DMLC_WORKER_ID"])
+    if coordinator is None:
+        return False  # single process
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh. ``axes`` is a dict name->size (-1 = infer one axis).
+
+    Example: make_mesh({'dp': -1, 'tp': 2}) on 8 devices -> 4x2 mesh.
+    Axis order follows insertion order; put the fastest-varying
+    (most-communicating, e.g. 'tp') LAST so it lands on adjacent ICI
+    neighbours (scaling-book recipe).
+    """
+    devices = devices if devices is not None else jax.devices()
+    n = len(devices)
+    axes = dict(axes or {"dp": n})
+    sizes = list(axes.values())
+    names = list(axes.keys())
+    n_infer = sizes.count(-1)
+    if n_infer > 1:
+        raise MXNetError("at most one mesh axis may be -1")
+    known = 1
+    for s in sizes:
+        if s != -1:
+            known *= s
+    if n_infer:
+        if n % known:
+            raise MXNetError(f"{n} devices not divisible by {known}")
+        sizes[sizes.index(-1)] = n // known
+    total = 1
+    for s in sizes:
+        total *= s
+    if total != n:
+        raise MXNetError(f"mesh {dict(zip(names, sizes))} != {n} devices")
+    arr = _np.asarray(devices).reshape(sizes)
+    return Mesh(arr, tuple(names))
+
+
+def local_mesh(axes=None):
+    return make_mesh(axes, jax.local_devices())
+
+
+class mesh_scope:
+    """with mesh_scope(mesh): ... — sets the ambient mesh used by
+    DataParallelTrainer / sharded layers."""
+
+    def __init__(self, mesh):
+        self.mesh = mesh
+
+    def __enter__(self):
+        stack = getattr(_STATE, "stack", None)
+        if stack is None:
+            stack = _STATE.stack = []
+        stack.append(self.mesh)
+        return self.mesh
+
+    def __exit__(self, *exc):
+        _STATE.stack.pop()
+        return False
+
+
+def current_mesh():
+    stack = getattr(_STATE, "stack", None)
+    return stack[-1] if stack else None
+
+
+def data_sharding(mesh, ndim, axis=0, data_axis="dp"):
+    """NamedSharding splitting dim `axis` over the data mesh axis."""
+    spec = [None] * ndim
+    spec[axis] = data_axis
+    return NamedSharding(mesh, P(*spec))
+
+
+def replicate_sharding(mesh):
+    return NamedSharding(mesh, P())

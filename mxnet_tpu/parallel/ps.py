@@ -1,0 +1,67 @@
+"""Host-side parameter server for sparse embeddings.
+
+Reference counterpart: the distinctive ``dist_async`` / row_sparse pull path
+(SURVEY.md §2.5 "Sparse/embedding parallel": row_sparse pull of embeddings
+from the PS, server-side optimizer). On TPU, giant embedding tables stay in
+HOST memory; workers pull only the rows a batch touches (gather on host,
+device_put of the slab), push row gradients back, and the server applies the
+optimizer row-wise — the classic PS pattern with processes replaced by a
+host-memory table per process + allgather of row updates across processes.
+"""
+from __future__ import annotations
+
+import numpy as _np
+import jax
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+from ..ndarray.sparse import RowSparseNDArray
+
+__all__ = ["EmbeddingPS"]
+
+
+class EmbeddingPS:
+    """Host-memory embedding table with row-wise pull/push/update."""
+
+    def __init__(self, num_rows, dim, optimizer=None, dtype="float32",
+                 init_scale=0.01, seed=0):
+        rng = _np.random.RandomState(seed)
+        self._table = rng.uniform(-init_scale, init_scale,
+                                  (num_rows, dim)).astype(dtype)
+        self._optimizer = optimizer
+        self._opt_state = {}
+        self.num_rows = num_rows
+        self.dim = dim
+
+    def row_sparse_pull(self, row_ids):
+        """Pull the rows for this batch onto device as a dense slab +
+        local-index mapping (reference: kvstore.row_sparse_pull)."""
+        ids = _np.asarray(row_ids.asnumpy() if isinstance(row_ids, NDArray)
+                          else row_ids).astype(_np.int64).ravel()
+        unique, inverse = _np.unique(ids, return_inverse=True)
+        slab = self._table[unique]
+        return (array(slab), array(unique.astype("int64"), dtype="int64"),
+                array(inverse.reshape(_np.asarray(
+                    row_ids.asnumpy() if isinstance(row_ids, NDArray)
+                    else row_ids).shape).astype("int32"), dtype="int32"))
+
+    def push(self, unique_rows, row_grads, lr=0.01):
+        """Apply row gradients to the host table (server-side optimizer:
+        plain SGD or the attached Optimizer per row-block)."""
+        rows = _np.asarray(unique_rows.asnumpy()
+                           if isinstance(unique_rows, NDArray)
+                           else unique_rows).astype(_np.int64)
+        grads = _np.asarray(row_grads.asnumpy()
+                            if isinstance(row_grads, NDArray) else row_grads)
+        if self._optimizer is None:
+            self._table[rows] -= lr * grads
+            return
+        # adagrad-style server state per row
+        state = self._opt_state.setdefault(
+            "h", _np.zeros(self._table.shape[0], self._table.dtype))
+        h = state[rows] + _np.mean(grads * grads, axis=1)
+        state[rows] = h
+        self._table[rows] -= (lr / _np.sqrt(h + 1e-7))[:, None] * grads
+
+    def as_ndarray(self):
+        return array(self._table)

@@ -1,0 +1,119 @@
+"""Ring attention: sequence/context parallelism over the mesh 'sp' axis.
+
+NEW capability vs the reference (SURVEY.md §5.7: absent upstream; required
+for the long-context/Llama stretch). Design:
+
+  - the sequence axis of Q/K/V is sharded over 'sp'
+  - inside shard_map, each device holds its Q block and rotates K/V blocks
+    around the ring with lax.ppermute (ICI neighbour exchanges), accumulating
+    attention with the numerically-stable running-max/denominator update
+    (flash-attention style), so no device ever materializes the full
+    (T x T) score matrix
+  - causal masking is applied per (q_block, kv_block) pair from ring offsets
+
+This composes with tp ('tp' on heads) and dp in one mesh.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["ring_attention", "sequence_parallel_attention"]
+
+
+def _block_attn(q, k, v, bias, scale):
+    """Standard attention for one (q_block, kv_block) pair, returning
+    (unnormalized out, row max, row denom) for streaming combination."""
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return o, m, l
+
+
+def _combine(o1, m1, l1, o2, m2, l2):
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    return o1 * a1 + o2 * a2, m, l1 * a1 + l2 * a2
+
+
+def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
+    """q/k/v: (B, H, T, D) jax.Arrays with T sharded over `axis_name`.
+
+    Returns attention output with the same sharding. Collective cost per
+    ring step: one neighbour ppermute of the local K/V block — bandwidth
+    optimal on an ICI ring (PAPERS.md: 'Exploring the limits of Concurrency
+    in ML Training on Google TPUs' motivates overlapping these sends with
+    the block compute; XLA pipelines the ppermute against einsum here).
+    """
+    if scale is None:
+        scale = 1.0 / (q.shape[-1] ** 0.5)
+    n = mesh.shape[axis_name]
+
+    def local_fn(q_blk, k_blk, v_blk):
+        idx = lax.axis_index(axis_name)
+        t_q = q_blk.shape[2]
+
+        def make_bias(kv_rank):
+            if not causal:
+                return None
+            # global positions: q rows at idx*t_q, kv cols at kv_rank*t_k
+            t_k = k_blk.shape[2]
+            q_pos = idx * t_q + jnp.arange(t_q)
+            k_pos = kv_rank * t_k + jnp.arange(t_k)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            return jnp.where(mask, 0.0, -1e30)[None, None]
+
+        o, m, l = _block_attn(q_blk, k_blk, v_blk, make_bias(idx), scale)
+
+        def body(i, carry):
+            o, m, l, k_cur, v_cur = carry
+            perm = [(j, (j + 1) % n) for j in range(n)]
+            k_cur = lax.ppermute(k_cur, axis_name, perm)
+            v_cur = lax.ppermute(v_cur, axis_name, perm)
+            kv_rank = (idx - i - 1) % n
+            bias = None
+            if causal:
+                t_k = k_cur.shape[2]
+                q_pos = idx * t_q + jnp.arange(t_q)
+                k_pos = kv_rank * t_k + jnp.arange(t_k)
+                mask = q_pos[:, None] >= k_pos[None, :]
+                bias = jnp.where(mask, 0.0, -1e30)[None, None]
+            o2, m2, l2 = _block_attn(q_blk, k_cur, v_cur, bias, scale)
+            o, m, l = _combine(o, m, l, o2, m2, l2)
+            return (o, m, l, k_cur, v_cur)
+
+        o, m, l, _, _ = lax.fori_loop(0, n - 1, body, (o, m, l, k_blk, v_blk))
+        return o / jnp.maximum(l, 1e-30)
+
+    spec = P(None, None, axis_name, None)
+    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                     out_specs=spec, check_rep=False)(q, k, v)
+
+
+def sequence_parallel_attention(q, k, v, mesh=None, axis_name="sp",
+                                causal=True, scale=None):
+    """NDArray-level wrapper: gluon attention layers call this when a mesh
+    with an 'sp' axis is ambient (exposed as
+    gluon.contrib.nn.SelfAttention(context_parallel=True))."""
+    from ..ndarray.ndarray import NDArray, apply_nary
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        raise MXNetError("sequence_parallel_attention needs an ambient mesh "
+                         f"with a '{axis_name}' axis")
+
+    def fn(qa, ka, va):
+        return ring_attention(qa, ka, va, mesh, axis_name, causal, scale)
+    return apply_nary(fn, [q, k, v], name="ring_attention")

@@ -1,0 +1,76 @@
+"""Tensor parallelism: megatron-style sharding annotations for Gluon layers.
+
+NEW capability vs the reference (SURVEY.md §2.5 TP row: "absent — jit +
+NamedSharding on weight matrices"). A Parameter carries a PartitionSpec
+(`param.shard(P('tp', None))`); DataParallelTrainer honors it, and XLA
+partitions the matmuls over the 'tp' axis with all-gather/reduce-scatter
+inserted from the sharding algebra (the scaling-book recipe: annotate, let
+XLA place collectives on ICI).
+
+Column-parallel then row-parallel Dense pairs avoid any resharding between
+them (activations stay 'tp'-sharded on the hidden axis).
+"""
+from __future__ import annotations
+
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["tp_spec_for_param", "shard_params_tp", "ParallelDense",
+           "ParallelEmbedding"]
+
+
+def tp_spec_for_param(name, shape, kind="auto"):
+    """Heuristic megatron specs: weights (out, in):
+    column-parallel -> P('tp', None); row-parallel -> P(None, 'tp');
+    embeddings (vocab, hidden) -> P(None, 'tp'); 1-D params replicated."""
+    if len(shape) < 2:
+        return P()
+    if kind == "column":
+        return P("tp", None)
+    if kind == "row":
+        return P(None, "tp")
+    if "embed" in name:
+        return P(None, "tp")
+    return P("tp", None)
+
+
+def shard_params_tp(block, rules=None):
+    """Annotate all params of a block with TP specs.
+
+    ``rules``: list of (substring, PartitionSpec); first match wins; default
+    heuristic otherwise. Returns the block for chaining."""
+    for name, p in block.collect_params().items():
+        spec = None
+        for pat, s in (rules or []):
+            if pat in name:
+                spec = s
+                break
+        if spec is None:
+            spec = tp_spec_for_param(name, p.shape or ())
+        p.shard(spec)
+    return block
+
+
+class ParallelDense(nn.Dense):
+    """Dense with an explicit TP flavor ('column' shards output features,
+    'row' shards input features)."""
+
+    def __init__(self, units, parallel_mode="column", **kwargs):
+        super().__init__(units, **kwargs)
+        if parallel_mode not in ("column", "row"):
+            raise MXNetError("parallel_mode must be 'column' or 'row'")
+        self.weight.shard(P("tp", None) if parallel_mode == "column"
+                          else P(None, "tp"))
+        if self.bias is not None:
+            self.bias.shard(P("tp") if parallel_mode == "column" else P())
+
+
+class ParallelEmbedding(nn.Embedding):
+    """Embedding sharded over the hidden axis."""
+
+    def __init__(self, input_dim, output_dim, **kwargs):
+        super().__init__(input_dim, output_dim, **kwargs)
+        self.weight.shard(P(None, "tp"))

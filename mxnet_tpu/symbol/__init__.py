@@ -1,0 +1,19 @@
+"""``mx.sym`` — Symbol facade.
+
+Reference: python/mxnet/symbol/ (~5k LoC over the nnvm graph). Disposition
+per SURVEY.md §2.1 "Symbol/nnvm graph": the symbolic IR is absorbed by
+jaxpr/StableHLO; this module keeps a thin, *executable* Symbol facade so
+Module-API scripts and `sym.json` tooling keep working:
+
+  - ``mx.sym.var`` / every nd op mirrored lazily: builds a small expression
+    graph of (op, args, kwargs)
+  - ``Symbol.bind / simple_bind`` -> an Executor that evaluates the graph
+    with mx.nd ops
+  - ``tojson`` / ``load_json`` round-trip the expression graph
+"""
+from . import symbol as _symbol_mod
+from .symbol import Symbol, var, Variable, Group, load, load_json
+
+
+def __getattr__(name):
+    return getattr(_symbol_mod, name)

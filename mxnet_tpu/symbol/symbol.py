@@ -1,0 +1,324 @@
+"""Symbol: a lazy expression graph evaluated by mx.nd ops.
+
+Reference: python/mxnet/symbol/symbol.py. See package docstring for the
+disposition; notably `simple_bind` shape inference runs the graph with
+jax.eval_shape (XLA abstract interpretation replaces the nnvm InferShape
+pass, reference src/executor/infer_graph_attr_pass.cc).
+"""
+from __future__ import annotations
+
+import json
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+from .. import ndarray as _nd
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+
+class Symbol:
+    """A node in the lazy expression graph."""
+
+    def __init__(self, op, args, kwargs, name=None, outputs=None):
+        self._op = op                  # str op name or None for var
+        self._args = args              # list of Symbol / constants
+        self._kwargs = kwargs
+        self._name = name or (op if op else "var")
+        self._outputs = outputs        # for Group / multi-output slicing
+        self._out_index = None
+
+    # -- construction ---------------------------------------------------
+    @staticmethod
+    def _var(name, **kwargs):
+        sym = Symbol(None, [], {}, name=name)
+        return sym
+
+    @property
+    def name(self):
+        return self._name
+
+    def list_arguments(self):
+        out = []
+        def walk(s):
+            if s._op is None and s._outputs is None:
+                if s._name not in out:
+                    out.append(s._name)
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    walk(a)
+            if s._outputs:
+                for o in s._outputs:
+                    walk(o)
+        walk(self)
+        return out
+
+    def list_outputs(self):
+        if self._outputs:
+            return [o._name + "_output" for o in self._outputs]
+        return [self._name + "_output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    # -- composition ----------------------------------------------------
+    def __call__(self, **kwargs):
+        return self
+
+    def __getitem__(self, idx):
+        if self._outputs:
+            return self._outputs[idx]
+        out = Symbol(self._op, self._args, dict(self._kwargs),
+                     name=f"{self._name}[{idx}]")
+        out._out_index = idx
+        return out
+
+    def attr(self, key):
+        return None
+
+    def get_internals(self):
+        return Group(_collect_nodes(self))
+
+    # -- arithmetic -----------------------------------------------------
+    def _bin(self, other, opname):
+        return Symbol(opname, [self, other], {})
+
+    def __add__(self, other):
+        return self._bin(other, "_plus")
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return self._bin(other, "_minus")
+
+    def __rsub__(self, other):
+        return Symbol("_rminus", [self, other], {})
+
+    def __mul__(self, other):
+        return self._bin(other, "_mul")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return self._bin(other, "_div")
+
+    def __rtruediv__(self, other):
+        return Symbol("_rdiv", [self, other], {})
+
+    def __pow__(self, other):
+        return self._bin(other, "_pow")
+
+    def __neg__(self):
+        return Symbol("negative", [self], {})
+
+    # -- evaluation -----------------------------------------------------
+    def _eval(self, bindings, cache=None):
+        cache = {} if cache is None else cache
+        key = id(self)
+        if key in cache:
+            return cache[key]
+        if self._op is None and self._outputs is None:
+            if self._name not in bindings:
+                raise MXNetError(f"unbound symbol variable '{self._name}'")
+            out = bindings[self._name]
+        elif self._outputs is not None:
+            out = [o._eval(bindings, cache) for o in self._outputs]
+        else:
+            args = [a._eval(bindings, cache) if isinstance(a, Symbol) else a
+                    for a in self._args]
+            out = _apply_nd_op(self._op, args, self._kwargs)
+            if self._out_index is not None:
+                out = out[self._out_index]
+        cache[key] = out
+        return out
+
+    def eval(self, ctx=None, **kwargs):
+        out = self._eval(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # -- binding --------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", **shapes):
+        from ..module.executor import Executor
+        return Executor(self, ctx, shapes, grad_req=grad_req)
+
+    def bind(self, ctx, args, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None):
+        from ..module.executor import Executor
+        return Executor(self, ctx, None, args=args, args_grad=args_grad,
+                        grad_req=grad_req)
+
+    def infer_shape(self, **shapes):
+        """Shape inference via jax.eval_shape over the graph."""
+        import jax
+        import jax.numpy as jnp
+        args = self.list_arguments()
+        unknown = [a for a in args if a not in shapes]
+
+        def run(*arrs):
+            bindings = {name: NDArray(arr)
+                        for name, arr in zip(known, arrs)}
+            out = self._eval(bindings)
+            outs = out if isinstance(out, list) else [out]
+            return tuple(o.data for o in outs)
+
+        known = [a for a in args if a in shapes]
+        if unknown:
+            return None, None, None
+        protos = [jax.ShapeDtypeStruct(tuple(shapes[a]), jnp.float32)
+                  for a in known]
+        from .. import _tape
+        with _tape.trace_scope():
+            out_shapes = jax.eval_shape(run, *protos)
+        return ([tuple(shapes[a]) for a in args],
+                [tuple(o.shape) for o in out_shapes], [])
+
+    def infer_type(self, **dtypes):
+        args = self.list_arguments()
+        return ([_np.float32] * len(args), [_np.float32], [])
+
+    # -- serialization --------------------------------------------------
+    def tojson(self):
+        nodes = []
+        index = {}
+
+        def emit(s):
+            if id(s) in index:
+                return index[id(s)]
+            arg_ids = []
+            for a in s._args:
+                if isinstance(a, Symbol):
+                    arg_ids.append(emit(a))
+                else:
+                    arg_ids.append(["const", a])
+            node = {"op": s._op or "null", "name": s._name,
+                    "attrs": {k: str(v) for k, v in s._kwargs.items()},
+                    "inputs": arg_ids}
+            nodes.append(node)
+            index[id(s)] = len(nodes) - 1
+            return len(nodes) - 1
+
+        heads = self._outputs if self._outputs else [self]
+        head_ids = [emit(h) for h in heads]
+        return json.dumps({"format": "mxnet_tpu-symbol-v1", "nodes": nodes,
+                           "heads": head_ids}, indent=2)
+
+    def save(self, fname):
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __repr__(self):
+        return f"<Symbol {self._name}>"
+
+
+def _collect_nodes(sym):
+    seen = []
+    def walk(s):
+        for a in s._args:
+            if isinstance(a, Symbol):
+                walk(a)
+        seen.append(s)
+    walk(sym)
+    return seen
+
+
+def _apply_nd_op(opname, args, kwargs):
+    special = {
+        "_plus": lambda a, b: a + b, "_minus": lambda a, b: a - b,
+        "_rminus": lambda a, b: b - a, "_mul": lambda a, b: a * b,
+        "_div": lambda a, b: a / b, "_rdiv": lambda a, b: b / a,
+        "_pow": lambda a, b: a ** b,
+    }
+    if opname in special:
+        return special[opname](*args)
+    if opname in ("LinearRegressionOutput", "MAERegressionOutput",
+                  "LogisticRegressionOutput"):
+        data, label = args[0], args[1] if len(args) > 1 else None
+        if opname == "LogisticRegressionOutput":
+            return _nd.sigmoid(data)
+        return data
+    if not hasattr(_nd, opname):
+        raise MXNetError(f"symbol op '{opname}' has no nd implementation")
+    return getattr(_nd, opname)(*args, **kwargs)
+
+
+def var(name, shape=None, dtype=None, init=None, **kwargs):
+    return Symbol._var(name)
+
+
+Variable = var
+
+
+def Group(symbols):
+    return Symbol(None, [], {}, name="group", outputs=list(symbols))
+
+
+def load(fname):
+    with open(fname) as f:
+        return load_json(f.read())
+
+
+def load_json(json_str):
+    data = json.loads(json_str)
+    if data.get("format") != "mxnet_tpu-symbol-v1":
+        raise MXNetError(
+            "cannot load legacy nnvm symbol.json graphs: rebuild the network "
+            "with gluon/model_zoo and load the .params file instead "
+            "(SURVEY.md §2.1 Symbol row)")
+    nodes = data["nodes"]
+    built = []
+    for node in nodes:
+        if node["op"] == "null":
+            built.append(var(node["name"]))
+        else:
+            args = []
+            for ref in node["inputs"]:
+                if isinstance(ref, list) and ref and ref[0] == "const":
+                    args.append(ref[1])
+                else:
+                    args.append(built[ref])
+            kwargs = {k: _parse_attr(v) for k, v in
+                      node.get("attrs", {}).items()}
+            built.append(Symbol(node["op"], args, kwargs, name=node["name"]))
+    heads = [built[i] for i in data["heads"]]
+    return heads[0] if len(heads) == 1 else Group(heads)
+
+
+def _parse_attr(v):
+    try:
+        return json.loads(v.replace("(", "[").replace(")", "]")
+                          .replace("'", '"'))
+    except Exception:
+        if v in ("True", "False"):
+            return v == "True"
+        return v
+
+
+# ----------------------------------------------------------------------
+# op mirrors: every mx.nd op is constructible symbolically
+# ----------------------------------------------------------------------
+
+def _make_op(opname):
+    def op(*args, name=None, **kwargs):
+        return Symbol(opname, list(args), kwargs, name=name or opname)
+    op.__name__ = opname
+    return op
+
+
+def __getattr__(opname):
+    if opname.startswith("_"):
+        raise AttributeError(opname)
+    if hasattr(_nd, opname):
+        return _make_op(opname)
+    raise AttributeError(opname)
+
+
+# commonly used ops pre-bound for introspection/tab-completion
+for _name in ["FullyConnected", "Convolution", "Activation", "Pooling",
+              "SoftmaxOutput", "Flatten", "BatchNorm", "Dropout", "Concat",
+              "LeakyReLU", "Embedding", "Reshape", "transpose", "flip",
+              "mean", "softmax", "log_softmax", "broadcast_add",
+              "broadcast_mul", "zeros", "ones",
+              "LinearRegressionOutput", "LogisticRegressionOutput",
+              "MAERegressionOutput"]:
+    globals()[_name] = _make_op(_name)

@@ -1,0 +1,235 @@
+"""``mx.test_utils`` — the testing toolkit.
+
+Reference: python/mxnet/test_utils.py (SURVEY.md §4): assert_almost_equal
+with dtype-scaled tolerances, check_numeric_gradient (central finite
+differences), check_consistency (cross-backend), default_context
+(env-switchable), rand_ndarray, @retry / with_seed seeding discipline.
+"""
+from __future__ import annotations
+
+import functools
+import os
+import random as _pyrandom
+import time
+
+import numpy as _np
+import jax
+
+from .base import MXNetError
+from .context import Context, cpu, tpu, current_context, num_tpus
+from .ndarray.ndarray import NDArray, array
+from .ndarray import random as _rnd
+
+__all__ = ["default_context", "set_default_context", "assert_almost_equal",
+           "almost_equal", "same", "rand_ndarray", "rand_shape_2d",
+           "rand_shape_3d", "rand_shape_nd", "check_numeric_gradient",
+           "check_consistency", "retry", "with_seed", "default_dtype",
+           "effective_dtype", "assert_allclose"]
+
+_DEFAULT_CTX = None
+
+
+def default_context():
+    """Env-switchable default (MXTPU_TEST_CTX=cpu|tpu), reference
+    test_utils.default_context with MXNET_TEST_DEVICE."""
+    global _DEFAULT_CTX
+    if _DEFAULT_CTX is not None:
+        return _DEFAULT_CTX
+    env = os.environ.get("MXTPU_TEST_CTX", os.environ.get("MXNET_TEST_DEVICE"))
+    if env:
+        return Context(env.split("(")[0], 0)
+    return current_context()
+
+
+def set_default_context(ctx):
+    global _DEFAULT_CTX
+    _DEFAULT_CTX = ctx
+
+
+def default_dtype():
+    return _np.float32
+
+
+def effective_dtype(arr):
+    dt = arr.data.dtype if isinstance(arr, NDArray) else _np.asarray(arr).dtype
+    return str(dt)
+
+
+def _tols(dtype_a, dtype_b, rtol, atol):
+    default = {"float16": (1e-2, 1e-4), "bfloat16": (4e-2, 1e-3),
+               "float32": (1e-4, 1e-6), "float64": (1e-7, 1e-9)}
+    loosest = (1e-7, 1e-9)
+    for d in (str(dtype_a), str(dtype_b)):
+        r, a = default.get(d, (1e-4, 1e-6))
+        loosest = (max(loosest[0], r), max(loosest[1], a))
+    return (rtol if rtol is not None else loosest[0],
+            atol if atol is not None else loosest[1])
+
+
+def _to_np(x):
+    if isinstance(x, NDArray):
+        return x.asnumpy()
+    return _np.asarray(jax.device_get(x)) if hasattr(x, "devices") else \
+        _np.asarray(x)
+
+
+def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
+                        equal_nan=False):
+    """Reference: test_utils.assert_almost_equal (dtype-scaled tols)."""
+    da = a.data.dtype if isinstance(a, NDArray) else _np.asarray(a).dtype
+    db = b.data.dtype if isinstance(b, NDArray) else _np.asarray(b).dtype
+    rtol, atol = _tols(da, db, rtol, atol)
+    na, nb = _to_np(a).astype(_np.float64), _to_np(b).astype(_np.float64)
+    if na.shape != nb.shape:
+        raise AssertionError(
+            f"shape mismatch: {names[0]}{na.shape} vs {names[1]}{nb.shape}")
+    if not _np.allclose(na, nb, rtol=rtol, atol=atol, equal_nan=equal_nan):
+        diff = _np.abs(na - nb)
+        rel = diff / (_np.abs(nb) + atol)
+        idx = _np.unravel_index(_np.argmax(rel), rel.shape)
+        raise AssertionError(
+            f"Values differ (rtol={rtol}, atol={atol}): max abs diff "
+            f"{diff.max():g}, max rel diff {rel.max():g} at {idx}: "
+            f"{names[0]}={na[idx]!r} {names[1]}={nb[idx]!r}")
+
+
+assert_allclose = assert_almost_equal
+
+
+def almost_equal(a, b, rtol=None, atol=None, equal_nan=False):
+    try:
+        assert_almost_equal(a, b, rtol, atol, equal_nan=equal_nan)
+        return True
+    except AssertionError:
+        return False
+
+
+def same(a, b):
+    return _np.array_equal(_to_np(a), _to_np(b))
+
+
+def rand_ndarray(shape, stype="default", density=None, dtype="float32",
+                 ctx=None, scale=1.0):
+    if stype == "default":
+        data = _np.random.uniform(-scale, scale, shape).astype(dtype)
+        return array(data, ctx=ctx, dtype=dtype)
+    from .ndarray import sparse
+    data = _np.random.uniform(-scale, scale, shape).astype(dtype)
+    density = 0.3 if density is None else density
+    mask = _np.random.rand(*shape) < density
+    data = data * mask
+    if stype == "row_sparse":
+        return sparse.row_sparse_array(data, shape=shape, ctx=ctx, dtype=dtype)
+    if stype == "csr":
+        return sparse.csr_matrix(data, shape=shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"bad stype {stype}")
+
+
+def rand_shape_2d(dim0=10, dim1=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1))
+
+
+def rand_shape_3d(dim0=10, dim1=10, dim2=10):
+    return (_np.random.randint(1, dim0 + 1), _np.random.randint(1, dim1 + 1),
+            _np.random.randint(1, dim2 + 1))
+
+
+def rand_shape_nd(num_dim, dim=10):
+    return tuple(_np.random.randint(1, dim + 1, size=num_dim))
+
+
+def check_numeric_gradient(fn, inputs, eps=1e-3, rtol=1e-2, atol=1e-4,
+                           grad_nodes=None):
+    """Compare autograd gradients against central finite differences.
+
+    ``fn(*inputs) -> scalar NDArray``; inputs are NDArrays to differentiate.
+    Reference: test_utils.check_numeric_gradient (the per-op correctness
+    workhorse, SURVEY.md §4 technique 1)."""
+    from . import autograd
+    for x in inputs:
+        x.attach_grad()
+    with autograd.record():
+        out = fn(*inputs)
+    out.backward()
+    analytic = [x.grad.asnumpy().copy() for x in inputs]
+
+    for i, x in enumerate(inputs):
+        base = x.asnumpy().astype(_np.float64)
+        numeric = _np.zeros_like(base)
+        flat = base.reshape(-1)
+        num_flat = numeric.reshape(-1)
+        for j in range(flat.size):
+            orig = flat[j]
+            flat[j] = orig + eps
+            fp = float(fn(*[array(base.astype(_np.float32))
+                            if k == i else inputs[k]
+                            for k in range(len(inputs))]).asnumpy().sum())
+            flat[j] = orig - eps
+            fm = float(fn(*[array(base.astype(_np.float32))
+                            if k == i else inputs[k]
+                            for k in range(len(inputs))]).asnumpy().sum())
+            flat[j] = orig
+            num_flat[j] = (fp - fm) / (2 * eps)
+        assert_almost_equal(analytic[i], numeric.astype(_np.float32),
+                            rtol=rtol, atol=atol,
+                            names=(f"autograd[{i}]", f"numeric[{i}]"))
+
+
+def check_consistency(fn, inputs, ctx_list=None, rtol=None, atol=None):
+    """Run fn on each context/dtype combination and cross-assert.
+    Reference: test_utils.check_consistency (cpu-vs-gpu; here cpu-vs-tpu
+    and fp32-vs-bf16, SURVEY.md §4 technique 2)."""
+    if ctx_list is None:
+        ctx_list = [cpu(0)] + ([tpu(0)] if num_tpus() else [])
+    results = []
+    for ctx in ctx_list:
+        moved = [x.as_in_context(ctx) for x in inputs]
+        results.append(fn(*moved))
+    for r in results[1:]:
+        assert_almost_equal(results[0], r, rtol=rtol, atol=atol)
+    return results
+
+
+def retry(n):
+    """Retry flaky (statistical) tests n times. Reference:
+    test_utils.retry."""
+    assert n > 0
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            for i in range(n):
+                try:
+                    return f(*args, **kwargs)
+                except AssertionError:
+                    if i == n - 1:
+                        raise
+                    _np.random.seed()
+        return wrapper
+    return decorate
+
+
+def with_seed(seed=None):
+    """Seed numpy/python/mx PRNGs per test and log the seed on failure.
+    Reference: tests/python/unittest/common.py with_seed (SURVEY.md §4
+    technique 4). Honors MXTPU_TEST_SEED for reproduction."""
+
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            env = os.environ.get("MXTPU_TEST_SEED",
+                                 os.environ.get("MXNET_TEST_SEED"))
+            this_seed = int(env) if env else \
+                (seed if seed is not None else
+                 _np.random.randint(0, 2 ** 31))
+            _np.random.seed(this_seed)
+            _pyrandom.seed(this_seed)
+            _rnd.seed(this_seed)
+            try:
+                return f(*args, **kwargs)
+            except Exception:
+                print(f"*** test failed with seed {this_seed}; reproduce "
+                      f"with MXTPU_TEST_SEED={this_seed} ***")
+                raise
+        return wrapper
+    return decorate

@@ -1,0 +1,64 @@
+"""``mx.util`` — misc utilities (reference: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import os
+
+__all__ = ["makedirs", "set_np", "reset_np", "is_np_array", "use_np",
+           "getenv", "setenv", "get_gpu_count", "get_gpu_memory"]
+
+_NP_ARRAY = False
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def set_np(shape=True, array=True):
+    """numpy-semantics switch. jax.numpy is already numpy-semantics, so this
+    only flips the flag consulted by is_np_array()."""
+    global _NP_ARRAY
+    _NP_ARRAY = array
+
+
+def reset_np():
+    global _NP_ARRAY
+    _NP_ARRAY = False
+
+
+def is_np_array():
+    return _NP_ARRAY
+
+
+def use_np(func):
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = _NP_ARRAY
+        set_np()
+        try:
+            return func(*args, **kwargs)
+        finally:
+            set_np(array=prev)
+    return wrapper
+
+
+def getenv(name):
+    return os.environ.get(name)
+
+
+def setenv(name, value):
+    os.environ[name] = value
+
+
+def get_gpu_count():
+    from .context import num_tpus
+    return num_tpus()
+
+
+def get_gpu_memory(dev_id=0):
+    import jax
+    try:
+        stats = jax.devices()[dev_id].memory_stats()
+        return stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0)
+    except Exception:
+        return 0, 0

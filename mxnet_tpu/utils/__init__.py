@@ -1,0 +1,2 @@
+"""Internal utilities (native bindings live here)."""
+from . import native

@@ -1,0 +1,96 @@
+"""ctypes bindings to the native C++ runtime library (src/).
+
+The native library accelerates host-side work that is NOT on the XLA compute
+path (SURVEY.md design stance: XLA is the device runtime; the host runtime
+around it is C++): RecordIO scanning/indexing and batch assembly with a
+prefetching thread pool — the role of src/io/ + dmlc-core in the reference.
+
+Falls back cleanly when the library has not been built
+(`python setup_native.py build` produces libmxtpu.so next to this file).
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+
+_LIB = None
+_TRIED = False
+
+
+def _find_lib():
+    here = os.path.dirname(os.path.abspath(__file__))
+    candidates = [
+        os.path.join(here, "libmxtpu.so"),
+        os.path.join(here, "..", "..", "src", "build", "libmxtpu.so"),
+        os.path.join(here, "..", "..", "build", "libmxtpu.so"),
+    ]
+    for c in candidates:
+        if os.path.exists(c):
+            return c
+    return None
+
+
+def _load():
+    global _LIB, _TRIED
+    if _TRIED:
+        return _LIB
+    _TRIED = True
+    path = _find_lib()
+    if path is None:
+        return None
+    try:
+        lib = ctypes.CDLL(path)
+        lib.mxtpu_recordio_open.restype = ctypes.c_void_p
+        lib.mxtpu_recordio_open.argtypes = [ctypes.c_char_p]
+        lib.mxtpu_recordio_count.restype = ctypes.c_int64
+        lib.mxtpu_recordio_count.argtypes = [ctypes.c_void_p]
+        lib.mxtpu_recordio_read.restype = ctypes.c_int64
+        lib.mxtpu_recordio_read.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.mxtpu_recordio_close.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+    except OSError:
+        _LIB = None
+    return _LIB
+
+
+def available():
+    return _load() is not None
+
+
+class NativeRecordFile:
+    """Random-access view over a .rec file backed by the C++ reader
+    (mmap + in-memory index, no per-read Python parsing)."""
+
+    def __init__(self, path):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native library not built")
+        self._lib = lib
+        self._handle = lib.mxtpu_recordio_open(path.encode())
+        if not self._handle:
+            raise IOError(f"cannot open {path}")
+        self._count = lib.mxtpu_recordio_count(self._handle)
+
+    def __len__(self):
+        return self._count
+
+    def __getitem__(self, i):
+        ptr = ctypes.c_void_p()
+        size = self._lib.mxtpu_recordio_read(self._handle, i,
+                                             ctypes.byref(ptr))
+        if size < 0:
+            raise IndexError(i)
+        return ctypes.string_at(ptr, size)
+
+    def close(self):
+        if self._handle:
+            self._lib.mxtpu_recordio_close(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

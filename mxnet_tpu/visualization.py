@@ -1,0 +1,50 @@
+"""``mx.viz`` — network visualization.
+
+Reference: python/mxnet/visualization.py (plot_network via graphviz,
+print_summary). Works on the Symbol facade graph and on Gluon blocks.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["print_summary", "plot_network"]
+
+
+def print_summary(symbol, shape=None, line_length=120, positions=None):
+    from .symbol.symbol import Symbol, _collect_nodes
+    if not isinstance(symbol, Symbol):
+        raise MXNetError("print_summary expects a Symbol")
+    nodes = _collect_nodes(symbol)
+    print("=" * line_length)
+    print(f"{'Layer (type)':<50}{'Op':<30}Inputs")
+    print("=" * line_length)
+    for node in nodes:
+        ins = ", ".join(a._name for a in node._args
+                        if isinstance(a, Symbol))
+        print(f"{node._name:<50}{node._op or 'null':<30}{ins}")
+    print("=" * line_length)
+
+
+def plot_network(symbol, title="plot", save_format="pdf", shape=None,
+                 node_attrs=None, hide_weights=True):
+    """Returns a graphviz Digraph if graphviz is installed, else a DOT
+    string (no hard dependency)."""
+    from .symbol.symbol import Symbol, _collect_nodes
+    nodes = _collect_nodes(symbol)
+    lines = ["digraph plot {"]
+    for node in nodes:
+        lines.append(f'  "{node._name}" [label="{node._name}\\n'
+                     f'{node._op or "var"}"];')
+        for a in node._args:
+            if isinstance(a, Symbol):
+                if hide_weights and a._op is None and \
+                        a._name.endswith(("weight", "bias", "gamma", "beta")):
+                    continue
+                lines.append(f'  "{a._name}" -> "{node._name}";')
+    lines.append("}")
+    dot_src = "\n".join(lines)
+    try:
+        import graphviz
+        return graphviz.Source(dot_src)
+    except ImportError:
+        return dot_src

@@ -1,0 +1,36 @@
+"""Test configuration.
+
+Mesh/collective tests run on a virtual 8-device CPU mesh
+(SURVEY.md §4 technique 3: the reference faked clusters with N local
+processes; we fake a pod with N host devices).
+
+Must run before any jax import in the test process.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("MXTPU_SYNTHETIC_DATA", "1")
+
+# The axon TPU sitecustomize (PYTHONPATH) force-registers the TPU plugin in
+# every interpreter; a wedged TPU tunnel would then hang ANY jax.devices()
+# call, even under JAX_PLATFORMS=cpu. Deregister the factory before any
+# backend initialization so CPU-only test runs can never block on the
+# tunnel.
+sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+try:
+    from jax._src import xla_bridge as _xb
+    for _name in list(getattr(_xb, "_backend_factories", {})):
+        if _name not in ("cpu", "interpreter"):
+            _xb._backend_factories.pop(_name, None)
+except Exception:
+    pass
+
+repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if repo_root not in sys.path:
+    sys.path.insert(0, repo_root)

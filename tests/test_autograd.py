@@ -1,0 +1,208 @@
+"""Autograd tape tests.
+
+Modelled on reference tests/python/unittest/test_autograd.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+def test_basic_grad():
+    x = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_chain_and_fanout():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2
+        b = a + x        # x used twice -> contributions sum
+        c = (b * b).sum()
+    c.backward()
+    # c = (3x)^2 -> dc/dx = 18x
+    assert_almost_equal(x.grad, 18 * x.asnumpy())
+
+
+def test_grad_req_add_accumulates():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 3 * 2 * x.asnumpy())
+
+
+def test_grad_req_write_overwrites():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad(grad_req="write")
+    for _ in range(3):
+        with autograd.record():
+            y = (x * x).sum()
+        y.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+    y.backward(nd.array([1.0, 10.0, 100.0]))
+    assert_almost_equal(x.grad, np.array([2.0, 20.0, 200.0], np.float32))
+
+
+def test_detach_blocks_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y.detach() * x).sum()
+    z.backward()
+    # z = (2x).detach() * x -> dz/dx = 2x (detached factor constant)
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_block_grad_op():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.BlockGrad(x * 3) * x
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 3 * x.asnumpy())
+
+
+def test_pause_scope():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        with autograd.pause():
+            w = x * 10    # not recorded
+        z = (y + w).sum()
+    z.backward()
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+    with autograd.record(train_mode=False):
+        assert not autograd.is_training()
+    with autograd.pause():
+        assert not autograd.is_recording()
+
+
+def test_autograd_grad_function():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x ** 3).sum()
+    g = autograd.grad(y, x)
+    assert_almost_equal(g, 3 * x.asnumpy() ** 2)
+    # .grad untouched by autograd.grad
+    assert (x.grad.asnumpy() == 0).all()
+
+
+def test_mark_variables():
+    x = nd.array([1.0, 4.0])
+    autograd.mark_variables([x], grad_reqs="write")
+    with autograd.record():
+        y = nd.sqrt(x).sum()
+    y.backward()
+    assert_almost_equal(x.grad, 0.5 / np.sqrt(x.asnumpy()))
+
+
+def test_multi_output_op_grad():
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.split(x, num_outputs=2, axis=1)
+        loss = (a * 2 + b * 3).sum()
+    loss.backward()
+    expected = np.concatenate([np.full((2, 2), 2.0), np.full((2, 2), 3.0)], 1)
+    assert_almost_equal(x.grad, expected.astype(np.float32))
+
+
+def test_custom_function():
+    class MySigmoid(autograd.Function):
+        def forward(self, x):
+            y = nd.sigmoid(x)
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array([0.0, 1.0, -1.0])
+    x.attach_grad()
+    f = MySigmoid()
+    with autograd.record():
+        y = f(x)
+        z = y.sum()
+    z.backward()
+    s = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, s * (1 - s), rtol=1e-4)
+
+
+def test_inplace_mutation_on_tape_raises():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        with pytest.raises(mx.MXNetError):
+            y += 1
+
+
+def test_numeric_gradient_checker():
+    x = nd.array(np.random.rand(3, 2).astype(np.float32) + 0.5)
+    check_numeric_gradient(lambda a: (a * a + nd.exp(a)).sum(), [x],
+                           rtol=5e-2, atol=1e-2)
+
+
+def test_softmax_output_fused_grad():
+    data = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3])
+    data.attach_grad()
+    with autograd.record():
+        out = nd.SoftmaxOutput(data, label)
+    out.backward(nd.ones(out.shape))
+    p = np.exp(data.asnumpy())
+    p = p / p.sum(1, keepdims=True)
+    oh = np.eye(5, dtype=np.float32)[[0, 1, 2, 3]]
+    assert_almost_equal(data.grad, p - oh, rtol=1e-4)
+
+
+def test_retain_graph_no_double_accumulation():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = (x * x).sum()
+    y.backward(retain_graph=True)
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+    y.backward(retain_graph=True)
+    # grad_req='write': second pass overwrites with the SAME value (no
+    # stale-cotangent doubling)
+    assert_almost_equal(x.grad, 2 * x.asnumpy())
+
+
+def test_grad_of_intermediate_variable():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        z = (y * y).sum()
+    g = autograd.grad(z, y)
+    assert_almost_equal(g, 2 * y.asnumpy())

@@ -1,0 +1,372 @@
+"""Gluon Block/HybridBlock/Parameter/Trainer tests.
+
+Modelled on reference tests/python/unittest/test_gluon.py (SURVEY.md §4).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter_basic():
+    p = gluon.Parameter("weight", shape=(3, 4))
+    p.initialize(ctx=mx.cpu())
+    assert p.data().shape == (3, 4)
+    assert p.grad() is not None
+    p.set_data(nd.ones((3, 4)))
+    assert (p.data().asnumpy() == 1).all()
+    with pytest.raises(mx.MXNetError):
+        gluon.Parameter("w2", shape=(0, 3)).initialize()
+
+
+def test_parameter_deferred_init():
+    dense = nn.Dense(5)
+    dense.initialize()
+    with pytest.raises(gluon.parameter.DeferredInitializationError
+                       if hasattr(gluon, "parameter") else Exception):
+        dense.weight.data()
+    out = dense(nd.ones((2, 7)))
+    assert out.shape == (2, 5)
+    assert dense.weight.shape == (5, 7)
+
+
+def test_dense_forward_values():
+    dense = nn.Dense(3, use_bias=True, in_units=4)
+    dense.initialize()
+    dense.weight.set_data(nd.ones((3, 4)))
+    dense.bias.set_data(nd.array([1.0, 2.0, 3.0]))
+    out = dense(nd.ones((2, 4)))
+    assert_almost_equal(out, np.array([[5, 6, 7], [5, 6, 7]], np.float32))
+
+
+def test_dense_flatten_false():
+    dense = nn.Dense(6, flatten=False)
+    dense.initialize()
+    out = dense(nd.ones((2, 5, 4)))
+    assert out.shape == (2, 5, 6)
+
+
+def test_sequential_and_getitem():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8), nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    assert len(net) == 3
+    out = net(nd.ones((3, 10)))
+    assert out.shape == (3, 2)
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_hybridize_matches_eager():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    x = nd.array(np.random.rand(5, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    assert_almost_equal(eager, hybrid, rtol=1e-5)
+
+
+def test_hybridize_grads_match():
+    def build():
+        mx.random.seed(7)
+        net = nn.HybridSequential()
+        net.add(nn.Dense(16, activation="tanh"), nn.Dense(1))
+        net.initialize()
+        return net
+
+    x = nd.array(np.random.rand(4, 6).astype(np.float32))
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        grads.append([p.grad().asnumpy()
+                      for _, p in sorted(net.collect_params().items())
+                      if p.grad_req != "null"])
+    for g0, g1 in zip(*grads):
+        assert_almost_equal(g0, g1, rtol=1e-4)
+
+
+def test_conv2d_shapes():
+    conv = nn.Conv2D(16, kernel_size=3, strides=2, padding=1)
+    conv.initialize()
+    out = conv(nd.ones((2, 3, 32, 32)))
+    assert out.shape == (2, 16, 16, 16)
+    assert conv.weight.shape == (16, 3, 3, 3)
+
+
+def test_conv2d_groups():
+    conv = nn.Conv2D(8, kernel_size=3, groups=4, in_channels=8)
+    conv.initialize()
+    out = conv(nd.ones((1, 8, 10, 10)))
+    assert out.shape == (1, 8, 8, 8)
+    assert conv.weight.shape == (8, 2, 3, 3)
+
+
+def test_conv_transpose():
+    deconv = nn.Conv2DTranspose(4, kernel_size=2, strides=2)
+    deconv.initialize()
+    out = deconv(nd.ones((1, 3, 8, 8)))
+    assert out.shape == (1, 4, 16, 16)
+
+
+def test_pooling_variants():
+    x = nd.array(np.random.rand(1, 2, 9, 9).astype(np.float32))
+    assert nn.MaxPool2D(2)(x).shape == (1, 2, 4, 4)
+    assert nn.MaxPool2D(2, ceil_mode=True)(x).shape == (1, 2, 5, 5)
+    assert nn.AvgPool2D(3, strides=2)(x).shape == (1, 2, 4, 4)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+    assert nn.GlobalMaxPool2D()(x).shape == (1, 2, 1, 1)
+    expected = x.asnumpy().max(axis=(2, 3), keepdims=True)
+    assert_almost_equal(nn.GlobalMaxPool2D()(x), expected)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm(in_channels=4)
+    bn.initialize()
+    x = nd.array(np.random.rand(8, 4, 5, 5).astype(np.float32) * 10)
+    with autograd.record():
+        out_train = bn(x)
+    m = out_train.asnumpy().mean(axis=(0, 2, 3))
+    v = out_train.asnumpy().var(axis=(0, 2, 3))
+    assert np.allclose(m, 0, atol=1e-3)
+    assert np.allclose(v, 1, atol=1e-2)
+    rm = bn.running_mean.data().asnumpy()
+    assert not np.allclose(rm, 0)  # running stats updated
+    out_eval = bn(x)  # eval mode uses running stats
+    assert not np.allclose(out_eval.asnumpy(), out_train.asnumpy())
+
+
+def test_layernorm_embedding_dropout():
+    ln = nn.LayerNorm(in_channels=6)
+    ln.initialize()
+    x = nd.array(np.random.rand(3, 6).astype(np.float32))
+    out = ln(x)
+    assert np.allclose(out.asnumpy().mean(-1), 0, atol=1e-5)
+
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    e = emb(nd.array([1, 5, 9]))
+    assert e.shape == (3, 4)
+
+    do = nn.Dropout(0.5)
+    x2 = nd.ones((100, 100))
+    out_eval = do(x2)
+    assert_almost_equal(out_eval, x2)  # identity outside training
+    with autograd.record():
+        out_train = do(x2)
+    frac_zero = float((out_train.asnumpy() == 0).mean())
+    assert 0.4 < frac_zero < 0.6
+
+
+def test_activation_layers():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    assert_almost_equal(nn.Activation("relu")(x),
+                        np.maximum(x.asnumpy(), 0))
+    assert_almost_equal(nn.LeakyReLU(0.1)(x),
+                        np.where(x.asnumpy() > 0, x.asnumpy(),
+                                 0.1 * x.asnumpy()), rtol=1e-4)
+    prelu = nn.PReLU()
+    prelu.initialize()
+    out = prelu(x)
+    assert out.shape == x.shape
+
+
+def test_trainer_sgd_step():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    net.weight.set_data(nd.array([[1.0, 1.0]]))
+    net.bias.set_data(nd.array([0.0]))
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    x = nd.array([[1.0, 2.0]])
+    with autograd.record():
+        y = net(x).sum()
+    y.backward()
+    trainer.step(1)
+    # dw = x -> w_new = w - 0.1 * x
+    assert_almost_equal(net.weight.data(), np.array([[0.9, 0.8]], np.float32))
+
+
+def test_trainer_stale_grad_raises():
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd", {})
+    with pytest.raises(mx.MXNetError):
+        trainer.step(1)
+    trainer.step(1, ignore_stale_grad=True)
+
+
+def test_save_load_parameters(tmp_path):
+    fname = str(tmp_path / "net.params")
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    ref = net(x).asnumpy()
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_collect_params_select():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    net(nd.ones((1, 3)))
+    all_params = net.collect_params()
+    weights = net.collect_params(".*weight")
+    assert len(weights) == 2
+    assert len(all_params) == 4
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5).astype(np.float32))
+    label = nd.array([0, 1, 2, 3])
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    logp = pred.asnumpy() - np.log(
+        np.exp(pred.asnumpy()).sum(1, keepdims=True))
+    expected = -logp[np.arange(4), [0, 1, 2, 3]]
+    assert_almost_equal(l, expected, rtol=1e-4)
+
+    p2 = nd.array([[1.0, 2.0]])
+    t2 = nd.array([[0.5, 1.0]])
+    l2 = gluon.loss.L2Loss()(p2, t2)
+    assert_almost_equal(l2, np.array([(0.25 + 1.0) / 2 / 2], np.float32))
+    l1 = gluon.loss.L1Loss()(p2, t2)
+    assert_almost_equal(l1, np.array([0.75], np.float32))
+    bce = gluon.loss.SigmoidBCELoss()(p2, nd.array([[1.0, 0.0]]))
+    assert bce.shape == (1,)
+    hl = gluon.loss.HuberLoss()(p2, t2)
+    assert hl.shape == (1,)
+
+
+def test_split_and_load():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(6, 2))
+    slices = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert len(slices) == 1 and slices[0].shape == (6, 2)
+    parts = gluon.utils.split_data(data, 3)
+    assert [p.shape for p in parts] == [(2, 2)] * 3
+    with pytest.raises(mx.MXNetError):
+        gluon.utils.split_data(data, 4)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((3,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    new_norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    assert abs(new_norm - 1.0) < 1e-4
+
+
+def test_block_repr_and_cast():
+    net = nn.Dense(4, in_units=3)
+    net.initialize()
+    net.cast("float16")
+    assert net.weight.data().data.dtype == np.float16
+    net.cast("float32")
+    out = net(nd.ones((1, 3)))
+    assert out.data.dtype == np.float32
+
+
+def test_lambda_blocks():
+    lam = nn.Lambda("relu")
+    assert_almost_equal(lam(nd.array([-1.0, 1.0])), [0.0, 1.0])
+    hlam = nn.HybridLambda(lambda F, x: x * 2)
+    assert_almost_equal(hlam(nd.array([1.0, 2.0])), [2.0, 4.0])
+
+
+def test_embedding_grad_is_scatter():
+    emb = nn.Embedding(5, 3)
+    emb.initialize()
+    idx = nd.array([1, 1, 4])
+    with autograd.record():
+        out = emb(idx).sum()
+    out.backward()
+    g = emb.weight.grad().asnumpy()
+    assert np.allclose(g[1], 2.0)
+    assert np.allclose(g[4], 1.0)
+    assert np.allclose(g[0], 0.0)
+
+
+def test_clip_global_norm_on_param_grads():
+    net = nn.Dense(2, in_units=3)
+    net.initialize()
+    with autograd.record():
+        out = (net(nd.ones((4, 3)) * 100) ** 2).sum()
+    out.backward()
+    grads = [p.grad() for p in net.collect_params().values()]
+    gluon.utils.clip_global_norm(grads, 0.5)
+    total = np.sqrt(sum((p.grad().asnumpy() ** 2).sum()
+                        for p in net.collect_params().values()))
+    assert abs(total - 0.5) < 1e-3  # clip reached the stored grads
+
+
+def test_batchnorm_eager_grad_matches_hybrid():
+    def build():
+        mx.random.seed(3)
+        net = nn.HybridSequential()
+        net.add(nn.Conv2D(4, 3, in_channels=2), nn.BatchNorm(in_channels=4),
+                nn.Activation("relu"), nn.Flatten(), nn.Dense(2))
+        net.initialize()
+        return net
+
+    x = nd.array(np.random.RandomState(0).rand(4, 2, 8, 8).astype("float32"))
+    grads = []
+    for hybrid in (False, True):
+        net = build()
+        if hybrid:
+            net.hybridize()
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        grads.append([p.grad().asnumpy()
+                      for _, p in sorted(net.collect_params().items())
+                      if p.grad_req != "null"])
+    for g0, g1 in zip(*grads):
+        assert_almost_equal(g0, g1, rtol=1e-3, atol=1e-4)
+
+
+def test_trainer_state_counters_survive_save_load(tmp_path):
+    fname = str(tmp_path / "trainer.states")
+    net = nn.Dense(1, in_units=2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.ones((2, 2))
+    for _ in range(5):
+        with autograd.record():
+            y = net(x).sum()
+        y.backward()
+        trainer.step(2)
+    assert trainer._optimizer.num_update == 5
+    trainer.save_states(fname)
+
+    net2 = nn.Dense(1, in_units=2)
+    net2.initialize()
+    trainer2 = gluon.Trainer(net2.collect_params(), "adam",
+                             {"learning_rate": 0.01})
+    trainer2.load_states(fname)
+    assert trainer2._optimizer.num_update == 5
+    assert trainer2._optimizer._index_update_count[0] == 5
+
+
+def test_pooling_int_dtype_and_sequence_last_axis1():
+    xi = mx.nd.array(np.arange(16, dtype=np.int32).reshape(1, 1, 4, 4))
+    out = mx.nd.Pooling(xi, kernel=(2, 2), stride=(2, 2), pool_type="sum")
+    assert out.asnumpy()[0, 0, 0, 0] == 0 + 1 + 4 + 5
+    data = mx.nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))  # B,T
+    sl = mx.nd.array([1, 2, 4])
+    last = mx.nd.SequenceLast(data, sequence_length=sl,
+                              use_sequence_length=True, axis=1)
+    assert_almost_equal(last, np.array([0.0, 5.0, 11.0], np.float32))
