@@ -1,0 +1,71 @@
+"""Headline benchmark: ResNet-50 fused training step, images/sec.
+
+Mirrors the reference's headline number (BASELINE.md: ResNet-50 v1 training
+throughput, ~380 img/s/GPU fp32 on V100 from docs/faq/perf.md). Here the
+whole record->forward->backward->update loop is ONE jitted XLA program
+(SURVEY.md §3.2 TPU mapping) on whatever accelerator jax exposes.
+
+Prints ONE JSON line:
+  {"metric": "resnet50_train_images_per_sec", "value": N, "unit": "img/s",
+   "vs_baseline": N/380}
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+BASELINE_IMG_S = 380.0  # ResNet-50 v1 fp32 per-V100 (BASELINE.md)
+
+
+def main():
+    batch = int(os.environ.get("MXTPU_BENCH_BATCH", "128"))
+    iters = int(os.environ.get("MXTPU_BENCH_ITERS", "20"))
+    warmup = int(os.environ.get("MXTPU_BENCH_WARMUP", "3"))
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    platform = jax.devices()[0].platform
+    if platform == "cpu":
+        # CPU smoke config so the bench is runnable anywhere
+        batch = min(batch, 16)
+        iters = min(iters, 5)
+
+    net = resnet50_v1()
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = DataParallelTrainer(net, loss_fn, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9},
+                                  mesh=mesh)
+
+    data = mx.nd.random.uniform(shape=(batch, 3, 224, 224))
+    label = mx.nd.zeros((batch,))
+
+    for _ in range(max(warmup, 1)):
+        loss = trainer.step(data, label)
+    loss.asnumpy()
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data, label)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+
+    img_s = batch * iters / dt
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec",
+        "value": round(img_s, 2),
+        "unit": "img/s",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

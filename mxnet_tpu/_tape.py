@@ -31,7 +31,6 @@ class _TapeState(threading.local):
     def __init__(self):
         self.recording = False
         self.training = False
-        self.nodes = []          # list[Node] in creation order
         self.counter = 0
         # inside a jit trace we must not record (pure replay), see CachedOp
         self.trace_depth = 0
@@ -76,7 +75,7 @@ class Node:
     """One recorded op: inputs, pullback, and per-output cotangent slots."""
 
     __slots__ = ("inputs", "vjp_fn", "n_out", "out_grads", "out_protos",
-                 "order", "name")
+                 "order", "name", "__weakref__")
 
     def __init__(self, inputs, vjp_fn, outs, order, name=""):
         self.inputs = inputs            # list[NDArray]
@@ -107,7 +106,6 @@ def apply_op(fn, inputs, n_out=1, name=""):
             outs = (outs,)
         _STATE.counter += 1
         node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name)
-        _STATE.nodes.append(node)
         return outs, node
     outs = fn(*datas)
     if n_out == 1:
@@ -175,8 +173,25 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             _apply_grad_req(arr, g)
         return
 
-    # Walk all recorded nodes newest->oldest; skip nodes with no cotangent.
-    for node in reversed(_STATE.nodes):
+    # Collect the subgraph reachable from the heads (the tape holds no
+    # global node list: the graph lives in NDArray._node / Node.inputs
+    # references, so dropped graphs are garbage-collected and backward on
+    # one graph can never disturb another recorded in the same scope).
+    reachable = {}
+    stack = [h._node for h in heads
+             if h._node is not None and h._node.vjp_fn is not None]
+    while stack:
+        node = stack.pop()
+        if id(node) in reachable:
+            continue
+        reachable[id(node)] = node
+        for inp in node.inputs:
+            if inp._node is not None and inp._node.vjp_fn is not None:
+                stack.append(inp._node)
+
+    # Walk reachable nodes newest->oldest; skip nodes with no cotangent.
+    for node in sorted(reachable.values(), key=lambda n: n.order,
+                       reverse=True):
         if node.vjp_fn is None or all(g is None for g in node.out_grads):
             continue
         cotangents = tuple(
@@ -206,8 +221,6 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     for arr, g in leaf_grads.values():
         _apply_grad_req(arr, g)
-    if not retain_graph:
-        clear_tape()
 
 
 def _apply_grad_req(arr, g):
@@ -218,8 +231,3 @@ def _apply_grad_req(arr, g):
     else:
         arr._grad = g
     arr._grad_fresh = True
-
-
-def clear_tape():
-    _STATE.nodes = []
-    _STATE.counter = 0

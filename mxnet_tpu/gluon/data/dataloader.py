@@ -165,31 +165,53 @@ class DataLoader:
         yield from self._threaded_iter()
 
     def _threaded_iter(self):
-        out_q = _queue.Queue(maxsize=self._prefetch or 2)
         batches = list(self._batch_sampler)
+        stop = threading.Event()
+        # permits bound decoded-but-unconsumed batches (prefetch depth)
+        sem = threading.Semaphore(max(self._prefetch, self._num_workers, 1))
+        in_q = _queue.SimpleQueue()
+        for item in enumerate(batches):
+            in_q.put(item)
+        results = _queue.SimpleQueue()
 
-        def producer():
-            for samples in batches:
+        def worker():
+            while not stop.is_set():
+                if not sem.acquire(timeout=0.1):
+                    continue
                 try:
-                    out_q.put(self._batchify_fn(
-                        [self._dataset[i] for i in samples]))
+                    idx, samples = in_q.get_nowait()
+                except _queue.Empty:
+                    sem.release()
+                    return
+                try:
+                    results.put((idx, self._batchify_fn(
+                        [self._dataset[i] for i in samples])))
                 except Exception as e:  # propagate to consumer
-                    out_q.put(e)
-            out_q.put(None)
+                    results.put((idx, e))
 
-        threads = [threading.Thread(target=producer, daemon=True)]
-        # single producer preserves order; workers parallelize inside
-        # batchify via dataset __getitem__ being cheap. For heavier decode
-        # use the native recordio pipeline (src/).
+        threads = [threading.Thread(target=worker, daemon=True)
+                   for _ in range(self._num_workers)]
         for t in threads:
             t.start()
-        while True:
-            item = out_q.get(timeout=self._timeout)
-            if item is None:
-                break
-            if isinstance(item, Exception):
-                raise item
-            yield item
+        buffered = {}
+        try:
+            for want in range(len(batches)):
+                while want not in buffered:
+                    try:
+                        idx, item = results.get(timeout=self._timeout)
+                    except _queue.Empty:
+                        raise MXNetError(
+                            f"DataLoader worker timed out after "
+                            f"{self._timeout}s waiting for batch {want}")
+                    buffered[idx] = item
+                item = buffered.pop(want)
+                sem.release()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            # unblocks workers even if iteration is abandoned mid-epoch
+            stop.set()
 
     def __len__(self):
         return len(self._batch_sampler)

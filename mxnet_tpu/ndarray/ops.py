@@ -224,8 +224,19 @@ nansum = _reduce_factory("nansum", jnp.nansum)
 nanprod = _reduce_factory("nanprod", jnp.nanprod)
 max = _reduce_factory("max", jnp.max)
 min = _reduce_factory("min", jnp.min)
-norm = _reduce_factory("norm", lambda d, axis, keepdims: jnp.sqrt(
-    jnp.sum(jnp.square(d), axis=axis, keepdims=keepdims)))
+@_register
+def norm(data, ord=2, axis=None, keepdims=False, **kwargs):
+    """Reference: src/operator/tensor/broadcast_reduce_op_value.cc (norm);
+    supports ord=1 (sum of |x|) and ord=2 (L2)."""
+    ax = _ax(axis)
+    if ord == 1:
+        jfn = lambda d: jnp.sum(jnp.abs(d), axis=ax, keepdims=keepdims)
+    elif ord == 2:
+        jfn = lambda d: jnp.sqrt(
+            jnp.sum(jnp.square(d), axis=ax, keepdims=keepdims))
+    else:
+        raise MXNetError(f"norm only supports ord=1 or 2, got {ord}")
+    return apply_nary(jfn, [data], name="norm")
 sum_axis = sum
 max_axis = max
 min_axis = min
@@ -870,6 +881,8 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
             axes = tuple(range(2, d.ndim))
             if pool_type == "max":
                 return jnp.max(d, axis=axes, keepdims=True)
+            if pool_type == "sum":
+                return jnp.sum(d, axis=axes, keepdims=True)
             return jnp.mean(d, axis=axes, keepdims=True)
         k = tuple(kernel)
         s = tuple(stride) if stride else (1,) * nd

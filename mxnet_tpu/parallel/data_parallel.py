@@ -144,8 +144,7 @@ class DataParallelTrainer:
             return NamedSharding(self.mesh, p.shard_spec)
         return NamedSharding(self.mesh, P())
 
-    def _build(self, n_inputs):
-        mesh = self.mesh
+    def _build(self):
         block = self.block
         loss_fn = self.loss_fn
         rule_apply = self._rule_apply
@@ -195,8 +194,6 @@ class DataParallelTrainer:
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
         mesh = self.mesh
-        data_shard = NamedSharding(
-            mesh, P(*([None] * self.batch_axis + ["dp"])))
         inputs = [jax.device_put(b, NamedSharding(
             mesh, P(*([None] * self.batch_axis + (["dp"] if b.ndim else [])))))
             for b in inputs]
@@ -208,7 +205,7 @@ class DataParallelTrainer:
                     x, NamedSharding(mesh, P())), self._rule_init(v))
                 for v in param_vals]
         if self._jitted is None:
-            self._build(len(inputs))
+            self._build()
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
         new_params, self._opt_state, loss = self._jitted(
@@ -220,14 +217,20 @@ class DataParallelTrainer:
 
 
 def all_reduce_gradients(params, mesh=None, axis="dp"):
-    """Eager helper: average .grad across the mesh data axis for parameters
-    trained outside the fused step (reference: trainer._allreduce_grads)."""
+    """Eager helper: sum .grad across worker *processes* for parameters
+    trained outside the fused step (reference: trainer._allreduce_grads).
+
+    Within one process an eagerly computed gradient already covers the full
+    local batch, so there is nothing to reduce; across processes this is a
+    real all-reduce via multihost allgather+sum (the out-of-graph KVStore
+    path — SURVEY.md §7 "in-graph collectives vs push/pull API" perf cliff).
+    """
+    if jax.process_count() == 1:
+        return params
+    from jax.experimental import multihost_utils
     for p in params:
         if getattr(p, "_data", None) is not None and \
                 p._data._grad is not None:
-            g = p._data._grad
-            # values are replicated per-process in the eager path; the mean
-            # over dp shards is an identity on a single host unless the grad
-            # is itself sharded, in which case XLA reduces it.
-            p._data._grad = g
+            stacked = multihost_utils.process_allgather(p._data._grad)
+            p._data._grad = jnp.sum(stacked, axis=0)
     return params

@@ -31,6 +31,15 @@ try:
 except Exception:
     pass
 
+# The sitecustomize may have imported jax already, in which case jax's
+# config captured JAX_PLATFORMS=axon at interpreter start; override at the
+# config level too (env alone is read only once).
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
 repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if repo_root not in sys.path:
     sys.path.insert(0, repo_root)

@@ -206,3 +206,38 @@ def test_grad_of_intermediate_variable():
         z = (y * y).sum()
     g = autograd.grad(z, y)
     assert_almost_equal(g, 2 * y.asnumpy())
+
+
+def test_two_graphs_same_scope():
+    # regression: backward on one graph must not destroy another graph
+    # recorded in the same record scope (GAN D/G pattern)
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        l1 = x * x
+        l2 = y * y * y
+    l1.backward()
+    l2.backward()
+    assert float(x.grad.asnumpy()[0]) == 4.0
+    assert float(y.grad.asnumpy()[0]) == 27.0
+
+
+def test_record_without_backward_no_leak():
+    # regression: abandoning a recorded graph must not pin it globally —
+    # the graph is owned by its output arrays only
+    import gc
+    import weakref
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, _tape
+    x = mx.nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        out = x * 2
+    node_ref = weakref.ref(out._node)
+    del out
+    gc.collect()
+    assert node_ref() is None

@@ -370,3 +370,22 @@ def test_pooling_int_dtype_and_sequence_last_axis1():
     last = mx.nd.SequenceLast(data, sequence_length=sl,
                               use_sequence_length=True, axis=1)
     assert_almost_equal(last, np.array([0.0, 5.0, 11.0], np.float32))
+
+
+def test_dataloader_workers_and_early_stop():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    ds = gluon.data.ArrayDataset(
+        mx.nd.array(np.arange(64).reshape(32, 2)),
+        mx.nd.array(np.arange(32)))
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=3)
+    seen = []
+    for data, label in loader:
+        seen.append(label.asnumpy())
+    assert np.concatenate(seen).tolist() == list(range(32))
+    # abandoning mid-epoch must not deadlock or leak blocked threads
+    for _ in range(3):
+        it = iter(loader)
+        next(it)
+        del it

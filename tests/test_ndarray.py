@@ -266,3 +266,22 @@ def test_context_api():
         a = nd.zeros((2,))
         assert a.context.device_type == "cpu"
     assert str(mx.cpu(1)) == "cpu(1)"
+
+
+def test_norm_ord():
+    import numpy as np
+    import mxnet_tpu as mx
+    x = mx.nd.array([[3.0, -4.0]])
+    assert abs(float(mx.nd.norm(x, ord=1).asnumpy()) - 7.0) < 1e-6
+    assert abs(float(mx.nd.norm(x, ord=2).asnumpy()) - 5.0) < 1e-6
+    assert abs(float(mx.nd.norm(x).asnumpy()) - 5.0) < 1e-6
+
+
+def test_global_pool_sum():
+    import numpy as np
+    import mxnet_tpu as mx
+    x = mx.nd.ones((1, 1, 4, 4))
+    out = mx.nd.Pooling(x, pool_type="sum", global_pool=True)
+    assert abs(float(out.asnumpy().ravel()[0]) - 16.0) < 1e-6
+    out = mx.nd.Pooling(x, pool_type="avg", global_pool=True)
+    assert abs(float(out.asnumpy().ravel()[0]) - 1.0) < 1e-6
