@@ -1,3 +1,4 @@
 """``gluon.model_zoo`` (reference: python/mxnet/gluon/model_zoo/)."""
 from . import vision
+from . import nlp
 from .vision import get_model

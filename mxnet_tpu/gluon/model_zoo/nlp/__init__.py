@@ -1,0 +1,34 @@
+"""``gluon.model_zoo.nlp`` — NLP models (GluonNLP capability parity).
+
+Reference: the external GluonNLP package (dmlc/gluon-nlp) listed as a
+capability target in SURVEY.md §2.4: BERT (pretrain+finetune), Transformer
+MT with beam search, AWD-LSTM/standard LSTM language models, attention
+cells.
+"""
+from .attention import *  # noqa: F401,F403
+from .bert import *  # noqa: F401,F403
+from .transformer import *  # noqa: F401,F403
+from .language_model import *  # noqa: F401,F403
+from .sampler import *  # noqa: F401,F403
+
+from . import attention, bert, transformer, language_model, sampler  # noqa
+
+_MODELS = {}
+for _m in (bert, transformer, language_model):
+    for _name in _m.__all__:
+        _fn = getattr(_m, _name)
+        # model constructors only: lowercase factories, excluding the
+        # parameterized get_* helpers and non-model utilities
+        if callable(_fn) and _name[0].islower() and \
+                not _name.startswith(("get_", "positional_")):
+            _MODELS[_name] = _fn
+
+
+def get_model(name, **kwargs):
+    """Reference: gluonnlp.model.get_model(name)."""
+    if name not in _MODELS:
+        from ....base import MXNetError
+        raise MXNetError(
+            f"Model {name!r} is not present in the NLP model zoo; "
+            f"available: {sorted(_MODELS)}")
+    return _MODELS[name](**kwargs)

@@ -1,0 +1,128 @@
+"""Attention cells for the NLP model zoo.
+
+Reference capability: GluonNLP's attention cells
+(gluon-nlp/src/gluonnlp/model/attention_cell.py: DotProductAttentionCell,
+MultiHeadAttentionCell) and the fused ``contrib`` transformer ops
+(src/operator/contrib/transformer.cc [>=1.6]) — SURVEY.md §2.4/§5.7.
+
+TPU-native: one (B, H, Lq, Lk) einsum pair that XLA maps straight onto the
+MXU; the scaled-dot-product core is swappable for the Pallas
+flash-attention kernel (``mxnet_tpu.ops.flash_attention``) which never
+materializes the (Lq, Lk) score matrix in HBM.
+"""
+from __future__ import annotations
+
+import math
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["DotProductAttention", "MultiHeadAttention"]
+
+
+def _masked_softmax(F, scores, mask):
+    """scores: (..., Lq, Lk); mask broadcastable, 1=keep 0=drop."""
+    if mask is None:
+        return F.softmax(scores, axis=-1)
+    neg = -1e9 if scores.dtype == "float32" else -1e4
+    scores = F.where(mask, scores, F.ones_like(scores) * neg)
+    att = F.softmax(scores, axis=-1)
+    return att * mask
+
+
+class DotProductAttention(HybridBlock):
+    """Scaled dot-product attention: softmax(QK^T/sqrt(d))V.
+
+    Inputs: query (B, Lq, C), key (B, Lk, C), value (B, Lk, Cv),
+    optional mask (B, Lq, Lk). Returns (context, attn_weights).
+    """
+
+    def __init__(self, scaled=True, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self._scaled = scaled
+        with self.name_scope():
+            self._dropout = nn.Dropout(dropout)
+
+    def hybrid_forward(self, F, query, key, value, mask=None):
+        if self._scaled:
+            query = query / math.sqrt(query.shape[-1])
+        scores = F.batch_dot(query, key, transpose_b=True)
+        att = _masked_softmax(F, scores, mask)
+        att = self._dropout(att)
+        return F.batch_dot(att, value), att
+
+
+class MultiHeadAttention(HybridBlock):
+    """Multi-head attention (BERT/Transformer building block).
+
+    ``use_flash=True`` routes the core through the Pallas flash-attention
+    kernel (TPU; falls back to the XLA einsum path when a mask other than
+    causal is required or the kernel is unavailable).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, use_bias=True,
+                 use_flash=False, **kwargs):
+        super().__init__(**kwargs)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by num_heads "
+                             f"{num_heads}")
+        self._units = units
+        self._num_heads = num_heads
+        self._use_flash = use_flash
+        self._dropout_rate = dropout
+        with self.name_scope():
+            self.proj_query = nn.Dense(units, flatten=False,
+                                       use_bias=use_bias, prefix="query_")
+            self.proj_key = nn.Dense(units, flatten=False,
+                                     use_bias=use_bias, prefix="key_")
+            self.proj_value = nn.Dense(units, flatten=False,
+                                       use_bias=use_bias, prefix="value_")
+            self.proj_out = nn.Dense(units, flatten=False,
+                                     use_bias=use_bias, prefix="out_")
+            self._dropout = nn.Dropout(dropout)
+
+    def _split_heads(self, F, x):
+        # (B, L, C) -> (B, H, L, C/H)
+        b, l, _ = x.shape
+        x = F.reshape(x, (b, l, self._num_heads, -1))
+        return F.transpose(x, (0, 2, 1, 3))
+
+    def _merge_heads(self, F, x):
+        b, h, l, d = x.shape
+        return F.reshape(F.transpose(x, (0, 2, 1, 3)), (b, l, h * d))
+
+    def hybrid_forward(self, F, query, key=None, value=None, mask=None,
+                       causal=False):
+        key = query if key is None else key
+        value = key if value is None else value
+        q = self._split_heads(F, self.proj_query(query))
+        k = self._split_heads(F, self.proj_key(key))
+        v = self._split_heads(F, self.proj_value(value))
+
+        from ...._tape import is_training
+        flash_ok = (self._use_flash and mask is None and
+                    not (is_training() and self._dropout_rate > 0))
+        if flash_ok:
+            # flash kernel has no attention-dropout; only taken when that
+            # matches the XLA path (eval, or dropout disabled)
+            from ....ops import flash_attention
+            ctx = flash_attention(q, k, v, causal=causal)
+        else:
+            d = q.shape[-1]
+            q = q / math.sqrt(d)
+            # (B,H,Lq,d) x (B,H,Lk,d) -> (B,H,Lq,Lk)
+            scores = F.linalg_gemm2(q, k, transpose_b=True)
+            full_mask = None
+            if causal:
+                lq, lk = scores.shape[-2], scores.shape[-1]
+                rows = F.arange(lq).reshape((lq, 1))
+                cols = F.arange(lk).reshape((1, lk))
+                full_mask = (rows >= cols).reshape((1, 1, lq, lk))
+            if mask is not None:
+                m = F.expand_dims(mask, axis=1)  # (B,1,Lq,Lk)
+                full_mask = m if full_mask is None else full_mask * m
+            att = _masked_softmax(F, scores, full_mask)
+            att = self._dropout(att)
+            ctx = F.linalg_gemm2(att, v)
+        return self.proj_out(self._merge_heads(F, ctx))

@@ -1,0 +1,174 @@
+"""BERT for the TPU rebuild.
+
+Reference capability: GluonNLP BERT (gluon-nlp/src/gluonnlp/model/bert.py:
+BERTEncoder, BERTModel, bert_12_768_12 / bert_24_1024_16 with MLM + NSP
+heads) — SURVEY.md §2.4. Built from the same Gluon primitives so it
+hybridizes to one XLA program; gelu + layer_norm fuse into the matmuls.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+from .attention import MultiHeadAttention
+
+__all__ = ["BERTEncoder", "BERTModel", "get_bert_model", "bert_12_768_12",
+           "bert_24_1024_16"]
+
+
+class _PositionwiseFFN(HybridBlock):
+    """ffn(x) = W2 . gelu(W1 . x); reference gluonnlp BERTPositionwiseFFN."""
+
+    def __init__(self, units, hidden_size, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.ffn_1 = nn.Dense(hidden_size, flatten=False, prefix="ffn1_")
+            self.activation = nn.GELU()
+            self.ffn_2 = nn.Dense(units, flatten=False, prefix="ffn2_")
+            self.dropout = nn.Dropout(dropout)
+            self.layer_norm = nn.LayerNorm(epsilon=1e-12)
+
+    def hybrid_forward(self, F, x):
+        out = self.ffn_2(self.activation(self.ffn_1(x)))
+        return self.layer_norm(x + self.dropout(out))
+
+
+class _BERTEncoderCell(HybridBlock):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.attention = MultiHeadAttention(units, num_heads,
+                                                dropout=dropout)
+            self.dropout = nn.Dropout(dropout)
+            self.layer_norm = nn.LayerNorm(epsilon=1e-12)
+            self.ffn = _PositionwiseFFN(units, hidden_size, dropout=dropout)
+
+    def hybrid_forward(self, F, x, mask=None):
+        out = self.attention(x, x, x, mask)
+        x = self.layer_norm(x + self.dropout(out))
+        return self.ffn(x)
+
+
+class BERTEncoder(HybridBlock):
+    """Stack of post-norm transformer encoder cells.
+    Reference: gluonnlp BERTEncoder."""
+
+    def __init__(self, num_layers=12, units=768, hidden_size=3072,
+                 num_heads=12, dropout=0.1, max_length=512, **kwargs):
+        super().__init__(**kwargs)
+        self._max_length = max_length
+        self._units = units
+        with self.name_scope():
+            self.dropout = nn.Dropout(dropout)
+            self.layer_norm = nn.LayerNorm(epsilon=1e-12)
+            self.position_weight = self.params.get(
+                "position_weight", shape=(max_length, units),
+                init="normal")
+            self.transformer_cells = nn.HybridSequential(prefix="cells_")
+            with self.transformer_cells.name_scope():
+                for i in range(num_layers):
+                    self.transformer_cells.add(_BERTEncoderCell(
+                        units, hidden_size, num_heads, dropout=dropout,
+                        prefix=f"layer{i}_"))
+
+    def hybrid_forward(self, F, x, mask=None, position_weight=None):
+        seq_len = x.shape[1]
+        pos = F.slice(position_weight, begin=(0, 0), end=(seq_len, None))
+        x = x + F.expand_dims(pos, axis=0)
+        x = self.dropout(self.layer_norm(x))
+        for cell in self.transformer_cells._children.values():
+            x = cell(x, mask)
+        return x
+
+
+class BERTModel(HybridBlock):
+    """Embeddings + encoder + pooler + MLM decoder + NSP classifier.
+    Reference: gluonnlp BERTModel.
+
+    forward(inputs, token_types, valid_length=None, masked_positions=None)
+      -> (sequence_output, pooled_output[, mlm_scores][, nsp_scores])
+    """
+
+    def __init__(self, encoder, vocab_size, token_type_vocab_size=2,
+                 units=768, embed_dropout=0.1, use_pooler=True,
+                 use_decoder=True, use_classifier=True, **kwargs):
+        super().__init__(**kwargs)
+        self._use_pooler = use_pooler
+        self._use_decoder = use_decoder
+        self._use_classifier = use_classifier
+        self._vocab_size = vocab_size
+        with self.name_scope():
+            self.encoder = encoder
+            self.word_embed = nn.Embedding(vocab_size, units,
+                                           prefix="word_embed_")
+            self.token_type_embed = nn.Embedding(token_type_vocab_size,
+                                                 units,
+                                                 prefix="token_type_embed_")
+            self.embed_dropout = nn.Dropout(embed_dropout)
+            if use_pooler:
+                self.pooler = nn.Dense(units, activation="tanh",
+                                       flatten=False, prefix="pooler_")
+            if use_decoder:
+                # MLM head; output projection tied to word_embed in
+                # hybrid_forward (weight tying, reference decoder._collect)
+                self.decoder_transform = nn.Dense(units, flatten=False,
+                                                  activation=None,
+                                                  prefix="decoder_transform_")
+                self.decoder_norm = nn.LayerNorm(epsilon=1e-12)
+                self.decoder_bias = self.params.get(
+                    "decoder_bias", shape=(vocab_size,), init="zeros")
+            if use_classifier:
+                self.classifier = nn.Dense(2, flatten=False,
+                                           prefix="classifier_")
+
+    def _attention_mask(self, F, inputs, valid_length):
+        if valid_length is None:
+            return None
+        seq_len = inputs.shape[1]
+        steps = F.arange(seq_len).reshape((1, 1, seq_len))
+        mask = steps < F.reshape(valid_length, (-1, 1, 1))  # (B,1,Lk)
+        return F.broadcast_to(mask.astype("float32"),
+                              (inputs.shape[0], seq_len, seq_len))
+
+    def hybrid_forward(self, F, inputs, token_types, valid_length=None,
+                       masked_positions=None, position_weight=None,
+                       decoder_bias=None):
+        x = self.word_embed(inputs) + self.token_type_embed(token_types)
+        x = self.embed_dropout(x)
+        mask = self._attention_mask(F, inputs, valid_length)
+        seq_out = self.encoder(x, mask)
+        outputs = [seq_out]
+        pooled = None
+        if self._use_pooler:
+            cls = F.slice_axis(seq_out, axis=1, begin=0, end=1)
+            pooled = self.pooler(F.reshape(cls, (inputs.shape[0], -1)))
+            outputs.append(pooled)
+        if self._use_decoder and masked_positions is not None:
+            picked = F.gather_positions(seq_out, masked_positions)
+            h = self.decoder_norm(
+                F.LeakyReLU(self.decoder_transform(picked), act_type="gelu"))
+            emb = self.word_embed.weight.data()
+            scores = F.dot(h, emb, transpose_b=True) + decoder_bias
+            outputs.append(scores)
+        if self._use_classifier and pooled is not None:
+            outputs.append(self.classifier(pooled))
+        return outputs[0] if len(outputs) == 1 else tuple(outputs)
+
+
+def get_bert_model(num_layers=12, units=768, hidden_size=3072, num_heads=12,
+                   vocab_size=30522, max_length=512, dropout=0.1, **kwargs):
+    encoder = BERTEncoder(num_layers=num_layers, units=units,
+                          hidden_size=hidden_size, num_heads=num_heads,
+                          dropout=dropout, max_length=max_length,
+                          prefix="encoder_")
+    return BERTModel(encoder, vocab_size, units=units, embed_dropout=dropout,
+                     **kwargs)
+
+
+def bert_12_768_12(vocab_size=30522, **kwargs):
+    """BERT-base. Reference: gluonnlp bert_12_768_12."""
+    return get_bert_model(12, 768, 3072, 12, vocab_size=vocab_size, **kwargs)
+
+
+def bert_24_1024_16(vocab_size=30522, **kwargs):
+    """BERT-large. Reference: gluonnlp bert_24_1024_16."""
+    return get_bert_model(24, 1024, 4096, 16, vocab_size=vocab_size, **kwargs)

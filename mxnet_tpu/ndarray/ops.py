@@ -1181,3 +1181,15 @@ def batch_take(a, indices):
         return jnp.take_along_axis(
             d, i.astype(jnp.int32).reshape(-1, 1), axis=1).squeeze(1)
     return apply_nary(fn, [a, _nd(indices, a)], name="batch_take")
+
+
+@_register
+def gather_positions(data, positions):
+    """Pick rows at per-batch positions: data (B, L, C), positions (B, M)
+    -> (B, M, C). The MLM-head gather (reference: gluonnlp BERT decoder
+    uses gather_nd for this)."""
+    def fn(d, p):
+        return jnp.take_along_axis(
+            d, p.astype(jnp.int32)[..., None], axis=1)
+    return apply_nary(fn, [data, _nd(positions, data)],
+                      name="gather_positions")
