@@ -112,12 +112,30 @@ class RecordFileDataset(Dataset):
 
     def __init__(self, filename):
         from ... import recordio
+        from ...utils import native
         self._filename = filename
+        self._native = None
+        if native.available():
+            # C++ mmap reader builds its own index at open (src/recordio.cc)
+            self._native = native.NativeRecordFile(filename)
+            self._record = None
+            return
         idx_file = os.path.splitext(filename)[0] + ".idx"
         self._record = recordio.MXIndexedRecordIO(idx_file, filename, "r")
+        if not self._record.keys:
+            # no .idx sidecar: build the index with one sequential scan
+            pos = self._record.tell()
+            while self._record.read() is not None:
+                self._record.idx[len(self._record.keys)] = pos
+                self._record.keys.append(len(self._record.keys))
+                pos = self._record.tell()
 
     def __getitem__(self, idx):
+        if self._native is not None:
+            return self._native[idx]
         return self._record.read_idx(self._record.keys[idx])
 
     def __len__(self):
+        if self._native is not None:
+            return len(self._native)
         return len(self._record.keys)

@@ -87,12 +87,18 @@ class BeamSearchSampler:
                 jnp.asarray(log_probs)
             vocab = lp.shape[-1]
             lp = lp.reshape(batch, beam, vocab)
-            # finished beams: only EOS continuation keeps the score
-            eos_only = jnp.full((vocab,), -1e18).at[self._eos_id].set(0.0)
-            lp = jnp.where(done[..., None], eos_only, lp)
             cand = self._scorer(lp.reshape(batch * beam, vocab),
                                 scores.reshape(batch * beam),
-                                step).reshape(batch, beam * vocab)
+                                step).reshape(batch, beam, vocab)
+            # finished beams: score is frozen at its finish-time value
+            # (only the EOS self-loop carries it forward) — matching the
+            # reference sampler, which stops re-normalizing by lp(step)
+            # once a hypothesis ends.
+            eos_hot = jnp.arange(vocab) == self._eos_id
+            frozen = jnp.where(eos_hot[None, None, :], scores[..., None],
+                               -1e18)
+            cand = jnp.where(done[..., None], frozen, cand)
+            cand = cand.reshape(batch, beam * vocab)
             top_scores, top_idx = _topk(cand, beam)
             beam_idx = top_idx // vocab                       # (B, K)
             word_idx = top_idx % vocab

@@ -91,12 +91,12 @@ class _DecoderCell(HybridBlock):
         if self._pre_norm:
             h = self.norm_self(x)
             x = x + self.dropout(self.self_attention(
-                h, h, h, self_mask, causal=self_mask is None))
+                h, h, h, self_mask, causal=True))
             h = self.norm_inter(x)
             x = x + self.dropout(self.inter_attention(h, mem, mem, mem_mask))
         else:
             x = self.norm_self(x + self.dropout(self.self_attention(
-                x, x, x, self_mask, causal=self_mask is None)))
+                x, x, x, self_mask, causal=True)))
             x = self.norm_inter(x + self.dropout(
                 self.inter_attention(x, mem, mem, mem_mask)))
         return self.ffn(x)

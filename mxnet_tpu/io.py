@@ -262,10 +262,19 @@ class ImageRecordIter(DataIter):
         self._data_shape = tuple(data_shape)
         self._shuffle = shuffle
         self._rand_mirror = rand_mirror
+        self._label_width = label_width
         self._mean = _np.array([mean_r, mean_g, mean_b]).reshape(3, 1, 1)
         self._std = _np.array([std_r, std_g, std_b]).reshape(3, 1, 1)
         self._order = _np.arange(len(self._dataset))
         self._pos = 0
+        self._path_imgrec = path_imgrec
+        self._n_threads = preprocess_threads
+        # Native C++ decode+prefetch pipeline (src/prefetch.cc) when the
+        # library is built and the target shape is square RGB.
+        from .utils import native as _native
+        c, h, w = self._data_shape
+        self._use_native = (_native.available() and c == 3 and h == w)
+        self._native_iter = None
         self.reset()
 
     @property
@@ -280,26 +289,49 @@ class ImageRecordIter(DataIter):
         self._pos = 0
         if self._shuffle:
             _np.random.shuffle(self._order)
+        if self._use_native:
+            from .utils import native as _native
+            if self._native_iter is None:
+                self._native_iter = _native.NativePrefetcher(
+                    self._path_imgrec, self._order, self.batch_size,
+                    n_threads=self._n_threads, mode="image",
+                    edge=self._data_shape[1], label_width=self._label_width)
+            else:  # reuse the open mmap'd reader; just reschedule
+                self._native_iter.reset(self._order)
 
     def iter_next(self):
         return self._pos + self.batch_size <= len(self._dataset)
+
+    def _next_native(self):
+        batch, labels = next(self._native_iter)  # raises StopIteration at end
+        if len(batch) < self.batch_size:
+            raise StopIteration
+        img = batch.astype("float32").transpose(0, 3, 1, 2)  # NHWC->NCHW
+        if self._rand_mirror:
+            flip = _np.random.rand(len(img)) < 0.5
+            img[flip] = img[flip][..., ::-1]
+        img = (img - self._mean[None]) / self._std[None]
+        self._pos += self.batch_size
+        lab = labels[:, 0] if self._label_width == 1 else labels
+        return DataBatch(data=[array(img)], label=[array(lab)], pad=0)
 
     def next(self):
         from . import recordio, image
         if not self.iter_next():
             raise StopIteration
+        if self._use_native:
+            return self._next_native()
         datas, labels = [], []
         for i in range(self._pos, self._pos + self.batch_size):
             rec = self._dataset[self._order[i]]
             header, img_bytes = recordio.unpack(rec)
-            img = image.imdecode(img_bytes).asnumpy().astype("float32")
-            img = img.transpose(2, 0, 1)  # HWC->CHW
+            img = image.imdecode(img_bytes)
+            # Same preprocessing as the native pipeline (src/prefetch.cc):
+            # short-side resize then center crop to exactly (h, w).
             c, h, w = self._data_shape
-            img = img[:, :h, :w]
-            if img.shape[1] < h or img.shape[2] < w:
-                padded = _np.zeros(self._data_shape, "float32")
-                padded[:, :img.shape[1], :img.shape[2]] = img
-                img = padded
+            img = image.resize_short(img, min(h, w))
+            img, _ = image.center_crop(img, (w, h))
+            img = img.asnumpy().astype("float32").transpose(2, 0, 1)
             if self._rand_mirror and _np.random.rand() < 0.5:
                 img = img[:, :, ::-1]
             img = (img - self._mean) / self._std

@@ -1,1 +1,9 @@
-"""Pallas TPU kernels (flash attention etc.)."""
+"""Custom TPU kernels (Pallas) behind MXNet-style op entry points.
+
+The reference accelerates its hot ops with hand-written CUDA/cuDNN
+(SURVEY.md §2.1 "Operator library"); here XLA covers the bulk and Pallas
+covers what XLA won't fuse well — starting with flash attention.
+"""
+from .flash_attention import flash_attention
+
+__all__ = ["flash_attention"]

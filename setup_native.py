@@ -1,0 +1,33 @@
+"""Build the native host runtime (src/ -> mxnet_tpu/utils/libmxtpu.so).
+
+Usage: python setup_native.py build
+Requires cmake + a C++17 compiler + libjpeg headers (all in the standard
+image). The library is optional: every consumer falls back to pure Python
+when it is absent (mxnet_tpu/utils/native.py:available()).
+"""
+import os
+import subprocess
+import sys
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def build():
+    build_dir = os.path.join(ROOT, "src", "build")
+    gen = []
+    try:
+        subprocess.run(["ninja", "--version"], capture_output=True, check=True)
+        gen = ["-G", "Ninja"]
+    except Exception:
+        pass
+    subprocess.check_call(
+        ["cmake", "-S", os.path.join(ROOT, "src"), "-B", build_dir] + gen)
+    subprocess.check_call(["cmake", "--build", build_dir])
+    print("built:", os.path.join(ROOT, "mxnet_tpu", "utils", "libmxtpu.so"))
+
+
+if __name__ == "__main__":
+    if len(sys.argv) < 2 or sys.argv[1] != "build":
+        print(__doc__)
+        sys.exit(1)
+    build()

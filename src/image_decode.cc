@@ -1,0 +1,130 @@
+/*
+ * image_decode.cc — JPEG decode + bilinear resize on the host CPU.
+ *
+ * Role of the reference's src/io/image_aug_default.cc decode path
+ * (libjpeg-turbo/OpenCV there). Output is RGB uint8 HWC; resize is a
+ * separable bilinear to a square `edge` (the classic short-side-resize
+ * + center-crop is done by the prefetcher on top of this).
+ */
+#include "mxtpu.h"
+
+#include <csetjmp>
+#include <cstdio>
+#include <cstring>
+#include <vector>
+
+#include <jpeglib.h>
+
+namespace {
+
+struct JpegErrorMgr {
+  jpeg_error_mgr pub;
+  jmp_buf setjmp_buffer;
+};
+
+void JpegErrorExit(j_common_ptr cinfo) {
+  auto *err = reinterpret_cast<JpegErrorMgr *>(cinfo->err);
+  longjmp(err->setjmp_buffer, 1);
+}
+
+}  // namespace
+
+namespace {
+
+/* setjmp/longjmp frame: only POD locals live here; the scratch row buffer
+ * is owned by the caller so its destructor runs even on a longjmp'd error
+ * return. */
+int DecodeImpl(const void *jpeg, int64_t size, uint8_t *out,
+               int64_t out_capacity, int32_t *height, int32_t *width,
+               int32_t *channels, std::vector<uint8_t> *row) {
+  jpeg_decompress_struct cinfo;
+  JpegErrorMgr jerr;
+  cinfo.err = jpeg_std_error(&jerr.pub);
+  jerr.pub.error_exit = JpegErrorExit;
+  if (setjmp(jerr.setjmp_buffer)) {
+    jpeg_destroy_decompress(&cinfo);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, static_cast<const unsigned char *>(jpeg),
+               static_cast<unsigned long>(size));
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  *height = static_cast<int32_t>(cinfo.image_height);
+  *width = static_cast<int32_t>(cinfo.image_width);
+  *channels = 3;
+  if (out == nullptr) {  // header-only probe
+    jpeg_destroy_decompress(&cinfo);
+    return 0;
+  }
+  int64_t needed =
+      static_cast<int64_t>(cinfo.image_height) * cinfo.image_width * 3;
+  if (out_capacity < needed) {
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  jpeg_start_decompress(&cinfo);
+  row->resize(static_cast<size_t>(cinfo.output_width) *
+              cinfo.output_components);
+  while (cinfo.output_scanline < cinfo.output_height) {
+    uint8_t *rowptr = out + static_cast<int64_t>(cinfo.output_scanline) *
+                                cinfo.output_width * 3;
+    if (cinfo.output_components == 3) {
+      JSAMPROW rows[1] = {rowptr};
+      jpeg_read_scanlines(&cinfo, rows, 1);
+    } else {  // grayscale: expand to RGB
+      JSAMPROW rows[1] = {row->data()};
+      jpeg_read_scanlines(&cinfo, rows, 1);
+      for (unsigned x = 0; x < cinfo.output_width; ++x) {
+        rowptr[3 * x] = rowptr[3 * x + 1] = rowptr[3 * x + 2] = (*row)[x];
+      }
+    }
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int mxtpu_jpeg_decode(const void *jpeg, int64_t size, uint8_t *out,
+                      int64_t out_capacity, int32_t *height, int32_t *width,
+                      int32_t *channels) {
+  std::vector<uint8_t> row;
+  return DecodeImpl(jpeg, size, out, out_capacity, height, width, channels,
+                    &row);
+}
+
+}  // extern "C"
+
+/* Shared by prefetch.cc — not part of the C ABI. */
+void mxtpu_bilinear_resize_rgb(const uint8_t *src, int sh, int sw,
+                               uint8_t *dst, int dh, int dw) {
+  const float scale_y = static_cast<float>(sh) / dh;
+  const float scale_x = static_cast<float>(sw) / dw;
+  for (int y = 0; y < dh; ++y) {
+    float fy = (y + 0.5f) * scale_y - 0.5f;
+    int y0 = fy < 0 ? 0 : static_cast<int>(fy);
+    int y1 = y0 + 1 < sh ? y0 + 1 : sh - 1;
+    float wy = fy - y0;
+    if (wy < 0) wy = 0;
+    for (int x = 0; x < dw; ++x) {
+      float fx = (x + 0.5f) * scale_x - 0.5f;
+      int x0 = fx < 0 ? 0 : static_cast<int>(fx);
+      int x1 = x0 + 1 < sw ? x0 + 1 : sw - 1;
+      float wx = fx - x0;
+      if (wx < 0) wx = 0;
+      for (int c = 0; c < 3; ++c) {
+        float v00 = src[(y0 * sw + x0) * 3 + c];
+        float v01 = src[(y0 * sw + x1) * 3 + c];
+        float v10 = src[(y1 * sw + x0) * 3 + c];
+        float v11 = src[(y1 * sw + x1) * 3 + c];
+        float v = v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                  v10 * wy * (1 - wx) + v11 * wy * wx;
+        dst[(y * dw + x) * 3 + c] = static_cast<uint8_t>(v + 0.5f);
+      }
+    }
+  }
+}

@@ -1,0 +1,203 @@
+/*
+ * recordio.cc — mmap-backed RecordIO reader + buffered writer.
+ *
+ * Framing (compatible with dmlc-core recordio, see reference
+ * dmlc-core/src/recordio.cc behavior): each part is
+ *   uint32 magic (0xced7230a) | uint32 lrec | payload | pad to 4B
+ * where lrec = (cflag << 29) | length. cflag: 0 = whole record,
+ * 1 = first part, 2 = middle part, 3 = last part.
+ */
+#include "mxtpu.h"
+
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+constexpr uint32_t kMagic = 0xced7230a;
+
+thread_local std::string g_last_error;
+
+inline uint32_t ReadU32(const uint8_t *p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+struct RecordRef {
+  uint64_t offset;   // offset of first payload byte
+  uint32_t length;   // payload length of this part
+  bool multipart;    // cflag != 0 at this position
+};
+
+struct Reader {
+  int fd = -1;
+  const uint8_t *base = nullptr;
+  size_t size = 0;
+  std::vector<RecordRef> index;   // one entry per logical record
+  std::string scratch;            // assembly buffer for multipart reads
+};
+
+}  // namespace
+
+extern "C" {
+
+const char *mxtpu_last_error(void) { return g_last_error.c_str(); }
+
+void *mxtpu_recordio_open(const char *path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) {
+    g_last_error = std::string("open failed: ") + path;
+    return nullptr;
+  }
+  struct stat st;
+  if (fstat(fd, &st) != 0) {
+    ::close(fd);
+    g_last_error = "fstat failed";
+    return nullptr;
+  }
+  auto *r = new Reader();
+  r->fd = fd;
+  r->size = static_cast<size_t>(st.st_size);
+  if (r->size > 0) {
+    void *m = mmap(nullptr, r->size, PROT_READ, MAP_PRIVATE, fd, 0);
+    if (m == MAP_FAILED) {
+      ::close(fd);
+      delete r;
+      g_last_error = "mmap failed";
+      return nullptr;
+    }
+    r->base = static_cast<const uint8_t *>(m);
+    madvise(const_cast<uint8_t *>(r->base), r->size, MADV_WILLNEED);
+  }
+  // Single sequential scan to index logical record boundaries.
+  size_t pos = 0;
+  while (pos + 8 <= r->size) {
+    if (ReadU32(r->base + pos) != kMagic) {
+      g_last_error = "magic mismatch at offset " + std::to_string(pos);
+      munmap(const_cast<uint8_t *>(r->base), r->size);
+      ::close(fd);
+      delete r;
+      return nullptr;
+    }
+    uint32_t lrec = ReadU32(r->base + pos + 4);
+    uint32_t cflag = lrec >> 29u;
+    uint32_t length = lrec & ((1u << 29u) - 1u);
+    if (pos + 8 + length > r->size) {
+      g_last_error = "truncated record at offset " + std::to_string(pos);
+      munmap(const_cast<uint8_t *>(r->base), r->size);
+      ::close(fd);
+      delete r;
+      return nullptr;
+    }
+    if (cflag == 0 || cflag == 1) {
+      r->index.push_back({pos + 8, length, cflag != 0});
+    }
+    pos += 8 + ((length + 3u) & ~3u);
+  }
+  return r;
+}
+
+int64_t mxtpu_recordio_count(void *handle) {
+  if (!handle) return -1;
+  return static_cast<int64_t>(static_cast<Reader *>(handle)->index.size());
+}
+
+int64_t mxtpu_recordio_read(void *handle, int64_t i, void **out) {
+  auto *r = static_cast<Reader *>(handle);
+  if (!r || i < 0 || i >= static_cast<int64_t>(r->index.size())) {
+    g_last_error = "index out of range";
+    return -1;
+  }
+  const RecordRef &ref = r->index[static_cast<size_t>(i)];
+  if (!ref.multipart) {
+    *out = const_cast<uint8_t *>(r->base + ref.offset);
+    return ref.length;
+  }
+  // Assemble continuation parts into the scratch buffer.
+  r->scratch.assign(reinterpret_cast<const char *>(r->base + ref.offset),
+                    ref.length);
+  size_t pos = ref.offset + ((ref.length + 3u) & ~3u);
+  while (pos + 8 <= r->size) {
+    uint32_t lrec = ReadU32(r->base + pos + 4);
+    uint32_t cflag = lrec >> 29u;
+    uint32_t length = lrec & ((1u << 29u) - 1u);
+    r->scratch.append(reinterpret_cast<const char *>(r->base + pos + 8),
+                      length);
+    pos += 8 + ((length + 3u) & ~3u);
+    if (cflag == 3) break;
+  }
+  *out = const_cast<char *>(r->scratch.data());
+  return static_cast<int64_t>(r->scratch.size());
+}
+
+void mxtpu_recordio_close(void *handle) {
+  auto *r = static_cast<Reader *>(handle);
+  if (!r) return;
+  if (r->base) munmap(const_cast<uint8_t *>(r->base), r->size);
+  if (r->fd >= 0) ::close(r->fd);
+  delete r;
+}
+
+/* ---------------- writer ---------------- */
+
+namespace {
+struct Writer {
+  FILE *f = nullptr;
+  int64_t pos = 0;
+};
+}  // namespace
+
+void *mxtpu_recordio_writer_open(const char *path) {
+  FILE *f = std::fopen(path, "wb");
+  if (!f) {
+    g_last_error = std::string("fopen failed: ") + path;
+    return nullptr;
+  }
+  auto *w = new Writer();
+  w->f = f;
+  return w;
+}
+
+int64_t mxtpu_recordio_writer_write(void *handle, const void *buf,
+                                    int64_t size) {
+  auto *w = static_cast<Writer *>(handle);
+  if (!w || size < 0 || size >= (1ll << 29)) {
+    g_last_error = "bad write (record too large for single part?)";
+    return -1;
+  }
+  int64_t start = w->pos;
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(size)};
+  uint32_t pad = (4u - static_cast<uint32_t>(size % 4)) % 4u;
+  static const char zeros[4] = {0, 0, 0, 0};
+  if (std::fwrite(header, 4, 2, w->f) != 2 ||
+      std::fwrite(buf, 1, static_cast<size_t>(size), w->f) !=
+          static_cast<size_t>(size) ||
+      (pad && std::fwrite(zeros, 1, pad, w->f) != pad)) {
+    g_last_error = "record write failed (disk full?)";
+    return -1;
+  }
+  w->pos += 8 + size + pad;
+  return start;
+}
+
+int mxtpu_recordio_writer_close(void *handle) {
+  auto *w = static_cast<Writer *>(handle);
+  if (!w) return 0;
+  int rc = 0;
+  if (w->f && std::fclose(w->f) != 0) {
+    g_last_error = "fclose failed (data may be truncated)";
+    rc = -1;
+  }
+  delete w;
+  return rc;
+}
+
+}  // extern "C"
