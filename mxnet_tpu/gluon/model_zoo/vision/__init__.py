@@ -10,6 +10,9 @@ from .squeezenet import *  # noqa: F401,F403
 from .mobilenet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .inception import *  # noqa: F401,F403
+from .ssd import *  # noqa: F401,F403
+from .yolo import *  # noqa: F401,F403
+from .segmentation import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
@@ -21,7 +24,7 @@ def _register_models():
     import importlib
     mods = [importlib.import_module(f"{__name__}.{m}")
             for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
-                      "densenet", "inception")]
+                      "densenet", "inception", "ssd", "yolo", "segmentation")]
     for mod in mods:
         for name in mod.__all__:
             fn = getattr(mod, name)

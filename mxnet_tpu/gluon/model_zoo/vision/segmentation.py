@@ -1,0 +1,303 @@
+"""Semantic segmentation zoo: FCN / PSPNet / DeepLabV3 (GluonCV parity:
+gluoncv/model_zoo/{fcn.py,pspnet.py,deeplabv3.py}, segbase.py).
+
+Backbone is a dilated ResNetV1b (stages 3/4 use dilation 2/4, output stride
+8) — the GluonCV `resnet50_v1b` pattern. All heads are HybridBlocks; the
+final bilinear upsample is `contrib.BilinearResize2D` (static target size).
+SyncBatchNorm can be swapped in via `norm_layer` for multi-chip training
+(gluon.contrib.nn.SyncBatchNorm reduces stats over the mesh 'dp' axis).
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["ResNetV1b", "resnet50_v1b", "resnet101_v1b",
+           "FCN", "PSPNet", "DeepLabV3",
+           "get_fcn", "get_psp", "get_deeplab"]
+
+
+class BottleneckV1b(HybridBlock):
+    expansion = 4
+
+    def __init__(self, planes, strides=1, dilation=1, downsample=None,
+                 previous_dilation=1, norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(**kwargs)
+        self.conv1 = nn.Conv2D(planes, kernel_size=1, use_bias=False)
+        self.bn1 = norm_layer()
+        self.conv2 = nn.Conv2D(planes, kernel_size=3, strides=strides,
+                               padding=dilation, dilation=dilation,
+                               use_bias=False)
+        self.bn2 = norm_layer()
+        self.conv3 = nn.Conv2D(planes * 4, kernel_size=1, use_bias=False)
+        self.bn3 = norm_layer()
+        self.relu = nn.Activation("relu")
+        self.downsample = downsample
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.relu(self.bn2(self.conv2(out)))
+        out = self.bn3(self.conv3(out))
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        return self.relu(out + residual)
+
+
+class ResNetV1b(HybridBlock):
+    """Dilated ResNet backbone (gluoncv resnetv1b.py), output stride 8."""
+
+    def __init__(self, layers, classes=1000, dilated=True,
+                 norm_layer=nn.BatchNorm, deep_stem=False, **kwargs):
+        super().__init__(**kwargs)
+        self.conv1 = nn.Conv2D(64, kernel_size=7, strides=2, padding=3,
+                               use_bias=False)
+        self.bn1 = norm_layer()
+        self.relu = nn.Activation("relu")
+        self.maxpool = nn.MaxPool2D(pool_size=3, strides=2, padding=1)
+        planes = (64, 128, 256, 512)
+        strides = (1, 2, 1, 1) if dilated else (1, 2, 2, 2)
+        dilations = (1, 1, 2, 4) if dilated else (1, 1, 1, 1)
+        self.layer1 = self._make_layer(planes[0], layers[0], strides[0],
+                                       dilations[0], norm_layer)
+        self.layer2 = self._make_layer(planes[1], layers[1], strides[1],
+                                       dilations[1], norm_layer)
+        self.layer3 = self._make_layer(planes[2], layers[2], strides[2],
+                                       dilations[2], norm_layer)
+        self.layer4 = self._make_layer(planes[3], layers[3], strides[3],
+                                       dilations[3], norm_layer)
+        self.avgpool = nn.GlobalAvgPool2D()
+        self.fc = nn.Dense(classes)
+
+    def _make_layer(self, planes, blocks, strides, dilation, norm_layer):
+        layer = nn.HybridSequential()
+        downsample = nn.HybridSequential()
+        downsample.add(nn.Conv2D(planes * 4, kernel_size=1, strides=strides,
+                                 use_bias=False))
+        downsample.add(norm_layer())
+        first_dil = 1 if dilation in (1, 2) else 2
+        layer.add(BottleneckV1b(planes, strides, first_dil, downsample,
+                                norm_layer=norm_layer))
+        for _ in range(1, blocks):
+            layer.add(BottleneckV1b(planes, 1, dilation,
+                                    norm_layer=norm_layer))
+        return layer
+
+    def hybrid_forward(self, F, x):
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        c1 = self.layer1(x)
+        c2 = self.layer2(c1)
+        c3 = self.layer3(c2)
+        c4 = self.layer4(c3)
+        x = self.avgpool(c4)
+        return self.fc(F.flatten(x))
+
+    def extract(self, x):
+        """Return (c3, c4) feature maps for segmentation heads."""
+        x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+        x = self.layer1(x)
+        x = self.layer2(x)
+        c3 = self.layer3(x)
+        c4 = self.layer4(c3)
+        return c3, c4
+
+
+def resnet50_v1b(**kwargs):
+    return ResNetV1b([3, 4, 6, 3], **kwargs)
+
+
+def resnet101_v1b(**kwargs):
+    return ResNetV1b([3, 4, 23, 3], **kwargs)
+
+
+class _FCNHead(HybridBlock):
+    def __init__(self, nclass, channels=512, norm_layer=nn.BatchNorm,
+                 **kwargs):
+        super().__init__(**kwargs)
+        self.block = nn.HybridSequential()
+        self.block.add(nn.Conv2D(channels // 4, kernel_size=3, padding=1,
+                                 use_bias=False))
+        self.block.add(norm_layer())
+        self.block.add(nn.Activation("relu"))
+        self.block.add(nn.Dropout(0.1))
+        self.block.add(nn.Conv2D(nclass, kernel_size=1))
+
+    def hybrid_forward(self, F, x):
+        return self.block(x)
+
+
+class SegBaseModel(HybridBlock):
+    def __init__(self, nclass, backbone="resnet50", aux=True,
+                 norm_layer=nn.BatchNorm, crop_size=480, **kwargs):
+        super().__init__(**kwargs)
+        self.nclass = nclass
+        self.aux = aux
+        self.crop_size = crop_size
+        if backbone == "resnet50":
+            self.base = resnet50_v1b(norm_layer=norm_layer)
+        elif backbone == "resnet101":
+            self.base = resnet101_v1b(norm_layer=norm_layer)
+        else:
+            raise MXNetError(f"unknown backbone {backbone}")
+
+    def _resize(self, x, like):
+        from ....ndarray import contrib
+        return contrib.BilinearResize2D(x, height=like.shape[2],
+                                        width=like.shape[3])
+
+    def predict(self, x):
+        from .... import _tape
+        prev = _tape.set_training(False)
+        try:
+            out = self(x)
+        finally:
+            _tape.set_training(prev)
+        return out[0] if isinstance(out, (tuple, list)) else out
+
+    def evaluate(self, x):
+        return self.predict(x)
+
+
+class FCN(SegBaseModel):
+    """Fully Convolutional Network (gluoncv fcn.py FCN8s-style head)."""
+
+    def __init__(self, nclass, backbone="resnet50", aux=True,
+                 norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(nclass, backbone, aux, norm_layer, **kwargs)
+        self.head = _FCNHead(nclass, 2048, norm_layer)
+        if aux:
+            self.auxlayer = _FCNHead(nclass, 1024, norm_layer)
+
+    def hybrid_forward(self, F, x):
+        from .... import _tape
+        c3, c4 = self.base.extract(x)
+        out = self._resize(self.head(c4), x)
+        if self.aux and _tape.is_training():
+            return out, self._resize(self.auxlayer(c3), x)
+        return out
+
+
+class _PyramidPooling(HybridBlock):
+    def __init__(self, norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(**kwargs)
+        self.convs = nn.HybridSequential()
+        for _ in range(4):
+            blk = nn.HybridSequential()
+            blk.add(nn.Conv2D(512, kernel_size=1, use_bias=False))
+            blk.add(norm_layer())
+            blk.add(nn.Activation("relu"))
+            self.convs.add(blk)
+
+    def hybrid_forward(self, F, x):
+        from ....ndarray import contrib
+        h, w = x.shape[2], x.shape[3]
+        outs = [x]
+        for size, conv in zip((1, 2, 3, 6), self.convs):
+            p = contrib.AdaptiveAvgPooling2D(x, output_size=size)
+            p = conv(p)
+            outs.append(contrib.BilinearResize2D(p, height=h, width=w))
+        return F.concat(*outs, dim=1)
+
+
+class PSPNet(SegBaseModel):
+    """Pyramid Scene Parsing (gluoncv pspnet.py)."""
+
+    def __init__(self, nclass, backbone="resnet50", aux=True,
+                 norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(nclass, backbone, aux, norm_layer, **kwargs)
+        self.psp = _PyramidPooling(norm_layer)
+        self.head = nn.HybridSequential()
+        self.head.add(nn.Conv2D(512, kernel_size=3, padding=1,
+                                use_bias=False))
+        self.head.add(norm_layer())
+        self.head.add(nn.Activation("relu"))
+        self.head.add(nn.Dropout(0.1))
+        self.head.add(nn.Conv2D(nclass, kernel_size=1))
+        if aux:
+            self.auxlayer = _FCNHead(nclass, 1024, norm_layer)
+
+    def hybrid_forward(self, F, x):
+        from .... import _tape
+        c3, c4 = self.base.extract(x)
+        out = self._resize(self.head(self.psp(c4)), x)
+        if self.aux and _tape.is_training():
+            return out, self._resize(self.auxlayer(c3), x)
+        return out
+
+
+class _ASPP(HybridBlock):
+    """Atrous spatial pyramid pooling (deeplabv3.py), rates 12/24/36."""
+
+    def __init__(self, norm_layer=nn.BatchNorm, rates=(12, 24, 36), **kwargs):
+        super().__init__(**kwargs)
+        out_ch = 256
+        self.b0 = nn.HybridSequential()
+        self.b0.add(nn.Conv2D(out_ch, kernel_size=1, use_bias=False))
+        self.b0.add(norm_layer())
+        self.b0.add(nn.Activation("relu"))
+        self.branches = nn.HybridSequential()
+        for r in rates:
+            blk = nn.HybridSequential()
+            blk.add(nn.Conv2D(out_ch, kernel_size=3, padding=r, dilation=r,
+                              use_bias=False))
+            blk.add(norm_layer())
+            blk.add(nn.Activation("relu"))
+            self.branches.add(blk)
+        self.gap_conv = nn.HybridSequential()
+        self.gap_conv.add(nn.Conv2D(out_ch, kernel_size=1, use_bias=False))
+        self.gap_conv.add(norm_layer())
+        self.gap_conv.add(nn.Activation("relu"))
+        self.project = nn.HybridSequential()
+        self.project.add(nn.Conv2D(out_ch, kernel_size=1, use_bias=False))
+        self.project.add(norm_layer())
+        self.project.add(nn.Activation("relu"))
+        self.project.add(nn.Dropout(0.5))
+
+    def hybrid_forward(self, F, x):
+        from ....ndarray import contrib
+        h, w = x.shape[2], x.shape[3]
+        outs = [self.b0(x)]
+        for blk in self.branches:
+            outs.append(blk(x))
+        gap = contrib.AdaptiveAvgPooling2D(x, output_size=1)
+        gap = self.gap_conv(gap)
+        outs.append(contrib.BilinearResize2D(gap, height=h, width=w))
+        return self.project(F.concat(*outs, dim=1))
+
+
+class DeepLabV3(SegBaseModel):
+    """DeepLabV3 (gluoncv deeplabv3.py)."""
+
+    def __init__(self, nclass, backbone="resnet50", aux=True,
+                 norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(nclass, backbone, aux, norm_layer, **kwargs)
+        self.aspp = _ASPP(norm_layer)
+        self.head = nn.HybridSequential()
+        self.head.add(nn.Conv2D(256, kernel_size=3, padding=1,
+                                use_bias=False))
+        self.head.add(norm_layer())
+        self.head.add(nn.Activation("relu"))
+        self.head.add(nn.Conv2D(nclass, kernel_size=1))
+        if aux:
+            self.auxlayer = _FCNHead(nclass, 1024, norm_layer)
+
+    def hybrid_forward(self, F, x):
+        from .... import _tape
+        c3, c4 = self.base.extract(x)
+        out = self._resize(self.head(self.aspp(c4)), x)
+        if self.aux and _tape.is_training():
+            return out, self._resize(self.auxlayer(c3), x)
+        return out
+
+
+def get_fcn(nclass=21, backbone="resnet50", **kwargs):
+    return FCN(nclass, backbone, **kwargs)
+
+
+def get_psp(nclass=21, backbone="resnet50", **kwargs):
+    return PSPNet(nclass, backbone, **kwargs)
+
+
+def get_deeplab(nclass=21, backbone="resnet50", **kwargs):
+    return DeepLabV3(nclass, backbone, **kwargs)

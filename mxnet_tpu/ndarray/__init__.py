@@ -11,6 +11,7 @@ from .ops import concat, stack
 from . import random
 from .utils import save, load, load_frombuffer
 from . import sparse
+from . import contrib
 
 zeros_like_fn = None  # avoid accidental shadowing confusion
 

@@ -1,0 +1,566 @@
+"""``mx.nd.contrib`` — detection / misc contrib operators, TPU-first.
+
+Reference surface: src/operator/contrib/ (bounding_box.cc: box_nms, box_iou,
+bipartite_matching, box_encode/decode; roi_align.cc; multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc for the legacy SSD path).
+
+Design notes (TPU): all ops are static-shape and branch-free so they jit onto
+the VPU/MXU — NMS is a fixed-trip `lax.fori_loop` over the top-k scored boxes
+(suppressed entries are masked, never dropped), ROIAlign is vectorised
+bilinear gather, matching is an argmax sweep. No dynamic shapes anywhere.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .ndarray import NDArray, apply_nary
+
+__all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
+           "bipartite_matching", "ROIAlign", "ROIPooling",
+           "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
+           "getnnz", "quantize", "arange_like", "fused_gelu",
+           "BilinearResize2D", "AdaptiveAvgPooling2D"]
+
+
+def _corner(box, fmt):
+    """Convert [..., 4] boxes to corner (xmin, ymin, xmax, ymax)."""
+    if fmt == "corner":
+        return box
+    if fmt == "center":
+        x, y, w, h = jnp.split(box, 4, axis=-1)
+        return jnp.concatenate(
+            [x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+    raise MXNetError(f"unknown box format {fmt!r}")
+
+
+def _pairwise_iou(lhs, rhs):
+    """IoU of [..., N, 4] x [..., M, 4] corner boxes -> [..., N, M]."""
+    l = lhs[..., :, None, :]
+    r = rhs[..., None, :, :]
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_l = jnp.maximum(l[..., 2] - l[..., 0], 0.0) * \
+        jnp.maximum(l[..., 3] - l[..., 1], 0.0)
+    area_r = jnp.maximum(r[..., 2] - r[..., 0], 0.0) * \
+        jnp.maximum(r[..., 3] - r[..., 1], 0.0)
+    return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+
+def box_iou(lhs, rhs, format="corner"):
+    """Pairwise IoU (reference: src/operator/contrib/bounding_box.cc)."""
+    def fn(a, b):
+        return _pairwise_iou(_corner(a, format), _corner(b, format))
+    return apply_nary(fn, [lhs, rhs], name="box_iou")
+
+
+def box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+            coord_start=2, score_index=1, id_index=-1, force_suppress=False,
+            in_format="corner", out_format="corner", background_id=-1):
+    """Non-maximum suppression, MXNet semantics.
+
+    data: (..., N, K) with K >= coord_start+4; suppressed boxes get score -1
+    (all other fields preserved), output sorted by score descending. The
+    suppression sweep is a fixed-trip ``lax.fori_loop`` over candidates so the
+    whole op compiles to one static XLA program (no data-dependent shapes).
+    """
+    def fn(d):
+        shape = d.shape
+        d2 = d.reshape((-1,) + shape[-2:])
+        n = d2.shape[1]
+
+        # suppression sweep runs only on the top-k candidates (k x k IoU,
+        # k-trip loop) — the O(N^2) full matrix would not fit the VPU
+        # budget for SSD-sized anchor sets (N ~ 10k)
+        k = n if topk < 0 else min(int(topk), n)
+
+        def one(batch):
+            scores = batch[:, score_index]
+            ids = batch[:, id_index] if id_index >= 0 else jnp.zeros(n)
+            valid = scores > valid_thresh
+            if background_id >= 0 and id_index >= 0:
+                valid = valid & (ids != background_id)
+            order = jnp.argsort(-jnp.where(valid, scores, -jnp.inf))
+            boxes = _corner(batch[:, coord_start:coord_start + 4], in_format)
+            cand = order[:k]
+            sboxes = boxes[cand]
+            svalid = valid[cand]
+            sids = ids[cand]
+            iou = _pairwise_iou(sboxes, sboxes)
+            if not force_suppress and id_index >= 0:
+                same = sids[:, None] == sids[None, :]
+                iou = jnp.where(same, iou, 0.0)
+
+            def body(i, keep):
+                alive = keep[i] & svalid[i]
+                sup = (iou[i] > overlap_thresh) & (jnp.arange(k) > i)
+                return jnp.where(alive, keep & ~sup, keep)
+
+            keep_k = lax.fori_loop(0, k, body, jnp.ones(k, bool))
+            keep = jnp.zeros(n, bool).at[:k].set(keep_k & svalid)
+            out = batch[order]
+            out = out.at[:, score_index].set(
+                jnp.where(keep, out[:, score_index], -1.0))
+            if out_format != in_format:
+                cs = coord_start
+                box_out = _corner(out[:, cs:cs + 4], in_format)
+                if out_format == "center":
+                    xmin, ymin, xmax, ymax = jnp.split(box_out, 4, axis=-1)
+                    box_out = jnp.concatenate(
+                        [(xmin + xmax) / 2, (ymin + ymax) / 2,
+                         xmax - xmin, ymax - ymin], axis=-1)
+                out = out.at[:, cs:cs + 4].set(box_out)
+            return out
+
+        return jax.vmap(one)(d2).reshape(shape)
+
+    return apply_nary(fn, [data], name="box_nms")
+
+
+def box_encode(samples, matches, anchors, refs, means=(0., 0., 0., 0.),
+               stds=(0.1, 0.1, 0.2, 0.2)):
+    """Encode matched gt boxes against anchors as normalized offsets.
+
+    samples: (B, N) in {-1, 0, 1} (1 = positive); matches: (B, N) gt index;
+    anchors/refs: (B, N, 4)/(B, M, 4) corner boxes. Returns (targets, masks).
+    Reference: src/operator/contrib/bounding_box.cc (BoxEncode).
+    """
+    means = jnp.asarray(means)
+    stds = jnp.asarray(stds)
+
+    def fn(s, m, a, r):
+        g = jnp.take_along_axis(r, m[..., None].astype(jnp.int32).clip(0)
+                                .repeat(4, -1), axis=1)
+        aw = a[..., 2] - a[..., 0]
+        ah = a[..., 3] - a[..., 1]
+        ax = (a[..., 0] + a[..., 2]) / 2
+        ay = (a[..., 1] + a[..., 3]) / 2
+        gw = g[..., 2] - g[..., 0]
+        gh = g[..., 3] - g[..., 1]
+        gx = (g[..., 0] + g[..., 2]) / 2
+        gy = (g[..., 1] + g[..., 3]) / 2
+        t = jnp.stack([(gx - ax) / jnp.maximum(aw, 1e-12),
+                       (gy - ay) / jnp.maximum(ah, 1e-12),
+                       jnp.log(jnp.maximum(gw, 1e-12) /
+                               jnp.maximum(aw, 1e-12)),
+                       jnp.log(jnp.maximum(gh, 1e-12) /
+                               jnp.maximum(ah, 1e-12))], axis=-1)
+        t = (t - means) / stds
+        mask = (s > 0.5)[..., None].astype(t.dtype)
+        return t * mask, mask.repeat(4, -1) * 0 + mask
+
+    out = apply_nary(fn, [samples, matches, anchors, refs], n_out=2,
+                     name="box_encode")
+    return out
+
+
+def box_decode(data, anchors, std0=0.1, std1=0.1, std2=0.2, std3=0.2,
+               clip=-1.0, format="center"):
+    """Decode offsets back to corner boxes (inverse of box_encode)."""
+    stds = jnp.asarray([std0, std1, std2, std3])
+
+    def fn(d, a):
+        if format == "corner":
+            ac = a
+            aw = ac[..., 2] - ac[..., 0]
+            ah = ac[..., 3] - ac[..., 1]
+            ax = (ac[..., 0] + ac[..., 2]) / 2
+            ay = (ac[..., 1] + ac[..., 3]) / 2
+        else:
+            ax, ay, aw, ah = (a[..., 0], a[..., 1], a[..., 2], a[..., 3])
+        t = d * stds
+        ox = t[..., 0] * aw + ax
+        oy = t[..., 1] * ah + ay
+        tw = t[..., 2]
+        th = t[..., 3]
+        if clip > 0:
+            tw = jnp.minimum(tw, clip)
+            th = jnp.minimum(th, clip)
+        ow = jnp.exp(tw) * aw / 2
+        oh = jnp.exp(th) * ah / 2
+        return jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+
+    return apply_nary(fn, [data, anchors], name="box_decode")
+
+
+def bipartite_matching(data, threshold=1e-12, is_ascend=False, topk=-1):
+    """Greedy bipartite matching on a (B, N, M) score matrix.
+
+    Returns (row_match, col_match): for each row the matched column (or -1),
+    and for each column the matched row (or -1). Fixed-trip argmax sweep.
+    Reference: src/operator/contrib/bounding_box.cc (BipartiteMatching).
+    """
+    def fn(d):
+        sign = 1.0 if not is_ascend else -1.0
+
+        def one(mat):
+            n, m = mat.shape
+            k = min(n, m) if topk < 0 else min(int(topk), n, m)
+            s = mat * sign
+
+            def body(_, carry):
+                s_cur, row, col = carry
+                flat = jnp.argmax(s_cur)
+                i, j = flat // m, flat % m
+                ok = s_cur[i, j] > (threshold * sign if not is_ascend
+                                    else -jnp.inf)
+                row = jnp.where(ok, row.at[i].set(j), row)
+                col = jnp.where(ok, col.at[j].set(i), col)
+                s_cur = jnp.where(ok, s_cur.at[i, :].set(-jnp.inf)
+                                  .at[:, j].set(-jnp.inf), s_cur)
+                return s_cur, row, col
+
+            _, row, col = lax.fori_loop(
+                0, k, body, (s, -jnp.ones(n, jnp.float32),
+                             -jnp.ones(m, jnp.float32)))
+            return row, col
+
+        rows, cols = jax.vmap(one)(d)
+        return rows, cols
+
+    return apply_nary(fn, [data], n_out=2, name="bipartite_matching")
+
+
+def _roi_align_one(feat, roi, pooled_h, pooled_w, spatial_scale, ratio):
+    """feat: (C, H, W); roi: (4,) corner in image coords -> (C, ph, pw)."""
+    c, h, w = feat.shape
+    x0, y0, x1, y1 = roi * spatial_scale
+    rw = jnp.maximum(x1 - x0, 1.0)
+    rh = jnp.maximum(y1 - y0, 1.0)
+    bin_w = rw / pooled_w
+    bin_h = rh / pooled_h
+    sr = ratio if ratio > 0 else 2
+    # sample grid: (ph, pw, sr, sr) bilinear sample points
+    iy = jnp.arange(sr) + 0.5
+    ix = jnp.arange(sr) + 0.5
+    py = jnp.arange(pooled_h)
+    px = jnp.arange(pooled_w)
+    ys2 = jnp.broadcast_to(
+        (y0 + py[:, None] * bin_h + iy[None, :] / sr * bin_h)[:, None, :, None],
+        (pooled_h, pooled_w, sr, sr))
+    xs2 = jnp.broadcast_to(
+        (x0 + px[:, None] * bin_w + ix[None, :] / sr * bin_w)[None, :, None, :],
+        (pooled_h, pooled_w, sr, sr))
+
+    def bilinear(yy, xx):
+        yy = jnp.clip(yy, 0.0, h - 1.0)
+        xx = jnp.clip(xx, 0.0, w - 1.0)
+        y0i = jnp.floor(yy).astype(jnp.int32)
+        x0i = jnp.floor(xx).astype(jnp.int32)
+        y1i = jnp.minimum(y0i + 1, h - 1)
+        x1i = jnp.minimum(x0i + 1, w - 1)
+        wy = yy - y0i
+        wx = xx - x0i
+        v00 = feat[:, y0i, x0i]
+        v01 = feat[:, y0i, x1i]
+        v10 = feat[:, y1i, x0i]
+        v11 = feat[:, y1i, x1i]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    vals = bilinear(ys2, xs2)            # (C, ph, pw, sr, sr)
+    return vals.mean(axis=(-1, -2))
+
+
+def ROIAlign(data, rois, pooled_size=(7, 7), spatial_scale=1.0,
+             sample_ratio=-1, position_sensitive=False, aligned=False):
+    """ROI Align (reference: src/operator/contrib/roi_align.cc).
+
+    data: (B, C, H, W); rois: (R, 5) rows [batch_idx, x0, y0, x1, y1].
+    Returns (R, C, ph, pw). Vectorised bilinear gather — XLA lowers the
+    gathers; sample grid is static (sample_ratio<=0 -> 2x2).
+    """
+    if position_sensitive:
+        raise MXNetError("position_sensitive ROIAlign not supported")
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def fn(d, r):
+        off = 0.5 if aligned else 0.0
+
+        def one(roi):
+            b = roi[0].astype(jnp.int32).clip(0, d.shape[0] - 1)
+            feat = d[b]
+            return _roi_align_one(feat, roi[1:5] - off / spatial_scale,
+                                  ph, pw, spatial_scale, sample_ratio)
+
+        return jax.vmap(one)(r)
+
+    return apply_nary(fn, [data, rois], name="ROIAlign")
+
+
+def ROIPooling(data, rois, pooled_size=(7, 7), spatial_scale=1.0):
+    """Max ROI pooling (reference: src/operator/roi_pooling.cc)."""
+    ph, pw = (pooled_size if isinstance(pooled_size, (tuple, list))
+              else (pooled_size, pooled_size))
+
+    def fn(d, r):
+        _, _, h, w = d.shape
+
+        def one(roi):
+            b = roi[0].astype(jnp.int32).clip(0, d.shape[0] - 1)
+            feat = d[b]
+            x0 = jnp.round(roi[1] * spatial_scale)
+            y0 = jnp.round(roi[2] * spatial_scale)
+            x1 = jnp.round(roi[3] * spatial_scale)
+            y1 = jnp.round(roi[4] * spatial_scale)
+            rw = jnp.maximum(x1 - x0 + 1, 1.0)
+            rh = jnp.maximum(y1 - y0 + 1, 1.0)
+            ys = jnp.arange(h)
+            xs = jnp.arange(w)
+            py = jnp.floor((ys - y0) / (rh / ph))
+            px = jnp.floor((xs - x0) / (rw / pw))
+            inside_y = (ys >= y0) & (ys <= y1)
+            inside_x = (xs >= x0) & (xs <= x1)
+            bins_y = jnp.where(inside_y, py, -1).clip(-1, ph - 1)
+            bins_x = jnp.where(inside_x, px, -1).clip(-1, pw - 1)
+            onehot_y = bins_y[:, None] == jnp.arange(ph)[None, :]
+            onehot_x = bins_x[:, None] == jnp.arange(pw)[None, :]
+            masked = jnp.where(
+                onehot_y[None, :, None, :, None] &
+                onehot_x[None, None, :, None, :],
+                feat[:, :, :, None, None], -jnp.inf)
+            out = masked.max(axis=(1, 2))
+            return jnp.where(jnp.isfinite(out), out, 0.0)
+
+        return jax.vmap(one)(r)
+
+    return apply_nary(fn, [data, rois], name="ROIPooling")
+
+
+def MultiBoxPrior(data, sizes=(1.0,), ratios=(1.0,), clip=False,
+                  steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+    """Anchor generation (reference: src/operator/contrib/multibox_prior.cc).
+
+    data: (B, C, H, W) feature map -> (1, H*W*(S+R-1), 4) corner anchors in
+    [0,1] image coords.
+    """
+    sizes = tuple(float(s) for s in sizes)
+    ratios = tuple(float(r) for r in ratios)
+
+    def fn(d):
+        h, w = d.shape[-2], d.shape[-1]
+        step_y = steps[0] if steps[0] > 0 else 1.0 / h
+        step_x = steps[1] if steps[1] > 0 else 1.0 / w
+        cy = (jnp.arange(h) + offsets[0]) * step_y
+        cx = (jnp.arange(w) + offsets[1]) * step_x
+        # anchor shapes: sizes with ratio[0], plus ratios[1:] with size[0]
+        ws, hs = [], []
+        for s in sizes:
+            ws.append(s * math_sqrt(ratios[0]))
+            hs.append(s / math_sqrt(ratios[0]))
+        for r in ratios[1:]:
+            ws.append(sizes[0] * math_sqrt(r))
+            hs.append(sizes[0] / math_sqrt(r))
+        ws = jnp.asarray(ws)
+        hs = jnp.asarray(hs)
+        cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+        cyg = cyg[..., None]
+        cxg = cxg[..., None]
+        out = jnp.stack([cxg - ws / 2, cyg - hs / 2,
+                         cxg + ws / 2, cyg + hs / 2], axis=-1)
+        out = out.reshape(1, -1, 4)
+        if clip:
+            out = out.clip(0.0, 1.0)
+        return out
+
+    return apply_nary(fn, [data], name="MultiBoxPrior")
+
+
+def math_sqrt(x):
+    return float(x) ** 0.5
+
+
+def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
+                   ignore_label=-1.0, negative_mining_ratio=3.0,
+                   negative_mining_thresh=0.5, minimum_negative_samples=0,
+                   variances=(0.1, 0.1, 0.2, 0.2)):
+    """SSD training targets (reference: multibox_target.cc).
+
+    anchor: (1, N, 4) corner; label: (B, M, 5) rows [cls, x0, y0, x1, y1]
+    with cls=-1 padding; cls_pred: (B, num_cls+1, N).
+    Returns (box_target (B, N*4), box_mask (B, N*4), cls_target (B, N)).
+    """
+    variances = jnp.asarray(variances)
+
+    def fn(anc, lab, pred):
+        anc = anc[0]
+        n = anc.shape[0]
+
+        def one(lb, pr):
+            gt_valid = lb[:, 0] >= 0
+            iou = _pairwise_iou(anc, lb[:, 1:5])     # (N, M)
+            iou = jnp.where(gt_valid[None, :], iou, 0.0)
+            best_gt = jnp.argmax(iou, axis=1)
+            best_iou = jnp.max(iou, axis=1)
+            pos = best_iou >= overlap_threshold
+            # force-match: each valid gt claims its best anchor
+            best_anchor = jnp.argmax(iou, axis=0)    # (M,)
+            m = lb.shape[0]
+            forced = jnp.zeros(n, bool).at[best_anchor].max(gt_valid)
+            pos = pos | forced
+            best_gt = jnp.where(
+                forced,
+                jnp.zeros_like(best_gt).at[best_anchor].set(jnp.arange(m)),
+                best_gt)
+            g = lb[best_gt.clip(0), 1:5]
+            aw = anc[:, 2] - anc[:, 0]
+            ah = anc[:, 3] - anc[:, 1]
+            ax = (anc[:, 0] + anc[:, 2]) / 2
+            ay = (anc[:, 1] + anc[:, 3]) / 2
+            gw = g[:, 2] - g[:, 0]
+            gh = g[:, 3] - g[:, 1]
+            gx = (g[:, 0] + g[:, 2]) / 2
+            gy = (g[:, 1] + g[:, 3]) / 2
+            t = jnp.stack([(gx - ax) / jnp.maximum(aw, 1e-12) / variances[0],
+                           (gy - ay) / jnp.maximum(ah, 1e-12) / variances[1],
+                           jnp.log(jnp.maximum(gw, 1e-12) /
+                                   jnp.maximum(aw, 1e-12)) / variances[2],
+                           jnp.log(jnp.maximum(gh, 1e-12) /
+                                   jnp.maximum(ah, 1e-12)) / variances[3]],
+                          axis=-1)
+            box_target = jnp.where(pos[:, None], t, 0.0).reshape(-1)
+            box_mask = jnp.where(pos[:, None],
+                                 jnp.ones_like(t), 0.0).reshape(-1)
+            cls_target = jnp.where(pos, lb[best_gt.clip(0), 0] + 1, 0.0)
+            # hard negative mining: keep top (ratio * num_pos) background by
+            # max non-background confidence
+            if negative_mining_ratio > 0:
+                bg_conf = 1.0 - jax.nn.softmax(pr, axis=0)[0]
+                neg_score = jnp.where(pos, -jnp.inf, bg_conf)
+                num_pos = jnp.sum(pos)
+                max_neg = jnp.maximum(
+                    (negative_mining_ratio * num_pos).astype(jnp.int32),
+                    minimum_negative_samples)
+                rank = jnp.argsort(jnp.argsort(-neg_score))
+                keep_neg = (rank < max_neg) & ~pos
+                cls_target = jnp.where(pos | keep_neg, cls_target,
+                                       ignore_label)
+            return box_target, box_mask, cls_target
+
+        bt, bm, ct = jax.vmap(one)(lab, pred)
+        return bt, bm, ct
+
+    return apply_nary(fn, [anchor, label, cls_pred], n_out=3,
+                      name="MultiBoxTarget")
+
+
+def MultiBoxDetection(cls_prob, loc_pred, anchor, clip=True, threshold=0.01,
+                      background_id=0, nms_threshold=0.5, force_suppress=False,
+                      variances=(0.1, 0.1, 0.2, 0.2), nms_topk=-1):
+    """SSD decode + NMS (reference: multibox_detection.cc).
+
+    cls_prob: (B, num_cls+1, N); loc_pred: (B, N*4); anchor: (1, N, 4).
+    Returns (B, N, 6) rows [cls_id, score, x0, y0, x1, y1]; invalid rows have
+    cls_id = -1.
+    """
+    variances = jnp.asarray(variances)
+
+    def fn(cp, lp, anc):
+        anc = anc[0]
+        n = anc.shape[0]
+
+        def one(p, loc):
+            t = loc.reshape(n, 4) * variances
+            aw = anc[:, 2] - anc[:, 0]
+            ah = anc[:, 3] - anc[:, 1]
+            ax = (anc[:, 0] + anc[:, 2]) / 2
+            ay = (anc[:, 1] + anc[:, 3]) / 2
+            ox = t[:, 0] * aw + ax
+            oy = t[:, 1] * ah + ay
+            ow = jnp.exp(t[:, 2]) * aw / 2
+            oh = jnp.exp(t[:, 3]) * ah / 2
+            boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+            if clip:
+                boxes = boxes.clip(0.0, 1.0)
+            score = jnp.max(
+                jnp.where(jnp.arange(p.shape[0])[:, None] == background_id,
+                          -jnp.inf, p), axis=0)
+            cls_id = jnp.argmax(
+                jnp.where(jnp.arange(p.shape[0])[:, None] == background_id,
+                          -jnp.inf, p), axis=0).astype(boxes.dtype) - \
+                (1.0 if background_id == 0 else 0.0)
+            cls_id = jnp.where(score > threshold, cls_id, -1.0)
+            return jnp.concatenate(
+                [cls_id[:, None], score[:, None], boxes], axis=-1)
+
+        dets = jax.vmap(one)(cp, lp)
+        return dets
+
+    out = apply_nary(fn, [cls_prob, loc_pred, anchor], name="MultiBoxDecode")
+    out = box_nms(out, overlap_thresh=nms_threshold, valid_thresh=threshold,
+                  topk=nms_topk, coord_start=2, score_index=1, id_index=0,
+                  force_suppress=force_suppress)
+    return out
+
+
+def getnnz(data, axis=None):
+    """Count non-zeros (reference: contrib nnz for CSR)."""
+    def fn(d):
+        return jnp.sum(d != 0, axis=axis).astype(jnp.int64)
+    return apply_nary(fn, [data], name="getnnz")
+
+
+def quantize(data, min_range, max_range, out_type="uint8"):
+    """Affine-quantize a tensor (reference: src/operator/quantization/)."""
+    def fn(d, lo, hi):
+        if out_type == "uint8":
+            qmin, qmax = 0.0, 255.0
+        else:
+            qmin, qmax = -127.0, 127.0
+        scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
+        q = jnp.clip(jnp.round((d - lo) * scale + qmin), qmin, qmax)
+        return q.astype(jnp.uint8 if out_type == "uint8" else jnp.int8)
+    return apply_nary(fn, [data, min_range, max_range], name="quantize")
+
+
+def arange_like(data, start=0.0, step=1.0, axis=None):
+    def fn(d):
+        n = d.size if axis is None else d.shape[axis]
+        return start + step * jnp.arange(n, dtype=d.dtype)
+    return apply_nary(fn, [data], name="arange_like")
+
+
+def fused_gelu(data):
+    def fn(d):
+        return jax.nn.gelu(d, approximate=False)
+    return apply_nary(fn, [data], name="fused_gelu")
+
+
+def BilinearResize2D(data, height=None, width=None, scale_height=None,
+                     scale_width=None, like=None, mode="size",
+                     align_corners=False):
+    """Bilinear resize on NCHW (reference: src/operator/contrib/
+    bilinear_resize.cc). Lowers to jax.image.resize (XLA gather+dot)."""
+    if like is not None:
+        height, width = like.shape[2], like.shape[3]
+
+    def fn(d):
+        h = height if height is not None else int(d.shape[2] * scale_height)
+        w = width if width is not None else int(d.shape[3] * scale_width)
+        return jax.image.resize(d, d.shape[:2] + (h, w), method="bilinear")
+
+    return apply_nary(fn, [data], name="BilinearResize2D")
+
+
+def AdaptiveAvgPooling2D(data, output_size=1):
+    """Adaptive average pool to a target (H, W) (reference:
+    src/operator/contrib/adaptive_avg_pooling.cc)."""
+    oh, ow = (output_size if isinstance(output_size, (tuple, list))
+              else (output_size, output_size))
+
+    def fn(d):
+        b, c, h, w = d.shape
+        # split H/W into oh/ow nearly-equal bins (static python loop)
+        rows = [d[:, :, (i * h) // oh:((i + 1) * h + oh - 1) // oh or 1, :]
+                .mean(axis=2, keepdims=True) for i in range(oh)]
+        col = jnp.concatenate(rows, axis=2)
+        cols = [col[:, :, :, (j * w) // ow:((j + 1) * w + ow - 1) // ow or 1]
+                .mean(axis=3, keepdims=True) for j in range(ow)]
+        return jnp.concatenate(cols, axis=3)
+
+    return apply_nary(fn, [data], name="AdaptiveAvgPooling2D")
