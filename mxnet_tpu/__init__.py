@@ -27,6 +27,9 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+from ._dist_init import maybe_init_distributed as _maybe_init_distributed
+_maybe_init_distributed()   # must precede any jax computation
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus
@@ -61,6 +64,8 @@ from . import parallel
 # jax.numpy already provides numpy semantics; expose it under the mx.np name.
 import jax.numpy as np  # noqa: F401
 from . import npx  # noqa: F401
+from . import amp  # noqa: F401
+from . import contrib  # noqa: F401
 
 
 def __getattr__(name):

@@ -1,0 +1,32 @@
+"""Multi-process rendezvous, run before any JAX computation.
+
+Reference contract: ps-lite rendezvous happens when the first KVStore is
+created from DMLC_* env (SURVEY.md §3.5). JAX's coordination service must
+instead be up BEFORE the backend initializes, so this runs at package
+import when tools/launch.py (or an operator) set the MXTPU_* env.
+"""
+from __future__ import annotations
+
+import os
+
+_DONE = False
+
+
+def maybe_init_distributed():
+    global _DONE
+    if _DONE:
+        return
+    coord = os.environ.get("MXTPU_COORDINATOR")
+    nproc = int(os.environ.get("MXTPU_NUM_PROCESSES", "1"))
+    if coord and nproc > 1:
+        # only latch once an actual init was attempted, so a store created
+        # before the env is set still triggers rendezvous later
+        _DONE = True
+        import jax
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=nproc,
+                process_id=int(os.environ.get("MXTPU_PROCESS_ID", "0")))
+        except RuntimeError:
+            pass    # operator initialized it already

@@ -74,12 +74,13 @@ class trace_scope:
 class Node:
     """One recorded op: inputs, pullback, and per-output cotangent slots."""
 
-    __slots__ = ("inputs", "vjp_fn", "n_out", "out_grads", "out_protos",
-                 "order", "name", "__weakref__")
+    __slots__ = ("inputs", "vjp_fn", "fn", "n_out", "out_grads",
+                 "out_protos", "order", "name", "__weakref__")
 
-    def __init__(self, inputs, vjp_fn, outs, order, name=""):
+    def __init__(self, inputs, vjp_fn, outs, order, name="", fn=None):
         self.inputs = inputs            # list[NDArray]
         self.vjp_fn = vjp_fn
+        self.fn = fn                    # pure forward, kept for replay
         self.n_out = len(outs)
         self.out_grads = [None] * self.n_out
         self.out_protos = [(o.shape, o.dtype) for o in outs]
@@ -105,7 +106,7 @@ def apply_op(fn, inputs, n_out=1, name=""):
         if n_out == 1:
             outs = (outs,)
         _STATE.counter += 1
-        node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name)
+        node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name, fn=fn)
         return outs, node
     outs = fn(*datas)
     if n_out == 1:
@@ -217,10 +218,60 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
         node.out_grads = [None] * node.n_out
         if not retain_graph:
             node.vjp_fn = None
+            node.fn = None      # also blocks replay_function on this graph
             node.inputs = []
 
     for arr, g in leaf_grads.values():
         _apply_grad_req(arr, g)
+
+
+def replay_function(heads, variables):
+    """Rebuild the pure function variables -> heads from the recorded tape.
+
+    The higher-order-grad path (reference: MXAutogradBackwardEx with
+    create_graph, python/mxnet/autograd.py grad()): the imperative tape is
+    replayed as a pure jax function so ``jax.vjp`` of it can itself be
+    recorded as one tape op — grad-of-grad then falls out of jax's ability
+    to differentiate through vjp. Requires nodes that still hold their
+    forward ``fn`` (i.e. recorded in this scope, not consumed by a
+    non-retaining backward).
+    """
+    reachable = {}
+    stack = [h._node for h in heads if h._node is not None]
+    while stack:
+        node = stack.pop()
+        if node is None or id(node) in reachable:
+            continue
+        if node.fn is None:
+            raise MXNetError(
+                "graph was consumed by a previous backward; pass "
+                "retain_graph=True / create_graph=True on the earlier call")
+        reachable[id(node)] = node
+        for inp in node.inputs:
+            if inp._node is not None:
+                stack.append(inp._node)
+    order = sorted(reachable.values(), key=lambda n: n.order)
+    var_ids = {id(v): i for i, v in enumerate(variables)}
+
+    def f(*var_datas):
+        out_cache = {}
+
+        def val(arr):
+            if id(arr) in var_ids:
+                return var_datas[var_ids[id(arr)]]
+            n = arr._node
+            if n is not None and id(n) in out_cache:
+                return out_cache[id(n)][arr._out_index]
+            return arr._data
+
+        for node in order:
+            outs = node.fn(*[val(i) for i in node.inputs])
+            if node.n_out == 1:
+                outs = (outs,)
+            out_cache[id(node)] = outs
+        return tuple(val(h) for h in heads)
+
+    return f
 
 
 def _apply_grad_req(arr, g):

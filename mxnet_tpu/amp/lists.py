@@ -1,0 +1,30 @@
+"""AMP op lists (reference: python/mxnet/contrib/amp/lists/symbol.py).
+
+Three classes, MXNet's scheme:
+- TARGET_DTYPE_OPS: run in the low-precision target dtype (bf16 on TPU —
+  these are the MXU ops where reduced precision buys throughput).
+- FP32_OPS: numerically sensitive; inputs are cast up to float32.
+- WIDEST_TYPE_CASTS: multi-input ops whose inputs are cast to the widest
+  dtype among them (e.g. elementwise add of bf16 + fp32).
+Everything unlisted runs in whatever dtype arrives.
+"""
+
+# MXU-bound ops: matmuls / convs / rnn — the fp16 whitelist of the reference
+TARGET_DTYPE_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "dot", "batch_dot",
+    "linalg_gemm2", "RNN",
+]
+
+# the reference's fp32 blacklist: softmax family, norms, losses, exp/log/pow
+FP32_OPS = [
+    "softmax", "log_softmax", "softmin", "SoftmaxActivation", "SoftmaxOutput",
+    "softmax_cross_entropy", "BatchNorm", "LayerNorm", "InstanceNorm",
+    "L2Normalization", "norm", "exp", "log", "log2", "log10", "expm1",
+    "log1p", "erf", "gamma", "gammaln", "smooth_l1", "mean", "sum", "nansum",
+    "prod", "nanprod", "cumsum",
+]
+
+WIDEST_TYPE_CASTS = [
+    "add_n", "concat", "stack", "where", "broadcast_add", "broadcast_sub",
+    "broadcast_mul", "broadcast_div",
+]

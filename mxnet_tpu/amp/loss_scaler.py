@@ -1,0 +1,42 @@
+"""Dynamic loss scaler (reference: python/mxnet/contrib/amp/loss_scaler.py).
+
+On TPU the target dtype is bfloat16, whose exponent range equals fp32 —
+loss scaling is then a no-op (scale pinned to 1). For fp16 the classic
+dynamic scheme applies: double every `scale_window` clean steps, halve on
+overflow and skip the update.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+
+class LossScaler:
+    def __init__(self, init_scale=2.0 ** 16, scale_factor=2.0,
+                 scale_window=2000, dynamic=True):
+        self.loss_scale = float(init_scale)
+        self._scale_factor = scale_factor
+        self._scale_window = scale_window
+        self._dynamic = dynamic
+        self._unskipped = 0
+
+    def has_overflow(self, params):
+        """True if any present gradient is non-finite."""
+        import jax.numpy as jnp
+        for p in params:
+            if p._data is not None and p._data._grad is not None:
+                if not bool(jnp.isfinite(p._data._grad).all()):
+                    return True
+        return False
+
+    def update_scale(self, overflow):
+        if not self._dynamic:
+            return
+        if overflow:
+            self.loss_scale = max(self.loss_scale / self._scale_factor, 1.0)
+            self._unskipped = 0
+        else:
+            self._unskipped += 1
+            if self._unskipped >= self._scale_window:
+                self.loss_scale = min(self.loss_scale * self._scale_factor,
+                                      2.0 ** 24)
+                self._unskipped = 0

@@ -8,6 +8,9 @@ from __future__ import annotations
 
 from contextlib import contextmanager
 
+import jax
+import jax.numpy as jnp
+
 from . import _tape
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
@@ -81,15 +84,32 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
     """Compute and RETURN grads of heads wrt variables (does not touch .grad).
 
     Reference: python/mxnet/autograd.py grad(). ``create_graph=True``
-    (higher-order) is not supported by the v1 tape — use jax.grad composition
-    via hybridized blocks for higher-order needs.
+    replays the recorded subgraph as a pure jax function and records its
+    vjp as one tape op, so the returned grads are differentiable again
+    (higher-order; jax differentiates through vjp natively).
     """
-    if create_graph:
-        raise MXNetError(
-            "create_graph=True is not supported by the imperative tape; "
-            "compose jax.grad over a hybridized block instead")
     single = isinstance(variables, NDArray)
     var_list = [variables] if single else list(variables)
+    if create_graph:
+        heads_list = [heads] if isinstance(heads, NDArray) else list(heads)
+        if head_grads is None:
+            seeds = [jnp.ones(h.shape, h.dtype) for h in heads_list]
+        else:
+            hg = [head_grads] if isinstance(head_grads, NDArray) \
+                else list(head_grads)
+            seeds = [g._data for g in hg]
+        f = _tape.replay_function(heads_list, var_list)
+
+        def grad_fn(*var_datas):
+            _, pull = jax.vjp(f, *var_datas)
+            g = pull(tuple(seeds))
+            return g if len(var_list) > 1 else g[0]
+
+        from .ndarray.ndarray import apply_nary
+        outs = apply_nary(grad_fn, var_list, n_out=len(var_list),
+                          name="grad")
+        outs = outs if isinstance(outs, list) else [outs]
+        return outs[0] if single else outs
     # stash current grads/reqs, run a scoped backward, then restore
     saved = [(v._grad, v._grad_req) for v in var_list]
     for v in var_list:

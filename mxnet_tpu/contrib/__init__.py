@@ -1,0 +1,8 @@
+"""``mx.contrib`` — contrib namespace (reference: python/mxnet/contrib/).
+
+amp and onnx live at their reference paths; quantization is here; the
+contrib *operators* are under ``mx.nd.contrib``.
+"""
+from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
+from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401

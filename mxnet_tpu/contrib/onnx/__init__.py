@@ -1,0 +1,47 @@
+"""``mx.contrib.onnx`` — ONNX import/export.
+
+Reference: python/mxnet/contrib/onnx/{onnx2mx,mx2onnx}/ (SURVEY.md §2.2).
+The `onnx` pip package is not in this image, so the converters are gated:
+they raise a clear ImportError at call time (same pattern as the reference,
+which requires `pip install onnx`). `export_model` additionally offers the
+TPU-native path: StableHLO export via HybridBlock.export(), which covers
+the reference's main use of ONNX (deploy a trained graph).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+
+__all__ = ["import_model", "export_model", "get_model_metadata"]
+
+
+def _require_onnx():
+    try:
+        import onnx  # noqa: F401
+        return onnx
+    except ImportError as e:
+        raise ImportError(
+            "ONNX support requires the `onnx` package (reference behavior: "
+            "python/mxnet/contrib/onnx checks the same). For TPU-native "
+            "deployment use HybridBlock.export() which writes StableHLO + "
+            "params instead.") from e
+
+
+def import_model(model_file):
+    """Reference: onnx_mxnet.import_model -> (sym, arg_params, aux_params)."""
+    _require_onnx()
+    raise MXNetError("ONNX graph conversion to the TPU op registry is not "
+                     "implemented yet; load reference .params checkpoints "
+                     "via mx.nd.load / Block.load_parameters instead.")
+
+
+def export_model(sym, params, input_shape, input_type=None,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Reference: export_model. Gated on the `onnx` package."""
+    _require_onnx()
+    raise MXNetError("ONNX export is not implemented; use "
+                     "HybridBlock.export() (StableHLO + params).")
+
+
+def get_model_metadata(model_file):
+    _require_onnx()
+    raise MXNetError("ONNX metadata parsing is not implemented.")

@@ -1,0 +1,271 @@
+"""INT8 post-training quantization.
+
+Reference: python/mxnet/contrib/quantization.py (+ src/operator/quantization/
+for the int8 kernels, SURVEY.md §2.2 "Quantization"): calibrate activation
+ranges (naive min/max or KL-entropy), then run conv/fc in int8.
+
+TPU-first: the int8 compute path is ``lax.dot_general(int8, int8,
+preferred_element_type=int32)`` — XLA lowers this straight onto the MXU's
+8-bit mode, so the quantized matmul is native, not emulated. Weights are
+quantized per-output-channel symmetric; activations per-tensor affine from
+the calibration thresholds.
+
+Gluon-level API (the reference's 1.6-era `quantize_net`): walk the block
+tree, swap `nn.Dense` / `nn.Conv2D` for quantized twins.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["quantize_net", "calib_thresholds", "QuantizedDense",
+           "QuantizedConv2D", "optimal_threshold_kl"]
+
+
+def _quant_params_symmetric(w, axis=None):
+    """Per-channel symmetric int8 scale for weights: s = max|w| / 127."""
+    import jax.numpy as jnp
+    amax = jnp.max(jnp.abs(w), axis=axis, keepdims=axis is not None)
+    scale = jnp.maximum(amax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def optimal_threshold_kl(hist, hist_edges, num_quantized_bins=255):
+    """KL-divergence calibration threshold (reference:
+    _LayerHistogramCollector / _get_optimal_threshold): pick the clip
+    threshold whose quantized distribution best matches the original.
+    Pure numpy — runs on host once, offline."""
+    hist = _np.asarray(hist, dtype=_np.float64)
+    num_bins = len(hist)
+    if num_bins < num_quantized_bins + 2:
+        return float(hist_edges[-1])
+    zero_bin = num_bins // 2
+    best_kl, best_t = _np.inf, float(hist_edges[-1])
+    # threshold sweep: symmetric windows growing from the center
+    for i in range(num_quantized_bins // 2 + 1, num_bins // 2 + 1):
+        lo, hi = zero_bin - i, zero_bin + i
+        p = hist[lo:hi].copy()
+        outliers = hist[:lo].sum() + hist[hi:].sum()
+        if p.sum() == 0:
+            continue
+        p[0] += hist[:lo].sum()
+        p[-1] += hist[hi:].sum()
+        # quantize p into num_quantized_bins, then expand back
+        factor = len(p) / num_quantized_bins
+        q = _np.zeros_like(p)
+        for j in range(num_quantized_bins):
+            start = int(j * factor)
+            stop = max(int((j + 1) * factor), start + 1)
+            chunk = p[start:stop]
+            nz = (chunk > 0).sum()
+            if nz:
+                q[start:stop] = _np.where(chunk > 0, chunk.sum() / nz, 0)
+        pm = p / p.sum()
+        qm = q / q.sum() if q.sum() else q
+        mask = (pm > 0) & (qm > 0)
+        if not mask.any():
+            continue
+        kl = float((pm[mask] * _np.log(pm[mask] / qm[mask])).sum())
+        if kl < best_kl:
+            best_kl = kl
+            best_t = float(hist_edges[hi])
+    return best_t
+
+
+class _Collector:
+    """Forward-hook activation range collector (naive or entropy mode)."""
+
+    def __init__(self, mode="naive", num_bins=2001):
+        self.mode = mode
+        self.num_bins = num_bins
+        self.ranges = {}      # block -> (min, max) or histogram
+        self.hists = {}
+
+    def hook(self, block):
+        def pre_hook(blk, args):
+            x = args[0]
+            v = _np.asarray(x.asnumpy(), dtype=_np.float64)
+            if self.mode == "naive":
+                lo, hi = float(v.min()), float(v.max())
+                old = self.ranges.get(blk)
+                if old:
+                    lo, hi = min(lo, old[0]), max(hi, old[1])
+                self.ranges[blk] = (lo, hi)
+            else:
+                amax = float(_np.abs(v).max()) or 1e-8
+                hist, edges = _np.histogram(v, bins=self.num_bins,
+                                            range=(-amax, amax))
+                old = self.hists.get(blk)
+                if old is not None and len(old[0]) == len(hist) and \
+                        old[1][-1] >= edges[-1]:
+                    self.hists[blk] = (old[0] + hist, old[1])
+                else:
+                    self.hists[blk] = (hist, edges)
+        return pre_hook
+
+    def threshold(self, blk):
+        if self.mode == "naive":
+            lo, hi = self.ranges[blk]
+            return max(abs(lo), abs(hi))
+        hist, edges = self.hists[blk]
+        return optimal_threshold_kl(hist, edges)
+
+
+class QuantizedDense(HybridBlock):
+    """int8 x int8 -> int32 Dense (reference: quantized_fully_connected)."""
+
+    def __init__(self, dense, act_threshold, **kwargs):
+        super().__init__(**kwargs)
+        import jax.numpy as jnp
+        w = dense.weight.data().data.astype(jnp.float32)
+        self._qw, self._w_scale = _quant_params_symmetric(w, axis=1)
+        self._bias = (dense.bias.data().data
+                      if dense.bias is not None else None)
+        self._act_scale = float(act_threshold) / 127.0
+        self._units = dense._units if hasattr(dense, "_units") else w.shape[0]
+        self._act_type = getattr(dense, "_act_type", None)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray.ndarray import apply_nary
+        qw, w_scale, a_scale = self._qw, self._w_scale, self._act_scale
+        bias, act = self._bias, self._act_type
+
+        def fn(d):
+            flat = d.reshape(d.shape[0], -1)
+            qx = jnp.clip(jnp.round(flat / a_scale), -127, 127) \
+                .astype(jnp.int8)
+            acc = lax.dot_general(
+                qx, qw, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (a_scale * w_scale.reshape(1, -1))
+            if bias is not None:
+                out = out + bias
+            if act == "relu":
+                out = jnp.maximum(out, 0)
+            return out
+
+        return apply_nary(fn, [x], name="quantized_dense")
+
+
+class QuantizedConv2D(HybridBlock):
+    """int8 conv -> int32 accum (reference: quantized_conv)."""
+
+    def __init__(self, conv, act_threshold, **kwargs):
+        super().__init__(**kwargs)
+        import jax.numpy as jnp
+        w = conv.weight.data().data.astype(jnp.float32)   # (O, I, kh, kw)
+        self._qw, self._w_scale = _quant_params_symmetric(
+            w, axis=(1, 2, 3))
+        self._bias = (conv.bias.data().data
+                      if getattr(conv, "bias", None) is not None else None)
+        self._act_scale = float(act_threshold) / 127.0
+        self._kwargs = dict(getattr(conv, "_kwargs", {}))
+        self._stride = self._kwargs.get("stride", (1, 1))
+        self._pad = self._kwargs.get("pad", (0, 0))
+        self._dilate = self._kwargs.get("dilate", (1, 1))
+        self._groups = self._kwargs.get("num_group", 1)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from jax import lax
+        from ..ndarray.ndarray import apply_nary
+        qw, w_scale, a_scale = self._qw, self._w_scale, self._act_scale
+        bias = self._bias
+        stride, pad, dilate = self._stride, self._pad, self._dilate
+        groups = self._groups
+
+        def fn(d):
+            qx = jnp.clip(jnp.round(d / a_scale), -127, 127) \
+                .astype(jnp.int8)
+            dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
+                                            ("NCHW", "OIHW", "NCHW"))
+            acc = lax.conv_general_dilated(
+                qx, qw, window_strides=tuple(stride),
+                padding=[(p, p) for p in pad],
+                rhs_dilation=tuple(dilate), dimension_numbers=dn,
+                feature_group_count=groups,
+                preferred_element_type=jnp.int32)
+            scale = (a_scale * w_scale.reshape(1, -1, 1, 1))
+            out = acc.astype(jnp.float32) * scale
+            if bias is not None:
+                out = out + bias.reshape(1, -1, 1, 1)
+            return out
+
+        return apply_nary(fn, [x], name="quantized_conv")
+
+
+def calib_thresholds(net, calib_data, calib_mode="naive", num_batches=10):
+    """Run calibration forwards, return {block: threshold}."""
+    from .. import _tape
+    collector = _Collector(mode=("naive" if calib_mode == "naive"
+                                 else "entropy"))
+    targets = [b for b in _walk(net)
+               if isinstance(b, (nn.Dense, nn.Conv2D))]
+    handles = []
+    for b in targets:
+        h = collector.hook(b)
+        b._forward_pre_hooks.append(h)
+        handles.append((b, h))
+    prev = _tape.set_training(False)
+    try:
+        for i, batch in enumerate(calib_data):
+            if i >= num_batches:
+                break
+            x = batch[0] if isinstance(batch, (tuple, list)) else batch
+            net(x)
+    finally:
+        _tape.set_training(prev)
+        for b, h in handles:
+            b._forward_pre_hooks.remove(h)
+    return {b: collector.threshold(b) for b in targets
+            if b in collector.ranges or b in collector.hists}
+
+
+def _walk(block):
+    yield block
+    for child in block._children.values():
+        yield from _walk(child)
+
+
+def quantize_net(network, calib_data=None, calib_mode="naive",
+                 quantized_dtype="int8", exclude_layers=None,
+                 num_calib_batches=10):
+    """Quantize a Gluon net in place (reference: quantization.quantize_net).
+
+    Replaces Dense/Conv2D children with int8 twins using calibrated
+    activation thresholds. Blocks listed in `exclude_layers` (by name) and
+    blocks never seen in calibration keep fp32.
+    """
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("only int8 quantization is supported on TPU "
+                         "(uint8 has no MXU advantage)")
+    if calib_data is None:
+        raise MXNetError("calib_data is required (post-training "
+                         "calibration)")
+    exclude = set(exclude_layers or [])
+    thresholds = calib_thresholds(network, calib_data, calib_mode,
+                                  num_calib_batches)
+
+    def convert(block):
+        for name, child in list(block._children.items()):
+            if child in thresholds and name not in exclude and \
+                    child.weight._data is not None:
+                if isinstance(child, nn.Dense):
+                    q = QuantizedDense(child, thresholds[child])
+                elif isinstance(child, nn.Conv2D):
+                    q = QuantizedConv2D(child, thresholds[child])
+                else:
+                    continue
+                block._children[name] = q
+                if hasattr(block, name):
+                    object.__setattr__(block, name, q)
+            else:
+                convert(child)
+    convert(network)
+    return network

@@ -398,15 +398,18 @@ def MultiBoxTarget(anchor, label, cls_pred, overlap_threshold=0.5,
             best_gt = jnp.argmax(iou, axis=1)
             best_iou = jnp.max(iou, axis=1)
             pos = best_iou >= overlap_threshold
-            # force-match: each valid gt claims its best anchor
+            # force-match: each VALID gt claims its best anchor; padded
+            # rows scatter to a dropped slot n so they can't clobber
+            # anchor 0 (their zeroed iou column argmaxes to 0)
             best_anchor = jnp.argmax(iou, axis=0)    # (M,)
             m = lb.shape[0]
-            forced = jnp.zeros(n, bool).at[best_anchor].max(gt_valid)
+            safe_anchor = jnp.where(gt_valid, best_anchor, n)
+            forced = jnp.zeros(n + 1, bool).at[safe_anchor] \
+                .set(True)[:n]
             pos = pos | forced
-            best_gt = jnp.where(
-                forced,
-                jnp.zeros_like(best_gt).at[best_anchor].set(jnp.arange(m)),
-                best_gt)
+            forced_gt = jnp.zeros(n + 1, best_gt.dtype) \
+                .at[safe_anchor].set(jnp.arange(m))[:n]
+            best_gt = jnp.where(forced, forced_gt, best_gt)
             g = lb[best_gt.clip(0), 1:5]
             aw = anc[:, 2] - anc[:, 0]
             ah = anc[:, 3] - anc[:, 1]

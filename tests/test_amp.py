@@ -1,0 +1,96 @@
+"""AMP mixed precision (reference: tests/python/unittest/test_amp.py).
+
+Checks: op-list casting (MXU ops run bf16, blacklist ops run fp32),
+end-to-end bf16 training step, fp16 dynamic loss scaling skip-on-overflow.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import amp, autograd, gluon
+
+nd = mx.nd
+
+
+@pytest.fixture
+def amp_bf16():
+    amp.init(target_dtype="bfloat16")
+    yield
+    amp._deinit_for_tests()
+
+
+@pytest.fixture
+def amp_fp16():
+    amp.init(target_dtype="float16")
+    yield
+    amp._deinit_for_tests()
+
+
+def test_target_ops_cast_down(amp_bf16):
+    a = nd.random.uniform(shape=(4, 8))
+    b = nd.random.uniform(shape=(8, 4))
+    out = nd.dot(a, b)
+    assert str(out.data.dtype) == "bfloat16"
+
+
+def test_fp32_ops_cast_up(amp_bf16):
+    x = nd.random.uniform(shape=(4, 8)).astype("bfloat16")
+    out = nd.softmax(x)
+    assert str(out.data.dtype) == "float32"
+
+
+def test_bf16_training_step(amp_bf16):
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    x = nd.random.uniform(shape=(8, 16))
+    y = nd.zeros((8,))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    with autograd.record():
+        out = net(x)
+        loss = loss_fn(out, y)
+        with amp.scale_loss(loss, trainer) as scaled:
+            scaled.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(8)
+    assert not np.allclose(net.weight.data().asnumpy(), w_before)
+
+
+def test_fp16_loss_scaler_overflow_skips_step(amp_fp16):
+    net = gluon.nn.Dense(2)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    amp.init_trainer(trainer)
+    scaler = trainer._amp_loss_scaler
+    assert scaler.loss_scale > 1.0
+    x = nd.random.uniform(shape=(4, 4))
+    with autograd.record():
+        out = net(x)
+        loss = (out * float("inf")).sum()
+        loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    s_before = scaler.loss_scale
+    trainer.step(4)
+    # overflow: weights unchanged, scale halved
+    assert np.allclose(net.weight.data().asnumpy(), w_before)
+    assert scaler.loss_scale == s_before / 2
+
+
+def test_loss_scaler_growth():
+    s = amp.LossScaler(init_scale=4.0, scale_window=2)
+    s.update_scale(False)
+    s.update_scale(False)
+    assert s.loss_scale == 8.0
+    s.update_scale(True)
+    assert s.loss_scale == 4.0
+
+
+def test_convert_hybrid_block(amp_bf16):
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    net(nd.zeros((2, 5)))
+    amp.convert_hybrid_block(net)
+    assert str(net.weight.data().data.dtype) == "bfloat16"

@@ -241,3 +241,46 @@ def test_record_without_backward_no_leak():
     del out
     gc.collect()
     assert node_ref() is None
+
+
+def test_higher_order_grad():
+    """create_graph=True: grad-of-grad through the replayed tape
+    (reference: tests/python/unittest/test_higher_order_grad.py)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        g = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        g2 = autograd.grad(g, [x])[0]
+    assert float(g.asnumpy()[0]) == 12.0     # 3x^2
+    assert float(g2.asnumpy()[0]) == 12.0    # 6x
+
+
+def test_third_order_grad():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = mx.nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x * x
+        g = autograd.grad(y, [x], create_graph=True, retain_graph=True)[0]
+        g2 = autograd.grad(g, [x], create_graph=True, retain_graph=True)[0]
+        g3 = autograd.grad(g2, [x])[0]
+    assert float(g3.asnumpy()[0]) == 48.0    # 24x
+
+
+def test_grad_multiple_variables():
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd
+    x = mx.nd.array([2.0])
+    y = mx.nd.array([3.0])
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = x * y + x
+        gx, gy = autograd.grad(z, [x, y], create_graph=True,
+                               retain_graph=True)
+    assert float(gx.asnumpy()[0]) == 4.0     # y + 1
+    assert float(gy.asnumpy()[0]) == 2.0     # x

@@ -1,0 +1,95 @@
+"""KVStore semantics (reference: tests/python/unittest/test_kvstore.py +
+nightly dist_sync_kvstore.py --gc-type 2bit for compression)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.kvstore.kvstore import GradientCompression
+
+nd = mx.nd
+
+
+def test_init_push_pull_single():
+    kv = mx.kv.create("local")
+    kv.init("a", nd.ones((4,)))
+    kv.push("a", nd.ones((4,)) * 3)
+    out = nd.zeros((4,))
+    kv.pull("a", out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_push_list_reduces():
+    kv = mx.kv.create("device")
+    kv.init(0, nd.zeros((2, 2)))
+    kv.push(0, [nd.ones((2, 2)), nd.ones((2, 2)) * 2])
+    out = nd.zeros((2, 2))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 3.0)
+
+
+def test_list_keys():
+    kv = mx.kv.create("local")
+    kv.init(["x", "y"], [nd.ones((2,)), nd.ones((3,))])
+    outs = [nd.zeros((2,)), nd.zeros((3,))]
+    kv.pull(["x", "y"], out=outs)
+    assert outs[0].shape == (2,)
+    assert outs[1].shape == (3,)
+
+
+def test_updater_applied_on_push():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((4, 4)))
+
+    def update(key, grad, weight):
+        weight -= 0.5 * grad
+
+    kv._set_updater(update)
+    kv.push(3, nd.ones((4, 4)))
+    out = nd.zeros((4, 4))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 0.5)
+
+
+def test_gradient_compression_roundtrip():
+    gc = GradientCompression(threshold=0.5)
+    g = np.array([0.7, -0.7, 0.1, -0.1, 2.0], np.float32)
+    packed, shape = gc.compress("k", mx.nd.array(g).data)
+    deq = np.asarray(gc.decompress(packed, shape))
+    np.testing.assert_allclose(deq, [0.5, -0.5, 0.0, 0.0, 0.5])
+    # error feedback: residual carries the truncated mass
+    res = np.asarray(gc._residuals["k"])
+    np.testing.assert_allclose(res, [0.2, -0.2, 0.1, -0.1, 1.5], atol=1e-6)
+    # second step: residual alone pushes 1.5 -> +0.5 again
+    packed2, _ = gc.compress("k", mx.nd.zeros((5,)).data)
+    deq2 = np.asarray(gc.decompress(packed2, shape))
+    assert deq2[4] == pytest.approx(0.5)
+
+
+def test_gradient_compression_packing_is_4x():
+    gc = GradientCompression(threshold=1.0)
+    g = mx.nd.random.uniform(-2, 2, shape=(1024,)).data
+    packed, _ = gc.compress("k", g)
+    assert packed.dtype.name == "uint8"
+    assert packed.shape == (256,)     # 4 codes per byte
+
+
+def test_kvstore_with_compression():
+    # compression applies to the cross-worker hop -> dist store only
+    # (single-process dist still exercises the pack/unpack path)
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    kv.init(0, nd.zeros((4,)))
+    kv.push(0, nd.array([1.0, -1.0, 0.2, 0.0]))
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [0.5, -0.5, 0.0, 0.0])
+
+
+def test_optimizer_on_kvstore():
+    kv = mx.kv.create("local")
+    kv.init(0, nd.ones((4,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=1.0))
+    kv.push(0, nd.ones((4,)))
+    out = nd.zeros((4,))
+    kv.pull(0, out=out)
+    assert not np.allclose(out.asnumpy(), 1.0)     # weight moved

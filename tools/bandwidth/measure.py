@@ -1,0 +1,66 @@
+#!/usr/bin/env python
+"""KVStore push/pull bandwidth microbenchmark
+(reference: tools/bandwidth/measure.py — the third BASELINE metric).
+
+Times `kv.pushpull` over ResNet-sized gradient buffers and reports GB/s
+against the device's theoretical bound. On a mesh the pushpull is the
+in-graph psum over the data axis (ICI); single-chip it measures the
+dispatch+copy floor.
+
+    python tools/bandwidth/measure.py --kv-store device --data-mb 100
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--kv-store", default="device")
+    ap.add_argument("--num-keys", type=int, default=20,
+                    help="number of gradient tensors (ResNet-50 has ~160)")
+    ap.add_argument("--data-mb", type=float, default=100.0,
+                    help="total payload size in MB")
+    ap.add_argument("--iters", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    args = ap.parse_args()
+
+    import mxnet_tpu as mx
+
+    kv = mx.kv.create(args.kv_store)
+    total_elems = int(args.data_mb * 1e6 / 4)
+    per_key = total_elems // args.num_keys
+    vals = []
+    for k in range(args.num_keys):
+        v = mx.nd.random.uniform(shape=(per_key,))
+        kv.init(k, v)
+        vals.append(v)
+
+    def run_once():
+        for k, v in enumerate(vals):
+            kv.pushpull(k, v, out=v)
+        vals[-1].wait_to_read()
+
+    for _ in range(args.warmup):
+        run_once()
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        run_once()
+    dt = time.perf_counter() - t0
+
+    total_bytes = args.iters * total_elems * 4 * 2     # push + pull
+    gbps = total_bytes / dt / 1e9
+    print(f"kvstore={kv.type} workers={kv.num_workers} "
+          f"payload={args.data_mb:.0f}MB x{args.iters} "
+          f"time={dt:.3f}s bandwidth={gbps:.2f} GB/s")
+    return gbps
+
+
+if __name__ == "__main__":
+    main()

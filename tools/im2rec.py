@@ -1,0 +1,89 @@
+#!/usr/bin/env python
+"""Pack images into RecordIO .rec files (reference: tools/im2rec.py).
+
+Two modes, like the reference:
+  --list: walk an image root, write a .lst file (index\tlabel\tpath)
+  pack  : read a .lst, write .rec/.idx with IRHeader-framed JPEG bytes
+
+The reference optionally re-encodes/resizes via OpenCV; this image has no
+cv2, so bytes are packed as-is (``--pass-through``, the recommended mode
+for TPU input pipelines anyway — decode happens in the native C++ pipeline,
+src/image_decode.cc).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+EXTS = (".jpg", ".jpeg", ".png")
+
+
+def make_list(root, prefix, train_ratio=1.0, shuffle=True, seed=42):
+    classes = sorted(d for d in os.listdir(root)
+                     if os.path.isdir(os.path.join(root, d)))
+    items = []
+    for label, cls in enumerate(classes):
+        for dirpath, _, files in os.walk(os.path.join(root, cls)):
+            for fn in files:
+                if fn.lower().endswith(EXTS):
+                    rel = os.path.relpath(os.path.join(dirpath, fn), root)
+                    items.append((rel, label))
+    if shuffle:
+        random.Random(seed).shuffle(items)
+    n_train = int(len(items) * train_ratio)
+    splits = [("train", items[:n_train])] if train_ratio < 1.0 else \
+        [("", items)]
+    if train_ratio < 1.0:
+        splits.append(("val", items[n_train:]))
+    for tag, chunk in splits:
+        name = f"{prefix}_{tag}.lst" if tag else f"{prefix}.lst"
+        with open(name, "w") as f:
+            for i, (rel, label) in enumerate(chunk):
+                f.write(f"{i}\t{label}\t{rel}\n")
+        print(f"wrote {name} ({len(chunk)} items, {len(classes)} classes)")
+
+
+def pack(lst_path, root, prefix):
+    from mxnet_tpu import recordio
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    n = 0
+    with open(lst_path) as f:
+        for line in f:
+            parts = line.strip().split("\t")
+            if len(parts) < 3:
+                continue
+            idx, label, rel = int(parts[0]), float(parts[1]), parts[-1]
+            with open(os.path.join(root, rel), "rb") as img:
+                buf = img.read()
+            header = recordio.IRHeader(0, label, idx, 0)
+            rec.write_idx(idx, recordio.pack(header, buf))
+            n += 1
+    rec.close()
+    print(f"wrote {prefix}.rec / {prefix}.idx ({n} records)")
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("prefix", help="output prefix (or .lst path when packing)")
+    ap.add_argument("root", help="image root directory")
+    ap.add_argument("--list", action="store_true",
+                    help="generate .lst instead of packing")
+    ap.add_argument("--train-ratio", type=float, default=1.0)
+    ap.add_argument("--no-shuffle", action="store_true")
+    args = ap.parse_args()
+    if args.list:
+        make_list(args.root, args.prefix, args.train_ratio,
+                  shuffle=not args.no_shuffle)
+    else:
+        lst = args.prefix if args.prefix.endswith(".lst") \
+            else args.prefix + ".lst"
+        out = lst[:-4]
+        pack(lst, args.root, out)
+
+
+if __name__ == "__main__":
+    main()

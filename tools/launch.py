@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Distributed job launcher (reference: tools/launch.py + dmlc-core tracker).
+
+The reference spawns scheduler/server/worker processes over ssh/mpi/local
+and wires them with ``DMLC_*`` env. The TPU-native rebuild has no servers:
+each host runs ONE worker process and the processes rendezvous through
+``jax.distributed`` (coordinator = worker 0). This launcher keeps the
+reference's CLI:
+
+    python tools/launch.py -n 4 --launcher local python train.py --kv-store dist_sync
+
+``--launcher local`` forks N worker processes on this machine (the
+reference's fake-cluster mode used by tests/nightly/dist_sync_kvstore.py);
+each gets JAX_PLATFORMS=cpu and a private coordinator port so the whole
+flow (rendezvous, psum over processes, barrier) runs on one box.
+``--launcher ssh`` emits the per-host command lines (zero-egress images
+cannot ssh; print instead of exec so the operator's scheduler runs them).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import sys
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI compat; the TPU "
+                         "backend has no parameter servers")
+    ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
+    ap.add_argument("-H", "--hostfile", default=None,
+                    help="one host per line (ssh launcher)")
+    ap.add_argument("--env", action="append", default=[],
+                    help="extra KEY=VALUE env for workers")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args()
+    if not args.command:
+        ap.error("no command given")
+    cmd = args.command
+
+    port = _free_port()
+    coordinator = f"127.0.0.1:{port}"
+
+    if args.launcher == "ssh":
+        hosts = []
+        if args.hostfile:
+            with open(args.hostfile) as f:
+                hosts = [h.strip() for h in f if h.strip()]
+        if not hosts:
+            hosts = [f"host{i}" for i in range(args.num_workers)]
+        coord = f"{hosts[0]}:{port}"
+        print("# zero-egress image: run these on each host")
+        for rank in range(args.num_workers):
+            env = (f"DMLC_ROLE=worker DMLC_NUM_WORKER={args.num_workers} "
+                   f"DMLC_WORKER_ID={rank} "
+                   f"MXTPU_COORDINATOR={coord} "
+                   f"MXTPU_NUM_PROCESSES={args.num_workers} "
+                   f"MXTPU_PROCESS_ID={rank}")
+            print(f"ssh {hosts[rank % len(hosts)]} '{env} "
+                  f"{' '.join(cmd)}'")
+        return 0
+
+    procs = []
+    try:
+        for rank in range(args.num_workers):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_ROLE": "worker",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_NUM_SERVER": str(args.num_servers),
+                "DMLC_WORKER_ID": str(rank),
+                "MXTPU_COORDINATOR": coordinator,
+                "MXTPU_NUM_PROCESSES": str(args.num_workers),
+                "MXTPU_PROCESS_ID": str(rank),
+                # local fake cluster runs on CPU (SURVEY.md §4 technique 3)
+                "JAX_PLATFORMS": "cpu",
+            })
+            for kv in args.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            procs.append(subprocess.Popen(cmd, env=env))
+        rc = 0
+        for p in procs:
+            rc = p.wait() or rc
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
