@@ -10,8 +10,10 @@ from .bert import *  # noqa: F401,F403
 from .transformer import *  # noqa: F401,F403
 from .language_model import *  # noqa: F401,F403
 from .sampler import *  # noqa: F401,F403
+from .llama import *  # noqa: F401,F403
 
-from . import attention, bert, transformer, language_model, sampler  # noqa
+from . import attention, bert, transformer, language_model, sampler, \
+    llama  # noqa
 
 _MODELS = {}
 for _m in (bert, transformer, language_model):

@@ -1,0 +1,256 @@
+"""Llama-family decoder LM (the BASELINE.json Llama-3-8B stretch config).
+
+No reference counterpart exists (the fork predates Llama; SURVEY.md §2.5
+lists TP/SP as new capabilities) — this is the TPU-native flagship decoder:
+RMSNorm + RoPE + grouped-query attention + SwiGLU, attention through the
+Pallas flash kernel (ops/flash_attention.py), with two scaling hooks:
+
+- tensor parallel: `tensor_parallel=True` swaps QKV/MLP projections for
+  ParallelDense (megatron column/row split over the mesh 'tp' axis; XLA
+  inserts the all-reduces from the sharding algebra).
+- context parallel: `context_parallel=True` routes attention through
+  parallel.ring_attention over the mesh 'sp' axis (neighbour ppermute of
+  K/V blocks riding the ICI ring) for sequences longer than one chip's HBM.
+"""
+from __future__ import annotations
+
+import math
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM", "RMSNorm",
+           "llama3_8b", "llama_tiny"]
+
+
+class LlamaConfig:
+    def __init__(self, vocab_size=128256, hidden_size=4096,
+                 intermediate_size=14336, num_layers=32, num_heads=32,
+                 num_kv_heads=8, max_seq_len=8192, rope_theta=500000.0,
+                 rms_eps=1e-5, tie_embeddings=False,
+                 tensor_parallel=False, context_parallel=False):
+        self.vocab_size = vocab_size
+        self.hidden_size = hidden_size
+        self.intermediate_size = intermediate_size
+        self.num_layers = num_layers
+        self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads
+        self.max_seq_len = max_seq_len
+        self.rope_theta = rope_theta
+        self.rms_eps = rms_eps
+        self.tie_embeddings = tie_embeddings
+        self.tensor_parallel = tensor_parallel
+        self.context_parallel = context_parallel
+        if hidden_size % num_heads:
+            raise MXNetError("hidden_size must divide num_heads")
+        if num_heads % num_kv_heads:
+            raise MXNetError("num_heads must divide num_kv_heads")
+        self.head_dim = hidden_size // num_heads
+
+
+class RMSNorm(HybridBlock):
+    """Root-mean-square norm (no mean subtraction, no bias)."""
+
+    def __init__(self, hidden_size, eps=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self._eps = eps
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(hidden_size,),
+                                          init="ones")
+
+    def hybrid_forward(self, F, x, weight):
+        import jax.numpy as jnp
+        from ....ndarray.ndarray import apply_nary
+        eps = self._eps
+
+        def fn(d, w):
+            # reduce in fp32 for bf16 inputs (standard practice)
+            d32 = d.astype(jnp.float32)
+            var = jnp.mean(d32 * d32, axis=-1, keepdims=True)
+            return (d32 / jnp.sqrt(var + eps)).astype(d.dtype) * w
+
+        return apply_nary(fn, [x, weight], name="rms_norm")
+
+
+def _dense(units, use_tp, mode, **kw):
+    if use_tp:
+        from ....parallel.tensor_parallel import ParallelDense
+        return ParallelDense(units, parallel_mode=mode, use_bias=False,
+                             flatten=False, **kw)
+    return nn.Dense(units, use_bias=False, flatten=False, **kw)
+
+
+class LlamaAttention(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        with self.name_scope():
+            self.q_proj = _dense(h * d, cfg.tensor_parallel, "column")
+            self.k_proj = _dense(kvh * d, cfg.tensor_parallel, "column")
+            self.v_proj = _dense(kvh * d, cfg.tensor_parallel, "column")
+            self.o_proj = _dense(cfg.hidden_size, cfg.tensor_parallel, "row")
+
+    def hybrid_forward(self, F, x):
+        import jax
+        import jax.numpy as jnp
+        from ....ndarray.ndarray import apply_nary
+        from ....ops.flash_attention import flash_attention
+        cfg = self.cfg
+        b, t = x.shape[0], x.shape[1]
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        theta = cfg.rope_theta
+
+        def rope_and_shape(qd, kd, vd):
+            qd = qd.reshape(b, t, h, d).transpose(0, 2, 1, 3)
+            kd = kd.reshape(b, t, kvh, d).transpose(0, 2, 1, 3)
+            vd = vd.reshape(b, t, kvh, d).transpose(0, 2, 1, 3)
+            # rotary embeddings
+            pos = jnp.arange(t)
+            freqs = theta ** (-jnp.arange(0, d, 2) / d)
+            ang = pos[:, None] * freqs[None, :]           # (t, d/2)
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+
+            def rot(u):
+                u1, u2 = u[..., 0::2], u[..., 1::2]
+                r1 = u1 * cos - u2 * sin
+                r2 = u2 * cos + u1 * sin
+                return jnp.stack([r1, r2], axis=-1).reshape(u.shape)
+
+            qd = rot(qd)
+            kd = rot(kd)
+            # GQA: repeat kv heads
+            rep = h // kvh
+            kd = jnp.repeat(kd, rep, axis=1)
+            vd = jnp.repeat(vd, rep, axis=1)
+            return qd, kd, vd
+
+        # Context parallelism is a COMPILED feature: ring attention's
+        # shard_map only composes with jit tracing (hybridize /
+        # DataParallelTrainer / dryrun) or eager inference — the eager
+        # imperative tape records ops under jax.vjp, where cross-device
+        # resharding is illegal. Under an eager recorded forward we fall
+        # back to local flash attention (numerically identical; just not
+        # sequence-sharded).
+        from .... import _tape
+        use_ring = False
+        mesh = None
+        if cfg.context_parallel:
+            from ....parallel import current_mesh
+            mesh = current_mesh()
+            in_jit_trace = _tape._STATE.trace_depth > 0
+            eager_infer = not _tape.is_recording()
+            use_ring = (mesh is not None and "sp" in mesh.shape
+                        and (in_jit_trace or eager_infer))
+
+        def attn(qd, kd, vd):
+            qd, kd, vd = rope_and_shape(qd, kd, vd)
+            if use_ring:
+                from ....parallel.ring_attention import ring_attention
+                o = ring_attention(qd, kd, vd, mesh, axis_name="sp",
+                                   causal=True)
+            else:
+                o = flash_attention(qd, kd, vd, causal=True)
+            if hasattr(o, "data"):
+                o = o.data
+            return o.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+        out = apply_nary(attn, [q, k, v], name="llama_attention")
+        return self.o_proj(out)
+
+
+class LlamaMLP(HybridBlock):
+    """SwiGLU feed-forward."""
+
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.gate_proj = _dense(cfg.intermediate_size,
+                                    cfg.tensor_parallel, "column")
+            self.up_proj = _dense(cfg.intermediate_size,
+                                  cfg.tensor_parallel, "column")
+            self.down_proj = _dense(cfg.hidden_size,
+                                    cfg.tensor_parallel, "row")
+
+    def hybrid_forward(self, F, x):
+        import jax
+        from ....ndarray.ndarray import apply_nary
+        gate = self.gate_proj(x)
+        up = self.up_proj(x)
+
+        def fn(g, u):
+            return jax.nn.silu(g) * u
+
+        return self.down_proj(apply_nary(fn, [gate, up], name="swiglu"))
+
+
+class LlamaLayer(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.input_norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+            self.attention = LlamaAttention(cfg)
+            self.post_norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+            self.mlp = LlamaMLP(cfg)
+
+    def hybrid_forward(self, F, x):
+        x = x + self.attention(self.input_norm(x))
+        return x + self.mlp(self.post_norm(x))
+
+
+class LlamaModel(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        with self.name_scope():
+            self.embed = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+            self.layers = nn.HybridSequential()
+            for _ in range(cfg.num_layers):
+                self.layers.add(LlamaLayer(cfg))
+            self.norm = RMSNorm(cfg.hidden_size, cfg.rms_eps)
+
+    def hybrid_forward(self, F, tokens):
+        x = self.embed(tokens)
+        for layer in self.layers:
+            x = layer(x)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(HybridBlock):
+    def __init__(self, cfg, **kwargs):
+        super().__init__(**kwargs)
+        self.cfg = cfg
+        with self.name_scope():
+            self.model = LlamaModel(cfg)
+            self.lm_head = None if cfg.tie_embeddings else \
+                _dense(cfg.vocab_size, cfg.tensor_parallel, "column")
+
+    def hybrid_forward(self, F, tokens):
+        import jax.numpy as jnp
+        from ....ndarray.ndarray import apply_nary
+        x = self.model(tokens)
+        if self.lm_head is not None:
+            return self.lm_head(x)
+        w = self.model.embed.weight.data()
+
+        def fn(d, emb):
+            return d @ emb.T
+
+        return apply_nary(fn, [x, w], name="tied_lm_head")
+
+
+def llama3_8b(**overrides):
+    """Llama-3-8B geometry (BASELINE stretch config)."""
+    return LlamaForCausalLM(LlamaConfig(**overrides))
+
+
+def llama_tiny(**overrides):
+    """Tiny config for tests / dryruns."""
+    kw = dict(vocab_size=256, hidden_size=64, intermediate_size=128,
+              num_layers=2, num_heads=4, num_kv_heads=2, max_seq_len=128)
+    kw.update(overrides)
+    return LlamaForCausalLM(LlamaConfig(**kw))

@@ -98,8 +98,29 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
         return o / jnp.maximum(l, 1e-30)
 
     spec = P(None, None, axis_name, None)
-    return shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
-                     out_specs=spec, check_rep=False)(q, k, v)
+    sharding = jax.sharding.NamedSharding(mesh, spec)
+
+    # Eager arrays committed to one device are laid out over the mesh
+    # first (and the output restored to the caller's layout so eager CP
+    # composes with unsharded surrounding ops); under jit the constraint
+    # is compiled in and the output stays sequence-sharded.
+    eager = not isinstance(q, jax.core.Tracer)
+    restore = None
+
+    def place(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, sharding)
+        return jax.device_put(x, sharding)
+
+    if eager and getattr(q, "sharding", None) is not None and \
+            not q.sharding.is_equivalent_to(sharding, q.ndim):
+        restore = q.sharding
+    q, k, v = place(q), place(k), place(v)
+    out = shard_map(local_fn, mesh=mesh, in_specs=(spec, spec, spec),
+                    out_specs=spec, check_vma=False)(q, k, v)
+    if restore is not None:
+        out = jax.device_put(out, restore)
+    return out
 
 
 def sequence_parallel_attention(q, k, v, mesh=None, axis_name="sp",
