@@ -13,6 +13,7 @@ from .inception import *  # noqa: F401,F403
 from .ssd import *  # noqa: F401,F403
 from .yolo import *  # noqa: F401,F403
 from .segmentation import *  # noqa: F401,F403
+from .rcnn import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
@@ -24,7 +25,8 @@ def _register_models():
     import importlib
     mods = [importlib.import_module(f"{__name__}.{m}")
             for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
-                      "densenet", "inception", "ssd", "yolo", "segmentation")]
+                      "densenet", "inception", "ssd", "yolo", "segmentation",
+                      "rcnn")]
     for mod in mods:
         for name in mod.__all__:
             fn = getattr(mod, name)

@@ -1,0 +1,243 @@
+"""Faster R-CNN (GluonCV parity: gluoncv/model_zoo/rcnn/faster_rcnn/).
+
+TPU-first: every stage is static-shape. Proposal selection is top-k (fixed
+k) + fixed-trip NMS — low-scoring slots survive as masked rows instead of
+being dropped, so the whole detector is one jittable program (the
+reference's dynamic-shape `contrib.Proposal` op cannot tile onto the MXU).
+ROIAlign is the vectorised bilinear gather from mx.nd.contrib.
+"""
+from __future__ import annotations
+
+import math
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+from .segmentation import resnet50_v1b
+
+__all__ = ["RPN", "FasterRCNN", "faster_rcnn_resnet50_v1b"]
+
+
+class RPNAnchorGenerator(HybridBlock):
+    """Absolute-pixel anchors at one stride (gluoncv rpn/anchor.py)."""
+
+    def __init__(self, stride=16, scales=(8, 16, 32), ratios=(0.5, 1, 2),
+                 base_size=16, **kwargs):
+        super().__init__(**kwargs)
+        self._stride = stride
+        shapes = []
+        for s in scales:
+            for r in ratios:
+                size = (base_size * s) ** 2 / r
+                w = math.sqrt(size)
+                h = w * r
+                shapes.append((w, h))
+        self._shapes = shapes
+
+    @property
+    def num_anchors(self):
+        return len(self._shapes)
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from ....ndarray.ndarray import apply_nary
+        stride, shapes = self._stride, self._shapes
+
+        def fn(d):
+            h, w = d.shape[-2], d.shape[-1]
+            cy = (jnp.arange(h) + 0.5) * stride
+            cx = (jnp.arange(w) + 0.5) * stride
+            ws = jnp.asarray([s[0] for s in shapes])
+            hs = jnp.asarray([s[1] for s in shapes])
+            cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+            cyg = cyg[..., None]
+            cxg = cxg[..., None]
+            anch = jnp.stack([cxg - ws / 2, cyg - hs / 2,
+                              cxg + ws / 2, cyg + hs / 2], axis=-1)
+            return anch.reshape(1, -1, 4)
+
+        return apply_nary(fn, [x], name="rpn_anchors")
+
+
+class RPN(HybridBlock):
+    """Region proposal network head + static proposal selection."""
+
+    def __init__(self, channels=256, stride=16, pre_nms=2000, post_nms=300,
+                 nms_thresh=0.7, **kwargs):
+        super().__init__(**kwargs)
+        self._pre_nms = pre_nms
+        self._post_nms = post_nms
+        self._nms_thresh = nms_thresh
+        with self.name_scope():
+            self.anchor_gen = RPNAnchorGenerator(stride=stride)
+            na = self.anchor_gen.num_anchors
+            self.conv = nn.Conv2D(channels, 3, 1, 1, activation="relu")
+            self.score = nn.Conv2D(na, 1, 1, 0)
+            self.loc = nn.Conv2D(na * 4, 1, 1, 0)
+
+    def hybrid_forward(self, F, feat, im_size):
+        import jax
+        import jax.numpy as jnp
+        from ....ndarray.ndarray import apply_nary
+        x = self.conv(feat)
+        score = self.score(x)       # (B, na, H, W)
+        loc = self.loc(x)           # (B, na*4, H, W)
+        anchors = self.anchor_gen(feat)
+        pre_nms, post_nms = self._pre_nms, self._post_nms
+        nms_thresh = self._nms_thresh
+        imh, imw = im_size
+
+        def proposals(sc, lc, anc):
+            b = sc.shape[0]
+            na = anc.shape[1]
+            sc = jax.nn.sigmoid(sc.transpose(0, 2, 3, 1).reshape(b, -1))
+            lc = lc.transpose(0, 2, 3, 1).reshape(b, -1, 4)
+            a = anc[0]
+            aw = a[:, 2] - a[:, 0]
+            ah = a[:, 3] - a[:, 1]
+            ax = (a[:, 0] + a[:, 2]) / 2
+            ay = (a[:, 1] + a[:, 3]) / 2
+
+            def one(s, l):
+                ox = l[:, 0] * aw + ax
+                oy = l[:, 1] * ah + ay
+                ow = jnp.exp(jnp.clip(l[:, 2], -10, 10)) * aw / 2
+                oh = jnp.exp(jnp.clip(l[:, 3], -10, 10)) * ah / 2
+                boxes = jnp.stack(
+                    [jnp.clip(ox - ow, 0, imw), jnp.clip(oy - oh, 0, imh),
+                     jnp.clip(ox + ow, 0, imw), jnp.clip(oy + oh, 0, imh)],
+                    axis=-1)
+                k = min(pre_nms, boxes.shape[0])
+                top_s, idx = jax.lax.top_k(s, k)
+                top_b = boxes[idx]
+                # fixed-trip greedy NMS on the top-k
+                def iou_row(i, keep):
+                    bi = top_b[i]
+                    tl = jnp.maximum(top_b[:, :2], bi[:2])
+                    br = jnp.minimum(top_b[:, 2:], bi[2:])
+                    wh = jnp.maximum(br - tl, 0.0)
+                    inter = wh[:, 0] * wh[:, 1]
+                    area = jnp.maximum(
+                        (top_b[:, 2] - top_b[:, 0]) *
+                        (top_b[:, 3] - top_b[:, 1]), 1e-12)
+                    ai = jnp.maximum((bi[2] - bi[0]) * (bi[3] - bi[1]),
+                                     1e-12)
+                    iou = inter / (area + ai - inter)
+                    sup = (iou > nms_thresh) & (jnp.arange(k) > i)
+                    return jnp.where(keep[i], keep & ~sup, keep)
+
+                keep = jax.lax.fori_loop(0, k, iou_row, jnp.ones(k, bool))
+                masked = jnp.where(keep, top_s, -1.0)
+                sel_s, sel_i = jax.lax.top_k(masked, post_nms)
+                return top_b[sel_i], sel_s
+
+            rois, scores = jax.vmap(one)(sc, lc)
+            return rois, scores
+
+        rois, roi_scores = apply_nary(proposals, [score, loc, anchors],
+                                      n_out=2, name="rpn_proposals")
+        return score, loc, anchors, rois, roi_scores
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector: RPN proposals -> ROIAlign -> box head.
+
+    Train mode returns (cls_pred, box_pred, rois, rpn_score, rpn_loc,
+    anchors); eval returns (ids, scores, bboxes) with per-roi best class.
+    """
+
+    def __init__(self, classes, backbone=None, roi_size=(7, 7), stride=16,
+                 post_nms=300, nms_thresh=0.3, score_thresh=0.05, **kwargs):
+        super().__init__(**kwargs)
+        self.classes = list(classes)
+        self.num_classes = len(self.classes)
+        self._roi_size = roi_size
+        self._stride = stride
+        self._nms_thresh = nms_thresh
+        self._score_thresh = score_thresh
+        with self.name_scope():
+            self.base = backbone or resnet50_v1b(dilated=False)
+            self.rpn = RPN(stride=stride, post_nms=post_nms)
+            self.top_features = nn.HybridSequential()
+            self.top_features.add(nn.Dense(1024, activation="relu",
+                                           flatten=True))
+            self.top_features.add(nn.Dense(1024, activation="relu"))
+            self.class_predictor = nn.Dense(self.num_classes + 1)
+            self.box_predictor = nn.Dense(self.num_classes * 4)
+
+    def _features(self, x):
+        b = self.base
+        y = b.maxpool(b.relu(b.bn1(b.conv1(x))))
+        y = b.layer1(y)
+        y = b.layer2(y)
+        return b.layer3(y)      # C4, stride 16
+
+    def hybrid_forward(self, F, x):
+        import jax.numpy as jnp
+        from .... import _tape
+        from ....ndarray import contrib
+        from ....ndarray.ndarray import apply_nary
+        im_h, im_w = x.shape[2], x.shape[3]
+        feat = self._features(x)
+        rpn_score, rpn_loc, anchors, rois, roi_scores = \
+            self.rpn(feat, (im_h, im_w))
+        b, n_roi = rois.shape[0], rois.shape[1]
+        stride = self._stride
+
+        def to_roi5(r):
+            batch_idx = jnp.repeat(jnp.arange(b, dtype=r.dtype), n_roi)
+            return jnp.concatenate(
+                [batch_idx[:, None], r.reshape(-1, 4)], axis=-1)
+
+        rois5 = apply_nary(to_roi5, [rois], name="roi5")
+        pooled = contrib.ROIAlign(feat, rois5, pooled_size=self._roi_size,
+                                  spatial_scale=1.0 / stride,
+                                  sample_ratio=2)
+        top = self.top_features(pooled)
+        cls_pred = self.class_predictor(top)    # (B*R, C+1)
+        box_pred = self.box_predictor(top)      # (B*R, C*4)
+        if _tape.is_training():
+            return cls_pred, box_pred, rois, rpn_score, rpn_loc, anchors
+        ncls = self.num_classes
+        score_thresh = self._score_thresh
+
+        def decode(cp, bp, r):
+            prob = jnp.exp(jnp.clip(cp - cp.max(-1, keepdims=True), -30, 0))
+            prob = prob / prob.sum(-1, keepdims=True)
+            best = jnp.argmax(prob[:, 1:], axis=-1)       # skip background
+            best_p = jnp.max(prob[:, 1:], axis=-1)
+            deltas = bp.reshape(-1, ncls, 4)
+            d = jnp.take_along_axis(
+                deltas, best[:, None, None].repeat(4, -1), axis=1)[:, 0]
+            rf = r.reshape(-1, 4)
+            rw = rf[:, 2] - rf[:, 0]
+            rh = rf[:, 3] - rf[:, 1]
+            rx = (rf[:, 0] + rf[:, 2]) / 2
+            ry = (rf[:, 1] + rf[:, 3]) / 2
+            ox = d[:, 0] * 0.1 * rw + rx
+            oy = d[:, 1] * 0.1 * rh + ry
+            ow = jnp.exp(jnp.clip(d[:, 2] * 0.2, -10, 10)) * rw / 2
+            oh = jnp.exp(jnp.clip(d[:, 3] * 0.2, -10, 10)) * rh / 2
+            boxes = jnp.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=-1)
+            ids = jnp.where(best_p > score_thresh,
+                            best.astype(boxes.dtype), -1.0)
+            det = jnp.concatenate([ids[:, None], best_p[:, None], boxes],
+                                  axis=-1)
+            return det.reshape(b, n_roi, 6)
+
+        dets = apply_nary(decode, [cls_pred, box_pred, rois], name="rcnn_decode")
+        dets = contrib.box_nms(dets, overlap_thresh=self._nms_thresh,
+                               valid_thresh=score_thresh, topk=100,
+                               coord_start=2, score_index=1, id_index=0)
+        ids = F.slice_axis(dets, axis=-1, begin=0, end=1)
+        scores = F.slice_axis(dets, axis=-1, begin=1, end=2)
+        bboxes = F.slice_axis(dets, axis=-1, begin=2, end=6)
+        return ids, scores, bboxes
+
+
+_VOC_CLASSES = tuple(f"class_{i}" for i in range(20))
+
+
+def faster_rcnn_resnet50_v1b(classes=_VOC_CLASSES, **kwargs):
+    """gluoncv faster_rcnn_resnet50_v1b_voc parity."""
+    return FasterRCNN(classes, **kwargs)

@@ -206,3 +206,30 @@ def test_get_model_detection_names():
     net.initialize()
     out = net(nd.random.uniform(shape=(1, 3, 64, 64)))
     assert out.shape == (1, 1000)
+
+
+@pytest.mark.slow
+def test_faster_rcnn_forward():
+    from mxnet_tpu.gluon.model_zoo.vision.rcnn import \
+        faster_rcnn_resnet50_v1b
+    net = faster_rcnn_resnet50_v1b()
+    net.initialize()
+    x = nd.random.uniform(shape=(1, 3, 128, 128))
+    prev = _tape.set_training(True)
+    try:
+        cls_p, box_p, rois, rpn_s, rpn_l, anchors = net(x)
+    finally:
+        _tape.set_training(prev)
+    assert cls_p.shape == (300, 21)
+    assert box_p.shape == (300, 80)
+    assert rois.shape == (1, 300, 4)
+    prev = _tape.set_training(False)
+    try:
+        ids, scores, bboxes = net(x)
+    finally:
+        _tape.set_training(prev)
+    assert bboxes.shape == (1, 300, 4)
+    # rois must lie inside the image
+    r = rois.asnumpy()
+    assert (r >= 0).all() and (r[..., 0::2] <= 128).all() \
+        and (r[..., 1::2] <= 128).all()
