@@ -128,7 +128,8 @@ class RPN(HybridBlock):
 
                 keep = jax.lax.fori_loop(0, k, iou_row, jnp.ones(k, bool))
                 masked = jnp.where(keep, top_s, -1.0)
-                sel_s, sel_i = jax.lax.top_k(masked, post_nms)
+                # small images can have fewer anchors than post_nms
+                sel_s, sel_i = jax.lax.top_k(masked, min(post_nms, k))
                 return top_b[sel_i], sel_s
 
             rois, scores = jax.vmap(one)(sc, lc)
@@ -157,6 +158,12 @@ class FasterRCNN(HybridBlock):
         self._score_thresh = score_thresh
         with self.name_scope():
             self.base = backbone or resnet50_v1b(dilated=False)
+            # only conv1..layer3 (C4) feed the detector — drop the
+            # classification tail so it is neither allocated nor saved
+            for tail in ("layer4", "avgpool", "fc"):
+                if tail in self.base._children:
+                    self.base._children.pop(tail)
+                    object.__delattr__(self.base, tail)
             self.rpn = RPN(stride=stride, post_nms=post_nms)
             self.top_features = nn.HybridSequential()
             self.top_features.add(nn.Dense(1024, activation="relu",

@@ -123,9 +123,9 @@ class NDArrayIter(DataIter):
     def reset(self):
         if self.shuffle:
             idx = _np.random.permutation(self.num_data)
-            self.data = [(k, NDArray(v.data[idx.tolist()]))
+            self.data = [(k, NDArray(v.data[idx]))
                          for k, v in self.data]
-            self.label = [(k, NDArray(v.data[idx.tolist()]))
+            self.label = [(k, NDArray(v.data[idx]))
                           for k, v in self.label]
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:

@@ -23,6 +23,11 @@ __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
 register, _create_registered, _REGISTRY = registry_create("metric")
 
 
+# short names the reference accepts (python/mxnet/metric.py aliases)
+_ALIASES = {"acc": "accuracy", "ce": "crossentropy",
+            "top_k_acc": "topkaccuracy", "top_k_accuracy": "topkaccuracy"}
+
+
 def create(metric, *args, **kwargs):
     if callable(metric):
         return CustomMetric(metric, *args, **kwargs)
@@ -31,6 +36,8 @@ def create(metric, *args, **kwargs):
         for m in metric:
             composite.add(create(m, *args, **kwargs))
         return composite
+    if isinstance(metric, str):
+        metric = _ALIASES.get(metric.lower(), metric)
     return _create_registered(metric, *args, **kwargs)
 
 

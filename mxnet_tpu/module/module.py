@@ -174,6 +174,7 @@ class Module(BaseModule):
         self._optimizer = None
         self._updater_states = {}
         self._kvstore = None
+        self._batch_size = None
 
     # -- bind -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -190,9 +191,7 @@ class Module(BaseModule):
             name, shape = (desc.name, desc.shape) if hasattr(desc, "name") \
                 else desc
             shapes[name] = shape
-        # infer remaining arg shapes with eval_shape
         arg_names = self.symbol.list_arguments()
-        inferred, _, _ = _infer_missing_shapes(self.symbol, shapes)
         reqs = {}
         for n in arg_names:
             if n in shapes and (n in self._data_names or
@@ -202,8 +201,14 @@ class Module(BaseModule):
                 reqs[n] = "null"
             else:
                 reqs[n] = grad_req
-        self._exec = Executor(self.symbol, self._context, inferred,
+        self._exec = Executor(self.symbol, self._context, shapes,
                               grad_req=reqs)
+        # parameter shapes follow from the data shapes via the executor's
+        # InferShape remnant (SURVEY.md §2.1 Symbol/nnvm row)
+        self._exec._materialize_params()
+        first = data_shapes[0]
+        self._batch_size = (first.shape if hasattr(first, "shape")
+                            else first[1])[0]
         self.binded = True
         self.for_training = for_training
 
@@ -228,7 +233,13 @@ class Module(BaseModule):
         if self.optimizer_initialized and not force_init:
             return
         if isinstance(optimizer, str):
-            optimizer = opt_mod.create(optimizer, **dict(optimizer_params))
+            params = dict(optimizer_params)
+            # reference Module._init_optimizer defaults rescale_grad to
+            # 1/batch_size (python/mxnet/module/module.py) — SoftmaxOutput
+            # grads are batch-summed, so this is load-bearing for SGD
+            if "rescale_grad" not in params and self._batch_size:
+                params["rescale_grad"] = 1.0 / self._batch_size
+            optimizer = opt_mod.create(optimizer, **params)
         self._optimizer = optimizer
         from .. import kvstore as kvs
         if kvstore:
@@ -305,13 +316,3 @@ class Module(BaseModule):
         return [o.shape for o in self._exec.outputs]
 
 
-def _infer_missing_shapes(symbol, known_shapes):
-    arg_names = symbol.list_arguments()
-    missing = [n for n in arg_names if n not in known_shapes]
-    if not missing:
-        return dict(known_shapes), None, None
-    raise MXNetError(
-        f"Module.bind could not infer shapes for {missing}. The Symbol "
-        "facade requires explicit shapes for all parameters: pass them in "
-        "data_shapes, or (recommended) use gluon.HybridBlock which infers "
-        "shapes on first forward (SURVEY.md §2.1 Symbol disposition).")

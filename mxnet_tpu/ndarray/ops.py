@@ -1083,7 +1083,8 @@ def while_loop(cond_fn, func, loop_vars, max_iterations=None):
     while cond_fn(*lv) and (max_iterations is None or steps < max_iterations):
         out, lv = func(*lv)
         lv = list(lv) if isinstance(lv, (list, tuple)) else [lv]
-        outputs.append(out)
+        if out is not None:     # step functions may carry state only
+            outputs.append(out)
         steps += 1
     if outputs and isinstance(outputs[0], (list, tuple)):
         outs = [stack(*[o[i] for o in outputs], axis=0)

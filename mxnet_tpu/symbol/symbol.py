@@ -237,6 +237,8 @@ def _apply_nd_op(opname, args, kwargs):
         if opname == "LogisticRegressionOutput":
             return _nd.sigmoid(data)
         return data
+    if opname == "SoftmaxOutput" and (len(args) < 2 or args[1] is None):
+        return _nd.softmax(args[0])    # predict path: no label bound
     if not hasattr(_nd, opname):
         raise MXNetError(f"symbol op '{opname}' has no nd implementation")
     return getattr(_nd, opname)(*args, **kwargs)
@@ -298,9 +300,50 @@ def _parse_attr(v):
 # op mirrors: every mx.nd op is constructible symbolically
 # ----------------------------------------------------------------------
 
+# Parameterized ops auto-create their weight variables when not supplied,
+# named {name}_{param} — the reference's hidden-variable behavior that
+# Module.init_params depends on (python/mxnet/symbol: auto 'fc1_weight').
+# Param shapes are materialized at bind time (module/executor.py rules).
+_OP_PARAMS = {
+    "FullyConnected": ("weight", "bias"),
+    "Convolution": ("weight", "bias"),
+    "Deconvolution": ("weight", "bias"),
+    "BatchNorm": ("gamma", "beta", "moving_mean", "moving_var"),
+    "LayerNorm": ("gamma", "beta"),
+    "InstanceNorm": ("gamma", "beta"),
+    "Embedding": ("weight",),
+    # loss heads auto-create their label variable ({name}_label)
+    "SoftmaxOutput": ("label",),
+    "LinearRegressionOutput": ("label",),
+    "MAERegressionOutput": ("label",),
+    "LogisticRegressionOutput": ("label",),
+}
+_AUTO_NAME_COUNTER = {}
+
+
+def _auto_name(opname):
+    i = _AUTO_NAME_COUNTER.get(opname, 0)
+    _AUTO_NAME_COUNTER[opname] = i + 1
+    return f"{opname.lower()}{i}"
+
+
 def _make_op(opname):
     def op(*args, name=None, **kwargs):
-        return Symbol(opname, list(args), kwargs, name=name or opname)
+        name = name or _auto_name(opname)
+        args = list(args)
+        if not args and "data" in kwargs:
+            args.append(kwargs.pop("data"))    # data-as-kwarg call style
+        params = _OP_PARAMS.get(opname, ())
+        if params:
+            n_given = max(len(args) - 1, 0)    # params supplied by caller
+            # nd.Deconvolution defaults no_bias=True, the others False
+            no_bias = kwargs.get("no_bias", opname == "Deconvolution")
+            for p in params[n_given:]:
+                if p == "bias" and no_bias:
+                    args.append(None)
+                else:
+                    args.append(Symbol._var(f"{name}_{p}"))
+        return Symbol(opname, args, kwargs, name=name)
     op.__name__ = opname
     return op
 

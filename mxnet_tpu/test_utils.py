@@ -116,7 +116,12 @@ def rand_ndarray(shape, stype="default", density=None, dtype="float32",
     from .ndarray import sparse
     data = _np.random.uniform(-scale, scale, shape).astype(dtype)
     density = 0.3 if density is None else density
-    mask = _np.random.rand(*shape) < density
+    if stype == "row_sparse":
+        # density = fraction of non-zero ROWS (reference rand_ndarray)
+        mask = (_np.random.rand(shape[0]) < density).reshape(
+            (-1,) + (1,) * (len(shape) - 1))
+    else:
+        mask = _np.random.rand(*shape) < density
     data = data * mask
     if stype == "row_sparse":
         return sparse.row_sparse_array(data, shape=shape, ctx=ctx, dtype=dtype)

@@ -1,0 +1,131 @@
+"""IO iterators, RecordIO, image transforms
+(reference: tests/python/unittest/test_io.py, test_image.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, image
+
+nd = mx.nd
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(40, dtype=np.float32).reshape(10, 4)
+    labels = np.arange(10, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=4, shuffle=False)
+    batches = list(it)
+    assert len(batches) == 3       # 10/4 -> 2 full + 1 padded
+    assert batches[0].data[0].shape == (4, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), data[:4])
+    assert batches[2].pad == 2
+    it.reset()
+    assert len(list(it)) == 3      # reset re-iterates
+
+
+def test_ndarray_iter_shuffle_covers_all():
+    data = np.arange(12, dtype=np.float32).reshape(12, 1)
+    it = mx.io.NDArrayIter(data, None, batch_size=4, shuffle=True)
+    seen = np.concatenate([b.data[0].asnumpy().ravel() for b in it])
+    assert sorted(seen.tolist()) == list(range(12))
+
+
+def test_csv_iter(tmp_path):
+    f = tmp_path / "data.csv"
+    arr = np.arange(20, dtype=np.float32).reshape(5, 4)
+    np.savetxt(f, arr, delimiter=",")
+    it = mx.io.CSVIter(data_csv=str(f), data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert batches[0].data[0].shape == (2, 4)
+    np.testing.assert_allclose(batches[0].data[0].asnumpy(), arr[:2])
+
+
+def test_recordio_roundtrip(tmp_path):
+    path = str(tmp_path / "x.rec")
+    rec = mx.recordio.MXRecordIO(path, "w")
+    for i in range(5):
+        rec.write(bytes([i]) * (10 + i))
+    rec.close()
+    rec = mx.recordio.MXRecordIO(path, "r")
+    for i in range(5):
+        blob = rec.read()
+        assert blob == bytes([i]) * (10 + i)
+    assert rec.read() is None
+    rec.close()
+
+
+def test_indexed_recordio_seek(tmp_path):
+    rec = mx.recordio.MXIndexedRecordIO(str(tmp_path / "x.idx"),
+                                        str(tmp_path / "x.rec"), "w")
+    for i in range(4):
+        header = mx.recordio.IRHeader(0, float(i), i, 0)
+        rec.write_idx(i, mx.recordio.pack(header, bytes([i]) * 8))
+    rec.close()
+    rec = mx.recordio.MXIndexedRecordIO(str(tmp_path / "x.idx"),
+                                        str(tmp_path / "x.rec"), "r")
+    header, blob = mx.recordio.unpack(rec.read_idx(2))
+    assert header.label == 2.0
+    assert blob == bytes([2]) * 8
+    rec.close()
+
+
+def test_image_resize_crop_normalize():
+    src = nd.array(np.random.RandomState(0).uniform(
+        0, 255, (32, 48, 3)).astype(np.float32))
+    out = image.imresize(src, 16, 8)
+    assert out.shape == (8, 16, 3)
+    short = image.resize_short(src, 16)
+    assert min(short.shape[:2]) == 16
+    crop, _ = image.center_crop(src, (20, 10))
+    assert crop.shape == (10, 20, 3)
+    norm = image.color_normalize(src / 255.0, mx.nd.array([0.5, 0.5, 0.5]),
+                                 mx.nd.array([0.2, 0.2, 0.2]))
+    assert abs(float(norm.asnumpy().mean())) < 2.0
+
+
+def test_gluon_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+    t = transforms.Compose([
+        transforms.Resize(16),
+        transforms.CenterCrop(12),
+        transforms.ToTensor(),
+        transforms.Normalize(0.5, 0.25),
+    ])
+    img = nd.array(np.random.RandomState(0).uniform(
+        0, 255, (20, 24, 3)).astype(np.uint8))
+    out = t(img)
+    assert out.shape == (3, 12, 12)       # CHW after ToTensor
+    assert out.asnumpy().min() < 0        # normalized
+
+
+def test_dataloader_batching_and_lastbatch():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    ds = ArrayDataset(nd.array(np.arange(10, dtype=np.float32)
+                               .reshape(10, 1)),
+                      nd.array(np.arange(10, dtype=np.float32)))
+    dl = DataLoader(ds, batch_size=4, last_batch="keep")
+    shapes = [d.shape[0] for d, _ in dl]
+    assert shapes == [4, 4, 2]
+    dl = DataLoader(ds, batch_size=4, last_batch="discard")
+    assert [d.shape[0] for d, _ in dl] == [4, 4]
+
+
+def test_vision_datasets_synthetic():
+    os.environ["MXTPU_SYNTHETIC_DATA"] = "1"
+    from mxnet_tpu.gluon.data.vision import MNIST
+    ds = MNIST(train=False)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) < 10
+
+
+def test_image_augmenters_list():
+    augs = image.CreateAugmenter((3, 24, 24), resize=26, rand_crop=True,
+                                 rand_mirror=True, mean=True, std=True)
+    assert len(augs) >= 3
+    src = nd.array(np.random.RandomState(0).uniform(
+        0, 255, (30, 30, 3)).astype(np.float32))
+    for aug in augs:
+        src = aug(src)
+    assert src.shape[2] == 3

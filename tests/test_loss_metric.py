@@ -1,0 +1,139 @@
+"""Losses vs manual formulas + metric semantics
+(reference: tests/python/unittest/test_loss.py, test_metric.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, metric
+
+nd = mx.nd
+loss_mod = gluon.loss
+
+
+def test_l2_l1_loss():
+    pred = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    label = nd.array([[0.0, 2.0], [3.0, 2.0]])
+    l2 = loss_mod.L2Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l2, [0.25, 1.0])      # mean of sq diff / 2
+    l1 = loss_mod.L1Loss()(pred, label).asnumpy()
+    np.testing.assert_allclose(l1, [0.5, 1.0])
+
+
+def test_softmax_ce_sparse_vs_dense():
+    pred = nd.array([[2.0, 1.0, 0.0], [0.0, 1.0, 2.0]])
+    sparse = loss_mod.SoftmaxCrossEntropyLoss()(
+        pred, nd.array([0, 2])).asnumpy()
+    dense = loss_mod.SoftmaxCrossEntropyLoss(sparse_label=False)(
+        pred, nd.array([[1.0, 0, 0], [0, 0, 1.0]])).asnumpy()
+    np.testing.assert_allclose(sparse, dense, rtol=1e-5)
+    logp = np.log(np.exp([2.0, 1.0, 0.0]) / np.exp([2.0, 1.0, 0.0]).sum())
+    np.testing.assert_allclose(sparse[0], -logp[0], rtol=1e-5)
+
+
+def test_sigmoid_bce():
+    pred = nd.array([[0.5, -0.5]])
+    label = nd.array([[1.0, 0.0]])
+    out = loss_mod.SigmoidBinaryCrossEntropyLoss()(pred, label).asnumpy()
+    p = 1 / (1 + np.exp(-np.array([0.5, -0.5])))
+    ref = -(np.log(p[0]) + np.log(1 - p[1])) / 2
+    np.testing.assert_allclose(out, [ref], rtol=1e-5)
+
+
+def test_kl_div_loss():
+    pred = nd.log(nd.array([[0.25, 0.75]]))
+    label = nd.array([[0.5, 0.5]])
+    out = loss_mod.KLDivLoss(from_logits=True)(pred, label).asnumpy()
+    ref = (0.5 * np.log(0.5 / 0.25) + 0.5 * np.log(0.5 / 0.75)) / 2
+    np.testing.assert_allclose(out, [ref], rtol=1e-4)
+
+
+def test_huber_loss_regions():
+    pred = nd.array([[0.5, 3.0]])
+    label = nd.array([[0.0, 0.0]])
+    out = loss_mod.HuberLoss(rho=1.0)(pred, label).asnumpy()
+    ref = (0.5 * 0.25 + (3.0 - 0.5)) / 2
+    np.testing.assert_allclose(out, [ref], rtol=1e-5)
+
+
+def test_triplet_loss_margin():
+    a = nd.array([[0.0, 0.0]])
+    p = nd.array([[0.1, 0.0]])
+    n = nd.array([[3.0, 0.0]])
+    out = loss_mod.TripletLoss(margin=1.0)(a, p, n).asnumpy()
+    assert out[0] == 0.0                     # separation >> margin
+    out2 = loss_mod.TripletLoss(margin=1.0)(a, n, p).asnumpy()
+    assert out2[0] > 0
+
+
+def test_ctc_loss_runs():
+    pred = nd.random.uniform(shape=(4, 2, 5))      # (T, B, C)
+    label = nd.array([[1, 2], [2, 3]])
+    out = loss_mod.CTCLoss(layout="TNC")(pred, label)
+    assert out.shape == (2,)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+# -- metrics ---------------------------------------------------------------
+
+def test_accuracy_metric():
+    m = metric.Accuracy()
+    m.update(nd.array([0, 1, 1]), nd.array([[0.9, 0.1], [0.3, 0.7],
+                                            [0.8, 0.2]]))
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert acc == pytest.approx(2.0 / 3.0)
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy():
+    m = metric.TopKAccuracy(top_k=2)
+    preds = nd.array([[0.1, 0.2, 0.7], [0.6, 0.3, 0.1]])
+    m.update(nd.array([1, 2]), preds)
+    assert m.get()[1] == pytest.approx(0.5)
+
+
+def test_f1_metric():
+    m = metric.F1()
+    m.update(nd.array([1, 0, 1, 0]),
+             nd.array([[0.1, 0.9], [0.9, 0.1], [0.2, 0.8], [0.3, 0.7]]))
+    # preds: 1, 0, 1, 1 -> tp=2 fp=1 fn=0 -> P=2/3 R=1 F1=0.8
+    assert m.get()[1] == pytest.approx(0.8)
+
+
+def test_perplexity():
+    m = metric.Perplexity(ignore_label=None)
+    m.update(nd.array([0]), nd.array([[0.5, 0.5]]))
+    assert m.get()[1] == pytest.approx(2.0)
+
+
+def test_mae_mse_rmse():
+    label = nd.array([[1.0, 2.0]])
+    pred = nd.array([[2.0, 4.0]])
+    assert metric.MAE().get_name_value() is not None
+    m = metric.MAE()
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(1.5)
+    m = metric.MSE()
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(2.5)
+    m = metric.RMSE()
+    m.update(label, pred)
+    assert m.get()[1] == pytest.approx(np.sqrt(2.5))
+
+
+def test_composite_and_custom():
+    comp = metric.CompositeEvalMetric()
+    comp.add(metric.Accuracy())
+    comp.add(metric.MAE())
+    comp.update(nd.array([1]), nd.array([[0.2, 0.8]]))
+    names, vals = comp.get()
+    assert len(names) == 2
+    cm = metric.CustomMetric(lambda l, p: 0.5, name="half")
+    cm.update(nd.array([1]), nd.array([1.0]))
+    assert cm.get()[1] == 0.5
+
+
+def test_metric_create_by_name():
+    m = metric.create("acc")
+    assert isinstance(m, metric.Accuracy)

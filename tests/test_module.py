@@ -1,0 +1,115 @@
+"""Module API (legacy symbolic trainer) — reference:
+tests/python/unittest/test_module.py + tests/python/train/test_mlp.py
+(the convergence smoke test, SURVEY.md §4 technique 5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+nd = mx.nd
+
+
+def _toy_symbol():
+    data = mx.sym.var("data")
+    fc1 = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    act = mx.sym.Activation(fc1, act_type="relu", name="relu1")
+    fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _toy_iter(n=240, batch=24, seed=0):
+    # class centers are FIXED (seed 1234) so train/val draws share the task;
+    # `seed` only varies the noise/label draw
+    centers = np.random.RandomState(1234).randn(3, 8) * 3
+    rng = np.random.RandomState(seed)
+    labels = rng.randint(0, 3, n)
+    data = centers[labels] + rng.randn(n, 8) * 0.3
+    return mx.io.NDArrayIter(data.astype(np.float32),
+                             labels.astype(np.float32), batch,
+                             shuffle=True, label_name="softmax_label")
+
+
+def test_module_bind_forward_shapes():
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    batch = mx.io.DataBatch(data=[nd.random.uniform(shape=(4, 8))],
+                            label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    out = mod.get_outputs()[0]
+    assert out.shape == (4, 3)
+    np.testing.assert_allclose(out.asnumpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_module_fit_converges():
+    """tests/python/train/test_mlp.py pattern: fit then assert accuracy."""
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    train = _toy_iter(seed=0)
+    val = _toy_iter(seed=1)
+    mod.fit(train, eval_data=val, num_epoch=10,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    m = mx.metric.Accuracy()
+    mod.score(val, m)
+    assert m.get()[1] > 0.9
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (4, 8))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    prefix = str(tmp_path / "model")
+    mod.save_checkpoint(prefix, 3)
+    sym, arg, aux = mx.mod.load_checkpoint(prefix, 3)
+    assert set(arg) == {"fc1_weight", "fc1_bias", "fc2_weight", "fc2_bias"}
+    mod2 = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                         label_names=("softmax_label",))
+    mod2.bind(data_shapes=[("data", (4, 8))],
+              label_shapes=[("softmax_label", (4,))])
+    mod2.set_params(arg, aux)
+    batch = mx.io.DataBatch(data=[nd.ones((4, 8))], label=[nd.zeros((4,))])
+    mod.forward(batch, is_train=False)
+    mod2.forward(batch, is_train=False)
+    np.testing.assert_allclose(mod.get_outputs()[0].asnumpy(),
+                               mod2.get_outputs()[0].asnumpy(), rtol=1e-6)
+
+
+def test_module_predict():
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    it = _toy_iter()
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    preds = mod.predict(it)
+    assert preds.shape[1] == 3
+
+
+def test_bucketing_module_varlen():
+    """BucketingModule (python/mxnet/module/bucketing_module.py): one module
+    per bucket, params shared."""
+    def sym_gen(seq_len):
+        # per-timestep FC (flatten=False): weight shape is length-
+        # independent, so buckets share it — the reference's RNN pattern
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc_shared",
+                                   flatten=False)
+        pooled = mx.sym.mean(fc, axis=1, name="pool")
+        out = mx.sym.SoftmaxOutput(pooled, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16)
+    mod.bind(data_shapes=[("data", (2, 16, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    # switch bucket: shorter sequence reuses the same weights
+    mod.switch_bucket(8, data_shapes=[("data", (2, 8, 6))],
+                      label_shapes=[("softmax_label", (2,))])
+    batch = mx.io.DataBatch(data=[nd.ones((2, 8, 6))],
+                            label=[nd.zeros((2,))], bucket_key=8)
+    mod.forward(batch, is_train=False)
+    assert mod.get_outputs()[0].shape == (2, 4)

@@ -1,0 +1,329 @@
+"""Operator semantics + numeric gradient checks.
+
+Models the reference's tests/python/unittest/test_operator.py (the ~10k-LoC
+workhorse, SURVEY.md §4 technique 1): each op's forward is checked against
+numpy and its autograd gradient against central finite differences via
+mx.test_utils.check_numeric_gradient.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, with_seed)
+
+nd = mx.nd
+
+
+# -- elementwise / broadcast ------------------------------------------------
+
+@with_seed()
+def test_unary_forward_against_numpy():
+    x = nd.random.uniform(0.1, 2.0, shape=(3, 4))
+    xn = x.asnumpy()
+    cases = [
+        (nd.exp, np.exp), (nd.log, np.log), (nd.sqrt, np.sqrt),
+        (nd.abs, np.abs), (nd.sign, np.sign), (nd.floor, np.floor),
+        (nd.ceil, np.ceil), (nd.sigmoid, lambda v: 1 / (1 + np.exp(-v))),
+        (nd.relu, lambda v: np.maximum(v, 0)), (nd.tanh, np.tanh),
+        (nd.square, np.square), (nd.rsqrt, lambda v: 1 / np.sqrt(v)),
+        (nd.reciprocal, lambda v: 1 / v),
+    ]
+    for op, ref in cases:
+        assert_almost_equal(op(x).asnumpy(), ref(xn), rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_unary_gradients():
+    for op in (nd.exp, nd.tanh, nd.sigmoid, nd.sqrt, nd.square):
+        x = nd.random.uniform(0.2, 1.5, shape=(3, 3))
+        check_numeric_gradient(op, [x])
+
+
+@with_seed()
+def test_binary_broadcast():
+    a = nd.random.uniform(shape=(2, 1, 4))
+    b = nd.random.uniform(shape=(1, 3, 1))
+    for op, ref in ((nd.broadcast_add, np.add), (nd.broadcast_mul,
+                                                 np.multiply),
+                    (nd.broadcast_sub, np.subtract),
+                    (nd.broadcast_div, np.divide),
+                    (nd.broadcast_maximum, np.maximum),
+                    (nd.broadcast_minimum, np.minimum)):
+        assert_almost_equal(op(a, b).asnumpy(), ref(a.asnumpy(), b.asnumpy()),
+                            rtol=1e-5, atol=1e-6)
+
+
+@with_seed()
+def test_reduce_ops_with_exclude():
+    x = nd.random.uniform(shape=(2, 3, 4))
+    xn = x.asnumpy()
+    assert_almost_equal(nd.sum(x, axis=1).asnumpy(), xn.sum(1), rtol=1e-5)
+    assert_almost_equal(nd.sum(x, axis=1, exclude=True).asnumpy(),
+                        xn.sum((0, 2)), rtol=1e-5)
+    assert_almost_equal(nd.mean(x, axis=(0, 2), keepdims=True).asnumpy(),
+                        xn.mean((0, 2), keepdims=True), rtol=1e-5)
+    assert_almost_equal(nd.max(x, axis=2).asnumpy(), xn.max(2), rtol=1e-5)
+    assert_almost_equal(nd.prod(x, axis=0).asnumpy(), xn.prod(0), rtol=1e-5)
+
+
+@with_seed()
+def test_dot_and_gradients():
+    a = nd.random.uniform(shape=(3, 4))
+    b = nd.random.uniform(shape=(4, 5))
+    assert_almost_equal(nd.dot(a, b).asnumpy(),
+                        a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    assert_almost_equal(
+        nd.dot(a, b, transpose_a=False, transpose_b=False).asnumpy(),
+        a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+    c = nd.random.uniform(shape=(5, 4))
+    assert_almost_equal(nd.dot(a, c, transpose_b=True).asnumpy(),
+                        a.asnumpy() @ c.asnumpy().T, rtol=1e-5)
+    check_numeric_gradient(lambda x: nd.dot(x, b), [a])
+
+
+@with_seed()
+def test_batch_dot():
+    a = nd.random.uniform(shape=(2, 3, 4))
+    b = nd.random.uniform(shape=(2, 4, 5))
+    out = nd.batch_dot(a, b).asnumpy()
+    assert_almost_equal(out, np.einsum("bij,bjk->bik", a.asnumpy(),
+                                       b.asnumpy()), rtol=1e-5)
+
+
+# -- shape ops --------------------------------------------------------------
+
+def test_reshape_special_codes():
+    x = nd.zeros((2, 3, 4))
+    assert nd.reshape(x, (0, -1)).shape == (2, 12)
+    assert nd.reshape(x, (-1, 4)).shape == (6, 4)
+    assert nd.reshape(x, (0, 0, 4)).shape == (2, 3, 4)
+    with pytest.raises(mx.MXNetError):
+        nd.reshape(x, (-2, 4))
+
+
+def test_slice_and_step():
+    x = nd.array(np.arange(24).reshape(2, 3, 4))
+    out = nd.slice(x, begin=(0, 1), end=(2, 3)).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy()[0:2, 1:3])
+    out = nd.slice_axis(x, axis=2, begin=1, end=3).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy()[:, :, 1:3])
+
+
+def test_transpose_swapaxes_flip():
+    x = nd.array(np.arange(6).reshape(2, 3))
+    np.testing.assert_allclose(nd.transpose(x).asnumpy(), x.asnumpy().T)
+    np.testing.assert_allclose(nd.swapaxes(x, 0, 1).asnumpy(), x.asnumpy().T)
+    np.testing.assert_allclose(nd.flip(x, axis=1).asnumpy(),
+                               x.asnumpy()[:, ::-1])
+
+
+def test_concat_stack_split():
+    a = nd.ones((2, 3))
+    b = nd.zeros((2, 3))
+    assert nd.concat(a, b, dim=0).shape == (4, 3)
+    assert nd.concat(a, b, dim=1).shape == (2, 6)
+    assert nd.stack(a, b, axis=0).shape == (2, 2, 3)
+    parts = nd.split(nd.ones((2, 6)), num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 2)
+    sq = nd.split(nd.ones((2, 3)), num_outputs=3, axis=1, squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+# -- indexing ---------------------------------------------------------------
+
+def test_take_pick_gather_scatter():
+    x = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(
+        nd.take(x, nd.array([0, 2]), axis=0).asnumpy(),
+        x.asnumpy()[[0, 2]])
+    picked = nd.pick(x, nd.array([0, 1, 2]), axis=1).asnumpy()
+    np.testing.assert_allclose(picked, [0, 5, 10])
+    g = nd.gather_nd(x, nd.array([[0, 2], [1, 3]])).asnumpy()
+    np.testing.assert_allclose(g, [x.asnumpy()[0, 1], x.asnumpy()[2, 3]])
+    s = nd.scatter_nd(nd.array([9.0, 8.0]), nd.array([[0, 1], [0, 1]]),
+                      shape=(2, 2)).asnumpy()
+    assert s[0, 0] == 9.0 and s[1, 1] == 8.0
+
+
+def test_one_hot_and_embedding():
+    oh = nd.one_hot(nd.array([0, 2]), depth=3).asnumpy()
+    np.testing.assert_allclose(oh, [[1, 0, 0], [0, 0, 1]])
+    w = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    e = nd.Embedding(nd.array([1, 3]), w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(e.asnumpy(), w.asnumpy()[[1, 3]])
+
+
+def test_ordering_ops():
+    x = nd.array([[3.0, 1.0, 2.0]])
+    np.testing.assert_allclose(nd.sort(x).asnumpy(), [[1, 2, 3]])
+    np.testing.assert_allclose(nd.argsort(x).asnumpy(), [[1, 2, 0]])
+    np.testing.assert_allclose(nd.argmax(x, axis=1).asnumpy(), [0])
+    top = nd.topk(x, k=2, ret_typ="value").asnumpy()
+    np.testing.assert_allclose(top, [[3, 2]])
+
+
+# -- nn ops -----------------------------------------------------------------
+
+@with_seed()
+def test_softmax_temperature_and_grad():
+    x = nd.random.uniform(shape=(2, 5))
+    out = nd.softmax(x, temperature=2.0).asnumpy()
+    e = np.exp(x.asnumpy() / 2.0)
+    np.testing.assert_allclose(out, e / e.sum(-1, keepdims=True), rtol=1e-5)
+    # softmax is shift-invariant: a plain sum has zero gradient, so weight
+    # the outputs to get a non-degenerate loss surface
+    w = nd.array(np.linspace(0.5, 2.0, 10).reshape(2, 5))
+    check_numeric_gradient(lambda v: (nd.softmax(v) * w).sum(), [x])
+
+
+@with_seed()
+def test_fully_connected_matches_manual():
+    x = nd.random.uniform(shape=(2, 8))
+    w = nd.random.uniform(shape=(4, 8))
+    b = nd.random.uniform(shape=(4,))
+    out = nd.FullyConnected(x, w, b, num_hidden=4).asnumpy()
+    np.testing.assert_allclose(out, x.asnumpy() @ w.asnumpy().T + b.asnumpy(),
+                               rtol=1e-5)
+
+
+@with_seed()
+def test_convolution_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = nd.random.uniform(shape=(2, 3, 8, 8))
+    w = nd.random.uniform(shape=(5, 3, 3, 3))
+    b = nd.random.uniform(shape=(5,))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=5,
+                         stride=(2, 2), pad=(1, 1)).asnumpy()
+    tout = torch.nn.functional.conv2d(
+        torch.tensor(x.asnumpy()), torch.tensor(w.asnumpy()),
+        torch.tensor(b.asnumpy()), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-4, atol=1e-5)
+
+
+@with_seed()
+def test_pooling_conventions():
+    torch = pytest.importorskip("torch")
+    x = nd.random.uniform(shape=(1, 2, 7, 7))
+    out = nd.Pooling(x, kernel=(2, 2), pool_type="max",
+                     stride=(2, 2)).asnumpy()
+    tout = torch.nn.functional.max_pool2d(
+        torch.tensor(x.asnumpy()), 2, 2).numpy()
+    np.testing.assert_allclose(out, tout, rtol=1e-6)
+    gout = nd.Pooling(x, global_pool=True, pool_type="avg").asnumpy()
+    np.testing.assert_allclose(gout[..., 0, 0],
+                               x.asnumpy().mean((2, 3)), rtol=1e-5)
+
+
+@with_seed()
+def test_batchnorm_use_global_stats():
+    x = nd.random.uniform(shape=(4, 3, 2, 2))
+    gamma = nd.ones((3,))
+    beta = nd.zeros((3,))
+    mean = nd.array([0.5, 0.5, 0.5])
+    var = nd.array([2.0, 2.0, 2.0])
+    out = nd.BatchNorm(x, gamma, beta, mean, var, eps=1e-5,
+                       use_global_stats=True).asnumpy()
+    ref = (x.asnumpy() - 0.5) / np.sqrt(2.0 + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+
+
+@with_seed()
+def test_layernorm_grad():
+    x = nd.random.uniform(shape=(3, 6))
+    g = nd.ones((6,))
+    b = nd.zeros((6,))
+    out = nd.LayerNorm(x, g, b).asnumpy()
+    xn = x.asnumpy()
+    ref = (xn - xn.mean(-1, keepdims=True)) / \
+        np.sqrt(xn.var(-1, keepdims=True) + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4)
+    w = nd.array(np.linspace(0.5, 2.0, 18).reshape(3, 6))
+    # fp32 central differences through a variance: ~1e-2 noise floor
+    check_numeric_gradient(lambda v: (nd.LayerNorm(v, g, b) * w).sum(), [x],
+                           rtol=5e-2)
+
+
+# -- sequence / control flow ------------------------------------------------
+
+def test_sequence_mask_last_reverse():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 2, 2))
+    sl = nd.array([1, 3])
+    m = nd.sequence_mask(x, sl, use_sequence_length=True, value=-1).asnumpy()
+    assert (m[1:, 0] == -1).all()
+    assert (m[:, 1] != -1).all()
+    last = nd.sequence_last(x, sl, use_sequence_length=True).asnumpy()
+    np.testing.assert_allclose(last[0], x.asnumpy()[0, 0])
+    np.testing.assert_allclose(last[1], x.asnumpy()[2, 1])
+
+
+def test_control_flow_foreach_scan():
+    data = nd.array(np.arange(6, dtype=np.float32).reshape(3, 2))
+
+    def step(x, states):
+        s = states[0]
+        return x + s, [s + 1]
+
+    outs, states = nd.foreach(step, data, [nd.zeros((2,))])
+    np.testing.assert_allclose(outs.asnumpy(),
+                               data.asnumpy() + [[0], [1], [2]])
+    np.testing.assert_allclose(states[0].asnumpy(), [3, 3])
+
+
+def test_control_flow_while_and_cond():
+    def cond_fn(i, s):
+        return i < 5
+
+    def body(i, s):
+        return None, (i + 1, s + i)
+
+    _, (i, s) = nd.while_loop(cond_fn, body,
+                              (nd.array([0.0]), nd.array([0.0])),
+                              max_iterations=10)
+    assert float(i.asnumpy()[0]) == 5.0
+    assert float(s.asnumpy()[0]) == 10.0
+    out = nd.cond(nd.array([1.0]), lambda: nd.array([2.0]),
+                  lambda: nd.array([3.0]))
+    assert float(out.asnumpy()[0]) == 2.0
+
+
+# -- misc -------------------------------------------------------------------
+
+def test_where_clip_add_n():
+    c = nd.array([1.0, 0.0, 1.0])
+    np.testing.assert_allclose(
+        nd.where(c, nd.ones((3,)), nd.zeros((3,))).asnumpy(), [1, 0, 1])
+    np.testing.assert_allclose(
+        nd.clip(nd.array([-2.0, 0.5, 9.0]), 0.0, 1.0).asnumpy(),
+        [0, 0.5, 1])
+    np.testing.assert_allclose(
+        nd.add_n(nd.ones((2,)), nd.ones((2,)), nd.ones((2,))).asnumpy(),
+        [3, 3])
+
+
+def test_space_depth_tile_repeat_pad():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 4, 2, 2))
+    d = nd.depth_to_space(x, 2)
+    assert d.shape == (1, 1, 4, 4)
+    s = nd.space_to_depth(d, 2)
+    np.testing.assert_allclose(s.asnumpy(), x.asnumpy())
+    assert nd.tile(nd.ones((2, 2)), (2, 3)).shape == (4, 6)
+    assert nd.repeat(nd.ones((2, 2)), 2, axis=0).shape == (4, 2)
+    p = nd.pad(nd.ones((1, 1, 2, 2)), mode="constant",
+               pad_width=(0, 0, 0, 0, 1, 1, 1, 1), constant_value=7)
+    assert p.shape == (1, 1, 4, 4)
+    assert p.asnumpy()[0, 0, 0, 0] == 7
+
+
+def test_norm_and_l2_normalization():
+    x = nd.array([[3.0, 4.0]])
+    assert float(nd.norm(x).asnumpy()) == pytest.approx(5.0)
+    n = nd.L2Normalization(x).asnumpy()
+    np.testing.assert_allclose(n, [[0.6, 0.8]], rtol=1e-5)
+
+
+def test_smooth_l1():
+    x = nd.array([-2.0, -0.5, 0.5, 2.0])
+    out = nd.smooth_l1(x, scalar=1.0).asnumpy()
+    np.testing.assert_allclose(out, [1.5, 0.125, 0.125, 1.5], rtol=1e-5)

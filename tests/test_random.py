@@ -1,0 +1,108 @@
+"""Random samplers: moments + seed reproducibility.
+
+Models the reference's tests/python/unittest/test_random.py (moment and
+KS-style checks with @with_seed, SURVEY.md §4 technique 4). The TPU rebuild
+keeps mx.random.seed global-seed semantics over jax's splitting PRNG.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import with_seed
+
+nd = mx.nd
+N = 50000
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = nd.random.uniform(shape=(100,)).asnumpy()
+    mx.random.seed(42)
+    b = nd.random.uniform(shape=(100,)).asnumpy()
+    np.testing.assert_array_equal(a, b)
+    c = nd.random.uniform(shape=(100,)).asnumpy()
+    assert not np.allclose(b, c)       # stream advances
+
+
+def test_uniform_moments():
+    mx.random.seed(0)
+    x = nd.random.uniform(2.0, 6.0, shape=(N,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.05
+    assert abs(x.var() - (6 - 2) ** 2 / 12) < 0.05
+    assert x.min() >= 2.0 and x.max() < 6.0
+
+
+def test_normal_moments():
+    mx.random.seed(1)
+    x = nd.random.normal(1.0, 2.0, shape=(N,)).asnumpy()
+    assert abs(x.mean() - 1.0) < 0.05
+    assert abs(x.std() - 2.0) < 0.05
+
+
+def test_gamma_moments():
+    mx.random.seed(2)
+    alpha, beta = 3.0, 2.0
+    x = nd.random.gamma(alpha, beta, shape=(N,)).asnumpy()
+    assert abs(x.mean() - alpha * beta) < 0.15
+    assert abs(x.var() - alpha * beta ** 2) < 0.8
+
+
+def test_poisson_moments():
+    mx.random.seed(3)
+    x = nd.random.poisson(4.0, shape=(N,)).asnumpy()
+    assert abs(x.mean() - 4.0) < 0.1
+    assert abs(x.var() - 4.0) < 0.2
+
+
+def test_exponential_moments():
+    mx.random.seed(4)
+    x = nd.random.exponential(2.0, shape=(N,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.1
+
+
+def test_randint_range():
+    mx.random.seed(5)
+    x = nd.random.randint(3, 9, shape=(1000,)).asnumpy()
+    assert x.min() >= 3 and x.max() < 9
+    assert set(np.unique(x)) == set(range(3, 9))
+
+
+def test_multinomial_distribution():
+    mx.random.seed(6)
+    probs = nd.array([0.1, 0.2, 0.7])
+    draws = nd.random.multinomial(probs, shape=(N,)).asnumpy()
+    freq = np.bincount(draws.astype(int), minlength=3) / N
+    np.testing.assert_allclose(freq, [0.1, 0.2, 0.7], atol=0.02)
+
+
+def test_bernoulli_mean():
+    mx.random.seed(7)
+    x = nd.random.bernoulli(0.3, shape=(N,)).asnumpy()
+    assert abs(x.mean() - 0.3) < 0.02
+
+
+def test_shuffle_is_permutation():
+    mx.random.seed(8)
+    x = nd.array(np.arange(100, dtype=np.float32))
+    y = nd.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(100))
+    assert not np.array_equal(y, np.arange(100))
+
+
+@with_seed()
+def test_dropout_respects_training_mode():
+    from mxnet_tpu import _tape
+    x = nd.ones((1000,))
+    prev = _tape.set_training(True)
+    try:
+        y = nd.Dropout(x, p=0.5).asnumpy()
+    finally:
+        _tape.set_training(prev)
+    # roughly half zeroed, survivors scaled by 2
+    assert 0.3 < (y == 0).mean() < 0.7
+    assert np.allclose(y[y > 0], 2.0)
+    prev = _tape.set_training(False)
+    try:
+        y_eval = nd.Dropout(x, p=0.5).asnumpy()
+    finally:
+        _tape.set_training(prev)
+    np.testing.assert_allclose(y_eval, 1.0)

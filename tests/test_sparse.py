@@ -1,0 +1,106 @@
+"""Sparse NDArray (row_sparse / CSR) semantics
+(reference: tests/python/unittest/test_sparse_ndarray.py,
+test_sparse_operator.py; disposition SURVEY.md §2.1 "Sparse ops" row)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.ndarray import sparse
+
+nd = mx.nd
+
+
+def _rsp(dense_np):
+    nz_rows = np.where(np.abs(dense_np).sum(1) > 0)[0]
+    return sparse.row_sparse_array(
+        (dense_np[nz_rows], nz_rows), shape=dense_np.shape)
+
+
+def test_row_sparse_create_and_dense():
+    dense = np.zeros((4, 3), np.float32)
+    dense[1] = [1, 2, 3]
+    dense[3] = [4, 5, 6]
+    rsp = _rsp(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    np.testing.assert_allclose(rsp.indices.asnumpy(), [1, 3])
+    np.testing.assert_allclose(rsp.values.asnumpy(), dense[[1, 3]])
+
+
+def test_csr_create_and_dense():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    np.testing.assert_allclose(csr.indptr.asnumpy(), [0, 1, 3])
+
+
+def test_cast_storage_roundtrip():
+    dense = np.zeros((4, 3), np.float32)
+    dense[2] = 7
+    rsp = _rsp(dense)
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), dense)
+    csr = sparse.csr_matrix(dense)
+    np.testing.assert_allclose(csr.tostype("default").asnumpy(), dense)
+
+
+def test_sparse_retain():
+    dense = np.arange(12, dtype=np.float32).reshape(4, 3)
+    rsp = _rsp(dense)
+    kept = sparse.retain(rsp, nd.array([0, 2]))
+    out = kept.asnumpy()
+    np.testing.assert_allclose(out[0], dense[0])
+    np.testing.assert_allclose(out[2], dense[2])
+    np.testing.assert_allclose(out[1], 0)
+    np.testing.assert_allclose(out[3], 0)
+
+
+def test_sparse_dot_csr_dense():
+    dense_a = np.array([[0, 1, 0], [2, 0, 3]], np.float32)
+    b = np.random.RandomState(0).randn(3, 4).astype(np.float32)
+    csr = sparse.csr_matrix(dense_a)
+    out = sparse.dot(csr, nd.array(b))
+    np.testing.assert_allclose(out.asnumpy(), dense_a @ b, rtol=1e-5)
+
+
+def test_sparse_elemwise_add():
+    dense = np.zeros((4, 3), np.float32)
+    dense[1] = 2
+    rsp = _rsp(dense)
+    out = (rsp + nd.array(np.ones((4, 3), np.float32))).asnumpy()
+    np.testing.assert_allclose(out, dense + 1)
+
+
+def test_sparse_zeros():
+    z = sparse.zeros("row_sparse", (3, 4))
+    assert z.stype == "row_sparse"
+    np.testing.assert_allclose(z.asnumpy(), 0)
+    z2 = sparse.zeros("csr", (3, 4))
+    assert z2.stype == "csr"
+
+
+def test_rand_ndarray_sparse():
+    from mxnet_tpu.test_utils import rand_ndarray
+    arr = rand_ndarray((10, 5), stype="row_sparse", density=0.3)
+    assert arr.stype == "row_sparse"
+    dense = arr.asnumpy()
+    frac = (np.abs(dense).sum(1) > 0).mean()
+    assert 0.05 <= frac <= 0.7
+
+
+def test_sparse_grad_embedding_pattern():
+    """row_sparse grads for embeddings: only touched rows update
+    (the reference's sparse embedding training pattern)."""
+    from mxnet_tpu import autograd
+    w = nd.random.uniform(shape=(10, 4))
+    w.attach_grad()
+    idx = nd.array([1, 3, 3])
+    with autograd.record():
+        emb = nd.Embedding(idx, w, input_dim=10, output_dim=4)
+        loss = emb.sum()
+    loss.backward()
+    g = w.grad.asnumpy()
+    assert (g[[0, 2, 4, 5, 6, 7, 8, 9]] == 0).all()
+    np.testing.assert_allclose(g[1], 1)
+    np.testing.assert_allclose(g[3], 2)      # accumulated twice
