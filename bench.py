@@ -23,6 +23,7 @@ def main():
     batch = int(os.environ.get("MXTPU_BENCH_BATCH", "128"))
     iters = int(os.environ.get("MXTPU_BENCH_ITERS", "20"))
     warmup = int(os.environ.get("MXTPU_BENCH_WARMUP", "3"))
+    dtype = os.environ.get("MXTPU_BENCH_DTYPE", "bf16")
 
     import jax
     import mxnet_tpu as mx
@@ -36,6 +37,12 @@ def main():
         # CPU smoke config so the bench is runnable anywhere
         batch = min(batch, 16)
         iters = min(iters, 5)
+
+    if dtype == "bf16":
+        # MXU-native mixed precision: conv/matmul inputs cast to bfloat16,
+        # softmax/norms in fp32 (mx.amp op lists); compiled into the step
+        from mxnet_tpu import amp
+        amp.init(target_dtype="bfloat16")
 
     net = resnet50_v1()
     net.initialize()

@@ -1,0 +1,93 @@
+"""Image classification with the Module API + Speedometer — the reference
+example/image-classification/train_*.py pattern (SURVEY.md §2.4): symbolic
+network, Module.fit, kvstore flag, Speedometer img/s logging.
+
+    python examples/image_classification.py --network mlp --num-epochs 3
+"""
+import argparse
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def get_symbol(network, num_classes=10):
+    data = mx.sym.var("data")
+    if network == "mlp":
+        x = mx.sym.FullyConnected(data, num_hidden=128, name="fc1")
+        x = mx.sym.Activation(x, act_type="relu", name="relu1")
+        x = mx.sym.FullyConnected(x, num_hidden=64, name="fc2")
+        x = mx.sym.Activation(x, act_type="relu", name="relu2")
+        x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc3")
+    elif network == "lenet":
+        x = mx.sym.Convolution(data, kernel=(5, 5), num_filter=20,
+                               name="conv1")
+        x = mx.sym.Activation(x, act_type="relu", name="a1")
+        x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                           name="p1")
+        x = mx.sym.Convolution(x, kernel=(3, 3), num_filter=50, name="conv2")
+        x = mx.sym.Activation(x, act_type="relu", name="a2")
+        x = mx.sym.Pooling(x, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                           name="p2")
+        x = mx.sym.Flatten(x, name="flat")
+        x = mx.sym.FullyConnected(x, num_hidden=num_classes, name="fc")
+    else:
+        raise ValueError(network)
+    return mx.sym.SoftmaxOutput(x, name="softmax")
+
+
+def synthetic_iters(network, batch_size, num_classes=10):
+    rng = np.random.RandomState(0)
+    n = 2000
+    if network == "lenet":
+        shape = (1, 28, 28)
+        protos = rng.randn(num_classes, *shape) * 2
+    else:
+        shape = (64,)
+        protos = rng.randn(num_classes, *shape) * 2
+    labels = rng.randint(0, num_classes, n)
+    data = protos[labels] + rng.randn(n, *shape) * 0.5
+    split = int(0.9 * n)
+    train = mx.io.NDArrayIter(data[:split].astype(np.float32),
+                              labels[:split].astype(np.float32),
+                              batch_size, shuffle=True,
+                              label_name="softmax_label")
+    val = mx.io.NDArrayIter(data[split:].astype(np.float32),
+                            labels[split:].astype(np.float32),
+                            batch_size, label_name="softmax_label")
+    return train, val
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--network", default="mlp", choices=["mlp", "lenet"])
+    ap.add_argument("--batch-size", type=int, default=100)
+    ap.add_argument("--num-epochs", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--kv-store", default="local")
+    args = ap.parse_args()
+
+    logging.basicConfig(level=logging.INFO)
+    train, val = synthetic_iters(args.network, args.batch_size)
+    mod = mx.mod.Module(get_symbol(args.network),
+                        data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.fit(train, eval_data=val, num_epoch=args.num_epochs,
+            initializer=mx.init.Xavier(),
+            kvstore=args.kv_store,
+            optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 10))
+    metric = mx.metric.Accuracy()
+    mod.score(val, metric)
+    print("final validation:", metric.get())
+    assert metric.get()[1] > 0.9, "example failed to converge"
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,84 @@
+"""Word-level language model (LSTM) — the reference example/gluon/
+word_language_model pattern: truncated BPTT over a corpus, perplexity
+metric, gradient clipping.
+
+    python examples/word_language_model.py --num-epochs 2
+"""
+import argparse
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo.nlp.language_model import StandardRNN
+
+
+def synthetic_corpus(vocab=200, length=20000, seed=0):
+    """Markov-chain text: each token strongly predicts the next."""
+    rng = np.random.RandomState(seed)
+    trans = rng.randint(0, vocab, (vocab, 3))
+    toks = [0]
+    for _ in range(length - 1):
+        toks.append(int(trans[toks[-1], rng.randint(0, 3)]))
+    return np.asarray(toks, np.int32)
+
+
+def batchify(corpus, batch_size):
+    n = len(corpus) // batch_size
+    return corpus[:n * batch_size].reshape(batch_size, n).T  # (T, B)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-epochs", type=int, default=2)
+    ap.add_argument("--batch-size", type=int, default=20)
+    ap.add_argument("--bptt", type=int, default=35)
+    ap.add_argument("--lr", type=float, default=0.003)
+    ap.add_argument("--clip", type=float, default=5.0)
+    args = ap.parse_args()
+
+    vocab = 200
+    data = batchify(synthetic_corpus(vocab), args.batch_size)
+    model = StandardRNN("lstm", vocab_size=vocab, embed_size=64,
+                        hidden_size=128, num_layers=1, dropout=0.2,
+                        tie_weights=False)
+    model.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(model.collect_params(), "adam",
+                            {"learning_rate": args.lr})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    for epoch in range(args.num_epochs):
+        total_l, n_batch = 0.0, 0
+        hidden = model.begin_state(batch_size=args.batch_size)
+        tic = time.time()
+        for i in range(0, data.shape[0] - 1 - args.bptt, args.bptt):
+            x = mx.nd.array(data[i:i + args.bptt])
+            y = mx.nd.array(data[i + 1:i + 1 + args.bptt])
+            hidden = [h.detach() for h in hidden]
+            with autograd.record():
+                out, hidden = model(x, hidden)
+                loss = loss_fn(out.reshape((-1, vocab)),
+                               y.reshape((-1,))).mean()
+            loss.backward()
+            # clip_global_norm, reference gluon.utils
+            gluon.utils.clip_global_norm(
+                [p.grad() for p in model.collect_params().values()
+                 if p.grad_req != "null"], args.clip)
+            trainer.step(1)
+            total_l += float(loss.asnumpy())
+            n_batch += 1
+        ppl = math.exp(total_l / max(n_batch, 1))
+        print(f"epoch {epoch}: perplexity {ppl:.1f} "
+              f"({time.time() - tic:.1f}s)")
+    assert ppl < vocab / 2, "LM failed to beat uniform baseline"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()

@@ -829,11 +829,13 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dn = _conv_dn(nd)
     inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
     def fn(d, w, *b):
+        # no preferred_element_type: XLA:TPU already accumulates bf16 convs
+        # in fp32, and an explicit fp32 hint breaks jax's conv transpose
+        # rule (fp32 cotangent x bf16 operand mismatch) under grad
         y = lax.conv_general_dilated(
             d, w, window_strides=stride, padding=padding,
             rhs_dilation=dilate, dimension_numbers=dn,
-            feature_group_count=num_group,
-            preferred_element_type=jnp.float32 if d.dtype == jnp.bfloat16 else None)
+            feature_group_count=num_group)
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)
         return y.astype(d.dtype)
