@@ -1,3 +1,4 @@
 """``gluon.contrib`` (reference: python/mxnet/gluon/contrib/)."""
 from . import nn
 from . import estimator
+from . import rnn

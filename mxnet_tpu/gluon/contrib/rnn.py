@@ -1,0 +1,141 @@
+"""``gluon.contrib.rnn`` — experimental recurrent cells.
+
+Reference: python/mxnet/gluon/contrib/rnn/{rnn_cell.py,conv_rnn_cell.py}
+(SURVEY.md §2.2 "Gluon contrib"): VariationalDropoutCell (one dropout mask
+reused across all time steps — Gal & Ghahramani) and convolutional LSTM
+cells (gates are convolutions over spatial state).
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from .. import nn
+from ..block import HybridBlock
+from ..rnn.rnn_cell import HybridRecurrentCell, LSTMCell
+
+__all__ = ["VariationalDropoutCell", "Conv2DLSTMCell"]
+
+
+class VariationalDropoutCell(HybridRecurrentCell):
+    """Wrap a cell; apply the SAME dropout mask at every step.
+
+    Reference: contrib.rnn.VariationalDropoutCell — masks are drawn once
+    per sequence (on first step after reset) for inputs, states, outputs.
+    """
+
+    def __init__(self, base_cell, drop_inputs=0.0, drop_states=0.0,
+                 drop_outputs=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.base_cell = base_cell
+        self._drop_inputs = drop_inputs
+        self._drop_states = drop_states
+        self._drop_outputs = drop_outputs
+        self._mask_inputs = None
+        self._mask_states = None
+        self._mask_outputs = None
+
+    def reset(self):
+        super().reset()
+        # RecurrentCell.__init__ calls reset() before base_cell is assigned
+        if getattr(self, "base_cell", None) is not None:
+            self.base_cell.reset()
+        self._mask_inputs = None
+        self._mask_states = None
+        self._mask_outputs = None
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, batch_size=0, **kwargs):
+        return self.base_cell.begin_state(batch_size=batch_size, **kwargs)
+
+    def infer_shape(self, x, *args):
+        if hasattr(self.base_cell, "infer_shape"):
+            self.base_cell.infer_shape(x, *args)
+
+    def _mask(self, p, like):
+        import jax.numpy as jnp
+        from ...ndarray import random as _rnd
+        from ...ndarray.ndarray import NDArray, apply_nary
+        import jax
+        key = _rnd.next_key()
+
+        def fn(d):
+            keep = jax.random.bernoulli(key, 1.0 - p, d.shape)
+            return keep.astype(d.dtype) / (1.0 - p)
+
+        return apply_nary(fn, [like], name="vd_mask")
+
+    def __call__(self, inputs, states):
+        from ... import _tape
+        if _tape.is_training():
+            if self._drop_inputs and self._mask_inputs is None:
+                self._mask_inputs = self._mask(self._drop_inputs, inputs)
+            if self._drop_states and self._mask_states is None:
+                self._mask_states = self._mask(self._drop_states, states[0])
+        if self._mask_inputs is not None:
+            inputs = inputs * self._mask_inputs
+        if self._mask_states is not None:
+            states = [states[0] * self._mask_states] + list(states[1:])
+        out, nstates = self.base_cell(inputs, states)
+        if _tape.is_training() and self._drop_outputs:
+            if self._mask_outputs is None:
+                self._mask_outputs = self._mask(self._drop_outputs, out)
+            out = out * self._mask_outputs
+        return out, nstates
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        return super().unroll(length, inputs, begin_state, layout,
+                              merge_outputs)
+
+
+class Conv2DLSTMCell(HybridRecurrentCell):
+    """Convolutional LSTM (xingjian et al.): gates are 2D convolutions.
+
+    Reference: contrib.rnn.Conv2DLSTMCell. input/state: (B, C, H, W);
+    hidden state has `hidden_channels` channels at the same spatial size
+    (same-padding convs).
+    """
+
+    def __init__(self, input_shape, hidden_channels, i2h_kernel=(3, 3),
+                 h2h_kernel=(3, 3), **kwargs):
+        super().__init__(**kwargs)
+        self._input_shape = tuple(input_shape)    # (C, H, W)
+        self._hc = hidden_channels
+        pad_i = tuple(k // 2 for k in i2h_kernel)
+        pad_h = tuple(k // 2 for k in h2h_kernel)
+        with self.name_scope():
+            self.i2h = nn.Conv2D(4 * hidden_channels, i2h_kernel,
+                                 padding=pad_i,
+                                 in_channels=self._input_shape[0])
+            self.h2h = nn.Conv2D(4 * hidden_channels, h2h_kernel,
+                                 padding=pad_h, use_bias=False,
+                                 in_channels=hidden_channels)
+
+    def state_info(self, batch_size=0):
+        c, h, w = self._input_shape
+        shape = (batch_size, self._hc, h, w)
+        return [{"shape": shape, "__layout__": "NCHW"},
+                {"shape": shape, "__layout__": "NCHW"}]
+
+    def _alias(self):
+        return "conv_lstm"
+
+    def __call__(self, inputs, states):
+        import jax
+        from ...ndarray.ndarray import apply_nary
+        gates = self.i2h(inputs) + self.h2h(states[0])
+
+        def fn(g, c_prev):
+            i, f, c_in, o = [g[:, k * self._hc:(k + 1) * self._hc]
+                             for k in range(4)]
+            i = jax.nn.sigmoid(i)
+            f = jax.nn.sigmoid(f)
+            o = jax.nn.sigmoid(o)
+            c = f * c_prev + i * jax.numpy.tanh(c_in)
+            return jax.numpy.tanh(c) * o, c
+
+        out, c = apply_nary(fn, [gates, states[1]], n_out=2,
+                            name="conv_lstm_step")
+        return out, [out, c]

@@ -14,11 +14,14 @@ import numpy as _np
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
+from . import ndarray as nd
 
 __all__ = ["imdecode", "imencode", "imresize", "resize_short", "fixed_crop",
            "center_crop", "random_crop", "color_normalize", "ImageIter",
            "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
-           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug"]
+           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "ColorNormalizeAug", "ImageDetIter", "CreateDetAugmenter",
+           "DetHorizontalFlipAug", "DetBorrowAug"]
 
 
 def _get_backend():
@@ -197,6 +200,20 @@ class HorizontalFlipAug(Augmenter):
         return src
 
 
+class ColorNormalizeAug(Augmenter):
+    """(src - mean) / std (reference: image.ColorNormalizeAug)."""
+
+    def __init__(self, mean, std=None):
+        super().__init__()
+        self.mean = mean if isinstance(mean, NDArray) or mean is None \
+            else array(mean)
+        self.std = std if isinstance(std, NDArray) or std is None \
+            else array(std)
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
 class CastAug(Augmenter):
     def __init__(self, typ="float32"):
         super().__init__(type=typ)
@@ -222,6 +239,12 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if mean is True:
+        mean = array([123.68, 116.28, 103.53])
+    if std is True:
+        std = array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
     return auglist
 
 
@@ -248,5 +271,115 @@ class ImageIter:
 
     def __next__(self):
         return self._inner.next()
+
+    next = __next__
+
+
+class DetHorizontalFlipAug(Augmenter):
+    """Flip image AND bounding boxes (reference: image/detection.py
+    DetHorizontalFlipAug). Labels are (N, 5+) rows [cls, x0, y0, x1, y1]
+    in [0,1] coords."""
+
+    def __init__(self, p=0.5):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src, label):
+        import random as _random
+        if _random.random() < self.p:
+            src = nd.flip(src, axis=1)
+            out = label.copy()
+            out[:, 1] = 1.0 - label[:, 3]
+            out[:, 3] = 1.0 - label[:, 1]
+            return src, out
+        return src, label
+
+
+class DetBorrowAug(Augmenter):
+    """Apply an image-only augmenter, passing labels through (reference:
+    image/detection.py DetBorrowAug)."""
+
+    def __init__(self, augmenter):
+        super().__init__()
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_mirror=False, mean=None,
+                       std=None, **kwargs):
+    """Reference: image.CreateDetAugmenter (detection augmenter list)."""
+    augs = []
+    if resize > 0:
+        augs.append(DetBorrowAug(ResizeAug(resize)))
+    augs.append(DetBorrowAug(ForceResizeAug((data_shape[2], data_shape[1]))))
+    if rand_mirror:
+        augs.append(DetHorizontalFlipAug(0.5))
+    if mean is True:
+        mean = nd.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = nd.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        augs.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return augs
+
+
+class ImageDetIter:
+    """Detection iterator: images + (N, 5) box labels with detection-aware
+    augmentation (reference: image/detection.py ImageDetIter). This build
+    is array-backed: pass `data` (B, H, W, C) and `label` (B, N, 5)."""
+
+    def __init__(self, batch_size, data_shape, data=None, label=None,
+                 aug_list=None, shuffle=False, **kwargs):
+        if data is None or label is None:
+            raise MXNetError("ImageDetIter on this build is array-backed: "
+                             "pass data=(B,H,W,C) and label=(B,N,5) arrays "
+                             "(use tools/im2rec.py + gluon.data for .rec)")
+        self._data = data if isinstance(data, nd.NDArray) else nd.array(data)
+        self._label = label if isinstance(label, nd.NDArray) \
+            else nd.array(label)
+        self.batch_size = batch_size
+        self._aug = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape)
+        self._shuffle = shuffle
+        self._order = None
+        self._cursor = 0
+        c, h, w = data_shape
+        self.provide_data = [("data", (batch_size, c, h, w))]
+        self.provide_label = [("label", (batch_size,) +
+                               tuple(self._label.shape[1:]))]
+        self.reset()
+
+    def reset(self):
+        import numpy as _np
+        n = self._data.shape[0]
+        self._order = _np.random.permutation(n) if self._shuffle \
+            else _np.arange(n)
+        self._cursor = 0
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        from .io import DataBatch
+        n = self._data.shape[0]
+        if self._cursor >= n:
+            raise StopIteration
+        idx = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        imgs, labels = [], []
+        for i in idx:
+            img = self._data[int(i)]
+            lab = self._label[int(i)].asnumpy()
+            for aug in self._aug:
+                img, lab = aug(img, lab) if isinstance(
+                    aug, (DetHorizontalFlipAug, DetBorrowAug)) \
+                    else (aug(img), lab)
+            imgs.append(nd.transpose(img, (2, 0, 1)))
+            labels.append(nd.array(lab))
+        return DataBatch(data=[nd.stack(*imgs, axis=0)],
+                         label=[nd.stack(*labels, axis=0)],
+                         pad=max(0, self.batch_size - len(imgs)))
 
     next = __next__

