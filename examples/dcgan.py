@@ -1,0 +1,107 @@
+"""DCGAN — the reference example/gluon/dcgan.py pattern: generator with
+Conv2DTranspose, discriminator with strided convs, alternating G/D steps.
+
+    python examples/dcgan.py --num-iters 30
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def build_generator(ngf=16, nc=1):
+    netG = nn.HybridSequential()
+    # latent (B, z, 1, 1) -> (B, nc, 16, 16)
+    netG.add(nn.Conv2DTranspose(ngf * 2, 4, 1, 0, use_bias=False))
+    netG.add(nn.BatchNorm())
+    netG.add(nn.Activation("relu"))
+    netG.add(nn.Conv2DTranspose(ngf, 4, 2, 1, use_bias=False))
+    netG.add(nn.BatchNorm())
+    netG.add(nn.Activation("relu"))
+    netG.add(nn.Conv2DTranspose(nc, 4, 2, 1, use_bias=False))
+    netG.add(nn.Activation("tanh"))
+    return netG
+
+
+def build_discriminator(ndf=16):
+    netD = nn.HybridSequential()
+    netD.add(nn.Conv2D(ndf, 4, 2, 1, use_bias=False))
+    netD.add(nn.LeakyReLU(0.2))
+    netD.add(nn.Conv2D(ndf * 2, 4, 2, 1, use_bias=False))
+    netD.add(nn.BatchNorm())
+    netD.add(nn.LeakyReLU(0.2))
+    netD.add(nn.Conv2D(1, 4, 1, 0, use_bias=False))
+    netD.add(nn.Flatten())
+    return netD
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-iters", type=int, default=30)
+    ap.add_argument("--batch-size", type=int, default=16)
+    ap.add_argument("--nz", type=int, default=8)
+    args = ap.parse_args()
+
+    mx.random.seed(0)
+    rng = np.random.RandomState(0)
+    # "real" data: smooth blobs, 16x16 grayscale in [-1, 1]
+    yy, xx = np.mgrid[0:16, 0:16] / 15.0
+
+    def real_batch(n):
+        cx = rng.uniform(0.3, 0.7, (n, 1, 1))
+        cy = rng.uniform(0.3, 0.7, (n, 1, 1))
+        img = np.exp(-(((xx - cx) ** 2 + (yy - cy) ** 2) / 0.05))
+        return mx.nd.array((img * 2 - 1)[:, None].astype(np.float32))
+
+    netG = build_generator(nc=1)
+    netD = build_discriminator()
+    netG.initialize(init=mx.init.Normal(0.02))
+    netD.initialize(init=mx.init.Normal(0.02))
+    trainerG = gluon.Trainer(netG.collect_params(), "adam",
+                             {"learning_rate": 2e-4, "beta1": 0.5})
+    trainerD = gluon.Trainer(netD.collect_params(), "adam",
+                             {"learning_rate": 2e-4, "beta1": 0.5})
+    loss_fn = gluon.loss.SigmoidBinaryCrossEntropyLoss()
+
+    b = args.batch_size
+    real_label = mx.nd.ones((b,))
+    fake_label = mx.nd.zeros((b,))
+    for it in range(args.num_iters):
+        # D step
+        noise = mx.nd.random.normal(shape=(b, args.nz, 1, 1))
+        real = real_batch(b)
+        with autograd.record():
+            out_real = netD(real).reshape((-1,))
+            err_real = loss_fn(out_real, real_label)
+            fake = netG(noise)
+            out_fake = netD(fake.detach()).reshape((-1,))
+            err_fake = loss_fn(out_fake, fake_label)
+            errD = (err_real + err_fake).mean()
+        errD.backward()
+        trainerD.step(1)
+        # G step
+        with autograd.record():
+            fake = netG(noise)
+            out = netD(fake).reshape((-1,))
+            errG = loss_fn(out, real_label).mean()
+        errG.backward()
+        trainerG.step(1)
+        if it % 10 == 0:
+            print(f"iter {it}: D {float(errD.asnumpy()):.3f} "
+                  f"G {float(errG.asnumpy()):.3f}")
+    img = netG(mx.nd.random.normal(shape=(1, args.nz, 1, 1)))
+    assert img.shape == (1, 1, 16, 16)
+    assert np.isfinite(errD.asnumpy()).all() and \
+        np.isfinite(errG.asnumpy()).all()
+    print("ok: generated", img.shape)
+
+
+if __name__ == "__main__":
+    main()

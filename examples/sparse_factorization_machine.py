@@ -1,0 +1,75 @@
+"""Factorization machine on sparse features — the reference's sparse
+showcase (SURVEY.md §2.1 sparse rows + §2.5 sparse/embedding parallel):
+row_sparse embedding gradients with a host parameter server
+(parallel/ps.py EmbeddingPS) pulling only the touched rows.
+
+    python examples/sparse_factorization_machine.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.parallel.ps import EmbeddingPS
+
+
+def main():
+    num_features = 1000        # sparse one-hot vocabulary
+    dim = 8                    # factorization rank
+    batch = 64
+    steps = 120
+    active = 5                 # non-zeros per example
+
+    rng = np.random.RandomState(0)
+    # ground truth: score = sum_i w[i] over active features, threshold
+    true_w = rng.randn(num_features) * 0.5
+
+    ps_v = EmbeddingPS(num_features, dim, optimizer="adagrad")
+    ps_w = EmbeddingPS(num_features, 1, optimizer="adagrad")
+
+    losses = []
+    for step in range(steps):
+        feats = rng.randint(0, num_features, (batch, active))
+        y = (true_w[feats].sum(1) > 0).astype(np.float32)
+
+        # host PS: pull only the touched embedding rows (row_sparse_pull
+        # returns the row slab, the unique ids, and per-example local ids)
+        v_rows, uniq, inv = ps_v.row_sparse_pull(feats)   # (U, dim)
+        w_rows, _, _ = ps_w.row_sparse_pull(feats)        # (U, 1)
+        v_rows.attach_grad()
+        w_rows.attach_grad()
+        idx = inv
+
+        n_uniq = v_rows.shape[0]
+        with autograd.record():
+            v = mx.nd.Embedding(idx, v_rows, input_dim=n_uniq,
+                                output_dim=dim)      # (B, A, dim)
+            w = mx.nd.Embedding(idx, w_rows, input_dim=n_uniq,
+                                output_dim=1)        # (B, A, 1)
+            linear = w.sum(axis=1).reshape((-1,))
+            # FM second order: 0.5 * ((sum v)^2 - sum v^2)
+            sv = v.sum(axis=1)
+            s2 = (v * v).sum(axis=1)
+            pair = 0.5 * (sv * sv - s2).sum(axis=-1)
+            logits = linear + pair
+            loss = mx.nd.log(1 + mx.nd.exp(-(2 * mx.nd.array(y) - 1) *
+                                           logits)).mean()
+        loss.backward()
+        # push sparse grads back: only touched rows update on the server
+        ps_v.push(uniq, v_rows.grad.asnumpy(), lr=0.3)
+        ps_w.push(uniq, w_rows.grad.asnumpy(), lr=0.3)
+        losses.append(float(loss.asnumpy()))
+        if step % 20 == 0:
+            print(f"step {step}: logloss {losses[-1]:.4f}")
+
+    print(f"logloss {losses[0]:.4f} -> {losses[-1]:.4f}")
+    assert losses[-1] < losses[0] * 0.9, "FM failed to learn"
+    print("ok")
+
+
+if __name__ == "__main__":
+    main()
