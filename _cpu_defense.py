@@ -1,0 +1,43 @@
+"""Shared axon-sitecustomize defense: pin a process to CPU JAX.
+
+The ambient env carries ``JAX_PLATFORMS=axon`` plus a sitecustomize on
+``PYTHONPATH=/root/.axon_site`` that force-registers the TPU plugin in every
+interpreter; when the TPU tunnel is wedged, ANY ``jax.devices()`` call hangs
+— even under ``JAX_PLATFORMS=cpu`` — because backend discovery still
+initializes the registered plugin. One copy of the counter-measure, used by
+``bench.py``, ``__graft_entry__.py`` and ``tests/conftest.py``.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def force_cpu(n_devices: int | None = None) -> None:
+    """Pin this process to CPU JAX, optionally with ``n_devices`` virtual
+    host devices. Must run before the first backend initialization; safe to
+    call repeatedly."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                       os.environ.get("XLA_FLAGS", ""))
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    try:
+        from jax._src import xla_bridge as _xb
+        for _name in list(getattr(_xb, "_backend_factories", {})):
+            if _name not in ("cpu", "interpreter"):
+                _xb._backend_factories.pop(_name, None)
+    except Exception:
+        pass
+    # If the sitecustomize already imported jax, its config captured
+    # JAX_PLATFORMS=axon at interpreter start; override at the config level
+    # too (the env var is read only once per process).
+    try:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass

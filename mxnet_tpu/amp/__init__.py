@@ -138,14 +138,18 @@ def init_trainer(trainer):
 
     def amp_step(batch_size, ignore_stale_grad=False):
         scaler = trainer._amp_loss_scaler
+        trainer._optimizer.rescale_grad = \
+            trainer._scale / batch_size / scaler.loss_scale
+        trainer._all_reduce_grads()
         # dynamic (fp16) scaling always checks for overflow — the scale can
         # sit at its 1.0 floor and grads still be inf; the static bf16
-        # scaler skips the check (bf16 has fp32's exponent range)
+        # scaler skips the check (bf16 has fp32's exponent range).
+        # Checked AFTER the grad sync: reduced grads are identical on every
+        # worker (inf/nan propagates through the sum), so all workers take
+        # the same skip decision — a pre-sync local check could desync the
+        # collective schedule under a dist kvstore.
         overflow = scaler._dynamic and scaler.has_overflow(trainer._params)
         if not overflow:
-            trainer._optimizer.rescale_grad = \
-                trainer._scale / batch_size / scaler.loss_scale
-            trainer._all_reduce_grads()
             trainer._update(ignore_stale_grad)
         else:   # skip step, drop stale grads
             for p in trainer._params:

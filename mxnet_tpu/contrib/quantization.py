@@ -128,16 +128,22 @@ class QuantizedDense(HybridBlock):
         self._act_scale = float(act_threshold) / 127.0
         self._units = dense._units if hasattr(dense, "_units") else w.shape[0]
         self._act_type = getattr(dense, "_act_type", None)
+        self._flatten = getattr(dense, "_flatten", True)
 
     def hybrid_forward(self, F, x):
         import jax.numpy as jnp
         from jax import lax
         from ..ndarray.ndarray import apply_nary
         qw, w_scale, a_scale = self._qw, self._w_scale, self._act_scale
-        bias, act = self._bias, self._act_type
+        bias, act, flatten = self._bias, self._act_type, self._flatten
 
         def fn(d):
-            flat = d.reshape(d.shape[0], -1)
+            # honor the wrapped Dense's flatten flag: flatten=False (sequence
+            # models) quantizes over the last axis only, preserving leading
+            # dims, exactly like the fp layer it replaces
+            lead = d.shape[:1] if flatten else d.shape[:-1]
+            flat = d.reshape(d.shape[0], -1) if flatten \
+                else d.reshape(-1, d.shape[-1])
             qx = jnp.clip(jnp.round(flat / a_scale), -127, 127) \
                 .astype(jnp.int8)
             acc = lax.dot_general(
@@ -148,7 +154,7 @@ class QuantizedDense(HybridBlock):
                 out = out + bias
             if act == "relu":
                 out = jnp.maximum(out, 0)
-            return out
+            return out.reshape(lead + (out.shape[-1],))
 
         return apply_nary(fn, [x], name="quantized_dense")
 

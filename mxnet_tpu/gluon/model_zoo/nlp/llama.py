@@ -43,9 +43,9 @@ class LlamaConfig:
         self.tensor_parallel = tensor_parallel
         self.context_parallel = context_parallel
         if hidden_size % num_heads:
-            raise MXNetError("hidden_size must divide num_heads")
+            raise MXNetError("num_heads must divide hidden_size")
         if num_heads % num_kv_heads:
-            raise MXNetError("num_heads must divide num_kv_heads")
+            raise MXNetError("num_kv_heads must divide num_heads")
         self.head_dim = hidden_size // num_heads
 
 

@@ -378,8 +378,16 @@ class ImageDetIter:
                     else (aug(img), lab)
             imgs.append(nd.transpose(img, (2, 0, 1)))
             labels.append(nd.array(lab))
+        # Pad the final ragged batch to the advertised fixed batch shape by
+        # repeating samples (reference behavior); `pad` records how many are
+        # repeats so consumers can mask them. Static shapes keep XLA from
+        # recompiling on the last batch.
+        pad = max(0, self.batch_size - len(imgs))
+        for k in range(pad):
+            imgs.append(imgs[k % (self.batch_size - pad)])
+            labels.append(labels[k % (self.batch_size - pad)])
         return DataBatch(data=[nd.stack(*imgs, axis=0)],
                          label=[nd.stack(*labels, axis=0)],
-                         pad=max(0, self.batch_size - len(imgs)))
+                         pad=pad)
 
     next = __next__

@@ -24,9 +24,165 @@ from ..ndarray import random as _rnd
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdamW", "AdaGrad", "AdaDelta",
            "RMSProp", "Ftrl", "Signum", "LAMB", "LARS", "SGLD", "Test",
-           "register", "create", "Updater", "get_updater"]
+           "register", "create", "Updater", "get_updater", "fused_rule"]
 
 register, create, _REGISTRY = registry_create("optimizer")
+
+
+# ---------------------------------------------------------------------------
+# Pure functional update kernels — the SINGLE source of update math
+# (VERDICT r1 #6). The eager Optimizer.update methods below delegate to
+# these, and parallel.DataParallelTrainer jits them directly, so the fused
+# and eager paths can never diverge. Each kernel is
+#   init(p)                  -> state dict of arrays
+#   apply(p, g, s, lr, wd)   -> (new_p, new_state)
+# with g already rescaled+clipped by the caller; wd semantics (coupled vs
+# decoupled) live inside the kernel. Reference: the fused CUDA update
+# kernels in src/operator/optimizer_op.cc collapse to these jnp chains
+# (XLA fuses the elementwise ops; one kernel launch per parameter).
+# ---------------------------------------------------------------------------
+
+def _k_sgd(momentum=0.0, nesterov=False, lazy_update=None):
+    def init(p):
+        return {"mom": jnp.zeros_like(p)} if momentum else {}
+
+    def apply(p, g, s, lr, wd):
+        g = g + wd * p
+        if not momentum:
+            return p - lr * g, dict(s)
+        if nesterov:
+            m = momentum * s["mom"] + g
+            return p - lr * (g + momentum * m), {"mom": m}
+        m = momentum * s["mom"] - lr * g
+        return p + m, {"mom": m}
+    return init, apply
+
+
+def _k_adam(beta1=0.9, beta2=0.999, epsilon=1e-8, decoupled_wd=False,
+            lazy_update=None):
+    def init(p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def apply(p, g, s, lr, wd):
+        if not decoupled_wd:
+            g = g + wd * p
+        t = s["t"] + 1
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m = beta1 * s["m"] + (1 - beta1) * g
+        v = beta2 * s["v"] + (1 - beta2) * jnp.square(g)
+        lr_t = lr * jnp.sqrt(1 - beta2 ** tf) / (1 - beta1 ** tf)
+        new_p = p - lr_t * m / (jnp.sqrt(v) + epsilon)
+        if decoupled_wd:
+            new_p = new_p - lr * wd * p
+        return new_p, {"m": m, "v": v, "t": t}
+    return init, apply
+
+
+def _k_lamb(beta1=0.9, beta2=0.999, epsilon=1e-6, lower_bound=None,
+            upper_bound=None, bias_correction=True):
+    def init(p):
+        return {"m": jnp.zeros_like(p), "v": jnp.zeros_like(p),
+                "t": jnp.zeros((), jnp.int32)}
+
+    def apply(p, g, s, lr, wd):
+        t = s["t"] + 1
+        tf = t.astype(jnp.float32) if hasattr(t, "astype") else float(t)
+        m = beta1 * s["m"] + (1 - beta1) * g
+        v = beta2 * s["v"] + (1 - beta2) * jnp.square(g)
+        if bias_correction:
+            m_hat = m / (1 - beta1 ** tf)
+            v_hat = v / (1 - beta2 ** tf)
+        else:
+            m_hat, v_hat = m, v
+        update = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * p
+        w_norm = jnp.linalg.norm(p)
+        u_norm = jnp.linalg.norm(update)
+        if lower_bound is not None:
+            w_norm = jnp.maximum(w_norm, lower_bound)
+        if upper_bound is not None:
+            w_norm = jnp.minimum(w_norm, upper_bound)
+        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
+        return p - lr * ratio * update, {"m": m, "v": v, "t": t}
+    return init, apply
+
+
+def _k_lars(eta=0.001, eps=1e-8, momentum=0.0):
+    def init(p):
+        return {"mom": jnp.zeros_like(p)} if momentum else {}
+
+    def apply(p, g, s, lr, wd):
+        w_norm = jnp.linalg.norm(p)
+        g_norm = jnp.linalg.norm(g)
+        trust = jnp.where((w_norm > 0) & (g_norm > 0),
+                          eta * w_norm / (g_norm + wd * w_norm + eps), 1.0)
+        g = (g + wd * p) * trust
+        if momentum:
+            m = momentum * s["mom"] - lr * g
+            return p + m, {"mom": m}
+        return p - lr * g, dict(s)
+    return init, apply
+
+
+def _k_rmsprop(gamma1=0.9, gamma2=0.9, epsilon=1e-8, centered=False,
+               clip_weights=None):
+    def init(p):
+        if centered:
+            return {"n": jnp.zeros_like(p), "g": jnp.zeros_like(p),
+                    "d": jnp.zeros_like(p)}
+        return {"n": jnp.zeros_like(p)}
+
+    def apply(p, g, s, lr, wd):
+        g = g + wd * p
+        if not centered:
+            n = (1 - gamma1) * jnp.square(g) + gamma1 * s["n"]
+            w = p - lr * g / jnp.sqrt(n + epsilon)
+            new_s = {"n": n}
+        else:
+            n = (1 - gamma1) * jnp.square(g) + gamma1 * s["n"]
+            gbar = (1 - gamma1) * g + gamma1 * s["g"]
+            d = gamma2 * s["d"] - lr * g / jnp.sqrt(
+                n - jnp.square(gbar) + epsilon)
+            w = p + d
+            new_s = {"n": n, "g": gbar, "d": d}
+        if clip_weights:
+            w = jnp.clip(w, -clip_weights, clip_weights)
+        return w, new_s
+    return init, apply
+
+
+_FUSED_KERNELS = {
+    "sgd": _k_sgd,
+    "nag": lambda **kw: _k_sgd(nesterov=True, **kw),
+    "adam": _k_adam,
+    "adamw": lambda **kw: _k_adam(decoupled_wd=True, **kw),
+    "lamb": _k_lamb,
+    "lars": _k_lars,
+    "rmsprop": _k_rmsprop,
+}
+
+
+def fused_rule(name, clip_gradient=None, **hyper):
+    """Return ``(init, apply)`` pure update kernels for optimizer ``name``.
+
+    ``apply(p, g, state, lr, wd)`` — jit/vmap/shard_map-safe; used by
+    ``parallel.DataParallelTrainer`` to fold every parameter update into the
+    one compiled train step. Raises for optimizers without a functional
+    kernel (use the eager ``gluon.Trainer`` path for those).
+    """
+    factory = _FUSED_KERNELS.get(name.lower() if isinstance(name, str)
+                                 else name)
+    if factory is None:
+        raise MXNetError(
+            f"no fused kernel for optimizer '{name}'; supported: "
+            f"{sorted(_FUSED_KERNELS)}")
+    init, kernel = factory(**hyper)
+
+    def apply(p, g, s, lr, wd=0.0):
+        if clip_gradient is not None:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return kernel(p, g, s, lr, wd)
+    return init, apply
 
 
 class Optimizer:
@@ -126,10 +282,16 @@ class Optimizer:
     def set_wd_mult(self, args_wd_mult):
         self.wd_mult = dict(args_wd_mult)
 
-    def _preprocess_grad(self, g, w, wd):
+    def _rescale_clip(self, g):
+        """Common grad preprocessing: rescale then clip (wd is applied by
+        the caller or inside the functional kernel)."""
         g = g * self.rescale_grad
         if self.clip_gradient is not None:
             g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        return g
+
+    def _preprocess_grad(self, g, w, wd):
+        g = self._rescale_clip(g)
         if wd:
             g = g + wd * w
         return g
@@ -140,6 +302,8 @@ class SGD(Optimizer):
     """SGD with momentum. Reference: optimizer.SGD + sgd_mom_update kernel
     (src/operator/optimizer_op.cc). Lazy sparse updates are accepted and
     executed densely (XLA has no sparse apply)."""
+
+    _nesterov = False
 
     def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
         super().__init__(**kwargs)
@@ -155,29 +319,20 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad.data, weight.data, wd)
-        if state is None:
-            weight._set_data(weight.data - lr * g)
-        else:
-            m = self.momentum * state.data - lr * g
-            state._set_data(m)
-            weight._set_data(weight.data + m)
+        g = self._rescale_clip(grad.data)
+        _, apply = _k_sgd(momentum=self.momentum, nesterov=self._nesterov)
+        s = {"mom": state.data} if state is not None else {}
+        new_w, new_s = apply(weight.data, g, s, lr, wd)
+        if state is not None:
+            state._set_data(new_s["mom"])
+        weight._set_data(new_w)
 
 
 @register
 class NAG(SGD):
     """Nesterov accelerated SGD. Reference: optimizer.NAG."""
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad.data, weight.data, wd)
-        if state is None:
-            weight._set_data(weight.data - lr * g)
-        else:
-            m = self.momentum * state.data + g
-            state._set_data(m)
-            weight._set_data(weight.data - lr * (g + self.momentum * m))
+    _nesterov = True
 
 
 @register
@@ -197,41 +352,29 @@ class Adam(Optimizer):
                             weight.context)
         return (z(), z())  # mean, var
 
+    _decoupled_wd = False
+
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
-        coef1 = 1.0 - self.beta1 ** t
-        coef2 = 1.0 - self.beta2 ** t
-        lr_t = lr * math.sqrt(coef2) / coef1
         mean, var = state
-        g = self._preprocess_grad(grad.data, weight.data, wd)
-        m = self.beta1 * mean.data + (1 - self.beta1) * g
-        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
-        mean._set_data(m)
-        var._set_data(v)
-        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon))
+        g = self._rescale_clip(grad.data)
+        _, apply = _k_adam(beta1=self.beta1, beta2=self.beta2,
+                           epsilon=self.epsilon,
+                           decoupled_wd=self._decoupled_wd)
+        s = {"m": mean.data, "v": var.data, "t": t - 1}
+        new_w, new_s = apply(weight.data, g, s, lr, wd)
+        mean._set_data(new_s["m"])
+        var._set_data(new_s["v"])
+        weight._set_data(new_w)
 
 
 @register
 class AdamW(Adam):
     """Decoupled weight decay (reference: contrib adamw_update op)."""
 
-    def update(self, index, weight, grad, state):
-        self._update_count(index)
-        lr, wd = self._get_lr(index), self._get_wd(index)
-        t = self._index_update_count[index]
-        lr_t = lr * math.sqrt(1 - self.beta2 ** t) / (1 - self.beta1 ** t)
-        mean, var = state
-        g = grad.data * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        m = self.beta1 * mean.data + (1 - self.beta1) * g
-        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
-        mean._set_data(m)
-        var._set_data(v)
-        weight._set_data(weight.data - lr_t * m / (jnp.sqrt(v) + self.epsilon)
-                         - lr * wd * weight.data)
+    _decoupled_wd = True
 
 
 @register
@@ -303,25 +446,22 @@ class RMSProp(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = self._preprocess_grad(grad.data, weight.data, wd)
-        if not self.centered:
-            (n,) = state
-            n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
-            n._set_data(n_new)
-            w = weight.data - lr * g / jnp.sqrt(n_new + self.epsilon)
-        else:
+        g = self._rescale_clip(grad.data)
+        _, apply = _k_rmsprop(gamma1=self.gamma1, gamma2=self.gamma2,
+                              epsilon=self.epsilon, centered=self.centered,
+                              clip_weights=self.clip_weights)
+        if self.centered:
             n, gbar, delta = state
-            n_new = (1 - self.gamma1) * jnp.square(g) + self.gamma1 * n.data
-            g_new = (1 - self.gamma1) * g + self.gamma1 * gbar.data
-            d_new = self.gamma2 * delta.data - lr * g / jnp.sqrt(
-                n_new - jnp.square(g_new) + self.epsilon)
-            n._set_data(n_new)
-            gbar._set_data(g_new)
-            delta._set_data(d_new)
-            w = weight.data + d_new
-        if self.clip_weights:
-            w = jnp.clip(w, -self.clip_weights, self.clip_weights)
-        weight._set_data(w)
+            s = {"n": n.data, "g": gbar.data, "d": delta.data}
+        else:
+            (n,) = state
+            s = {"n": n.data}
+        new_w, new_s = apply(weight.data, g, s, lr, wd)
+        n._set_data(new_s["n"])
+        if self.centered:
+            gbar._set_data(new_s["g"])
+            delta._set_data(new_s["d"])
+        weight._set_data(new_w)
 
 
 @register
@@ -408,27 +548,17 @@ class LAMB(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
         mean, var = state
-        g = grad.data * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        m = self.beta1 * mean.data + (1 - self.beta1) * g
-        v = self.beta2 * var.data + (1 - self.beta2) * jnp.square(g)
-        mean._set_data(m)
-        var._set_data(v)
-        if self.bias_correction:
-            m_hat = m / (1 - self.beta1 ** t)
-            v_hat = v / (1 - self.beta2 ** t)
-        else:
-            m_hat, v_hat = m, v
-        update = m_hat / (jnp.sqrt(v_hat) + self.epsilon) + wd * weight.data
-        w_norm = jnp.linalg.norm(weight.data)
-        u_norm = jnp.linalg.norm(update)
-        if self.lower_bound is not None:
-            w_norm = jnp.maximum(w_norm, self.lower_bound)
-        if self.upper_bound is not None:
-            w_norm = jnp.minimum(w_norm, self.upper_bound)
-        ratio = jnp.where((w_norm > 0) & (u_norm > 0), w_norm / u_norm, 1.0)
-        weight._set_data(weight.data - lr * ratio * update)
+        g = self._rescale_clip(grad.data)
+        _, apply = _k_lamb(beta1=self.beta1, beta2=self.beta2,
+                           epsilon=self.epsilon,
+                           lower_bound=self.lower_bound,
+                           upper_bound=self.upper_bound,
+                           bias_correction=self.bias_correction)
+        s = {"m": mean.data, "v": var.data, "t": t - 1}
+        new_w, new_s = apply(weight.data, g, s, lr, wd)
+        mean._set_data(new_s["m"])
+        var._set_data(new_s["v"])
+        weight._set_data(new_w)
 
 
 @register
@@ -444,21 +574,14 @@ class LARS(SGD):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
-        g = grad.data * self.rescale_grad
-        if self.clip_gradient is not None:
-            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
-        w_norm = jnp.linalg.norm(weight.data)
-        g_norm = jnp.linalg.norm(g)
-        trust = jnp.where((w_norm > 0) & (g_norm > 0),
-                          self.eta * w_norm /
-                          (g_norm + wd * w_norm + self.eps), 1.0)
-        g = (g + wd * weight.data) * trust
+        g = self._rescale_clip(grad.data)
+        _, apply = _k_lars(eta=self.eta, eps=self.eps,
+                           momentum=self.momentum)
+        s = {"mom": state.data} if state is not None else {}
+        new_w, new_s = apply(weight.data, g, s, lr, wd)
         if state is not None:
-            m = self.momentum * state.data - lr * g
-            state._set_data(m)
-            weight._set_data(weight.data + m)
-        else:
-            weight._set_data(weight.data - lr * g)
+            state._set_data(new_s["mom"])
+        weight._set_data(new_w)
 
 
 @register

@@ -9,37 +9,19 @@ Must run before any jax import in the test process.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = \
-        (flags + " --xla_force_host_platform_device_count=8").strip()
-os.environ["JAX_PLATFORMS"] = "cpu"
-os.environ.setdefault("MXTPU_SYNTHETIC_DATA", "1")
-
-# The axon TPU sitecustomize (PYTHONPATH) force-registers the TPU plugin in
-# every interpreter; a wedged TPU tunnel would then hang ANY jax.devices()
-# call, even under JAX_PLATFORMS=cpu. Deregister the factory before any
-# backend initialization so CPU-only test runs can never block on the
-# tunnel.
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-try:
-    from jax._src import xla_bridge as _xb
-    for _name in list(getattr(_xb, "_backend_factories", {})):
-        if _name not in ("cpu", "interpreter"):
-            _xb._backend_factories.pop(_name, None)
-except Exception:
-    pass
-
-# The sitecustomize may have imported jax already, in which case jax's
-# config captured JAX_PLATFORMS=axon at interpreter start; override at the
-# config level too (env alone is read only once).
-try:
-    import jax
-    jax.config.update("jax_platforms", "cpu")
-except Exception:
-    pass
-
 repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 if repo_root not in sys.path:
     sys.path.insert(0, repo_root)
+
+os.environ.setdefault("MXTPU_SYNTHETIC_DATA", "1")
+
+# Shared axon-sitecustomize defense (see _cpu_defense.py): a wedged TPU
+# tunnel would otherwise hang ANY jax.devices() call, even under
+# JAX_PLATFORMS=cpu. Must run before any backend initialization.
+from _cpu_defense import force_cpu
+
+n = 8
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" in flags:
+    n = None  # caller already chose a device count; keep it
+force_cpu(n)

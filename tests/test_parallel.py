@@ -141,3 +141,55 @@ def test_ps_embedding_store():
     from mxnet_tpu.parallel import ps as ps_mod
     names = [n for n in dir(ps_mod) if not n.startswith("_")]
     assert names, "ps module must export something"
+
+
+@needs8
+@pytest.mark.parametrize("opt,params", [
+    ("sgd", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-3}),
+    ("nag", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-3}),
+    ("adam", {"learning_rate": 0.01, "wd": 1e-3}),
+    ("adamw", {"learning_rate": 0.01, "wd": 1e-2}),
+    ("lamb", {"learning_rate": 0.01, "wd": 1e-2}),
+    ("lars", {"learning_rate": 0.05, "momentum": 0.9, "wd": 1e-3}),
+    ("rmsprop", {"learning_rate": 0.01, "wd": 1e-3}),
+])
+def test_fused_trainer_matches_eager_optimizer(opt, params):
+    """Fused and eager paths share one kernel (optimizer.fused_rule):
+    3 steps of DataParallelTrainer must equal 3 steps of gluon.Trainer
+    (VERDICT r1 #6 parity contract)."""
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    def build():
+        net = gluon.nn.Dense(4)
+        net.initialize()
+        net(nd.zeros((2, 8)))
+        for p in net.collect_params().values():
+            p.set_data(nd.array(np.random.RandomState(1)
+                                .randn(*p.shape).astype(np.float32) * 0.1))
+        return net
+
+    rs = np.random.RandomState(2)
+    xs = [nd.array(rs.randn(8, 8).astype(np.float32)) for _ in range(3)]
+    ys = [nd.array(rs.randint(0, 4, (8,))) for _ in range(3)]
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    ref = build()
+    tr = gluon.Trainer(ref.collect_params(), opt, dict(params))
+    for x, y in zip(xs, ys):
+        with autograd.record():
+            loss = loss_fn(ref(x), y).mean()
+        loss.backward()
+        tr.step(1)
+
+    net = build()
+    mesh = make_mesh({"dp": 8})
+    with mesh_scope(mesh):
+        dpt = DataParallelTrainer(net, loss_fn, opt, dict(params), mesh=mesh)
+        for x, y in zip(xs, ys):
+            dpt.step(x, y)
+
+    for (_, pr), (_, pn) in zip(sorted(ref.collect_params().items()),
+                                sorted(net.collect_params().items())):
+        np.testing.assert_allclose(pr.data().asnumpy(),
+                                   pn.data().asnumpy(), rtol=2e-4,
+                                   atol=2e-5)

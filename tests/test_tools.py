@@ -84,4 +84,4 @@ def test_launch_local_dist_kvstore(tmp_path):
          "-n", "2", "--launcher", "local", sys.executable, str(script)],
         capture_output=True, text=True, timeout=300, env=_cpu_env())
     assert r.returncode == 0, r.stderr + r.stdout
-    assert r.stdout.count("WORKER_OK") == 0 or True
+    assert r.stdout.count("WORKER_OK") == 2, r.stdout + r.stderr
