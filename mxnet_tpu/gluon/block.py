@@ -338,6 +338,7 @@ class CachedOp:
         self._param_objs = None  # ordered params
         self._out_tree = {}      # train_mode -> (n_out, structure)
         self._aux_params = {}    # train_mode -> [Parameter]
+        self._in_avals = None    # last input signature (for export)
 
     def _collect(self):
         if self._param_objs is None:
@@ -390,6 +391,8 @@ class CachedOp:
         key = _rnd.next_key()
         n_params = len(params)
         inputs = [p.data() for p in params] + list(args)
+        self._in_avals = [jax.ShapeDtypeStruct(a.data.shape, a.data.dtype)
+                          for a in args]
 
         if train not in self._out_tree:
             # trace abstractly once to learn output structure
@@ -520,37 +523,58 @@ class HybridBlock(Block):
     def export(self, path, epoch=0):
         """Serialize the traced computation (StableHLO via jax.export) plus
         parameters. Writes, like the reference (Block.export):
-          path-symbol.json   (metadata stub for ecosystem compat)
+          path-symbol.json   (metadata: param order, input avals, out tree)
           path-symbol.mlir   (the real artifact: serialized StableHLO)
           path-%04d.params   (arg:/aux:-prefixed parameter file)
         Requires at least one forward pass (to know input signatures) —
-        same constraint as the reference."""
-        if self._cached_op is None or not self._cached_op._jitted:
+        same constraint as the reference. ``SymbolBlock.imports`` reloads
+        and runs the artifact with NO Python model class."""
+        cached = self._cached_op
+        if cached is None or cached._in_avals is None:
             raise MXNetError(
                 "Please first call block.hybridize() and then run forward "
                 "with this block at least once before calling export.")
-        cached = self._cached_op
-        train = False if False in cached._jitted else \
-            list(cached._jitted)[0]
+        from jax import export as jax_export
         params = cached._collect()
         arg_dict = {}
         for p in params:
             arg_dict[("aux:" if p.grad_req == "null" else "arg:") + p.name] = \
                 p.data()
         nd_utils.save(f"{path}-{epoch:04d}.params", arg_dict)
+
+        # Trace an inference-mode pure function over (params..., inputs...)
+        # and serialize it. The PRNG key is baked in as a constant — dropout
+        # etc. are identity in eval mode anyway.
+        key = jax.random.PRNGKey(0)
+        pure = cached._make_pure(False)
+        n_params = len(params)
+
+        def infer_fn(*arrs):
+            outs = pure(key, arrs[:n_params], arrs[n_params:])
+            n_out, _ = cached._out_tree[False]
+            return outs[:n_out]
+
+        in_avals = (
+            [jax.ShapeDtypeStruct(p.shape, p.data().data.dtype)
+             for p in params] + list(cached._in_avals))
+        exp = jax_export.export(jax.jit(infer_fn))(*in_avals)
+        with open(f"{path}-symbol.mlir", "wb") as f:
+            f.write(exp.serialize())
+
+        n_out, tree = cached._out_tree[False]
         meta = {
             "format": "mxnet_tpu-stablehlo-v1",
             "name": self.name,
-            "params": [p.name for p in params],
-            "train_mode": bool(train),
+            "params": [("aux:" if p.grad_req == "null" else "arg:") + p.name
+                       for p in params],
+            "inputs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
+                       for a in cached._in_avals],
+            "n_out": n_out,
+            "out_tree": tree,
             "nodes": [],  # symbol.json stub for tools that parse it
         }
         with open(f"{path}-symbol.json", "w") as f:
             json.dump(meta, f, indent=2)
-        export_blob = getattr(self, "_export_blob", None)
-        if export_blob is not None:
-            with open(f"{path}-symbol.mlir", "wb") as f:
-                f.write(export_blob)
         return f"{path}-symbol.json"
 
 
@@ -558,32 +582,77 @@ class SymbolBlock(Block):
     """Run a previously exported computation as a Block.
     Reference: gluon/block.py SymbolBlock.imports(json, input_names, params).
 
-    On the TPU rebuild the portable artifact is params + the model-zoo
-    constructor; SymbolBlock.imports loads params into a rebuilt network or
-    wraps a raw callable."""
+    The portable artifact is the serialized-StableHLO ``-symbol.mlir`` next
+    to the ``-symbol.json``: ``imports`` deserializes it (jax.export) and
+    runs it with NO Python model class. A ``builder`` callable is an
+    optional alternative that rebuilds the network from code (useful when
+    further training is needed — the mlir path is inference-only)."""
 
     def __init__(self, outputs=None, inputs=None, params=None):
         super().__init__(prefix="", params=None)
         self._fn = outputs if callable(outputs) else None
         self._arg_params = params or {}
+        self._exported = None
+        self._param_arrays = None
+        self._out_tree = None
 
     @staticmethod
     def imports(symbol_file, input_names, param_file=None, ctx=None,
                 builder=None):
         with open(symbol_file) as f:
             meta = json.load(f)
-        if builder is None:
+        if builder is not None:
+            net = builder()
+            if param_file:
+                net.load_parameters(param_file, ctx=ctx)
+            return net
+        mlir_file = str(symbol_file).replace("-symbol.json", "-symbol.mlir")
+        if not os.path.exists(mlir_file):
             raise MXNetError(
-                "SymbolBlock.imports on the TPU rebuild needs `builder`: a "
-                "zero-arg callable returning the network (e.g. a model_zoo "
-                "constructor). The exported graph is XLA-compiled, not a "
-                "portable nnvm json (see SURVEY.md §2.1 Symbol row).")
-        net = builder()
-        if param_file:
-            net.load_parameters(param_file, ctx=ctx)
-        return net
+                f"no serialized program next to {symbol_file} (expected "
+                f"{mlir_file}); re-export with this version or pass "
+                "`builder` (a zero-arg callable returning the network)")
+        from jax import export as jax_export
+        with open(mlir_file, "rb") as f:
+            exported = jax_export.deserialize(f.read())
+        blk = SymbolBlock()
+        blk._exported = exported
+        blk._out_tree = meta.get("out_tree", "single")
+        param_names = meta.get("params", [])
+        if param_names:
+            if not param_file:
+                raise MXNetError(
+                    "exported program has parameters; pass param_file")
+            loaded = nd_utils.load(param_file)
+            try:
+                blk._param_arrays = [loaded[n].data for n in param_names]
+            except KeyError as e:
+                raise MXNetError(
+                    f"param file {param_file} is missing key {e} required "
+                    f"by {symbol_file}")
+        else:
+            blk._param_arrays = []
+        return blk
 
     def forward(self, *args):
+        if self._exported is not None:
+            arrs = [a.data if isinstance(a, NDArray) else jnp.asarray(a)
+                    for a in args]
+            ctx = args[0]._ctx if args and isinstance(args[0], NDArray) \
+                else current_context()
+            outs = self._exported.call(*self._param_arrays, *arrs)
+            if not isinstance(outs, (list, tuple)):
+                outs = (outs,)
+            results = [NDArray(o, ctx) for o in outs]
+            return _unflatten_output(results, _json_tree(self._out_tree))
         if self._fn is None:
             raise MXNetError("SymbolBlock has no callable attached")
         return self._fn(*args)
+
+
+def _json_tree(tree):
+    """Out-tree structure round-tripped through JSON (lists for tuples)."""
+    if tree == "single":
+        return "single"
+    tag, typename, subtrees = tree
+    return (tag, typename, [(_json_tree(s), n) for s, n in subtrees])
