@@ -88,6 +88,58 @@ class Node:
         self.name = name
 
 
+class SparseCotangent:
+    """A row-sparse cotangent flowing through backward: (row indices,
+    row values, dense shape). Produced by ops with ``sparse_grad=True``
+    (Embedding); accumulated leaf-side without densifying — the memory
+    contract of reference row_sparse gradients (SURVEY.md §2.5)."""
+
+    __slots__ = ("indices", "values", "shape")
+
+    def __init__(self, indices, values, shape):
+        self.indices = indices   # jnp int array (rows,)
+        self.values = values     # jnp array (rows, ...)
+        self.shape = tuple(shape)
+
+    @property
+    def dtype(self):
+        return self.values.dtype
+
+    def densify(self):
+        return jnp.zeros(self.shape, self.values.dtype) \
+            .at[self.indices].set(self.values)
+
+    def merge(self, other):
+        """Sum with another sparse cotangent of the same dense shape —
+        indices concat now, dedup deferred to materialization."""
+        return SparseCotangent(
+            jnp.concatenate([self.indices, other.indices]),
+            jnp.concatenate([self.values, other.values], axis=0),
+            self.shape)
+
+    def dedup(self):
+        from .ndarray.sparse import sum_duplicate_rows
+        uniq, summed = sum_duplicate_rows(self.indices, self.values)
+        return SparseCotangent(uniq, summed, self.shape)
+
+    def astype(self, dtype):
+        return SparseCotangent(self.indices, self.values.astype(dtype),
+                               self.shape)
+
+
+def _add_cotangents(a, b):
+    """Sum two cotangents, either of which may be sparse."""
+    a_sp = isinstance(a, SparseCotangent)
+    b_sp = isinstance(b, SparseCotangent)
+    if a_sp and b_sp:
+        return a.merge(b)
+    if a_sp:
+        return b.at[a.indices].add(a.values)
+    if b_sp:
+        return a.at[b.indices].add(b.values)
+    return a + b
+
+
 def _on_tape(arr):
     return arr._grad_req != "null" or arr._node is not None
 
@@ -114,7 +166,7 @@ def apply_op(fn, inputs, n_out=1, name=""):
     return outs, None
 
 
-def mark_variable(arr, grad_req="write"):
+def mark_variable(arr, grad_req="write", stype=None):
     """attach_grad: reference Imperative::MarkVariables."""
     if grad_req not in ("write", "add", "null"):
         raise MXNetError(f"invalid grad_req {grad_req!r}")
@@ -124,6 +176,10 @@ def mark_variable(arr, grad_req="write"):
     arr._node = None
     arr._out_index = 0
     if grad_req == "null":
+        arr._grad = None
+    elif stype == "row_sparse":
+        # no dense zero buffer: the first backward installs a
+        # RowSparseNDArray grad with memory O(nnz)
         arr._grad = None
     else:
         arr._grad = jnp.zeros(arr.shape, arr.dtype)
@@ -154,7 +210,8 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     def _leaf_accumulate(arr, g):
         if id(arr) in leaf_grads:
-            leaf_grads[id(arr)] = (arr, leaf_grads[id(arr)][1] + g)
+            leaf_grads[id(arr)] = (arr, _add_cotangents(
+                leaf_grads[id(arr)][1], g))
         else:
             leaf_grads[id(arr)] = (arr, g)
 
@@ -206,6 +263,10 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if g is None:
                 continue
             if inp._node is not None and inp._node.vjp_fn is not None:
+                # upstream pullbacks are dense jax.vjp closures — a sparse
+                # cotangent headed into one must materialize
+                if isinstance(g, SparseCotangent):
+                    g = g.densify()
                 pnode, pidx = inp._node, inp._out_index
                 pnode.out_grads[pidx] = _accumulate(pnode.out_grads[pidx], g)
             # an intermediate with attach_grad'd grad_req receives its grad
@@ -277,8 +338,25 @@ def replay_function(heads, variables):
 def _apply_grad_req(arr, g):
     if g.dtype != arr.dtype:
         g = g.astype(arr.dtype)
-    if arr._grad_req == "add" and arr._grad is not None:
-        arr._grad = arr._grad + g
+    if isinstance(g, SparseCotangent):
+        from .ndarray.sparse import RowSparseNDArray
+        prev = arr._grad
+        if arr._grad_req == "add" and isinstance(prev, RowSparseNDArray):
+            g = SparseCotangent(prev.indices.data, prev.values.data,
+                                g.shape).merge(g)
+        elif arr._grad_req == "add" and prev is not None:
+            # dense accumulator already exists (attach_grad default)
+            arr._grad = prev.at[g.indices].add(g.values)
+            arr._grad_fresh = True
+            return
+        g = g.dedup()
+        arr._grad = RowSparseNDArray(g.values, g.indices, g.shape, arr._ctx)
+    elif arr._grad_req == "add" and arr._grad is not None:
+        prev = arr._grad
+        from .ndarray.sparse import RowSparseNDArray
+        if isinstance(prev, RowSparseNDArray):
+            prev = prev.data
+        arr._grad = prev + g
     else:
         arr._grad = g
     arr._grad_fresh = True

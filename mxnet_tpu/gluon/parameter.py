@@ -71,7 +71,7 @@ class Parameter:
             req = "null"
         self._grad_req = req
         if self._data is not None:
-            self._data.attach_grad(req)
+            self._data.attach_grad(req, stype=self._grad_stype)
 
     @property
     def stype(self):
@@ -125,7 +125,8 @@ class Parameter:
         self._data = data
         self._deferred_init = None
         if self._grad_req != "null":
-            self._data.attach_grad(self._grad_req)
+            self._data.attach_grad(self._grad_req,
+                                   stype=self._grad_stype)
 
     def _finish_deferred_init(self, in_shape=None):
         """Called by layers once the input shape is known."""
@@ -186,7 +187,11 @@ class Parameter:
 
     def zero_grad(self):
         if self._data is not None and self._data._grad is not None:
-            self._data._grad = jnp.zeros(self._data.shape, self._data.data.dtype)
+            if self._grad_stype == "row_sparse":
+                self._data._grad = None    # next backward re-installs O(nnz)
+            else:
+                self._data._grad = jnp.zeros(self._data.shape,
+                                             self._data.data.dtype)
 
     def set_data(self, data):
         if isinstance(data, NDArray):
@@ -198,7 +203,8 @@ class Parameter:
             self._deferred_init = None
             self._data = NDArray(data, self._ctx or current_context())
             if self._grad_req != "null":
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
             return
         if tuple(data.shape) != self.shape:
             raise MXNetError(
@@ -210,7 +216,8 @@ class Parameter:
         if self._data is not None:
             self._data = self._data.as_in_context(self._ctx)
             if self._grad_req != "null":
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
 
     def cast(self, dtype):
         self.dtype = dtype
@@ -218,7 +225,8 @@ class Parameter:
             had_grad = self._data._grad is not None
             self._data = self._data.astype(dtype)
             if had_grad or self._grad_req != "null":
-                self._data.attach_grad(self._grad_req)
+                self._data.attach_grad(self._grad_req,
+                                       stype=self._grad_stype)
 
     # sharding annotation for pjit paths (TPU-native extension)
     def shard(self, spec):

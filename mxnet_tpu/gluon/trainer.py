@@ -91,12 +91,26 @@ class Trainer:
         if self._kvstore is None or self._kvstore.num_workers <= 1 and \
                 type(self._kvstore).__name__ == "KVStoreLocal":
             return
+        # one batched pushpull: the dist store coalesces the list into
+        # BIGARRAY_BOUND-sized buckets — one wire round per bucket instead
+        # of one per tensor
+        keys, grads, params = [], [], []
         for i, param in enumerate(self._params):
             if param.grad_req != "null" and param._data is not None and \
                     param._data._grad is not None:
-                grad = param.grad()
-                self._kvstore.pushpull(i, grad, out=grad)
-                param._data._grad = grad.data
+                keys.append(i)
+                grads.append(param.grad())
+                params.append(param)
+        if keys:
+            self._kvstore.pushpull(keys, grads, out=grads)
+            for param, grad in zip(params, grads):
+                if grad.stype == "row_sparse":
+                    # keep the compressed pair — .data here would
+                    # materialize a vocab-sized dense grad and disable the
+                    # optimizer's lazy row update
+                    param._data._grad = grad
+                else:
+                    param._data._grad = grad.data
 
     def allreduce_grads(self):
         if not self._kv_initialized:

@@ -167,14 +167,16 @@ class Module(BaseModule):
         self.symbol = symbol
         self._data_names = list(data_names)
         self._label_names = list(label_names or [])
-        self._context = context if not isinstance(context, (list, tuple)) \
-            else (context[0] if context else None)
+        self._contexts = (list(context) if isinstance(context, (list, tuple))
+                          else ([context] if context is not None else []))
+        self._context = self._contexts[0] if self._contexts else None
         self._fixed_param_names = set(fixed_param_names or [])
         self._exec = None
         self._optimizer = None
         self._updater_states = {}
         self._kvstore = None
         self._batch_size = None
+        self._mesh = None   # multi-device DP: set by bind when len(ctx) > 1
 
     # -- bind -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -209,8 +211,54 @@ class Module(BaseModule):
         first = data_shapes[0]
         self._batch_size = (first.shape if hasattr(first, "shape")
                             else first[1])[0]
+        if len(self._contexts) > 1:
+            self._bind_mesh()
         self.binded = True
         self.for_training = for_training
+
+    def _bind_mesh(self):
+        """Multi-context bind = the DataParallelExecutorGroup role
+        (reference python/mxnet/module/executor_group.py, SURVEY.md §3.4):
+        instead of one executor per context with explicit batch slicing,
+        the contexts form a 'dp' mesh — parameters are replicated over it,
+        the batch is sharded over it in forward(), and every eager op then
+        executes SPMD with the gradient psum implied by the sharding
+        algebra."""
+        import jax
+        import numpy as _np2
+        from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+        devs = []
+        for c in self._contexts:
+            d = getattr(c, "jax_device", None)
+            if d is None:
+                idx = getattr(c, "device_id", 0) or 0
+                d = jax.devices()[idx % len(jax.devices())]
+            devs.append(d)
+        if self._batch_size and self._batch_size % len(devs):
+            raise MXNetError(
+                f"batch size {self._batch_size} must be divisible by the "
+                f"number of contexts {len(devs)}")
+        self._mesh = Mesh(_np2.array(devs), ("dp",))
+
+    def _replicate_params(self):
+        """Pin parameters replicated on the dp mesh. Runs AFTER they hold
+        their real values (init_params/set_params overwrite data, so
+        replicating at bind time would be undone immediately)."""
+        if self._mesh is None:
+            return
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self._mesh, P())
+        for name, arr in self._exec.arg_dict.items():
+            if name not in self._data_names and \
+                    name not in self._label_names:
+                arr._set_data(jax.device_put(arr.data, rep))
+
+    def _shard_batch(self, arr):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        data = arr.data if hasattr(arr, "data") else arr
+        return jax.device_put(data, NamedSharding(self._mesh, P("dp")))
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False,
@@ -225,6 +273,7 @@ class Module(BaseModule):
                 arr._set_data(arg_params[name].data)
             else:
                 initializer(init_mod.InitDesc(name), arr)
+        self._replicate_params()
         self.params_initialized = True
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
@@ -253,10 +302,12 @@ class Module(BaseModule):
             is_train = self.for_training
         feed = {}
         for name, arr in zip(self._data_names, data_batch.data):
-            feed[name] = arr
+            feed[name] = self._shard_batch(arr) if self._mesh is not None \
+                else arr
         if data_batch.label is not None:
             for name, arr in zip(self._label_names, data_batch.label):
-                feed[name] = arr
+                feed[name] = self._shard_batch(arr) \
+                    if self._mesh is not None else arr
         self._exec.forward(is_train=is_train, **feed)
 
     def backward(self, out_grads=None):

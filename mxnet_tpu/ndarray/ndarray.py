@@ -113,6 +113,10 @@ class NDArray:
     def grad(self):
         if self._grad is None:
             return None
+        if isinstance(self._grad, NDArray):
+            # row_sparse grad (sparse_grad=True path): returned directly,
+            # stype preserved for the optimizer's lazy update
+            return self._grad
         out = NDArray(self._grad, self._ctx)
         # the wrapper is a live view: in-place mutation of it (clip, scale)
         # writes back to the owner's gradient buffer (see _set_data), so
@@ -129,7 +133,7 @@ class NDArray:
     # autograd surface (reference: python/mxnet/ndarray/ndarray.py)
     # ------------------------------------------------------------------
     def attach_grad(self, grad_req="write", stype=None):
-        _tape.mark_variable(self, grad_req)
+        _tape.mark_variable(self, grad_req, stype=stype)
 
     def backward(self, out_grad=None, retain_graph=False, train_mode=True):
         _tape.backward([self], [out_grad] if out_grad is not None else None,

@@ -552,10 +552,41 @@ def one_hot(indices, depth, on_value=1.0, off_value=0.0, dtype="float32"):
 @_register
 def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
               sparse_grad=False):
-    """Reference: src/operator/tensor/indexing_op.cc (Embedding)."""
+    """Reference: src/operator/tensor/indexing_op.cc (Embedding).
+
+    ``sparse_grad=True`` installs a row-sparse pullback: the weight
+    cotangent is (unique touched rows, segment-summed values) — memory and
+    compute O(nnz), never O(vocab) (reference kRowSparseStorage grad)."""
     def fn(i, w):
         return jnp.take(w, i.astype(jnp.int32), axis=0)
-    return apply_nary(fn, [_nd(data), weight], name="Embedding")
+    data_nd, weight_nd = _nd(data), _nd(weight)
+    if not sparse_grad:
+        return apply_nary(fn, [data_nd, weight_nd], name="Embedding")
+
+    from .ndarray import NDArray as _ND
+    from .. import _tape
+    outs, node = _tape.apply_op(fn, [data_nd, weight_nd], n_out=1,
+                                name="Embedding(sparse_grad)")
+    if node is not None:
+        import numpy as _np
+        ids_np = _np.asarray(data_nd.data).astype(_np.int64).ravel()
+        uniq, inv = _np.unique(ids_np, return_inverse=True)
+        inv_j = jnp.asarray(inv)
+        uniq_j = jnp.asarray(uniq)
+        vocab_shape = weight_nd.shape
+
+        def sparse_vjp(cot):
+            flat = cot.reshape(-1, cot.shape[-1])
+            vals = jax.ops.segment_sum(flat, inv_j,
+                                       num_segments=uniq_j.shape[0])
+            return (None,
+                    _tape.SparseCotangent(uniq_j, vals, vocab_shape))
+        node.vjp_fn = sparse_vjp
+    out = _ND(outs[0], data_nd._ctx)
+    if node is not None:
+        out._node = node
+        out._out_index = 0
+    return out
 
 
 embedding = Embedding

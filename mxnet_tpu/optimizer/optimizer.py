@@ -319,6 +319,18 @@ class SGD(Optimizer):
     def update(self, index, weight, grad, state):
         self._update_count(index)
         lr, wd = self._get_lr(index), self._get_wd(index)
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update and \
+                self.momentum == 0.0 and not self._nesterov:
+            # lazy row-sparse update: touch only the nnz rows (reference
+            # sgd_update kRowSparseStorage path) — O(nnz) not O(vocab)
+            rows = grad.indices.data
+            g = self._rescale_clip(grad.values.data)
+            w = weight.data
+            if wd:
+                g = g + wd * jnp.take(w, rows, axis=0)
+            weight._set_data(w.at[rows].add(-lr * g))
+            return
         g = self._rescale_clip(grad.data)
         _, apply = _k_sgd(momentum=self.momentum, nesterov=self._nesterov)
         s = {"mom": state.data} if state is not None else {}
@@ -346,6 +358,7 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         z = lambda: NDArray(jnp.zeros(weight.shape, weight.data.dtype),
@@ -359,6 +372,28 @@ class Adam(Optimizer):
         lr, wd = self._get_lr(index), self._get_wd(index)
         t = self._index_update_count[index]
         mean, var = state
+        from ..ndarray.sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and \
+                getattr(self, "lazy_update", True) and \
+                not self._decoupled_wd:
+            # lazy adam (reference adam_update kRowSparseStorage): moments
+            # and weight touched only at nnz rows
+            rows = grad.indices.data
+            g = self._rescale_clip(grad.values.data)
+            w = weight.data
+            if wd:
+                g = g + wd * jnp.take(w, rows, axis=0)
+            m_r = self.beta1 * jnp.take(mean.data, rows, axis=0) + \
+                (1 - self.beta1) * g
+            v_r = self.beta2 * jnp.take(var.data, rows, axis=0) + \
+                (1 - self.beta2) * jnp.square(g)
+            lr_t = lr * math.sqrt(1 - self.beta2 ** t) / \
+                (1 - self.beta1 ** t)
+            mean._set_data(mean.data.at[rows].set(m_r))
+            var._set_data(var.data.at[rows].set(v_r))
+            weight._set_data(w.at[rows].add(
+                -lr_t * m_r / (jnp.sqrt(v_r) + self.epsilon)))
+            return
         g = self._rescale_clip(grad.data)
         _, apply = _k_adam(beta1=self.beta1, beta2=self.beta2,
                            epsilon=self.epsilon,

@@ -1,0 +1,117 @@
+"""Mixture-of-Experts with expert parallelism over an 'ep' mesh axis.
+
+Reference capability (SURVEY.md §2.5 "EP/MoE" row — absent upstream as a
+first-class layer, present here because MoE is a headline TPU workload).
+GShard-style top-k routing with static capacity: dispatch/combine are
+einsums over a (tokens, experts, capacity) one-hot, so every shape is
+static and XLA shards the expert dimension over 'ep' — the all-to-all
+falls out of the sharding algebra instead of being hand-written.
+
+Functional core (``moe_apply``) + a gluon ``MoEDense`` block whose expert
+weights carry a ``P('ep', ...)`` shard spec for the fused trainer.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+
+__all__ = ["moe_apply", "MoEDense", "load_balance_loss"]
+
+
+def _top1_dispatch(logits, capacity):
+    """Top-1 routing with static capacity (GShard §3.2).
+
+    logits: (T, E). Returns dispatch (T, E, C) float 0/1, combine
+    (T, E, C) float (gate-weighted dispatch), plus aux tensors for the
+    load-balancing loss.
+    """
+    T, E = logits.shape
+    gates = jax.nn.softmax(logits, axis=-1)          # (T, E)
+    expert = jnp.argmax(gates, axis=-1)              # (T,)
+    gate = jnp.take_along_axis(gates, expert[:, None], axis=-1)[:, 0]
+    mask = jax.nn.one_hot(expert, E)                 # (T, E)
+    # position of each token within its expert's queue
+    pos = jnp.cumsum(mask, axis=0) * mask            # 1-based where routed
+    keep = (pos <= capacity) & (mask > 0)            # drop overflow tokens
+    pos0 = jnp.clip(pos - 1, 0, capacity - 1).astype(jnp.int32)
+    dispatch = (keep[..., None] *
+                jax.nn.one_hot(pos0, capacity)).astype(logits.dtype)
+    combine = dispatch * gate[:, None, None]
+    return dispatch, combine, gates, mask
+
+
+def load_balance_loss(gates, mask):
+    """GShard aux loss: E * sum_e (mean gate_e * mean routed_e)."""
+    E = gates.shape[-1]
+    density = jnp.mean(mask, axis=0)                 # fraction routed
+    density_proxy = jnp.mean(gates, axis=0)          # mean gate prob
+    return E * jnp.sum(density * density_proxy)
+
+
+def moe_apply(x, router_w, w_up, w_down, *, capacity_factor=1.25,
+              activation=jax.nn.gelu):
+    """Top-1 MoE FFN over tokens.
+
+    x: (T, d); router_w: (d, E); w_up: (E, d, h); w_down: (E, h, d).
+    Returns (y (T, d), aux_loss scalar). Under jit with w_up/w_down sharded
+    P('ep', ...) the per-expert einsums shard over 'ep' and XLA inserts the
+    dispatch all-to-all.
+    """
+    T, d = x.shape
+    E = router_w.shape[-1]
+    capacity = max(1, int(capacity_factor * T / E))
+    logits = x @ router_w                            # (T, E)
+    dispatch, combine, gates, mask = _top1_dispatch(logits, capacity)
+    expert_in = jnp.einsum("tec,td->ecd", dispatch, x)     # (E, C, d)
+    h = activation(jnp.einsum("ecd,edh->ech", expert_in, w_up))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, w_down)     # (E, C, d)
+    y = jnp.einsum("tec,ecd->td", combine, expert_out)
+    return y, load_balance_loss(gates, mask)
+
+
+class MoEDense:
+    """Gluon-flavoured MoE FFN block (functional params, shard-spec'd).
+
+    Deliberately NOT a HybridBlock: MoE lives inside fused jitted steps
+    (DataParallelTrainer / llama), where parameters flow functionally. Use
+    ``init_params(key)`` then ``apply(params, x)``; ``shard_specs()`` gives
+    the 'ep' PartitionSpecs for each weight.
+    """
+
+    def __init__(self, hidden_size, ffn_size, num_experts,
+                 capacity_factor=1.25):
+        if num_experts < 1:
+            raise MXNetError("num_experts must be >= 1")
+        self.hidden_size = hidden_size
+        self.ffn_size = ffn_size
+        self.num_experts = num_experts
+        self.capacity_factor = capacity_factor
+
+    def init_params(self, key):
+        kr, ku, kd = jax.random.split(key, 3)
+        d, h, E = self.hidden_size, self.ffn_size, self.num_experts
+        scale = d ** -0.5
+        return {
+            "router": jax.random.normal(kr, (d, E)) * scale,
+            "w_up": jax.random.normal(ku, (E, d, h)) * scale,
+            "w_down": jax.random.normal(kd, (E, h, d)) * (h ** -0.5),
+        }
+
+    def shard_specs(self, axis="ep"):
+        return {
+            "router": P(),
+            "w_up": P(axis, None, None),
+            "w_down": P(axis, None, None),
+        }
+
+    def apply(self, params, x):
+        """x: (..., d) — flattened to tokens internally."""
+        lead = x.shape[:-1]
+        tokens = x.reshape(-1, x.shape[-1])
+        y, aux = moe_apply(tokens, params["router"], params["w_up"],
+                           params["w_down"],
+                           capacity_factor=self.capacity_factor)
+        return y.reshape(lead + (x.shape[-1],)), aux

@@ -1,0 +1,137 @@
+"""Pipeline parallelism over a 'pp' mesh axis — TPU-native GPipe.
+
+Reference capability (SURVEY.md §2.5 "model parallel" row): upstream MXNet
+placed layer groups on devices with ``group2ctx`` and moved activations with
+explicit copies. The TPU design instead runs ALL stages as one SPMD program:
+stage parameters are stacked on a leading axis sharded over 'pp', and one
+``lax.scan`` over pipeline ticks moves activations between neighbouring
+stages with ``lax.ppermute`` (the activation hop rides ICI, compiled into
+the step). Differentiable end-to-end — ``jax.grad`` through the scan gives
+the 1F1B-equivalent backward for free, so a pipelined training step is just
+``value_and_grad(pipeline_apply)`` under ``jit``.
+
+The schedule is GPipe: with S stages and M microbatches the bubble fraction
+is (S-1)/(M+S-1); choose M >= 4*S for <20% bubble (How to Scale Your Model,
+pipelining chapter).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..base import MXNetError
+
+__all__ = ["pipeline_apply", "stack_stage_params", "Pipeline"]
+
+
+def stack_stage_params(per_stage_params):
+    """Stack a list of per-stage parameter pytrees (identical structure)
+    into one pytree with a leading [n_stages] axis — shard it over 'pp'."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_apply(stage_fn, stacked_params, x, *, mesh, n_microbatches,
+                   axis="pp"):
+    """Run ``x`` through S pipeline stages on the mesh's ``axis``.
+
+    stage_fn(params_one_stage, microbatch) -> microbatch' — the same
+    callable for every stage (homogeneous pipelining, the transformer
+    case). ``stacked_params`` has a leading [S] axis; ``x`` has a leading
+    batch axis that is split into ``n_microbatches``.
+
+    Returns the output batch (same leading shape as x). Differentiable.
+    """
+    n_stages = mesh.shape[axis]
+    batch = x.shape[0]
+    if batch % n_microbatches:
+        raise MXNetError(
+            f"batch {batch} not divisible by n_microbatches "
+            f"{n_microbatches}")
+    mb = batch // n_microbatches
+    micro = x.reshape((n_microbatches, mb) + x.shape[1:])
+
+    def spmd(params_s, micro_all):
+        # params_s: this stage's params (leading axis sliced to 1) — squeeze
+        params_s = jax.tree.map(lambda a: a[0], params_s)
+        stage = lax.axis_index(axis)
+        n_ticks = n_microbatches + n_stages - 1
+        # initial carries must already be device-varying over 'pp' so the
+        # scan carry type stays fixed (shard_map vma typing)
+        state = lax.pcast(
+            jnp.zeros((mb,) + micro_all.shape[2:], micro_all.dtype),
+            axis, to="varying")
+        outputs = lax.pcast(jnp.zeros_like(micro_all), axis, to="varying")
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (if still in range); other
+            # stages consume what arrived from the left neighbour
+            feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+            inp = jnp.where(stage == 0, micro_all[feed_idx], state)
+            out = stage_fn(params_s, inp)
+            # the last stage writes its finished microbatch (t - S + 1)
+            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+            write = (stage == n_stages - 1) & (t >= n_stages - 1)
+            outputs = jnp.where(
+                write,
+                outputs.at[out_idx].set(out),
+                outputs)
+            # shift activations one stage to the right (ring permute; the
+            # wrap-around value into stage 0 is ignored — it re-reads
+            # micro_all)
+            state = lax.ppermute(
+                out, axis,
+                [(i, (i + 1) % n_stages) for i in range(n_stages)])
+            return (state, outputs), None
+
+        (state, outputs), _ = lax.scan(
+            tick, (state, outputs), jnp.arange(n_ticks))
+        # every device carries a full `outputs` buffer but only the last
+        # stage's is real; broadcast it (psum of masked buffer)
+        outputs = lax.psum(
+            jnp.where(stage == n_stages - 1, outputs,
+                      jnp.zeros_like(outputs)), axis)
+        return outputs
+
+    param_spec = jax.tree.map(lambda _: P(axis), stacked_params)
+    fn = shard_map(
+        spmd, mesh=mesh,
+        in_specs=(param_spec, P()),
+        out_specs=P())
+    out = fn(stacked_params, micro)
+    return out.reshape((batch,) + out.shape[2:])
+
+
+class Pipeline:
+    """Convenience wrapper: hold stacked params + jit the pipelined forward.
+
+    Example::
+
+        def stage(params, x):
+            return jnp.tanh(x @ params["w"] + params["b"])
+        pp = Pipeline(stage, [stage0_params, ..., stage3_params],
+                      mesh=make_mesh({"pp": 4}), n_microbatches=8)
+        y = pp(x)
+    """
+
+    def __init__(self, stage_fn, per_stage_params, mesh, n_microbatches,
+                 axis="pp"):
+        self.stage_fn = stage_fn
+        self.mesh = mesh
+        self.axis = axis
+        self.n_microbatches = n_microbatches
+        stacked = stack_stage_params(per_stage_params)
+        self.params = jax.tree.map(
+            lambda a: jax.device_put(a, NamedSharding(
+                mesh, P(*([axis] + [None] * (a.ndim - 1))))), stacked)
+        self._jitted = jax.jit(functools.partial(
+            pipeline_apply, stage_fn, mesh=mesh,
+            n_microbatches=n_microbatches, axis=axis))
+
+    def __call__(self, x):
+        return self._jitted(self.params, x)

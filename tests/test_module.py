@@ -113,3 +113,73 @@ def test_bucketing_module_varlen():
                             label=[nd.zeros((2,))], bucket_key=8)
     mod.forward(batch, is_train=False)
     assert mod.get_outputs()[0].shape == (2, 4)
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 8,
+                    reason="needs 8 virtual devices")
+def test_module_multi_device_data_parallel():
+    """ctx=[cpu(0)..cpu(7)] forms a dp mesh: params replicated, batch
+    sharded — the DataParallelExecutorGroup role (reference
+    module/executor_group.py, SURVEY.md §3.4). Same task must converge
+    and score like the single-device module."""
+    ctxs = [mx.context.Context("cpu", i) for i in range(8)]
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",), context=ctxs)
+    train = _toy_iter(seed=0)
+    val = _toy_iter(seed=1)
+    mod.fit(train, eval_data=val, num_epoch=10,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    assert mod._mesh is not None and mod._mesh.shape["dp"] == 8
+    m = mx.metric.Accuracy()
+    mod.score(val, m)
+    assert m.get()[1] > 0.9
+
+
+def test_module_multi_device_batch_divisibility():
+    ctxs = [mx.context.Context("cpu", i) for i in range(3)]
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",), context=ctxs)
+    with pytest.raises(mx.MXNetError):
+        mod.bind(data_shapes=[("data", (4, 8))],
+                 label_shapes=[("softmax_label", (4,))])
+
+
+def test_mnist_convergence_floor():
+    """BASELINE correctness floor (SURVEY.md §4.5, reference
+    tests/python/train/test_mlp.py): MLP on MNIST must reach >0.98
+    accuracy in <5 epochs. Runs on the synthetic MNIST unless
+    MXTPU_REAL_DATA=1 (no network in CI)."""
+    import os
+    from mxnet_tpu import gluon, autograd
+    from mxnet_tpu.gluon import nn
+    if not os.environ.get("MXTPU_REAL_DATA"):
+        os.environ.setdefault("MXTPU_SYNTHETIC_DATA", "1")
+    train_set = gluon.data.vision.MNIST(train=True)
+    val_set = gluon.data.vision.MNIST(train=False)
+    tf = gluon.data.vision.transforms.ToTensor()
+    train_data = gluon.data.DataLoader(
+        train_set.transform_first(tf), batch_size=100, shuffle=True)
+    val_data = gluon.data.DataLoader(
+        val_set.transform_first(tf), batch_size=100)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    # lr 0.01: the synthetic class-separable set diverges with lr>=0.05 +
+    # momentum (verified against pure jax — optimization, not framework)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.01, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for epoch in range(5):
+        for data, label in train_data:
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+    metric = mx.metric.Accuracy()
+    for data, label in val_data:
+        metric.update([label], [net(data)])
+    assert metric.get()[1] > 0.98, f"val acc {metric.get()[1]}"

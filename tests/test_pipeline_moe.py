@@ -1,0 +1,144 @@
+"""Pipeline parallelism ('pp') and expert-parallel MoE ('ep') on the
+virtual 8-device CPU mesh (SURVEY.md §2.5 rows 59/61 — VERDICT r1 gaps)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.parallel import (make_mesh, pipeline_apply,
+                                stack_stage_params, Pipeline, moe_apply,
+                                MoEDense)
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+def _stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n, d, seed=0):
+    rs = np.random.RandomState(seed)
+    return [{"w": jnp.asarray(rs.randn(d, d).astype(np.float32) * 0.3),
+             "b": jnp.asarray(rs.randn(d).astype(np.float32) * 0.1)}
+            for _ in range(n)]
+
+
+@needs8
+def test_pipeline_matches_serial_forward():
+    d, batch, n_stages = 8, 16, 4
+    mesh = make_mesh({"pp": n_stages}, devices=jax.devices()[:n_stages])
+    stages = _make_stages(n_stages, d)
+    x = jnp.asarray(np.random.RandomState(1).randn(batch, d)
+                    .astype(np.float32))
+    ref = x
+    for p in stages:
+        ref = _stage(p, ref)
+    out = pipeline_apply(_stage, stack_stage_params(stages), x,
+                         mesh=mesh, n_microbatches=8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@needs8
+def test_pipeline_wrapper_and_jit_cache():
+    d = 4
+    mesh = make_mesh({"pp": 2}, devices=jax.devices()[:2])
+    stages = _make_stages(2, d, seed=3)
+    pp = Pipeline(_stage, stages, mesh=mesh, n_microbatches=4)
+    x = jnp.asarray(np.random.RandomState(2).randn(8, d).astype(np.float32))
+    ref = _stage(stages[1], _stage(stages[0], x))
+    np.testing.assert_allclose(np.asarray(pp(x)), np.asarray(ref),
+                               rtol=1e-5, atol=1e-6)
+
+
+@needs8
+def test_pipeline_training_grads_match_serial():
+    """jax.grad through the pipelined scan == grad of the serial net —
+    the GPipe backward falls out of AD."""
+    d, batch, n_stages = 6, 8, 2
+    mesh = make_mesh({"pp": n_stages}, devices=jax.devices()[:n_stages])
+    stages = _make_stages(n_stages, d, seed=5)
+    stacked = stack_stage_params(stages)
+    x = jnp.asarray(np.random.RandomState(4).randn(batch, d)
+                    .astype(np.float32))
+
+    def serial_loss(params_list):
+        h = x
+        for p in params_list:
+            h = _stage(p, h)
+        return jnp.sum(h ** 2)
+
+    def pp_loss(stacked_params):
+        out = pipeline_apply(_stage, stacked_params, x, mesh=mesh,
+                             n_microbatches=4)
+        return jnp.sum(out ** 2)
+
+    g_serial = jax.grad(serial_loss)(stages)
+    g_pp = jax.grad(pp_loss)(stacked)
+    for i in range(n_stages):
+        np.testing.assert_allclose(np.asarray(g_pp["w"][i]),
+                                   np.asarray(g_serial[i]["w"]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(g_pp["b"][i]),
+                                   np.asarray(g_serial[i]["b"]),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_moe_routes_and_reconstructs():
+    """With capacity ample and one dominant expert per token, MoE output
+    equals that expert's FFN on the token (gate-weighted)."""
+    d, h, E, T = 4, 8, 2, 6
+    layer = MoEDense(d, h, E, capacity_factor=4.0)
+    params = layer.init_params(jax.random.PRNGKey(0))
+    # force routing: huge router weights -> saturated softmax
+    router = np.zeros((d, E), np.float32)
+    router[0, 0] = 40.0
+    router[0, 1] = -40.0
+    params["router"] = jnp.asarray(router)
+    rs = np.random.RandomState(0)
+    x = np.abs(rs.randn(T, d)).astype(np.float32)    # x[:,0] > 0 -> expert 0
+    y, aux = layer.apply(params, jnp.asarray(x))
+    w_up = np.asarray(params["w_up"][0])
+    w_down = np.asarray(params["w_down"][0])
+    expected = np.array(jax.nn.gelu(x @ w_up)) @ w_down   # gate ~= 1.0
+    np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-3,
+                               atol=1e-4)
+    assert float(aux) > 0
+
+
+def test_moe_capacity_drops_overflow():
+    d, h, E, T = 4, 4, 2, 8
+    layer = MoEDense(d, h, E, capacity_factor=0.25)   # capacity 1
+    params = layer.init_params(jax.random.PRNGKey(1))
+    router = np.zeros((d, E), np.float32)
+    router[0, 0] = 40.0
+    router[0, 1] = -40.0
+    params["router"] = jnp.asarray(router)
+    x = np.abs(np.random.RandomState(1).randn(T, d)).astype(np.float32)
+    y, _ = layer.apply(params, jnp.asarray(x))
+    y = np.asarray(y)
+    # all tokens route to expert 0, capacity 1 -> only the first token kept
+    assert np.abs(y[0]).max() > 0
+    np.testing.assert_allclose(y[1:], 0, atol=1e-6)
+
+
+@needs8
+def test_moe_expert_parallel_matches_single_device():
+    d, h, E, T = 8, 16, 4, 32
+    layer = MoEDense(d, h, E, capacity_factor=2.0)
+    params = layer.init_params(jax.random.PRNGKey(2))
+    x = jnp.asarray(np.random.RandomState(3).randn(T, d).astype(np.float32))
+    y_ref, aux_ref = layer.apply(params, x)
+
+    mesh = make_mesh({"ep": 4}, devices=jax.devices()[:4])
+    from jax.sharding import NamedSharding
+    specs = layer.shard_specs("ep")
+    sharded = {k: jax.device_put(v, NamedSharding(mesh, specs[k]))
+               for k, v in params.items()}
+    with mesh:
+        y_ep, aux_ep = jax.jit(layer.apply)(sharded, x)
+    np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_ref),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(float(aux_ep), float(aux_ref), rtol=1e-5)
