@@ -1,47 +1,467 @@
-"""``mx.contrib.onnx`` — ONNX import/export.
+"""``mx.contrib.onnx`` — ONNX import/export, self-contained.
 
-Reference: python/mxnet/contrib/onnx/{onnx2mx,mx2onnx}/ (SURVEY.md §2.2).
-The `onnx` pip package is not in this image, so the converters are gated:
-they raise a clear ImportError at call time (same pattern as the reference,
-which requires `pip install onnx`). `export_model` additionally offers the
-TPU-native path: StableHLO export via HybridBlock.export(), which covers
-the reference's main use of ONNX (deploy a trained graph).
+Reference: python/mxnet/contrib/onnx/{mx2onnx,onnx2mx} (SURVEY.md §2.2 row
+45). The ``onnx`` pip package is not in this image, so the IR schema is
+vendored (``onnx_ir.proto`` — field numbers match the public onnx.proto3,
+so the files interoperate with any ONNX tooling) and compiled with protoc
+to ``onnx_ir_pb2.py``. Covered op subset: the vision/MLP graph vocabulary
+(Conv, Gemm, pooling, BatchNorm, activations, Softmax, Flatten, elemwise,
+Concat, Reshape, Dropout) in both directions.
 """
 from __future__ import annotations
+
+import numpy as _np
 
 from ...base import MXNetError
 
 __all__ = ["import_model", "export_model", "get_model_metadata"]
 
-
-def _require_onnx():
-    try:
-        import onnx  # noqa: F401
-        return onnx
-    except ImportError as e:
-        raise ImportError(
-            "ONNX support requires the `onnx` package (reference behavior: "
-            "python/mxnet/contrib/onnx checks the same). For TPU-native "
-            "deployment use HybridBlock.export() which writes StableHLO + "
-            "params instead.") from e
+_OPSET = 13
 
 
-def import_model(model_file):
-    """Reference: onnx_mxnet.import_model -> (sym, arg_params, aux_params)."""
-    _require_onnx()
-    raise MXNetError("ONNX graph conversion to the TPU op registry is not "
-                     "implemented yet; load reference .params checkpoints "
-                     "via mx.nd.load / Block.load_parameters instead.")
+def _pb():
+    from . import onnx_ir_pb2
+    return onnx_ir_pb2
+
+
+# ----------------------------------------------------------------------
+# mx Symbol -> ONNX
+# ----------------------------------------------------------------------
+
+def _shape_attr(kw, key, default=None):
+    v = kw.get(key, default)
+    if v is None:
+        return None
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return (int(v),)
+
+
+def _add_attr(node, name, value, pb):
+    a = node.attribute.add()
+    a.name = name
+    if isinstance(value, float):
+        a.type = pb.AttributeProto.FLOAT
+        a.f = value
+    elif isinstance(value, int):
+        a.type = pb.AttributeProto.INT
+        a.i = value
+    elif isinstance(value, str):
+        a.type = pb.AttributeProto.STRING
+        a.s = value.encode()
+    elif isinstance(value, (list, tuple)):
+        a.type = pb.AttributeProto.INTS
+        a.ints.extend(int(x) for x in value)
+    else:
+        raise MXNetError(f"unsupported attribute {name}={value!r}")
+
+
+def _tensor(pb, name, arr):
+    t = pb.TensorProto()
+    t.name = name
+    arr = _np.asarray(arr)
+    t.dims.extend(arr.shape)
+    if arr.dtype == _np.int64:
+        t.data_type = pb.TensorProto.INT64
+    elif arr.dtype == _np.int32:
+        t.data_type = pb.TensorProto.INT32
+    else:
+        arr = arr.astype(_np.float32)
+        t.data_type = pb.TensorProto.FLOAT
+    t.raw_data = arr.tobytes()
+    return t
 
 
 def export_model(sym, params, input_shape, input_type=None,
                  onnx_file_path="model.onnx", verbose=False):
-    """Reference: export_model. Gated on the `onnx` package."""
-    _require_onnx()
-    raise MXNetError("ONNX export is not implemented; use "
-                     "HybridBlock.export() (StableHLO + params).")
+    """Serialize a Symbol graph + params to an ONNX file.
+
+    ``params``: dict name -> NDArray (Module.get_params()[0] style; an
+    ``arg:``/``aux:`` prefix is stripped). ``input_shape``: the shape of
+    the single data input (or dict name -> shape for several).
+    Returns onnx_file_path. Reference: mx2onnx.export_model.
+    """
+    from ...symbol.symbol import Symbol, _collect_nodes
+    pb = _pb()
+    params = {k.split(":", 1)[-1]: v for k, v in (params or {}).items()}
+
+    model = pb.ModelProto()
+    model.ir_version = 8
+    model.producer_name = "mxnet_tpu"
+    op = model.opset_import.add()
+    op.domain = ""
+    op.version = _OPSET
+    g = model.graph
+    g.name = getattr(sym, "_name", "network")
+
+    seen = {}
+    order = []
+    for node in _collect_nodes(sym):
+        if id(node) not in seen:
+            seen[id(node)] = node
+            order.append(node)
+
+    out_name = {}     # id(Symbol) -> tensor name
+
+    def name_of(s):
+        if s._op is None and s._outputs is None:
+            return s._name
+        return out_name[id(s)]
+
+    label_names = set()
+    for s in order:
+        if s._op in ("SoftmaxOutput", "LinearRegressionOutput",
+                     "LogisticRegressionOutput", "MAERegressionOutput"):
+            for a in s._args[1:]:
+                if isinstance(a, Symbol) and a._op is None:
+                    label_names.add(a._name)
+
+    for s in order:
+        if s._op is None:
+            continue
+        _emit_node(g, s, name_of, out_name, pb)
+
+    used = set()
+    for n in g.node:
+        used.update(n.input)
+    for pname, arr in params.items():
+        if pname in used:
+            g.initializer.append(_tensor(pb, pname, arr.asnumpy()
+                                         if hasattr(arr, "asnumpy")
+                                         else arr))
+    init_names = {t.name for t in g.initializer}
+    shapes = input_shape if isinstance(input_shape, dict) else None
+    free_vars = [s._name for s in order
+                 if s._op is None and s._outputs is None and
+                 s._name not in init_names and
+                 s._name not in label_names and s._name in used]
+    if shapes is None and len(free_vars) > 1:
+        # more than one non-param input with a single shape would stamp
+        # the data shape onto e.g. BatchNorm moving stats missing from
+        # `params` — refuse rather than write a broken file
+        raise MXNetError(
+            f"graph has several non-parameter inputs {free_vars} but one "
+            "input_shape; pass a {name: shape} dict, or include aux "
+            "params (moving_mean/var) in `params` — e.g. "
+            "{**mod.get_params()[0], **mod.get_params()[1]}")
+    for name in free_vars:
+        vi = g.input.add()
+        vi.name = name
+        tt = vi.type.tensor_type
+        tt.elem_type = pb.TensorProto.FLOAT
+        shp = shapes.get(name) if shapes else input_shape
+        if shp is None:
+            raise MXNetError(f"no shape given for graph input '{name}'")
+        for d in shp:
+            tt.shape.dim.add().dim_value = int(d)
+    head = order[-1]
+    out_vi = g.output.add()
+    out_vi.name = name_of(head) if head._op else head._name
+    out_vi.type.tensor_type.elem_type = pb.TensorProto.FLOAT
+
+    with open(onnx_file_path, "wb") as f:
+        f.write(model.SerializeToString())
+    return onnx_file_path
+
+
+def _emit_node(g, s, name_of, out_name, pb):
+    from ...symbol.symbol import Symbol
+    kw = s._kwargs
+    out = s._name
+    out_name[id(s)] = out
+    ins = [name_of(a) for a in s._args
+           if isinstance(a, Symbol) and not (
+               a._op is None and a._outputs is None and
+               a._name.endswith("_label"))]
+
+    def emit(op_type, inputs, outputs=None, **attrs):
+        n = g.node.add()
+        n.op_type = op_type
+        n.name = out + "/" + op_type
+        n.input.extend(inputs)
+        n.output.extend(outputs or [out])
+        for k, v in attrs.items():
+            _add_attr(n, k, v, pb)
+        return n
+
+    op = s._op
+    if op == "FullyConnected":
+        data_in = ins[0]
+        if kw.get("flatten", True):
+            flat = out + "_flat"
+            emit("Flatten", [data_in], [flat], axis=1)
+            data_in = flat
+        emit("Gemm", [data_in] + ins[1:], alpha=1.0, beta=1.0,
+             transA=0, transB=1)
+    elif op == "Convolution":
+        kernel = _shape_attr(kw, "kernel")
+        stride = _shape_attr(kw, "stride", (1,) * len(kernel))
+        pad = _shape_attr(kw, "pad", (0,) * len(kernel))
+        dilate = _shape_attr(kw, "dilate", (1,) * len(kernel))
+        emit("Conv", ins, kernel_shape=kernel, strides=stride,
+             pads=list(pad) * 2, dilations=dilate,
+             group=int(kw.get("num_group", 1)))
+    elif op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus"}.get(kw.get("act_type", "relu"))
+        if act is None:
+            raise MXNetError(f"no ONNX mapping for activation "
+                             f"{kw.get('act_type')!r}")
+        emit(act, ins)
+    elif op == "LeakyReLU":
+        emit("LeakyRelu", ins, alpha=float(kw.get("slope", 0.25)))
+    elif op == "Pooling":
+        kernel = _shape_attr(kw, "kernel", (2, 2))
+        stride = _shape_attr(kw, "stride", kernel)
+        pad = _shape_attr(kw, "pad", (0,) * len(kernel))
+        ptype = kw.get("pool_type", "max")
+        if kw.get("global_pool", False):
+            emit("GlobalMaxPool" if ptype == "max"
+                 else "GlobalAveragePool", ins)
+        else:
+            emit("MaxPool" if ptype == "max" else "AveragePool", ins,
+                 kernel_shape=kernel, strides=stride, pads=list(pad) * 2)
+    elif op in ("SoftmaxOutput", "softmax"):
+        emit("Softmax", ins[:1], axis=-1)
+    elif op in ("LinearRegressionOutput", "MAERegressionOutput"):
+        emit("Identity", ins[:1])
+    elif op == "LogisticRegressionOutput":
+        emit("Sigmoid", ins[:1])
+    elif op == "BatchNorm":
+        emit("BatchNormalization", ins,
+             epsilon=float(kw.get("eps", 1e-5)),
+             momentum=float(kw.get("momentum", 0.9)))
+    elif op == "Flatten":
+        emit("Flatten", ins, axis=1)
+    elif op == "Dropout":
+        emit("Dropout", ins)
+    elif op in ("elemwise_add", "broadcast_add", "_plus", "_Plus"):
+        emit("Add", ins)
+    elif op in ("elemwise_mul", "broadcast_mul", "_mul"):
+        emit("Mul", ins)
+    elif op == "Concat":
+        emit("Concat", ins, axis=int(kw.get("dim", 1)))
+    elif op == "Reshape":
+        shape = _shape_attr(kw, "shape")
+        shape_name = out + "_shape"
+        g.initializer.append(_tensor(pb, shape_name,
+                                     _np.asarray(shape, _np.int64)))
+        emit("Reshape", ins + [shape_name])
+    elif op == "dot":
+        emit("MatMul", ins)
+    elif op == "identity":
+        emit("Identity", ins)
+    else:
+        raise MXNetError(
+            f"op '{op}' has no ONNX export mapping (supported: the "
+            "vision/MLP subset — see contrib/onnx docstring)")
+
+
+# ----------------------------------------------------------------------
+# ONNX -> mx Symbol
+# ----------------------------------------------------------------------
+
+def import_model(model_file):
+    """Parse an ONNX file into (sym, arg_params, aux_params).
+    Reference: onnx2mx.import_model."""
+    pb = _pb()
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+
+    from ... import symbol as mx_sym
+    from ...ndarray.ndarray import array as nd_array
+
+    tensors = {}      # tensor name -> Symbol
+    params_np = {t.name: _tensor_to_np(t, pb) for t in g.initializer}
+    for vi in g.input:
+        if vi.name not in params_np:
+            tensors[vi.name] = mx_sym.var(vi.name)
+    for name in params_np:
+        tensors[name] = mx_sym.var(name)
+
+    fresh = _make_fresh()
+    for node in g.node:
+        _import_node(node, tensors, params_np, mx_sym, fresh, pb)
+
+    out = tensors[g.output[0].name] if g.output else \
+        tensors[list(tensors)[-1]]
+    arg_params, aux_params = {}, {}
+    for name, arr in params_np.items():
+        if arr.dtype == _np.int64:
+            continue    # shape tensors, consumed at graph build
+        nd = nd_array(arr)
+        if "moving_" in name or "running_" in name or ".mean" in name \
+                or ".var" in name:
+            aux_params[name] = nd
+        else:
+            arg_params[name] = nd
+    return out, arg_params, aux_params
+
+
+def _tensor_to_np(t, pb):
+    dt = {pb.TensorProto.FLOAT: _np.float32,
+          pb.TensorProto.INT64: _np.int64,
+          pb.TensorProto.INT32: _np.int32,
+          pb.TensorProto.DOUBLE: _np.float64}.get(t.data_type)
+    if dt is None:
+        raise MXNetError(f"unsupported ONNX tensor dtype {t.data_type}")
+    shape = tuple(t.dims)
+    if t.raw_data:
+        return _np.frombuffer(t.raw_data, dt).reshape(shape).copy()
+    if t.float_data:
+        return _np.asarray(t.float_data, dt).reshape(shape)
+    if t.int64_data:
+        return _np.asarray(t.int64_data, dt).reshape(shape)
+    if t.int32_data:
+        return _np.asarray(t.int32_data, dt).reshape(shape)
+    return _np.zeros(shape, dt)
+
+
+def _attrs(node):
+    pb = _pb()
+    out = {}
+    for a in node.attribute:
+        if a.type == pb.AttributeProto.INT:
+            out[a.name] = int(a.i)
+        elif a.type == pb.AttributeProto.FLOAT:
+            out[a.name] = float(a.f)
+        elif a.type == pb.AttributeProto.STRING:
+            out[a.name] = a.s.decode()
+        elif a.type == pb.AttributeProto.INTS:
+            out[a.name] = tuple(int(x) for x in a.ints)
+    return out
+
+
+def _sym_pads(at, op):
+    """ONNX pads [b1..bn, e1..en] -> symmetric (p1..pn); raise if begin
+    and end halves differ (a silent truncation changes output shapes)."""
+    pads = at.get("pads")
+    if not pads:
+        return None
+    half = len(pads) // 2
+    begin, end = tuple(pads[:half]), tuple(pads[half:])
+    if begin != end:
+        raise MXNetError(
+            f"ONNX {op} with asymmetric pads {pads} is not supported "
+            "(begin half must equal end half)")
+    return begin
+
+
+def _import_node(node, tensors, params_np, mx_sym, fresh, pb):
+    at = _attrs(node)
+    ins = [tensors[i] for i in node.input if i in tensors]
+    out = node.output[0]
+    op = node.op_type
+    base = node.name or out
+
+    def put(sym):
+        tensors[out] = sym
+
+    if op == "Gemm":
+        # only the FullyConnected-shaped Gemm (y = x @ W.T + b) maps; a
+        # silent mis-map would return transposed-weight garbage
+        if at.get("transA", 0) or not at.get("transB", 1) or \
+                at.get("alpha", 1.0) != 1.0 or at.get("beta", 1.0) != 1.0:
+            raise MXNetError(
+                f"ONNX Gemm with transA={at.get('transA', 0)} "
+                f"transB={at.get('transB', 1)} alpha={at.get('alpha', 1.0)} "
+                f"beta={at.get('beta', 1.0)} is not supported (only the "
+                "FullyConnected form transA=0 transB=1 alpha=beta=1)")
+        w = params_np[node.input[1]]
+        put(mx_sym.FullyConnected(*ins, num_hidden=int(w.shape[0]),
+                                  no_bias=len(ins) < 3,
+                                  name=fresh(base)))
+    elif op == "MatMul":
+        put(mx_sym.dot(*ins, name=fresh(base)))
+    elif op == "Conv":
+        w = params_np[node.input[1]]
+        pad = _sym_pads(at, op)
+        put(mx_sym.Convolution(
+            *ins, num_filter=int(w.shape[0]),
+            kernel=at.get("kernel_shape", tuple(w.shape[2:])),
+            stride=at.get("strides", (1,) * len(w.shape[2:])),
+            pad=pad if pad else (0,) * len(w.shape[2:]),
+            num_group=int(at.get("group", 1)),
+            no_bias=len(ins) < 3, name=fresh(base)))
+    elif op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+        act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+               "Softplus": "softrelu"}[op]
+        put(mx_sym.Activation(ins[0], act_type=act, name=fresh(base)))
+    elif op == "LeakyRelu":
+        put(mx_sym.LeakyReLU(ins[0], slope=at.get("alpha", 0.01),
+                             name=fresh(base)))
+    elif op in ("MaxPool", "AveragePool"):
+        kernel = at["kernel_shape"]
+        pad = _sym_pads(at, op)
+        put(mx_sym.Pooling(
+            ins[0], kernel=kernel,
+            stride=at.get("strides", kernel),
+            pad=pad if pad else (0,) * len(kernel),
+            pool_type="max" if op == "MaxPool" else "avg",
+            name=fresh(base)))
+    elif op in ("GlobalMaxPool", "GlobalAveragePool"):
+        put(mx_sym.Pooling(
+            ins[0], global_pool=True, kernel=(1, 1),
+            pool_type="max" if op == "GlobalMaxPool" else "avg",
+            name=fresh(base)))
+    elif op == "BatchNormalization":
+        put(mx_sym.BatchNorm(*ins, eps=at.get("epsilon", 1e-5),
+                             momentum=at.get("momentum", 0.9),
+                             name=fresh(base)))
+    elif op == "Softmax":
+        put(mx_sym.softmax(ins[0], name=fresh(base)))
+    elif op == "Flatten":
+        put(mx_sym.Flatten(ins[0], name=fresh(base)))
+    elif op == "Dropout":
+        put(mx_sym.Dropout(ins[0], name=fresh(base)))
+    elif op == "Add":
+        put(ins[0] + ins[1])
+    elif op == "Mul":
+        put(ins[0] * ins[1])
+    elif op == "Concat":
+        put(mx_sym.Concat(*ins, dim=int(at.get("axis", 1)),
+                          name=fresh(base)))
+    elif op == "Reshape":
+        shape = tuple(int(x) for x in params_np[node.input[1]])
+        put(mx_sym.Reshape(ins[0], shape=shape, name=fresh(base)))
+    elif op == "Identity":
+        put(ins[0])
+    else:
+        raise MXNetError(
+            f"ONNX op '{op}' has no import mapping (supported: the "
+            "vision/MLP subset — see contrib/onnx docstring)")
+
+
+def _make_fresh():
+    """Per-import name deduper — deterministic across calls (a module
+    global would rename nodes on every re-import of the same file)."""
+    counter = {}
+
+    def fresh(base):
+        base = base.replace("/", "_").replace(":", "_")
+        i = counter.get(base, 0)
+        counter[base] = i + 1
+        return base if i == 0 else f"{base}_{i}"
+    return fresh
 
 
 def get_model_metadata(model_file):
-    _require_onnx()
-    raise MXNetError("ONNX metadata parsing is not implemented.")
+    """Reference: get_model_metadata -> {input_tensor_data,
+    output_tensor_data}."""
+    pb = _pb()
+    model = pb.ModelProto()
+    with open(model_file, "rb") as f:
+        model.ParseFromString(f.read())
+    g = model.graph
+    inits = {t.name for t in g.initializer}
+
+    def dims(vi):
+        return tuple(d.dim_value for d in vi.type.tensor_type.shape.dim)
+
+    return {
+        "input_tensor_data": [(vi.name, dims(vi)) for vi in g.input
+                              if vi.name not in inits],
+        "output_tensor_data": [(vi.name, dims(vi)) for vi in g.output],
+    }

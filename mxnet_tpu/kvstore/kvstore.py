@@ -83,12 +83,14 @@ class KVStore:
         reduce on the compressed pair (concat + segment-sum over unique
         rows) — never densified."""
         from ..ndarray.sparse import RowSparseNDArray, sum_duplicate_rows
-        if any(isinstance(v, RowSparseNDArray) for v in vs):
+        if all(isinstance(v, RowSparseNDArray) for v in vs):
             idx = jnp.concatenate([v.indices.data for v in vs])
             vals = jnp.concatenate([v.values.data for v in vs], axis=0)
             uniq, summed = sum_duplicate_rows(idx, vals)
             return RowSparseNDArray(summed, uniq,
                                     vs[0].shape, vs[0].context)
+        # mixed row_sparse + dense: fall through to the dense sum — the
+        # sparse members densify via .data (correctness over memory)
         merged = vs[0].data
         for extra in vs[1:]:
             merged = merged + extra.data

@@ -22,10 +22,22 @@ tests/test_ndarray.py, modelled on reference tests/python/unittest/test_ndarray.
 from __future__ import annotations
 
 import functools
+import os
+import warnings
+import weakref
 
 import numpy as _np
 import jax
 import jax.numpy as jnp
+
+if os.environ.get("MXTPU_INT64", "") in ("1", "true"):
+    # large-tensor mode (reference MXNET_INT64_TENSOR_SIZE build flag):
+    # real int64/float64 instead of the 32-bit truncation below
+    jax.config.update("jax_enable_x64", True)
+
+#: weak registry of live NDArrays — waitall() blocks on their buffers
+#: (reference engine WaitForAll semantics)
+_LIVE_ARRAYS = weakref.WeakSet()
 
 from ..base import MXNetError, numeric_types, integer_types
 from ..context import Context, current_context, cpu
@@ -42,9 +54,9 @@ def _dtype_of(dtype):
     if dtype == "bfloat16":
         return jnp.bfloat16
     dt = jnp.dtype(dtype)
-    # without jax_enable_x64, 64-bit dtypes are silently truncated with a
-    # warning; do the mapping explicitly (reference int64 indexing is
-    # int32-sufficient at test scale; large-tensor int64 mode is a TODO)
+    # without jax_enable_x64, 64-bit dtypes are narrowed; the warning is
+    # value-aware (see _check_narrowing) — numpy makes every Linux int
+    # array int64, so warning unconditionally would be pure noise
     if not jax.config.jax_enable_x64:
         if dt == jnp.dtype("int64"):
             return jnp.int32
@@ -53,6 +65,25 @@ def _dtype_of(dtype):
         if dt == jnp.dtype("uint64"):
             return jnp.uint32
     return dt
+
+
+def _check_narrowing(np_arr):
+    """Warn when 64-bit integer values actually exceed the 32-bit range
+    they are about to be narrowed into (reference large-tensor mode:
+    MXNET_INT64_TENSOR_SIZE build flag -> MXTPU_INT64=1 here)."""
+    if jax.config.jax_enable_x64 or np_arr.size == 0:
+        return
+    if np_arr.dtype == _np.int64:
+        if np_arr.max(initial=0) > 2**31 - 1 or \
+                np_arr.min(initial=0) < -2**31:
+            warnings.warn(
+                "int64 values exceed the int32 range and will wrap; set "
+                "MXTPU_INT64=1 for true 64-bit tensors", stacklevel=3)
+    elif np_arr.dtype == _np.uint64:
+        if np_arr.max(initial=0) > 2**32 - 1:
+            warnings.warn(
+                "uint64 values exceed the uint32 range and will wrap; set "
+                "MXTPU_INT64=1 for true 64-bit tensors", stacklevel=3)
 
 
 class NDArray:
@@ -78,6 +109,11 @@ class NDArray:
         self._grad_of = None
         self._node = None
         self._out_index = 0
+        _LIVE_ARRAYS.add(self)
+
+    def _sync_handles(self):
+        """Buffers waitall() must block on (sparse overrides: no densify)."""
+        return (self._data,)
 
     # ------------------------------------------------------------------
     # basic properties
@@ -622,6 +658,8 @@ def array(source_array, ctx=None, dtype=None):
         return _put(data, ctx)
     is_np_src = isinstance(source_array, _np.ndarray)
     np_arr = _np.asarray(source_array)
+    if np_arr.dtype in (_np.int64, _np.uint64):
+        _check_narrowing(np_arr)
     if dtype is None:
         # reference semantics (python/mxnet/ndarray/ndarray.py array()):
         # keep the dtype of ndarray sources, default float32 for lists etc.
@@ -687,6 +725,14 @@ def stack(*arrays, axis=0):
 
 
 def waitall():
-    """Reference: MXNDArrayWaitAll — engine WaitForAll."""
-    # jax has no global barrier; effectful only as a debugging aid
-    (jax.device_put(0.0) + 0).block_until_ready()
+    """Reference: MXNDArrayWaitAll — engine WaitForAll.
+
+    Blocks on every live NDArray's device buffer (weak registry), the
+    real equivalent of draining the reference's dependency engine."""
+    handles = []
+    for arr in list(_LIVE_ARRAYS):
+        for h in arr._sync_handles():
+            if h is not None and hasattr(h, "block_until_ready"):
+                handles.append(h)
+    if handles:
+        jax.block_until_ready(handles)

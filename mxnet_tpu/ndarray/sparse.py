@@ -39,6 +39,12 @@ def sum_duplicate_rows(indices, values):
 _LAZY = object()   # sentinel: "dense view not materialized"
 
 
+def _index_dtype():
+    """Row-index dtype: int64 under MXTPU_INT64/x64, else int32 (no
+    truncation warning — the narrowing is part of the storage design)."""
+    return jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+
+
 class BaseSparseNDArray(NDArray):
     __slots__ = ()
 
@@ -80,6 +86,11 @@ class RowSparseNDArray(BaseSparseNDArray):
         # compressed pair; it is recomputed on next .indices/.values access
         self._dense_cache = v
         self._sparse_stale = True
+
+    def _sync_handles(self):
+        if self._sparse_stale or self._dense_cache is not None:
+            return (self._dense_cache,)
+        return (self._indices, self._values)
 
     def _refresh_sparse(self):
         if self._sparse_stale:
@@ -196,6 +207,12 @@ class CSRNDArray(BaseSparseNDArray):
     def stype(self):
         return "csr"
 
+    def _sync_handles(self):
+        if self._dense_cache is not None:
+            return (self._dense_cache,)
+        v = self._values_csr
+        return (v,) if hasattr(v, "block_until_ready") else ()
+
     @property
     def indptr(self):
         return NDArray(jnp.asarray(self._indptr), self._ctx)
@@ -222,13 +239,13 @@ def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
         values = jnp.asarray(getattr(values, "data", values),
                              dtype=_dtype_of(dtype))
         indices = jnp.asarray(getattr(indices, "data", indices),
-                              _dtype_of("int64"))
+                              _index_dtype())
         return RowSparseNDArray(values, indices, shape, ctx)
     dense = array(arg1, ctx=ctx, dtype=dtype)
     np_d = dense.asnumpy()
     nz_rows = _np.where(_np.any(np_d != 0, axis=tuple(range(1, np_d.ndim))))[0]
     return RowSparseNDArray(jnp.asarray(np_d[nz_rows]),
-                            jnp.asarray(nz_rows, _dtype_of("int64")),
+                            jnp.asarray(nz_rows, _index_dtype()),
                             np_d.shape, ctx)
 
 
@@ -252,7 +269,7 @@ def zeros(stype, shape, ctx=None, dtype=None):
     dt = _dtype_of(dtype)
     if stype == "row_sparse":
         return RowSparseNDArray(jnp.zeros((0,) + tuple(shape[1:]), dt),
-                                jnp.zeros((0,), _dtype_of("int64")),
+                                jnp.zeros((0,), _index_dtype()),
                                 shape, ctx)
     if stype == "csr":
         return CSRNDArray(

@@ -1,0 +1,113 @@
+"""ONNX export/import round trip (reference:
+tests/python-pytest/onnx/; SURVEY.md §2.2 row 45 — VERDICT r1 missing #7).
+
+The IR schema is vendored (contrib/onnx/onnx_ir.proto, field numbers match
+the public onnx.proto3) so files interoperate with other ONNX tooling —
+verified against torch.onnx where available."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import onnx as mx_onnx
+
+nd = mx.nd
+
+
+def _lenet_symbol():
+    data = mx.sym.var("data")
+    c1 = mx.sym.Convolution(data, num_filter=8, kernel=(3, 3), name="c1")
+    a1 = mx.sym.Activation(c1, act_type="relu", name="a1")
+    p1 = mx.sym.Pooling(a1, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                        name="p1")
+    f1 = mx.sym.Flatten(p1, name="f1")
+    fc1 = mx.sym.FullyConnected(f1, num_hidden=32, name="fc1")
+    a2 = mx.sym.Activation(fc1, act_type="relu", name="a2")
+    fc2 = mx.sym.FullyConnected(a2, num_hidden=10, name="fc2")
+    return mx.sym.SoftmaxOutput(fc2, name="softmax")
+
+
+def _bind_and_init(sym, shape, seed=0):
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", shape)],
+             label_shapes=[("softmax_label", (shape[0],))])
+    rs = np.random.RandomState(seed)
+    for name, arr in mod._exec.arg_dict.items():
+        if name not in ("data", "softmax_label"):
+            arr._set_data(mx.nd.array(
+                rs.randn(*arr.shape).astype(np.float32) * 0.1).data)
+    mod.params_initialized = True
+    return mod
+
+
+def test_onnx_export_import_roundtrip(tmp_path):
+    sym = _lenet_symbol()
+    shape = (2, 1, 12, 12)
+    mod = _bind_and_init(sym, shape)
+    x = nd.array(np.random.RandomState(1).randn(*shape).astype(np.float32))
+    batch = mx.io.DataBatch(data=[x])
+    mod.forward(batch, is_train=False)
+    y_ref = mod.get_outputs()[0].asnumpy()
+
+    arg_params, _ = mod.get_params()
+    path = str(tmp_path / "lenet.onnx")
+    out = mx_onnx.export_model(sym, arg_params, shape, onnx_file_path=path)
+    assert out == path and os.path.getsize(path) > 500
+
+    sym2, args2, aux2 = mx_onnx.import_model(path)
+    mod2 = mx.mod.Module(sym2, data_names=("data",), label_names=())
+    mod2.bind(data_shapes=[("data", shape)])
+    mod2.init_params(arg_params={**args2, **aux2}, allow_missing=True)
+    mod2.forward(batch, is_train=False)
+    y2 = mod2.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(y_ref, y2, rtol=1e-4, atol=1e-5)
+
+
+def test_onnx_metadata(tmp_path):
+    sym = _lenet_symbol()
+    shape = (2, 1, 12, 12)
+    mod = _bind_and_init(sym, shape)
+    arg_params, _ = mod.get_params()
+    path = str(tmp_path / "m.onnx")
+    mx_onnx.export_model(sym, arg_params, shape, onnx_file_path=path)
+    meta = mx_onnx.get_model_metadata(path)
+    assert ("data", shape) in meta["input_tensor_data"]
+    assert meta["output_tensor_data"]
+
+
+def test_onnx_import_torch_export(tmp_path):
+    """Cross-tool interop: a file produced by torch.onnx must load through
+    our vendored schema and compute the same outputs."""
+    torch = pytest.importorskip("torch")
+    import torch.nn as tnn
+
+    class Net(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = tnn.Linear(6, 16)
+            self.fc2 = tnn.Linear(16, 4)
+
+        def forward(self, x):
+            return self.fc2(torch.relu(self.fc1(x)))
+
+    tnet = Net().eval()
+    x_np = np.random.RandomState(2).randn(3, 6).astype(np.float32)
+    with torch.no_grad():
+        y_ref = tnet(torch.from_numpy(x_np)).numpy()
+    path = str(tmp_path / "torch.onnx")
+    try:
+        torch.onnx.export(tnet, (torch.from_numpy(x_np),), path,
+                          input_names=["data"], output_names=["out"],
+                          dynamo=False)
+    except Exception as e:      # torch exporter unavailable in this image
+        pytest.skip(f"torch.onnx.export not usable: {e}")
+
+    sym, args, aux = mx_onnx.import_model(path)
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=())
+    mod.bind(data_shapes=[("data", (3, 6))])
+    mod.init_params(arg_params={**args, **aux}, allow_missing=True)
+    mod.forward(mx.io.DataBatch(data=[nd.array(x_np)]), is_train=False)
+    y = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(y_ref, y, rtol=1e-4, atol=1e-5)
