@@ -24,6 +24,15 @@ def maybe_init_distributed():
         _DONE = True
         import jax
         try:
+            if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+                # CPU processes need an XLA collective transport for the
+                # in-graph allreduce wire path (kvstore
+                # _bucketed_allreduce); gloo ships with jaxlib
+                jax.config.update(
+                    "jax_cpu_collectives_implementation", "gloo")
+        except Exception:  # noqa: BLE001 — allgather fallback still works
+            pass
+        try:
             jax.distributed.initialize(
                 coordinator_address=coord,
                 num_processes=nproc,

@@ -33,11 +33,13 @@ class _PositionwiseFFN(HybridBlock):
 
 
 class _BERTEncoderCell(HybridBlock):
-    def __init__(self, units, hidden_size, num_heads, dropout=0.0, **kwargs):
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 use_flash=False, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
             self.attention = MultiHeadAttention(units, num_heads,
-                                                dropout=dropout)
+                                                dropout=dropout,
+                                                use_flash=use_flash)
             self.dropout = nn.Dropout(dropout)
             self.layer_norm = nn.LayerNorm(epsilon=1e-12)
             self.ffn = _PositionwiseFFN(units, hidden_size, dropout=dropout)
@@ -53,7 +55,8 @@ class BERTEncoder(HybridBlock):
     Reference: gluonnlp BERTEncoder."""
 
     def __init__(self, num_layers=12, units=768, hidden_size=3072,
-                 num_heads=12, dropout=0.1, max_length=512, **kwargs):
+                 num_heads=12, dropout=0.1, max_length=512, use_flash=False,
+                 **kwargs):
         super().__init__(**kwargs)
         self._max_length = max_length
         self._units = units
@@ -68,7 +71,7 @@ class BERTEncoder(HybridBlock):
                 for i in range(num_layers):
                     self.transformer_cells.add(_BERTEncoderCell(
                         units, hidden_size, num_heads, dropout=dropout,
-                        prefix=f"layer{i}_"))
+                        use_flash=use_flash, prefix=f"layer{i}_"))
 
     def hybrid_forward(self, F, x, mask=None, position_weight=None):
         seq_len = x.shape[1]
@@ -155,11 +158,12 @@ class BERTModel(HybridBlock):
 
 
 def get_bert_model(num_layers=12, units=768, hidden_size=3072, num_heads=12,
-                   vocab_size=30522, max_length=512, dropout=0.1, **kwargs):
+                   vocab_size=30522, max_length=512, dropout=0.1,
+                   use_flash=False, **kwargs):
     encoder = BERTEncoder(num_layers=num_layers, units=units,
                           hidden_size=hidden_size, num_heads=num_heads,
                           dropout=dropout, max_length=max_length,
-                          prefix="encoder_")
+                          use_flash=use_flash, prefix="encoder_")
     return BERTModel(encoder, vocab_size, units=units, embed_dropout=dropout,
                      **kwargs)
 

@@ -1,0 +1,245 @@
+"""Host-side parameter server for ``dist_async``.
+
+Reference counterpart: src/kvstore/kvstore_dist_server.h (KVStoreDistServer:
+``DataHandleEx`` applies the server-side optimizer per push with NO worker
+barrier — the reference's distinctive async training mode) over ps-lite's
+ZMQ van (3rdparty/ps-lite). TPU-native design keeps the split the same way:
+the XLA/ICI collectives own the synchronous in-graph path
+(KVStoreDistTPUSync), while THIS module owns asynchronous host-side state —
+a TCP server thread on worker 0's host (DCN), length-prefixed pickle frames
+standing in for ZMQ messages.
+
+Async semantics preserved: each push is applied to the live table the
+moment it arrives (stale gradients included); pulls return the newest
+weights; no global step barrier exists anywhere on the training path.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+import time
+
+import numpy as _np
+
+__all__ = ["PSServer", "PSClient", "default_ps_addr"]
+
+_HDR = struct.Struct("<Q")
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_HDR.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock, n):
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def _recv_msg(sock):
+    (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
+    return pickle.loads(_recv_exact(sock, n))
+
+
+def default_ps_addr():
+    """Server address: MXTPU_PS_ADDR, or the coordinator host with a fixed
+    port offset (launch.py exports MXTPU_COORDINATOR for every role)."""
+    addr = os.environ.get("MXTPU_PS_ADDR")
+    if addr:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+    coord = os.environ.get("MXTPU_COORDINATOR", "127.0.0.1:9876")
+    host, port = coord.rsplit(":", 1)
+    return host, int(port) + 1000
+
+
+class PSServer:
+    """The server role. One instance runs (as a daemon thread pool) inside
+    worker 0's process — matching the reference's default of co-locating
+    servers with workers under ``launch.py -n N -s N`` on one host."""
+
+    def __init__(self, host, port, num_workers):
+        self._table = {}          # key -> np.ndarray (the live weights)
+        self._updater = None      # server-side optimizer (set_optimizer)
+        self._states = {}         # key -> optimizer state
+        self._push_count = {}     # key -> applied pushes (incl. stale)
+        self._lock = threading.Lock()
+        self._num_workers = num_workers
+        self._barrier_gen = 0
+        self._barrier_count = 0
+        self._barrier_cv = threading.Condition()
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(64)
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while True:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn):
+        try:
+            while True:
+                msg = _recv_msg(conn)
+                try:
+                    done = self._handle(conn, msg)
+                except (ConnectionError, OSError):
+                    raise
+                except Exception as e:  # noqa: BLE001 — reply, don't die
+                    # e.g. KeyError on push/pull of an uninitialized key:
+                    # the worker gets a diagnosable PS error instead of a
+                    # dead connection
+                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
+                    done = False
+                if done:
+                    return
+        except (ConnectionError, EOFError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def _handle(self, conn, msg):
+        """Serve one message; returns True when the server should stop.
+        Key lookups may raise (KeyError on an uninitialized key) — the
+        caller converts that to an ("err", ...) reply."""
+        op = msg[0]
+        if op == "init":
+            _, key, value = msg
+            with self._lock:
+                # reference InitImpl: first init wins (worker 0 inits
+                # first under launch.py ordering)
+                if key not in self._table:
+                    self._table[key] = _np.array(value)
+            _send_msg(conn, ("ok",))
+        elif op == "push":
+            _, key, grad = msg
+            with self._lock:
+                w = self._table[key]
+                if self._updater is not None:
+                    # DataHandleEx: apply optimizer NOW — no waiting for
+                    # other workers (async mode)
+                    self._updater(key, grad, w)
+                else:
+                    w += grad
+                self._push_count[key] = self._push_count.get(key, 0) + 1
+            _send_msg(conn, ("ok",))
+        elif op == "pull":
+            _, key = msg
+            with self._lock:
+                value = self._table[key].copy()
+            _send_msg(conn, ("ok", value))
+        elif op == "set_optimizer":
+            _, blob = msg
+            optimizer = pickle.loads(blob)
+            with self._lock:
+                self._updater = _ServerUpdater(optimizer)
+            _send_msg(conn, ("ok",))
+        elif op == "stats":
+            with self._lock:
+                _send_msg(conn, ("ok", dict(self._push_count)))
+        elif op == "barrier":
+            with self._barrier_cv:
+                gen = self._barrier_gen
+                self._barrier_count += 1
+                if self._barrier_count >= self._num_workers:
+                    self._barrier_count = 0
+                    self._barrier_gen += 1
+                    self._barrier_cv.notify_all()
+                else:
+                    while self._barrier_gen == gen:
+                        self._barrier_cv.wait(timeout=60)
+            _send_msg(conn, ("ok",))
+        elif op == "shutdown":
+            _send_msg(conn, ("ok",))
+            self._sock.close()
+            return True
+        else:
+            _send_msg(conn, ("err", f"unknown op {op!r}"))
+        return False
+
+
+class _ServerUpdater:
+    """Server-side optimizer application (reference ``set_optimizer`` →
+    server Updater): numpy in, numpy out, state kept per key."""
+
+    def __init__(self, optimizer):
+        self._optimizer = optimizer
+        self._states = {}
+
+    def __call__(self, key, grad, weight):
+        from ..ndarray.ndarray import NDArray, array
+        w = array(weight)
+        g = array(_np.asarray(grad))
+        if key not in self._states:
+            self._states[key] = self._optimizer.create_state(key, w)
+        self._optimizer.update(key, w, g, self._states[key])
+        weight[...] = _np.asarray(w.asnumpy())
+
+
+class PSClient:
+    """Worker-side connection (the ps::KVWorker role)."""
+
+    def __init__(self, host, port, retries=60):
+        last = None
+        for _ in range(retries):
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=120)
+                break
+            except OSError as e:     # server thread may start a bit later
+                last = e
+                time.sleep(0.25)
+        else:
+            raise ConnectionError(f"cannot reach PS at {host}:{port}: "
+                                  f"{last}")
+        self._lock = threading.Lock()
+
+    def _rpc(self, *msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            resp = _recv_msg(self._sock)
+        if resp[0] != "ok":
+            raise RuntimeError(f"PS error: {resp[1:]}" )
+        return resp[1] if len(resp) > 1 else None
+
+    def init(self, key, value):
+        return self._rpc("init", key, _np.asarray(value))
+
+    def push(self, key, grad):
+        return self._rpc("push", key, _np.asarray(grad))
+
+    def pull(self, key):
+        return self._rpc("pull", key)
+
+    def set_optimizer(self, optimizer):
+        return self._rpc("set_optimizer",
+                         pickle.dumps(optimizer,
+                                      protocol=pickle.HIGHEST_PROTOCOL))
+
+    def stats(self):
+        return self._rpc("stats")
+
+    def barrier(self):
+        return self._rpc("barrier")
+
+    def close(self):
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -175,8 +175,11 @@ class Module(BaseModule):
         self._optimizer = None
         self._updater_states = {}
         self._kvstore = None
+        self._update_on_kvstore = False
         self._batch_size = None
         self._mesh = None   # multi-device DP: set by bind when len(ctx) > 1
+        self._preloaded_params = None   # set by Module.load
+        self._group2ctxs = group2ctxs
 
     # -- bind -----------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
@@ -265,6 +268,13 @@ class Module(BaseModule):
                     allow_extra=False):
         if self.params_initialized and not force_init:
             return
+        if arg_params is None and aux_params is None and not force_init \
+                and self._preloaded_params is not None:
+            # Module.load stashed the checkpoint here; without this the
+            # loaded weights would be silently re-initialized (r2 missing
+            # #4b). force_init=True deliberately re-randomizes instead.
+            # Reference: Module.load -> fit(arg_params=...) flow.
+            arg_params, aux_params = self._preloaded_params
         initializer = initializer or init_mod.Uniform(0.01)
         for name, arr in self._exec.arg_dict.items():
             if name in self._data_names or name in self._label_names:
@@ -281,20 +291,51 @@ class Module(BaseModule):
                        force_init=False):
         if self.optimizer_initialized and not force_init:
             return
-        if isinstance(optimizer, str):
-            params = dict(optimizer_params)
-            # reference Module._init_optimizer defaults rescale_grad to
-            # 1/batch_size (python/mxnet/module/module.py) — SoftmaxOutput
-            # grads are batch-summed, so this is load-bearing for SGD
-            if "rescale_grad" not in params and self._batch_size:
-                params["rescale_grad"] = 1.0 / self._batch_size
-            optimizer = opt_mod.create(optimizer, **params)
-        self._optimizer = optimizer
         from .. import kvstore as kvs
         if kvstore:
             self._kvstore = kvs.create(kvstore) if isinstance(kvstore, str) \
                 else kvstore
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            # reference Module.init_optimizer defaults rescale_grad to
+            # 1/batch_size — and for dist SYNC stores 1/(batch_size *
+            # num_workers) since those SUM worker grads; dist_async
+            # applies each worker's grad individually, so no extra factor
+            # (python/mxnet/module/module.py: batch_size *= num_workers
+            # only when 'dist' in type and '_sync' in type)
+            if "rescale_grad" not in params and self._batch_size:
+                n = 1
+                if self._kvstore is not None and \
+                        "dist" in self._kvstore.type and \
+                        "_sync" in self._kvstore.type:
+                    n = self._kvstore.num_workers
+                params["rescale_grad"] = 1.0 / (self._batch_size * n)
+            optimizer = opt_mod.create(optimizer, **params)
+        self._optimizer = optimizer
+        if self._kvstore is not None:
+            import os
+            # reference default: optimizer runs ON the store (server-side
+            # update, kvstore_dist_server.h DataHandleEx); opt out via env
+            # like MXNET_UPDATE_ON_KVSTORE=0
+            self._update_on_kvstore = os.environ.get(
+                "MXTPU_UPDATE_ON_KVSTORE",
+                os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")) == "1"
+            if self._update_on_kvstore:
+                self._kvstore.set_optimizer(self._optimizer)
+            # register every trainable param; dist stores broadcast rank
+            # 0's value so all workers start identical (SURVEY.md §3.5
+            # "worker 0: kv.init -> broadcast initial weights")
+            for i, name in enumerate(self._trainable_names()):
+                arr = self._exec.arg_dict[name]
+                self._kvstore.init(i, arr)
+                if self._kvstore.num_workers > 1:
+                    self._kvstore.pull(i, out=arr)
         self.optimizer_initialized = True
+
+    def _trainable_names(self):
+        return [n for n in self.symbol.list_arguments()
+                if n not in self._data_names and n not in self._label_names
+                and n not in self._fixed_param_names]
 
     # -- compute --------------------------------------------------------
     def forward(self, data_batch, is_train=None):
@@ -314,20 +355,36 @@ class Module(BaseModule):
         self._exec.backward(out_grads)
 
     def update(self):
+        """Optimizer step. With a kvstore this routes gradients through it
+        (reference module.py update -> kvstore.push/pull, SURVEY.md §3.4):
+        update_on_kvstore pushes the grad and pulls the store-updated
+        weight; otherwise push+pull allreduces the grad and the local
+        optimizer applies it — either way N dist workers stay bitwise in
+        step (r2 missing #4a)."""
         assert self.optimizer_initialized
-        i = 0
-        for name in self.symbol.list_arguments():
-            if name in self._data_names or name in self._label_names or \
-                    name in self._fixed_param_names:
-                continue
-            arr = self._exec.arg_dict[name]
+        keys, arrs, grads = [], [], []
+        for i, name in enumerate(self._trainable_names()):
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
+            keys.append(i)
+            arrs.append(self._exec.arg_dict[name])
+            grads.append(grad)
+        if not keys:
+            return
+        # ONE list push/pull so the dist store coalesces all params into
+        # BIGARRAY_BOUND buckets (kvstore._bucketed_allreduce) instead of
+        # one collective round per parameter
+        if self._kvstore is not None and self._update_on_kvstore:
+            self._kvstore.push(keys, grads)
+            self._kvstore.pull(keys, out=arrs)
+            return
+        if self._kvstore is not None:
+            self._kvstore.pushpull(keys, grads, out=grads)
+        for i, arr, grad in zip(keys, arrs, grads):
             if i not in self._updater_states:
                 self._updater_states[i] = self._optimizer.create_state(i, arr)
             self._optimizer.update(i, arr, grad, self._updater_states[i])
-            i += 1
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())

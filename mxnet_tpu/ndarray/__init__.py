@@ -8,6 +8,7 @@ from .ndarray import (NDArray, array, zeros, ones, full, empty, arange,
                       concatenate, from_jax, waitall, eye, linspace)
 from .ops import *  # noqa: F401,F403
 from .ops import concat, stack
+from .linalg import *  # noqa: F401,F403
 from . import random
 from .utils import save, load, load_frombuffer
 from . import sparse

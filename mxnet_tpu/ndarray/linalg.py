@@ -1,0 +1,216 @@
+"""``mx.nd.linalg_*`` — the linear-algebra op family.
+
+Reference: src/operator/tensor/la_op.cc / la_op-inl.h (LAPACK/cuSolver
+wrappers). On TPU these map to jax.numpy.linalg / jax.lax.linalg, which
+XLA lowers to MXU-friendly blocked kernels; every op routes through
+``apply_nary`` so the imperative tape records it and jax.vjp supplies the
+(well-known) matrix-calculus gradients — no hand-written backward kernels.
+
+Batch semantics match the reference: all ops accept (..., m, n) stacks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .ndarray import NDArray, apply_nary
+
+__all__ = []
+
+
+def _register(fn):
+    __all__.append(fn.__name__)
+    return fn
+
+
+@_register
+def linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                beta=1.0, axis=-2):
+    """alpha * op(A) @ op(B) + beta * C (la_op.cc linalg_gemm). ``axis``
+    names the matrix-row axis (reference default -2); other values move
+    the batch dims accordingly."""
+    def fn(a, b, c):
+        if axis != -2:
+            a = jnp.moveaxis(a, axis, -2)
+            b = jnp.moveaxis(b, axis, -2)
+            c = jnp.moveaxis(c, axis, -2)
+        if transpose_a:
+            a = jnp.swapaxes(a, -1, -2)
+        if transpose_b:
+            b = jnp.swapaxes(b, -1, -2)
+        r = alpha * jnp.matmul(a, b) + beta * c
+        if axis != -2:
+            r = jnp.moveaxis(r, -2, axis)
+        return r
+    return apply_nary(fn, [A, B, C], name="linalg_gemm")
+
+
+@_register
+def linalg_potrf(A):
+    """Cholesky factor L of a PSD matrix: A = L @ L.T (la_op.cc
+    linalg_potrf). Returns the lower triangle like the reference."""
+    return apply_nary(jnp.linalg.cholesky, [A], name="linalg_potrf")
+
+
+@_register
+def linalg_potri(A):
+    """Inverse of the PSD matrix whose Cholesky factor is ``A``:
+    (A @ A.T)^-1 (la_op.cc linalg_potri)."""
+    def fn(l):
+        eye = jnp.broadcast_to(
+            jnp.eye(l.shape[-1], dtype=l.dtype), l.shape)
+        linv = lax.linalg.triangular_solve(
+            l, eye, left_side=True, lower=True)
+        return jnp.swapaxes(linv, -1, -2) @ linv
+    return apply_nary(fn, [A], name="linalg_potri")
+
+
+@_register
+def linalg_trsm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Solve op(A) X = alpha B (or X op(A) = alpha B) with triangular A
+    (la_op.cc linalg_trsm)."""
+    def fn(a, b):
+        return lax.linalg.triangular_solve(
+            a, alpha * b, left_side=not rightside, lower=lower,
+            transpose_a=transpose)
+    return apply_nary(fn, [A, B], name="linalg_trsm")
+
+
+@_register
+def linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                alpha=1.0):
+    """Multiply by a triangular matrix: alpha op(tri(A)) @ B
+    (la_op.cc linalg_trmm)."""
+    def fn(a, b):
+        t = jnp.tril(a) if lower else jnp.triu(a)
+        if transpose:
+            t = jnp.swapaxes(t, -1, -2)
+        return alpha * (jnp.matmul(b, t) if rightside else jnp.matmul(t, b))
+    return apply_nary(fn, [A, B], name="linalg_trmm")
+
+
+@_register
+def linalg_syrk(A, transpose=False, alpha=1.0):
+    """alpha * A @ A.T (or A.T @ A when transpose) — la_op.cc
+    linalg_syrk."""
+    def fn(a):
+        at = jnp.swapaxes(a, -1, -2)
+        return alpha * (jnp.matmul(at, a) if transpose
+                        else jnp.matmul(a, at))
+    return apply_nary(fn, [A], name="linalg_syrk")
+
+
+@_register
+def linalg_sumlogdiag(A):
+    """sum(log(diag(A))) per matrix (la_op.cc linalg_sumlogdiag)."""
+    def fn(a):
+        d = jnp.diagonal(a, axis1=-2, axis2=-1)
+        return jnp.sum(jnp.log(d), axis=-1)
+    return apply_nary(fn, [A], name="linalg_sumlogdiag")
+
+
+@_register
+def linalg_extractdiag(A, offset=0):
+    """Diagonal of each matrix in the stack (la_op.cc
+    linalg_extractdiag)."""
+    def fn(a):
+        return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+    return apply_nary(fn, [A], name="linalg_extractdiag")
+
+
+@_register
+def linalg_makediag(A, offset=0):
+    """Embed vectors as diagonal matrices (la_op.cc linalg_makediag)."""
+    def fn(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        rows = idx + max(0, -offset)
+        cols = idx + max(0, offset)
+        return base.at[..., rows, cols].set(a)
+    return apply_nary(fn, [A], name="linalg_makediag")
+
+
+def _trian_indices(n, offset, lower):
+    """Index pairs of the offset-SHIFTED triangle (the reference
+    semantics, la_op-inl.h CopyTriangle): the lower/upper triangle of the
+    (n-|offset|)-dim submatrix shifted by offset, (q)(q+1)/2 entries —
+    NOT the half-plane that numpy's tril/triu_indices(k=offset) gives."""
+    import numpy as _onp
+    q = n - abs(offset)
+    ri, ci = (_onp.tril_indices(q) if lower else _onp.triu_indices(q))
+    if offset >= 0:
+        return ri, ci + offset
+    return ri - offset, ci
+
+
+@_register
+def linalg_extracttrian(A, offset=0, lower=True):
+    """Flatten the (offset-shifted) triangle of each matrix into a
+    vector of (n-|offset|)(n-|offset|+1)/2 entries (la_op.cc
+    linalg_extracttrian)."""
+    def fn(a):
+        rows, cols = _trian_indices(a.shape[-1], offset, lower)
+        return a[..., rows, cols]
+    return apply_nary(fn, [A], name="linalg_extracttrian")
+
+
+@_register
+def linalg_maketrian(A, offset=0, lower=True):
+    """Inverse of extracttrian: vector -> triangular matrix (la_op.cc
+    linalg_maketrian)."""
+    def fn(a):
+        import math as _math
+        m = a.shape[-1]
+        # vector holds q(q+1)/2 entries of a triangle q = n - |offset|
+        q = (_math.isqrt(8 * m + 1) - 1) // 2
+        n = q + abs(offset)
+        rows, cols = _trian_indices(n, offset, lower)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        return base.at[..., rows, cols].set(a)
+    return apply_nary(fn, [A], name="linalg_maketrian")
+
+
+@_register
+def linalg_gelqf(A):
+    """LQ factorization A = L @ Q with Q orthonormal rows (la_op.cc
+    linalg_gelqf). Returns (L, Q)."""
+    def fn(a):
+        q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+        return jnp.swapaxes(r, -1, -2), jnp.swapaxes(q, -1, -2)
+    return apply_nary(fn, [A], name="linalg_gelqf", n_out=2)
+
+
+@_register
+def linalg_syevd(A):
+    """Symmetric eigendecomposition: A = U.T diag(L) U (la_op.cc
+    linalg_syevd). Returns (U, L) with eigenvectors as ROWS of U like the
+    reference."""
+    def fn(a):
+        w, v = jnp.linalg.eigh(a)
+        return jnp.swapaxes(v, -1, -2), w
+    return apply_nary(fn, [A], name="linalg_syevd", n_out=2)
+
+
+@_register
+def linalg_inverse(A):
+    """Matrix inverse (la_op.cc linalg_inverse)."""
+    return apply_nary(jnp.linalg.inv, [A], name="linalg_inverse")
+
+
+@_register
+def linalg_det(A):
+    """Determinant (la_op.cc linalg_det)."""
+    return apply_nary(jnp.linalg.det, [A], name="linalg_det")
+
+
+@_register
+def linalg_slogdet(A):
+    """(sign, log|det|) (la_op.cc linalg_slogdet)."""
+    def fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return sign, logdet
+    return apply_nary(fn, [A], name="linalg_slogdet", n_out=2)

@@ -606,24 +606,91 @@ def apply_nary(fn, inputs, ctx=None, n_out=1, name=""):
 
 
 def _resolve_reshape(cur, shape):
-    """MXNet reshape codes: 0 = copy input dim, -1 = infer (at most one).
+    """MXNet reshape codes, full set. Reference semantics:
+    src/operator/tensor/matrix_op-inl.h InferReshapeShape:
 
-    Reference semantics: src/operator/tensor/matrix_op-inl.h (ReshapeParam);
-    codes -2/-3/-4 are not supported here (clear error instead).
+      0  copy the corresponding input dim
+      -1 infer this dim from the remaining size (at most one)
+      -2 copy ALL remaining input dims from the current position
+      -3 merge two consecutive input dims into one
+      -4 split one input dim into the next TWO target entries (one of
+         which may be -1)
     """
     shape = tuple(int(s) for s in shape)
-    if any(s in (-2, -3, -4) for s in shape):
-        raise MXNetError("reshape codes -2/-3/-4 are not supported; "
-                         "use explicit shapes")
     out = []
-    for i, s in enumerate(shape):
-        if s == 0:
-            if i >= len(cur):
+    src = 0     # cursor into the input shape
+    i = 0
+    while i < len(shape):
+        s = shape[i]
+        if s > 0:
+            out.append(s)
+            src += 1
+        elif s == 0:
+            if src >= len(cur):
                 raise MXNetError(f"reshape code 0 at dim {i} out of range "
                                  f"for shape {cur}")
-            out.append(cur[i])
+            out.append(cur[src])
+            src += 1
+        elif s == -1:
+            if -1 in out:
+                raise MXNetError("reshape allows at most one -1 "
+                                 f"(outside -4 splits): {shape}")
+            out.append(-1)
+            src += 1
+        elif s == -2:
+            out.extend(cur[src:])
+            src = len(cur)
+        elif s == -3:
+            if src + 1 >= len(cur):
+                raise MXNetError(f"reshape code -3 at dim {i} needs two "
+                                 f"input dims, shape {cur} has "
+                                 f"{len(cur) - src} left")
+            out.append(cur[src] * cur[src + 1])
+            src += 2
+        elif s == -4:
+            if i + 2 >= len(shape):
+                raise MXNetError(
+                    f"reshape code -4 must be followed by two split dims: "
+                    f"{shape}")
+            if src >= len(cur):
+                raise MXNetError(f"reshape code -4 at dim {i} out of range "
+                                 f"for shape {cur}")
+            d = cur[src]
+            d1, d2 = shape[i + 1], shape[i + 2]
+            d1 = d if d1 == 0 else d1
+            d2 = d if d2 == 0 else d2
+            if d1 == -1 and d2 == -1:
+                raise MXNetError("reshape -4 split cannot infer both dims")
+            if d1 == -1:
+                d1 = d // d2
+            if d2 == -1:
+                d2 = d // d1
+            if d1 * d2 != d:
+                raise MXNetError(f"reshape -4 split {d1}x{d2} != input "
+                                 f"dim {d}")
+            out.extend([d1, d2])
+            src += 1
+            i += 2
         else:
-            out.append(s)
+            raise MXNetError(f"invalid reshape code {s}")
+        i += 1
+    total = 1
+    for c in cur:
+        total *= c
+    if -1 in out:
+        known = 1
+        for o in out:
+            if o != -1:
+                known *= o
+        if known == 0 or total % known:
+            raise MXNetError(f"cannot infer -1 in reshape {shape} of {cur}")
+        out[out.index(-1)] = total // known
+    size = 1
+    for o in out:
+        size *= o
+    if size != total:
+        raise MXNetError(f"reshape {shape} of {cur}: target size {size} "
+                         f"!= input size {total}")
     return tuple(out)
 
 

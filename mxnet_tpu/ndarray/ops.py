@@ -311,6 +311,17 @@ def linalg_gemm2(a, b, transpose_a=False, transpose_b=False, alpha=1.0):
 
 @_register
 def reshape(data, shape, reverse=False):
+    """MXNet reshape incl. codes 0/-1/-2/-3/-4 (matrix_op-inl.h
+    InferReshapeShape); ``reverse=True`` matches codes from the right."""
+    if reverse:
+        from .ndarray import _resolve_reshape
+        spec = tuple(int(s) for s in shape)
+        if -4 in spec:
+            raise MXNetError("reshape(reverse=True) with -4 split is not "
+                             "supported; write the split explicitly")
+        new_shape = _resolve_reshape(tuple(data.shape)[::-1],
+                                     spec[::-1])[::-1]
+        return data.reshape(new_shape)
     return data.reshape(shape)
 
 
@@ -1227,3 +1238,304 @@ def gather_positions(data, positions):
             d, p.astype(jnp.int32)[..., None], axis=1)
     return apply_nary(fn, [data, _nd(positions, data)],
                       name="gather_positions")
+
+
+# ======================================================================
+# index raveling (reference: src/operator/tensor/ravel.cc)
+# ======================================================================
+
+@_register
+def ravel_multi_index(data, shape):
+    """(ndim, n) coordinate rows -> flat indices for ``shape``
+    (ravel.cc ravel_multi_index)."""
+    shape = tuple(int(s) for s in shape)
+    def fn(d):
+        strides = _np.cumprod((1,) + shape[:0:-1])[::-1].copy()
+        return jnp.sum(d.astype(jnp.int32) *
+                       jnp.asarray(strides, jnp.int32)[:, None], axis=0)
+    return apply_nary(fn, [data], name="ravel_multi_index")
+
+
+@_register
+def unravel_index(data, shape):
+    """Flat indices -> (ndim, n) coordinate rows (ravel.cc
+    unravel_index)."""
+    shape = tuple(int(s) for s in shape)
+    def fn(d):
+        coords = jnp.unravel_index(d.astype(jnp.int32), shape)
+        return jnp.stack(coords, axis=0)
+    return apply_nary(fn, [data], name="unravel_index")
+
+
+@_register
+def khatri_rao(*args):
+    """Column-wise Khatri-Rao product: (m,k) x (n,k) -> (m*n, k)
+    (reference src/operator/contrib/krprod.cc)."""
+    if not args:
+        raise MXNetError("khatri_rao needs at least one matrix")
+    def fn(*ms):
+        out = ms[0]
+        for m in ms[1:]:
+            k = out.shape[1]
+            out = jnp.einsum("ik,jk->ijk", out, m).reshape(-1, k)
+        return out
+    return apply_nary(fn, [_nd(a) for a in args], name="khatri_rao")
+
+
+# ======================================================================
+# spatial sampling (reference: src/operator/grid_generator.cc,
+# bilinear_sampler.cc — the SpatialTransformer pair)
+# ======================================================================
+
+@_register
+def GridGenerator(data, transform_type="affine", target_shape=None):
+    """affine: (B, 6) thetas -> (B, 2, H, W) sampling grid in [-1, 1];
+    warp: (B, 2, H, W) flow field -> grid. Reference grid_generator.cc."""
+    if transform_type == "affine":
+        if target_shape is None:
+            raise MXNetError("GridGenerator(affine) needs target_shape")
+        h, w = int(target_shape[0]), int(target_shape[1])
+        def fn(theta):
+            b = theta.shape[0]
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            base = jnp.stack([gx.ravel(), gy.ravel(),
+                              jnp.ones(h * w)])            # (3, H*W)
+            t = theta.reshape(b, 2, 3).astype(jnp.float32)
+            grid = jnp.einsum("bij,jn->bin", t, base)      # (B, 2, H*W)
+            return grid.reshape(b, 2, h, w)
+        return apply_nary(fn, [data], name="GridGenerator")
+    if transform_type == "warp":
+        def fn(flow):
+            b, _, h, w = flow.shape
+            ys = jnp.arange(h, dtype=jnp.float32)
+            xs = jnp.arange(w, dtype=jnp.float32)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            x = (gx[None] + flow[:, 0]) * 2.0 / max(w - 1, 1) - 1.0
+            y = (gy[None] + flow[:, 1]) * 2.0 / max(h - 1, 1) - 1.0
+            return jnp.stack([x, y], axis=1)
+        return apply_nary(fn, [data], name="GridGenerator")
+    raise MXNetError(f"unknown transform_type {transform_type!r}")
+
+
+@_register
+def BilinearSampler(data, grid, cudnn_off=None):
+    """Sample data (B, C, H, W) at grid (B, 2, Ho, Wo) ([-1,1] x/y),
+    zero padding outside — reference bilinear_sampler.cc. Differentiable
+    in both data and grid (jax.vjp through the gather)."""
+    def fn(d, g):
+        b, c, h, w = d.shape
+        x = (g[:, 0] + 1.0) * (w - 1) / 2.0          # (B, Ho, Wo)
+        y = (g[:, 1] + 1.0) * (h - 1) / 2.0
+        x0 = jnp.floor(x); y0 = jnp.floor(y)
+        # per-batch gather, vectorized with vmap
+        def sample_one(dd, yy, xx):
+            yi = jnp.clip(yy.astype(jnp.int32), 0, h - 1)
+            xi = jnp.clip(xx.astype(jnp.int32), 0, w - 1)
+            valid = ((yy >= 0) & (yy <= h - 1) &
+                     (xx >= 0) & (xx <= w - 1)).astype(dd.dtype)
+            return dd[:, yi, xi] * valid[None]        # (C, Ho, Wo)
+        def one(dd, xx, yy, xx0, yy0):
+            wx = xx - xx0
+            wy = yy - yy0
+            v00 = sample_one(dd, yy0, xx0)
+            v01 = sample_one(dd, yy0, xx0 + 1)
+            v10 = sample_one(dd, yy0 + 1, xx0)
+            v11 = sample_one(dd, yy0 + 1, xx0 + 1)
+            return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx +
+                    v10 * wy * (1 - wx) + v11 * wy * wx)
+        return jax.vmap(one)(d, x, y, x0, y0)
+    return apply_nary(fn, [data, _nd(grid, data)], name="BilinearSampler")
+
+
+# ======================================================================
+# CTC loss (reference: src/operator/nn/ctc_loss.cc)
+# ======================================================================
+
+@_register
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first"):
+    """Connectionist temporal classification loss.
+
+    data: (T, B, C) pre-softmax activations; label: (B, L) padded with -1
+    (or 0s beyond label_lengths). Returns per-example forward loss (B,).
+    The alpha recursion (extended blank-interleaved label sequence, log
+    space) runs as a lax.scan and is fully differentiable through jax
+    autodiff — reference src/operator/nn/ctc_loss.cc (warpctc-free).
+    """
+    if blank_label not in ("first", "last"):
+        raise MXNetError("blank_label must be 'first' or 'last'")
+    NEG = -1e30
+
+    def _one(logp, ext, skip_ok, t_len, l_len):
+        """One example: logp (T, C) log-softmax, ext (S,) extended labels."""
+        T = logp.shape[0]
+        alpha0 = jnp.full(ext.shape, NEG, jnp.float32)
+        alpha0 = alpha0.at[0].set(logp[0, ext[0]])
+        alpha0 = alpha0.at[1].set(
+            jnp.where(l_len > 0, logp[0, ext[1]], NEG))
+
+        def step(alpha, xs):
+            lp_t, t = xs
+            a_prev = jnp.concatenate([jnp.full((1,), NEG), alpha[:-1]])
+            a_prev2 = jnp.concatenate([jnp.full((2,), NEG), alpha[:-2]])
+            a = jnp.logaddexp(alpha, a_prev)
+            a = jnp.where(skip_ok, jnp.logaddexp(a, a_prev2), a)
+            new = a + lp_t[ext]
+            return jnp.where(t < t_len, new, alpha), None
+
+        alpha, _ = lax.scan(step, alpha0,
+                            (logp[1:], jnp.arange(1, T)))
+        end = 2 * l_len                      # last blank of the used prefix
+        a_last = jnp.take(alpha, end)
+        a_last2 = jnp.where(l_len > 0,
+                            jnp.take(alpha, jnp.maximum(end - 1, 0)), NEG)
+        return -jnp.logaddexp(a_last, a_last2)
+
+    def fn(d, lab, *lens):
+        t, b, c = d.shape
+        blank = 0 if blank_label == "first" else c - 1
+        logp = jax.nn.log_softmax(
+            jnp.transpose(d, (1, 0, 2)).astype(jnp.float32), axis=-1)
+        lab = lab.astype(jnp.int32)
+        # lens layout strictly follows the use_* flags (inputs are built
+        # the same way below — a None length with the flag set raises)
+        if use_label_lengths:
+            l_len = lens[1 if use_data_lengths else 0].astype(jnp.int32)
+        else:
+            l_len = jnp.sum((lab > 0) if blank == 0 else (lab >= 0),
+                            axis=1).astype(jnp.int32)
+        if use_data_lengths:
+            t_len = lens[0].astype(jnp.int32)
+        else:
+            t_len = jnp.full((b,), t, jnp.int32)
+        lab = jnp.maximum(lab, 0)
+        L = lab.shape[1]
+        ext = jnp.full((b, 2 * L + 1), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        skip = jnp.zeros((b, 2 * L + 1), bool)
+        skip = skip.at[:, 2:].set((ext[:, 2:] != blank) &
+                                  (ext[:, 2:] != ext[:, :-2]))
+        return jax.vmap(_one)(logp, ext, skip, t_len, l_len)
+
+    inputs = [data, _nd(label, data)]
+    if use_data_lengths:
+        if data_lengths is None:
+            raise MXNetError("use_data_lengths=True requires data_lengths")
+        inputs.append(_nd(data_lengths, data))
+    if use_label_lengths:
+        if label_lengths is None:
+            raise MXNetError(
+                "use_label_lengths=True requires label_lengths")
+        inputs.append(_nd(label_lengths, data))
+    return apply_nary(fn, inputs, name="ctc_loss")
+
+
+CTCLoss = ctc_loss
+__all__.append("CTCLoss")
+
+
+# ======================================================================
+# fused multi-tensor optimizer ops (reference:
+# src/operator/optimizer_op.cc multi_sgd_update / multi_sgd_mom_update,
+# src/operator/contrib/multi_lamb.cc)
+# ======================================================================
+
+def _group_pairs(arrays, per_weight):
+    n = len(arrays) // per_weight
+    return [arrays[i * per_weight:(i + 1) * per_weight] for i in range(n)]
+
+
+@_register
+def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                     clip_gradient=None, out=None):
+    """Fused group SGD: arrays = (w0, g0, w1, g1, ...). ONE dispatch /
+    XLA program updates every weight (the reference's multi-tensor-apply);
+    weights are updated in place on their handles and returned."""
+    groups = _group_pairs(list(arrays), 2)
+    def fn(*flat):
+        outs = []
+        for i in range(0, len(flat), 2):
+            w, g = flat[i], flat[i + 1]
+            lr, wd = lrs[i // 2], wds[i // 2]
+            g = g * rescale_grad
+            if clip_gradient is not None:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            outs.append(w - lr * (g + wd * w))
+        # apply_nary with n_out=1 expects a bare array, not a 1-tuple
+        return tuple(outs) if len(outs) > 1 else outs[0]
+    updated = apply_nary(fn, list(arrays), n_out=len(groups),
+                         name="multi_sgd_update")
+    updated = updated if isinstance(updated, list) else [updated]
+    for (w, _), nw in zip(groups, updated):
+        w._set_data(nw.data)
+    return updated
+
+
+@_register
+def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.9, rescale_grad=1.0,
+                         clip_gradient=None, out=None):
+    """Fused group SGD+momentum: arrays = (w0, g0, m0, w1, g1, m1, ...);
+    weights AND momenta update in place (optimizer_op.cc
+    multi_sgd_mom_update)."""
+    groups = _group_pairs(list(arrays), 3)
+    def fn(*flat):
+        outs = []
+        for i in range(0, len(flat), 3):
+            w, g, m = flat[i], flat[i + 1], flat[i + 2]
+            lr, wd = lrs[i // 3], wds[i // 3]
+            g = g * rescale_grad
+            if clip_gradient is not None:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            new_m = momentum * m - lr * (g + wd * w)
+            outs.append(w + new_m)
+            outs.append(new_m)
+        return tuple(outs)
+    updated = apply_nary(fn, list(arrays), n_out=2 * len(groups),
+                         name="multi_sgd_mom_update")
+    for gi, (w, _, m) in enumerate(groups):
+        w._set_data(updated[2 * gi].data)
+        m._set_data(updated[2 * gi + 1].data)
+    return [updated[2 * i] for i in range(len(groups))]
+
+
+@_register
+def multi_lamb_update(*arrays, lrs, wds, beta1=0.9, beta2=0.999,
+                      epsilon=1e-6, rescale_grad=1.0, clip_gradient=None,
+                      step=1, lower_bound=None, upper_bound=None, out=None):
+    """Fused group LAMB: arrays = (w0, g0, mean0, var0, ...); one XLA
+    program for the whole group (contrib/multi_lamb.cc)."""
+    groups = _group_pairs(list(arrays), 4)
+    def fn(*flat):
+        outs = []
+        for i in range(0, len(flat), 4):
+            w, g, mean, var = flat[i:i + 4]
+            lr, wd = lrs[i // 4], wds[i // 4]
+            g = g * rescale_grad
+            if clip_gradient is not None:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            new_mean = beta1 * mean + (1 - beta1) * g
+            new_var = beta2 * var + (1 - beta2) * jnp.square(g)
+            mhat = new_mean / (1 - beta1 ** step)
+            vhat = new_var / (1 - beta2 ** step)
+            upd = mhat / (jnp.sqrt(vhat) + epsilon) + wd * w
+            wnorm = jnp.linalg.norm(w)
+            unorm = jnp.linalg.norm(upd)
+            ratio = jnp.where(
+                (wnorm > 0) & (unorm > 0),
+                wnorm / jnp.maximum(unorm, 1e-12), 1.0)
+            if lower_bound is not None:
+                ratio = jnp.maximum(ratio, lower_bound)
+            if upper_bound is not None:
+                ratio = jnp.minimum(ratio, upper_bound)
+            outs.extend([w - lr * ratio * upd, new_mean, new_var])
+        return tuple(outs)
+    updated = apply_nary(fn, list(arrays), n_out=3 * len(groups),
+                         name="multi_lamb_update")
+    for gi, (w, _, mean, var) in enumerate(groups):
+        w._set_data(updated[3 * gi].data)
+        mean._set_data(updated[3 * gi + 1].data)
+        var._set_data(updated[3 * gi + 2].data)
+    return [updated[3 * i] for i in range(len(groups))]

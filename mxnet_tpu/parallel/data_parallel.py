@@ -73,6 +73,7 @@ class DataParallelTrainer:
         self._param_vals = None   # device-resident, sharded; owned by us
         self._opt_state = None
         self._jitted = None
+        self._jitted_indexed = None
         self._num_update = 0
         self._donate = donate
 
@@ -93,24 +94,24 @@ class DataParallelTrainer:
             return NamedSharding(self.mesh, p.shard_spec)
         return NamedSharding(self.mesh, P())
 
-    def _build(self):
+    def _step_body(self):
+        """The fused fwd/bwd/reduce/update body shared by the *batch and
+        indexed-epoch jit entry points (single source — the two step paths
+        can never diverge)."""
         block = self.block
         loss_fn = self.loss_fn
         rule_apply = self._rule_apply
-        batch_axis = self.batch_axis
         params = self._param_objs
 
-        def train_step(param_vals, opt_state, lr, key, *batch):
+        def body(param_vals, opt_state, lr, key, inputs, label):
             def loss_of(pv):
                 prev = _tape.set_training(True)
                 binding = {p: NDArray(v) for p, v in zip(params, pv)}
                 try:
                     with _tape.trace_scope(), _bind_params(binding), \
                             _rnd.trace_key_scope(key):
-                        inputs = [NDArray(b) for b in batch[:-1]]
-                        label = NDArray(batch[-1])
-                        out = block.forward(*inputs)
-                        loss = loss_fn(out, label)
+                        out = block.forward(*[NDArray(b) for b in inputs])
+                        loss = loss_fn(out, NDArray(label))
                 finally:
                     _tape.set_training(prev)
                 return jnp.mean(loss.data)
@@ -122,9 +123,31 @@ class DataParallelTrainer:
                 new_params.append(np_)
                 new_state.append(ns)
             return new_params, new_state, loss
+        return body
+
+    def _build(self):
+        body = self._step_body()
+
+        def train_step(param_vals, opt_state, lr, key, *batch):
+            return body(param_vals, opt_state, lr, key,
+                        list(batch[:-1]), batch[-1])
 
         donate = (0, 1) if self._donate else ()
         self._jitted = jax.jit(train_step, donate_argnums=donate)
+
+    def _build_indexed(self):
+        body = self._step_body()
+
+        def train_step(param_vals, opt_state, lr, key, superdata,
+                       superlabel, i):
+            data = jax.lax.dynamic_index_in_dim(superdata, i, 0,
+                                                keepdims=False)
+            label_b = jax.lax.dynamic_index_in_dim(superlabel, i, 0,
+                                                   keepdims=False)
+            return body(param_vals, opt_state, lr, key, [data], label_b)
+
+        donate = (0, 1) if self._donate else ()
+        self._jitted_indexed = jax.jit(train_step, donate_argnums=donate)
 
     # -- public API -----------------------------------------------------
     @property
@@ -146,10 +169,47 @@ class DataParallelTrainer:
         inputs = [jax.device_put(b, NamedSharding(
             mesh, P(*([None] * self.batch_axis + (["dp"] if b.ndim else [])))))
             for b in inputs]
-        # Params stay resident on device across steps (VERDICT r1 weak #6:
-        # re-device_put per step put a host round on the timed path). Only
-        # a parameter externally mutated since our last write (identity
-        # check against the cached array) is re-transferred.
+        self._ensure_device_state(params)
+        if self._jitted is None:
+            self._build()
+        key = _rnd.next_key()
+        lr = jnp.asarray(self.learning_rate, jnp.float32)
+        new_params, self._opt_state, loss = self._jitted(
+            self._param_vals, self._opt_state, lr, key, *inputs)
+        self._num_update += 1
+        self._param_vals = list(new_params)
+        for p, v in zip(params, new_params):
+            p._data._set_data(v)
+        return NDArray(loss)
+
+    def put_epoch(self, superdata, superlabel):
+        """Upload an epoch of batches to device once: superdata
+        (n_batches, B, ...), superlabel (n_batches, B, ...). Returns an
+        opaque handle for :meth:`step_indexed`.
+
+        Device-resident epoch feeding: per step only a scalar index
+        crosses host->device; the batch select is an in-graph
+        ``dynamic_index``. This is the TPU analog of the reference's
+        PrefetcherIter keeping decoded batches pinned
+        (src/io/iter_prefetcher.h) — and on remote-tunneled hosts it
+        avoids the per-step H2D dispatch stall entirely.
+        """
+        mesh = self.mesh
+        sd = jnp.asarray(superdata.data if isinstance(superdata, NDArray)
+                         else superdata)
+        sl = jnp.asarray(superlabel.data if isinstance(superlabel, NDArray)
+                         else superlabel)
+        spec_d = P(*([None, "dp"] + [None] * (sd.ndim - 2)))
+        spec_l = P(*([None, "dp"] + [None] * (sl.ndim - 2)))
+        # caller owns the handle; dropping it frees the device buffers
+        return (jax.device_put(sd, NamedSharding(mesh, spec_d)),
+                jax.device_put(sl, NamedSharding(mesh, spec_l)))
+
+    def _ensure_device_state(self, params):
+        """Params stay resident on device across steps (VERDICT r1 weak
+        #6: re-device_put per step put a host round on the timed path).
+        Only a parameter externally mutated since our last write (identity
+        check against the cached array) is re-transferred."""
         if self._param_vals is None:
             self._param_vals = [
                 jax.device_put(p.data().data, self._param_sharding(p))
@@ -163,14 +223,25 @@ class DataParallelTrainer:
         if self._opt_state is None:
             self._opt_state = [
                 jax.tree.map(lambda x: jax.device_put(
-                    x, NamedSharding(mesh, P())), self._rule_init(v))
+                    x, NamedSharding(self.mesh, P())), self._rule_init(v))
                 for v in self._param_vals]
-        if self._jitted is None:
-            self._build()
+
+    def step_indexed(self, epoch_handle, i):
+        """One fused train step on batch ``i`` of a resident epoch
+        (see :meth:`put_epoch`)."""
+        superdata, superlabel = epoch_handle
+        if self._param_objs is None:
+            # probe batch only for deferred-shape resolution on first call
+            self._collect(NDArray(superdata[0]))
+        params = self._param_objs
+        self._ensure_device_state(params)
+        if self._jitted_indexed is None:
+            self._build_indexed()
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = self._jitted(
-            self._param_vals, self._opt_state, lr, key, *inputs)
+        new_params, self._opt_state, loss = self._jitted_indexed(
+            self._param_vals, self._opt_state, lr, key, superdata,
+            superlabel, jnp.asarray(i, jnp.int32))
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):

@@ -91,7 +91,9 @@ def test_profiler_scoped_events(tmp_path):
 
 def test_exception_propagation_clear_message():
     with pytest.raises(mx.MXNetError):
-        nd.reshape(nd.zeros((2, 2)), (-2, 1))
+        nd.reshape(nd.zeros((2, 2)), (-5,))       # invalid reshape code
+    with pytest.raises(mx.MXNetError):
+        nd.reshape(nd.zeros((2, 2)), (-3, -3))    # -3 past the input rank
     with pytest.raises(mx.MXNetError):
         gluon.nn.Dense(4).weight.data()      # uninitialized param
     # shape errors from jax surface as exceptions, not hangs

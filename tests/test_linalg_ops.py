@@ -1,0 +1,313 @@
+"""linalg_* family + r3 op-registry additions (reference:
+src/operator/tensor/la_op.cc tests in tests/python/unittest/test_operator.py
+test_laop_*; ravel.cc; krprod.cc; bilinear_sampler.cc; ctc_loss.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, retry, with_seed)
+
+
+def _spd(n, batch=(), seed=0):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*batch, n, n).astype(np.float32)
+    return a @ np.swapaxes(a, -1, -2) + n * np.eye(n, dtype=np.float32)
+
+
+@with_seed()
+def test_linalg_gemm():
+    rng = np.random.RandomState(0)
+    a = rng.randn(2, 3, 4).astype(np.float32)
+    b = rng.randn(2, 4, 5).astype(np.float32)
+    c = rng.randn(2, 3, 5).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5)
+    assert_almost_equal(out.asnumpy(), 2.0 * (a @ b) + 0.5 * c, rtol=1e-5)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b.swapaxes(-1, -2)),
+                         nd.array(c), transpose_b=True)
+    assert_almost_equal(out.asnumpy(), a @ b + c, rtol=1e-5)
+
+
+@with_seed()
+def test_linalg_potrf_potri():
+    a = _spd(4, (2,))
+    l = nd.linalg_potrf(nd.array(a))
+    ln = l.asnumpy()
+    assert_almost_equal(ln @ np.swapaxes(ln, -1, -2), a, rtol=1e-4)
+    assert np.allclose(np.triu(ln, 1), 0)   # lower factor
+    inv = nd.linalg_potri(l)
+    assert_almost_equal(inv.asnumpy(), np.linalg.inv(a), rtol=1e-3,
+                        atol=1e-4)
+
+
+@with_seed()
+@retry(3)
+def test_linalg_potrf_grad():
+    a = _spd(3)
+    check_numeric_gradient(
+        lambda x: (nd.linalg_potrf(x) * nd.array(
+            np.tril(np.linspace(1, 2, 9).reshape(3, 3)
+                    .astype(np.float32)))).sum(),
+        [nd.array(a)], rtol=5e-2, atol=1e-2)
+
+
+@with_seed()
+def test_linalg_trsm_trmm():
+    rng = np.random.RandomState(1)
+    l = np.tril(rng.rand(3, 3).astype(np.float32) + 1)
+    b = rng.randn(3, 2).astype(np.float32)
+    x = nd.linalg_trsm(nd.array(l), nd.array(b))
+    assert_almost_equal(l @ x.asnumpy(), b, rtol=1e-4)
+    x = nd.linalg_trsm(nd.array(l), nd.array(b), transpose=True)
+    assert_almost_equal(l.T @ x.asnumpy(), b, rtol=1e-4)
+    y = nd.linalg_trmm(nd.array(l), nd.array(b))
+    assert_almost_equal(y.asnumpy(), l @ b, rtol=1e-5)
+
+
+@with_seed()
+def test_linalg_syrk_sumlogdiag():
+    rng = np.random.RandomState(2)
+    a = rng.randn(3, 4).astype(np.float32)
+    assert_almost_equal(nd.linalg_syrk(nd.array(a)).asnumpy(), a @ a.T,
+                        rtol=1e-5)
+    assert_almost_equal(
+        nd.linalg_syrk(nd.array(a), transpose=True, alpha=0.5).asnumpy(),
+        0.5 * (a.T @ a), rtol=1e-5)
+    spd = _spd(4)
+    l = np.linalg.cholesky(spd).astype(np.float32)
+    s = nd.linalg_sumlogdiag(nd.array(l)).asnumpy()
+    assert_almost_equal(s, np.log(np.diag(l)).sum(), rtol=1e-5)
+
+
+def test_linalg_diag_trian_roundtrip():
+    rng = np.random.RandomState(3)
+    a = rng.randn(2, 4, 4).astype(np.float32)
+    d = nd.linalg_extractdiag(nd.array(a))
+    assert_almost_equal(d.asnumpy(), np.diagonal(a, axis1=-2, axis2=-1),
+                        rtol=1e-6)
+    m = nd.linalg_makediag(d)
+    assert_almost_equal(np.diagonal(m.asnumpy(), axis1=-2, axis2=-1),
+                        d.asnumpy(), rtol=1e-6)
+    t = nd.linalg_extracttrian(nd.array(a))
+    back = nd.linalg_maketrian(t)
+    assert_almost_equal(back.asnumpy(), np.tril(a), rtol=1e-6)
+
+
+@with_seed()
+def test_linalg_gelqf_syevd():
+    rng = np.random.RandomState(4)
+    a = rng.randn(3, 5).astype(np.float32)
+    l, q = nd.linalg_gelqf(nd.array(a))
+    assert_almost_equal(l.asnumpy() @ q.asnumpy(), a, rtol=1e-4, atol=1e-5)
+    assert_almost_equal(q.asnumpy() @ q.asnumpy().T, np.eye(3), rtol=1e-4,
+                        atol=1e-5)
+    spd = _spd(4)
+    u, w = nd.linalg_syevd(nd.array(spd))
+    un, wn = u.asnumpy(), w.asnumpy()
+    assert_almost_equal(un.T @ np.diag(wn) @ un, spd, rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_det_inverse_slogdet():
+    a = _spd(3)
+    assert_almost_equal(nd.linalg_det(nd.array(a)).asnumpy(),
+                        np.linalg.det(a), rtol=1e-4)
+    assert_almost_equal(nd.linalg_inverse(nd.array(a)).asnumpy(),
+                        np.linalg.inv(a), rtol=1e-3, atol=1e-5)
+    sign, logdet = nd.linalg_slogdet(nd.array(a))
+    s, ld = np.linalg.slogdet(a)
+    assert_almost_equal(sign.asnumpy(), s, rtol=1e-5)
+    assert_almost_equal(logdet.asnumpy(), ld, rtol=1e-4)
+
+
+# -- reshape codes ----------------------------------------------------------
+
+def test_reshape_special_codes():
+    x = nd.arange(24).reshape((2, 3, 4))
+    assert nd.reshape(x, (-2,)).shape == (2, 3, 4)
+    assert nd.reshape(x, (0, -2)).shape == (2, 3, 4)
+    assert nd.reshape(x, (-3, 4)).shape == (6, 4)
+    assert nd.reshape(x, (0, -3)).shape == (2, 12)
+    assert nd.reshape(x, (-4, 1, 2, -2)).shape == (1, 2, 3, 4)
+    assert nd.reshape(x, (-4, 2, -1, 0, 0)).shape == (2, 1, 3, 4)
+    assert nd.reshape(x, (2, -1)).shape == (2, 12)
+    # values preserved
+    np.testing.assert_array_equal(
+        nd.reshape(x, (-3, -2)).asnumpy(), x.asnumpy().reshape(6, 4))
+    # reverse matches from the right: (8, 1, 7) reshape (-1, 0) reverse
+    y = nd.zeros((8, 1, 7))
+    assert nd.reshape(y, (-1, 0), reverse=True).shape == (8, 7)
+    with pytest.raises(mx.MXNetError):
+        nd.reshape(x, (-4, 5, 5, 0))
+    with pytest.raises(mx.MXNetError):
+        nd.reshape(x, (-1, -1))
+
+
+# -- ravel / khatri-rao -----------------------------------------------------
+
+def test_ravel_unravel():
+    shape = (3, 4, 5)
+    coords = np.array([[1, 2, 0], [2, 0, 3], [0, 1, 4]])  # (ndim, n)
+    flat = nd.ravel_multi_index(nd.array(coords.astype(np.float32)), shape)
+    ref = np.ravel_multi_index(tuple(coords), shape)
+    np.testing.assert_array_equal(flat.asnumpy(), ref)
+    back = nd.unravel_index(flat, shape)
+    np.testing.assert_array_equal(back.asnumpy(), coords)
+
+
+def test_khatri_rao():
+    a = np.arange(6).reshape(2, 3).astype(np.float32)
+    b = np.arange(9).reshape(3, 3).astype(np.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b))
+    assert out.shape == (6, 3)
+    ref = np.stack([np.kron(a[:, k], b[:, k]) for k in range(3)], axis=1)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+
+
+# -- spatial sampling -------------------------------------------------------
+
+def test_grid_generator_affine_identity():
+    theta = nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(np.float32))
+    grid = nd.GridGenerator(theta, "affine", target_shape=(4, 6))
+    g = grid.asnumpy()
+    assert g.shape == (2, 2, 4, 6)
+    np.testing.assert_allclose(g[0, 0, 0], np.linspace(-1, 1, 6), rtol=1e-5)
+    np.testing.assert_allclose(g[0, 1, :, 0], np.linspace(-1, 1, 4),
+                               rtol=1e-5)
+
+
+def test_bilinear_sampler_identity_and_grad():
+    rng = np.random.RandomState(5)
+    data = rng.randn(2, 3, 5, 7).astype(np.float32)
+    theta = nd.array(np.tile([1, 0, 0, 0, 1, 0], (2, 1)).astype(np.float32))
+    grid = nd.GridGenerator(theta, "affine", target_shape=(5, 7))
+    out = nd.BilinearSampler(nd.array(data), grid)
+    assert_almost_equal(out.asnumpy(), data, rtol=1e-4, atol=1e-5)
+    # torch cross-check on a random grid
+    torch = pytest.importorskip("torch")
+    g = rng.uniform(-1, 1, size=(2, 2, 4, 6)).astype(np.float32)
+    out = nd.BilinearSampler(nd.array(data), nd.array(g))
+    tg = torch.tensor(np.moveaxis(g, 1, -1))       # (B, Ho, Wo, 2)
+    tout = torch.nn.functional.grid_sample(
+        torch.tensor(data), tg, mode="bilinear", padding_mode="zeros",
+        align_corners=True)
+    assert_almost_equal(out.asnumpy(), tout.numpy(), rtol=1e-4, atol=1e-5)
+    # gradient flows to both data and grid
+    d = nd.array(data)
+    gr = nd.array(g)
+    d.attach_grad()
+    gr.attach_grad()
+    with autograd.record():
+        loss = nd.BilinearSampler(d, gr).sum()
+    loss.backward()
+    assert np.abs(d.grad.asnumpy()).sum() > 0
+    assert np.abs(gr.grad.asnumpy()).sum() > 0
+
+
+# -- CTC loss ---------------------------------------------------------------
+
+def test_ctc_loss_matches_torch():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(6)
+    T, B, C, L = 10, 2, 5, 3
+    acts = rng.randn(T, B, C).astype(np.float32)
+    labels = rng.randint(1, C, size=(B, L)).astype(np.float32)
+    loss = nd.ctc_loss(nd.array(acts), nd.array(labels))
+    tacts = torch.tensor(acts).log_softmax(-1)
+    tloss = torch.nn.functional.ctc_loss(
+        tacts, torch.tensor(labels).long(),
+        input_lengths=torch.full((B,), T, dtype=torch.long),
+        target_lengths=torch.full((B,), L, dtype=torch.long),
+        blank=0, reduction="none")
+    assert_almost_equal(loss.asnumpy(), tloss.numpy(), rtol=1e-3)
+
+
+def test_ctc_loss_label_lengths_and_grad():
+    rng = np.random.RandomState(7)
+    T, B, C = 8, 2, 4
+    acts = nd.array(rng.randn(T, B, C).astype(np.float32))
+    labels = nd.array(np.array([[1, 2, -1], [3, -1, -1]], np.float32))
+    loss = nd.ctc_loss(acts, labels)
+    assert loss.shape == (B,)
+    acts.attach_grad()
+    with autograd.record():
+        out = nd.ctc_loss(acts, labels).sum()
+    out.backward()
+    assert np.isfinite(acts.grad.asnumpy()).all()
+    assert np.abs(acts.grad.asnumpy()).sum() > 0
+
+
+# -- fused multi-tensor optimizer ops --------------------------------------
+
+def test_multi_sgd_update():
+    ws = [nd.ones((3,)) * v for v in (1.0, 2.0)]
+    gs = [nd.ones((3,)) * v for v in (0.5, 0.25)]
+    nd.multi_sgd_update(ws[0], gs[0], ws[1], gs[1],
+                        lrs=(0.1, 0.2), wds=(0.0, 0.0))
+    assert_almost_equal(ws[0].asnumpy(), np.full(3, 0.95), rtol=1e-6)
+    assert_almost_equal(ws[1].asnumpy(), np.full(3, 1.95), rtol=1e-6)
+
+
+def test_multi_sgd_mom_matches_serial():
+    rng = np.random.RandomState(8)
+    w1, w2 = rng.randn(4).astype(np.float32), rng.randn(5).astype(np.float32)
+    g1, g2 = rng.randn(4).astype(np.float32), rng.randn(5).astype(np.float32)
+    # serial reference
+    from mxnet_tpu.optimizer import SGD
+    opt = SGD(learning_rate=0.1, momentum=0.9, wd=0.01, rescale_grad=1.0)
+    wa, wb = nd.array(w1), nd.array(w2)
+    sa, sb = opt.create_state(0, wa), opt.create_state(1, wb)
+    for _ in range(3):
+        opt.update(0, wa, nd.array(g1), sa)
+        opt.update(1, wb, nd.array(g2), sb)
+    # fused group
+    fa, fb = nd.array(w1), nd.array(w2)
+    ma, mb = nd.zeros((4,)), nd.zeros((5,))
+    for _ in range(3):
+        nd.multi_sgd_mom_update(fa, nd.array(g1), ma, fb, nd.array(g2), mb,
+                                lrs=(0.1, 0.1), wds=(0.01, 0.01),
+                                momentum=0.9)
+    assert_almost_equal(fa.asnumpy(), wa.asnumpy(), rtol=1e-5)
+    assert_almost_equal(fb.asnumpy(), wb.asnumpy(), rtol=1e-5)
+
+
+def test_multi_lamb_update_runs():
+    rng = np.random.RandomState(9)
+    w = nd.array(rng.randn(6).astype(np.float32))
+    g = nd.array(rng.randn(6).astype(np.float32))
+    mean, var = nd.zeros((6,)), nd.zeros((6,))
+    before = w.asnumpy().copy()
+    nd.multi_lamb_update(w, g, mean, var, lrs=(0.01,), wds=(0.01,), step=1)
+    after = w.asnumpy()
+    assert np.abs(after - before).sum() > 0
+    assert np.isfinite(after).all()
+
+
+def test_linalg_trian_offsets():
+    """Offset semantics are the SHIFTED triangle (q=n-|offset| rows), not
+    numpy's half-plane (la_op-inl.h CopyTriangle)."""
+    rng = np.random.RandomState(10)
+    a = rng.randn(4, 4).astype(np.float32)
+    t = nd.linalg_extracttrian(nd.array(a), offset=1)
+    assert t.shape == (6,)                      # q=3 -> 3*4/2
+    ref = a[np.tril_indices(3)[0], np.tril_indices(3)[1] + 1]
+    assert_almost_equal(t.asnumpy(), ref, rtol=1e-6)
+    back = nd.linalg_maketrian(t, offset=1)
+    assert back.shape == (4, 4)
+    assert_almost_equal(nd.linalg_extracttrian(back, offset=1).asnumpy(),
+                        t.asnumpy(), rtol=1e-6)
+    t2 = nd.linalg_extracttrian(nd.array(a), offset=-1)
+    assert t2.shape == (6,)
+    ref2 = a[np.tril_indices(3)[0] + 1, np.tril_indices(3)[1]]
+    assert_almost_equal(t2.asnumpy(), ref2, rtol=1e-6)
+
+
+def test_linalg_gemm_axis():
+    rng = np.random.RandomState(11)
+    a = rng.randn(3, 2, 4).astype(np.float32)   # rows on axis -3
+    b = rng.randn(4, 2, 5).astype(np.float32)
+    c = rng.randn(3, 2, 5).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c), axis=-3)
+    ref = np.einsum("ibk,kbj->ibj", a, b) + c
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-5)

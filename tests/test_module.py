@@ -183,3 +183,105 @@ def test_mnist_convergence_floor():
     for data, label in val_data:
         metric.update([label], [net(data)])
     assert metric.get()[1] > 0.98, f"val acc {metric.get()[1]}"
+
+
+def test_module_load_applies_checkpoint(tmp_path):
+    """Module.load -> bind -> init_params must score like the saved model;
+    before r3 the checkpoint was stashed and silently re-initialized
+    (VERDICT r2 missing #4b). Reference: Module.load(prefix, epoch)."""
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    train = _toy_iter(seed=0)
+    mod.fit(train, num_epoch=5, initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    val = _toy_iter(seed=1)
+    m = mx.metric.Accuracy()
+    mod.score(val, m)
+    trained_acc = m.get()[1]
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 5)
+
+    mod2 = mx.mod.Module.load(prefix, 5, data_names=("data",),
+                              label_names=("softmax_label",))
+    mod2.bind(data_shapes=[("data", (24, 8))],
+              label_shapes=[("softmax_label", (24,))])
+    mod2.init_params()    # must apply the loaded params, not re-init
+    m2 = mx.metric.Accuracy()
+    mod2.score(val, m2)
+    assert m2.get()[1] == pytest.approx(trained_acc, abs=1e-6)
+
+
+def test_module_update_routes_through_kvstore():
+    """kvstore='local' fit must apply updates THROUGH the store (server-side
+    optimizer, reference kvstore_dist_server.h DataHandleEx semantics) and
+    match the no-kvstore run bit-for-bit."""
+    runs = {}
+    for kv in (None, "local"):
+        np.random.seed(7)   # NDArrayIter(shuffle=True) uses the global RNG
+        mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                            label_names=("softmax_label",))
+        train = _toy_iter(seed=0)
+        mod.fit(train, num_epoch=3,
+                initializer=mx.init.Constant(0.05), kvstore=kv,
+                optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+        runs[kv] = {k: v.asnumpy() for k, v in mod.get_params()[0].items()}
+    for k in runs[None]:
+        np.testing.assert_allclose(runs[None][k], runs["local"][k],
+                                   rtol=1e-6, err_msg=k)
+    # and the store really was in the loop
+    assert mod._kvstore is not None and mod._update_on_kvstore
+
+
+@pytest.mark.slow
+def test_module_fit_dist_2proc(tmp_path):
+    """2-process Module.fit over dist_sync: ranks train on DIFFERENT data
+    shards yet must end with identical weights (r2 missing #4a: update()
+    used to skip the kvstore and silently train divergent models)."""
+    import os
+    import subprocess
+    import sys
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank = kv.rank\n"
+        "centers = np.random.RandomState(1234).randn(3, 8) * 3\n"
+        "rng = np.random.RandomState(rank)  # DIFFERENT data per rank\n"
+        "labels = rng.randint(0, 3, 96)\n"
+        "data = (centers[labels] + rng.randn(96, 8) * 0.3)\n"
+        "it = mx.io.NDArrayIter(data.astype(np.float32),\n"
+        "                       labels.astype(np.float32), 24,\n"
+        "                       label_name='softmax_label')\n"
+        "data_sym = mx.sym.var('data')\n"
+        "fc1 = mx.sym.FullyConnected(data_sym, num_hidden=16, name='fc1')\n"
+        "act = mx.sym.Activation(fc1, act_type='relu', name='relu1')\n"
+        "fc2 = mx.sym.FullyConnected(act, num_hidden=3, name='fc2')\n"
+        "sym = mx.sym.SoftmaxOutput(fc2, name='softmax')\n"
+        "mod = mx.mod.Module(sym, data_names=('data',),\n"
+        "                    label_names=('softmax_label',))\n"
+        "np.random.seed(100 + rank)  # init would diverge w/o broadcast\n"
+        "mod.fit(it, num_epoch=2, kvstore=kv,\n"
+        "        optimizer='sgd',\n"
+        "        optimizer_params={'learning_rate': 0.1})\n"
+        "args, _ = mod.get_params()\n"
+        "digest = float(sum(np.abs(v.asnumpy()).sum()\n"
+        "               for v in args.values()))\n"
+        "print(f'WORKER_DIGEST {rank} {digest:.10f}')\n")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and ".axon_site" not in p] + [REPO])
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr + r.stdout
+    import re
+    digests = dict(re.findall(r"WORKER_DIGEST (\d+) ([0-9.]+)", r.stdout))
+    assert len(digests) == 2, r.stdout + r.stderr
+    assert digests["0"] == digests["1"], digests

@@ -11,7 +11,7 @@ import pytest
 import mxnet_tpu as mx
 from mxnet_tpu import autograd
 from mxnet_tpu.test_utils import (assert_almost_equal,
-                                  check_numeric_gradient, with_seed)
+                                  check_numeric_gradient, retry, with_seed)
 
 nd = mx.nd
 
@@ -35,6 +35,7 @@ def test_unary_forward_against_numpy():
 
 
 @with_seed()
+@retry(3)
 def test_unary_gradients():
     for op in (nd.exp, nd.tanh, nd.sigmoid, nd.sqrt, nd.square):
         x = nd.random.uniform(0.2, 1.5, shape=(3, 3))
@@ -167,6 +168,7 @@ def test_ordering_ops():
 # -- nn ops -----------------------------------------------------------------
 
 @with_seed()
+@retry(3)
 def test_softmax_temperature_and_grad():
     x = nd.random.uniform(shape=(2, 5))
     out = nd.softmax(x, temperature=2.0).asnumpy()
@@ -230,6 +232,7 @@ def test_batchnorm_use_global_stats():
 
 
 @with_seed()
+@retry(3)
 def test_layernorm_grad():
     x = nd.random.uniform(shape=(3, 6))
     g = nd.ones((6,))

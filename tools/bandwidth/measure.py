@@ -72,6 +72,12 @@ def main():
     if results.get("per-key"):
         print(f"batched/per-key speedup: "
               f"{results['batched'] / results['per-key']:.2f}x")
+    import json
+    print("BWJSON " + json.dumps({
+        "kvstore": kv.type, "workers": kv.num_workers,
+        "wire": getattr(kv, "_wire_mode", None),
+        "batched_gb_s": round(results["batched"], 3),
+        "per_key_gb_s": round(results.get("per-key", 0.0), 3)}))
     return results["batched"]
 
 
