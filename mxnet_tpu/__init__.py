@@ -54,6 +54,7 @@ from . import util
 from . import test_utils
 from . import symbol
 from . import symbol as sym
+from .symbol import AttrScope
 from . import module
 from . import module as mod
 from . import visualization as viz

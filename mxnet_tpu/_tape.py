@@ -106,8 +106,10 @@ class SparseCotangent:
         return self.values.dtype
 
     def densify(self):
+        # .add, not .set: indices may repeat (Embedding emits raw batch
+        # ids) and duplicate rows must SUM
         return jnp.zeros(self.shape, self.values.dtype) \
-            .at[self.indices].set(self.values)
+            .at[self.indices].add(self.values)
 
     def merge(self, other):
         """Sum with another sparse cotangent of the same dense shape —

@@ -376,6 +376,23 @@ class CachedOp:
 
     def __call__(self, *args):
         params = self._collect()
+        # Sparse-grad params can't ride jax.vjp of the fused program (its
+        # cotangents are dense O(vocab)): dispatch the block imperatively
+        # while grads are being recorded, so the Embedding op's row-sparse
+        # pullback stays live. Mirrors the reference, where CachedOp defers
+        # to FComputeEx imperative dispatch for sparse storage
+        # (src/imperative/cached_op.cc storage-type fallback).
+        if _tape.is_recording() and \
+                any(p.grad_stype == "row_sparse" for p in params):
+            if not getattr(self, "_warned_sparse_fallback", False):
+                self._warned_sparse_fallback = True
+                import warnings
+                warnings.warn(
+                    f"{self.block.name}: hybridized block has "
+                    "row_sparse-grad parameters; training forward runs "
+                    "imperatively to keep O(nnz) gradients (reference "
+                    "sparse FComputeEx fallback)")
+            return self.block.forward(*args)
         # deferred shapes: run one eager pause()-mode forward to resolve
         if any(p._data is None for p in params):
             with _tape.trace_scope():

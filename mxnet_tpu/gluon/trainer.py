@@ -133,7 +133,65 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
+    def _fused_group_update(self, ignore_stale_grad):
+        """ONE multi-tensor op for the whole parameter group (reference
+        multi_sgd_mom_update, src/operator/optimizer_op.cc): collapses N
+        eager dispatches per step into one XLA program. Only the plain
+        dense-SGD case qualifies; anything else falls back per-param."""
+        from .. import optimizer as opt_mod
+        from ..ndarray import sparse as _sp
+        from ..ndarray import ops as _ops
+        opt = self._optimizer
+        if type(opt) is not opt_mod.SGD or opt.multi_precision:
+            return False
+        # phase 1: qualification only — no optimizer state is touched, so
+        # bailing to the per-param path cannot double-count updates
+        arrays, idxs = [], []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._data._grad is None or not param._data._grad_fresh:
+                if ignore_stale_grad:
+                    continue
+                return False      # per-param path raises the right error
+            if param.grad_req == "add" or \
+                    isinstance(param._data._grad, _sp.RowSparseNDArray):
+                return False      # sparse/accumulating grads: exact path
+            idxs.append(i)
+            arrays.append((param, param.data(), param.grad()))
+        if not arrays:
+            return True
+        # phase 2: commit — counters/lr/wd evaluated once per param
+        lrs, wds = [], []
+        for i in idxs:
+            opt._update_count(i)
+            lrs.append(opt._get_lr(i))
+            wds.append(opt._get_wd(i))
+        if opt.momentum:
+            flat = []
+            for i, (param, w, g) in zip(idxs, arrays):
+                if i not in self._states:
+                    self._states[i] = opt.create_state_multi_precision(
+                        i, w)
+                flat += [w, g, self._states[i]]
+            _ops.multi_sgd_mom_update(
+                *flat, lrs=lrs, wds=wds, momentum=opt.momentum,
+                rescale_grad=opt.rescale_grad,
+                clip_gradient=opt.clip_gradient)
+        else:
+            flat = []
+            for param, w, g in arrays:
+                flat += [w, g]
+            _ops.multi_sgd_update(
+                *flat, lrs=lrs, wds=wds, rescale_grad=opt.rescale_grad,
+                clip_gradient=opt.clip_gradient)
+        for param, _, _ in arrays:
+            param._data._grad_fresh = False
+        return True
+
     def _update(self, ignore_stale_grad=False):
+        if self._fused_group_update(ignore_stale_grad):
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null" or param._data is None:
                 continue

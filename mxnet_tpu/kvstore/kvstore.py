@@ -148,6 +148,23 @@ def _listify(v):
     return v if isinstance(v, (list, tuple)) else [v]
 
 
+def _make_buckets(flats, bound):
+    """Greedy coalescing of flat arrays into <=bound-byte buckets (index
+    lists) — the BIGARRAY_BOUND wire coalescing shared by the allreduce
+    and allgather paths."""
+    buckets, cur, cur_bytes = [], [], 0
+    for i, f in enumerate(flats):
+        nbytes = f.size * f.dtype.itemsize
+        if cur and cur_bytes + nbytes > bound:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nbytes
+    if cur:
+        buckets.append(cur)
+    return buckets
+
+
 class KVStoreLocal(KVStore):
     """Single-process store. Reference: KVStoreLocal + CommCPU/CommDevice
     (src/kvstore/kvstore_local.h, comm.h): push of a list of per-device
@@ -335,16 +352,20 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
     def init(self, key, value):
         """Reference semantics (KVStoreDist::InitImpl): the server keeps
         worker 0's value; other workers' inits are ignored. Implemented as
-        a rank-0 broadcast (zeros elsewhere + cross-process sum) so every
-        process starts from identical weights."""
+        a rank-0 broadcast (zeros elsewhere + cross-process sum), bucketed
+        into ONE collective round per BIGARRAY_BOUND of payload — not one
+        blocking DCN round per parameter."""
         super().init(key, value)
         if self._size > 1:
             keys, _ = self._canon(key, value)
-            for k in keys:
-                k = str(k)
-                v = self._store[k].data
-                contrib = v if self._rank == 0 else jnp.zeros_like(v)
-                self._store[k]._set_data(_cross_process_sum(contrib))
+            vals = [self._store[str(k)].data for k in keys]
+            contribs = [v if self._rank == 0 else jnp.zeros_like(v)
+                        for v in vals]
+            reduced = self._bucketed_allreduce(contribs)
+            if reduced is None:
+                reduced = [_cross_process_sum(c) for c in contribs]
+            for k, r in zip(keys, reduced):
+                self._store[str(k)]._set_data(r)
 
     def push(self, key, value, priority=0):
         from ..ndarray.sparse import RowSparseNDArray
@@ -444,16 +465,7 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
             local_devs = jax.local_devices()
             bound = self._bound()
             flats = [jnp.ravel(a).astype(jnp.float32) for a in arrays]
-            buckets, cur, cur_bytes = [], [], 0
-            for i, f in enumerate(flats):
-                nbytes = f.size * 4
-                if cur and cur_bytes + nbytes > bound:
-                    buckets.append(cur)
-                    cur, cur_bytes = [], 0
-                cur.append(i)
-                cur_bytes += nbytes
-            if cur:
-                buckets.append(cur)
+            buckets = _make_buckets(flats, bound)
             out_per_key = [None] * len(arrays)
             for idxs in buckets:
                 concat = jnp.concatenate([flats[i] for i in idxs]) \
@@ -500,16 +512,7 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
         from jax.experimental import multihost_utils
         bound = self._bound()
         flats = [a.reshape(-1) for a in arrays]
-        buckets, cur, cur_bytes = [], [], 0
-        for i, f in enumerate(flats):
-            nbytes = f.size * f.dtype.itemsize
-            if cur and cur_bytes + nbytes > bound:
-                buckets.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(i)
-            cur_bytes += nbytes
-        if cur:
-            buckets.append(cur)
+        buckets = _make_buckets(flats, bound)
         per_key = [None] * len(arrays)
         for idxs in buckets:
             if len({flats[i].dtype for i in idxs}) > 1:
@@ -609,12 +612,6 @@ class KVStoreDistAsync(KVStoreLocal):
             w = jnp.asarray(self._client.pull(str(k)))
             for dst in _listify(o):
                 dst._set_data(w)
-
-    def pushpull(self, key, value, out=None, priority=0):
-        self.push(key, value, priority)
-        self.pull(key, out=out if out is not None else value,
-                  priority=priority)
-        return out
 
     def push_stats(self):
         """Applied-push counters per key (stale pushes included) — test /

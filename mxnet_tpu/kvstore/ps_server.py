@@ -68,8 +68,8 @@ class PSServer:
 
     def __init__(self, host, port, num_workers):
         self._table = {}          # key -> np.ndarray (the live weights)
-        self._updater = None      # server-side optimizer (set_optimizer)
-        self._states = {}         # key -> optimizer state
+        self._updater = None      # server-side optimizer (set_optimizer;
+                                  # per-key state lives in _ServerUpdater)
         self._push_count = {}     # key -> applied pushes (incl. stale)
         self._lock = threading.Lock()
         self._num_workers = num_workers
@@ -201,6 +201,11 @@ class PSClient:
             try:
                 self._sock = socket.create_connection((host, port),
                                                       timeout=120)
+                # connect timeout must NOT become the RPC timeout: async
+                # workers legitimately block in barrier()/pull() for as
+                # long as the slowest worker takes (reference ps-lite
+                # blocks indefinitely; liveness is the launcher's job)
+                self._sock.settimeout(None)
                 break
             except OSError as e:     # server thread may start a bit later
                 last = e

@@ -19,9 +19,19 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx=None, shapes=None, args=None,
-                 args_grad=None, grad_req="write", label_shapes=None):
+                 args_grad=None, grad_req="write", label_shapes=None,
+                 group2ctxs=None):
         self._symbol = symbol
         self._ctx = ctx or current_context()
+        # manual model parallel (reference group2ctx in Symbol.bind):
+        # {ctx_group attr -> Context}; ops in a group run on its device
+        self._ctx_map = {}
+        if group2ctxs:
+            g2c = group2ctxs[0] if isinstance(group2ctxs, (list, tuple)) \
+                else group2ctxs
+            for group, c in g2c.items():
+                d = getattr(c, "jax_device", c)
+                self._ctx_map[group] = d
         self.grad_req = grad_req
         arg_names = symbol.list_arguments()
         self.arg_dict = {}
@@ -193,9 +203,11 @@ class Executor:
                 if req != "null" and not _is_input_name(name):
                     arr.attach_grad(req)
             with autograd.record():
-                out = self._symbol._eval(bindings)
+                out = self._symbol._eval(bindings,
+                                         ctx_map=self._ctx_map or None)
         else:
-            out = self._symbol._eval(bindings)
+            out = self._symbol._eval(bindings,
+                                     ctx_map=self._ctx_map or None)
         self.outputs = out if isinstance(out, list) else [out]
         self._train_outputs = self.outputs if is_train else None
         return self.outputs

@@ -207,7 +207,7 @@ class Module(BaseModule):
             else:
                 reqs[n] = grad_req
         self._exec = Executor(self.symbol, self._context, shapes,
-                              grad_req=reqs)
+                              grad_req=reqs, group2ctxs=self._group2ctxs)
         # parameter shapes follow from the data shapes via the executor's
         # InferShape remnant (SURVEY.md §2.1 Symbol/nnvm row)
         self._exec._materialize_params()
@@ -320,16 +320,27 @@ class Module(BaseModule):
             self._update_on_kvstore = os.environ.get(
                 "MXTPU_UPDATE_ON_KVSTORE",
                 os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")) == "1"
+            if self._kvstore.type == "dist_async" and \
+                    not self._update_on_kvstore:
+                # the PS table holds WEIGHTS; a pushpull would hand the
+                # local optimizer a weight as if it were a gradient.
+                # Reference refuses the combination too (mxnet.model
+                # _update_params asserts update_on_kvstore for async).
+                raise MXNetError(
+                    "dist_async requires update_on_kvstore=1 (the "
+                    "server applies the optimizer)")
             if self._update_on_kvstore:
                 self._kvstore.set_optimizer(self._optimizer)
-            # register every trainable param; dist stores broadcast rank
-            # 0's value so all workers start identical (SURVEY.md §3.5
-            # "worker 0: kv.init -> broadcast initial weights")
-            for i, name in enumerate(self._trainable_names()):
-                arr = self._exec.arg_dict[name]
-                self._kvstore.init(i, arr)
-                if self._kvstore.num_workers > 1:
-                    self._kvstore.pull(i, out=arr)
+            # register every trainable param in ONE list call; dist stores
+            # broadcast rank 0's values (bucketed — one collective per
+            # 25MB, not per param) so all workers start identical
+            # (SURVEY.md §3.5 "worker 0: kv.init -> broadcast")
+            names = self._trainable_names()
+            keys = list(range(len(names)))
+            arrs = [self._exec.arg_dict[n] for n in names]
+            self._kvstore.init(keys, arrs)
+            if self._kvstore.num_workers > 1:
+                self._kvstore.pull(keys, out=arrs)
         self.optimizer_initialized = True
 
     def _trainable_names(self):

@@ -22,7 +22,8 @@ __all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
            "bipartite_matching", "ROIAlign", "ROIPooling",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
            "getnnz", "quantize", "arange_like", "fused_gelu",
-           "BilinearResize2D", "AdaptiveAvgPooling2D"]
+           "BilinearResize2D", "AdaptiveAvgPooling2D",
+           "DeformableConvolution"]
 
 
 def _corner(box, fmt):
@@ -521,11 +522,11 @@ def quantize(data, min_range, max_range, out_type="uint8"):
     return apply_nary(fn, [data, min_range, max_range], name="quantize")
 
 
-def arange_like(data, start=0.0, step=1.0, axis=None):
-    def fn(d):
-        n = d.size if axis is None else d.shape[axis]
-        return start + step * jnp.arange(n, dtype=d.dtype)
-    return apply_nary(fn, [data], name="arange_like")
+def arange_like(data, start=0.0, step=1.0, axis=None, repeat=1):
+    """Delegates to the single implementation in ops.py (reference
+    init_op.cc arange_like; contrib exports the same op)."""
+    from .ops import arange_like as _al
+    return _al(data, start=start, step=step, repeat=repeat, axis=axis)
 
 
 def fused_gelu(data):
@@ -567,3 +568,83 @@ def AdaptiveAvgPooling2D(data, output_size=1):
         return jnp.concatenate(cols, axis=3)
 
     return apply_nary(fn, [data], name="AdaptiveAvgPooling2D")
+
+
+def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
+                          stride=(1, 1), pad=(0, 0), dilate=(1, 1),
+                          num_filter=1, num_deformable_group=1,
+                          no_bias=False, **kwargs):
+    """Deformable convolution v1 (reference:
+    src/operator/contrib/deformable_convolution.cc — Dai et al. 2017).
+
+    data (B, C, H, W); offset (B, dg*2*kh*kw, Ho, Wo) with per-tap (y, x)
+    offset pairs; weight (O, C, kh, kw). TPU-native: the deformable im2col
+    is a vmapped bilinear gather (VPU) feeding ONE big (O, C*kh*kw) x
+    (C*kh*kw, Ho*Wo) matmul (MXU) — no per-pixel scalar loops.
+    """
+    from .ndarray import NDArray, apply_nary
+    kh, kw = kernel
+    sh, sw = stride
+    ph, pw = pad
+    dh, dw = dilate
+    dg = num_deformable_group
+
+    def fn(*arrs):
+        d, off, w = arrs[0], arrs[1], arrs[2]
+        b = arrs[3] if len(arrs) > 3 else None
+        B, C, H, W = d.shape
+        O = w.shape[0]
+        Ho = (H + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        Wo = (W + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        base_y = jnp.arange(Ho) * sh - ph          # (Ho,)
+        base_x = jnp.arange(Wo) * sw - pw
+        off = off.reshape(B, dg, kh * kw, 2, Ho, Wo)
+        d_grp = d.reshape(B, dg, C // dg, H, W)
+
+        def sample(img, py, px):
+            # img (Cg, H, W); py/px (Ho, Wo) absolute float coords
+            y0 = jnp.floor(py)
+            x0 = jnp.floor(px)
+            wy = py - y0
+            wx = px - x0
+
+            def at(yy, xx):
+                yi = jnp.clip(yy.astype(jnp.int32), 0, H - 1)
+                xi = jnp.clip(xx.astype(jnp.int32), 0, W - 1)
+                valid = ((yy >= 0) & (yy <= H - 1) &
+                         (xx >= 0) & (xx <= W - 1)).astype(img.dtype)
+                return img[:, yi, xi] * valid[None]
+            return (at(y0, x0) * (1 - wy) * (1 - wx) +
+                    at(y0, x0 + 1) * (1 - wy) * wx +
+                    at(y0 + 1, x0) * wy * (1 - wx) +
+                    at(y0 + 1, x0 + 1) * wy * wx)     # (Cg, Ho, Wo)
+
+        def one_image(img_g, off_g):
+            # img_g (dg, Cg, H, W); off_g (dg, kh*kw, 2, Ho, Wo)
+            def one_group(img, offs):
+                def one_tap(t):
+                    i, j = t // kw, t % kw
+                    py = base_y[:, None] + i * dh + offs[t, 0]
+                    px = base_x[None, :] + j * dw + offs[t, 1]
+                    return sample(img, py, px)        # (Cg, Ho, Wo)
+                taps = jax.vmap(one_tap)(jnp.arange(kh * kw))
+                return taps                            # (K, Cg, Ho, Wo)
+            cols = jax.vmap(one_group)(img_g, off_g)   # (dg, K, Cg, Ho, Wo)
+            # -> (C*kh*kw, Ho*Wo) with channel-major layout matching the
+            # (O, C, kh, kw) weight flatten
+            cols = jnp.transpose(cols, (0, 2, 1, 3, 4))   # (dg, Cg, K, ...)
+            return cols.reshape(C * kh * kw, Ho * Wo)
+
+        cols = jax.vmap(one_image)(d_grp, off)         # (B, C*K, Ho*Wo)
+        wm = w.reshape(O, C * kh * kw)
+        out = jnp.einsum("ok,bkn->bon", wm, cols,
+                         preferred_element_type=jnp.float32)
+        out = out.reshape(B, O, Ho, Wo).astype(d.dtype)
+        if b is not None:
+            out = out + b.reshape(1, O, 1, 1)
+        return out
+
+    inputs = [data, offset, weight]
+    if bias is not None and not no_bias:
+        inputs.append(bias)
+    return apply_nary(fn, inputs, name="DeformableConvolution")

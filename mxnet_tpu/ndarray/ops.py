@@ -579,19 +579,17 @@ def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
     outs, node = _tape.apply_op(fn, [data_nd, weight_nd], n_out=1,
                                 name="Embedding(sparse_grad)")
     if node is not None:
-        import numpy as _np
-        ids_np = _np.asarray(data_nd.data).astype(_np.int64).ravel()
-        uniq, inv = _np.unique(ids_np, return_inverse=True)
-        inv_j = jnp.asarray(inv)
-        uniq_j = jnp.asarray(uniq)
+        # Fully device-side pullback (r2 weak #6 fixed): the cotangent
+        # carries the RAW batch ids (duplicates included) — no host
+        # np.unique on the forward hot path, nnz bounded by the batch.
+        # Dedup is deferred to SparseCotangent.dedup() at leaf
+        # materialization (all consumers sum duplicates).
+        ids_j = data_nd.data.astype(jnp.int64).ravel()
         vocab_shape = weight_nd.shape
 
         def sparse_vjp(cot):
             flat = cot.reshape(-1, cot.shape[-1])
-            vals = jax.ops.segment_sum(flat, inv_j,
-                                       num_segments=uniq_j.shape[0])
-            return (None,
-                    _tape.SparseCotangent(uniq_j, vals, vocab_shape))
+            return (None, _tape.SparseCotangent(ids_j, flat, vocab_shape))
         node.vjp_fn = sparse_vjp
     out = _ND(outs[0], data_nd._ctx)
     if node is not None:
@@ -1539,3 +1537,18 @@ def multi_lamb_update(*arrays, lrs, wds, beta1=0.9, beta2=0.999,
         mean._set_data(updated[3 * gi + 1].data)
         var._set_data(updated[3 * gi + 2].data)
     return [updated[3 * i] for i in range(len(groups))]
+
+
+@_register
+def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
+    """arange shaped like ``data`` (or its ``axis`` length) — reference
+    src/operator/tensor/init_op.cc (arange_like). ``repeat`` repeats each
+    value WITHIN the same element count (the output always has data's
+    shape / the axis length)."""
+    def fn(d):
+        n = d.shape[axis] if axis is not None else d.size
+        dt = d.dtype if jnp.issubdtype(d.dtype, jnp.floating) or \
+            jnp.issubdtype(d.dtype, jnp.integer) else jnp.float32
+        vals = (start + step * (jnp.arange(n) // repeat)).astype(dt)
+        return vals if axis is not None else vals.reshape(d.shape)
+    return apply_nary(fn, [data], name="arange_like")

@@ -94,11 +94,14 @@ class RowSparseNDArray(BaseSparseNDArray):
 
     def _refresh_sparse(self):
         if self._sparse_stale:
-            np_d = _np.asarray(self._dense_cache)
-            nz = _np.where(_np.any(np_d != 0,
-                                   axis=tuple(range(1, np_d.ndim))))[0]
-            self._indices = jnp.asarray(nz, self._indices.dtype)
-            self._values = jnp.asarray(np_d[nz])
+            d = self._dense_cache
+            # device-side recovery (r2 weak #7): row mask + gather stay on
+            # device; only the O(rows) mask syncs to size the result —
+            # never the O(rows x dim) dense payload
+            mask = jnp.any(d != 0, axis=tuple(range(1, d.ndim)))
+            nz = jnp.nonzero(mask)[0]
+            self._indices = nz.astype(self._indices.dtype)
+            self._values = jnp.take(d, nz, axis=0)
             self._sparse_stale = False
 
     # -- shape/dtype without densifying ---------------------------------

@@ -1,26 +1,84 @@
-"""``mx.npx`` — numpy-extension namespace.
+"""``mx.npx`` — the numpy-extension namespace.
 
-Reference: python/mxnet/numpy_extension/ [≥1.6]. Provides the non-numpy
-neural ops under numpy semantics. Backed directly by the op library.
+Reference: python/mxnet/numpy_extension/ [>=1.6]: the neural/network ops
+that have no numpy counterpart, exposed under numpy-array semantics
+(``mx.np`` is jax.numpy per SURVEY.md §2.2 "numpy-compat" disposition).
+Families mirrored here: nn compute ops, control flow
+(src/operator/control_flow.cc), sequence ops, detection/contrib ops,
+engine/state utilities (seed/waitall), and io.
 """
 from __future__ import annotations
 
-from .ndarray.ops import (softmax, log_softmax, relu, sigmoid, one_hot,
-                          topk, pick, batch_dot, FullyConnected, Convolution,
-                          Pooling, BatchNorm, LayerNorm, Embedding, Dropout,
-                          Activation, sequence_mask)
-from .util import set_np, reset_np, is_np_array
+from .ndarray.ops import (  # noqa: F401 — re-exported surface
+    softmax, log_softmax, relu, sigmoid, one_hot, topk, pick, batch_dot,
+    FullyConnected, Convolution, Deconvolution, Pooling, BatchNorm,
+    LayerNorm, Embedding, Dropout, Activation, LeakyReLU, sequence_mask,
+    sequence_last, sequence_reverse, gather_nd, scatter_nd, arange_like,
+    smooth_l1, ctc_loss, GridGenerator, BilinearSampler, where, clip,
+    erf, erfinv, gamma, gammaln, reshape, foreach, while_loop, cond)
+from .ndarray.contrib import box_iou, box_nms, ROIAlign as roi_align  # noqa: F401
+from .ndarray.ndarray import waitall  # noqa: F401
+from .ndarray import random  # noqa: F401
+from .ndarray.utils import save, load  # noqa: F401
+from .util import set_np, reset_np, is_np_array  # noqa: F401
+from .context import cpu, gpu, num_gpus  # noqa: F401
 
+# snake_case aliases (npx convention)
 fully_connected = FullyConnected
 convolution = Convolution
+deconvolution = Deconvolution
 pooling = Pooling
 batch_norm = BatchNorm
 layer_norm = LayerNorm
 embedding = Embedding
 dropout = Dropout
 activation = Activation
+leaky_relu = LeakyReLU
+grid_generator = GridGenerator
+bilinear_sampler = BilinearSampler
+top_k = topk
 
 
 def gelu(x):
-    from .ndarray.ops import LeakyReLU
+    """Gaussian error linear unit (reference npx.leaky_relu
+    act_type='gelu')."""
     return LeakyReLU(x, act_type="gelu")
+
+
+def seed(s):
+    """Global PRNG seed (reference npx.random.seed)."""
+    from .ndarray import random as _r
+    _r.seed(s)
+
+
+def batch_flatten(x):
+    """Collapse all but the first axis (reference npx.batch_flatten)."""
+    return x.reshape((x.shape[0], -1))
+
+
+def sigmoid_binary_cross_entropy(pred, label):
+    """Numerically-stable fused sigmoid + binary cross entropy."""
+    import jax.numpy as jnp
+    from .ndarray.ndarray import NDArray, apply_nary
+
+    def fn(p, y):
+        return jnp.maximum(p, 0) - p * y + jnp.log1p(jnp.exp(-jnp.abs(p)))
+    if isinstance(pred, NDArray):
+        return apply_nary(fn, [pred, label],
+                          name="sigmoid_binary_cross_entropy")
+    return fn(pred, label)
+
+
+__all__ = [
+    "softmax", "log_softmax", "relu", "sigmoid", "one_hot", "topk",
+    "top_k", "pick", "batch_dot", "fully_connected", "convolution",
+    "deconvolution", "pooling", "batch_norm", "layer_norm", "embedding",
+    "dropout", "activation", "leaky_relu", "gelu", "sequence_mask",
+    "sequence_last", "sequence_reverse", "gather_nd", "scatter_nd",
+    "arange_like", "smooth_l1", "ctc_loss", "grid_generator",
+    "bilinear_sampler", "roi_align", "box_iou", "box_nms", "foreach",
+    "while_loop", "cond", "waitall", "seed", "random", "save", "load",
+    "set_np", "reset_np", "is_np_array", "cpu", "gpu", "num_gpus",
+    "batch_flatten", "sigmoid_binary_cross_entropy", "reshape", "where",
+    "clip", "erf", "erfinv", "gamma", "gammaln",
+]

@@ -12,7 +12,8 @@ Module-API scripts and `sym.json` tooling keep working:
   - ``tojson`` / ``load_json`` round-trip the expression graph
 """
 from . import symbol as _symbol_mod
-from .symbol import Symbol, var, Variable, Group, load, load_json
+from .symbol import (Symbol, AttrScope, var, Variable, Group, load,
+                     load_json)
 
 
 def __getattr__(name):

@@ -18,6 +18,33 @@ from .. import ndarray as _nd
 __all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
 
 
+class AttrScope:
+    """``with mx.AttrScope(ctx_group='stage1'):`` — attrs attached to every
+    Symbol created in the scope. Reference: python/mxnet/attribute.py (the
+    manual model-parallel placement mechanism: ctx_group + bind's
+    group2ctx, SURVEY.md §2.5 "Model parallel")."""
+
+    _stack = []
+
+    def __init__(self, **attrs):
+        self._attrs = attrs
+
+    @classmethod
+    def _current(cls):
+        merged = {}
+        for scope in cls._stack:
+            merged.update(scope._attrs)
+        return merged
+
+    def __enter__(self):
+        AttrScope._stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        AttrScope._stack.pop()
+        return False
+
+
 class Symbol:
     """A node in the lazy expression graph."""
 
@@ -28,6 +55,7 @@ class Symbol:
         self._name = name or (op if op else "var")
         self._outputs = outputs        # for Group / multi-output slicing
         self._out_index = None
+        self._attrs = dict(AttrScope._current()) if AttrScope._stack else {}
 
     # -- construction ---------------------------------------------------
     @staticmethod
@@ -75,7 +103,7 @@ class Symbol:
         return out
 
     def attr(self, key):
-        return None
+        return self._attrs.get(key) if self._attrs else None
 
     def get_internals(self):
         return Group(_collect_nodes(self))
@@ -113,7 +141,7 @@ class Symbol:
         return Symbol("negative", [self], {})
 
     # -- evaluation -----------------------------------------------------
-    def _eval(self, bindings, cache=None):
+    def _eval(self, bindings, cache=None, ctx_map=None):
         cache = {} if cache is None else cache
         key = id(self)
         if key in cache:
@@ -123,10 +151,20 @@ class Symbol:
                 raise MXNetError(f"unbound symbol variable '{self._name}'")
             out = bindings[self._name]
         elif self._outputs is not None:
-            out = [o._eval(bindings, cache) for o in self._outputs]
+            out = [o._eval(bindings, cache, ctx_map) for o in self._outputs]
         else:
-            args = [a._eval(bindings, cache) if isinstance(a, Symbol) else a
-                    for a in self._args]
+            args = [a._eval(bindings, cache, ctx_map)
+                    if isinstance(a, Symbol) else a for a in self._args]
+            if ctx_map:
+                group = self._attrs.get("ctx_group")
+                dev = ctx_map.get(group)
+                if dev is not None:
+                    # cross-device hop as a TAPE-VISIBLE op: device_put is
+                    # a differentiable jax primitive, so the cotangent
+                    # transfers back automatically in backward (the manual
+                    # model-parallel boundary, reference group2ctx in
+                    # Symbol.bind / example/model-parallel)
+                    args = [_to_device(a, dev) for a in args]
             out = _apply_nd_op(self._op, args, self._kwargs)
             if self._out_index is not None:
                 out = out[self._out_index]
@@ -194,6 +232,12 @@ class Symbol:
             node = {"op": s._op or "null", "name": s._name,
                     "attrs": {k: str(v) for k, v in s._kwargs.items()},
                     "inputs": arg_ids}
+            if s._attrs:
+                # AttrScope attrs (ctx_group etc.) must survive the json
+                # round-trip or reloaded models lose their model-parallel
+                # placement silently
+                node["node_attrs"] = {k: str(v)
+                                      for k, v in s._attrs.items()}
             nodes.append(node)
             index[id(s)] = len(nodes) - 1
             return len(nodes) - 1
@@ -209,6 +253,22 @@ class Symbol:
 
     def __repr__(self):
         return f"<Symbol {self._name}>"
+
+
+def _to_device(a, dev):
+    """Move an eval value to ``dev`` (a jax device) through the autograd
+    tape; non-arrays and already-placed arrays pass through."""
+    from ..ndarray.ndarray import NDArray, apply_nary
+    import jax
+    if not isinstance(a, NDArray):
+        return a
+    try:
+        if a.data.devices() == {dev}:
+            return a
+    except Exception:  # noqa: BLE001 — uncommitted arrays just move
+        pass
+    return apply_nary(lambda d: jax.device_put(d, dev), [a],
+                      name="_cross_device_copy")
 
 
 def _collect_nodes(sym):
@@ -281,7 +341,10 @@ def load_json(json_str):
                     args.append(built[ref])
             kwargs = {k: _parse_attr(v) for k, v in
                       node.get("attrs", {}).items()}
-            built.append(Symbol(node["op"], args, kwargs, name=node["name"]))
+            sym = Symbol(node["op"], args, kwargs, name=node["name"])
+            if node.get("node_attrs"):
+                sym._attrs = dict(node["node_attrs"])
+            built.append(sym)
     heads = [built[i] for i in data["heads"]]
     return heads[0] if len(heads) == 1 else Group(heads)
 

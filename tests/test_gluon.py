@@ -87,8 +87,11 @@ def test_hybridize_grads_match():
         with autograd.record():
             y = net(x).sum()
         y.backward()
+        # insertion (structural) order, NOT sorted-by-name: the global
+        # name counters differ between the two builds and "dense10_" sorts
+        # before "dense9_", which would misalign the zip
         grads.append([p.grad().asnumpy()
-                      for _, p in sorted(net.collect_params().items())
+                      for _, p in net.collect_params().items()
                       if p.grad_req != "null"])
     for g0, g1 in zip(*grads):
         assert_almost_equal(g0, g1, rtol=1e-4)

@@ -311,3 +311,27 @@ def test_linalg_gemm_axis():
     out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c), axis=-3)
     ref = np.einsum("ibk,kbj->ibj", a, b) + c
     assert_almost_equal(out.asnumpy(), ref, rtol=1e-5)
+
+
+def test_deformable_convolution_zero_offset_matches_conv():
+    """contrib.DeformableConvolution with zero offsets == Convolution
+    (reference src/operator/contrib/deformable_convolution.cc)."""
+    rng = np.random.RandomState(12)
+    x = nd.array(rng.randn(2, 4, 8, 8).astype(np.float32))
+    w = nd.array(rng.randn(5, 4, 3, 3).astype(np.float32))
+    b = nd.array(rng.randn(5).astype(np.float32))
+    off = nd.zeros((2, 2 * 9, 8, 8))
+    out = nd.contrib.DeformableConvolution(
+        x, off, w, b, kernel=(3, 3), pad=(1, 1), num_filter=5)
+    ref = nd.Convolution(x, w, b, kernel=(3, 3), pad=(1, 1), num_filter=5)
+    assert_almost_equal(out.asnumpy(), ref.asnumpy(), rtol=1e-4, atol=1e-4)
+    # gradients flow to data, offset and weight
+    for arr in (x, off, w):
+        arr.attach_grad()
+    with autograd.record():
+        loss = nd.contrib.DeformableConvolution(
+            x, off, w, b, kernel=(3, 3), pad=(1, 1), num_filter=5).sum()
+    loss.backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert np.abs(w.grad.asnumpy()).sum() > 0
+    assert np.isfinite(off.grad.asnumpy()).all()

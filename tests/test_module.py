@@ -285,3 +285,67 @@ def test_module_fit_dist_2proc(tmp_path):
     digests = dict(re.findall(r"WORKER_DIGEST (\d+) ([0-9.]+)", r.stdout))
     assert len(digests) == 2, r.stdout + r.stderr
     assert digests["0"] == digests["1"], digests
+
+
+def test_symbol_json_roundtrip_rebuilds_module(tmp_path):
+    """Symbol.load(tojson()) -> executable graph: load_checkpoint rebuilds
+    a scoring Module WITHOUT the original model script (r2 missing #5).
+    Reference: Symbol.load/load_json -> GraphExecutor (SURVEY.md §5.4)."""
+    mod = mx.mod.Module(_toy_symbol(), data_names=("data",),
+                        label_names=("softmax_label",))
+    train = _toy_iter(seed=0)
+    mod.fit(train, num_epoch=5, initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    prefix = str(tmp_path / "sym_ckpt")
+    mod.save_checkpoint(prefix, 1)
+
+    # rebuild purely from the saved files: symbol json + params blob
+    sym, arg_params, aux_params = mx.mod.load_checkpoint(prefix, 1)
+    assert sym is not None, "symbol.json did not round-trip"
+    mod2 = mx.mod.Module(sym, data_names=("data",),
+                         label_names=("softmax_label",))
+    mod2.bind(data_shapes=[("data", (24, 8))],
+              label_shapes=[("softmax_label", (24,))])
+    mod2.set_params(arg_params, aux_params)
+    val = _toy_iter(seed=1)
+    m1, m2 = mx.metric.Accuracy(), mx.metric.Accuracy()
+    mod.score(val, m1)
+    mod2.score(val, m2)
+    assert m2.get()[1] == pytest.approx(m1.get()[1], abs=1e-6)
+    assert m2.get()[1] > 0.9
+
+
+@pytest.mark.skipif(len(__import__("jax").devices()) < 2,
+                    reason="needs 2 devices")
+def test_group2ctxs_manual_model_parallel():
+    """Manual model parallel (r2 missing #6): AttrScope(ctx_group=...) +
+    Module(group2ctxs=...) places each stage's compute on its own device;
+    cross-device hops are tape ops so backward crosses back. Reference:
+    group2ctx in Symbol.bind + example/model-parallel."""
+    import jax
+    with mx.AttrScope(ctx_group="stage1"):
+        data = mx.sym.var("data")
+        fc1 = mx.sym.FullyConnected(data, num_hidden=16, name="g_fc1")
+        act = mx.sym.Activation(fc1, act_type="relu", name="g_relu")
+    with mx.AttrScope(ctx_group="stage2"):
+        fc2 = mx.sym.FullyConnected(act, num_hidden=3, name="g_fc2")
+        sym = mx.sym.SoftmaxOutput(fc2, name="softmax")
+    assert fc1.attr("ctx_group") == "stage1"
+    assert fc2.attr("ctx_group") == "stage2"
+
+    ctx1 = mx.context.Context("cpu", 0)
+    ctx2 = mx.context.Context("cpu", 1)
+    mod = mx.mod.Module(sym, data_names=("data",),
+                        label_names=("softmax_label",),
+                        group2ctxs={"stage1": ctx1, "stage2": ctx2})
+    train = _toy_iter(seed=0)
+    val = _toy_iter(seed=1)
+    mod.fit(train, eval_data=val, num_epoch=10,
+            initializer=mx.init.Xavier(),
+            optimizer="sgd", optimizer_params={"learning_rate": 0.5})
+    # the head really ran on stage2's device
+    out_dev = mod.get_outputs()[0].data.devices()
+    assert out_dev == {ctx2.jax_device}, out_dev
+    m = mx.metric.Accuracy()
+    mod.score(val, m)
+    assert m.get()[1] > 0.9

@@ -166,3 +166,41 @@ def test_trainer_states_roundtrip(tmp_path):
                         {"learning_rate": 0.01})
     tr2.load_states(f)
     assert tr2._optimizer.num_update == tr._optimizer.num_update
+
+
+def test_trainer_fused_group_update_parity():
+    """gluon.Trainer's multi-tensor SGD fast path must match the
+    per-param update bit-for-bit (reference multi_sgd_mom_update parity
+    with sgd_mom_update)."""
+    import numpy as np
+    from mxnet_tpu import gluon, autograd, nd
+    from mxnet_tpu.gluon import nn
+
+    def build_and_train(disable_fused):
+        np.random.seed(3)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(8, activation="relu"), nn.Dense(2))
+        net.initialize(init=mx.init.Constant(0.07))
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1, "momentum": 0.9,
+                            "wd": 0.01}, kvstore=None)
+        if disable_fused:
+            tr._fused_group_update = lambda *_: False
+        loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+        x = nd.array(np.random.RandomState(5).randn(6, 4)
+                     .astype(np.float32))
+        y = nd.array(np.array([0, 1, 0, 1, 1, 0], np.float32))
+        for _ in range(4):
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            tr.step(6)
+        # name-scope counters differ between builds; compare by order
+        return [v.data().asnumpy()
+                for _, v in sorted(net.collect_params().items())]
+
+    fused = build_and_train(False)
+    serial = build_and_train(True)
+    for i, (f, s) in enumerate(zip(fused, serial)):
+        np.testing.assert_allclose(f, s, rtol=1e-6, err_msg=str(i))

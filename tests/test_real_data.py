@@ -1,0 +1,87 @@
+"""REAL-data convergence floor (VERDICT r2 task 9).
+
+The synthetic MNIST floor (tests/test_module.py) is class-separable by
+construction; this test runs the full real pipeline on REAL handwritten
+digit images — sklearn's bundled UCI digits set (1797 genuine scans, no
+network needed): real images -> JPEG -> .rec (tools/im2rec.py format) ->
+ImageRecordIter (C++ decode when built) -> hybridized MLP -> accuracy
+floor. Reference contract: tests/python/train/test_mlp.py (SURVEY.md §4.5).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+
+def _digits_rec(tmp_path, split):
+    sklearn_datasets = pytest.importorskip("sklearn.datasets")
+    cv2 = pytest.importorskip("cv2")
+    from mxnet_tpu import recordio
+
+    d = sklearn_datasets.load_digits()
+    images, labels = d.images, d.target         # (1797, 8, 8) real scans
+    order = np.random.RandomState(42).permutation(len(labels))
+    images, labels = images[order], labels[order]
+    n_train = 1500
+    if split == "train":
+        sl = slice(0, n_train)
+    else:
+        sl = slice(n_train, None)
+    prefix = str(tmp_path / f"digits_{split}")
+    rec = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec", "w")
+    for i, (img, lab) in enumerate(zip(images[sl], labels[sl])):
+        u8 = np.clip(img * 16, 0, 255).astype(np.uint8)
+        rgb = cv2.cvtColor(cv2.resize(u8, (28, 28),
+                                      interpolation=cv2.INTER_CUBIC),
+                           cv2.COLOR_GRAY2BGR)
+        header = recordio.IRHeader(0, float(lab), i, 0)
+        rec.write_idx(i, recordio.pack_img(header, rgb, quality=95))
+    rec.close()
+    return prefix + ".rec"
+
+
+def test_real_data_convergence_floor(tmp_path):
+    """Real scans through the real pipeline must converge: >0.95 val
+    accuracy (real data; the 0.98 MNIST figure is the synthetic-floor
+    contract in test_module.py)."""
+    train_rec = _digits_rec(tmp_path, "train")
+    val_rec = _digits_rec(tmp_path, "val")
+    train_iter = mx.io.ImageRecordIter(
+        path_imgrec=train_rec, data_shape=(3, 28, 28), batch_size=50,
+        shuffle=True, std_r=255.0, std_g=255.0, std_b=255.0)
+    val_iter = mx.io.ImageRecordIter(
+        path_imgrec=val_rec, data_shape=(3, 28, 28), batch_size=50,
+        std_r=255.0, std_g=255.0, std_b=255.0)
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Flatten(), nn.Dense(128, activation="relu"),
+                nn.Dense(64, activation="relu"), nn.Dense(10))
+    net.initialize(init=mx.init.Xavier())
+    net.hybridize()
+    # lr 0.1+momentum diverges on this set (verified in tuning); 0.05
+    # reaches the floor in ~20 epochs
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    np.random.seed(0)
+    for epoch in range(20):
+        train_iter.reset()
+        for batch in train_iter:
+            data, label = batch.data[0], batch.label[0]
+            with autograd.record():
+                loss = loss_fn(net(data), label)
+            loss.backward()
+            trainer.step(data.shape[0])
+
+    metric = mx.metric.Accuracy()
+    val_iter.reset()
+    for batch in val_iter:
+        metric.update([batch.label[0]], [net(batch.data[0])])
+    acc = metric.get()[1]
+    assert acc > 0.95, f"real-digits val acc {acc}"

@@ -237,3 +237,52 @@ def test_embedding_sparse_grad_matches_dense():
         outs[sparse] = g.asnumpy() if g.stype == "default" else \
             g.tostype("default").asnumpy()
     np.testing.assert_allclose(outs[False], outs[True], rtol=1e-6)
+
+
+def test_embedding_sparse_grad_device_side_duplicates():
+    """r2 weak #6: the pullback carries raw batch ids (no host unique on
+    the forward path); duplicate ids must SUM at materialization."""
+    from mxnet_tpu import autograd
+    w = nd.random.uniform(shape=(10, 4))
+    w.attach_grad(stype="row_sparse")
+    ids = nd.array(np.array([[1, 1], [2, 1]], np.float32))
+    with autograd.record():
+        out = nd.Embedding(ids, w, input_dim=10, output_dim=4,
+                           sparse_grad=True)
+        loss = out.sum()
+    loss.backward()
+    g = w.grad
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    assert isinstance(g, RowSparseNDArray)
+    np.testing.assert_array_equal(np.asarray(g.indices.asnumpy()), [1, 2])
+    np.testing.assert_allclose(g.values.asnumpy()[0], 3.0 * np.ones(4))
+    np.testing.assert_allclose(g.values.asnumpy()[1], 1.0 * np.ones(4))
+
+
+def test_embedding_sparse_grad_survives_hybridize():
+    """Hybridized block with a row_sparse-grad Embedding keeps O(nnz)
+    grads (imperative FComputeEx-style fallback, not silent dense)."""
+    import warnings as _w
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.ndarray.sparse import RowSparseNDArray
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Embedding(50, 8, sparse_grad=True))
+        net.add(nn.Dense(3, flatten=False))
+    net.initialize()
+    net.hybridize()
+    ids = nd.array(np.array([[3, 7, 3]], np.float32))
+    with _w.catch_warnings(record=True) as caught:
+        _w.simplefilter("always")
+        with autograd.record():
+            loss = net(ids).sum()
+        loss.backward()
+    emb_w = net[0].weight
+    g = emb_w.grad()
+    assert isinstance(g, RowSparseNDArray), type(g)
+    assert g.values.shape[0] <= 3          # O(nnz), not O(vocab)=50
+    assert any("row_sparse" in str(w.message) for w in caught)
+    # eval forward still uses the jitted path (no grads involved)
+    out = net(ids)
+    assert out.shape == (1, 3, 3)
