@@ -1,0 +1,122 @@
+"""Async checkpointing: training never blocks on the filesystem.
+
+Reference context (SURVEY.md §5.4): the reference's recovery story is
+"checkpoint every epoch and restart" with synchronous `mx.nd.save`. The
+TPU-idiomatic upgrade (orbax-style async checkpoint) splits the save into
+(a) a device->host snapshot started immediately (async D2H — the step
+stream keeps running) and (b) serialization + atomic file rename on a
+background thread. `save_checkpoint_async` returns a ticket; the NEXT save
+(or `wait()`) joins the previous write, bounding the number of in-flight
+checkpoints to one — the same discipline orbax uses.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+import jax
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+from .ndarray import utils as nd_utils
+
+__all__ = ["AsyncCheckpointer", "save_checkpoint_async"]
+
+
+class _Ticket:
+    def __init__(self):
+        self._done = threading.Event()
+        self._error = None
+        self.path = None
+
+    def wait(self, timeout=None):
+        """Block until the write is durable; re-raises writer errors."""
+        if not self._done.wait(timeout):
+            raise MXNetError("checkpoint write timed out")
+        if self._error is not None:
+            raise self._error
+        return self.path
+
+
+class AsyncCheckpointer:
+    """One in-flight checkpoint at a time, written off-thread.
+
+    Usage::
+
+        ckpt = AsyncCheckpointer()
+        for epoch in ...:
+            train_epoch()
+            ckpt.save(f"model-{epoch:04d}.params", net_params_dict)
+        ckpt.wait_until_finished()
+    """
+
+    def __init__(self):
+        self._current = None   # (thread, ticket)
+        self._lock = threading.Lock()
+
+    def save(self, fname, arrays):
+        """Snapshot ``arrays`` (name -> NDArray) and write them to
+        ``fname`` in the background. Returns a ticket with ``.wait()``.
+
+        The device buffers are snapshotted BEFORE returning (async D2H
+        copies are started; jax arrays are immutable so the values are
+        consistent even while training continues); only the host-side
+        serialization happens on the thread.
+        """
+        # start non-blocking D2H for every array; immutability makes this
+        # a consistent snapshot of "now"
+        snap = {}
+        for k, v in arrays.items():
+            a = v.data if isinstance(v, NDArray) else v
+            try:
+                a.copy_to_host_async()
+            except (AttributeError, RuntimeError):
+                pass
+            # wrap the captured IMMUTABLE jax array, never the caller's
+            # mutable handle — later `w += ...` on the handle must not
+            # leak into this snapshot
+            snap[k] = NDArray(a)
+
+        self.wait_until_finished()      # at most one write in flight
+        ticket = _Ticket()
+
+        def write():
+            tmp = fname + ".tmp"
+            try:
+                nd_utils.save(tmp, snap)
+                os.replace(tmp, fname)  # atomic: readers never see a torn file
+                ticket.path = fname
+            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                ticket._error = MXNetError(
+                    f"async checkpoint to {fname} failed: "
+                    f"{type(e).__name__}: {e}")
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+            finally:
+                ticket._done.set()
+
+        t = threading.Thread(target=write, daemon=True,
+                             name="mxtpu-ckpt-writer")
+        with self._lock:
+            self._current = (t, ticket)
+        t.start()
+        return ticket
+
+    def wait_until_finished(self, timeout=None):
+        with self._lock:
+            cur = self._current
+            self._current = None
+        if cur is not None:
+            thread, ticket = cur
+            ticket.wait(timeout)
+        return True
+
+
+_DEFAULT = AsyncCheckpointer()
+
+
+def save_checkpoint_async(fname, arrays):
+    """Module-level convenience over a shared AsyncCheckpointer."""
+    return _DEFAULT.save(fname, arrays)

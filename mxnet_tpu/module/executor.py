@@ -183,6 +183,30 @@ class Executor:
     def aux_arrays(self):
         return []
 
+    def _place_group_params(self):
+        """Pin each ctx_group's PARAMETERS on its mapped device once (the
+        reference binds weights to group2ctx devices at bind time) — so
+        only activations hop across stages in _eval, not whole weight
+        stacks every step."""
+        if not self._ctx_map:
+            return
+        import jax
+        from ..symbol.symbol import Symbol, _collect_nodes
+        heads = self._symbol._outputs or [self._symbol]
+        nodes = [n for h in heads for n in _collect_nodes(h)]
+        for node in nodes:
+            group = node._attrs.get("ctx_group") if node._attrs else None
+            dev = self._ctx_map.get(group)
+            if dev is None:
+                continue
+            for a in node._args:
+                if isinstance(a, Symbol) and a._op is None and \
+                        not _is_input_name(a._name):
+                    arr = self.arg_dict.get(a._name)
+                    if arr is not None and arr._data is not None and \
+                            arr.data.devices() != {dev}:
+                        arr._set_data(jax.device_put(arr.data, dev))
+
     def forward(self, is_train=False, **kwargs):
         for name, value in kwargs.items():
             if name not in self.arg_dict:
@@ -191,6 +215,7 @@ class Executor:
                 self.arg_dict[name]._set_data(
                     value.data if isinstance(value, NDArray) else value)
         self._materialize_params()
+        self._place_group_params()
         bindings = dict(self.arg_dict)
         # unbound labels evaluate as None: output heads then run
         # forward-only (softmax / identity), matching reference predict

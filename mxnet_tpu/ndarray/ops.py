@@ -584,7 +584,7 @@ def Embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
         # np.unique on the forward hot path, nnz bounded by the batch.
         # Dedup is deferred to SparseCotangent.dedup() at leaf
         # materialization (all consumers sum duplicates).
-        ids_j = data_nd.data.astype(jnp.int64).ravel()
+        ids_j = data_nd.data.astype(jnp.int32).ravel()
         vocab_shape = weight_nd.shape
 
         def sparse_vjp(cot):

@@ -28,24 +28,30 @@ from ..base import MXNetError
 __all__ = ["ring_attention", "sequence_parallel_attention"]
 
 
-def _block_attn(q, k, v, bias, scale):
-    """Standard attention for one (q_block, kv_block) pair, returning
-    (unnormalized out, row max, row denom) for streaming combination."""
-    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if bias is not None:
-        s = s + bias
-    m = jnp.max(s, axis=-1, keepdims=True)
-    p = jnp.exp(s - m)
-    l = jnp.sum(p, axis=-1, keepdims=True)
-    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
-    return o, m, l
+def _block_attn(q, k, v, causal, scale):
+    """Attention over one (q_block, kv_block) pair via the BLOCKWISE
+    streaming kernel (ops.flash_attention._scan_forward): per-device
+    memory O(T_local * bk), never the (T_local, T_local) score matrix —
+    the flash x ring composition (SURVEY.md §5.7 TPU plan). Returns
+    (normalized out, logsumexp) for exact cross-block combination."""
+    from ..ops.flash_attention import _pick_block, _scan_forward
+    b, h, t, d = q.shape
+    lk = k.shape[2]
+    bk = _pick_block(lk, 256) or lk
+    out, lse = _scan_forward(q.reshape(b * h, t, d),
+                             k.reshape(b * h, lk, d),
+                             v.reshape(b * h, lk, d), causal, scale, bk)
+    return (out.reshape(b, h, t, d),
+            lse.reshape(b, h, t))
 
 
-def _combine(o1, m1, l1, o2, m2, l2):
-    m = jnp.maximum(m1, m2)
-    a1 = jnp.exp(m1 - m)
-    a2 = jnp.exp(m2 - m)
-    return o1 * a1 + o2 * a2, m, l1 * a1 + l2 * a2
+def _combine(o1, lse1, o2, lse2):
+    """Exact merge of two normalized partial attentions via logsumexp;
+    a fully-masked block (lse=-inf) contributes exactly zero."""
+    lse = jnp.logaddexp(lse1, lse2)
+    w1 = jnp.exp(lse1 - lse)[..., None]
+    w2 = jnp.exp(lse2 - lse)[..., None]
+    return o1 * w1 + o2 * w2, lse
 
 
 def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
@@ -63,39 +69,31 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
 
     def local_fn(q_blk, k_blk, v_blk):
         idx = lax.axis_index(axis_name)
-        t_q = q_blk.shape[2]
 
-        def make_bias(kv_rank):
-            if not causal:
-                return None
-            # global positions: q rows at idx*t_q, kv cols at kv_rank*t_k
-            t_k = k_blk.shape[2]
-            q_pos = idx * t_q + jnp.arange(t_q)
-            k_pos = kv_rank * t_k + jnp.arange(t_k)
-            mask = q_pos[:, None] >= k_pos[None, :]
-            return jnp.where(mask, 0.0, -1e30)[None, None]
-
-        o, m, l = _block_attn(q_blk, k_blk, v_blk, make_bias(idx), scale)
+        # ring step 0 is always the DIAGONAL pair: in-block causal mask
+        # handled inside the streaming kernel itself
+        o, lse = _block_attn(q_blk, k_blk, v_blk, causal, scale)
 
         def body(i, carry):
-            o, m, l, k_cur, v_cur = carry
+            o, lse, k_cur, v_cur = carry
             perm = [(j, (j + 1) % n) for j in range(n)]
             k_cur = lax.ppermute(k_cur, axis_name, perm)
             v_cur = lax.ppermute(v_cur, axis_name, perm)
             kv_rank = (idx - i - 1) % n
-            bias = None
+            # off-diagonal pairs are all-or-nothing under causal: past
+            # blocks attend fully, future blocks are nulled via lse=-inf
+            # (uniform compute keeps the ring SPMD)
+            o2, lse2 = _block_attn(q_blk, k_cur, v_cur, False, scale)
             if causal:
-                t_k = k_cur.shape[2]
-                q_pos = idx * t_q + jnp.arange(t_q)
-                k_pos = kv_rank * t_k + jnp.arange(t_k)
-                mask = q_pos[:, None] >= k_pos[None, :]
-                bias = jnp.where(mask, 0.0, -1e30)[None, None]
-            o2, m2, l2 = _block_attn(q_blk, k_cur, v_cur, bias, scale)
-            o, m, l = _combine(o, m, l, o2, m2, l2)
-            return (o, m, l, k_cur, v_cur)
+                lse2 = jnp.where(kv_rank < idx, lse2,
+                                 jnp.full_like(lse2, -1e30))
+            o, lse = _combine(o, lse, o2, lse2)
+            return (o, lse, k_cur, v_cur)
 
-        o, m, l, _, _ = lax.fori_loop(0, n - 1, body, (o, m, l, k_blk, v_blk))
-        return o / jnp.maximum(l, 1e-30)
+        o, lse, _, _ = lax.fori_loop(0, n - 1, body, (o, lse, k_blk, v_blk))
+        # the logsumexp weights are f32; keep the caller's dtype (bf16
+        # AMP long-context is exactly this kernel's use case)
+        return o.astype(q_blk.dtype)
 
     spec = P(None, None, axis_name, None)
     sharding = jax.sharding.NamedSharding(mesh, spec)

@@ -331,7 +331,10 @@ def load_json(json_str):
     built = []
     for node in nodes:
         if node["op"] == "null":
-            built.append(var(node["name"]))
+            v = var(node["name"])
+            if node.get("node_attrs"):
+                v._attrs = dict(node["node_attrs"])
+            built.append(v)
         else:
             args = []
             for ref in node["inputs"]:

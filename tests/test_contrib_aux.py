@@ -120,3 +120,31 @@ def test_runtime_features():
     names = [str(f) for f in feats] if hasattr(feats, "__iter__") else \
         dir(feats)
     assert names
+
+
+def test_async_checkpointer_roundtrip(tmp_path):
+    """Async save -> wait -> load must round-trip; training-side mutation
+    after save() must NOT leak into the snapshot (SURVEY.md §5.4
+    orbax-style async checkpoint)."""
+    import numpy as np
+    from mxnet_tpu.checkpoint import AsyncCheckpointer
+    from mxnet_tpu.ndarray import utils as nd_utils
+
+    w = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    b = nd.array(np.ones(3, dtype=np.float32))
+    ckpt = AsyncCheckpointer()
+    path = str(tmp_path / "m.params")
+    ticket = ckpt.save(path, {"w": w, "b": b})
+    # mutate the HANDLE after save: jax arrays are immutable, so the
+    # snapshot must still hold the old values
+    w += 100.0
+    assert ticket.wait(30) == path
+    loaded = nd_utils.load(path)
+    np.testing.assert_allclose(loaded["w"].asnumpy(),
+                               np.arange(6).reshape(2, 3))
+    np.testing.assert_allclose(loaded["b"].asnumpy(), np.ones(3))
+    # second save joins the first; errors surface on wait
+    t2 = ckpt.save(path, {"w": w})
+    ckpt.wait_until_finished()
+    np.testing.assert_allclose(nd_utils.load(path)["w"].asnumpy(),
+                               np.arange(6).reshape(2, 3) + 100.0)
