@@ -56,6 +56,7 @@ from . import symbol
 from . import symbol as sym
 from .symbol import AttrScope
 from . import module
+from . import operator
 from . import module as mod
 from . import visualization as viz
 from . import image

@@ -110,7 +110,17 @@ class AsyncCheckpointer:
             self._current = None
         if cur is not None:
             thread, ticket = cur
-            ticket.wait(timeout)
+            try:
+                ticket.wait(timeout)
+            except MXNetError:
+                # writer still running (timeout): keep tracking it so the
+                # next save() joins it instead of racing a second writer
+                # onto the same .tmp path
+                if not ticket._done.is_set():
+                    with self._lock:
+                        if self._current is None:
+                            self._current = cur
+                raise
         return True
 
 

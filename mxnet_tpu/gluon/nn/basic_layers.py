@@ -323,20 +323,9 @@ class GroupNorm(HybridBlock):
         self.beta.shape_updated((c,))
 
     def hybrid_forward(self, F, x, gamma, beta):
-        ng = self._num_groups
-        eps = self._epsilon
-        def fn(d, g, b):
-            n, c = d.shape[:2]
-            rest = d.shape[2:]
-            dd = d.reshape((n, ng, c // ng) + rest)
-            axes = tuple(range(2, dd.ndim))
-            m = jnp.mean(dd, axis=axes, keepdims=True)
-            v = jnp.var(dd, axis=axes, keepdims=True)
-            out = ((dd - m) / jnp.sqrt(v + eps)).reshape(d.shape)
-            shape = (1, c) + (1,) * len(rest)
-            return out * g.reshape(shape) + b.reshape(shape)
-        from ...ndarray.ndarray import apply_nary
-        return apply_nary(fn, [x, gamma, beta], name="GroupNorm")
+        # single source of the math: the GroupNorm op (ops.py)
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
 
 
 class Embedding(HybridBlock):

@@ -26,6 +26,7 @@ class Executor:
         # manual model parallel (reference group2ctx in Symbol.bind):
         # {ctx_group attr -> Context}; ops in a group run on its device
         self._ctx_map = {}
+        self._group_placements = None   # name -> device, built lazily
         if group2ctxs:
             g2c = group2ctxs[0] if isinstance(group2ctxs, (list, tuple)) \
                 else group2ctxs
@@ -191,21 +192,30 @@ class Executor:
         if not self._ctx_map:
             return
         import jax
-        from ..symbol.symbol import Symbol, _collect_nodes
-        heads = self._symbol._outputs or [self._symbol]
-        nodes = [n for h in heads for n in _collect_nodes(h)]
-        for node in nodes:
-            group = node._attrs.get("ctx_group") if node._attrs else None
-            dev = self._ctx_map.get(group)
-            if dev is None:
-                continue
-            for a in node._args:
-                if isinstance(a, Symbol) and a._op is None and \
-                        not _is_input_name(a._name):
-                    arr = self.arg_dict.get(a._name)
-                    if arr is not None and arr._data is not None and \
-                            arr.data.devices() != {dev}:
-                        arr._set_data(jax.device_put(arr.data, dev))
+        if self._group_placements is None:
+            # the graph and ctx_map are fixed after bind: walk ONCE and
+            # cache (param name -> device); per-forward cost is then just
+            # an identity check per grouped param
+            from ..symbol.symbol import Symbol, _collect_nodes
+            heads = self._symbol._outputs or [self._symbol]
+            nodes = [n for h in heads for n in _collect_nodes(h)]
+            placements = {}
+            for node in nodes:
+                group = node._attrs.get("ctx_group") if node._attrs \
+                    else None
+                dev = self._ctx_map.get(group)
+                if dev is None:
+                    continue
+                for a in node._args:
+                    if isinstance(a, Symbol) and a._op is None and \
+                            not _is_input_name(a._name):
+                        placements[a._name] = dev
+            self._group_placements = placements
+        for name, dev in self._group_placements.items():
+            arr = self.arg_dict.get(name)
+            if arr is not None and arr._data is not None and \
+                    arr.data.devices() != {dev}:
+                arr._set_data(jax.device_put(arr.data, dev))
 
     def forward(self, is_train=False, **kwargs):
         for name, value in kwargs.items():

@@ -14,6 +14,13 @@ from .utils import save, load, load_frombuffer
 from . import sparse
 from . import contrib
 
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered custom python op (reference mx.nd.Custom ->
+    src/operator/custom/custom.cc; see mxnet_tpu.operator)."""
+    from ..operator import Custom as _custom
+    return _custom(*inputs, op_type=op_type, **kwargs)
+
 zeros_like_fn = None  # avoid accidental shadowing confusion
 
 

@@ -1552,3 +1552,154 @@ def arange_like(data, start=0.0, step=1.0, repeat=1, ctx=None, axis=None):
         vals = (start + step * (jnp.arange(n) // repeat)).astype(dt)
         return vals if axis is not None else vals.reshape(d.shape)
     return apply_nary(fn, [data], name="arange_like")
+
+
+# ======================================================================
+# remaining classic nn ops (reference: src/operator/{pad,lrn,correlation,
+# upsampling,crop}.cc, nn/group_norm, tensor/broadcast_reduce_op)
+# ======================================================================
+
+@_register
+def Pad(data, mode="constant", pad_width=(), constant_value=0.0):
+    """N-d padding (reference src/operator/pad.cc): pad_width is a flat
+    (before, after) pair per axis; mode constant|edge|reflect."""
+    pw = tuple(int(p) for p in pad_width)
+    if len(pw) != 2 * len(data.shape):
+        raise MXNetError(f"pad_width needs 2 entries per axis, got "
+                         f"{len(pw)} for ndim {len(data.shape)}")
+    pairs = tuple((pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2))
+    jmode = {"constant": "constant", "edge": "edge",
+             "reflect": "reflect"}.get(mode)
+    if jmode is None:
+        raise MXNetError(f"unknown pad mode {mode!r}")
+    def fn(d):
+        if jmode == "constant":
+            return jnp.pad(d, pairs, mode="constant",
+                           constant_values=constant_value)
+        return jnp.pad(d, pairs, mode=jmode)
+    return apply_nary(fn, [data], name="Pad")
+
+
+pad = Pad
+__all__.append("pad")
+
+
+@_register
+def argmax_channel(data):
+    """argmax over the channel axis (axis 1), float output like the
+    reference (broadcast_reduce_op_index.cc argmax_channel)."""
+    return apply_nary(lambda d: jnp.argmax(d, axis=1).astype(jnp.float32),
+                      [data], name="argmax_channel")
+
+
+@_register
+def GroupNorm(data, gamma, beta, num_groups=1, eps=1e-5):
+    """Group normalization over (C//G)-channel groups of NCHW input
+    (reference src/operator/nn/group_norm.cc)."""
+    def fn(d, g, b):
+        n, c = d.shape[0], d.shape[1]
+        rest = d.shape[2:]
+        x = d.reshape(n, num_groups, c // num_groups, *rest)
+        axes = tuple(range(2, x.ndim))
+        mean = jnp.mean(x, axis=axes, keepdims=True)
+        var = jnp.var(x, axis=axes, keepdims=True)
+        x = (x - mean) / jnp.sqrt(var + eps)
+        x = x.reshape(d.shape)
+        shape = (1, c) + (1,) * len(rest)
+        return x * g.reshape(shape) + b.reshape(shape)
+    return apply_nary(fn, [data, _nd(gamma, data), _nd(beta, data)],
+                      name="GroupNorm")
+
+
+@_register
+def LRN(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+    """Local response normalization across channels (reference
+    src/operator/lrn.cc — the AlexNet-era op)."""
+    def fn(d):
+        sq = jnp.square(d)
+        half = nsize // 2
+        padded = jnp.pad(sq, ((0, 0), (half, half)) +
+                         ((0, 0),) * (d.ndim - 2))
+        acc = jnp.zeros_like(d)
+        for i in range(nsize):
+            acc = acc + lax.slice_in_dim(padded, i, i + d.shape[1], axis=1)
+        return d / jnp.power(knorm + alpha * acc / nsize, beta)
+    return apply_nary(fn, [data], name="LRN")
+
+
+@_register
+def UpSampling(*data, scale=2, sample_type="nearest", num_filter=0,
+               num_args=1):
+    """Spatial upsampling of NCHW inputs (reference
+    src/operator/upsampling.cc): nearest or bilinear; multiple inputs
+    are each upsampled to the FIRST input's target size and concatenated
+    along channels (the FCN skip-connection pattern)."""
+    def one(d, th, tw):
+        n, c, h, w = d.shape
+        if sample_type == "nearest" and th == h * scale and tw == w * scale:
+            return jnp.repeat(jnp.repeat(d, scale, axis=2), scale, axis=3)
+        import jax.image
+        method = "nearest" if sample_type == "nearest" else "bilinear"
+        return jax.image.resize(d, (n, c, th, tw), method=method)
+
+    def fn(*ds):
+        th = ds[0].shape[2] * scale
+        tw = ds[0].shape[3] * scale
+        outs = [one(d, th, tw) for d in ds]
+        return outs[0] if len(outs) == 1 else \
+            jnp.concatenate(outs, axis=1)
+    return apply_nary(fn, [_nd(d) for d in data], name="UpSampling")
+
+
+@_register
+def Crop(*data, offset=(0, 0), h_w=(0, 0), num_args=1, center_crop=False):
+    """Crop the first NCHW input to the size of the second (or to h_w)
+    (reference src/operator/crop.cc)."""
+    x = data[0]
+    if num_args == 2 and len(data) > 1:
+        th, tw = data[1].shape[2], data[1].shape[3]
+    else:
+        th, tw = h_w
+    if th <= 0 or tw <= 0:
+        raise MXNetError("Crop needs a reference input (num_args=2) or a "
+                         f"positive h_w, got {(th, tw)}")
+    h, w = x.shape[2], x.shape[3]
+    oy, ox = ((h - th) // 2, (w - tw) // 2) if center_crop else offset
+    if oy < 0 or ox < 0 or oy + th > h or ox + tw > w:
+        raise MXNetError(f"Crop window {(th, tw)} at offset {(oy, ox)} "
+                         f"exceeds input {(h, w)}")
+    def fn(d):
+        return d[:, :, oy:oy + th, ox:ox + tw]
+    return apply_nary(fn, [x], name="Crop")
+
+
+@_register
+def Correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
+                stride2=1, pad_size=4, is_multiply=True):
+    """Correlation layer (reference src/operator/correlation.cc, the
+    FlowNet op): per-displacement mean inner product of two feature maps.
+    Vectorized as one shifted-multiply per displacement — XLA fuses the
+    window sums; no per-pixel loops."""
+    if kernel_size != 1:
+        raise MXNetError("Correlation: only kernel_size=1 is supported")
+    def fn(a, b):
+        n, c, h, w = a.shape
+        bp = jnp.pad(b, ((0, 0), (0, 0), (pad_size, pad_size),
+                         (pad_size, pad_size)))
+        d = max_displacement
+        outs = []
+        for dy in range(-d, d + 1, stride2):
+            for dx in range(-d, d + 1, stride2):
+                oy, ox = dy + pad_size, dx + pad_size
+                shifted = lax.dynamic_slice(
+                    bp, (0, 0, oy, ox), (n, c, h, w))
+                if is_multiply:
+                    prod = a * shifted
+                else:
+                    prod = jnp.abs(a - shifted)
+                outs.append(jnp.mean(prod, axis=1))
+        out = jnp.stack(outs, axis=1)           # (N, D*D, H, W)
+        if stride1 > 1:
+            out = out[:, :, ::stride1, ::stride1]
+        return out
+    return apply_nary(fn, [data1, _nd(data2, data1)], name="Correlation")

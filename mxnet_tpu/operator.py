@@ -1,0 +1,162 @@
+"""``mx.operator`` — user-defined Python operators (CustomOp).
+
+Reference: python/mxnet/operator.py (`CustomOp`, `CustomOpProp`,
+`@mx.operator.register`, invoked via ``mx.nd.Custom(..., op_type=...)``)
+over src/operator/custom/custom.cc. Semantics preserved: the op body is a
+host Python callback with explicit ``forward``/``backward`` and
+``assign(dst, req, src)`` write/add discipline; shape/type inference comes
+from the Prop.
+
+TPU mapping: custom ops run EAGERLY and record one tape node whose
+pullback calls the user's ``backward`` — exactly the reference behavior
+(custom ops are engine-thread Python callbacks there, and they break
+fusion there too). A custom op inside a hybridized block therefore forces
+that block onto the imperative path, mirroring the reference's
+CachedOp-with-Custom dispatch. For compiled-speed custom kernels the
+TPU-native route is a Pallas kernel behind ``apply_nary`` (see
+ops/flash_attention.py as the exemplar).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .base import MXNetError
+from . import _tape
+from .ndarray.ndarray import NDArray, zeros as nd_zeros
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_registry", "Custom"]
+
+_REGISTRY = {}
+
+
+class CustomOp:
+    """Base for user op bodies (reference mx.operator.CustomOp)."""
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+    @staticmethod
+    def assign(dst, req, src):
+        """Write ``src`` into ``dst`` honoring the grad_req discipline."""
+        if req == "null":
+            return
+        src = src if isinstance(src, NDArray) else NDArray(
+            _ensure_jax(src))
+        if req == "add":
+            dst._set_data(dst.data + src.data)
+        else:                       # "write" / "inplace"
+            dst._set_data(src.data)
+
+
+class CustomOpProp:
+    """Op metadata + factory (reference mx.operator.CustomOpProp)."""
+
+    def __init__(self, need_top_grad=True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def list_auxiliary_states(self):
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]] * len(self.list_outputs()), []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        raise NotImplementedError
+
+
+def register(reg_name):
+    """``@mx.operator.register("my_op")`` on a CustomOpProp subclass."""
+    def do_register(prop_cls):
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError(
+                f"register({reg_name!r}) expects a CustomOpProp subclass")
+        _REGISTRY[reg_name] = prop_cls
+        return prop_cls
+    return do_register
+
+
+def get_registry():
+    return dict(_REGISTRY)
+
+
+def _ensure_jax(x):
+    import jax.numpy as jnp
+    return x.data if isinstance(x, NDArray) else jnp.asarray(x)
+
+
+def Custom(*inputs, op_type=None, **kwargs):
+    """Invoke a registered custom op (reference mx.nd.Custom).
+
+    Extra kwargs go to the Prop constructor (string-typed in the
+    reference; here passed through as-is).
+    """
+    if op_type is None:
+        raise MXNetError("Custom(...) requires op_type=")
+    prop_cls = _REGISTRY.get(op_type)
+    if prop_cls is None:
+        raise MXNetError(f"custom op {op_type!r} is not registered "
+                         f"(known: {sorted(_REGISTRY)})")
+    prop = prop_cls(**kwargs)
+    in_names = prop.list_arguments()
+    if len(inputs) != len(in_names):
+        raise MXNetError(
+            f"custom op {op_type!r} expects {len(in_names)} inputs "
+            f"{in_names}, got {len(inputs)}")
+    in_data = [x if isinstance(x, NDArray) else NDArray(_ensure_jax(x))
+               for x in inputs]
+
+    in_shapes, out_shapes, aux_shapes = prop.infer_shape(
+        [list(x.shape) for x in in_data])
+    in_types, out_types, _ = prop.infer_type(
+        [x.dtype for x in in_data])
+    ctx = in_data[0].context if in_data else None
+    op = prop.create_operator(ctx, out_shapes, out_types)
+
+    out_data = [nd_zeros(tuple(s), ctx=ctx, dtype=t)
+                for s, t in zip(out_shapes, out_types)]
+    aux = [nd_zeros(tuple(s), ctx=ctx) for s in aux_shapes]
+
+    is_train = _tape.is_training()
+    n_out = len(out_data)
+    with _tape.trace_scope():
+        # the op BODY is not recorded (reference: custom callbacks run on
+        # the engine thread outside autograd); only the single Custom
+        # node below is, with the user's backward as its pullback
+        op.forward(is_train=is_train, req=["write"] * n_out,
+                   in_data=in_data, out_data=out_data, aux=aux)
+
+    record = _tape.is_recording() and any(
+        _tape._on_tape(x) for x in in_data)
+    if record:
+        def vjp_fn(cotangents):
+            cots = cotangents if isinstance(cotangents, tuple) \
+                else (cotangents,)
+            out_grad = [NDArray(_ensure_jax(c)) for c in cots]
+            in_grad = [nd_zeros(x.shape, ctx=ctx, dtype=x.dtype)
+                       for x in in_data]
+            with _tape.trace_scope():
+                op.backward(req=["write"] * len(in_grad),
+                            out_grad=out_grad, in_data=in_data,
+                            out_data=out_data, in_grad=in_grad, aux=aux)
+            return tuple(g.data for g in in_grad)
+
+        _tape._STATE.counter += 1
+        node = _tape.Node(list(in_data), vjp_fn,
+                          [o.data for o in out_data],
+                          _tape._STATE.counter, name=f"Custom({op_type})")
+        for i, o in enumerate(out_data):
+            o._node = node
+            o._out_index = i
+    return out_data[0] if n_out == 1 else out_data

@@ -1,0 +1,100 @@
+"""mx.operator CustomOp API (reference: python/mxnet/operator.py +
+tests/python/unittest/test_operator.py test_custom_op)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import assert_almost_equal, check_numeric_gradient
+
+
+@mx.operator.register("test_sigmoid")
+class SigmoidProp(mx.operator.CustomOpProp):
+    def __init__(self):
+        super().__init__(need_top_grad=True)
+
+    def list_arguments(self):
+        return ["data"]
+
+    def list_outputs(self):
+        return ["output"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        return Sigmoid()
+
+
+class Sigmoid(mx.operator.CustomOp):
+    def forward(self, is_train, req, in_data, out_data, aux):
+        y = 1.0 / (1.0 + nd.exp(-in_data[0]))
+        self.assign(out_data[0], req[0], y)
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        y = out_data[0]
+        self.assign(in_grad[0], req[0], out_grad[0] * y * (1.0 - y))
+
+
+def test_custom_op_forward_matches():
+    x = nd.array(np.linspace(-3, 3, 12).reshape(3, 4).astype(np.float32))
+    out = nd.Custom(x, op_type="test_sigmoid")
+    assert_almost_equal(out.asnumpy(), 1 / (1 + np.exp(-x.asnumpy())),
+                        rtol=1e-5)
+
+
+def test_custom_op_backward_through_tape():
+    x = nd.array(np.linspace(-2, 2, 8).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        # custom op composes with regular tape ops on both sides
+        y = (nd.Custom(x * 2.0, op_type="test_sigmoid") * 3.0).sum()
+    y.backward()
+    s = 1 / (1 + np.exp(-2.0 * x.asnumpy()))
+    ref = 3.0 * s * (1 - s) * 2.0
+    assert_almost_equal(x.grad.asnumpy(), ref, rtol=1e-4)
+
+
+def test_custom_op_numeric_gradient():
+    x = nd.array(np.random.RandomState(0).randn(2, 3).astype(np.float32))
+    check_numeric_gradient(
+        lambda v: nd.Custom(v, op_type="test_sigmoid").sum(), [x],
+        rtol=5e-2, atol=1e-3)
+
+
+def test_custom_op_unknown_type_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.Custom(nd.ones((2,)), op_type="never_registered")
+
+
+@mx.operator.register("test_two_out")
+class TwoOutProp(mx.operator.CustomOpProp):
+    def list_outputs(self):
+        return ["plus", "minus"]
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0], in_shape[0]], []
+
+    def create_operator(self, ctx, shapes, dtypes):
+        class TwoOut(mx.operator.CustomOp):
+            def forward(self, is_train, req, in_data, out_data, aux):
+                self.assign(out_data[0], req[0], in_data[0] + 1.0)
+                self.assign(out_data[1], req[1], in_data[0] - 1.0)
+
+            def backward(self, req, out_grad, in_data, out_data,
+                         in_grad, aux):
+                self.assign(in_grad[0], req[0],
+                            out_grad[0] + out_grad[1])
+        return TwoOut()
+
+
+def test_custom_op_multi_output():
+    x = nd.array(np.arange(4, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        a, b = nd.Custom(x, op_type="test_two_out")
+        loss = (a * 2.0).sum() + b.sum()
+    loss.backward()
+    assert_almost_equal(a.asnumpy(), x.asnumpy() + 1, rtol=1e-6)
+    assert_almost_equal(b.asnumpy(), x.asnumpy() - 1, rtol=1e-6)
+    assert_almost_equal(x.grad.asnumpy(), np.full(4, 3.0), rtol=1e-6)

@@ -1,0 +1,115 @@
+"""Round-3 op-tail semantics (reference: src/operator/{pad,lrn,
+correlation,upsampling,crop}.cc, nn/group_norm.cc + the matching
+tests/python/unittest/test_operator.py cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, retry, with_seed)
+
+
+def test_pad_modes():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(1, 1, 3, 4))
+    pw = (0, 0, 0, 0, 1, 1, 2, 2)
+    out = nd.Pad(x, mode="constant", pad_width=pw, constant_value=7.0)
+    ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (1, 1), (2, 2)),
+                 constant_values=7.0)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+    for mode in ("edge", "reflect"):
+        out = nd.Pad(x, mode=mode, pad_width=pw)
+        ref = np.pad(x.asnumpy(), ((0, 0), (0, 0), (1, 1), (2, 2)),
+                     mode=mode)
+        assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        nd.Pad(x, pad_width=(1, 1))
+
+
+def test_argmax_channel():
+    x = nd.array(np.random.RandomState(0).randn(2, 5, 3).astype(np.float32))
+    out = nd.argmax_channel(x)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy().argmax(axis=1))
+
+
+@with_seed()
+@retry(3)
+def test_group_norm_matches_torch_and_grads():
+    torch = pytest.importorskip("torch")
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 6, 4, 4).astype(np.float32)
+    g = rng.rand(6).astype(np.float32) + 0.5
+    b = rng.randn(6).astype(np.float32)
+    out = nd.GroupNorm(nd.array(x), nd.array(g), nd.array(b), num_groups=3)
+    tout = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, torch.tensor(g), torch.tensor(b))
+    assert_almost_equal(out.asnumpy(), tout.numpy(), rtol=1e-4, atol=1e-5)
+    w = nd.array(rng.rand(2, 6, 4, 4).astype(np.float32))
+    check_numeric_gradient(
+        lambda v: (nd.GroupNorm(v, nd.array(g), nd.array(b),
+                                num_groups=3) * w).sum(),
+        [nd.array(x)], rtol=5e-2, atol=1e-2)
+
+
+def test_lrn_matches_torch():
+    torch = pytest.importorskip("torch")
+    x = np.abs(np.random.RandomState(2).randn(2, 8, 5, 5)).astype(np.float32)
+    out = nd.LRN(nd.array(x), alpha=1e-3, beta=0.75, knorm=2.0, nsize=5)
+    tout = torch.nn.functional.local_response_norm(
+        torch.tensor(x), size=5, alpha=1e-3, beta=0.75, k=2.0)
+    assert_almost_equal(out.asnumpy(), tout.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_upsampling_nearest_and_bilinear():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.UpSampling(x, scale=2, sample_type="nearest")
+    assert out.shape == (1, 1, 8, 8)
+    np.testing.assert_array_equal(out.asnumpy()[0, 0, :2, :2],
+                                  np.zeros((2, 2)))
+    np.testing.assert_array_equal(out.asnumpy()[0, 0, 6:, 6:],
+                                  np.full((2, 2), 15.0))
+    out = nd.UpSampling(x, scale=2, sample_type="bilinear")
+    assert out.shape == (1, 1, 8, 8)
+    assert np.isfinite(out.asnumpy()).all()
+
+
+def test_crop_to_reference_and_center():
+    x = nd.array(np.arange(36, dtype=np.float32).reshape(1, 1, 6, 6))
+    like = nd.zeros((1, 1, 4, 4))
+    out = nd.Crop(x, like, num_args=2, center_crop=True)
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, :, 1:5, 1:5])
+    out = nd.Crop(x, offset=(2, 1), h_w=(3, 3))
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  x.asnumpy()[:, :, 2:5, 1:4])
+
+
+def test_correlation_identity_peak():
+    """correlating a map with itself peaks at zero displacement."""
+    rng = np.random.RandomState(3)
+    x = rng.randn(1, 4, 6, 6).astype(np.float32)
+    out = nd.Correlation(nd.array(x), nd.array(x), max_displacement=2,
+                         pad_size=2)
+    o = out.asnumpy()
+    assert o.shape == (1, 25, 6, 6)
+    center = o[0, 12]                     # (dy,dx)=(0,0) channel
+    # zero-displacement of a self-correlation is the channel-mean of
+    # squares exactly
+    assert_almost_equal(center, (x ** 2).mean(axis=1)[0], rtol=1e-5)
+    # displaced channels see zero-padded borders: the corner at max
+    # negative displacement correlates with padding only
+    np.testing.assert_allclose(o[0, 0, 0, 0], 0.0, atol=1e-6)
+
+
+def test_correlation_gradient_flows():
+    rng = np.random.RandomState(4)
+    a = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    b = nd.array(rng.randn(1, 2, 5, 5).astype(np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        loss = nd.Correlation(a, b, max_displacement=1, pad_size=1).sum()
+    loss.backward()
+    assert np.abs(a.grad.asnumpy()).sum() > 0
+    assert np.abs(b.grad.asnumpy()).sum() > 0
