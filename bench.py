@@ -203,7 +203,8 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         from mxnet_tpu import amp
         amp.init(target_dtype="bfloat16")
 
-    net = resnet50_v1()
+    s2d = os.environ.get("MXTPU_RESNET_S2D", "1") == "1"
+    net = resnet50_v1(s2d_stem=s2d)
     feeder = None
     if data_mode == "rec":
         from tools.bench_pipeline import RecBatchFeeder, wrap_preproc
@@ -261,6 +262,7 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         "batch": batch,
         "dtype": dtype,
         "data": data_mode,
+        "s2d_stem": s2d,
     }
     if feeder is not None:
         result["input_pipeline"] = feeder.stats
@@ -409,6 +411,14 @@ def _bench_bert() -> dict:
     except Exception as e:  # noqa: BLE001 — evidence must not void the
         # already-measured throughput number
         result["flash_attention"] = {"error": f"{type(e).__name__}: {e}"}
+    if platform == "tpu":
+        # long-context point: at L>=2k the O(L^2) score tensor is what the
+        # kernel exists to avoid (SURVEY §5.7); report speedup there too
+        try:
+            result["flash_attention_long"] = _flash_evidence(4, 2048)
+        except Exception as e:  # noqa: BLE001
+            result["flash_attention_long"] = {
+                "error": f"{type(e).__name__}: {e}"}
     return result
 
 

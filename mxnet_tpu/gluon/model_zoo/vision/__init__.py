@@ -14,6 +14,7 @@ from .ssd import *  # noqa: F401,F403
 from .yolo import *  # noqa: F401,F403
 from .segmentation import *  # noqa: F401,F403
 from .rcnn import *  # noqa: F401,F403
+from .resnest import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
@@ -26,7 +27,7 @@ def _register_models():
     mods = [importlib.import_module(f"{__name__}.{m}")
             for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
                       "densenet", "inception", "ssd", "yolo", "segmentation",
-                      "rcnn")]
+                      "rcnn", "resnest")]
     for mod in mods:
         for name in mod.__all__:
             fn = getattr(mod, name)

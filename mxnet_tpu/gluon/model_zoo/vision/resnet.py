@@ -10,7 +10,8 @@ from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
+__all__ = ["ResNetV1", "ResNetV2", "SpaceToDepthStem",
+           "BasicBlockV1", "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
            "resnet50_v1", "resnet101_v1", "resnet152_v1", "resnet18_v2",
            "resnet34_v2", "resnet50_v2", "resnet101_v2", "resnet152_v2",
@@ -20,6 +21,55 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
 def _conv3x3(channels, stride, in_channels):
     return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
                      use_bias=False, in_channels=in_channels)
+
+
+class SpaceToDepthStem(HybridBlock):
+    """Numerically exact space-to-depth rewrite of the 7x7/stride-2 ImageNet
+    stem (the MLPerf ResNet trick).
+
+    The stride-2 7x7 conv over (B,3,224,224) becomes a stride-1 4x4 conv over
+    the space-to-depth(2) input (B,12,112,112): identical FLOPs and output,
+    but 4x more input channels feeding the MXU's contracted dimension and 4x
+    fewer spatial positions — the stem stops being the worst-tiled conv in the
+    net. The parameter keeps the reference shape (C,3,7,7)
+    (python/mxnet/gluon/model_zoo/vision/resnet.py stem conv), and the 4x4/12ch
+    kernel is re-tiled from it in-graph each step (a few kB; XLA hoists it).
+
+    Derivation: out(i,j) = sum_{ky,kx,c} x[c, 2i+ky-3, 2j+kx-3] w[o,c,ky,kx].
+    Writing ky = 2m+dy-1 (m in 0..3, dy in 0..1) turns the sum into a 4-tap
+    stride-1 conv over the s2d grid with symmetric pad 2, valid outputs 0..111.
+    """
+
+    def __init__(self, channels, **kwargs):
+        super().__init__(**kwargs)
+        self._channels = channels
+        with self.name_scope():
+            self.weight = self.params.get("weight", shape=(channels, 3, 7, 7),
+                                          allow_deferred_init=True)
+
+    def hybrid_forward(self, F, x, weight):
+        o = self._channels
+        try:
+            oh, ow = int(x.shape[2]) % 2, int(x.shape[3]) % 2
+        except (TypeError, IndexError):   # shapeless symbolic trace
+            oh = ow = 0
+        if oh or ow:
+            # odd spatial size: the 7x7/p3 conv reads zeros past the edge
+            # anyway, so one explicit zero row/col keeps exact equivalence
+            x = F.Pad(x, mode="constant",
+                      pad_width=(0, 0, 0, 0, 0, oh, 0, ow))
+        xs = F.space_to_depth(x, 2)
+        # (O,3,7,7) -> pad front of each spatial dim -> (O,3,8,8); index
+        # kyp = ky+1 = 2m+dy splits as (m, dy)
+        w = F.Pad(weight, mode="constant",
+                  pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
+        w = F.reshape(w, (o, 3, 4, 2, 4, 2))          # (O, c, m, dy, n, dx)
+        w = F.transpose(w, axes=(0, 3, 5, 1, 2, 4))    # (O, dy, dx, c, m, n)
+        w = F.reshape(w, (o, 12, 4, 4))                # ch = dy*6 + dx*3 + c
+        y = F.Convolution(xs, w, None, kernel=(4, 4), stride=(1, 1),
+                          pad=(2, 2), num_filter=o, no_bias=True)
+        return F.slice(y, begin=(None, None, 0, 0),
+                       end=(None, None, -1, -1))
 
 
 class BasicBlockV1(HybridBlock):
@@ -142,7 +192,7 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 s2d_stem=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -150,8 +200,13 @@ class ResNetV1(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                # prefix keeps the param named conv0_weight so checkpoints
+                # interop between s2d_stem=True and the stock stem
+                self.features.add(SpaceToDepthStem(channels[0],
+                                                   prefix="conv0_")
+                                  if s2d_stem
+                                  else nn.Conv2D(channels[0], 7, 2, 3,
+                                                 use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))
@@ -182,7 +237,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
+                 s2d_stem=False, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -191,8 +246,11 @@ class ResNetV2(HybridBlock):
             if thumbnail:
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
+                self.features.add(SpaceToDepthStem(channels[0],
+                                                   prefix="conv0_")
+                                  if s2d_stem
+                                  else nn.Conv2D(channels[0], 7, 2, 3,
+                                                 use_bias=False))
                 self.features.add(nn.BatchNorm())
                 self.features.add(nn.Activation("relu"))
                 self.features.add(nn.MaxPool2D(3, 2, 1))

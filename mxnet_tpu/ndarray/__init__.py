@@ -10,6 +10,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import concat, stack
 from .linalg import *  # noqa: F401,F403
 from . import random
+from .random import shuffle  # reference aliases mx.nd.shuffle -> _shuffle op
 from .utils import save, load, load_frombuffer
 from . import sparse
 from . import contrib

@@ -88,6 +88,14 @@ arccosh = _unary_factory("arccosh", jnp.arccosh)
 arctanh = _unary_factory("arctanh", jnp.arctanh)
 erf = _unary_factory("erf", jax.scipy.special.erf)
 erfinv = _unary_factory("erfinv", jax.scipy.special.erfinv)
+digamma = _unary_factory("digamma", jax.scipy.special.digamma)
+
+
+@_register
+def hard_sigmoid(data, alpha=0.2, beta=0.5):
+    """y = clip(alpha*x + beta, 0, 1). Reference: src/operator/tensor/elemwise_unary_op_basic.cc (hard_sigmoid)."""
+    return apply_nary(lambda d: jnp.clip(alpha * d + beta, 0.0, 1.0),
+                      [data], name="hard_sigmoid")
 gamma = _unary_factory("gamma", lambda d: jnp.exp(jax.scipy.special.gammaln(d)))
 gammaln = _unary_factory("gammaln", jax.scipy.special.gammaln)
 logical_not = _unary_factory("logical_not",
@@ -948,7 +956,11 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
                 jnp.iinfo(d.dtype).min
             return lax.reduce_window(d, init, lax.max, window, strides,
                                      padding)
-        zero = jnp.zeros((), d.dtype)
+        # init must be a CONCRETE zero: lax.reduce_window only dispatches to
+        # the differentiable reduce_window_sum monoid when it can see the
+        # identity; a traced jnp zero falls back to a generic reduce_window
+        # whose linearization fails under vjp-of-jit (hybridize + record)
+        zero = _np.zeros((), d.dtype)
         ssum = lax.reduce_window(d, zero, lax.add, window, strides, padding)
         if pool_type == "sum":
             return ssum

@@ -392,3 +392,42 @@ def test_dataloader_workers_and_early_stop():
         it = iter(loader)
         next(it)
         del it
+
+
+def test_space_to_depth_stem_matches_7x7_conv():
+    """MLPerf-style stem rewrite must be numerically exact (same weight)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+    rng = np.random.RandomState(7)
+    x = nd.array(rng.randn(2, 3, 32, 32).astype(np.float32))
+    w = rng.randn(16, 3, 7, 7).astype(np.float32)
+    ref = nn.Conv2D(16, 7, 2, 3, use_bias=False, in_channels=3)
+    ref.initialize()
+    ref.weight.set_data(nd.array(w))
+    stem = SpaceToDepthStem(16)
+    stem.initialize()
+    stem.weight.set_data(nd.array(w))
+    assert_almost_equal(stem(x).asnumpy(), ref(x).asnumpy(),
+                        rtol=1e-4, atol=1e-4)
+    stem.hybridize()
+    assert_almost_equal(stem(x).asnumpy(), ref(x).asnumpy(),
+                        rtol=1e-4, atol=1e-4)
+    # odd spatial sizes pad-to-even and stay exact (7x7/p3 reads zeros
+    # past the edge either way)
+    x_odd = nd.array(rng.randn(2, 3, 33, 33).astype(np.float32))
+    assert_almost_equal(stem(x_odd).asnumpy(), ref(x_odd).asnumpy(),
+                        rtol=1e-4, atol=1e-4)
+    # full model: stock checkpoint loads into the s2d variant (param is
+    # conv0_weight in both) and outputs match
+    import os
+    import tempfile
+    from mxnet_tpu.gluon.model_zoo import vision
+    std = vision.resnet18_v1(classes=10)
+    std.initialize()
+    xm = nd.array(rng.randn(1, 3, 64, 64).astype(np.float32))
+    y_std = std(xm)
+    path = os.path.join(tempfile.mkdtemp(), "r18.params")
+    std.save_parameters(path)
+    net = vision.resnet18_v1(classes=10, s2d_stem=True)
+    net.load_parameters(path)
+    assert_almost_equal(net(xm).asnumpy(), y_std.asnumpy(),
+                        rtol=1e-4, atol=1e-4)

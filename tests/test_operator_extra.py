@@ -113,3 +113,46 @@ def test_correlation_gradient_flows():
     loss.backward()
     assert np.abs(a.grad.asnumpy()).sum() > 0
     assert np.abs(b.grad.asnumpy()).sum() > 0
+
+
+def test_hard_sigmoid():
+    x = nd.array(np.array([-5.0, -1.0, 0.0, 1.0, 5.0], dtype=np.float32))
+    out = nd.hard_sigmoid(x)
+    ref = np.clip(0.2 * x.asnumpy() + 0.5, 0.0, 1.0)
+    assert_almost_equal(out.asnumpy(), ref, rtol=1e-6)
+    out2 = nd.hard_sigmoid(x, alpha=0.5, beta=0.25)
+    assert_almost_equal(out2.asnumpy(),
+                        np.clip(0.5 * x.asnumpy() + 0.25, 0.0, 1.0),
+                        rtol=1e-6)
+    # gradient: alpha inside the linear band, 0 where clipped
+    x.attach_grad()
+    with autograd.record():
+        y = nd.hard_sigmoid(x)
+    y.backward()
+    assert_almost_equal(x.grad.asnumpy(),
+                        np.array([0.0, 0.2, 0.2, 0.2, 0.0], np.float32),
+                        rtol=1e-6)
+
+
+@with_seed()
+def test_digamma():
+    x = nd.array(np.array([0.5, 1.0, 2.0, 5.0], dtype=np.float32))
+    out = nd.digamma(x)
+    # psi(1) = -euler_gamma; psi(2) = 1 - euler_gamma
+    eg = 0.5772156649
+    assert_almost_equal(out.asnumpy()[1], -eg, rtol=1e-5)
+    assert_almost_equal(out.asnumpy()[2], 1.0 - eg, rtol=1e-5)
+    check_numeric_gradient(lambda a: nd.digamma(a).sum(), [x], rtol=1e-2,
+                           atol=1e-3)
+
+
+@with_seed()
+def test_shuffle_first_axis():
+    x = nd.array(np.arange(24, dtype=np.float32).reshape(8, 3))
+    out = nd.shuffle(x)
+    # rows are permuted intact: same multiset of rows, same row contents
+    got = out.asnumpy()
+    assert sorted(got[:, 0].tolist()) == x.asnumpy()[:, 0].tolist()
+    for row in got:
+        base = row[0]
+        np.testing.assert_allclose(row, [base, base + 1, base + 2])
