@@ -6,3 +6,4 @@ contrib *operators* are under ``mx.nd.contrib``.
 from .. import amp  # noqa: F401  (reference path: mx.contrib.amp)
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401  (reference path: mx.contrib.text)

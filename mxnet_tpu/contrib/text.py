@@ -1,0 +1,254 @@
+"""``mx.contrib.text`` — vocabulary and token-embedding utilities.
+
+Reference: python/mxnet/contrib/text/{utils,vocab,embedding}.py (the
+word-embedding capability of SURVEY §2.4; GluonNLP's TokenEmbedding grew out
+of this module). Embedding matrices live as one device-resident (V, D) array
+— lookups are jnp takes (MXU-friendly gather), similarity queries one matmul.
+
+Pretrained downloads (GloVe/fastText) need network access; in this offline
+build ``create``/``get_pretrained_file_names`` raise with instructions to use
+``CustomEmbedding`` on a local vector file instead.
+"""
+from __future__ import annotations
+
+import collections
+import io
+
+import numpy as _np
+
+from ..base import MXNetError
+from .. import ndarray as nd
+
+__all__ = ["count_tokens_from_str", "Vocabulary", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding", "create",
+           "get_pretrained_file_names"]
+
+
+def count_tokens_from_str(source_str, token_delim=" ", seq_delim="\n",
+                          to_lower=False, counter_to_update=None):
+    """Count tokens in a delimited string (reference contrib/text/utils.py)."""
+    source_str = source_str.replace(seq_delim, token_delim)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(t for t in source_str.split(token_delim) if t)
+    return counter
+
+
+class Vocabulary:
+    """Indexed vocabulary with an unknown token and optional reserved tokens
+    (reference contrib/text/vocab.py Vocabulary)."""
+
+    def __init__(self, counter=None, most_freq_count=None, min_freq=1,
+                 unknown_token="<unk>", reserved_tokens=None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                    unknown_token in reserved_tokens:
+                raise MXNetError("reserved_tokens must be unique and must "
+                                 "not contain the unknown token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens \
+            else None
+        self._idx_to_token = [unknown_token] + (self._reserved_tokens or [])
+        self._token_to_idx = {t: i for i, t in enumerate(self._idx_to_token)}
+        if counter is not None:
+            pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+            if most_freq_count is not None:
+                pairs = pairs[:most_freq_count]
+            for token, freq in pairs:
+                if freq < min_freq:
+                    continue
+                if token not in self._token_to_idx:
+                    self._token_to_idx[token] = len(self._idx_to_token)
+                    self._idx_to_token.append(token)
+
+    def __len__(self):
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self):
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens):
+        """Tokens -> indices; unknown tokens map to index 0."""
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range "
+                                 f"[0, {len(self._idx_to_token)})")
+        toks = [self._idx_to_token[i] for i in idxs]
+        return toks[0] if single else toks
+
+
+class TokenEmbedding(Vocabulary):
+    """Vocabulary + a (V, D) vector table (reference contrib/text/embedding.py
+    _TokenEmbedding). Lookup returns device arrays; unknown tokens get the
+    init_unknown_vec row."""
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self._vec_len = 0
+        self._idx_to_vec = None   # NDArray (V, D)
+
+    @property
+    def vec_len(self):
+        return self._vec_len
+
+    @property
+    def idx_to_vec(self):
+        return self._idx_to_vec
+
+    def _load_embedding(self, file_like, elem_delim,
+                        init_unknown_vec=nd.zeros):
+        """Parse 'token v1 v2 ...' lines; tokens seen first win (reference
+        loads in file order and warns on duplicates)."""
+        vectors = {}
+        vec_len = None
+        for lineno, line in enumerate(file_like):
+            parts = [p for p in line.rstrip().split(elem_delim) if p]
+            if len(parts) < 2:
+                continue
+            token, elems = parts[0], parts[1:]
+            if lineno == 0 and len(parts) == 2 and \
+                    all(p.lstrip("-").isdigit() for p in parts):
+                continue   # fastText-style "count dim" header line
+            if vec_len is None:
+                vec_len = len(elems)
+            elif len(elems) != vec_len:
+                raise MXNetError(
+                    f"inconsistent vector length for token {token!r}: "
+                    f"{len(elems)} vs {vec_len}")
+            if token and token not in vectors:
+                vectors[token] = _np.asarray([float(e) for e in elems],
+                                             dtype=_np.float32)
+        if vec_len is None:
+            raise MXNetError("no vectors found in the embedding file")
+        self._vec_len = vec_len
+        for token in vectors:
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+        table = _np.zeros((len(self), vec_len), dtype=_np.float32)
+        table[0] = init_unknown_vec(shape=(vec_len,)).asnumpy()
+        for token, vec in vectors.items():
+            table[self._token_to_idx[token]] = vec
+        self._idx_to_vec = nd.array(table)
+
+    def get_vecs_by_tokens(self, tokens, lower_case_backup=False):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        if lower_case_backup:
+            toks = [t if t in self._token_to_idx else t.lower()
+                    for t in toks]
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        vecs = self._idx_to_vec[nd.array(idx, dtype="int32")]
+        return vecs[0] if single else vecs
+
+    def update_token_vectors(self, tokens, new_vectors):
+        toks = [tokens] if isinstance(tokens, str) else tokens
+        for t in toks:
+            if t not in self._token_to_idx or self._token_to_idx[t] == 0:
+                raise MXNetError(f"token {t!r} is unknown; only tokens in "
+                                 "the embedding can be updated")
+        rows = nd.array([self._token_to_idx[t] for t in toks], dtype="int32")
+        vals = new_vectors.reshape((len(toks), self._vec_len))
+        # on-device scatter: no (V, D) host round-trip for a few-row update
+        self._idx_to_vec[rows] = vals
+
+    def most_similar(self, token, k=5):
+        """k nearest tokens by cosine similarity — one (V,D)x(D,) matmul on
+        device (the evaluation helper GluonNLP ships separately)."""
+        import jax.numpy as jnp
+        vec = self.get_vecs_by_tokens(token).data
+        table = self._idx_to_vec.data
+        norms = jnp.linalg.norm(table, axis=1) * jnp.linalg.norm(vec) + 1e-10
+        sims = table @ vec / norms
+        order = jnp.argsort(-sims)
+        out = []
+        for i in _np.asarray(order):
+            t = self._idx_to_token[int(i)]
+            if t != token and int(i) != 0:
+                out.append((t, float(sims[int(i)])))
+            if len(out) == k:
+                break
+        return out
+
+
+class CustomEmbedding(TokenEmbedding):
+    """Embedding from a local 'token v1 v2 ...' text file (reference
+    contrib/text/embedding.py CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path, elem_delim=" ", encoding="utf8",
+                 init_unknown_vec=nd.zeros, vocabulary=None, **kwargs):
+        if vocabulary is not None:
+            kwargs.setdefault("unknown_token", vocabulary.unknown_token)
+        super().__init__(**kwargs)
+        with io.open(pretrained_file_path, "r", encoding=encoding) as f:
+            self._load_embedding(f, elem_delim, init_unknown_vec)
+        if vocabulary is not None:
+            self._restrict_to(vocabulary)
+
+    def _restrict_to(self, vocabulary):
+        table = self._idx_to_vec.asnumpy()
+        rows = _np.zeros((len(vocabulary), self._vec_len), _np.float32)
+        for i, tok in enumerate(vocabulary.idx_to_token):
+            j = self._token_to_idx.get(tok, 0)
+            rows[i] = table[j]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._unknown_token = vocabulary.unknown_token
+        self._idx_to_vec = nd.array(rows)
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings over one vocabulary (reference
+    contrib/text/embedding.py CompositeEmbedding)."""
+
+    def __init__(self, vocabulary, token_embeddings):
+        super().__init__()
+        embs = token_embeddings if isinstance(token_embeddings, list) \
+            else [token_embeddings]
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        self._reserved_tokens = vocabulary.reserved_tokens
+        self._unknown_token = vocabulary.unknown_token
+        parts = [e.get_vecs_by_tokens(self._idx_to_token) for e in embs]
+        self._idx_to_vec = nd.concat(*parts, dim=1)
+        self._vec_len = self._idx_to_vec.shape[1]
+
+
+def get_pretrained_file_names(embedding_name=None):
+    raise MXNetError(
+        "pretrained embedding downloads (glove/fasttext) need network "
+        "access; this build is offline — load a local vector file with "
+        "contrib.text.CustomEmbedding instead")
+
+
+def create(embedding_name, **kwargs):
+    raise MXNetError(
+        "pretrained embedding downloads (glove/fasttext) need network "
+        "access; this build is offline — load a local vector file with "
+        "contrib.text.CustomEmbedding instead")

@@ -15,6 +15,7 @@ from .yolo import *  # noqa: F401,F403
 from .segmentation import *  # noqa: F401,F403
 from .rcnn import *  # noqa: F401,F403
 from .resnest import *  # noqa: F401,F403
+from .pose import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
@@ -27,12 +28,13 @@ def _register_models():
     mods = [importlib.import_module(f"{__name__}.{m}")
             for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
                       "densenet", "inception", "ssd", "yolo", "segmentation",
-                      "rcnn", "resnest")]
+                      "rcnn", "resnest", "pose")]
+    non_models = {"heatmap_to_coord"}   # exported utilities, not factories
     for mod in mods:
         for name in mod.__all__:
             fn = getattr(mod, name)
             if callable(fn) and name[0].islower() and \
-                    not name.startswith("get_"):
+                    not name.startswith("get_") and name not in non_models:
                 _MODELS[name] = fn
 
 

@@ -1,0 +1,77 @@
+"""Pose estimation zoo: SimplePose (GluonCV parity:
+gluoncv/model_zoo/simple_pose/simple_pose_resnet.py).
+
+"Simple Baselines for Human Pose Estimation" (Xiao et al., 2018): a ResNet
+trunk followed by three 4x4/stride-2 deconvolution stages and a 1x1 head
+producing per-joint heatmaps. Deconvs lower to lax.conv_transpose (one MXU
+matmul per stage after XLA tiling); heatmap argmax decoding is a pure
+jnp reduction, no host round-trip.
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...block import HybridBlock
+from ... import nn
+from . import segmentation as _v1b
+
+__all__ = ["SimplePoseResNet", "simple_pose_resnet18_v1b",
+           "simple_pose_resnet50_v1b", "heatmap_to_coord"]
+
+_TRUNKS = {"resnet18_v1b": _v1b.resnet18_v1b,
+           "resnet34_v1b": _v1b.resnet34_v1b,
+           "resnet50_v1b": _v1b.resnet50_v1b,
+           "resnet101_v1b": _v1b.resnet101_v1b}
+
+
+class SimplePoseResNet(HybridBlock):
+    def __init__(self, base_name="resnet50_v1b", num_joints=17,
+                 num_deconv_layers=3, num_deconv_filters=256,
+                 pretrained_base=False, **kwargs):
+        super().__init__(**kwargs)
+        if base_name not in _TRUNKS:
+            raise MXNetError(f"unknown pose trunk {base_name!r}; "
+                             f"options: {sorted(_TRUNKS)}")
+        # true v1b trunk (stride on the 3x3 conv, BasicBlockV1b for 18/34)
+        # at output stride 32 — gluoncv simple_pose_resnet.py
+        trunk = _TRUNKS[base_name](classes=1, dilated=False)
+        with self.name_scope():
+            # everything before global pool: stem + 4 stages
+            self.features = nn.HybridSequential(prefix="features_")
+            for name in ("conv1", "bn1", "relu", "maxpool",
+                         "layer1", "layer2", "layer3", "layer4"):
+                self.features.add(getattr(trunk, name))
+            self.deconv_layers = nn.HybridSequential(prefix="deconv_")
+            for _ in range(num_deconv_layers):
+                self.deconv_layers.add(nn.Conv2DTranspose(
+                    num_deconv_filters, kernel_size=4, strides=2, padding=1,
+                    use_bias=False))
+                self.deconv_layers.add(nn.BatchNorm())
+                self.deconv_layers.add(nn.Activation("relu"))
+            self.final_layer = nn.Conv2D(num_joints, kernel_size=1)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        x = self.deconv_layers(x)
+        return self.final_layer(x)
+
+
+def heatmap_to_coord(heatmaps):
+    """Decode (B, K, H, W) heatmaps to ((B, K, 2) coords, (B, K) scores) —
+    gluoncv.utils.metrics (get_max_pred) semantics, computed on device."""
+    import jax.numpy as jnp
+    from ....ndarray import NDArray, from_jax
+    hm = heatmaps.data if isinstance(heatmaps, NDArray) else heatmaps
+    b, k, h, w = hm.shape
+    flat = hm.reshape(b, k, h * w)
+    idx = jnp.argmax(flat, axis=-1)
+    scores = jnp.max(flat, axis=-1)
+    coords = jnp.stack([idx % w, idx // w], axis=-1).astype(jnp.float32)
+    return from_jax(coords), from_jax(scores)
+
+
+def simple_pose_resnet18_v1b(**kwargs):
+    return SimplePoseResNet("resnet18_v1b", **kwargs)
+
+
+def simple_pose_resnet50_v1b(**kwargs):
+    return SimplePoseResNet("resnet50_v1b", **kwargs)

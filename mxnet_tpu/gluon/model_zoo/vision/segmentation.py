@@ -13,9 +13,39 @@ from ....base import MXNetError
 from ...block import HybridBlock
 from ... import nn
 
-__all__ = ["ResNetV1b", "resnet50_v1b", "resnet101_v1b",
+__all__ = ["ResNetV1b", "resnet18_v1b", "resnet34_v1b", "resnet50_v1b",
+           "resnet101_v1b",
            "FCN", "PSPNet", "DeepLabV3",
            "get_fcn", "get_psp", "get_deeplab"]
+
+
+class BasicBlockV1b(HybridBlock):
+    """Two-3x3 residual block, stride on the first conv (gluoncv
+    resnetv1b.py BasicBlockV1b)."""
+
+    expansion = 1
+
+    def __init__(self, planes, strides=1, dilation=1, downsample=None,
+                 previous_dilation=1, norm_layer=nn.BatchNorm, **kwargs):
+        super().__init__(**kwargs)
+        self.conv1 = nn.Conv2D(planes, kernel_size=3, strides=strides,
+                               padding=dilation, dilation=dilation,
+                               use_bias=False)
+        self.bn1 = norm_layer()
+        self.conv2 = nn.Conv2D(planes, kernel_size=3, strides=1,
+                               padding=previous_dilation,
+                               dilation=previous_dilation, use_bias=False)
+        self.bn2 = norm_layer()
+        self.relu = nn.Activation("relu")
+        self.downsample = downsample
+
+    def hybrid_forward(self, F, x):
+        residual = x
+        out = self.relu(self.bn1(self.conv1(x)))
+        out = self.bn2(self.conv2(out))
+        if self.downsample is not None:
+            residual = self.downsample(x)
+        return self.relu(out + residual)
 
 
 class BottleneckV1b(HybridBlock):
@@ -49,8 +79,10 @@ class ResNetV1b(HybridBlock):
     """Dilated ResNet backbone (gluoncv resnetv1b.py), output stride 8."""
 
     def __init__(self, layers, classes=1000, dilated=True,
-                 norm_layer=nn.BatchNorm, deep_stem=False, **kwargs):
+                 norm_layer=nn.BatchNorm, deep_stem=False,
+                 block=BottleneckV1b, **kwargs):
         super().__init__(**kwargs)
+        self._block = block
         self.conv1 = nn.Conv2D(64, kernel_size=7, strides=2, padding=3,
                                use_bias=False)
         self.bn1 = norm_layer()
@@ -71,17 +103,23 @@ class ResNetV1b(HybridBlock):
         self.fc = nn.Dense(classes)
 
     def _make_layer(self, planes, blocks, strides, dilation, norm_layer):
+        block = self._block
         layer = nn.HybridSequential()
-        downsample = nn.HybridSequential()
-        downsample.add(nn.Conv2D(planes * 4, kernel_size=1, strides=strides,
-                                 use_bias=False))
-        downsample.add(norm_layer())
+        in_c = getattr(self, "_in_c", 64)
+        if strides != 1 or in_c != planes * block.expansion:
+            downsample = nn.HybridSequential()
+            downsample.add(nn.Conv2D(planes * block.expansion, kernel_size=1,
+                                     strides=strides, use_bias=False))
+            downsample.add(norm_layer())
+        else:   # identity shortcut (gluoncv: no downsample when shapes match)
+            downsample = None
+        self._in_c = planes * block.expansion
         first_dil = 1 if dilation in (1, 2) else 2
-        layer.add(BottleneckV1b(planes, strides, first_dil, downsample,
-                                norm_layer=norm_layer))
+        layer.add(block(planes, strides, first_dil, downsample,
+                        previous_dilation=dilation, norm_layer=norm_layer))
         for _ in range(1, blocks):
-            layer.add(BottleneckV1b(planes, 1, dilation,
-                                    norm_layer=norm_layer))
+            layer.add(block(planes, 1, dilation, previous_dilation=dilation,
+                            norm_layer=norm_layer))
         return layer
 
     def hybrid_forward(self, F, x):
@@ -101,6 +139,14 @@ class ResNetV1b(HybridBlock):
         c3 = self.layer3(x)
         c4 = self.layer4(c3)
         return c3, c4
+
+
+def resnet18_v1b(**kwargs):
+    return ResNetV1b([2, 2, 2, 2], block=BasicBlockV1b, **kwargs)
+
+
+def resnet34_v1b(**kwargs):
+    return ResNetV1b([3, 4, 6, 3], block=BasicBlockV1b, **kwargs)
 
 
 def resnet50_v1b(**kwargs):

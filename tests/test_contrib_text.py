@@ -1,0 +1,74 @@
+"""mx.contrib.text parity tests (reference python/mxnet/contrib/text/ —
+vocab.py Vocabulary, embedding.py CustomEmbedding/CompositeEmbedding,
+utils.py count_tokens_from_str)."""
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib import text
+
+
+def _vec_file():
+    path = os.path.join(tempfile.mkdtemp(), "vec.txt")
+    with open(path, "w") as f:
+        f.write("hello 1.0 0.0\nworld 0.9 0.1\nfoo 0.0 1.0\n")
+    return path
+
+
+def test_count_tokens_and_vocabulary():
+    c = text.count_tokens_from_str("a b b c c c\nd a")
+    assert c["c"] == 3 and c["a"] == 2 and c["d"] == 1
+    v = text.Vocabulary(c, min_freq=2, reserved_tokens=["<pad>"])
+    # <unk>, <pad>, then by (-freq, token): c, a, b
+    assert v.idx_to_token == ["<unk>", "<pad>", "c", "a", "b"]
+    assert v.to_indices(["c", "never-seen"]) == [2, 0]
+    assert v.to_tokens([2, 0]) == ["c", "<unk>"]
+    with pytest.raises(mx.MXNetError):
+        v.to_tokens(99)
+    with pytest.raises(mx.MXNetError):
+        text.Vocabulary(c, reserved_tokens=["<unk>"])
+
+
+def test_custom_embedding_lookup_update_similarity():
+    emb = text.CustomEmbedding(_vec_file())
+    assert emb.vec_len == 2 and len(emb) == 4
+    vecs = emb.get_vecs_by_tokens(["hello", "missing"])
+    np.testing.assert_allclose(vecs.asnumpy(), [[1.0, 0.0], [0.0, 0.0]])
+    assert emb.most_similar("hello", k=1)[0][0] == "world"
+    emb.update_token_vectors("foo", mx.nd.array([[0.5, 0.5]]))
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("foo").asnumpy(),
+                               [0.5, 0.5])
+    with pytest.raises(mx.MXNetError):
+        emb.update_token_vectors("missing", mx.nd.array([[1.0, 1.0]]))
+
+
+def test_embedding_with_vocabulary_and_composite():
+    c = text.count_tokens_from_str("hello world hello unseen")
+    v = text.Vocabulary(c)
+    emb = text.CustomEmbedding(_vec_file(), vocabulary=v)
+    assert len(emb) == len(v)
+    # vocab token not in the file gets the unknown vector
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("unseen").asnumpy(), [0.0, 0.0])
+    comp = text.CompositeEmbedding(v, [emb, emb])
+    assert comp.idx_to_vec.shape == (len(v), 4)
+
+
+def test_pretrained_downloads_gated():
+    with pytest.raises(mx.MXNetError):
+        text.create("glove")
+    with pytest.raises(mx.MXNetError):
+        text.get_pretrained_file_names()
+
+
+def test_fasttext_style_header_and_whitespace():
+    path = os.path.join(tempfile.mkdtemp(), "ft.vec")
+    with open(path, "w") as f:
+        f.write("2 3\nhello 1 0 0 \nworld 0 1 0\n")   # header + trailing ws
+    emb = text.CustomEmbedding(path)
+    assert emb.vec_len == 3 and len(emb) == 3
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1.0, 0.0, 0.0])

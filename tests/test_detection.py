@@ -233,3 +233,23 @@ def test_faster_rcnn_forward():
     r = rois.asnumpy()
     assert (r >= 0).all() and (r[..., 0::2] <= 128).all() \
         and (r[..., 1::2] <= 128).all()
+
+
+def test_simple_pose():
+    """SimplePose (gluoncv simple_pose_resnet.py): trunk -> 3 deconvs ->
+    per-joint heatmaps at input/4; on-device argmax decode."""
+    from mxnet_tpu.gluon.model_zoo.vision.pose import (heatmap_to_coord,
+                                                       simple_pose_resnet18_v1b)
+    net = simple_pose_resnet18_v1b(num_joints=17)
+    net.initialize()
+    net.hybridize()
+    x = nd.array(np.random.RandomState(0).randn(1, 3, 64, 64)
+                 .astype(np.float32))
+    hm = net(x)
+    assert hm.shape == (1, 17, 16, 16)
+    coords, scores = heatmap_to_coord(hm)
+    assert coords.shape == (1, 17, 2) and scores.shape == (1, 17)
+    # decoded coords index the max heatmap cell
+    h = hm.asnumpy()
+    cx, cy = int(coords.asnumpy()[0, 0, 0]), int(coords.asnumpy()[0, 0, 1])
+    assert h[0, 0, cy, cx] == h[0, 0].max()
