@@ -362,12 +362,21 @@ def squeeze(data, axis=None):
 
 @_register
 def broadcast_axis(data, axis, size):
+    """Broadcast size-1 axes to the given sizes (reference broadcast_axis /
+    broadcast_axes in src/operator/tensor/broadcast_reduce_op_value.cc)."""
     axes = (axis,) if isinstance(axis, int) else tuple(axis)
     sizes = (size,) if isinstance(size, int) else tuple(size)
     tgt = list(data.shape)
     for a, s in zip(axes, sizes):
+        if tgt[a] != 1:
+            raise MXNetError(
+                f"broadcast_axis: axis {a} has size {tgt[a]} != 1")
         tgt[a] = s
     return data.broadcast_to(tuple(tgt))
+
+
+broadcast_axes = broadcast_axis
+__all__.append("broadcast_axes")
 
 
 @_register
@@ -1715,3 +1724,474 @@ def Correlation(data1, data2, kernel_size=1, max_displacement=4, stride1=1,
             out = out[:, :, ::stride1, ::stride1]
         return out
     return apply_nary(fn, [data1, _nd(data2, data1)], name="Correlation")
+
+
+# ======================================================================
+# round-3 op tail: activations, numpy-parity, sample_*, legacy outputs
+# (reference: src/operator/tensor/elemwise_unary_op*.cc, matrix_op.cc,
+#  src/operator/random/sample_op.cc, src/operator/regression_output*.cc)
+# ======================================================================
+
+mish = _unary_factory("mish", lambda d: d * jnp.tanh(jax.nn.softplus(d)))
+# erf-based (exact) gelu to match the reference and LeakyReLU(act_type=gelu)
+gelu = _unary_factory("gelu", lambda d: jax.nn.gelu(d, approximate=False))
+rcbrt = _unary_factory("rcbrt", lambda d: 1.0 / jnp.cbrt(d))
+relu6 = _unary_factory("relu6", lambda d: jnp.clip(d, 0.0, 6.0))
+selu = _unary_factory("selu", jax.nn.selu)
+softrelu = _unary_factory("softrelu", jax.nn.softplus)
+log_sigmoid = _unary_factory("log_sigmoid", jax.nn.log_sigmoid)
+silu = _unary_factory("silu", jax.nn.silu)
+swish = _unary_factory("swish", jax.nn.silu)
+isnan = _unary_factory("isnan", jnp.isnan)
+isinf = _unary_factory("isinf", jnp.isinf)
+isfinite = _unary_factory("isfinite", jnp.isfinite)
+
+
+@_register
+def elu(data, alpha=1.0):
+    """ELU (reference LeakyReLU act_type='elu')."""
+    return apply_nary(lambda d: jnp.where(d > 0, d, alpha * jnp.expm1(d)),
+                      [data], name="elu")
+
+
+def _binary_factory(name, jfn):
+    def op(lhs, rhs, **kwargs):
+        return apply_nary(jfn, [lhs, _nd(rhs, lhs)], name=name)
+    op.__name__ = name
+    op.__doc__ = f"Elementwise {name}. Reference: src/operator/tensor/elemwise_binary_op_basic.cc."
+    return _register(op)
+
+
+fmod = _binary_factory("fmod", jnp.fmod)
+mod = _binary_factory("mod", jnp.mod)
+floor_divide = _binary_factory("floor_divide", jnp.floor_divide)
+true_divide = _binary_factory("true_divide", jnp.true_divide)
+outer = _binary_factory("outer", jnp.outer)
+inner = _binary_factory("inner", jnp.inner)
+vdot = _binary_factory("vdot", jnp.vdot)
+kron = _binary_factory("kron", jnp.kron)
+matmul = _binary_factory("matmul", jnp.matmul)
+
+
+@_register
+def tensordot(a, b, axes=2):
+    return apply_nary(lambda x, y: jnp.tensordot(x, y, axes=axes),
+                      [a, _nd(b, a)], name="tensordot")
+
+
+@_register
+def cumsum(a, axis=None, dtype=None):
+    return apply_nary(
+        lambda d: jnp.cumsum(d, axis=axis,
+                             dtype=_dtype_of(dtype) if dtype else None),
+        [a], name="cumsum")
+
+
+@_register
+def cumprod(a, axis=None):
+    return apply_nary(lambda d: jnp.cumprod(d, axis=axis), [a],
+                      name="cumprod")
+
+
+@_register
+def trace(data, offset=0, axis1=0, axis2=1):
+    return apply_nary(lambda d: jnp.trace(d, offset, axis1, axis2), [data],
+                      name="trace")
+
+
+@_register
+def rot90(data, k=1, axes=(0, 1)):
+    return apply_nary(lambda d: jnp.rot90(d, k, axes), [data], name="rot90")
+
+
+@_register
+def tril(data, k=0):
+    return apply_nary(lambda d: jnp.tril(d, k), [data], name="tril")
+
+
+@_register
+def triu(data, k=0):
+    return apply_nary(lambda d: jnp.triu(d, k), [data], name="triu")
+
+
+@_register
+def full_like(data, fill_value, dtype=None):
+    return apply_nary(
+        lambda d: jnp.full_like(d, fill_value,
+                                dtype=_dtype_of(dtype) if dtype else None),
+        [data], name="full_like")
+
+
+@_register
+def masked_softmax(data, mask, axis=-1, temperature=1.0):
+    """Softmax over positions where mask is true; masked positions get 0
+    probability (reference src/operator/nn/softmax.cc masked_softmax)."""
+    def fn(d, m):
+        neg = jnp.finfo(d.dtype if jnp.issubdtype(d.dtype, jnp.floating)
+                        else jnp.float32).min
+        z = jnp.where(m.astype(bool), d / temperature, neg)
+        p = jax.nn.softmax(z, axis=axis)
+        return jnp.where(m.astype(bool), p, jnp.zeros((), p.dtype))
+    return apply_nary(fn, [data, _nd(mask, data)], name="masked_softmax")
+
+
+@_register
+def meshgrid(*arrays, indexing="xy"):
+    arrs = [_nd(a) for a in arrays]
+    if len(arrs) == 1:   # numpy semantics: always a list, even for one input
+        return [apply_nary(
+            lambda d: jnp.meshgrid(d, indexing=indexing)[0], arrs,
+            name="meshgrid")]
+    return apply_nary(lambda *ds: tuple(jnp.meshgrid(*ds, indexing=indexing)),
+                      arrs, n_out=len(arrs), name="meshgrid")
+
+
+def _stack_factory(name, jfn):
+    def op(*arrays, **kwargs):
+        if len(arrays) == 1 and isinstance(arrays[0], (list, tuple)):
+            arrays = tuple(arrays[0])
+        arrs = [_nd(a) for a in arrays]
+        return apply_nary(lambda *ds: jfn(ds), arrs, name=name)
+    op.__name__ = name
+    op.__doc__ = f"numpy-style {name}."
+    return _register(op)
+
+
+hstack = _stack_factory("hstack", jnp.hstack)
+vstack = _stack_factory("vstack", jnp.vstack)
+dstack = _stack_factory("dstack", jnp.dstack)
+
+
+def _np_split_factory(name, jfn):
+    def op(data, indices_or_sections):
+        n = indices_or_sections if isinstance(indices_or_sections, int) \
+            else len(indices_or_sections) + 1
+        if n == 1:   # numpy semantics: a one-element list
+            return [apply_nary(lambda d: jfn(d, indices_or_sections)[0],
+                               [data], name=name)]
+        return apply_nary(lambda d: tuple(jfn(d, indices_or_sections)),
+                          [data], n_out=n, name=name)
+    op.__name__ = name
+    op.__doc__ = f"numpy-style {name}."
+    return _register(op)
+
+
+hsplit = _np_split_factory("hsplit", jnp.hsplit)
+vsplit = _np_split_factory("vsplit", jnp.vsplit)
+
+
+@_register
+def histogram(data, bins=10, range=None):
+    """Histogram counts + bin edges. Not differentiable (counts are
+    integer, so the input is detached); runs eagerly on device."""
+    data = _nd(data).detach()
+    rng = range
+    def fn(d):
+        return jnp.histogram(d, bins=bins, range=rng)
+    return apply_nary(fn, [data], n_out=2, name="histogram")
+
+
+@_register
+def bincount(data, weights=None, minlength=0):
+    """Integer-count op: data-dependent output size, eager only; inputs are
+    detached (counts are not differentiable w.r.t. indices)."""
+    data = _nd(data).detach()
+    if weights is None:
+        return apply_nary(
+            lambda d: jnp.bincount(d.astype(jnp.int32), minlength=minlength,
+                                   length=None),
+            [data], name="bincount")
+    return apply_nary(
+        lambda d, w: jnp.bincount(d.astype(jnp.int32), w,
+                                  minlength=minlength),
+        [data, _nd(weights, data)], name="bincount")
+
+
+@_register
+def unique(data):
+    """Sorted unique values. Output size is data-dependent — eager only
+    (inside jit/hybridize the size cannot be static); not differentiable, so
+    the input is detached from any open tape; reference mx.np.unique."""
+    return apply_nary(lambda d: jnp.unique(d), [_nd(data).detach()],
+                      name="unique")
+
+
+# ---- sample_* family: per-element distribution parameters ----
+# reference src/operator/random/sample_op.cc: output shape = params.shape
+# + shape; each output element drawn from its own parameterization
+
+def _sample_shape(pshape, shape):
+    if shape is None:
+        return tuple(pshape)
+    extra = (shape,) if isinstance(shape, int) else tuple(shape)
+    return tuple(pshape) + extra
+
+
+@_register
+def sample_uniform(low, high, shape=None, dtype=None, ctx=None):
+    from . import random as _rnd
+    low = _nd(low)
+    high = _nd(high, low)
+    out_shape = _sample_shape(low.shape, shape)
+    def fn(lo, hi):
+        u = jax.random.uniform(_rnd.next_key(), out_shape,
+                               _dtype_of(dtype) if dtype else jnp.float32)
+        nd_ = lo.ndim
+        bshape = lo.shape + (1,) * (len(out_shape) - nd_)
+        return lo.reshape(bshape) + u * (hi - lo).reshape(bshape)
+    return apply_nary(fn, [low, high], name="sample_uniform")
+
+
+@_register
+def sample_normal(mu, sigma, shape=None, dtype=None, ctx=None):
+    from . import random as _rnd
+    mu = _nd(mu)
+    sigma = _nd(sigma, mu)
+    out_shape = _sample_shape(mu.shape, shape)
+    def fn(m, s):
+        z = jax.random.normal(_rnd.next_key(), out_shape,
+                              _dtype_of(dtype) if dtype else jnp.float32)
+        bshape = m.shape + (1,) * (len(out_shape) - m.ndim)
+        return m.reshape(bshape) + z * s.reshape(bshape)
+    return apply_nary(fn, [mu, sigma], name="sample_normal")
+
+
+@_register
+def sample_gamma(alpha, beta, shape=None, dtype=None, ctx=None):
+    from . import random as _rnd
+    alpha = _nd(alpha)
+    beta = _nd(beta, alpha)
+    out_shape = _sample_shape(alpha.shape, shape)
+    def fn(a, b):
+        bshape = a.shape + (1,) * (len(out_shape) - a.ndim)
+        g = jax.random.gamma(_rnd.next_key(),
+                             jnp.broadcast_to(a.reshape(bshape), out_shape),
+                             dtype=_dtype_of(dtype) if dtype else jnp.float32)
+        return g * b.reshape(bshape)
+    return apply_nary(fn, [alpha, beta], name="sample_gamma")
+
+
+@_register
+def sample_exponential(lam, shape=None, dtype=None, ctx=None):
+    from . import random as _rnd
+    lam = _nd(lam)
+    out_shape = _sample_shape(lam.shape, shape)
+    def fn(l):
+        e = jax.random.exponential(
+            _rnd.next_key(), out_shape,
+            _dtype_of(dtype) if dtype else jnp.float32)
+        return e / l.reshape(l.shape + (1,) * (len(out_shape) - l.ndim))
+    return apply_nary(fn, [lam], name="sample_exponential")
+
+
+@_register
+def sample_poisson(lam, shape=None, dtype=None, ctx=None):
+    from . import random as _rnd
+    lam = _nd(lam)
+    out_shape = _sample_shape(lam.shape, shape)
+    def fn(l):
+        lb = jnp.broadcast_to(
+            l.reshape(l.shape + (1,) * (len(out_shape) - l.ndim)), out_shape)
+        p = jax.random.poisson(_rnd.next_key(), lb, shape=out_shape)
+        return p.astype(_dtype_of(dtype) if dtype else jnp.float32)
+    return apply_nary(fn, [lam], name="sample_poisson")
+
+
+@_register
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32"):
+    """Draw from rows of probabilities; with get_prob=True also return the
+    log-likelihood of each draw for REINFORCE-style training (reference
+    src/operator/random/sample_op.cc sample_multinomial: output shape is
+    data.shape[:-1] + shape)."""
+    from . import random as _rnd
+    data = _nd(data)
+    extra = () if shape is None else (
+        (shape,) if isinstance(shape, int) else tuple(shape))
+    n = int(_np.prod(extra)) if extra else 1
+    def fn(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        draws = jax.random.categorical(
+            _rnd.next_key(), logits, axis=-1, shape=(n,) + p.shape[:-1])
+        draws = jnp.moveaxis(draws, 0, -1)          # (..., n)
+        out_shape = p.shape[:-1] + extra
+        out = draws.reshape(out_shape).astype(_dtype_of(dtype))
+        if not get_prob:
+            return out
+        logp = jnp.take_along_axis(
+            jnp.broadcast_to(logits[..., None, :],
+                             p.shape[:-1] + (n, p.shape[-1])),
+            draws[..., :, None].astype(jnp.int32), axis=-1)
+        return out, logp[..., 0].reshape(out_shape).astype(p.dtype)
+    return apply_nary(fn, [data], n_out=2 if get_prob else 1,
+                      name="sample_multinomial")
+
+
+def random_uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
+    """Alias of mx.nd.random.uniform (reference _random_uniform)."""
+    from . import random as _rnd
+    return _rnd.uniform(low, high, shape, dtype, ctx)
+
+
+def random_normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
+    """Alias of mx.nd.random.normal (reference _random_normal)."""
+    from . import random as _rnd
+    return _rnd.normal(loc, scale, shape, dtype, ctx)
+
+
+__all__ += ["random_uniform", "random_normal"]
+
+
+# ---- legacy Module-era output ops: forward=identity, custom backward ----
+# reference src/operator/regression_output{,-inl}.h, svm_output.cc,
+# make_loss.cc: backward IGNORES the incoming cotangent and emits the
+# op-defined gradient scaled by grad_scale
+
+def _output_op(name, grad_fn):
+    def op(data, label, grad_scale=1.0):
+        label = _nd(label, data)
+
+        @jax.custom_vjp
+        def fwd(d, l):
+            return d
+
+        def fwd_fwd(d, l):
+            return d, (d, l)
+
+        def fwd_bwd(res, g):
+            d, l = res
+            return (grad_fn(d, l, grad_scale).astype(d.dtype),
+                    jnp.zeros_like(l))
+
+        fwd.defvjp(fwd_fwd, fwd_bwd)
+        return apply_nary(fwd, [data, label], name=name)
+    op.__name__ = name
+    op.__doc__ = (f"{name} (reference src/operator/): identity forward; "
+                  "backward is the op-defined gradient, replacing the "
+                  "incoming cotangent (legacy Module-era loss op).")
+    return _register(op)
+
+
+def _linreg_grad(d, l, scale):
+    return (d - l.reshape(d.shape)) * scale
+
+
+def _maereg_grad(d, l, scale):
+    return jnp.sign(d - l.reshape(d.shape)) * scale
+
+
+LinearRegressionOutput = _output_op("LinearRegressionOutput", _linreg_grad)
+MAERegressionOutput = _output_op("MAERegressionOutput", _maereg_grad)
+
+
+@_register
+def LogisticRegressionOutput(data, label, grad_scale=1.0):
+    """Reference src/operator/regression_output.cc (LogisticRegressionOutput):
+    forward = sigmoid(data); backward w.r.t. data = (out - label)*grad_scale,
+    replacing the incoming cotangent (legacy Module-era loss op)."""
+    label = _nd(label, data)
+
+    @jax.custom_vjp
+    def fwd(d, l):
+        return jax.nn.sigmoid(d)
+
+    def fwd_fwd(d, l):
+        out = jax.nn.sigmoid(d)
+        return out, (out, l)
+
+    def fwd_bwd(res, g):
+        out, l = res
+        return (((out - l.reshape(out.shape)) * grad_scale).astype(out.dtype),
+                jnp.zeros_like(l))
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return apply_nary(fwd, [data, label], name="LogisticRegressionOutput")
+
+
+def _svm_grad(d, l, scale, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False):
+    lab = l.astype(jnp.int32)
+    onehot = jax.nn.one_hot(lab, d.shape[-1], dtype=d.dtype)
+    signed = jnp.where(onehot > 0, -d, d)
+    viol = (margin + signed) > 0
+    if use_linear:
+        g = jnp.where(viol, jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+    else:
+        g = jnp.where(viol, 2.0 * (margin + signed) *
+                      jnp.where(onehot > 0, -1.0, 1.0), 0.0)
+    return g * scale * regularization_coefficient
+
+
+@_register
+def SVMOutput(data, label, margin=1.0, regularization_coefficient=1.0,
+              use_linear=False, grad_scale=1.0):
+    """Hinge-loss output op (reference src/operator/svm_output.cc):
+    identity forward, margin-violation gradient backward."""
+    label = _nd(label, data)
+
+    @jax.custom_vjp
+    def fwd(d, l):
+        return d
+
+    def fwd_fwd(d, l):
+        return d, (d, l)
+
+    def fwd_bwd(res, g):
+        d, l = res
+        return (_svm_grad(d, l, grad_scale, margin,
+                          regularization_coefficient,
+                          use_linear).astype(d.dtype),
+                jnp.zeros_like(l))
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return apply_nary(fwd, [data, label], name="SVMOutput")
+
+
+@_register
+def im2col(data, kernel, stride=None, dilate=None, pad=None):
+    """Unfold conv patches to a (N, C*prod(kernel), L) matrix (reference
+    src/operator/nn/im2col.h via the im2col op). Lowered to
+    lax.conv_general_dilated_patches so XLA emits one gather-free windowed
+    read; column order matches the reference (channel-major, then kernel
+    positions row-major, spatial L last)."""
+    ndim = len(kernel)
+    stride = tuple(stride) if stride else (1,) * ndim
+    dilate = tuple(dilate) if dilate else (1,) * ndim
+    pad_ = tuple(pad) if pad else (0,) * ndim
+    def fn(d):
+        patches = lax.conv_general_dilated_patches(
+            d, filter_shape=tuple(kernel), window_strides=stride,
+            padding=[(p, p) for p in pad_], rhs_dilation=dilate)
+        # patches: (N, C*prod(k), *out_spatial) already channel-major
+        n = patches.shape[0]
+        c = patches.shape[1]
+        return patches.reshape(n, c, -1)
+    return apply_nary(fn, [data], name="im2col")
+
+
+@_register
+def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
+    """Fold a (N, C*prod(kernel), L) matrix back to an image, summing
+    overlapping patches (reference col2im op) — implemented as the exact
+    linear transpose of im2col via jax.linear_transpose, so the pair is
+    adjoint by construction."""
+    ndim = len(kernel)
+    stride_ = tuple(stride) if stride else (1,) * ndim
+    dilate_ = tuple(dilate) if dilate else (1,) * ndim
+    pad_ = tuple(pad) if pad else (0,) * ndim
+    out_sp = (output_size,) * ndim if isinstance(output_size, int) \
+        else tuple(output_size)
+    def fn(cols):
+        n = cols.shape[0]
+        ck = cols.shape[1]
+        c = ck // int(_np.prod(kernel))
+        img_shape = (n, c) + out_sp
+        def unfold(img):
+            p = lax.conv_general_dilated_patches(
+                img, filter_shape=tuple(kernel), window_strides=stride_,
+                padding=[(p_, p_) for p_ in pad_], rhs_dilation=dilate_)
+            return p.reshape(n, ck, -1)
+        img0 = jnp.zeros(img_shape, cols.dtype)
+        transpose = jax.linear_transpose(unfold, img0)
+        (img,) = transpose(cols)
+        return img
+    return apply_nary(fn, [data], name="col2im")

@@ -1,0 +1,213 @@
+"""Round-3 operator tail: activations, numpy-parity ops, sample_* family,
+im2col/col2im, legacy output ops (reference: src/operator/tensor/
+elemwise_unary_op*.cc, random/sample_op.cc, nn/im2col.h,
+regression_output-inl.h, svm_output.cc)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient, with_seed)
+
+
+def test_new_activations_values_and_grads():
+    x = nd.array(np.array([-2.0, -0.5, 0.0, 0.5, 2.0], np.float32))
+    xn = x.asnumpy()
+    sp = np.log1p(np.exp(xn))
+    assert_almost_equal(nd.mish(x).asnumpy(), xn * np.tanh(sp), rtol=1e-5)
+    assert_almost_equal(nd.softrelu(x).asnumpy(), sp, rtol=1e-5)
+    assert_almost_equal(nd.silu(x).asnumpy(), xn / (1 + np.exp(-xn)),
+                        rtol=1e-5)
+    assert_almost_equal(nd.swish(x).asnumpy(), nd.silu(x).asnumpy(),
+                        rtol=1e-7)
+    assert_almost_equal(nd.relu6(nd.array([-1.0, 3.0, 8.0])).asnumpy(),
+                        [0.0, 3.0, 6.0], rtol=1e-7)
+    assert_almost_equal(nd.elu(nd.array([-1.0, 2.0]), alpha=2.0).asnumpy(),
+                        [2.0 * (np.exp(-1) - 1), 2.0], rtol=1e-5)
+    assert_almost_equal(nd.log_sigmoid(x).asnumpy(),
+                        -np.log1p(np.exp(-xn)), rtol=1e-5)
+    for name in ("mish", "gelu", "silu", "softrelu", "selu"):
+        check_numeric_gradient(lambda a, n=name: getattr(nd, n)(a).sum(),
+                               [nd.array([0.3, -0.7, 1.2])],
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_float_classification_ops():
+    x = nd.array(np.array([np.nan, np.inf, -np.inf, 1.0], np.float32))
+    assert nd.isnan(x).asnumpy().tolist() == [True, False, False, False]
+    assert nd.isinf(x).asnumpy().tolist() == [False, True, True, False]
+    assert nd.isfinite(x).asnumpy().tolist() == [False, False, False, True]
+
+
+def test_numpy_parity_matrix_ops():
+    m = nd.array(np.arange(6).reshape(2, 3).astype(np.float32))
+    assert nd.cumsum(m, axis=1).asnumpy()[1].tolist() == [3, 7, 12]
+    assert nd.cumprod(m + 1, axis=0).asnumpy()[1].tolist() == [4, 10, 18]
+    assert float(nd.trace(m).asnumpy()) == 4.0
+    assert nd.tril(nd.ones((3, 3))).asnumpy().sum() == 6
+    assert nd.triu(nd.ones((3, 3)), k=1).asnumpy().sum() == 3
+    assert nd.rot90(m).shape == (3, 2)
+    assert nd.full_like(m, 7).asnumpy()[1, 2] == 7
+    assert nd.broadcast_axes(nd.ones((1, 3)), axis=0, size=4).shape == (4, 3)
+    with pytest.raises(mx.MXNetError):
+        nd.broadcast_axes(nd.ones((2, 3)), axis=0, size=4)
+    assert nd.matmul(nd.ones((2, 3)), nd.ones((3, 4))).asnumpy()[0, 0] == 3
+    assert nd.kron(nd.eye(2), nd.ones((2, 2))).shape == (4, 4)
+    assert float(nd.vdot(nd.array([1.0, 2.0]),
+                         nd.array([3.0, 4.0])).asnumpy()) == 11.0
+    assert nd.outer(nd.array([1.0, 2.0]), nd.array([3.0, 4.0])) \
+        .asnumpy()[1, 1] == 8.0
+    assert nd.tensordot(nd.ones((2, 3)), nd.ones((3, 4)),
+                        axes=1).shape == (2, 4)
+
+
+def test_stack_split_hist_unique():
+    assert nd.hstack(nd.ones((2, 2)), nd.zeros((2, 3))).shape == (2, 5)
+    assert nd.vstack([nd.ones((1, 2)), nd.zeros((3, 2))]).shape == (4, 2)
+    assert nd.dstack(nd.ones((2, 2)), nd.ones((2, 2))).shape == (2, 2, 2)
+    parts = nd.hsplit(nd.arange(12).reshape((2, 6)), 3)
+    assert len(parts) == 3 and parts[2].asnumpy()[0].tolist() == [4, 5]
+    vparts = nd.vsplit(nd.arange(12).reshape((4, 3)), 2)
+    assert len(vparts) == 2 and vparts[1].shape == (2, 3)
+    cnt, edges = nd.histogram(nd.array([0.1, 0.2, 0.9]), bins=2,
+                              range=(0, 1))
+    assert cnt.asnumpy().tolist() == [2, 1] and edges.shape == (3,)
+    assert nd.bincount(nd.array([0, 1, 1, 3], dtype="int32")) \
+        .asnumpy().tolist() == [1, 2, 0, 1]
+    assert nd.unique(nd.array([3.0, 1.0, 3.0])).asnumpy().tolist() == [1, 3]
+    g1, g2 = nd.meshgrid(nd.array([1.0, 2.0]), nd.array([3.0, 4.0, 5.0]))
+    assert g1.shape == (3, 2) and g2.asnumpy()[2, 0] == 5.0
+
+
+def test_masked_softmax():
+    data = nd.array([[1.0, 2.0, 3.0]])
+    mask = nd.array([[1, 1, 0]])
+    out = nd.masked_softmax(data, mask)
+    assert out.asnumpy()[0, 2] == 0.0
+    assert abs(out.asnumpy().sum() - 1.0) < 1e-5
+    ref = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    assert_almost_equal(out.asnumpy()[0, :2], ref.astype(np.float32),
+                        rtol=1e-5)
+    # temperature scales the logits
+    hot = nd.masked_softmax(data, mask, temperature=100.0)
+    assert abs(float(hot.asnumpy()[0, 0]) - 0.5) < 1e-2
+
+
+@with_seed()
+def test_sample_family_moments():
+    mx.random.seed(42)
+    s = nd.sample_uniform(nd.array([0.0, 10.0]), nd.array([1.0, 20.0]),
+                          shape=500)
+    assert s.shape == (2, 500)
+    assert 0 <= s.asnumpy()[0].min() and s.asnumpy()[0].max() <= 1
+    assert 10 <= s.asnumpy()[1].min() and s.asnumpy()[1].max() <= 20
+    sn = nd.sample_normal(nd.array([0.0, 100.0]), nd.array([1.0, 2.0]),
+                          shape=2000)
+    assert abs(sn.asnumpy()[1].mean() - 100) < 1
+    sg = nd.sample_gamma(nd.array([2.0]), nd.array([3.0]), shape=3000)
+    assert abs(sg.asnumpy().mean() - 6.0) < 0.5        # mean = alpha*beta
+    sp = nd.sample_poisson(nd.array([4.0]), shape=1000)
+    assert abs(sp.asnumpy().mean() - 4.0) < 0.5
+    se = nd.sample_exponential(nd.array([2.0]), shape=3000)
+    assert abs(se.asnumpy().mean() - 0.5) < 0.1        # mean = 1/lam
+    smn = nd.sample_multinomial(nd.array([[0.0, 1.0, 0.0],
+                                          [1.0, 0.0, 0.0]]), shape=8)
+    assert smn.shape == (2, 8)
+    assert (smn.asnumpy()[0] == 1).all() and (smn.asnumpy()[1] == 0).all()
+    assert nd.random_uniform(shape=(3,)).shape == (3,)
+    assert nd.random_normal(shape=(2, 2)).shape == (2, 2)
+
+
+def test_im2col_matches_torch_unfold_and_col2im_adjoint():
+    torch = pytest.importorskip("torch")
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 6, 6)
+                 .astype(np.float32))
+    cols = nd.im2col(x, kernel=(3, 3), stride=(1, 1), pad=(1, 1))
+    ref = torch.nn.functional.unfold(torch.from_numpy(x.asnumpy()), 3,
+                                     padding=1).numpy()
+    assert_almost_equal(cols.asnumpy(), ref, rtol=1e-6)
+    # adjoint identity <im2col(x), y> == <x, col2im(y)>
+    y = nd.array(np.random.RandomState(1).randn(*cols.shape)
+                 .astype(np.float32))
+    lhs = float((cols.asnumpy() * y.asnumpy()).sum())
+    back = nd.col2im(y, output_size=(6, 6), kernel=(3, 3), stride=(1, 1),
+                     pad=(1, 1))
+    rhs = float((x.asnumpy() * back.asnumpy()).sum())
+    assert abs(lhs - rhs) < 1e-2 * max(1.0, abs(lhs))
+
+
+def test_legacy_output_ops_gradient_contract():
+    d = nd.array([[0.5, -0.2]])
+    lab = nd.array([[0.0, 0.0]])
+    d.attach_grad()
+    with autograd.record():
+        out = nd.LinearRegressionOutput(d, lab, grad_scale=2.0)
+    out.backward()
+    assert_almost_equal(out.asnumpy(), d.asnumpy(), rtol=1e-7)
+    assert_almost_equal(d.grad.asnumpy(), [[1.0, -0.4]], rtol=1e-5)
+
+    d = nd.array([[0.5, -0.2]])
+    d.attach_grad()
+    with autograd.record():
+        out = nd.MAERegressionOutput(d, lab)
+    out.backward()
+    assert_almost_equal(d.grad.asnumpy(), [[1.0, -1.0]], rtol=1e-6)
+
+    d2 = nd.array([[0.3]])
+    d2.attach_grad()
+    with autograd.record():
+        o2 = nd.LogisticRegressionOutput(d2, nd.array([[1.0]]))
+    o2.backward()
+    sig = 1 / (1 + np.exp(-0.3))
+    assert_almost_equal(o2.asnumpy(), [[sig]], rtol=1e-5)
+    assert_almost_equal(d2.grad.asnumpy(), [[sig - 1.0]], rtol=1e-4)
+
+    d3 = nd.array([[1.0, 0.2, -0.5]])
+    d3.attach_grad()
+    with autograd.record():
+        o3 = nd.SVMOutput(d3, nd.array([0]), use_linear=True)
+    o3.backward()
+    # class 0 satisfies margin (signed=-1 -> 1-1=0, not >0): grad 0;
+    # wrong classes violate (0.2+1, -0.5+1 > 0): grad +1
+    assert_almost_equal(d3.grad.asnumpy(), [[0.0, 1.0, 1.0]], rtol=1e-6)
+
+
+def test_review_regressions():
+    """Paths from the round-3 review: single-output meshgrid/splits,
+    get_prob, tuple sample shape, gelu parity, tape-detached count ops."""
+    # single-input meshgrid / hsplit(x, 1) return one-element lists
+    (g,) = nd.meshgrid(nd.array([1.0, 2.0]))
+    assert g.asnumpy().tolist() == [1.0, 2.0]
+    (h,) = nd.hsplit(nd.ones((2, 4)), 1)
+    assert h.shape == (2, 4)
+    (v,) = nd.vsplit(nd.ones((4, 2)), 1)
+    assert v.shape == (4, 2)
+    # sample_multinomial: tuple shape appends, get_prob returns log-lik
+    probs = nd.array([[0.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+    s = nd.sample_multinomial(probs, shape=(2, 3))
+    assert s.shape == (2, 2, 3)
+    s2, logp = nd.sample_multinomial(probs, shape=4, get_prob=True)
+    assert s2.shape == (2, 4) and logp.shape == (2, 4)
+    assert np.allclose(logp.asnumpy(), 0.0, atol=1e-5)  # p=1 draws
+    # gelu is erf-based, matching LeakyReLU(act_type='gelu')
+    x = nd.array([0.5, -1.3, 2.0])
+    assert_almost_equal(nd.gelu(x).asnumpy(),
+                        nd.LeakyReLU(x, act_type="gelu").asnumpy(),
+                        rtol=1e-6)
+    # count ops run under an open tape without breaking it
+    t = nd.array([1.0, 2.0, 2.0])
+    t.attach_grad()
+    with autograd.record():
+        y = (t * 2).sum()
+        nd.unique(t)
+        nd.histogram(t, bins=2, range=(0, 3))
+        nd.bincount(nd.array([0, 1], dtype="int32"))
+    y.backward()
+    assert t.grad.asnumpy().tolist() == [2.0, 2.0, 2.0]
+    # broadcast_axes validates non-1 axes and aliases broadcast_axis
+    assert nd.broadcast_axes(nd.ones((1, 3)), axis=0, size=4).shape == (4, 3)
+    with pytest.raises(mx.MXNetError):
+        nd.broadcast_axes(nd.ones((2, 3)), axis=0, size=4)
+    with pytest.raises(TypeError):
+        nd.LinearRegressionOutput(nd.ones((1,)), nd.ones((1,)), out=None)
