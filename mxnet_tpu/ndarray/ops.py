@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import builtins as _builtins
 import math
+import os as _os
 
 import numpy as _np
 import jax
@@ -883,16 +884,27 @@ def Convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
     dilate = tuple(dilate) if dilate else (1,) * nd
     pad_ = tuple(pad) if pad else (0,) * nd
     padding = [(p, p) for p in pad_]
-    dn = _conv_dn(nd)
+    # MXTPU_CONV_LAYOUT=NHWC runs the 2D conv internally channels-last
+    # (TPU-native lane layout); boundary transposes between consecutive
+    # convs cancel in XLA. User-facing semantics stay NCHW.
+    nhwc = nd == 2 and _os.environ.get("MXTPU_CONV_LAYOUT", "") == "NHWC"
+    dn = lax.conv_dimension_numbers(
+        (1, 1, 1, 1), (1, 1, 1, 1), ("NHWC", "HWIO", "NHWC")) if nhwc \
+        else _conv_dn(nd)
     inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
     def fn(d, w, *b):
         # no preferred_element_type: XLA:TPU already accumulates bf16 convs
         # in fp32, and an explicit fp32 hint breaks jax's conv transpose
         # rule (fp32 cotangent x bf16 operand mismatch) under grad
+        if nhwc:
+            d = jnp.transpose(d, (0, 2, 3, 1))
+            w = jnp.transpose(w, (2, 3, 1, 0))
         y = lax.conv_general_dilated(
             d, w, window_strides=stride, padding=padding,
             rhs_dilation=dilate, dimension_numbers=dn,
             feature_group_count=num_group)
+        if nhwc:
+            y = jnp.transpose(y, (0, 3, 1, 2))
         if b:
             y = y + b[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)
         return y.astype(d.dtype)

@@ -1,0 +1,106 @@
+"""One-shot TPU experiment matrix for the ResNet MFU push (round 3).
+
+Times the fused ResNet-50 train step under the layout/stem/batch knobs and
+prints one JSON line per configuration. Run ONLY when the tunnel is free
+(single TPU client rule — see .claude/skills/verify/SKILL.md).
+
+    python tools/tpu_conv_experiments.py            # full matrix
+    MXTPU_EXP_CONFIGS=s2d,nhwc python tools/...     # subset
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_config(name, batch, s2d, layout, iters=20, warmup=3):
+    # fresh process-level env for the conv layout knob (read at trace time)
+    if layout:
+        os.environ["MXTPU_CONV_LAYOUT"] = layout
+    else:
+        os.environ.pop("MXTPU_CONV_LAYOUT", None)
+
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+    from mxnet_tpu import amp
+
+    if jax.devices()[0].platform == "cpu":   # smoke config
+        batch, iters, warmup = min(batch, 8), min(iters, 2), 1
+    amp.init(target_dtype="bfloat16")
+    net = resnet50_v1(s2d_stem=s2d)
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    trainer = DataParallelTrainer(net, loss_fn, "sgd",
+                                  {"learning_rate": 0.1, "momentum": 0.9},
+                                  mesh=mesh)
+    data = mx.nd.random.uniform(shape=(batch, 3, 224, 224))
+    label = mx.nd.zeros((batch,))
+    t_c0 = time.perf_counter()
+    for _ in range(warmup):
+        loss = trainer.step(data, label)
+    loss.asnumpy()
+    compile_s = time.perf_counter() - t_c0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(data, label)
+    loss.asnumpy()
+    dt = time.perf_counter() - t0
+    img_s = batch * iters / dt
+    out = {"config": name, "batch": batch, "s2d_stem": s2d,
+           "conv_layout": layout or "NCHW",
+           "img_per_sec": round(img_s, 2),
+           "step_ms": round(dt / iters * 1e3, 2),
+           "compile_s": round(compile_s, 1)}
+    print(json.dumps(out), flush=True)
+    return out
+
+
+MATRIX = {
+    "base": dict(batch=128, s2d=False, layout=None),
+    "s2d": dict(batch=128, s2d=True, layout=None),
+    "nhwc": dict(batch=128, s2d=False, layout="NHWC"),
+    "s2d_nhwc": dict(batch=128, s2d=True, layout="NHWC"),
+    "b256": dict(batch=256, s2d=False, layout=None),
+    "b256_s2d": dict(batch=256, s2d=True, layout=None),
+    "b256_s2d_nhwc": dict(batch=256, s2d=True, layout="NHWC"),
+}
+
+
+def main():
+    want = os.environ.get("MXTPU_EXP_CONFIGS")
+    names = want.split(",") if want else list(MATRIX)
+    results = []
+    for n in names:
+        # each config in a subprocess: conv-layout env is baked into traces
+        # and jit caches must not leak across configs
+        if os.environ.get("MXTPU_EXP_CHILD") == n:
+            run_config(n, **MATRIX[n])
+            return
+        import subprocess
+        env = dict(os.environ, MXTPU_EXP_CHILD=n)
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                           env=env, capture_output=True, text=True,
+                           timeout=1800)
+        line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        if line:
+            results.append(json.loads(line[-1]))
+            print(line[-1], flush=True)
+        else:
+            print(json.dumps({"config": n, "error":
+                              (p.stderr or "no output")[-300:]}), flush=True)
+    if results:
+        best = max(results, key=lambda r: r.get("img_per_sec", 0))
+        print(json.dumps({"best": best}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
