@@ -59,6 +59,10 @@ from . import module
 from . import operator
 from . import module as mod
 from . import visualization as viz
+from . import name
+from . import attribute
+from . import engine
+from . import rtc
 from . import image
 from . import parallel
 

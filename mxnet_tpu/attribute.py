@@ -1,0 +1,9 @@
+"""``mx.attribute`` — attribute scoping for symbols.
+
+Reference: python/mxnet/attribute.py (AttrScope). The implementation lives
+with the Symbol facade (symbol/symbol.py AttrScope — ctx_group etc. survive
+the json round-trip); this module provides the reference import path.
+"""
+from .symbol.symbol import AttrScope  # noqa: F401
+
+__all__ = ["AttrScope"]

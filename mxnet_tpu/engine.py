@@ -1,0 +1,40 @@
+"""``mx.engine`` — dependency-engine control shims.
+
+Reference: python/mxnet/engine.py (bulk/set_bulk_size) over the threaded
+engine (SURVEY §2.1 row 1). The TPU rebuild has no threaded engine — XLA
+async dispatch plays that role — so these controls are accepted for API
+compatibility and mapped to their closest real effect:
+
+- ``set_bulk_size`` is a no-op returning the previous value (XLA fuses the
+  whole jitted program; there is no op-bulking knob to turn).
+- ``bulk`` is a null context manager.
+- The debug switch the reference exposes as MXNET_ENGINE_TYPE=NaiveEngine
+  (serialize everything) maps to MXTPU_EAGER=1 — disable hybridize jit and
+  run op-by-op; see base.py feature flags.
+"""
+from __future__ import annotations
+
+import contextlib
+
+__all__ = ["bulk", "set_bulk_size"]
+
+_bulk_size = 15   # reference default MXNET_EXEC_BULK_EXEC_MAX_NODE_TRAIN
+
+
+def set_bulk_size(size):
+    """Accepted for compatibility; returns the previous setting. XLA fusion
+    subsumes engine op-bulking (SURVEY §2.1 disposition)."""
+    global _bulk_size
+    prev, _bulk_size = _bulk_size, int(size)
+    return prev
+
+
+@contextlib.contextmanager
+def bulk(size):
+    """Reference mx.engine.bulk(size): batch engine pushes. No-op here —
+    everything inside a hybridized block is already one XLA program."""
+    prev = set_bulk_size(size)
+    try:
+        yield
+    finally:
+        set_bulk_size(prev)

@@ -483,6 +483,11 @@ class HybridBlock(Block):
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
                   inline_limit=2, **kwargs):
+        # MXTPU_EAGER=1: serialize-everything debug switch — the reference's
+        # MXNET_ENGINE_TYPE=NaiveEngine equivalent (SURVEY §2.1 row 1):
+        # hybridize becomes a no-op so every op dispatches eagerly
+        if active and os.environ.get("MXTPU_EAGER", "") == "1":
+            active = False
         self._active = active
         self._flags = {"static_alloc": static_alloc,
                        "static_shape": static_shape,

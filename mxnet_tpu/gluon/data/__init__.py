@@ -1,5 +1,6 @@
 """``gluon.data`` (reference: python/mxnet/gluon/data/)."""
 from .dataset import Dataset, SimpleDataset, ArrayDataset, RecordFileDataset
 from .dataloader import (DataLoader, default_batchify_fn, Sampler,
-                         SequentialSampler, RandomSampler, BatchSampler)
+                         SequentialSampler, RandomSampler, BatchSampler,
+                         FilterSampler)
 from . import vision

@@ -21,7 +21,7 @@ from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, array
 
 __all__ = ["DataLoader", "default_batchify_fn", "Sampler", "SequentialSampler",
-           "RandomSampler", "BatchSampler"]
+           "RandomSampler", "BatchSampler", "FilterSampler"]
 
 
 # ----------------------------------------------------------------------
@@ -59,6 +59,20 @@ class RandomSampler(Sampler):
 
     def __len__(self):
         return self._length
+
+
+class FilterSampler(Sampler):
+    """Samples indices whose dataset element satisfies fn (reference
+    gluon/data/sampler.py FilterSampler)."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset)) if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)
 
 
 class BatchSampler(Sampler):

@@ -1,0 +1,61 @@
+"""``mx.name`` — auto-naming manager for symbols.
+
+Reference: python/mxnet/name.py (NameManager/Prefix). Symbol ops created
+without an explicit ``name=`` consult the innermost active manager; the
+default manager numbers per-op ("convolution0"), Prefix prepends a string —
+exactly the reference behavior the Module/viz layers rely on for stable
+param names.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+
+class NameManager:
+    """Scope manager that turns op-type hints into unique names."""
+
+    _state = threading.local()
+
+    def __init__(self):
+        self._counter = {}
+
+    def get(self, name, hint):
+        if name is not None:
+            return name
+        i = self._counter.get(hint, 0)
+        self._counter[hint] = i + 1
+        return f"{hint}{i}"
+
+    def __enter__(self):
+        stack = self._stack()
+        stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        self._stack().pop()
+        return False
+
+    @classmethod
+    def _stack(cls):
+        if not hasattr(cls._state, "stack"):
+            cls._state.stack = []
+        return cls._state.stack
+
+
+class Prefix(NameManager):
+    """``with mx.name.Prefix('mynet_'):`` — prefix every auto name."""
+
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    stack = NameManager._stack()
+    return stack[-1] if stack else None

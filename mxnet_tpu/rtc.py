@@ -1,0 +1,25 @@
+"""``mx.rtc`` — runtime kernel compilation (reference python/mxnet/rtc.py,
+CUDA NVRTC). There is no CUDA on TPU; the supported extension points are
+mx.operator.CustomOp (python) and Pallas kernels (mxnet_tpu/ops/). The
+entry points below raise with that guidance instead of silently missing.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+
+__all__ = ["CudaModule", "CudaKernel"]
+
+_MSG = ("mx.rtc compiles CUDA source at runtime; this TPU-native build has "
+        "no CUDA path. Write custom ops with mx.operator.CustomOp (host "
+        "python) or a Pallas TPU kernel (see mxnet_tpu/ops/flash_attention"
+        ".py for the pattern).")
+
+
+class CudaModule:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)
+
+
+class CudaKernel:
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(_MSG)

@@ -388,6 +388,15 @@ _AUTO_NAME_COUNTER = {}
 
 
 def _auto_name(opname):
+    # reference python/mxnet/name.py: the innermost NameManager owns both
+    # prefix and numbering, and a fresh scope restarts counts — so mixing
+    # scoped and unscoped creation in ONE graph can collide (same upstream;
+    # pass explicit name= where it matters). Prefixed names never collide
+    # with unprefixed ones.
+    from .. import name as _name_mod
+    mgr = _name_mod.current()
+    if mgr is not None:
+        return mgr.get(None, opname.lower())
     i = _AUTO_NAME_COUNTER.get(opname, 0)
     _AUTO_NAME_COUNTER[opname] = i + 1
     return f"{opname.lower()}{i}"

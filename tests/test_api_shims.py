@@ -1,0 +1,73 @@
+"""API-parity modules: mx.name, mx.attribute, mx.engine, mx.rtc,
+FilterSampler, MXTPU_EAGER debug switch (reference python/mxnet/{name,
+attribute,engine,rtc}.py, gluon/data/sampler.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon import data as gdata
+from mxnet_tpu.gluon import nn
+
+
+def test_name_manager_and_prefix():
+    with mx.name.NameManager():
+        a = mx.sym.relu(mx.sym.var("x"))
+        b = mx.sym.relu(mx.sym.var("y"))
+    assert a.name == "relu0" and b.name == "relu1"
+    with mx.name.Prefix("mynet_"):
+        s = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3)
+    assert s.name.startswith("mynet_fullyconnected")
+    # explicit names always win
+    with mx.name.Prefix("p_"):
+        t = mx.sym.relu(mx.sym.var("z"), name="myrelu")
+    assert t.name == "myrelu"
+    assert mx.name.current() is None
+
+
+def test_attribute_scope_path():
+    with mx.attribute.AttrScope(ctx_group="stage1"):
+        v = mx.sym.var("w")
+    assert v.attr("ctx_group") == "stage1"
+
+
+def test_engine_shims():
+    prev = mx.engine.set_bulk_size(8)
+    assert mx.engine.set_bulk_size(prev) == 8
+    with mx.engine.bulk(4):
+        pass
+
+
+def test_rtc_gated():
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaModule("__global__ void k() {}")
+    with pytest.raises(mx.MXNetError):
+        mx.rtc.CudaKernel()
+
+
+def test_eager_debug_switch():
+    os.environ["MXTPU_EAGER"] = "1"
+    try:
+        d = nn.Dense(2)
+        d.initialize()
+        d.hybridize()
+        assert d._active is False        # NaiveEngine-equivalent: stays eager
+        out = d(mx.nd.ones((1, 3)))
+        assert out.shape == (1, 2)
+    finally:
+        del os.environ["MXTPU_EAGER"]
+    d2 = nn.Dense(2)
+    d2.initialize()
+    d2.hybridize()
+    assert d2._active is True
+
+
+def test_filter_sampler():
+    ds = gdata.ArrayDataset(mx.nd.array([1.0, 2.0, 3.0, 4.0]))
+    fs = gdata.FilterSampler(lambda x: float(x) > 2, ds)
+    assert list(fs) == [2, 3]
+    assert len(fs) == 2
+    loader = gdata.DataLoader(ds, batch_size=2, sampler=fs)
+    (batch,) = list(loader)
+    assert batch.asnumpy().tolist() == [3.0, 4.0]

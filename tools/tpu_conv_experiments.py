@@ -76,27 +76,31 @@ MATRIX = {
 
 
 def main():
+    import subprocess
+    child = os.environ.get("MXTPU_EXP_CHILD")
+    if child:   # child process: run exactly ONE config, never recurse
+        run_config(child, **MATRIX[child])
+        return
     want = os.environ.get("MXTPU_EXP_CONFIGS")
     names = want.split(",") if want else list(MATRIX)
     results = []
     for n in names:
         # each config in a subprocess: conv-layout env is baked into traces
         # and jit caches must not leak across configs
-        if os.environ.get("MXTPU_EXP_CHILD") == n:
-            run_config(n, **MATRIX[n])
-            return
-        import subprocess
         env = dict(os.environ, MXTPU_EXP_CHILD=n)
-        p = subprocess.run([sys.executable, os.path.abspath(__file__)],
-                           env=env, capture_output=True, text=True,
-                           timeout=1800)
-        line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+        try:
+            p = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                               env=env, capture_output=True, text=True,
+                               timeout=1800)
+            line = [l for l in p.stdout.splitlines() if l.startswith("{")]
+            err = (p.stderr or "no output")[-300:]
+        except subprocess.TimeoutExpired:
+            line, err = [], "timeout after 1800s"
         if line:
             results.append(json.loads(line[-1]))
             print(line[-1], flush=True)
         else:
-            print(json.dumps({"config": n, "error":
-                              (p.stderr or "no output")[-300:]}), flush=True)
+            print(json.dumps({"config": n, "error": err}), flush=True)
     if results:
         best = max(results, key=lambda r: r.get("img_per_sec", 0))
         print(json.dumps({"best": best}), flush=True)
