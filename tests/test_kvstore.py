@@ -93,3 +93,45 @@ def test_optimizer_on_kvstore():
     out = nd.zeros((4,))
     kv.pull(0, out=out)
     assert not np.allclose(out.asnumpy(), 1.0)     # weight moved
+
+
+def test_int8_compression_roundtrip_and_feedback():
+    """EQuARX-style blockwise int8 wire quantization (PAPERS.md row 9):
+    value-proportional error, ~4x wire reduction, error feedback."""
+    from mxnet_tpu.kvstore.kvstore import Int8GradientCompression
+    gc = Int8GradientCompression()
+    rng = np.random.RandomState(0)
+    g = mx.nd.array(rng.randn(1000).astype(np.float32) * 0.01).data
+    packed, shape = gc.compress("k", g)
+    assert packed.dtype.name == "uint8"
+    # 1000 values -> 4 blocks of 256: 1024 code bytes + 16 scale bytes
+    assert packed.shape == (1040,)
+    deq = np.asarray(gc.decompress(packed, shape))
+    scale_bound = np.abs(np.asarray(g)).max() / 127.0
+    assert np.abs(deq - np.asarray(g)).max() <= scale_bound
+    # error feedback: the running mean of dequantized grads converges far
+    # below one quantization step
+    gc2 = Int8GradientCompression()
+    acc = np.zeros(1000, np.float32)
+    for _ in range(30):
+        p, s = gc2.compress("k", g)
+        acc += np.asarray(gc2.decompress(p, s))
+    assert np.abs(acc / 30 - np.asarray(g)).max() < scale_bound / 20
+    # non-multiple-of-block sizes roundtrip
+    g3 = mx.nd.array(rng.randn(777).astype(np.float32)).data
+    p3, s3 = gc.compress("x", g3)
+    d3 = np.asarray(gc.decompress(p3, s3))
+    assert d3.shape == (777,)
+    assert np.abs(d3 - np.asarray(g3)).max() <= \
+        np.abs(np.asarray(g3)).max() / 127.0
+
+
+def test_kvstore_with_int8_compression():
+    kv = mx.kv.create("dist_sync")
+    kv.set_gradient_compression({"type": "int8"})
+    kv.init(1, nd.zeros((600,)))
+    g = np.linspace(-1, 1, 600).astype(np.float32)
+    kv.push(1, nd.array(g))
+    out = nd.zeros((600,))
+    kv.pull(1, out=out)
+    np.testing.assert_allclose(out.asnumpy(), g, atol=1.0 / 127.0)
