@@ -330,15 +330,17 @@ class CachedOp:
     Reference: src/imperative/cached_op.{h,cc} (CachedOp::Forward)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 inline_limit=2):
+                 inline_limit=2, remat=False):
         self.block = block
         self.static_alloc = static_alloc
         self.static_shape = static_shape
+        self.remat = remat
         self._jitted = {}       # train_mode -> jitted fn
         self._param_objs = None  # ordered params
         self._out_tree = {}      # train_mode -> (n_out, structure)
         self._aux_params = {}    # train_mode -> [Parameter]
         self._in_avals = None    # last input signature (for export)
+        self._none_pos = ()      # positions of None args (reinserted)
 
     def _collect(self):
         if self._param_objs is None:
@@ -358,6 +360,8 @@ class CachedOp:
                 with _tape.trace_scope(), _bind_params(binding), \
                         _rnd.trace_key_scope(key), _aux_scope() as aux:
                     ins = [NDArray(a) for a in input_arrays]
+                    for i in cached._none_pos:   # optional args elided
+                        ins.insert(i, None)
                     out = block.forward(*ins)
             finally:
                 _tape.set_training(prev_train)
@@ -371,10 +375,28 @@ class CachedOp:
 
     def _get_jitted(self, train):
         if train not in self._jitted:
-            self._jitted[train] = jax.jit(self._make_pure(train))
+            fn = self._make_pure(train)
+            if self.remat:
+                # jax.checkpoint: discard this block's activations in the
+                # enclosing differentiated program and recompute them in
+                # its backward — HBM for FLOPs. Survives inlining into an
+                # outer jit (e.g. the fused DataParallelTrainer step), so
+                # hybridize(remat=True) per encoder layer gives the classic
+                # per-layer rematerialization schedule.
+                fn = jax.checkpoint(fn)
+            self._jitted[train] = jax.jit(fn)
         return self._jitted[train]
 
     def __call__(self, *args):
+        # None args (optional masks etc.) fall back to the forward()
+        # defaults — jit signatures carry arrays only; _make_pure reinserts
+        # them by position
+        none_pos = tuple(i for i, a in enumerate(args) if a is None)
+        if none_pos != self._none_pos:
+            self._none_pos = none_pos
+            self._jitted = {}
+            self._out_tree = {}
+        args = tuple(a for a in args if a is not None)
         params = self._collect()
         # Sparse-grad params can't ride jax.vjp of the fused program (its
         # cotangents are dense O(vocab)): dispatch the block imperatively
@@ -482,16 +504,20 @@ class HybridBlock(Block):
         self._flags = {}
 
     def hybridize(self, active=True, static_alloc=False, static_shape=False,
-                  inline_limit=2, **kwargs):
+                  inline_limit=2, remat=None, **kwargs):
         # MXTPU_EAGER=1: serialize-everything debug switch — the reference's
         # MXNET_ENGINE_TYPE=NaiveEngine equivalent (SURVEY §2.1 row 1):
         # hybridize becomes a no-op so every op dispatches eagerly
         if active and os.environ.get("MXTPU_EAGER", "") == "1":
             active = False
         self._active = active
+        if remat is None:   # unspecified: keep a previously-set schedule
+            # (ancestor hybridize() recursion must not wipe per-layer remat)
+            remat = self._flags.get("remat", False)
         self._flags = {"static_alloc": static_alloc,
                        "static_shape": static_shape,
-                       "inline_limit": inline_limit}
+                       "inline_limit": inline_limit,
+                       "remat": remat}
         self._cached_op = None
         super().hybridize(active, static_alloc=static_alloc,
                           static_shape=static_shape, **kwargs)
@@ -513,11 +539,18 @@ class HybridBlock(Block):
             "in_channels explicitly.")
 
     def __call__(self, *args, **kwargs):
-        if self._active and _tape._STATE.trace_depth == 0 and not kwargs:
+        # inside an enclosing trace (outer CachedOp / fused trainer step)
+        # blocks normally inline as plain ops — EXCEPT remat blocks, which
+        # must still route through their jax.checkpoint-wrapped CachedOp so
+        # the rematerialization boundary survives into the outer program
+        in_trace = _tape._STATE.trace_depth > 0
+        if self._active and not kwargs and \
+                (not in_trace or self._flags.get("remat")):
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, **{
                     k: v for k, v in self._flags.items()
-                    if k in ("static_alloc", "static_shape", "inline_limit")})
+                    if k in ("static_alloc", "static_shape", "inline_limit",
+                             "remat")})
             return self._cached_op(*args)
         return super().__call__(*args, **kwargs)
 

@@ -82,6 +82,15 @@ class BERTEncoder(HybridBlock):
             x = cell(x, mask)
         return x
 
+    def remat(self, active=True):
+        """Per-cell rematerialization: each encoder cell is jitted under
+        jax.checkpoint, so the enclosing differentiated step keeps only
+        layer BOUNDARY activations in HBM and recomputes the interiors in
+        backward — the standard long-sequence memory schedule (task brief:
+        'jax.checkpoint to trade FLOPs for memory')."""
+        for cell in self.transformer_cells._children.values():
+            cell.hybridize(active, remat=active)
+
 
 class BERTModel(HybridBlock):
     """Embeddings + encoder + pooler + MLM decoder + NSP classifier.

@@ -219,6 +219,13 @@ class LlamaModel(HybridBlock):
             x = layer(x)
         return self.norm(x)
 
+    def remat(self, active=True):
+        """Per-decoder-layer jax.checkpoint: keep only layer-boundary
+        activations in HBM, recompute interiors in backward (the long-
+        context memory schedule; composes with the TP/CP shardings)."""
+        for layer in self.layers:
+            layer.hybridize(active, remat=active)
+
 
 class LlamaForCausalLM(HybridBlock):
     def __init__(self, cfg, **kwargs):

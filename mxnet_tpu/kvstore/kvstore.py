@@ -122,11 +122,18 @@ class KVStore:
         params = dict(compression_params or {})
         ctype = params.get("type", "2bit")
         if ctype == "2bit":
+            extra = set(params) - {"type", "threshold"}
+            if extra:
+                raise MXNetError(f"unknown compression params {sorted(extra)}")
             self._compression = GradientCompression(
                 threshold=float(params.get("threshold", 0.5)))
         elif ctype == "int8":
             # EQuARX-style blockwise int8 wire quantization (this build's
             # extension beyond the reference's 2-bit — see PAPERS.md)
+            extra = set(params) - {"type"}
+            if extra:
+                raise MXNetError(
+                    f"int8 compression takes no params, got {sorted(extra)}")
             self._compression = Int8GradientCompression()
         else:
             raise MXNetError(f"unsupported compression type {ctype!r}")

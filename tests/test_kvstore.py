@@ -135,3 +135,11 @@ def test_kvstore_with_int8_compression():
     out = nd.zeros((600,))
     kv.pull(1, out=out)
     np.testing.assert_allclose(out.asnumpy(), g, atol=1.0 / 127.0)
+
+
+def test_compression_rejects_unknown_params():
+    kv = mx.kv.create("dist_sync")
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "int8", "threshold": 0.1})
+    with pytest.raises(mx.MXNetError):
+        kv.set_gradient_compression({"type": "2bit", "block": 64})
