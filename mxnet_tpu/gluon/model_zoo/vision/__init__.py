@@ -16,6 +16,7 @@ from .segmentation import *  # noqa: F401,F403
 from .rcnn import *  # noqa: F401,F403
 from .resnest import *  # noqa: F401,F403
 from .pose import *  # noqa: F401,F403
+from .resnext import *  # noqa: F401,F403
 
 from ....base import MXNetError
 
@@ -28,7 +29,7 @@ def _register_models():
     mods = [importlib.import_module(f"{__name__}.{m}")
             for m in ("resnet", "alexnet", "vgg", "squeezenet", "mobilenet",
                       "densenet", "inception", "ssd", "yolo", "segmentation",
-                      "rcnn", "resnest", "pose")]
+                      "rcnn", "resnest", "pose", "resnext")]
     non_models = {"heatmap_to_coord"}   # exported utilities, not factories
     for mod in mods:
         for name in mod.__all__:

@@ -77,3 +77,39 @@ def test_avgpool_hybridized_backward_regression():
         loss.backward()
         g = x.grad.asnumpy()
         assert float(np.abs(g).sum()) > 0
+
+
+def test_resnext_and_se_resnet():
+    """ResNeXt grouped bottleneck + SE gate (gluoncv resnext.py/senet.py)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnext import (ResNeXt, SEBlock,
+                                                          resnext50_32x4d,
+                                                          se_resnet50)
+    x = nd.array(np.random.RandomState(0).randn(2, 3, 64, 64)
+                 .astype(np.float32))
+    tiny = ResNeXt([1, 1, 1, 1], cardinality=4, bottleneck_width=4,
+                   classes=10)
+    tiny.initialize()
+    tiny.hybridize()
+    with autograd.record():
+        out = tiny(x)
+        loss = out.sum()
+    loss.backward()
+    assert out.shape == (2, 10)
+    # SE gate scales channels in [0, 1]
+    se = SEBlock(8)
+    se.initialize()
+    h = nd.array(np.random.RandomState(1).randn(1, 8, 4, 4)
+                 .astype(np.float32))
+    g = se(h)
+    assert g.shape == h.shape
+    # full model param counts: resnext50_32x4d ~25.0M, se_resnet50 ~28.1M
+    for ctor, lo, hi in ((resnext50_32x4d, 22e6, 27e6),
+                         (se_resnet50, 25e6, 31e6)):
+        net = ctor(classes=10)
+        net.initialize()
+        net(nd.zeros((1, 3, 64, 64)))
+        n = sum(int(np.prod(p.shape))
+                for p in net.collect_params().values())
+        assert lo < n < hi, (ctor.__name__, n)
+    assert vision.get_model("resnext50_32x4d", classes=5) is not None
+    assert vision.get_model("se_resnet50", classes=5) is not None
