@@ -16,7 +16,9 @@ from ...nn import Sequential, HybridSequential
 
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
            "RandomResizedCrop", "RandomFlipLeftRight", "RandomFlipTopBottom",
-           "RandomCrop"]
+           "RandomCrop", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomHue", "RandomColorJitter",
+           "RandomLighting"]
 
 
 def _to_np(x):
@@ -176,3 +178,113 @@ class RandomFlipTopBottom(Block):
         if _np.random.rand() < 0.5:
             np_x = np_x[::-1].copy()
         return array(np_x)
+
+
+class RandomBrightness(Block):
+    """Scale all channels by U(1-b, 1+b) (reference transforms.RandomBrightness)."""
+
+    def __init__(self, brightness):
+        super().__init__()
+        self._b = brightness
+
+    def forward(self, x):
+        np_x = _to_np(x).astype(_np.float32)
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        return array(np_x * alpha)
+
+
+class RandomContrast(Block):
+    """Blend with the per-image gray mean (reference RandomContrast)."""
+
+    def __init__(self, contrast):
+        super().__init__()
+        self._c = contrast
+
+    def forward(self, x):
+        np_x = _to_np(x).astype(_np.float32)
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        gray = np_x.mean()
+        return array(np_x * alpha + gray * (1.0 - alpha))
+
+
+_GRAY_COEF = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+
+class RandomSaturation(Block):
+    """Blend with the per-pixel gray value (reference RandomSaturation)."""
+
+    def __init__(self, saturation):
+        super().__init__()
+        self._s = saturation
+
+    def forward(self, x):
+        np_x = _to_np(x).astype(_np.float32)
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        gray = (np_x * _GRAY_COEF).sum(axis=-1, keepdims=True)
+        return array(np_x * alpha + gray * (1.0 - alpha))
+
+
+class RandomHue(Block):
+    """Rotate the hue via the YIQ transform (reference RandomHue)."""
+
+    def __init__(self, hue):
+        super().__init__()
+        self._h = hue
+
+    def forward(self, x):
+        np_x = _to_np(x).astype(_np.float32)
+        alpha = _np.random.uniform(-self._h, self._h) * _np.pi
+        u, w = _np.cos(alpha), _np.sin(alpha)
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], _np.float32)
+        t_rgb = _np.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], _np.float32)
+        rot = _np.array([[1.0, 0.0, 0.0],
+                         [0.0, u, -w],
+                         [0.0, w, u]], _np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return array(np_x @ m.T)
+
+
+class RandomColorJitter(Block):
+    """Apply brightness/contrast/saturation/hue jitter in random order
+    (reference RandomColorJitter)."""
+
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        super().__init__()
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
+
+    def forward(self, x):
+        order = _np.random.permutation(len(self._ts))
+        for i in order:
+            x = self._ts[int(i)](x)
+        return x
+
+
+class RandomLighting(Block):
+    """AlexNet-style PCA lighting noise (reference RandomLighting)."""
+
+    _EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+    _EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                         [-0.5808, -0.0045, -0.8140],
+                         [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+    def __init__(self, alpha):
+        super().__init__()
+        self._alpha = alpha
+
+    def forward(self, x):
+        np_x = _to_np(x).astype(_np.float32)
+        a = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        rgb = (self._EIGVEC * a * self._EIGVAL).sum(axis=1)
+        return array(np_x + rgb)

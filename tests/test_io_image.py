@@ -129,3 +129,23 @@ def test_image_augmenters_list():
     for aug in augs:
         src = aug(src)
     assert src.shape[2] == 3
+
+
+def test_color_jitter_transforms():
+    """Reference gluon/data/vision/transforms.py color-jitter family."""
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    rng = np.random.RandomState(0)
+    img = mx.nd.array(rng.randint(0, 255, (8, 8, 3)).astype(np.float32))
+    for t in (T.RandomBrightness(0.3), T.RandomContrast(0.3),
+              T.RandomSaturation(0.3), T.RandomHue(0.1),
+              T.RandomColorJitter(0.2, 0.2, 0.2, 0.05),
+              T.RandomLighting(0.1)):
+        out = t(img)
+        assert out.shape == img.shape
+    # zero-strength hue is identity up to the YIQ round-trip (~1/255)
+    np.testing.assert_allclose(T.RandomHue(0.0)(img).asnumpy(),
+                               img.asnumpy(), atol=1.5)
+    # brightness scales linearly: zero image stays zero
+    z = mx.nd.zeros((4, 4, 3))
+    np.testing.assert_allclose(
+        T.RandomBrightness(0.5)(z).asnumpy(), 0.0, atol=1e-6)

@@ -29,11 +29,17 @@ def main():
                     help="total payload size in MB")
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--gc-type", default=None, choices=["2bit", "int8"],
+                    help="gradient compression on the wire hop (reference "
+                         "dist_sync_kvstore.py --gc-type; int8 is the "
+                         "EQuARX-style extension)")
     args = ap.parse_args()
 
     import mxnet_tpu as mx
 
     kv = mx.kv.create(args.kv_store)
+    if args.gc_type:
+        kv.set_gradient_compression({"type": args.gc_type})
     total_elems = int(args.data_mb * 1e6 / 4)
     per_key = total_elems // args.num_keys
     vals = []
@@ -76,6 +82,7 @@ def main():
     print("BWJSON " + json.dumps({
         "kvstore": kv.type, "workers": kv.num_workers,
         "wire": getattr(kv, "_wire_mode", None),
+        "compression": args.gc_type,
         "batched_gb_s": round(results["batched"], 3),
         "per_key_gb_s": round(results.get("per-key", 0.0), 3)}))
     return results["batched"]
