@@ -321,6 +321,19 @@ def builtins_sum(it):
     return total
 
 
+def _abstract_trace(args):
+    """True when the enclosing trace is a real (abstract) jit trace — the
+    PRNG trace key installed by the tracing scope is itself a Tracer, or a
+    tensor argument is. Eager passes under trace_scope (deferred-shape
+    resolution) carry concrete keys/arrays and must NOT re-route through
+    nested jit/checkpoint (their placement constraints fight commitments)."""
+    stack = _rnd._STATE.trace_stack
+    if stack and isinstance(stack[-1][0], jax.core.Tracer):
+        return True
+    return any(isinstance(getattr(a, "data", None), jax.core.Tracer)
+               for a in args if a is not None)
+
+
 # ----------------------------------------------------------------------
 # CachedOp — the hybridize() engine
 # ----------------------------------------------------------------------
@@ -341,6 +354,7 @@ class CachedOp:
         self._aux_params = {}    # train_mode -> [Parameter]
         self._in_avals = None    # last input signature (for export)
         self._none_pos = ()      # positions of None args (reinserted)
+        self._raw = {}           # train_mode -> un-jitted pure fn
 
     def _collect(self):
         if self._param_objs is None:
@@ -373,8 +387,14 @@ class CachedOp:
             return outs
         return _pure
 
-    def _get_jitted(self, train):
-        if train not in self._jitted:
+    def _get_jitted(self, train, raw=False):
+        """raw=True returns the (possibly checkpointed) pure fn WITHOUT the
+        jax.jit wrapper — used when this block executes inside an enclosing
+        trace: a nested jit would pin concrete captured args (PRNG key) to
+        one device and fight mesh sharding constraints, while the raw fn
+        inlines cleanly with the remat boundary intact."""
+        store = self._raw if raw else self._jitted
+        if train not in store:
             fn = self._make_pure(train)
             if self.remat:
                 # jax.checkpoint: discard this block's activations in the
@@ -384,8 +404,8 @@ class CachedOp:
                 # hybridize(remat=True) per encoder layer gives the classic
                 # per-layer rematerialization schedule.
                 fn = jax.checkpoint(fn)
-            self._jitted[train] = jax.jit(fn)
-        return self._jitted[train]
+            store[train] = fn if raw else jax.jit(fn)
+        return store[train]
 
     def __call__(self, *args):
         # None args (optional masks etc.) fall back to the forward()
@@ -395,6 +415,7 @@ class CachedOp:
         if none_pos != self._none_pos:
             self._none_pos = none_pos
             self._jitted = {}
+            self._raw = {}
             self._out_tree = {}
         args = tuple(a for a in args if a is not None)
         params = self._collect()
@@ -426,7 +447,7 @@ class CachedOp:
             self._param_objs = None
             params = self._collect()
         train = _tape.is_training()
-        jfn = self._get_jitted(train)
+        jfn = self._get_jitted(train, raw=_tape._STATE.trace_depth > 0)
         key = _rnd.next_key()
         n_params = len(params)
         inputs = [p.data() for p in params] + list(args)
@@ -542,10 +563,14 @@ class HybridBlock(Block):
         # inside an enclosing trace (outer CachedOp / fused trainer step)
         # blocks normally inline as plain ops — EXCEPT remat blocks, which
         # must still route through their jax.checkpoint-wrapped CachedOp so
-        # the rematerialization boundary survives into the outer program
+        # the rematerialization boundary survives into the outer program.
+        # Only when the enclosing trace is abstract (real jit tracing):
+        # eager passes under trace_scope (deferred-shape resolution) carry
+        # concrete arrays, where the boundary is meaningless and nested
+        # placement constraints (ring attention) would fight commitments.
         in_trace = _tape._STATE.trace_depth > 0
-        if self._active and not kwargs and \
-                (not in_trace or self._flags.get("remat")):
+        remat_route = self._flags.get("remat") and _abstract_trace(args)
+        if self._active and not kwargs and (not in_trace or remat_route):
             if self._cached_op is None:
                 self._cached_op = CachedOp(self, **{
                     k: v for k, v in self._flags.items()

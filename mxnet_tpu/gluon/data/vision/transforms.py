@@ -193,6 +193,15 @@ class RandomBrightness(Block):
         return array(np_x * alpha)
 
 
+_GRAY_COEF = _np.array([0.299, 0.587, 0.114], _np.float32)
+_T_YIQ = _np.array([[0.299, 0.587, 0.114],
+                    [0.596, -0.274, -0.321],
+                    [0.211, -0.523, 0.311]], _np.float32)
+_T_RGB = _np.array([[1.0, 0.956, 0.621],
+                    [1.0, -0.272, -0.647],
+                    [1.0, -1.107, 1.705]], _np.float32)
+
+
 class RandomContrast(Block):
     """Blend with the per-image gray mean (reference RandomContrast)."""
 
@@ -203,11 +212,10 @@ class RandomContrast(Block):
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
         alpha = 1.0 + _np.random.uniform(-self._c, self._c)
-        gray = np_x.mean()
+        # reference blends with the LUMINANCE mean (image.random_contrast),
+        # not the unweighted channel mean
+        gray = (np_x * _GRAY_COEF).sum(axis=-1).mean()
         return array(np_x * alpha + gray * (1.0 - alpha))
-
-
-_GRAY_COEF = _np.array([0.299, 0.587, 0.114], _np.float32)
 
 
 class RandomSaturation(Block):
@@ -235,16 +243,10 @@ class RandomHue(Block):
         np_x = _to_np(x).astype(_np.float32)
         alpha = _np.random.uniform(-self._h, self._h) * _np.pi
         u, w = _np.cos(alpha), _np.sin(alpha)
-        t_yiq = _np.array([[0.299, 0.587, 0.114],
-                           [0.596, -0.274, -0.321],
-                           [0.211, -0.523, 0.311]], _np.float32)
-        t_rgb = _np.array([[1.0, 0.956, 0.621],
-                           [1.0, -0.272, -0.647],
-                           [1.0, -1.107, 1.705]], _np.float32)
         rot = _np.array([[1.0, 0.0, 0.0],
                          [0.0, u, -w],
                          [0.0, w, u]], _np.float32)
-        m = t_rgb @ rot @ t_yiq
+        m = _T_RGB @ rot @ _T_YIQ
         return array(np_x @ m.T)
 
 

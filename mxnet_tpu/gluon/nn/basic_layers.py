@@ -19,7 +19,8 @@ from ..parameter import DeferredInitializationError
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
            "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU",
-           "ELU", "SELU", "Swish", "GELU"]
+           "ELU", "SELU", "Swish", "GELU",
+           "Identity", "Concatenate", "HybridConcatenate"]
 
 
 class Sequential(Block):
@@ -473,3 +474,28 @@ class Swish(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x * F.sigmoid(self._beta * x)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference gluon/nn/basic_layers.py Identity) —
+    useful as a configurable no-op branch."""
+
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class HybridConcatenate(HybridSequential):
+    """Run children on the same input and concat outputs along `axis`
+    (reference gluon/nn/basic_layers.py HybridConcatenate/HybridConcurrent)."""
+
+    def __init__(self, axis=-1, **kwargs):
+        super().__init__(**kwargs)
+        self.axis = axis
+
+    def hybrid_forward(self, F, x):
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Concatenate(HybridConcatenate):
+    """Imperative alias of HybridConcatenate (reference Concatenate)."""

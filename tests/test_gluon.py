@@ -515,3 +515,21 @@ def test_bert_encoder_remat():
     net_b.hybridize()
     cells = net_b.encoder.transformer_cells._children.values()
     assert all(c._flags.get("remat") for c in cells)
+
+
+def test_identity_and_concatenate():
+    """Reference basic_layers Identity/HybridConcatenate (>=1.6)."""
+    ident = nn.Identity()
+    x = nd.array(np.random.RandomState(0).rand(2, 3).astype(np.float32))
+    assert_almost_equal(ident(x).asnumpy(), x.asnumpy())
+    cat = nn.HybridConcatenate(axis=-1)
+    cat.add(nn.Dense(4), nn.Dense(2), nn.Identity())
+    cat.initialize()
+    out = cat(x)
+    assert out.shape == (2, 9)
+    cat.hybridize()
+    assert_almost_equal(cat(x).asnumpy(), out.asnumpy(), rtol=1e-5)
+    with autograd.record():
+        loss = cat(x).sum()
+    loss.backward()
+    assert isinstance(nn.Concatenate(axis=1), nn.HybridConcatenate)

@@ -39,6 +39,9 @@ def main():
 
     kv = mx.kv.create(args.kv_store)
     if args.gc_type:
+        if not kv.type.startswith("dist"):
+            ap.error(f"--gc-type applies to the cross-worker wire hop; "
+                     f"kvstore {kv.type!r} has none (use dist_sync)")
         kv.set_gradient_compression({"type": args.gc_type})
     total_elems = int(args.data_mb * 1e6 / 4)
     per_key = total_elems // args.num_keys
