@@ -23,7 +23,9 @@ __all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
            "MultiBoxPrior", "MultiBoxTarget", "MultiBoxDetection",
            "getnnz", "quantize", "arange_like", "fused_gelu",
            "BilinearResize2D", "AdaptiveAvgPooling2D",
-           "DeformableConvolution"]
+           "DeformableConvolution",
+           "boolean_mask", "index_copy", "index_array", "allclose",
+           "gradientmultiplier", "fft", "ifft", "count_sketch"]
 
 
 def _corner(box, fmt):
@@ -648,3 +650,117 @@ def DeformableConvolution(data, offset, weight, bias=None, kernel=(3, 3),
     if bias is not None and not no_bias:
         inputs.append(bias)
     return apply_nary(fn, inputs, name="DeformableConvolution")
+
+
+# ----------------------------------------------------------------------
+# round-3 contrib tail (reference: src/operator/contrib/{boolean_mask,
+# index_copy,index_array,allclose,gradient_multiplier_op,fft,count_sketch}.cc)
+# ----------------------------------------------------------------------
+
+def _as_nd(x, like=None):
+    if isinstance(x, NDArray):
+        return x
+    from .ndarray import array
+    return array(x, ctx=like._ctx if like is not None else None)
+
+
+def boolean_mask(data, index, axis=0):
+    """Select rows where index!=0. Output size is data-dependent — eager
+    only (reference boolean_mask has the same dynamic-shape nature; its
+    CachedOp path also bails to imperative)."""
+    def fn(d, idx):
+        keep = jnp.nonzero(idx.astype(bool))[0]
+        return jnp.take(d, keep, axis=axis)
+    return apply_nary(fn, [data, _as_nd(index, data)], name="boolean_mask")
+
+
+def index_copy(old_tensor, index_vector, new_tensor):
+    """Copy new_tensor rows into old_tensor at index_vector (reference
+    index_copy: out-of-place, differentiable w.r.t. both tensors)."""
+    def fn(old, idx, new):
+        return old.at[idx.astype(jnp.int32)].set(new)
+    return apply_nary(fn, [old_tensor, _as_nd(index_vector, old_tensor),
+                           _as_nd(new_tensor, old_tensor)],
+                      name="index_copy")
+
+
+def index_array(data, axes=None):
+    """Return an int64 array of index coordinates of data's shape
+    (reference index_array): out[i_0,..,i_{n-1}] = (i_0,..,i_{n-1}),
+    optionally restricted to `axes`."""
+    def fn(d):
+        sel = range(d.ndim) if axes is None else axes
+        dt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        # build only the selected axes: arange along axis a broadcast to
+        # the full shape (no O(ndim * numel) meshgrid materialization)
+        grids = [jnp.broadcast_to(
+            jnp.arange(d.shape[a], dtype=dt).reshape(
+                tuple(d.shape[a] if i == a else 1
+                      for i in range(d.ndim))), d.shape) for a in sel]
+        return jnp.stack(grids, axis=-1)
+    return apply_nary(fn, [data], name="index_array")
+
+
+def allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True):
+    """Scalar 1.0/0.0 allclose (reference contrib/allclose_op.cc)."""
+    def fn(x, y):
+        return jnp.allclose(x, y, rtol=rtol, atol=atol,
+                            equal_nan=equal_nan).astype(jnp.float32)
+    return apply_nary(fn, [a, _as_nd(b, a)], name="allclose")
+
+
+def gradientmultiplier(data, scalar=1.0):
+    """Identity forward, gradient scaled by `scalar` (reference
+    gradient_multiplier_op.cc — the gradient-reversal-layer primitive when
+    scalar is negative)."""
+    @jax.custom_vjp
+    def fwd(d):
+        return d
+
+    def fwd_fwd(d):
+        return d, None
+
+    def fwd_bwd(_, g):
+        return (g * scalar,)
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return apply_nary(fwd, [data], name="gradientmultiplier")
+
+
+def fft(data, compute_size=128):
+    """FFT along the last axis, complex output interleaved as
+    (..., 2*n) real/imag pairs (reference contrib/fft.cc layout)."""
+    def fn(d):
+        c = jnp.fft.fft(d, axis=-1)
+        out = jnp.stack([c.real, c.imag], axis=-1)
+        return out.reshape(d.shape[:-1] + (2 * d.shape[-1],)) \
+            .astype(jnp.float32)
+    return apply_nary(fn, [data], name="fft")
+
+
+def ifft(data, compute_size=128):
+    """Inverse of contrib.fft: input (..., 2*n) interleaved real/imag,
+    output (..., n) real part, scaled by n like the reference (which
+    does not normalize, leaving the caller to divide)."""
+    def fn(d):
+        n = d.shape[-1] // 2
+        pairs = d.reshape(d.shape[:-1] + (n, 2))
+        c = lax.complex(pairs[..., 0], pairs[..., 1])
+        return jnp.fft.ifft(c, axis=-1).real.astype(jnp.float32) * n
+    return apply_nary(fn, [data], name="ifft")
+
+
+def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
+    """Count sketch projection (reference contrib/count_sketch.cc):
+    out[..., h[j]] += s[j] * data[..., j]; h in [0, out_dim), s in ±1."""
+    if out_dim is None:
+        raise MXNetError("count_sketch requires out_dim")
+    def fn(d, hh, ss):
+        idx = hh.astype(jnp.int32).reshape(-1)
+        sign = ss.reshape(-1).astype(d.dtype)
+        flat = d.reshape(-1, d.shape[-1])
+        out = jnp.zeros((flat.shape[0], out_dim), d.dtype)
+        out = out.at[:, idx].add(flat * sign[None, :])
+        return out.reshape(d.shape[:-1] + (out_dim,))
+    return apply_nary(fn, [data, _as_nd(h, data), _as_nd(s, data)],
+                      name="count_sketch")

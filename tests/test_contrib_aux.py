@@ -148,3 +148,52 @@ def test_async_checkpointer_roundtrip(tmp_path):
     ckpt.wait_until_finished()
     np.testing.assert_allclose(nd_utils.load(path)["w"].asnumpy(),
                                np.arange(6).reshape(2, 3) + 100.0)
+
+
+def test_contrib_round3_tail():
+    """boolean_mask/index_copy/index_array/allclose/gradientmultiplier/
+    fft+ifft/count_sketch (reference src/operator/contrib/)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    c = nd.contrib
+    d = nd.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    out = c.boolean_mask(d, nd.array([1, 0, 1]))
+    assert out.asnumpy().tolist() == [[1, 2], [5, 6]]
+    d.attach_grad()
+    with autograd.record():
+        loss = c.boolean_mask(d, nd.array([1, 0, 1])).sum()
+    loss.backward()
+    assert d.grad.asnumpy().tolist() == [[1, 1], [0, 0], [1, 1]]
+
+    out = c.index_copy(nd.zeros((4, 2)), nd.array([1, 3]), nd.ones((2, 2)))
+    np.testing.assert_allclose(out.asnumpy(),
+                               [[0, 0], [1, 1], [0, 0], [1, 1]])
+
+    ia = c.index_array(nd.zeros((2, 3)))
+    assert ia.shape == (2, 3, 2) and ia.asnumpy()[1, 2].tolist() == [1, 2]
+    assert c.index_array(nd.zeros((2, 3)), axes=(1,)).shape == (2, 3, 1)
+
+    assert float(c.allclose(nd.ones((3,)), nd.ones((3,))).asnumpy()) == 1.0
+    assert float(c.allclose(nd.ones((3,)), nd.zeros((3,))).asnumpy()) == 0.0
+
+    # gradient reversal: forward identity, grad scaled by the scalar
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = c.gradientmultiplier(x, scalar=-0.5).sum()
+    y.backward()
+    assert float(y.asnumpy()) == 2.0
+    assert x.grad.asnumpy()[0] == -0.5
+
+    # fft/ifft roundtrip with the reference's interleaved layout + n scale
+    sig = nd.array(np.random.RandomState(0).randn(2, 8).astype(np.float32))
+    F = c.fft(sig)
+    assert F.shape == (2, 16)
+    rec = c.ifft(F) / 8
+    np.testing.assert_allclose(rec.asnumpy(), sig.asnumpy(), atol=1e-5)
+
+    # count sketch preserves inner products in expectation; check exact
+    # scatter on a tiny case: h=[0,0], s=[1,-1], x=[3,5] -> out[0]=-2
+    cs = c.count_sketch(nd.array([[3.0, 5.0]]), nd.array([0, 0]),
+                        nd.array([1.0, -1.0]), out_dim=2)
+    np.testing.assert_allclose(cs.asnumpy(), [[-2.0, 0.0]])

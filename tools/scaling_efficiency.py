@@ -39,7 +39,7 @@ def build_model(name):
     return net, shape
 
 
-def time_mesh(n_dev, model, shape, per_dev_batch, iters, warmup):
+def time_mesh(n_dev, model, per_dev_batch, iters, warmup):
     import jax
     import numpy as np
     import mxnet_tpu as mx
@@ -56,9 +56,10 @@ def time_mesh(n_dev, model, shape, per_dev_batch, iters, warmup):
     rng = np.random.RandomState(0)
     data = mx.nd.array(rng.rand(batch, *in_shape).astype(np.float32))
     label = mx.nd.array(rng.randint(0, 10, batch))
-    for _ in range(warmup):
+    for _ in range(max(warmup, 1)):       # >=1: the compile must not be timed
         loss = trainer.step(data, label)
     loss.asnumpy()
+    iters = max(iters, 1)
     t0 = time.perf_counter()
     for _ in range(iters):
         loss = trainer.step(data, label)
@@ -83,7 +84,7 @@ def main():
     rows = []
     t1 = None
     for n in sizes:
-        dt, batch = time_mesh(n, args.model, (), args.per_device_batch,
+        dt, batch = time_mesh(n, args.model, args.per_device_batch,
                               args.iters, args.warmup)
         t1 = t1 if t1 is not None else dt
         eff = t1 / dt
