@@ -152,3 +152,24 @@ def test_image_record_iter_native(tmp_path):
     assert batches[0].data[0].shape == (4, 3, 28, 28)
     labels = np.concatenate([b.label[0].asnumpy() for b in batches])
     assert labels.tolist() == [float(i % 3) for i in range(12)]
+
+
+def test_cpp_unit_tests():
+    """Run the native C++ unit-test binary (reference tests/cpp/ role);
+    builds on demand when cmake is present."""
+    import os
+    import subprocess
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    binary = os.path.join(root, "src", "build", "mxtpu_cpp_tests")
+    if not os.path.exists(binary):
+        try:
+            subprocess.run(["cmake", "--build",
+                            os.path.join(root, "src", "build"),
+                            "--target", "mxtpu_cpp_tests"],
+                           check=True, capture_output=True, timeout=300)
+        except Exception:
+            pytest.skip("mxtpu_cpp_tests not built and cmake unavailable")
+    out = subprocess.run([binary], capture_output=True, text=True,
+                         timeout=120)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "ALL CPP TESTS PASSED" in out.stdout
