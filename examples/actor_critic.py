@@ -1,0 +1,97 @@
+"""Actor-critic on a tiny corridor environment (reference
+example/gluon/actor_critic/actor_critic.py pattern: shared trunk, policy
+head sampled with ``mx.nd.sample_multinomial(get_prob=True)``, REINFORCE
+with a value baseline, one Trainer step per episode).
+
+Environment (numpy, host-side like any gym): an agent starts at cell 0 of
+a length-8 corridor and must reach cell 7; +1 on reaching the goal, -0.01
+per step, episodes cap at 50 steps. Optimal policy = always step right.
+
+    JAX_PLATFORMS=cpu python examples/actor_critic.py
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+from mxnet_tpu.gluon import nn
+
+N_CELLS, GOAL, MAX_STEPS = 8, 7, 50
+
+
+class ActorCritic(gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.trunk = nn.Dense(32, activation="relu")
+            self.policy = nn.Dense(2)      # left / right logits
+            self.value = nn.Dense(1)
+
+    def hybrid_forward(self, F, x):
+        h = self.trunk(x)
+        return self.policy(h), self.value(h)
+
+
+def one_hot(cell):
+    v = np.zeros((1, N_CELLS), np.float32)
+    v[0, cell] = 1.0
+    return nd.array(v)
+
+
+def run_episode(net):
+    """Collect one episode; returns (log_probs, values, rewards)."""
+    cell, steps = 0, 0
+    log_probs, values, rewards = [], [], []
+    while cell != GOAL and steps < MAX_STEPS:
+        logits, value = net(one_hot(cell))
+        probs = nd.softmax(logits, axis=-1)
+        action, logp = nd.sample_multinomial(probs, get_prob=True)
+        a = int(action.asnumpy()[0])
+        cell = max(0, cell - 1) if a == 0 else min(N_CELLS - 1, cell + 1)
+        steps += 1
+        log_probs.append(logp[0])
+        values.append(value[0, 0])
+        rewards.append(1.0 if cell == GOAL else -0.01)
+    return log_probs, values, rewards
+
+
+def main(episodes=150, gamma=0.95, seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = ActorCritic()
+    net.initialize(init=mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.02})
+    history = []
+    for ep in range(episodes):
+        with autograd.record():
+            log_probs, values, rewards = run_episode(net)
+            returns, g = [], 0.0
+            for r in reversed(rewards):
+                g = r + gamma * g
+                returns.append(g)
+            returns.reverse()
+            loss = nd.zeros((1,))
+            for logp, v, ret in zip(log_probs, values, returns):
+                advantage = ret - float(v.asnumpy())   # baseline, no grad
+                loss = loss - logp * advantage + 0.5 * (v - ret) ** 2
+        loss.backward()
+        trainer.step(1)
+        history.append(len(rewards))
+        if (ep + 1) % 30 == 0:
+            avg = sum(history[-30:]) / 30
+            print(f"episode {ep + 1:3d}  avg steps (last 30): {avg:.1f}")
+    early = sum(history[:30]) / 30
+    late = sum(history[-30:]) / 30
+    assert late < early, (early, late)
+    # optimal is 7 steps; trained policy should be close
+    print(f"actor-critic OK: avg steps {early:.1f} -> {late:.1f} "
+          f"(optimal {GOAL})")
+
+
+if __name__ == "__main__":
+    main()
