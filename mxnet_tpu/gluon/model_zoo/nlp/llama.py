@@ -10,7 +10,10 @@ Pallas flash kernel (ops/flash_attention.py), with two scaling hooks:
   inserts the all-reduces from the sharding algebra).
 - context parallel: `context_parallel=True` routes attention through
   parallel.ring_attention over the mesh 'sp' axis (neighbour ppermute of
-  K/V blocks riding the ICI ring) for sequences longer than one chip's HBM.
+  K/V blocks riding the ICI ring) for sequences longer than one chip's HBM;
+  `context_parallel="ulysses"` selects the all-to-all head-scatter scheme
+  instead (parallel.ulysses — 4 all-to-alls/layer, heads must divide the
+  'sp' size; GQA kv repeated after the wire hop).
 """
 from __future__ import annotations
 
@@ -105,7 +108,7 @@ class LlamaAttention(HybridBlock):
         v = self.v_proj(x)
         theta = cfg.rope_theta
 
-        def rope_and_shape(qd, kd, vd):
+        def rope_and_shape(qd, kd, vd, repeat_kv=True):
             qd = qd.reshape(b, t, h, d).transpose(0, 2, 1, 3)
             kd = kd.reshape(b, t, kvh, d).transpose(0, 2, 1, 3)
             vd = vd.reshape(b, t, kvh, d).transpose(0, 2, 1, 3)
@@ -123,10 +126,12 @@ class LlamaAttention(HybridBlock):
 
             qd = rot(qd)
             kd = rot(kd)
-            # GQA: repeat kv heads
-            rep = h // kvh
-            kd = jnp.repeat(kd, rep, axis=1)
-            vd = jnp.repeat(vd, rep, axis=1)
+            if repeat_kv:
+                # GQA: repeat kv heads (the ulysses path defers this until
+                # after its all-to-all so the wire carries only true kv)
+                rep = h // kvh
+                kd = jnp.repeat(kd, rep, axis=1)
+                vd = jnp.repeat(vd, rep, axis=1)
             return qd, kd, vd
 
         # Context parallelism is a COMPILED feature: ring attention's
@@ -148,8 +153,17 @@ class LlamaAttention(HybridBlock):
                         and (in_jit_trace or eager_infer))
 
         def attn(qd, kd, vd):
-            qd, kd, vd = rope_and_shape(qd, kd, vd)
-            if use_ring:
+            # cfg.context_parallel selects the CP scheme (SURVEY §5.7
+            # lists both): "ulysses" = 4 all-to-alls per layer (q/k/v
+            # scatter + out gather), bandwidth ~4x activation; ring =
+            # S-1 neighbour K/V block hops
+            ulysses = use_ring and self.cfg.context_parallel == "ulysses"
+            qd, kd, vd = rope_and_shape(qd, kd, vd, repeat_kv=not ulysses)
+            if ulysses:
+                from ....parallel.ulysses import ulysses_attention
+                o = ulysses_attention(qd, kd, vd, mesh, axis_name="sp",
+                                      causal=True)
+            elif use_ring:
                 from ....parallel.ring_attention import ring_attention
                 o = ring_attention(qd, kd, vd, mesh, axis_name="sp",
                                    causal=True)

@@ -95,13 +95,20 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
         # AMP long-context is exactly this kernel's use case)
         return o.astype(q_blk.dtype)
 
+    return _shard_mapped_qkv(local_fn, q, k, v, mesh, axis_name)
+
+
+def _shard_mapped_qkv(local_fn, q, k, v, mesh, axis_name):
+    """Shared CP scaffolding (ring + ulysses): sequence-shard q/k/v over
+    `axis_name`, run `local_fn` under shard_map, restore the caller's
+    layout for eager inputs.
+
+    Eager arrays committed to one device are laid out over the mesh
+    first (and the output restored to the caller's layout so eager CP
+    composes with unsharded surrounding ops); under jit the constraint
+    is compiled in and the output stays sequence-sharded."""
     spec = P(None, None, axis_name, None)
     sharding = jax.sharding.NamedSharding(mesh, spec)
-
-    # Eager arrays committed to one device are laid out over the mesh
-    # first (and the output restored to the caller's layout so eager CP
-    # composes with unsharded surrounding ops); under jit the constraint
-    # is compiled in and the output stays sequence-sharded.
     eager = not isinstance(q, jax.core.Tracer)
     restore = None
 

@@ -1,0 +1,86 @@
+"""Ulysses-style sequence parallelism: all-to-all head scatter.
+
+NEW capability vs the reference (SURVEY §5.7 names it as the alternative
+to ring attention — "Ulysses-style all-to-all head scatter"; DeepSpeed
+Ulysses, arXiv:2309.14509, is the public origin of the pattern).
+
+Layout dance (per shard_map device, seq sharded over 'sp' of size S):
+
+    (B, H, T/S, D)  --all_to_all-->  (B, H/S, T, D)
+        attention over the FULL sequence on an H/S head slice
+    (B, H/S, T, D)  --all_to_all-->  (B, H, T/S, D)
+
+vs ring attention: 2 all-to-alls of the whole activation per layer
+(bandwidth-optimal on all-to-all-friendly fabrics) instead of S-1
+neighbour K/V hops; causal masking is exact-local because every device
+sees the full sequence; head count must be divisible by S. The local
+attention runs the same blockwise streaming kernel as the ring path (the
+Pallas flash kernel on TPU), so no (T, T) score tensor either way.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from ..base import MXNetError
+from .ring_attention import _block_attn, _shard_mapped_qkv
+
+__all__ = ["ulysses_attention", "ulysses_sequence_parallel_attention"]
+
+
+def ulysses_attention(q, k, v, mesh, axis_name="sp", causal=False,
+                      scale=None):
+    """q/k/v: (B, H, T, D) with T sharded over `axis_name`; returns the
+    attention output with the same sharding. K/V may carry fewer (GQA)
+    heads — they are repeated AFTER the head-scatter, so the all-to-alls
+    move only the true kv payload."""
+    n = mesh.shape[axis_name]
+    b, h, t, d = q.shape
+    if h % n:
+        raise MXNetError(
+            f"ulysses_attention: heads ({h}) must divide by the "
+            f"'{axis_name}' axis size ({n}); use ring_attention otherwise")
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    kv_h = k.shape[1]
+    if kv_h % n or h % kv_h:
+        raise MXNetError(
+            f"ulysses_attention: kv heads ({kv_h}) must divide by "
+            f"'{axis_name}' ({n}) and divide heads ({h}); use "
+            "ring_attention otherwise")
+    rep = h // kv_h
+
+    def local_fn(q_blk, k_blk, v_blk):
+        # (B, H, T_local, D) -> (B, H/S, T, D): scatter heads, gather seq
+        def a2a_fwd(x):
+            return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                                  tiled=True)
+        qh = a2a_fwd(q_blk)
+        kh, vh = a2a_fwd(k_blk), a2a_fwd(v_blk)
+        if rep > 1:   # GQA repeat after the wire hop (kv_h/S -> H/S heads)
+            kh = jnp.repeat(kh, rep, axis=1)
+            vh = jnp.repeat(vh, rep, axis=1)
+        out, _ = _block_attn(qh, kh, vh, causal, scale)
+        # back: scatter seq, gather heads
+        return lax.all_to_all(out.astype(q_blk.dtype), axis_name,
+                              split_axis=2, concat_axis=1, tiled=True)
+
+    return _shard_mapped_qkv(local_fn, q, k, v, mesh, axis_name)
+
+
+def ulysses_sequence_parallel_attention(q, k, v, mesh=None, axis_name="sp",
+                                        causal=True, scale=None):
+    """NDArray-level wrapper mirroring sequence_parallel_attention."""
+    from ..ndarray.ndarray import apply_nary
+    from .mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None or axis_name not in mesh.shape:
+        raise MXNetError("ulysses_sequence_parallel_attention needs an "
+                         f"ambient mesh with a '{axis_name}' axis")
+
+    def fn(qa, ka, va):
+        return ulysses_attention(qa, ka, va, mesh, axis_name, causal, scale)
+    return apply_nary(fn, [q, k, v], name="ulysses_attention")
