@@ -588,7 +588,11 @@ class HybridBlock(Block):
         except DeferredInitializationError:
             self._deferred_init_params(*args)
             params = {name: p.data() for name, p in self._reg_params.items()}
-        return self.hybrid_forward(F, *args, **params, **kwargs)
+        # per-block profiler annotation (SURVEY §5.1): inside a jit trace
+        # this names the HLO region, so mx.profiler / TensorBoard traces
+        # group ops by the Gluon block that produced them
+        with jax.named_scope(self.name or type(self).__name__):
+            return self.hybrid_forward(F, *args, **params, **kwargs)
 
     def _deferred_init_params(self, *args):
         self.infer_shape(*args)

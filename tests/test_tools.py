@@ -120,3 +120,32 @@ def test_launch_local_dist_async(tmp_path):
         capture_output=True, text=True, timeout=300, env=_cpu_env())
     assert r.returncode == 0, r.stderr + r.stdout
     assert r.stdout.count("ASYNC_OK") == 2, r.stdout + r.stderr
+
+
+def test_launch_local_dist_int8_compression(tmp_path):
+    """2-process dist_sync with EQuARX-style int8 wire compression: the
+    cross-worker sum matches within the per-block quantization bound."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_sync')\n"
+        "rank, size = kv.rank, kv.num_workers\n"
+        "assert size == 2, size\n"
+        "kv.set_gradient_compression({'type': 'int8'})\n"
+        "g = np.linspace(-1, 1, 600).astype(np.float32) * (rank + 1)\n"
+        "kv.init('w', mx.nd.zeros((600,)))\n"
+        "v = mx.nd.array(g)\n"
+        "kv.pushpull('w', v, out=v)\n"
+        "expect = np.linspace(-1, 1, 600) * 3.0\n"
+        "np.testing.assert_allclose(v.asnumpy(), expect, atol=3 / 127.0)\n"
+        "kv.barrier()\n"
+        "print('WORKER_OK', rank)\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "2", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert r.stdout.count("WORKER_OK") == 2, r.stdout + r.stderr
