@@ -52,6 +52,25 @@ class LlamaConfig:
         self.head_dim = hidden_size // num_heads
 
 
+def _rms(d, w, eps):
+    """Shared RMSNorm math (layer forward AND kv-cache decode — one
+    source so the decode parity can't drift)."""
+    import jax.numpy as jnp
+    # reduce in fp32 for bf16 inputs (standard practice)
+    d32 = d.astype(jnp.float32)
+    var = jnp.mean(d32 * d32, axis=-1, keepdims=True)
+    return (d32 / jnp.sqrt(var + eps)).astype(d.dtype) * w
+
+
+def _rot_interleaved(u, cos, sin):
+    """Shared interleaved-pair RoPE rotation; cos/sin broadcast against
+    u[..., 0::2] ((t, d/2) in the forward, (d/2,) at a decode step)."""
+    import jax.numpy as jnp
+    u1, u2 = u[..., 0::2], u[..., 1::2]
+    return jnp.stack([u1 * cos - u2 * sin,
+                      u2 * cos + u1 * sin], axis=-1).reshape(u.shape)
+
+
 class RMSNorm(HybridBlock):
     """Root-mean-square norm (no mean subtraction, no bias)."""
 
@@ -63,17 +82,10 @@ class RMSNorm(HybridBlock):
                                           init="ones")
 
     def hybrid_forward(self, F, x, weight):
-        import jax.numpy as jnp
         from ....ndarray.ndarray import apply_nary
         eps = self._eps
-
-        def fn(d, w):
-            # reduce in fp32 for bf16 inputs (standard practice)
-            d32 = d.astype(jnp.float32)
-            var = jnp.mean(d32 * d32, axis=-1, keepdims=True)
-            return (d32 / jnp.sqrt(var + eps)).astype(d.dtype) * w
-
-        return apply_nary(fn, [x, weight], name="rms_norm")
+        return apply_nary(lambda d, w: _rms(d, w, eps), [x, weight],
+                          name="rms_norm")
 
 
 def _dense(units, use_tp, mode, **kw):
@@ -118,14 +130,8 @@ class LlamaAttention(HybridBlock):
             ang = pos[:, None] * freqs[None, :]           # (t, d/2)
             cos, sin = jnp.cos(ang), jnp.sin(ang)
 
-            def rot(u):
-                u1, u2 = u[..., 0::2], u[..., 1::2]
-                r1 = u1 * cos - u2 * sin
-                r2 = u2 * cos + u1 * sin
-                return jnp.stack([r1, r2], axis=-1).reshape(u.shape)
-
-            qd = rot(qd)
-            kd = rot(kd)
+            qd = _rot_interleaved(qd, cos, sin)
+            kd = _rot_interleaved(kd, cos, sin)
             if repeat_kv:
                 # GQA: repeat kv heads (the ulysses path defers this until
                 # after its all-to-all so the wire carries only true kv)
@@ -262,6 +268,137 @@ class LlamaForCausalLM(HybridBlock):
             return d @ emb.T
 
         return apply_nary(fn, [x, w], name="tied_lm_head")
+
+    # ------------------------------------------------------------------
+    # KV-cache autoregressive decoding
+    # ------------------------------------------------------------------
+    def _decode_params(self):
+        m = self.model
+        layers = []
+        for layer in m.layers:
+            a, f = layer.attention, layer.mlp
+            layers.append((layer.input_norm.weight.data().data,
+                           a.q_proj.weight.data().data,
+                           a.k_proj.weight.data().data,
+                           a.v_proj.weight.data().data,
+                           a.o_proj.weight.data().data,
+                           layer.post_norm.weight.data().data,
+                           f.gate_proj.weight.data().data,
+                           f.up_proj.weight.data().data,
+                           f.down_proj.weight.data().data))
+        head = None if self.lm_head is None \
+            else self.lm_head.weight.data().data
+        return (m.embed.weight.data().data, m.norm.weight.data().data,
+                head, layers)
+
+    def generate(self, tokens, max_new_tokens, temperature=0.0, seed=0):
+        """Autoregressive decode with per-layer KV caches: ONE jitted
+        lax.scan over prefill+generation (static shapes — cache length is
+        prefix+max_new), a single cache-row dynamic_update_slice per layer
+        per step. The inference path the reference era served via repeated
+        full forwards; here the step is O(T) attention against the cache
+        instead of O(T^2) recompute. Greedy at temperature=0, else
+        categorical sampling from logits/temperature.
+
+        tokens: (B, T_prefix) int NDArray; returns (B, T_prefix +
+        max_new_tokens) int32 NDArray.
+        """
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from ....ndarray.ndarray import NDArray, from_jax
+
+        cfg = self.cfg
+        if cfg.tensor_parallel:
+            raise MXNetError("generate() runs the single-chip decode path; "
+                             "TP-sharded models serve through forward()")
+        toks = tokens.data.astype(jnp.int32) if isinstance(tokens, NDArray) \
+            else jnp.asarray(tokens, jnp.int32)
+        b, t_prefix = toks.shape
+        if t_prefix == 0:
+            raise MXNetError("generate() needs at least one prefix token")
+        total = t_prefix + int(max_new_tokens)
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        rep = h // kvh
+        params = self._decode_params()   # pytree: passed as a jit ARGUMENT
+        # (weights must not bake into the executable as constants), and the
+        # compiled scan is cached per shape/temperature signature
+        n_layers = len(params[3])
+        theta = cfg.rope_theta
+        temp = float(temperature)
+        eps = cfg.rms_eps
+
+        def run(params, toks, key):
+            emb, norm_w, head_w, layers = params
+            freqs = theta ** (-jnp.arange(0, d, 2) / d)
+
+            def step(carry, xs):
+                caches_k, caches_v, prev, key = carry
+                i, forced = xs
+                tok = jnp.where(i < t_prefix, forced, prev)    # (B,)
+                x = emb[tok]                                   # (B, hidden)
+                pos_mask = (jnp.arange(total) <= i)            # (total,)
+                new_k, new_v = [], []
+                for li, (in_w, qw, kw, vw, ow, po_w, gw, uw, dw) in \
+                        enumerate(layers):
+                    hh = _rms(x, in_w, eps)
+                    q = (hh @ qw.T).reshape(b, h, d)
+                    k = (hh @ kw.T).reshape(b, kvh, d)
+                    v = (hh @ vw.T).reshape(b, kvh, d)
+                    ang = i * freqs
+                    cos, sin = jnp.cos(ang), jnp.sin(ang)
+                    q = _rot_interleaved(q, cos, sin)
+                    k = _rot_interleaved(k, cos, sin)
+                    ck = lax.dynamic_update_slice(
+                        caches_k[li], k[:, :, None, :], (0, 0, i, 0))
+                    cv = lax.dynamic_update_slice(
+                        caches_v[li], v[:, :, None, :], (0, 0, i, 0))
+                    new_k.append(ck)
+                    new_v.append(cv)
+                    # GQA attention against the cache: fold q heads as
+                    # (kvh, rep) so the cache is used unrepeated
+                    qg = q.reshape(b, kvh, rep, d)
+                    scores = jnp.einsum("bgrd,bgld->bgrl", qg, ck) \
+                        / (d ** 0.5)
+                    scores = jnp.where(pos_mask[None, None, None, :],
+                                       scores.astype(jnp.float32), -jnp.inf)
+                    p = jax.nn.softmax(scores, axis=-1)
+                    o = jnp.einsum("bgrl,bgld->bgrd", p.astype(ck.dtype), cv)
+                    x = x + o.reshape(b, h * d) @ ow.T
+                    y = _rms(x, po_w, eps)
+                    x = x + (jax.nn.silu(y @ gw.T) * (y @ uw.T)) @ dw.T
+                logits = _rms(x, norm_w, eps) @ (emb.T if head_w is None
+                                                 else head_w.T)
+                if temp == 0.0:
+                    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                else:
+                    key, sub = jax.random.split(key)
+                    nxt = jax.random.categorical(
+                        sub, logits.astype(jnp.float32) / temp,
+                        axis=-1).astype(jnp.int32)
+                return (new_k, new_v, nxt, key), nxt
+
+            caches_k = [jnp.zeros((b, kvh, total, d), emb.dtype)
+                        for _ in range(n_layers)]
+            caches_v = [jnp.zeros((b, kvh, total, d), emb.dtype)
+                        for _ in range(n_layers)]
+            forced = jnp.concatenate(
+                [toks, jnp.zeros((b, total - t_prefix), jnp.int32)], axis=1)
+            init = (caches_k, caches_v, jnp.zeros((b,), jnp.int32), key)
+            _, outs = lax.scan(step, init,
+                               (jnp.arange(total), forced.T))
+            # outs[i] = next-token prediction AFTER consuming position i;
+            # generated tokens are outs[t_prefix-1 : total-1]
+            gen = outs[t_prefix - 1:total - 1].T        # (B, max_new)
+            return jnp.concatenate([toks, gen], axis=1)
+
+        sig = (b, t_prefix, total, temp)
+        cache = getattr(self, "_gen_jit", None)
+        if cache is None:
+            cache = self._gen_jit = {}
+        if sig not in cache:
+            cache[sig] = jax.jit(run)
+        return from_jax(cache[sig](params, toks, jax.random.key(seed)))
 
 
 def llama3_8b(**overrides):

@@ -120,3 +120,60 @@ def test_gqa_head_counts():
     # kv projection is num_kv_heads * head_dim wide
     attn = net.model.layers[0].attention
     assert attn.k_proj.weight.shape[0] == 1 * 8
+
+
+def test_generate_kv_cache_matches_full_forward():
+    """KV-cache lax.scan decode must reproduce the naive greedy loop
+    (full-prefix forward each step) token for token."""
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=64, tie_embeddings=True)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    prefix = nd.array(np.random.RandomState(0).randint(0, 64, (2, 5)),
+                      dtype="int32")
+    net(prefix)
+    out = net.generate(prefix, max_new_tokens=6, temperature=0.0)
+    assert out.shape == (2, 11)
+    cur = prefix.asnumpy()
+    for _ in range(6):
+        logits = net(nd.array(cur, dtype="int32")).asnumpy()
+        nxt = logits[:, -1].argmax(-1).astype(np.int32)
+        cur = np.concatenate([cur, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(out.asnumpy(), cur)
+    # prefix passthrough
+    np.testing.assert_array_equal(out.asnumpy()[:, :5], prefix.asnumpy())
+
+
+def test_generate_sampling_and_untied_head():
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+    import mxnet_tpu as mx
+    cfg = LlamaConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                      num_heads=2, num_kv_heads=2, intermediate_size=32,
+                      max_seq_len=32, tie_embeddings=False)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    prefix = nd.array([[1, 2, 3]], dtype="int32")
+    net(prefix)
+    a = net.generate(prefix, 5, temperature=1.0, seed=0).asnumpy()
+    b = net.generate(prefix, 5, temperature=1.0, seed=0).asnumpy()
+    np.testing.assert_array_equal(a, b)        # same seed reproducible
+    assert a.shape == (1, 8)
+    assert (a < 32).all() and (a >= 0).all()
+    # the seed must matter: some seed in a small set produces a different
+    # sample (vanishingly unlikely to all coincide unless seed is ignored)
+    assert any(
+        not np.array_equal(a,
+                           net.generate(prefix, 5, temperature=1.0,
+                                        seed=s_).asnumpy())
+        for s_ in (1, 2, 3))
+    # TP models are gated with a clear error
+    cfg_tp = LlamaConfig(vocab_size=32, hidden_size=16, num_layers=1,
+                         num_heads=2, num_kv_heads=2, intermediate_size=32,
+                         tensor_parallel=True)
+    net_tp = LlamaForCausalLM(cfg_tp)
+    with pytest.raises(mx.MXNetError):
+        net_tp.generate(prefix, 2)
