@@ -14,7 +14,8 @@ from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
            "BatchBegin", "BatchEnd", "StoppingHandler", "MetricHandler",
-           "LoggingHandler"]
+           "LoggingHandler", "CheckpointHandler", "EarlyStoppingHandler",
+           "ValidationHandler"]
 
 
 class TrainBegin:
@@ -102,6 +103,91 @@ class LoggingHandler(TrainBegin, TrainEnd, EpochEnd):
         msgs = [f"{name}={val:.6f}" for m in self.metrics
                 for name, val in m.get_name_value()]
         print(f"Epoch {estimator.current_epoch}: " + " ".join(msgs))
+
+
+class ValidationHandler(EpochEnd):
+    """Score val_data with eval_fn each epoch (reference
+    estimator/event_handler.py ValidationHandler)."""
+
+    def __init__(self, val_data, eval_fn, epoch_period=1):
+        self.val_data = val_data
+        self.eval_fn = eval_fn
+        self.epoch_period = epoch_period
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        if (estimator.current_epoch + 1) % self.epoch_period == 0:
+            self.eval_fn(self.val_data)
+
+
+class CheckpointHandler(TrainBegin, EpochEnd):
+    """Save parameters (and trainer states) per epoch; optionally only on
+    monitored-metric improvement (reference CheckpointHandler)."""
+
+    def __init__(self, model_dir, model_prefix="model", monitor=None,
+                 save_best=False, mode="min", max_checkpoints=5):
+        import os
+        self.model_dir = model_dir
+        self.model_prefix = model_prefix
+        self.monitor = monitor
+        self.save_best = save_best
+        self.mode = mode
+        self.max_checkpoints = max_checkpoints
+        self.best = None
+        self.saved = []
+        os.makedirs(model_dir, exist_ok=True)
+
+    def _value(self):
+        return self.monitor.get_name_value()[0][1]
+
+    def _improved(self, val):
+        if self.best is None:
+            return True
+        return val < self.best if self.mode == "min" else val > self.best
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        import os
+        path = os.path.join(
+            self.model_dir,
+            f"{self.model_prefix}-epoch{estimator.current_epoch}.params")
+        estimator.net.save_parameters(path)
+        self.saved.append(path)
+        while len(self.saved) > self.max_checkpoints:
+            old = self.saved.pop(0)
+            if os.path.exists(old):
+                os.remove(old)
+        if self.save_best and self.monitor is not None:
+            val = self._value()
+            if self._improved(val):
+                self.best = val
+                estimator.net.save_parameters(os.path.join(
+                    self.model_dir, f"{self.model_prefix}-best.params"))
+
+
+class EarlyStoppingHandler(EpochEnd):
+    """Stop when the monitored metric stops improving (reference
+    EarlyStoppingHandler): patience epochs of no improvement beyond
+    min_delta end training."""
+
+    def __init__(self, monitor, mode="min", patience=3, min_delta=0.0):
+        self.monitor = monitor
+        self.mode = mode
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = None
+        self.bad_epochs = 0
+
+    def epoch_end(self, estimator, *args, **kwargs):
+        val = self.monitor.get_name_value()[0][1]
+        improved = self.best is None or (
+            self.best - val > self.min_delta if self.mode == "min"
+            else val - self.best > self.min_delta)
+        if improved:
+            self.best = val
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+            if self.bad_epochs >= self.patience:
+                estimator.stop_training = True
 
 
 class Estimator:

@@ -197,3 +197,45 @@ def test_contrib_round3_tail():
     cs = c.count_sketch(nd.array([[3.0, 5.0]]), nd.array([0, 0]),
                         nd.array([1.0, -1.0]), out_dim=2)
     np.testing.assert_allclose(cs.asnumpy(), [[-2.0, 0.0]])
+
+
+def test_estimator_checkpoint_and_early_stopping(tmp_path):
+    """CheckpointHandler (rotation + best) and EarlyStoppingHandler
+    (reference gluon/contrib/estimator/event_handler.py)."""
+    import os
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.gluon.contrib import estimator as est
+
+    net = nn.Dense(2, in_units=4)
+    net.initialize()
+    data = [(nd.ones((8, 4)), nd.zeros((8,)))]
+    loss = gluon.loss.SoftmaxCrossEntropyLoss()
+    acc = mx.metric.Accuracy()
+    e = est.Estimator(net, loss, train_metrics=[acc])
+    ckpt = est.CheckpointHandler(str(tmp_path), monitor=acc,
+                                 save_best=True, mode="max",
+                                 max_checkpoints=2)
+    e.fit(data, epochs=4, event_handlers=[ckpt])
+    files = sorted(os.listdir(str(tmp_path)))
+    # rotation keeps 2 epoch files + the best file
+    assert sum("epoch" in f for f in files) == 2, files
+    assert any("best" in f for f in files)
+
+    # early stopping: constant metric -> no improvement -> stops after
+    # patience epochs, well before the epoch cap
+    acc2 = mx.metric.Accuracy()
+    e2 = est.Estimator(net, loss, train_metrics=[acc2])
+    stop = est.EarlyStoppingHandler(acc2, mode="max", patience=2)
+    e2.fit(data, epochs=50, event_handlers=[stop])
+    assert e2.current_epoch <= 5
+
+    # validation handler runs the eval_fn per period
+    seen = []
+    vh = est.ValidationHandler([1], eval_fn=lambda d: seen.append(1),
+                               epoch_period=2)
+    e3 = est.Estimator(net, loss, train_metrics=[mx.metric.Accuracy()])
+    e3.fit(data, epochs=4, event_handlers=[vh])
+    assert len(seen) == 2
