@@ -126,6 +126,14 @@ class TokenEmbedding(Vocabulary):
         loads in file order and warns on duplicates)."""
         vectors = {}
         vec_len = None
+        # A first line of exactly two whole numbers *may* be a fastText
+        # "count dim" header — but it may also be a legitimate 1-d vector
+        # whose token is an integer string. Hold it until end of file:
+        # it is a header iff treating it as a vector would disagree with
+        # the file's vector length, or (1-d files) its first field equals
+        # the number of following data rows, as a real count would.
+        pending_header = None
+        n_rows = 0
         for lineno, line in enumerate(file_like):
             parts = [p for p in line.rstrip().split(elem_delim) if p]
             if len(parts) < 2:
@@ -133,7 +141,9 @@ class TokenEmbedding(Vocabulary):
             token, elems = parts[0], parts[1:]
             if lineno == 0 and len(parts) == 2 and \
                     all(p.lstrip("-").isdigit() for p in parts):
-                continue   # fastText-style "count dim" header line
+                pending_header = (token, elems)
+                continue
+            n_rows += 1
             if vec_len is None:
                 vec_len = len(elems)
             elif len(elems) != vec_len:
@@ -143,6 +153,15 @@ class TokenEmbedding(Vocabulary):
             if token and token not in vectors:
                 vectors[token] = _np.asarray([float(e) for e in elems],
                                              dtype=_np.float32)
+        if pending_header is not None and vec_len in (None, 1) \
+                and int(pending_header[0]) != n_rows:
+            # not a credible header (its count field doesn't match the data
+            # rows): it was a 1-d vector whose token is an integer string
+            htok, helems = pending_header
+            vec_len = 1
+            if htok not in vectors:
+                vectors[htok] = _np.asarray([float(e) for e in helems],
+                                            dtype=_np.float32)
         if vec_len is None:
             raise MXNetError("no vectors found in the embedding file")
         self._vec_len = vec_len

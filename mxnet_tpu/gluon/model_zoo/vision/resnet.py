@@ -40,15 +40,26 @@ class SpaceToDepthStem(HybridBlock):
     stride-1 conv over the s2d grid with symmetric pad 2, valid outputs 0..111.
     """
 
-    def __init__(self, channels, **kwargs):
+    def __init__(self, channels, in_channels=3, **kwargs):
         super().__init__(**kwargs)
         self._channels = channels
+        self._in_channels = in_channels
         with self.name_scope():
-            self.weight = self.params.get("weight", shape=(channels, 3, 7, 7),
-                                          allow_deferred_init=True)
+            self.weight = self.params.get(
+                "weight", shape=(channels, in_channels, 7, 7),
+                allow_deferred_init=True)
 
     def hybrid_forward(self, F, x, weight):
-        o = self._channels
+        o, c_in = self._channels, self._in_channels
+        try:
+            if int(x.shape[1]) != c_in:
+                raise MXNetError(
+                    f"SpaceToDepthStem built for in_channels={c_in} but got "
+                    f"input with {int(x.shape[1])} channels; pass "
+                    f"in_channels= to the stem (reference stock stem defers "
+                    f"in_channels).")
+        except (TypeError, IndexError):
+            pass   # shapeless symbolic trace
         try:
             oh, ow = int(x.shape[2]) % 2, int(x.shape[3]) % 2
         except (TypeError, IndexError):   # shapeless symbolic trace
@@ -59,13 +70,13 @@ class SpaceToDepthStem(HybridBlock):
             x = F.Pad(x, mode="constant",
                       pad_width=(0, 0, 0, 0, 0, oh, 0, ow))
         xs = F.space_to_depth(x, 2)
-        # (O,3,7,7) -> pad front of each spatial dim -> (O,3,8,8); index
+        # (O,C,7,7) -> pad front of each spatial dim -> (O,C,8,8); index
         # kyp = ky+1 = 2m+dy splits as (m, dy)
         w = F.Pad(weight, mode="constant",
                   pad_width=(0, 0, 0, 0, 1, 0, 1, 0))
-        w = F.reshape(w, (o, 3, 4, 2, 4, 2))          # (O, c, m, dy, n, dx)
+        w = F.reshape(w, (o, c_in, 4, 2, 4, 2))        # (O, c, m, dy, n, dx)
         w = F.transpose(w, axes=(0, 3, 5, 1, 2, 4))    # (O, dy, dx, c, m, n)
-        w = F.reshape(w, (o, 12, 4, 4))                # ch = dy*6 + dx*3 + c
+        w = F.reshape(w, (o, 4 * c_in, 4, 4))          # ch = (dy*2+dx)*C + c
         y = F.Convolution(xs, w, None, kernel=(4, 4), stride=(1, 1),
                           pad=(2, 2), num_filter=o, no_bias=True)
         return F.slice(y, begin=(None, None, 0, 0),
@@ -192,7 +203,7 @@ class BottleneckV2(HybridBlock):
 
 class ResNetV1(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 s2d_stem=False, **kwargs):
+                 s2d_stem=False, stem_in_channels=3, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -203,6 +214,7 @@ class ResNetV1(HybridBlock):
                 # prefix keeps the param named conv0_weight so checkpoints
                 # interop between s2d_stem=True and the stock stem
                 self.features.add(SpaceToDepthStem(channels[0],
+                                                   stem_in_channels,
                                                    prefix="conv0_")
                                   if s2d_stem
                                   else nn.Conv2D(channels[0], 7, 2, 3,
@@ -237,7 +249,7 @@ class ResNetV1(HybridBlock):
 
 class ResNetV2(HybridBlock):
     def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 s2d_stem=False, **kwargs):
+                 s2d_stem=False, stem_in_channels=3, **kwargs):
         super().__init__(**kwargs)
         assert len(layers) == len(channels) - 1
         with self.name_scope():
@@ -247,6 +259,7 @@ class ResNetV2(HybridBlock):
                 self.features.add(_conv3x3(channels[0], 1, 0))
             else:
                 self.features.add(SpaceToDepthStem(channels[0],
+                                                   stem_in_channels,
                                                    prefix="conv0_")
                                   if s2d_stem
                                   else nn.Conv2D(channels[0], 7, 2, 3,

@@ -729,7 +729,10 @@ def gradientmultiplier(data, scalar=1.0):
 
 def fft(data, compute_size=128):
     """FFT along the last axis, complex output interleaved as
-    (..., 2*n) real/imag pairs (reference contrib/fft.cc layout)."""
+    (..., 2*n) real/imag pairs (reference contrib/fft.cc layout).
+
+    `compute_size` (the reference's cuFFT batching knob) is accepted for API
+    compatibility and has no effect: XLA schedules the whole batch itself."""
     def fn(d):
         c = jnp.fft.fft(d, axis=-1)
         out = jnp.stack([c.real, c.imag], axis=-1)
@@ -741,7 +744,10 @@ def fft(data, compute_size=128):
 def ifft(data, compute_size=128):
     """Inverse of contrib.fft: input (..., 2*n) interleaved real/imag,
     output (..., n) real part, scaled by n like the reference (which
-    does not normalize, leaving the caller to divide)."""
+    does not normalize, leaving the caller to divide).
+
+    `compute_size` is accepted for API compatibility and has no effect
+    under XLA (see contrib.fft)."""
     def fn(d):
         n = d.shape[-1] // 2
         pairs = d.reshape(d.shape[:-1] + (n, 2))
@@ -752,7 +758,10 @@ def ifft(data, compute_size=128):
 
 def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
     """Count sketch projection (reference contrib/count_sketch.cc):
-    out[..., h[j]] += s[j] * data[..., j]; h in [0, out_dim), s in ±1."""
+    out[..., h[j]] += s[j] * data[..., j]; h in [0, out_dim), s in ±1.
+
+    `processing_batch_size` (the reference's CUDA batching knob) is accepted
+    for API compatibility and has no effect: XLA tiles the scatter itself."""
     if out_dim is None:
         raise MXNetError("count_sketch requires out_dim")
     def fn(d, hh, ss):

@@ -462,10 +462,11 @@ class NDArray:
         return _binary(self, other, lambda a, b: b / a, name="rdiv")
 
     def __mod__(self, other):
-        return _binary(self, other, jnp.mod, name="mod")
+        # reference mod is C fmod semantics (sign of dividend), not Python %
+        return _binary(self, other, jnp.fmod, name="mod")
 
     def __rmod__(self, other):
-        return _binary(self, other, lambda a, b: b % a)
+        return _binary(self, other, lambda a, b: jnp.fmod(b, a), name="rmod")
 
     def __pow__(self, other):
         return _binary(self, other, jnp.power, name="pow")

@@ -143,7 +143,9 @@ add = _binary_factory("add", jnp.add)
 subtract = _binary_factory("subtract", jnp.subtract)
 multiply = _binary_factory("multiply", jnp.multiply)
 divide = _binary_factory("divide", jnp.divide)
-modulo = _binary_factory("modulo", jnp.mod)
+# reference elemwise_binary_op_basic.cc mod is C fmod semantics: the result
+# takes the sign of the dividend (unlike numpy/Python mod).
+modulo = _binary_factory("modulo", jnp.fmod)
 power = _binary_factory("power", jnp.power)
 maximum = _binary_factory("maximum", jnp.maximum)
 minimum = _binary_factory("minimum", jnp.minimum)
@@ -1775,7 +1777,7 @@ def _binary_factory(name, jfn):
 
 
 fmod = _binary_factory("fmod", jnp.fmod)
-mod = _binary_factory("mod", jnp.mod)
+mod = _binary_factory("mod", jnp.fmod)   # C fmod semantics, see `modulo`
 floor_divide = _binary_factory("floor_divide", jnp.floor_divide)
 true_divide = _binary_factory("true_divide", jnp.true_divide)
 outer = _binary_factory("outer", jnp.outer)

@@ -72,3 +72,26 @@ def test_fasttext_style_header_and_whitespace():
     assert emb.vec_len == 3 and len(emb) == 3
     np.testing.assert_allclose(
         emb.get_vecs_by_tokens("hello").asnumpy(), [1.0, 0.0, 0.0])
+
+
+def test_one_dim_embedding_integer_token_not_eaten_as_header():
+    """A legit 1-d embedding whose first token is an integer string must not
+    be dropped by the fastText header heuristic (advisor round-3 finding)."""
+    path = os.path.join(tempfile.mkdtemp(), "one.vec")
+    with open(path, "w") as f:
+        f.write("7 5\nfoo 2\nbar 3\n")   # '7' is a token, not a count
+    emb = text.CustomEmbedding(path)
+    assert emb.vec_len == 1 and len(emb) == 4   # unk + 3 tokens
+    np.testing.assert_allclose(emb.get_vecs_by_tokens("7").asnumpy(), [5.0])
+    # a real header (dim agrees with following rows, dim > 1) is still dropped
+    path2 = os.path.join(tempfile.mkdtemp(), "hdr.vec")
+    with open(path2, "w") as f:
+        f.write("2 2\na 1 0\nb 0 1\n")
+    emb2 = text.CustomEmbedding(path2)
+    assert emb2.vec_len == 2 and len(emb2) == 3
+    # a real header on a 1-d file: count field matches the data rows
+    path3 = os.path.join(tempfile.mkdtemp(), "hdr1d.vec")
+    with open(path3, "w") as f:
+        f.write("3 1\na 5\nb 6\nc 7\n")
+    emb3 = text.CustomEmbedding(path3)
+    assert emb3.vec_len == 1 and len(emb3) == 4   # unk + a,b,c; '3' dropped

@@ -433,6 +433,33 @@ def test_space_to_depth_stem_matches_7x7_conv():
                         rtol=1e-4, atol=1e-4)
 
 
+def test_space_to_depth_stem_non_rgb_inputs():
+    """in_channels != 3 works when declared, errors clearly when not
+    (advisor round-3 finding: the stock stem defers in_channels)."""
+    from mxnet_tpu.gluon.model_zoo.vision.resnet import SpaceToDepthStem
+    rng = np.random.RandomState(11)
+    x = nd.array(rng.randn(2, 4, 16, 16).astype(np.float32))
+    w = rng.randn(8, 4, 7, 7).astype(np.float32)
+    ref = nn.Conv2D(8, 7, 2, 3, use_bias=False, in_channels=4)
+    ref.initialize()
+    ref.weight.set_data(nd.array(w))
+    stem = SpaceToDepthStem(8, in_channels=4)
+    stem.initialize()
+    stem.weight.set_data(nd.array(w))
+    assert_almost_equal(stem(x).asnumpy(), ref(x).asnumpy(),
+                        rtol=1e-4, atol=1e-4)
+    stem3 = SpaceToDepthStem(8)
+    stem3.initialize()
+    with pytest.raises(mx.MXNetError, match="in_channels"):
+        stem3(x)
+    # threads through the model-zoo API
+    from mxnet_tpu.gluon.model_zoo import vision
+    net = vision.resnet18_v1(classes=4, s2d_stem=True, stem_in_channels=1)
+    net.initialize()
+    xg = nd.array(rng.randn(2, 1, 32, 32).astype(np.float32))
+    assert net(xg).shape == (2, 4)
+
+
 def test_hybridize_remat_gradient_parity():
     """hybridize(remat=True) must be bit-compatible with the plain jit
     path while carrying the jax.checkpoint schedule."""

@@ -330,3 +330,16 @@ def test_smooth_l1():
     x = nd.array([-2.0, -0.5, 0.5, 2.0])
     out = nd.smooth_l1(x, scalar=1.0).asnumpy()
     np.testing.assert_allclose(out, [1.5, 0.125, 0.125, 1.5], rtol=1e-5)
+
+
+def test_mod_c_fmod_semantics():
+    """Reference mod/broadcast_mod take the sign of the dividend (C fmod),
+    not numpy's sign-of-divisor (advisor round-3 finding)."""
+    a = nd.array([-5.0, 5.0, -5.0, 5.0])
+    b = nd.array([3.0, -3.0, -3.0, 3.0])
+    expected = [-2.0, 2.0, -2.0, 2.0]      # sign follows the dividend
+    np.testing.assert_allclose(nd.mod(a, b).asnumpy(), expected)
+    np.testing.assert_allclose(nd.modulo(a, b).asnumpy(), expected)
+    np.testing.assert_allclose(nd.broadcast_mod(a, b).asnumpy(), expected)
+    np.testing.assert_allclose((a % b).asnumpy(), expected)
+    np.testing.assert_allclose((-5.0 % nd.array([3.0])).asnumpy(), [-2.0])
