@@ -30,12 +30,17 @@ __version__ = "0.1.0"
 from ._dist_init import maybe_init_distributed as _maybe_init_distributed
 _maybe_init_distributed()   # must precede any jax computation
 
+from . import debug
+debug._install()            # MXTPU_DEBUG_NANS / MXTPU_ENFORCE_DETERMINISM
+                            # must configure jax before any computation
+
 from .base import MXNetError
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus
 from . import ndarray
 from . import ndarray as nd
 from .ndarray import random
+debug._seed_from_env()      # MXTPU_SEED: reproducible driver runs
 from . import autograd
 from . import initializer
 from . import initializer as init

@@ -155,17 +155,25 @@ def apply_op(fn, inputs, n_out=1, name=""):
     """
     datas = [x._data for x in inputs]
     record = is_recording() and any(_on_tape(x) for x in inputs)
-    if record:
-        outs, vjp_fn = jax.vjp(lambda *a: fn(*a), *datas)
+    try:
+        if record:
+            outs, vjp_fn = jax.vjp(lambda *a: fn(*a), *datas)
+            if n_out == 1:
+                outs = (outs,)
+            _STATE.counter += 1
+            node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name,
+                        fn=fn)
+            return outs, node
+        outs = fn(*datas)
         if n_out == 1:
             outs = (outs,)
-        _STATE.counter += 1
-        node = Node(list(inputs), vjp_fn, outs, _STATE.counter, name, fn=fn)
-        return outs, node
-    outs = fn(*datas)
-    if n_out == 1:
-        outs = (outs,)
-    return outs, None
+        return outs, None
+    except FloatingPointError as e:
+        # MXTPU_DEBUG_NANS=1: jax_debug_nans raised on the first NaN/Inf —
+        # attach the framework op name (jax only names the XLA primitive)
+        raise MXNetError(
+            f"NaN/Inf produced by op '{name or getattr(fn, '__name__', fn)}'"
+            f" (MXTPU_DEBUG_NANS): {e}") from e
 
 
 def mark_variable(arr, grad_req="write", stype=None):
@@ -258,7 +266,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             jnp.zeros(node.out_protos[k][0], node.out_protos[k][1])
             if g is None else g
             for k, g in enumerate(node.out_grads))
-        in_grads = node.vjp_fn(cotangents if node.n_out > 1 else cotangents[0])
+        try:
+            in_grads = node.vjp_fn(
+                cotangents if node.n_out > 1 else cotangents[0])
+        except FloatingPointError as e:
+            raise MXNetError(
+                f"NaN/Inf produced in backward of op "
+                f"'{node.name or node.fn}' (MXTPU_DEBUG_NANS): {e}") from e
         if not isinstance(in_grads, (list, tuple)):
             in_grads = (in_grads,)
         for inp, g in zip(node.inputs, in_grads):

@@ -1,0 +1,64 @@
+"""``mx.debug`` — NaN-debugging and determinism switches.
+
+Reference counterparts (SURVEY §5.2 race/debug tooling, §5.6 config flags):
+
+- ``MXNET_ENGINE_TYPE=NaiveEngine`` (serialize the engine to bisect races)
+  → ``MXTPU_EAGER=1`` (gluon/block.py: hybridize becomes a no-op).
+- NaN hunting (the reference had no first-class switch; users bisected with
+  NaiveEngine + per-op checks) → ``MXTPU_DEBUG_NANS=1``: enables
+  ``jax.config.jax_debug_nans`` so the first NaN/Inf produced by any
+  primitive raises immediately, and the imperative tape re-raises with the
+  *framework op name* attached (the jax error only names the primitive).
+- ``MXNET_ENFORCE_DETERMINISM=1`` (reject non-deterministic cuDNN algos,
+  python/mxnet docs/faq/env_var.md) → ``MXTPU_ENFORCE_DETERMINISM=1``:
+  XLA:TPU kernels are deterministic by construction, so the residual
+  nondeterminism lives in the *host-side* RNG plumbing. The switch makes
+  ``mx.random.seed`` also seed numpy's global RNG (samplers and image
+  augmenters draw from it), forces the DataLoader's random transforms onto
+  a single thread (thread interleaving otherwise reorders global-RNG
+  draws), and turns on ``jax_threefry_partitionable`` so device RNG streams
+  are stable across sharding layouts. Like the reference flag, it trades
+  input-pipeline throughput for bit-reproducibility.
+
+Both flags are read once at ``import mxnet_tpu`` (they must configure jax
+before any computation). ``MXTPU_SEED=<n>`` seeds the global RNG at import
+so driver-launched runs are reproducible without code changes.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["debug_nans_enabled", "determinism_enabled"]
+
+_DEBUG_NANS = os.environ.get("MXTPU_DEBUG_NANS", "") == "1"
+_DETERMINISM = os.environ.get("MXTPU_ENFORCE_DETERMINISM", "") == "1"
+
+
+def debug_nans_enabled():
+    """True when MXTPU_DEBUG_NANS=1 was set at import."""
+    return _DEBUG_NANS
+
+
+def determinism_enabled():
+    """True when MXTPU_ENFORCE_DETERMINISM=1 was set at import."""
+    return _DETERMINISM
+
+
+def _install():
+    """Apply the flags to jax config; called from mxnet_tpu/__init__."""
+    if _DEBUG_NANS or _DETERMINISM:
+        import jax
+        if _DEBUG_NANS:
+            jax.config.update("jax_debug_nans", True)
+            jax.config.update("jax_debug_infs", True)   # div-by-zero grads
+        if _DETERMINISM:
+            jax.config.update("jax_threefry_partitionable", True)
+
+
+def _seed_from_env():
+    """MXTPU_SEED: seed the global RNG at import; called from __init__
+    after mx.random exists."""
+    s = os.environ.get("MXTPU_SEED", "")
+    if s:
+        from .ndarray import random as _random
+        _random.seed(int(s))

@@ -172,7 +172,13 @@ class DataLoader:
         self._batchify_fn = batchify_fn or default_batchify_fn
 
     def __iter__(self):
-        if self._num_workers == 0:
+        from ... import debug as _debug
+        if self._num_workers == 0 or _debug.determinism_enabled():
+            # MXTPU_ENFORCE_DETERMINISM: random transforms draw from the
+            # global numpy RNG; worker-thread interleaving would reorder the
+            # draws, so the pipeline runs synchronously (throughput for
+            # reproducibility, like the reference's ENFORCE_DETERMINISM
+            # rejecting fast non-deterministic cuDNN algos)
             for batch in self._batch_sampler:
                 yield self._batchify_fn([self._dataset[i] for i in batch])
             return

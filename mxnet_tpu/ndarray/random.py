@@ -37,6 +37,12 @@ _STATE = _RandState()
 def seed(seed_state, ctx="all"):
     """mx.random.seed — reference python/mxnet/random.py."""
     _STATE.key = jax.random.key(int(seed_state))
+    from .. import debug as _debug
+    if _debug.determinism_enabled():
+        # samplers and image augmenters draw from numpy's global RNG; under
+        # MXTPU_ENFORCE_DETERMINISM one seed pins the whole input pipeline
+        import numpy as _onp
+        _onp.random.seed(int(seed_state) % (2 ** 32))
 
 
 def next_key():
