@@ -170,7 +170,12 @@ def apply_op(fn, inputs, n_out=1, name=""):
         return outs, None
     except FloatingPointError as e:
         # MXTPU_DEBUG_NANS=1: jax_debug_nans raised on the first NaN/Inf —
-        # attach the framework op name (jax only names the XLA primitive)
+        # attach the framework op name (jax only names the XLA primitive).
+        # If the user enabled jax debug_nans themselves, leave the exception
+        # type alone so their `except FloatingPointError` handlers still work.
+        from . import debug as _debug
+        if not _debug.debug_nans_enabled():
+            raise
         raise MXNetError(
             f"NaN/Inf produced by op '{name or getattr(fn, '__name__', fn)}'"
             f" (MXTPU_DEBUG_NANS): {e}") from e
@@ -270,6 +275,9 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             in_grads = node.vjp_fn(
                 cotangents if node.n_out > 1 else cotangents[0])
         except FloatingPointError as e:
+            from . import debug as _debug
+            if not _debug.debug_nans_enabled():
+                raise
             raise MXNetError(
                 f"NaN/Inf produced in backward of op "
                 f"'{node.name or node.fn}' (MXTPU_DEBUG_NANS): {e}") from e

@@ -31,12 +31,17 @@ import os
 __all__ = ["debug_nans_enabled", "determinism_enabled"]
 
 _DEBUG_NANS = os.environ.get("MXTPU_DEBUG_NANS", "") == "1"
+# Separate switch: legitimate models carry intentional -inf (attention
+# masks, beam-search seeds, max-reduce inits), so inf-checking would
+# false-positive on healthy forwards and must be opted into.
+_DEBUG_INFS = os.environ.get("MXTPU_DEBUG_INFS", "") == "1"
 _DETERMINISM = os.environ.get("MXTPU_ENFORCE_DETERMINISM", "") == "1"
 
 
 def debug_nans_enabled():
-    """True when MXTPU_DEBUG_NANS=1 was set at import."""
-    return _DEBUG_NANS
+    """True when MXTPU_DEBUG_NANS=1 or MXTPU_DEBUG_INFS=1 was set at
+    import (either one routes tape errors through the op-naming path)."""
+    return _DEBUG_NANS or _DEBUG_INFS
 
 
 def determinism_enabled():
@@ -46,10 +51,11 @@ def determinism_enabled():
 
 def _install():
     """Apply the flags to jax config; called from mxnet_tpu/__init__."""
-    if _DEBUG_NANS or _DETERMINISM:
+    if _DEBUG_NANS or _DEBUG_INFS or _DETERMINISM:
         import jax
         if _DEBUG_NANS:
             jax.config.update("jax_debug_nans", True)
+        if _DEBUG_INFS:
             jax.config.update("jax_debug_infs", True)   # div-by-zero grads
         if _DETERMINISM:
             jax.config.update("jax_threefry_partitionable", True)

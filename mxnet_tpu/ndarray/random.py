@@ -41,8 +41,7 @@ def seed(seed_state, ctx="all"):
     if _debug.determinism_enabled():
         # samplers and image augmenters draw from numpy's global RNG; under
         # MXTPU_ENFORCE_DETERMINISM one seed pins the whole input pipeline
-        import numpy as _onp
-        _onp.random.seed(int(seed_state) % (2 ** 32))
+        _np.random.seed(int(seed_state) % (2 ** 32))
 
 
 def next_key():

@@ -11,7 +11,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def _env(**extra):
-    env = dict(os.environ)
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith(("MXTPU_", "JAX_DEBUG"))}
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.pathsep.join(
         [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
@@ -37,7 +38,9 @@ def test_debug_nans_names_forward_op():
 
 
 def test_debug_nans_names_backward_op():
-    # forward is finite, backward of sqrt at 0 is inf -> must name the op
+    # forward is finite, backward of sqrt at 0 is inf -> must name the op.
+    # inf-checking is a separate opt-in (models carry intentional -inf in
+    # attention masks), hence MXTPU_DEBUG_INFS here.
     r = _run(
         "import mxnet_tpu as mx\n"
         "from mxnet_tpu import nd, autograd\n"
@@ -45,7 +48,7 @@ def test_debug_nans_names_backward_op():
         "with autograd.record():\n"
         "    y = nd.sqrt(x)\n"
         "y.backward()\n",
-        MXTPU_DEBUG_NANS="1")
+        MXTPU_DEBUG_INFS="1")
     assert r.returncode != 0
     assert "MXNetError" in r.stderr
     assert "sqrt" in r.stderr and "MXTPU_DEBUG_NANS" in r.stderr
@@ -126,3 +129,18 @@ def test_mxtpu_seed_env_seeds_global_rng():
         r1.stderr + r2.stderr + r3.stderr
     assert r1.stdout == r2.stdout
     assert r1.stdout != r3.stdout
+
+
+def test_debug_nans_tolerates_intentional_neg_inf():
+    # attention masking uses -inf; NaN-mode alone must not flag it
+    r = _run(
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import jax.numpy as jnp\n"
+        "s = nd.array([[1.0, 2.0], [3.0, 4.0]])\n"
+        "m = nd.array([[1.0, 0.0], [1.0, 1.0]])\n"
+        "masked = nd.where(m, s, nd.full((2, 2), -jnp.inf))\n"
+        "out = nd.softmax(masked).asnumpy()\n"
+        "assert out[0, 1] == 0.0\n",
+        MXTPU_DEBUG_NANS="1")
+    assert r.returncode == 0, r.stderr
