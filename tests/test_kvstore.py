@@ -143,3 +143,163 @@ def test_compression_rejects_unknown_params():
         kv.set_gradient_compression({"type": "int8", "threshold": 0.1})
     with pytest.raises(mx.MXNetError):
         kv.set_gradient_compression({"type": "2bit", "block": 64})
+
+
+def _mesh8(axis="dp"):
+    import jax
+    devs = np.array(jax.devices()[:8])
+    from jax.sharding import Mesh
+    return Mesh(devs, (axis,))
+
+
+def test_tpu_sync_traced_push_lowers_to_psum():
+    """VERDICT r3 #9: a traced push through the tpu_sync facade must stay
+    in-graph as a psum over the mesh data axis — assert on the jaxpr and
+    on executed numerics (every shard sees the cross-device sum)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    mesh = _mesh8()
+    kv = mx.kv.create("tpu_sync")
+    kv.init(3, nd.zeros((4,)))
+
+    def step(g):
+        gn = NDArray(g[0])          # shard-local (1,4) -> (4,)
+        kv.push(3, gn)
+        out = NDArray(jnp.zeros((4,), jnp.float32))
+        kv.pull(3, out=out)
+        return out.data[None, :]
+
+    f = shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    jaxpr = str(jax.make_jaxpr(f)(x))
+    assert "psum" in jaxpr
+    y = np.asarray(jax.jit(f)(x))
+    expect = np.asarray(x).sum(axis=0)
+    for shard in y:
+        np.testing.assert_allclose(shard, expect, rtol=1e-6)
+
+
+def test_dist_tpu_sync_traced_push_stays_in_graph():
+    """VERDICT r3 #4b: pushpull inside a jitted step must not take the
+    host-mediated bucketed-allreduce (device_put/D2H per bucket). Tracing
+    succeeding is itself the no-host-sync proof (np.asarray on a tracer
+    raises); also assert the collective is in the lowered jaxpr."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    mesh = _mesh8()
+    kv = mx.kv.create("dist_tpu_sync")
+    kv.init(7, nd.zeros((2,)))
+
+    def step(g):
+        gn = NDArray(g[0])
+        kv.pushpull(7, gn, out=gn)
+        return gn.data[None, :]
+
+    f = shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    x = jnp.ones((8, 2), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(f)(x))
+    assert "psum" in jaxpr
+    y = np.asarray(jax.jit(f)(x))
+    np.testing.assert_allclose(y, np.full((8, 2), 8.0), rtol=1e-6)
+
+
+def test_tpu_sync_traced_push_rejects_updater():
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    mesh = _mesh8()
+    kv = mx.kv.create("tpu_sync")
+    kv.init(1, nd.zeros((2,)))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))
+
+    def step(g):
+        gn = NDArray(g[0])
+        kv.push(1, gn)
+        return g
+
+    f = shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    with pytest.raises(mx.MXNetError, match="update-on-kvstore"):
+        import jax
+        jax.make_jaxpr(f)(jnp.ones((8, 2), jnp.float32))
+
+
+def test_tpu_sync_traced_mixed_pull_and_stale_scrub():
+    """Review findings: mixed traced/eager pulls route per key; stale
+    tracers from an aborted trace never leak into eager pulls."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    mesh = _mesh8()
+    kv = mx.kv.create("tpu_sync")
+    kv.init(1, nd.array([10.0, 20.0]))
+    kv.init(2, nd.array([5.0, 6.0]))
+
+    def step(g):
+        gn = NDArray(g[0])
+        kv.push(1, gn)
+        o1 = NDArray(jnp.zeros((2,), jnp.float32))
+        o2 = nd.zeros((2,))
+        kv.pull([1, 2], out=[o1, o2])    # key 2 was never pushed traced
+        return (o1.data + o2.data)[None, :]
+
+    f = shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    y = np.asarray(jax.jit(f)(jnp.ones((8, 2), jnp.float32)))
+    np.testing.assert_allclose(y, np.full((8, 2), 8.0) + [5.0, 6.0])
+
+    # aborted trace: push happens, pull never does -> eager pull must
+    # return the stored value, not the dead tracer
+    def bad_step(g):
+        kv.push(1, NDArray(g[0]))
+        raise ValueError("abort after push")
+
+    fb = shard_map(bad_step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    with pytest.raises(ValueError):
+        jax.make_jaxpr(fb)(jnp.ones((8, 2), jnp.float32))
+    out = nd.zeros((2,))
+    kv.pull(1, out=out)
+    np.testing.assert_allclose(out.asnumpy(), [10.0, 20.0])
+
+
+def test_tpu_sync_traced_push_guards():
+    """Uninitialized keys and unbound axis names fail fast with guidance."""
+    import jax
+    import jax.numpy as jnp
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    kv = mx.kv.create("tpu_sync")
+    kv.init(0, nd.zeros((2,)))
+    mesh = _mesh8()
+
+    def push99(g):
+        kv.push(99, NDArray(g[0]))
+        return g
+
+    f = shard_map(push99, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+    with pytest.raises(mx.MXNetError, match="not initialized"):
+        jax.make_jaxpr(f)(jnp.ones((8, 2), jnp.float32))
+
+    mesh_model = _mesh8(axis="model")    # no 'dp' axis in scope
+
+    def push0(g):
+        kv.push(0, NDArray(g[0]))
+        return g
+
+    fm = shard_map(push0, mesh=mesh_model,
+                   in_specs=P("model"), out_specs=P("model"))
+    with pytest.raises(mx.MXNetError, match="set_data_axis"):
+        jax.make_jaxpr(fm)(jnp.ones((8, 2), jnp.float32))
