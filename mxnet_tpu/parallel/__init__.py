@@ -22,7 +22,8 @@ from .mesh import (make_mesh, local_mesh, distributed_init, mesh_scope,
 from .data_parallel import DataParallelTrainer, all_reduce_gradients
 from .tensor_parallel import (shard_params_tp, tp_spec_for_param,
                               ParallelDense, ParallelEmbedding)
-from .ring_attention import ring_attention, sequence_parallel_attention
+from .ring_attention import ring_attention, ring_attention_local, \
+    sequence_parallel_attention
 from .ulysses import ulysses_attention, ulysses_sequence_parallel_attention
 from .pipeline_parallel import pipeline_apply, stack_stage_params, Pipeline
 from .moe import moe_apply, MoEDense, load_balance_loss

@@ -26,13 +26,73 @@ from jax import shard_map
 
 from ..base import MXNetError
 
-__all__ = ["pipeline_apply", "stack_stage_params", "Pipeline"]
+__all__ = ["pipeline_apply", "pipeline_local", "stack_stage_params",
+           "Pipeline"]
 
 
 def stack_stage_params(per_stage_params):
     """Stack a list of per-stage parameter pytrees (identical structure)
     into one pytree with a leading [n_stages] axis — shard it over 'pp'."""
     return jax.tree.map(lambda *xs: jnp.stack(xs), *per_stage_params)
+
+
+def pipeline_local(stage_fn, params_local, micro_all, *, axis, n_stages,
+                   n_microbatches):
+    """GPipe tick schedule for use INSIDE an existing shard_map whose mesh
+    binds ``axis`` — the composable core shared by ``pipeline_apply`` and
+    multi-axis SPMD programs that pipeline alongside dp/tp/sp (mirrors
+    ``ring_attention_local``).
+
+    ``params_local``: this stage's (already-squeezed) parameter pytree.
+    ``micro_all``: (n_microbatches, mb, ...) — replicated over ``axis``;
+    stage 0 ingests from it. Returns the finished (n_microbatches, mb, ...)
+    outputs, broadcast to every stage.
+    """
+    stage = lax.axis_index(axis)
+    mb_shape = micro_all.shape[1:]
+    n_ticks = n_microbatches + n_stages - 1
+    # initial carries must already be device-varying over the pipeline axis
+    # so the scan carry type stays fixed (shard_map vma typing); under
+    # check_vma=False pcast is unavailable and also unnecessary
+    state = _pcast_varying(jnp.zeros(mb_shape, micro_all.dtype), axis)
+    outputs = _pcast_varying(jnp.zeros_like(micro_all), axis)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (if still in range); other
+        # stages consume what arrived from the left neighbour
+        feed_idx = jnp.clip(t, 0, n_microbatches - 1)
+        inp = jnp.where(stage == 0, micro_all[feed_idx], state)
+        out = stage_fn(params_local, inp)
+        # the last stage writes its finished microbatch (t - S + 1)
+        out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
+        write = (stage == n_stages - 1) & (t >= n_stages - 1)
+        outputs = jnp.where(
+            write,
+            outputs.at[out_idx].set(out),
+            outputs)
+        # shift activations one stage to the right (ring permute; the
+        # wrap-around value into stage 0 is ignored — it re-reads
+        # micro_all)
+        state = lax.ppermute(
+            out, axis,
+            [(i, (i + 1) % n_stages) for i in range(n_stages)])
+        return (state, outputs), None
+
+    (state, outputs), _ = lax.scan(
+        tick, (state, outputs), jnp.arange(n_ticks))
+    # every device carries a full `outputs` buffer but only the last
+    # stage's is real; broadcast it (psum of masked buffer)
+    return lax.psum(
+        jnp.where(stage == n_stages - 1, outputs,
+                  jnp.zeros_like(outputs)), axis)
+
+
+def _pcast_varying(x, axis):
+    try:
+        return lax.pcast(x, axis, to="varying")
+    except Exception:  # noqa: BLE001 — check_vma=False context: no-op
+        return x
 
 
 def pipeline_apply(stage_fn, stacked_params, x, *, mesh, n_microbatches,
@@ -58,45 +118,9 @@ def pipeline_apply(stage_fn, stacked_params, x, *, mesh, n_microbatches,
     def spmd(params_s, micro_all):
         # params_s: this stage's params (leading axis sliced to 1) — squeeze
         params_s = jax.tree.map(lambda a: a[0], params_s)
-        stage = lax.axis_index(axis)
-        n_ticks = n_microbatches + n_stages - 1
-        # initial carries must already be device-varying over 'pp' so the
-        # scan carry type stays fixed (shard_map vma typing)
-        state = lax.pcast(
-            jnp.zeros((mb,) + micro_all.shape[2:], micro_all.dtype),
-            axis, to="varying")
-        outputs = lax.pcast(jnp.zeros_like(micro_all), axis, to="varying")
-
-        def tick(carry, t):
-            state, outputs = carry
-            # stage 0 ingests microbatch t (if still in range); other
-            # stages consume what arrived from the left neighbour
-            feed_idx = jnp.clip(t, 0, n_microbatches - 1)
-            inp = jnp.where(stage == 0, micro_all[feed_idx], state)
-            out = stage_fn(params_s, inp)
-            # the last stage writes its finished microbatch (t - S + 1)
-            out_idx = jnp.clip(t - (n_stages - 1), 0, n_microbatches - 1)
-            write = (stage == n_stages - 1) & (t >= n_stages - 1)
-            outputs = jnp.where(
-                write,
-                outputs.at[out_idx].set(out),
-                outputs)
-            # shift activations one stage to the right (ring permute; the
-            # wrap-around value into stage 0 is ignored — it re-reads
-            # micro_all)
-            state = lax.ppermute(
-                out, axis,
-                [(i, (i + 1) % n_stages) for i in range(n_stages)])
-            return (state, outputs), None
-
-        (state, outputs), _ = lax.scan(
-            tick, (state, outputs), jnp.arange(n_ticks))
-        # every device carries a full `outputs` buffer but only the last
-        # stage's is real; broadcast it (psum of masked buffer)
-        outputs = lax.psum(
-            jnp.where(stage == n_stages - 1, outputs,
-                      jnp.zeros_like(outputs)), axis)
-        return outputs
+        return pipeline_local(stage_fn, params_s, micro_all, axis=axis,
+                              n_stages=n_stages,
+                              n_microbatches=n_microbatches)
 
     param_spec = jax.tree.map(lambda _: P(axis), stacked_params)
     fn = shard_map(

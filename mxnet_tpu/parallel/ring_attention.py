@@ -25,7 +25,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..base import MXNetError
 
-__all__ = ["ring_attention", "sequence_parallel_attention"]
+__all__ = ["ring_attention", "ring_attention_local",
+           "sequence_parallel_attention"]
 
 
 def _block_attn(q, k, v, causal, scale):
@@ -68,34 +69,51 @@ def ring_attention(q, k, v, mesh, axis_name="sp", causal=False, scale=None):
     n = mesh.shape[axis_name]
 
     def local_fn(q_blk, k_blk, v_blk):
-        idx = lax.axis_index(axis_name)
-
-        # ring step 0 is always the DIAGONAL pair: in-block causal mask
-        # handled inside the streaming kernel itself
-        o, lse = _block_attn(q_blk, k_blk, v_blk, causal, scale)
-
-        def body(i, carry):
-            o, lse, k_cur, v_cur = carry
-            perm = [(j, (j + 1) % n) for j in range(n)]
-            k_cur = lax.ppermute(k_cur, axis_name, perm)
-            v_cur = lax.ppermute(v_cur, axis_name, perm)
-            kv_rank = (idx - i - 1) % n
-            # off-diagonal pairs are all-or-nothing under causal: past
-            # blocks attend fully, future blocks are nulled via lse=-inf
-            # (uniform compute keeps the ring SPMD)
-            o2, lse2 = _block_attn(q_blk, k_cur, v_cur, False, scale)
-            if causal:
-                lse2 = jnp.where(kv_rank < idx, lse2,
-                                 jnp.full_like(lse2, -1e30))
-            o, lse = _combine(o, lse, o2, lse2)
-            return (o, lse, k_cur, v_cur)
-
-        o, lse, _, _ = lax.fori_loop(0, n - 1, body, (o, lse, k_blk, v_blk))
-        # the logsumexp weights are f32; keep the caller's dtype (bf16
-        # AMP long-context is exactly this kernel's use case)
-        return o.astype(q_blk.dtype)
+        return ring_attention_local(q_blk, k_blk, v_blk, axis_name, n,
+                                    causal=causal, scale=scale)
 
     return _shard_mapped_qkv(local_fn, q, k, v, mesh, axis_name)
+
+
+def ring_attention_local(q_blk, k_blk, v_blk, axis_name, n_shards,
+                         causal=False, scale=None):
+    """Ring-attention body for use INSIDE an existing shard_map whose mesh
+    binds ``axis_name`` — this is what makes CP composable with dp/tp/pp in
+    one SPMD program (e.g. a pipelined stage function that is itself inside
+    a dp x tp x sp x pp shard_map). ``ring_attention`` wraps it in its own
+    shard_map for standalone use.
+
+    q/k/v blocks: (B, H_local, T_local, D) — this device's sequence shard.
+    """
+    if scale is None:
+        scale = 1.0 / (q_blk.shape[-1] ** 0.5)
+    n = n_shards
+    idx = lax.axis_index(axis_name)
+
+    # ring step 0 is always the DIAGONAL pair: in-block causal mask
+    # handled inside the streaming kernel itself
+    o, lse = _block_attn(q_blk, k_blk, v_blk, causal, scale)
+
+    def body(i, carry):
+        o, lse, k_cur, v_cur = carry
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        kv_rank = (idx - i - 1) % n
+        # off-diagonal pairs are all-or-nothing under causal: past
+        # blocks attend fully, future blocks are nulled via lse=-inf
+        # (uniform compute keeps the ring SPMD)
+        o2, lse2 = _block_attn(q_blk, k_cur, v_cur, False, scale)
+        if causal:
+            lse2 = jnp.where(kv_rank < idx, lse2,
+                             jnp.full_like(lse2, -1e30))
+        o, lse = _combine(o, lse, o2, lse2)
+        return (o, lse, k_cur, v_cur)
+
+    o, lse, _, _ = lax.fori_loop(0, n - 1, body, (o, lse, k_blk, v_blk))
+    # the logsumexp weights are f32; keep the caller's dtype (bf16
+    # AMP long-context is exactly this kernel's use case)
+    return o.astype(q_blk.dtype)
 
 
 def _shard_mapped_qkv(local_fn, q, k, v, mesh, axis_name):
