@@ -1283,9 +1283,14 @@ def ravel_multi_index(data, shape):
     (ravel.cc ravel_multi_index)."""
     shape = tuple(int(s) for s in shape)
     def fn(d):
-        strides = _np.cumprod((1,) + shape[:0:-1])[::-1].copy()
-        return jnp.sum(d.astype(jnp.int32) *
-                       jnp.asarray(strides, jnp.int32)[:, None], axis=0)
+        # index arithmetic in the widest available int: under MXTPU_INT64
+        # (jax_enable_x64) flat indices past 2^31 stay exact — the
+        # large-tensor mode's reason to exist
+        idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        strides = _np.cumprod((1,) + shape[:0:-1],
+                              dtype=_np.int64)[::-1].copy()
+        return jnp.sum(d.astype(idt) *
+                       jnp.asarray(strides, idt)[:, None], axis=0)
     return apply_nary(fn, [data], name="ravel_multi_index")
 
 
@@ -1295,7 +1300,8 @@ def unravel_index(data, shape):
     unravel_index)."""
     shape = tuple(int(s) for s in shape)
     def fn(d):
-        coords = jnp.unravel_index(d.astype(jnp.int32), shape)
+        idt = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        coords = jnp.unravel_index(d.astype(idt), shape)
         return jnp.stack(coords, axis=0)
     return apply_nary(fn, [data], name="unravel_index")
 
@@ -2209,3 +2215,808 @@ def col2im(data, output_size, kernel, stride=None, dilate=None, pad=None):
         (img,) = transpose(cols)
         return img
     return apply_nary(fn, [data], name="col2im")
+
+
+# ======================================================================
+# bitwise / integer elementwise (reference: mx.np bitwise ops +
+# src/operator/tensor/elemwise_binary_op_logic.cc family)
+# ======================================================================
+
+def _int_binary_factory(name, jfn):
+    """Integer-only binary ops: a Python-scalar rhs must NOT go through
+    _nd (which builds a float32 NDArray jax would reject) — pass it raw
+    so jax weak-types it to the lhs integer dtype."""
+    def op(lhs, rhs, **kwargs):
+        if isinstance(rhs, NDArray):
+            return apply_nary(jfn, [lhs, rhs], name=name)
+        return apply_nary(lambda a: jfn(a, rhs), [lhs], name=name)
+    op.__name__ = name
+    op.__doc__ = (f"Elementwise {name}. Reference: mx.np bitwise/int ops "
+                  "(src/operator/tensor/elemwise_binary_op_logic.cc "
+                  "family).")
+    return _register(op)
+
+
+bitwise_and = _int_binary_factory("bitwise_and", jnp.bitwise_and)
+bitwise_or = _int_binary_factory("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _int_binary_factory("bitwise_xor", jnp.bitwise_xor)
+left_shift = _int_binary_factory("left_shift", jnp.left_shift)
+right_shift = _int_binary_factory("right_shift", jnp.right_shift)
+lcm = _int_binary_factory("lcm", jnp.lcm)
+gcd = _int_binary_factory("gcd", jnp.gcd)
+
+
+@_register
+def bitwise_not(data):
+    return apply_nary(jnp.bitwise_not, [data], name="bitwise_not")
+
+
+invert = bitwise_not
+__all__.append("invert")
+
+
+@_register
+def isposinf(data):
+    return apply_nary(lambda d: jnp.isposinf(d).astype(jnp.float32), [data],
+                      name="isposinf")
+
+
+@_register
+def isneginf(data):
+    return apply_nary(lambda d: jnp.isneginf(d).astype(jnp.float32), [data],
+                      name="isneginf")
+
+
+@_register
+def nan_to_num(data, copy=True, nan=0.0, posinf=None, neginf=None):
+    return apply_nary(
+        lambda d: jnp.nan_to_num(d, nan=nan, posinf=posinf, neginf=neginf),
+        [data], name="nan_to_num")
+
+
+@_register
+def ediff1d(data, to_end=None, to_begin=None):
+    def fn(d):
+        out = jnp.diff(d.ravel())
+        parts = []
+        if to_begin is not None:
+            parts.append(jnp.atleast_1d(jnp.asarray(to_begin, out.dtype))
+                         .ravel())
+        parts.append(out)
+        if to_end is not None:
+            parts.append(jnp.atleast_1d(jnp.asarray(to_end, out.dtype))
+                         .ravel())
+        return jnp.concatenate(parts) if len(parts) > 1 else out
+    return apply_nary(fn, [data], name="ediff1d")
+
+
+@_register
+def interp(x, xp, fp, left=None, right=None):
+    def fn(a, b, c):
+        return jnp.interp(a, b, c, left=left, right=right)
+    return apply_nary(fn, [x, _nd(xp, x), _nd(fp, x)], name="interp")
+
+
+@_register
+def polyval(p, x):
+    def fn(pp, xx):
+        return jnp.polyval(pp, xx)
+    return apply_nary(fn, [_nd(p, x), x], name="polyval")
+
+
+@_register
+def divmod(lhs, rhs):   # noqa: A001 — reference op name
+    def fn(a, b):
+        q = jnp.floor_divide(a, b)
+        return q, a - q * b
+    return apply_nary(fn, [lhs, _nd(rhs, lhs)], n_out=2, name="divmod")
+
+
+@_register
+def digitize(data, bins, right=False):
+    def fn(d, b):
+        return jnp.digitize(d, b, right=right).astype(jnp.int64)
+    return apply_nary(fn, [data, _nd(bins, data)], name="digitize")
+
+
+@_register
+def searchsorted(a, v, side="left", sorter=None):
+    if sorter is not None:
+        raise MXNetError("searchsorted: sorter is not supported; "
+                         "pre-sort the input")
+    def fn(aa, vv):
+        return jnp.searchsorted(aa, vv, side=side).astype(jnp.int64)
+    return apply_nary(fn, [a, _nd(v, a)], name="searchsorted")
+
+
+# ======================================================================
+# random_pdf_* family (reference: src/operator/random/pdf_op.cc) —
+# pdf of `sample` under per-row distribution parameters. Parameter
+# arrays have shape S; samples have shape S + (n,) (dirichlet:
+# alpha S + (k,), sample S + (n, k)). All support is_log.
+# ======================================================================
+
+def _pdf_op(name, logpdf_fn, n_params, event_dims=0):
+    def op(sample, *params, is_log=False):
+        if len(params) != n_params:
+            raise MXNetError(f"{name} expects {n_params} parameter "
+                             f"array(s), got {len(params)}")
+
+        def fn(s, *ps):
+            # parameters broadcast over the trailing sample axis (for
+            # dirichlet the event axis stays rightmost: insert before it)
+            axis = -1 - event_dims
+            ps = [jnp.expand_dims(p, axis) for p in ps]
+            lp = logpdf_fn(s, *ps)
+            return lp if is_log else jnp.exp(lp)
+        return apply_nary(fn, [sample] + [_nd(p, sample) for p in params],
+                          name=name)
+    op.__name__ = name
+    op.__doc__ = (f"{name}(sample, params..., is_log=False) — reference "
+                  "src/operator/random/pdf_op.cc; grads via jax.vjp.")
+    return _register(op)
+
+
+def _lgamma(x):
+    return lax.lgamma(x.astype(jnp.float32))
+
+
+random_pdf_uniform = _pdf_op(
+    "random_pdf_uniform",
+    lambda s, low, high: jnp.where(
+        (s >= low) & (s <= high), -jnp.log(high - low), -jnp.inf), 2)
+
+random_pdf_normal = _pdf_op(
+    "random_pdf_normal",
+    lambda s, mu, sigma: -0.5 * jnp.square((s - mu) / sigma)
+    - jnp.log(sigma) - 0.5 * math.log(2 * math.pi), 2)
+
+random_pdf_gamma = _pdf_op(
+    "random_pdf_gamma",
+    lambda s, alpha, beta: (alpha - 1) * jnp.log(s) - s * beta
+    + alpha * jnp.log(beta) - _lgamma(alpha), 2)
+
+random_pdf_exponential = _pdf_op(
+    "random_pdf_exponential",
+    lambda s, lam: jnp.log(lam) - lam * s, 1)
+
+random_pdf_poisson = _pdf_op(
+    "random_pdf_poisson",
+    lambda s, lam: s * jnp.log(lam) - lam - _lgamma(s + 1), 1)
+
+random_pdf_negative_binomial = _pdf_op(
+    "random_pdf_negative_binomial",
+    lambda s, k, p: _lgamma(s + k) - _lgamma(s + 1) - _lgamma(k)
+    + k * jnp.log(p) + s * jnp.log1p(-p), 2)
+
+
+def _gnb_logpdf(s, mu, alpha):
+    # generalized negative binomial in (mu, alpha) parametrization
+    # (reference pdf_op.cc): r = 1/alpha, p = r/(r+mu)
+    r = 1.0 / alpha
+    p = r / (r + mu)
+    return (_lgamma(s + r) - _lgamma(s + 1) - _lgamma(r)
+            + r * jnp.log(p) + s * jnp.log1p(-p))
+
+
+random_pdf_generalized_negative_binomial = _pdf_op(
+    "random_pdf_generalized_negative_binomial", _gnb_logpdf, 2)
+
+
+def _dirichlet_logpdf(s, alpha):
+    # s: (..., n, k), alpha broadcast (..., 1, k)
+    return (jnp.sum((alpha - 1) * jnp.log(s), axis=-1)
+            + _lgamma(jnp.sum(alpha, axis=-1))
+            - jnp.sum(_lgamma(alpha), axis=-1))
+
+
+random_pdf_dirichlet = _pdf_op(
+    "random_pdf_dirichlet", _dirichlet_logpdf, 1, event_dims=1)
+
+
+# ======================================================================
+# optimizer update-op tail (reference: src/operator/optimizer_op.cc) —
+# raw op-level entry points mirroring the fused kernels Optimizer uses.
+# All mutate `weight` (and state) in place and return the weight handle,
+# matching the reference's out=weight convention.
+# ======================================================================
+
+def _prep_grad(g, w, wd, rescale_grad, clip_gradient):
+    g = g * rescale_grad
+    if clip_gradient is not None and clip_gradient > 0:
+        g = jnp.clip(g, -clip_gradient, clip_gradient)
+    return g + wd * w
+
+
+@_register
+def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
+                   clip_gradient=-1.0, out=None):
+    def fn(w, g):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return (1 - lr * wd) * w - lr * jnp.sign(g)
+    new_w = apply_nary(fn, [weight, grad], name="signsgd_update")
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def signum_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                  rescale_grad=1.0, clip_gradient=-1.0, wd_lh=0.0, out=None):
+    def fn(w, g, m):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        m_new = momentum * m - (1 - momentum) * g
+        return ((1 - lr * wd_lh) * w + lr * jnp.sign(m_new), m_new)
+    new_w, new_m = apply_nary(fn, [weight, grad, mom], n_out=2,
+                              name="signum_update")
+    mom._set_data(new_m._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def rmsprop_update(weight, grad, n, lr, gamma1=0.95, epsilon=1e-8, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, clip_weights=-1.0,
+                   out=None):
+    def fn(w, g, nn_):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        n_new = gamma1 * nn_ + (1 - gamma1) * jnp.square(g)
+        w_new = w - lr * g / (jnp.sqrt(n_new) + epsilon)
+        if clip_weights > 0:
+            w_new = jnp.clip(w_new, -clip_weights, clip_weights)
+        return (w_new, n_new)
+    new_w, new_n = apply_nary(fn, [weight, grad, n], n_out=2,
+                              name="rmsprop_update")
+    n._set_data(new_n._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def rmspropalex_update(weight, grad, n, g, delta, lr, gamma1=0.95,
+                       gamma2=0.9, epsilon=1e-8, wd=0.0, rescale_grad=1.0,
+                       clip_gradient=-1.0, clip_weights=-1.0, out=None):
+    """RMSProp with the Alex Graves centered variant + momentum delta."""
+    def fn(w, gr, nn_, gm, dl):
+        gr = _prep_grad(gr, w, wd, rescale_grad, clip_gradient)
+        n_new = gamma1 * nn_ + (1 - gamma1) * jnp.square(gr)
+        g_new = gamma1 * gm + (1 - gamma1) * gr
+        d_new = gamma2 * dl - lr * gr / jnp.sqrt(
+            n_new - jnp.square(g_new) + epsilon)
+        w_new = w + d_new
+        if clip_weights > 0:
+            w_new = jnp.clip(w_new, -clip_weights, clip_weights)
+        return (w_new, n_new, g_new, d_new)
+    new_w, new_n, new_g, new_d = apply_nary(
+        fn, [weight, grad, n, g, delta], n_out=4, name="rmspropalex_update")
+    n._set_data(new_n._data)
+    g._set_data(new_g._data)
+    delta._set_data(new_d._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
+                rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def fn(w, g, zz, nn_):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        n_new = nn_ + jnp.square(g)
+        sigma = (jnp.sqrt(n_new) - jnp.sqrt(nn_)) / lr
+        z_new = zz + g - sigma * w
+        w_new = -(z_new - jnp.sign(z_new) * lamda1) / \
+            ((beta + jnp.sqrt(n_new)) / lr + wd)
+        w_new = jnp.where(jnp.abs(z_new) <= lamda1,
+                          jnp.zeros_like(w_new), w_new)
+        return (w_new, z_new, n_new)
+    new_w, new_z, new_n = apply_nary(fn, [weight, grad, z, n], n_out=3,
+                                     name="ftrl_update")
+    z._set_data(new_z._data)
+    n._set_data(new_n._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def adagrad_update(weight, grad, history, lr, epsilon=1e-7, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def fn(w, g, h):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        h_new = h + jnp.square(g)
+        return (w - lr * g / (jnp.sqrt(h_new) + epsilon), h_new)
+    new_w, new_h = apply_nary(fn, [weight, grad, history], n_out=2,
+                              name="adagrad_update")
+    history._set_data(new_h._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def nag_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
+                   rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def fn(w, g, m):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        m_new = momentum * m + g
+        return (w - lr * (g + momentum * m_new), m_new)
+    new_w, new_m = apply_nary(fn, [weight, grad, mom], n_out=2,
+                              name="nag_mom_update")
+    mom._set_data(new_m._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
+                epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
+                out=None):
+    def fn(w, g, dd, vv, zz):
+        g = g * rescale_grad
+        if clip_grad > 0:
+            g = jnp.clip(g, -clip_grad, clip_grad)
+        g = g + wd * w
+        v_new = beta2 * vv + (1 - beta2) * jnp.square(g)
+        d_new = (1 - beta1 ** t) / lr * (
+            jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+        sigma = d_new - beta1 * dd
+        z_new = beta1 * zz + (1 - beta1) * g - sigma * w
+        return (-z_new / d_new, d_new, v_new, z_new)
+    new_w, new_d, new_v, new_z = apply_nary(
+        fn, [weight, grad, d, v, z], n_out=4, name="ftml_update")
+    d._set_data(new_d._data)
+    v._set_data(new_v._data)
+    z._set_data(new_z._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def adamax_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
+                  epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                  out=None):
+    """lr is expected pre-bias-corrected (lr_t = lr / (1 - beta1^t)),
+    matching the reference op contract."""
+    def fn(w, g, m, u):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g
+        u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+        return (w - lr * m_new / (u_new + epsilon), m_new, u_new)
+    new_w, new_m, new_u = apply_nary(fn, [weight, grad, mean, var], n_out=3,
+                                     name="adamax_update")
+    mean._set_data(new_m._data)
+    var._set_data(new_u._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def nadam_update(weight, grad, mean, var, lr, t, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, wd=0.0,
+                 rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    """Nesterov Adam (reference python optimizer.Nadam semantics). The
+    bias correction uses the CUMULATIVE momentum-schedule product
+    m_schedule = prod_i mu_i, not just the current step's mu_t; t is a
+    static Python int so the product is a tiny host-side loop."""
+    mus = [beta1 * (1 - 0.5 * 0.96 ** (i * schedule_decay))
+           for i in range(1, t + 2)]
+    m_schedule = float(_np.prod(mus[:t]))          # prod mu_1..mu_t
+    m_schedule_next = m_schedule * mus[t]          # * mu_{t+1}
+
+    def fn(w, g, m, v):
+        g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g
+        v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+        g_hat = g / (1 - m_schedule)
+        m_hat = m_new / (1 - m_schedule_next)
+        v_hat = v_new / (1 - beta2 ** t)
+        m_bar = (1 - mus[t - 1]) * g_hat + mus[t] * m_hat
+        return (w - lr * m_bar / (jnp.sqrt(v_hat) + epsilon), m_new, v_new)
+    new_w, new_m, new_v = apply_nary(fn, [weight, grad, mean, var], n_out=3,
+                                     name="nadam_update")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def lamb_update_phase1(weight, grad, mean, var, t, beta1=0.9, beta2=0.999,
+                       epsilon=1e-6, bias_correction=True, wd=0.0,
+                       rescale_grad=1.0, clip_gradient=-1.0):
+    """Phase 1 of the two-phase LAMB update: returns the raw layer update
+    direction g' (the trust-ratio scaling happens in phase 2). Mutates
+    mean/var in place like the reference op."""
+    def fn(w, g, m, v):
+        g = g * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g
+        v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+        if bias_correction:
+            m_hat = m_new / (1 - beta1 ** t)
+            v_hat = v_new / (1 - beta2 ** t)
+        else:
+            m_hat, v_hat = m_new, v_new
+        return (m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w, m_new, v_new)
+    g_out, new_m, new_v = apply_nary(fn, [weight, grad, mean, var], n_out=3,
+                                     name="lamb_update_phase1")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    return g_out
+
+
+@_register
+def lamb_update_phase2(weight, g, r1, r2, lr, lower_bound=-1.0,
+                       upper_bound=-1.0, out=None):
+    """Phase 2: apply the trust ratio r1/r2 (weight norm / update norm);
+    a zero norm on either side means ratio 1 (reference semantics)."""
+    def fn(w, gg, rr1, rr2):
+        rr1 = rr1.reshape(())
+        rr2 = rr2.reshape(())
+        if lower_bound > 0:
+            rr1 = jnp.maximum(rr1, lower_bound)
+        if upper_bound > 0:
+            rr1 = jnp.minimum(rr1, upper_bound)
+        ratio = jnp.where((rr1 > 0) & (rr2 > 0), rr1 / rr2, 1.0)
+        return w - lr * ratio * gg
+    new_w = apply_nary(fn, [weight, g, _nd(r1, weight), _nd(r2, weight)],
+                       name="lamb_update_phase2")
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def mp_sgd_update(weight, grad, weight32, lr, wd=0.0, rescale_grad=1.0,
+                  clip_gradient=-1.0, out=None):
+    """Mixed-precision SGD: the master fp32 copy carries the update; the
+    low-precision weight is the cast of it (reference mp_sgd_update)."""
+    def fn(w, g, w32):
+        g = _prep_grad(g.astype(jnp.float32), w32, wd, rescale_grad,
+                       clip_gradient)
+        w32_new = w32 - lr * g
+        return (w32_new.astype(w.dtype), w32_new)
+    new_w, new_w32 = apply_nary(fn, [weight, grad, weight32], n_out=2,
+                                name="mp_sgd_update")
+    weight32._set_data(new_w32._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def mp_sgd_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def fn(w, g, m, w32):
+        g = _prep_grad(g.astype(jnp.float32), w32, wd, rescale_grad,
+                       clip_gradient)
+        m_new = momentum * m - lr * g
+        w32_new = w32 + m_new
+        return (w32_new.astype(w.dtype), m_new, w32_new)
+    new_w, new_m, new_w32 = apply_nary(fn, [weight, grad, mom, weight32],
+                                       n_out=3, name="mp_sgd_mom_update")
+    mom._set_data(new_m._data)
+    weight32._set_data(new_w32._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def mp_nag_mom_update(weight, grad, mom, weight32, lr, momentum=0.0, wd=0.0,
+                      rescale_grad=1.0, clip_gradient=-1.0, out=None):
+    def fn(w, g, m, w32):
+        g = _prep_grad(g.astype(jnp.float32), w32, wd, rescale_grad,
+                       clip_gradient)
+        m_new = momentum * m + g
+        w32_new = w32 - lr * (g + momentum * m_new)
+        return (w32_new.astype(w.dtype), m_new, w32_new)
+    new_w, new_m, new_w32 = apply_nary(fn, [weight, grad, mom, weight32],
+                                       n_out=3, name="mp_nag_mom_update")
+    mom._set_data(new_m._data)
+    weight32._set_data(new_w32._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+@_register
+def mp_lamb_update_phase1(weight, grad, mean, var, weight32, t, beta1=0.9,
+                          beta2=0.999, epsilon=1e-6, bias_correction=True,
+                          wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+    """fp32-master LAMB phase 1: statistics and direction in fp32."""
+    def fn(w, g, m, v, w32):
+        g = g.astype(jnp.float32) * rescale_grad
+        if clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        m_new = beta1 * m + (1 - beta1) * g
+        v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+        if bias_correction:
+            m_hat = m_new / (1 - beta1 ** t)
+            v_hat = v_new / (1 - beta2 ** t)
+        else:
+            m_hat, v_hat = m_new, v_new
+        return (m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w32,
+                m_new, v_new)
+    g_out, new_m, new_v = apply_nary(
+        fn, [weight, grad, mean, var, weight32], n_out=3,
+        name="mp_lamb_update_phase1")
+    mean._set_data(new_m._data)
+    var._set_data(new_v._data)
+    return g_out
+
+
+@_register
+def mp_lamb_update_phase2(weight, g, r1, r2, weight32, lr, lower_bound=-1.0,
+                          upper_bound=-1.0, out=None):
+    def fn(w, gg, rr1, rr2, w32):
+        rr1 = rr1.reshape(())
+        rr2 = rr2.reshape(())
+        if lower_bound > 0:
+            rr1 = jnp.maximum(rr1, lower_bound)
+        if upper_bound > 0:
+            rr1 = jnp.minimum(rr1, upper_bound)
+        ratio = jnp.where((rr1 > 0) & (rr2 > 0), rr1 / rr2, 1.0)
+        w32_new = w32 - lr * ratio * gg
+        return (w32_new.astype(w.dtype), w32_new)
+    new_w, new_w32 = apply_nary(
+        fn, [weight, g, _nd(r1, weight), _nd(r2, weight), weight32],
+        n_out=2, name="mp_lamb_update_phase2")
+    weight32._set_data(new_w32._data)
+    target = out if out is not None else weight
+    target._set_data(new_w._data)
+    return target
+
+
+# ======================================================================
+# multi-tensor utility ops (reference: src/operator/contrib/multi_*.cc,
+# all_finite.cc — the LARS/AMP support kernels)
+# ======================================================================
+
+@_register
+def all_finite(data, init_output=True):
+    """1.0 if every element is finite (reference all_finite.cc; the AMP
+    dynamic-loss-scaler check)."""
+    return apply_nary(
+        lambda d: jnp.all(jnp.isfinite(d)).astype(jnp.float32).reshape(1),
+        [data], name="all_finite")
+
+
+@_register
+def multi_all_finite(*arrays, num_arrays=None, init_output=True):
+    if num_arrays is not None and num_arrays != len(arrays):
+        raise MXNetError(f"multi_all_finite: num_arrays {num_arrays} != "
+                         f"{len(arrays)} inputs")
+    def fn(*ds):
+        ok = jnp.ones((), jnp.bool_)
+        for d in ds:
+            ok = ok & jnp.all(jnp.isfinite(d))
+        return ok.astype(jnp.float32).reshape(1)
+    return apply_nary(fn, list(arrays), name="multi_all_finite")
+
+
+@_register
+def multi_sum_sq(*arrays, num_arrays=None):
+    """Per-array sum of squares, one fused launch (reference
+    multi_sum_sq.cc — feeds multi_lars). Returns shape (n,)."""
+    if num_arrays is not None and num_arrays != len(arrays):
+        raise MXNetError(f"multi_sum_sq: num_arrays {num_arrays} != "
+                         f"{len(arrays)} inputs")
+    def fn(*ds):
+        return jnp.stack([jnp.sum(jnp.square(d.astype(jnp.float32)))
+                          for d in ds])
+    return apply_nary(fn, list(arrays), name="multi_sum_sq")
+
+
+@_register
+def multi_lars(lrs, weights_sum_sq, grads_sum_sq, wds, eta=0.001,
+               eps=1e-8, rescale_grad=1.0):
+    """LARS trust-ratio layer-wise lr scaling (reference multi_lars.cc):
+    lr_i *= eta*||w||/(||g||*rescale + wd*||w|| + eps), identity when
+    either norm is zero."""
+    def fn(lr, wss, gss, wd):
+        wn = jnp.sqrt(wss)
+        gn = jnp.sqrt(gss) * rescale_grad
+        ratio = eta * wn / (gn + wd * wn + eps)
+        return jnp.where((wn > 0) & (gn > 0), lr * ratio, lr)
+    return apply_nary(fn, [lrs, _nd(weights_sum_sq, lrs),
+                           _nd(grads_sum_sq, lrs), _nd(wds, lrs)],
+                      name="multi_lars")
+
+
+@_register
+def amp_cast(data, dtype):
+    """AMP-inserted cast (reference src/operator/tensor/amp_cast.cc)."""
+    dt = _dtype_of(dtype)
+    return apply_nary(lambda d: d.astype(dt), [data], name="amp_cast")
+
+
+@_register
+def amp_multicast(*data, num_outputs=None, cast_narrow=False):
+    """Cast all inputs to their widest (or narrowest) floating dtype."""
+    if num_outputs is not None and num_outputs != len(data):
+        raise MXNetError(f"amp_multicast: num_outputs {num_outputs} != "
+                         f"{len(data)} inputs")
+    dts = [d.data.dtype for d in data]
+    key = (lambda t: jnp.finfo(t).bits) if not cast_narrow else \
+        (lambda t: -jnp.finfo(t).bits)
+    target = _builtins.max(dts, key=key)   # `max` is the reduction op here
+    def fn(*ds):
+        return tuple(d.astype(target) for d in ds)
+    return apply_nary(fn, list(data), n_out=len(data),
+                      name="amp_multicast")
+
+
+@_register
+def moments(data, axes=None, keepdims=False):
+    """(mean, variance) in one op (reference src/operator/nn/moments.cc)."""
+    ax = tuple(axes) if isinstance(axes, (list, tuple)) else axes
+    def fn(d):
+        mu = jnp.mean(d, axis=ax, keepdims=keepdims)
+        var = jnp.var(d, axis=ax, keepdims=keepdims)
+        return (mu, var)
+    return apply_nary(fn, [data], n_out=2, name="moments")
+
+
+# ======================================================================
+# preloaded multi-sgd (reference src/operator/contrib/preloaded_multi_sgd.cc
+# — lrs/wds live on device as tensors, one launch updates many weights)
+# ======================================================================
+
+def _preloaded_multi(name, step, n_per_weight, mutated_idx):
+    """Build a preloaded_multi_* op. All n weight-groups update in ONE
+    apply_nary dispatch (one traced graph XLA fuses into one launch) with
+    lrs/wds consumed in-graph — no per-weight host indexing or sync.
+    ``step`` maps one group's raw arrays to the new values of the arrays
+    at ``mutated_idx`` within the group."""
+    def op(*data, rescale_grad=1.0, clip_gradient=-1.0, momentum=0.0,
+           num_weights=None):
+        n = num_weights if num_weights is not None else \
+            (len(data) - 2) // n_per_weight
+        if len(data) != n * n_per_weight + 2:
+            raise MXNetError(
+                f"{name}: expected {n}*{n_per_weight}+2 arrays "
+                f"(groups + lrs + wds), got {len(data)}")
+        groups = [data[i * n_per_weight:(i + 1) * n_per_weight]
+                  for i in range(n)]
+        lrs, wds = data[-2], data[-1]
+
+        def fn(*arrs):
+            flat, lr_a, wd_a = arrs[:-2], arrs[-2], arrs[-1]
+            outs = []
+            for i in range(n):
+                grp = flat[i * n_per_weight:(i + 1) * n_per_weight]
+                outs.extend(step(grp, lr_a[i], wd_a[i], rescale_grad,
+                                 clip_gradient, momentum))
+            return tuple(outs)
+
+        flat_in = [a for grp in groups for a in grp] + [lrs, wds]
+        n_out = n * len(mutated_idx)
+        results = apply_nary(fn, flat_in, n_out=n_out, name=name)
+        if n_out == 1:
+            results = [results]
+        k = 0
+        for grp in groups:
+            for j in mutated_idx:
+                grp[j]._set_data(results[k]._data)
+                k += 1
+        return [grp[0] for grp in groups]
+    op.__name__ = name
+    op.__doc__ = (f"{name} — reference contrib/preloaded_multi_sgd.cc; "
+                  "lrs/wds are device tensors indexed per weight, the "
+                  "whole update is one fused dispatch.")
+    return _register(op)
+
+
+def _plain_sgd_step(grp, lr, wd, rescale, clip, momentum):
+    w, g = grp
+    g = _prep_grad(g, w, wd, rescale, clip)
+    return (w - lr * g,)
+
+
+def _mom_sgd_step(grp, lr, wd, rescale, clip, momentum):
+    w, g, m = grp
+    g = _prep_grad(g, w, wd, rescale, clip)
+    m_new = momentum * m - lr * g
+    return (w + m_new, m_new)
+
+
+def _mp_sgd_step(grp, lr, wd, rescale, clip, momentum):
+    w, g, w32 = grp
+    g = _prep_grad(g.astype(jnp.float32), w32, wd, rescale, clip)
+    w32_new = w32 - lr * g
+    return (w32_new.astype(w.dtype), w32_new)
+
+
+def _mp_mom_sgd_step(grp, lr, wd, rescale, clip, momentum):
+    w, g, m, w32 = grp
+    g = _prep_grad(g.astype(jnp.float32), w32, wd, rescale, clip)
+    m_new = momentum * m - lr * g
+    w32_new = w32 + m_new
+    return (w32_new.astype(w.dtype), m_new, w32_new)
+
+
+preloaded_multi_sgd_update = _preloaded_multi(
+    "preloaded_multi_sgd_update", _plain_sgd_step, 2, (0,))
+preloaded_multi_sgd_mom_update = _preloaded_multi(
+    "preloaded_multi_sgd_mom_update", _mom_sgd_step, 3, (0, 2))
+preloaded_multi_mp_sgd_update = _preloaded_multi(
+    "preloaded_multi_mp_sgd_update", _mp_sgd_step, 3, (0, 2))
+preloaded_multi_mp_sgd_mom_update = _preloaded_multi(
+    "preloaded_multi_mp_sgd_mom_update", _mp_mom_sgd_step, 4, (0, 2, 3))
+
+
+# ======================================================================
+# legacy structured ops
+# ======================================================================
+
+@_register
+def choose_element_0index(data, index, axis=1, keepdims=False):
+    """Pick one element per row by index (reference legacy op; alias of
+    pick with the row axis)."""
+    return pick(data, index, axis=axis, keepdims=keepdims)
+
+
+@_register
+def fill_element_0index(lhs, mhs, rhs):
+    """lhs[i, rhs[i]] = mhs[i] per row (reference legacy op)."""
+    def fn(l, m, r):
+        rows = jnp.arange(l.shape[0])
+        return l.at[rows, r.astype(jnp.int32)].set(m)
+    return apply_nary(fn, [lhs, _nd(mhs, lhs), _nd(rhs, lhs)],
+                      name="fill_element_0index")
+
+
+@_register
+def SpatialTransformer(data, loc, target_shape=None,
+                       transform_type="affine", sampler_type="bilinear",
+                       cudnn_off=None):
+    """Affine spatial transformer = GridGenerator + BilinearSampler
+    (reference src/operator/spatial_transformer.cc)."""
+    if transform_type != "affine" or sampler_type != "bilinear":
+        raise MXNetError("SpatialTransformer supports affine/bilinear "
+                         "(reference supports exactly these too)")
+    grid = GridGenerator(loc, transform_type="affine",
+                         target_shape=target_shape)
+    return BilinearSampler(data, grid)
+
+
+@_register
+def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
+                              momentum=0.9):
+    """Identity forward; backward adds the KL sparsity penalty gradient
+    pushing mean activation toward sparseness_target (reference
+    src/operator/identity_attach_KL_sparse_reg.cc).
+
+    ``momentum`` is accepted for API compatibility and has no effect: the
+    reference keeps a momentum-smoothed moving average of the activation
+    in auxiliary op state; this functional op has no cross-call state, so
+    rho is the current batch mean (equivalent to momentum=0)."""
+    t = sparseness_target
+
+    @jax.custom_vjp
+    def fwd(d):
+        return d
+
+    def fwd_fwd(d):
+        return d, d
+
+    def fwd_bwd(d, g):
+        rho = jnp.clip(jnp.mean(d, axis=0, keepdims=True), 1e-6, 1 - 1e-6)
+        kl_grad = penalty * (-t / rho + (1 - t) / (1 - rho))
+        return (g + jnp.broadcast_to(kl_grad, g.shape) / d.shape[0],)
+
+    fwd.defvjp(fwd_fwd, fwd_bwd)
+    return apply_nary(fwd, [data], name="IdentityAttachKLSparseReg")

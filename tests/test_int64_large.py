@@ -1,0 +1,116 @@
+"""Large-tensor (int64) semantics — the small-memory equivalent of the
+reference's tests/nightly/test_large_array.py: we cannot allocate >2^31
+elements here, but every *index-arithmetic* path that overflows int32 can
+be exercised with scalars/coordinates beyond 2^31 (reference
+MXNET_INT64_TENSOR_SIZE build flag -> MXTPU_INT64=1).
+
+MXTPU_INT64 is read at import (it flips jax_enable_x64), so each scenario
+runs in a subprocess.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(code, int64=True):
+    env = {k: v for k, v in os.environ.items()
+           if not k.startswith("MXTPU_")}
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = os.pathsep.join(
+        [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+         if p and ".axon_site" not in p] + [REPO])
+    if int64:
+        env["MXTPU_INT64"] = "1"
+    return subprocess.run([sys.executable, "-c", code], capture_output=True,
+                          text=True, timeout=300, env=env)
+
+
+pytestmark = pytest.mark.int64
+
+
+def test_int64_values_beyond_int32_roundtrip_exact():
+    r = _run(
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import numpy as np\n"
+        "v = np.array([2**40 + 7, -(2**35), 2**31], np.int64)\n"
+        "a = nd.array(v, dtype='int64')\n"
+        "assert a.dtype == np.int64, a.dtype\n"
+        "np.testing.assert_array_equal(a.asnumpy(), v)\n"
+        "s = int((a + 1).sum().asnumpy())\n"
+        "assert s == int(v.sum()) + 3, s\n"
+        "b = nd.arange(2**33, 2**33 + 4, dtype='int64')\n"
+        "np.testing.assert_array_equal(b.asnumpy(),\n"
+        "    np.arange(2**33, 2**33 + 4, dtype=np.int64))\n")
+    assert r.returncode == 0, r.stderr
+
+
+def test_int64_ravel_unravel_beyond_int32():
+    # flat index arithmetic over a shape whose product is 2^34 — the
+    # canonical large-tensor indexing overflow (reference ravel.cc paths)
+    r = _run(
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import numpy as np\n"
+        "shape = (2**17, 2**17)      # product 2^34 > int32\n"
+        "coords = nd.array(np.array([[2**16, 123], [2**16 + 1, 456]],\n"
+        "                  np.int64).T, dtype='int64')\n"
+        "flat = nd.ravel_multi_index(coords, shape=shape)\n"
+        "want = np.ravel_multi_index(\n"
+        "    np.array([[2**16, 123], [2**16 + 1, 456]], np.int64).T,\n"
+        "    shape)\n"
+        "np.testing.assert_array_equal(flat.asnumpy(), want)\n"
+        "back = nd.unravel_index(flat, shape=shape)\n"
+        "np.testing.assert_array_equal(\n"
+        "    back.asnumpy(), np.array(np.unravel_index(want, shape)))\n")
+    assert r.returncode == 0, r.stderr
+
+
+def test_int64_reductions_and_cumsum_exact():
+    r = _run(
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import numpy as np\n"
+        "a = nd.full((8,), 2**30, dtype='int64')\n"
+        "assert int(a.sum().asnumpy()) == 2**33\n"
+        "c = nd.cumsum(a)\n"
+        "assert int(c.asnumpy()[-1]) == 2**33\n"
+        "assert c.asnumpy().dtype == np.int64\n"
+        "p = nd.prod(nd.array([2**20, 2**20], dtype='int64'))\n"
+        "assert int(p.asnumpy()) == 2**40\n")
+    assert r.returncode == 0, r.stderr
+
+
+def test_int64_shape_size_arrays():
+    r = _run(
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import numpy as np\n"
+        "x = nd.zeros((3, 5))\n"
+        "assert nd.shape_array(x).asnumpy().dtype == np.int64\n"
+        "assert nd.size_array(x).asnumpy().dtype == np.int64\n"
+        "bins = nd.array([0.0, 1.0, 2.0])\n"
+        "assert nd.digitize(nd.array([0.5]), bins).asnumpy().dtype \\\n"
+        "    == np.int64\n"
+        "assert nd.searchsorted(bins, nd.array([1.5])).asnumpy().dtype \\\n"
+        "    == np.int64\n")
+    assert r.returncode == 0, r.stderr
+
+
+def test_without_flag_overflowing_values_warn():
+    r = _run(
+        "import warnings\n"
+        "import mxnet_tpu as mx\n"
+        "from mxnet_tpu import nd\n"
+        "import numpy as np\n"
+        "with warnings.catch_warnings(record=True) as w:\n"
+        "    warnings.simplefilter('always')\n"
+        "    nd.array(np.array([2**40], np.int64))\n"
+        "assert any('MXTPU_INT64' in str(x.message) for x in w), \\\n"
+        "    [str(x.message) for x in w]\n",
+        int64=False)
+    assert r.returncode == 0, r.stderr
