@@ -1,0 +1,53 @@
+"""Local model store for pretrained zoo weights.
+
+Reference: python/mxnet/gluon/model_zoo/model_store.py — get_model_file
+resolved ``<name>-<sha1-prefix>.params`` in a local root and downloaded
+from the model zoo bucket on miss. This environment has zero egress, so
+the store is strictly local: drop reference-era ``.params`` files (the
+NDARRAY_V2 reader in ndarray/utils.py parses them byte-for-byte) or
+files saved by this framework into the root and ``get_model(name,
+pretrained=True)`` picks them up.
+
+Root resolution order: explicit ``root=`` argument, ``MXTPU_MODEL_STORE``
+env var, ``~/.mxnet/models`` (the reference default, so an existing
+reference model cache is found as-is).
+"""
+from __future__ import annotations
+
+import glob
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "default_root"]
+
+
+def default_root():
+    return os.environ.get("MXTPU_MODEL_STORE",
+                          os.path.join("~", ".mxnet", "models"))
+
+
+def get_model_file(name, root=None):
+    """Resolve the ``.params`` file for zoo model ``name``.
+
+    Accepts ``<name>.params`` or the reference's hashed
+    ``<name>-<hash>.params`` (newest wins when several match). Reference
+    cache files spell width multipliers with dots (``squeezenet1.0``),
+    registry names with underscores — both are tried."""
+    import re
+    root = os.path.expanduser(root or default_root())
+    dotted = re.sub(r"(?<=\d)_(?=\d)", ".", name)
+    for cand in dict.fromkeys((name, dotted)):
+        exact = os.path.join(root, f"{cand}.params")
+        if os.path.isfile(exact):
+            return exact
+        hashed = sorted(glob.glob(os.path.join(root, f"{cand}-*.params")),
+                        key=os.path.getmtime)
+        if hashed:
+            return hashed[-1]
+    raise MXNetError(
+        f"No pretrained weights for '{name}' in model store '{root}' "
+        f"(looked for {name}.params and {name}-*.params). This build has "
+        "no network access: place a reference-era .params file (read "
+        "natively) or one saved by save_parameters() there, or pass "
+        "root=/MXTPU_MODEL_STORE.")

@@ -26,11 +26,18 @@ for _m in (bert, transformer, language_model):
             _MODELS[_name] = _fn
 
 
-def get_model(name, **kwargs):
-    """Reference: gluonnlp.model.get_model(name)."""
+def get_model(name, pretrained=False, root=None, ctx=None, **kwargs):
+    """Reference: gluonnlp.model.get_model(name, pretrained=).
+
+    ``pretrained=True`` resolves weights from the LOCAL model store
+    (model_store.get_model_file; zero-egress build, no download)."""
     if name not in _MODELS:
         from ....base import MXNetError
         raise MXNetError(
             f"Model {name!r} is not present in the NLP model zoo; "
             f"available: {sorted(_MODELS)}")
-    return _MODELS[name](**kwargs)
+    net = _MODELS[name](**kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net

@@ -42,10 +42,21 @@ def _register_models():
 _register_models()
 
 
-def get_model(name, **kwargs):
-    """Reference: model_zoo.vision.get_model(name)."""
-    name = name.lower().replace("-", "_")
+def get_model(name, pretrained=False, root=None, ctx=None, **kwargs):
+    """Reference: model_zoo.vision.get_model(name, pretrained=, root=).
+
+    ``pretrained=True`` loads weights from the LOCAL model store (see
+    model_store.get_model_file — reference-era NDARRAY_V2 ``.params``
+    files load byte-for-byte; no download in this zero-egress build).
+    ``ctx`` is accepted for API compatibility (one device context here)."""
+    # reference zoo names use dots in width multipliers (squeezenet1.0,
+    # mobilenet0.25); the registry keys are identifier-safe
+    name = name.lower().replace("-", "_").replace(".", "_")
     if name not in _MODELS:
         raise MXNetError(
             f"Model {name} is not supported. Available: {sorted(_MODELS)}")
-    return _MODELS[name](**kwargs)
+    net = _MODELS[name](**kwargs)
+    if pretrained:
+        from ..model_store import get_model_file
+        net.load_parameters(get_model_file(name, root), ctx=ctx)
+    return net

@@ -2269,9 +2269,14 @@ def isneginf(data):
 
 @_register
 def nan_to_num(data, copy=True, nan=0.0, posinf=None, neginf=None):
-    return apply_nary(
+    out = apply_nary(
         lambda d: jnp.nan_to_num(d, nan=nan, posinf=posinf, neginf=neginf),
         [data], name="nan_to_num")
+    if not copy:
+        # reference copy=False mutates the input in place
+        data._set_data(out._data)
+        return data
+    return out
 
 
 @_register
@@ -2560,10 +2565,7 @@ def ftml_update(weight, grad, d, v, z, lr, t, beta1=0.6, beta2=0.999,
                 epsilon=1e-8, wd=0.0, rescale_grad=1.0, clip_grad=-1.0,
                 out=None):
     def fn(w, g, dd, vv, zz):
-        g = g * rescale_grad
-        if clip_grad > 0:
-            g = jnp.clip(g, -clip_grad, clip_grad)
-        g = g + wd * w
+        g = _prep_grad(g, w, wd, rescale_grad, clip_grad)
         v_new = beta2 * vv + (1 - beta2) * jnp.square(g)
         d_new = (1 - beta1 ** t) / lr * (
             jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
@@ -2600,18 +2602,32 @@ def adamax_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
     return target
 
 
+_NADAM_SCHED = {}   # (beta1, schedule_decay) -> (mus, cumprods); cumprods[i]
+                    # = prod mu_1..mu_i, extended lazily as t grows
+
+
+def _nadam_schedule(beta1, schedule_decay, t):
+    mus, cum = _NADAM_SCHED.setdefault((beta1, schedule_decay),
+                                       ([None], [1.0]))
+    while len(mus) <= t + 1:
+        i = len(mus)
+        mu = beta1 * (1 - 0.5 * 0.96 ** (i * schedule_decay))
+        mus.append(mu)
+        cum.append(cum[-1] * mu)
+    return mus[t], mus[t + 1], cum[t], cum[t] * mus[t + 1]
+
+
 @_register
 def nadam_update(weight, grad, mean, var, lr, t, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, schedule_decay=0.004, wd=0.0,
                  rescale_grad=1.0, clip_gradient=-1.0, out=None):
     """Nesterov Adam (reference python optimizer.Nadam semantics). The
     bias correction uses the CUMULATIVE momentum-schedule product
-    m_schedule = prod_i mu_i, not just the current step's mu_t; t is a
-    static Python int so the product is a tiny host-side loop."""
-    mus = [beta1 * (1 - 0.5 * 0.96 ** (i * schedule_decay))
-           for i in range(1, t + 2)]
-    m_schedule = float(_np.prod(mus[:t]))          # prod mu_1..mu_t
-    m_schedule_next = m_schedule * mus[t]          # * mu_{t+1}
+    m_schedule = prod_i mu_i, not just the current step's mu_t; the
+    products are cached per (beta1, schedule_decay) and extended
+    incrementally, so step t costs O(1) host work in a training loop."""
+    mu_t, mu_tp1, m_schedule, m_schedule_next = _nadam_schedule(
+        beta1, schedule_decay, t)
 
     def fn(w, g, m, v):
         g = _prep_grad(g, w, wd, rescale_grad, clip_gradient)
@@ -2620,7 +2636,7 @@ def nadam_update(weight, grad, mean, var, lr, t, beta1=0.9, beta2=0.999,
         g_hat = g / (1 - m_schedule)
         m_hat = m_new / (1 - m_schedule_next)
         v_hat = v_new / (1 - beta2 ** t)
-        m_bar = (1 - mus[t - 1]) * g_hat + mus[t] * m_hat
+        m_bar = (1 - mu_t) * g_hat + mu_tp1 * m_hat
         return (w - lr * m_bar / (jnp.sqrt(v_hat) + epsilon), m_new, v_new)
     new_w, new_m, new_v = apply_nary(fn, [weight, grad, mean, var], n_out=3,
                                      name="nadam_update")

@@ -19,7 +19,7 @@ import numpy as _np
 from ..base import MXNetError
 from .ndarray import NDArray, array
 
-__all__ = ["save", "load", "load_frombuffer"]
+__all__ = ["save", "load", "load_frombuffer", "save_legacy"]
 
 _MAGIC = b"MXTPU001"
 
@@ -126,11 +126,13 @@ def _load_legacy(blob):
         magic = u32()
         if magic not in (_LEGACY_ND_MAGIC, _LEGACY_ND_MAGIC_V3):
             raise MXNetError(f"bad ndarray record magic {magic:#x}")
-        stype = -1
+        stype = 0
         if magic == _LEGACY_ND_MAGIC:
             stype = struct.unpack_from("<i", blob, off)[0]
             off += 4
-            if stype != -1:
+            # reference NDArrayStorageType: dense (kDefaultStorage) is 0;
+            # tolerate -1 (kUndefinedStorage) from early files of ours
+            if stype not in (0, -1):
                 raise MXNetError("sparse legacy checkpoints not supported yet")
         ndim = u32()
         shape = [struct.unpack_from("<q", blob, off + 8 * i)[0]
@@ -159,3 +161,48 @@ def _load_legacy(blob):
     if names:
         return dict(zip(names, arrays))
     return arrays
+
+
+_LEGACY_DTYPE_FLAGS = {v: k for k, v in _LEGACY_DTYPES.items()}
+
+
+def save_legacy(fname, data):
+    """Write the reference's dmlc NDArray container (NDARRAY_V2 records,
+    src/ndarray/ndarray.cc Save) so checkpoints produced here load in
+    reference MXNet — the migration path in the other direction, and the
+    generator for byte-genuine ``.params`` fixtures. Dense only."""
+    if isinstance(data, NDArray):
+        data = [data]
+    if isinstance(data, dict):
+        names = list(data.keys())
+        arrays = [data[k] for k in names]
+    else:
+        names = []
+        arrays = list(data)
+    out = bytearray()
+    out += struct.pack("<Q", _LEGACY_FILE_MAGIC)
+    out += struct.pack("<Q", 0)                    # reserved
+    out += struct.pack("<Q", len(arrays))
+    for arr in arrays:
+        np_arr = _np.ascontiguousarray(arr.asnumpy())
+        dname = str(np_arr.dtype)
+        if dname not in _LEGACY_DTYPE_FLAGS:
+            raise MXNetError(
+                f"dtype {dname} has no legacy NDARRAY_V2 encoding; cast "
+                f"to one of {sorted(_LEGACY_DTYPE_FLAGS)} first")
+        out += struct.pack("<I", _LEGACY_ND_MAGIC)
+        out += struct.pack("<i", 0)    # stype: dense (kDefaultStorage)
+        out += struct.pack("<I", np_arr.ndim)
+        for s in np_arr.shape:
+            out += struct.pack("<q", s)
+        out += struct.pack("<I", 1)                # ctx dev_type: cpu
+        out += struct.pack("<I", 0)                # ctx dev_id
+        out += struct.pack("<I", _LEGACY_DTYPE_FLAGS[dname])
+        out += np_arr.tobytes()
+    out += struct.pack("<Q", len(names))
+    for name in names:
+        b = name.encode()
+        out += struct.pack("<Q", len(b))
+        out += b
+    with open(fname, "wb") as f:
+        f.write(bytes(out))
