@@ -665,17 +665,25 @@ class KVStoreDistAsync(KVStoreLocal):
     def __init__(self):
         super().__init__()
         import os
-        from .ps_server import PSServer, PSClient, default_ps_addr
+        from .ps_server import PSServer, PSClient, ps_addrs, key_to_server
         self._rank = int(os.environ.get("MXTPU_PROCESS_ID", "0"))
         self._size = int(os.environ.get("MXTPU_NUM_PROCESSES", "1"))
-        host, port = default_ps_addr()
+        self._key_to_server = key_to_server
+        addrs = ps_addrs()
         self._server = None
-        if self._rank == 0:
-            # servers co-locate with worker 0 (launch.py -n N runs no
-            # separate server role; reference local launcher does the same)
+        if "MXTPU_PS_ADDRS" not in os.environ and self._rank == 0:
+            # no dedicated server role (launch.py without -s): one server
+            # co-locates with worker 0, reference local-launcher style
+            host, port = addrs[0]
             self._server = PSServer("0.0.0.0", port, self._size)
-            host = "127.0.0.1"
-        self._client = PSClient(host, port)
+            addrs = [("127.0.0.1", port)]
+        # one client per server; keys shard across them (ps-lite key
+        # ranges -> crc32 hash here); barriers coordinate on server 0
+        self._clients = [PSClient(h, p) for h, p in addrs]
+        self._client = self._clients[0]
+
+    def _client_for(self, key):
+        return self._clients[self._key_to_server(key, len(self._clients))]
 
     @property
     def type(self):
@@ -696,38 +704,46 @@ class KVStoreDistAsync(KVStoreLocal):
                 v = v[0]
             self._store[str(k)] = NDArray(v.data, v.context)
             if self._rank == 0:
-                self._client.init(str(k), _onp_asarray(v))
+                self._client_for(str(k)).init(str(k), _onp_asarray(v))
         # worker 0's init wins (reference InitImpl); everyone else waits
         # for it then pulls the authoritative value
         self._client.barrier()
         if self._rank != 0:
             for k in keys:
-                w = self._client.pull(str(k))
+                w = self._client_for(str(k)).pull(str(k))
                 self._store[str(k)]._set_data(jnp.asarray(w))
 
     def set_optimizer(self, optimizer):
-        # optimizer runs ON the server (update_on_kvstore) — exactly the
-        # reference flow; no local updater
+        # optimizer runs ON the servers (update_on_kvstore) — exactly the
+        # reference flow; no local updater. Every server gets the config.
         self._optimizer = optimizer
-        self._client.set_optimizer(optimizer)
+        for c in self._clients:
+            c.set_optimizer(optimizer)
 
     def push(self, key, value, priority=0):
         keys, values = self._canon(key, value)
         for k, v in zip(keys, values):
             grad = self._local_reduce(_listify(v))
-            self._client.push(str(k), _onp_asarray(grad))
+            self._client_for(str(k)).push(str(k), _onp_asarray(grad))
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = self._canon(key, out)
         for k, o in zip(keys, outs):
-            w = jnp.asarray(self._client.pull(str(k)))
+            w = jnp.asarray(self._client_for(str(k)).pull(str(k)))
             for dst in _listify(o):
                 dst._set_data(w)
 
     def push_stats(self):
-        """Applied-push counters per key (stale pushes included) — test /
-        observability hook."""
-        return self._client.stats()
+        """Applied-push counters per key (stale pushes included), merged
+        across all servers — test / observability hook."""
+        merged = {}
+        for c in self._clients:
+            merged.update(c.stats())
+        return merged
+
+    def per_server_stats(self):
+        """Per-server push counters (observability for the key sharding)."""
+        return [c.stats() for c in self._clients]
 
     def barrier(self):
         self._client.barrier()

@@ -5,9 +5,25 @@ Reference counterpart: src/kvstore/kvstore_dist_server.h (KVStoreDistServer:
 barrier — the reference's distinctive async training mode) over ps-lite's
 ZMQ van (3rdparty/ps-lite). TPU-native design keeps the split the same way:
 the XLA/ICI collectives own the synchronous in-graph path
-(KVStoreDistTPUSync), while THIS module owns asynchronous host-side state —
-a TCP server thread on worker 0's host (DCN), length-prefixed pickle frames
-standing in for ZMQ messages.
+(KVStoreDistTPUSync), while THIS module owns asynchronous host-side state.
+
+Wire format: a length-prefixed TYPED binary protocol (like ps-lite's binary
+van, NOT pickle — nothing on the wire can execute code):
+
+    frame   := u64 payload_len, payload
+    payload := u8 opcode, fields...
+    key     := u16 len, utf8 bytes
+    tensor  := u8 dtype_flag, u8 ndim, i64*ndim shape, raw LE bytes
+    text    := u32 len, utf8 bytes (JSON for optimizer conf / stats)
+
+The server-side optimizer travels as a typed JSON config (registry name +
+scalar hyper-parameters), reconstructed through mx.optimizer.create — a
+malicious peer can at worst pick a registered optimizer, not run code.
+
+Sharding: with ``launch.py -s N`` (reference ``DMLC_NUM_SERVER``), N server
+processes run this module's ``__main__``; every worker connects to all of
+them and routes each key by a deterministic hash (crc32 % N), the
+reference's key-to-server assignment role. Barriers coordinate on server 0.
 
 Async semantics preserved: each push is applied to the live table the
 moment it arrives (stale gradients included); pulls return the newest
@@ -15,22 +31,87 @@ weights; no global step barrier exists anywhere on the training path.
 """
 from __future__ import annotations
 
+import json
 import os
-import pickle
 import socket
 import struct
 import threading
 import time
+import zlib
 
 import numpy as _np
 
-__all__ = ["PSServer", "PSClient", "default_ps_addr"]
+__all__ = ["PSServer", "PSClient", "default_ps_addr", "ps_addrs",
+           "key_to_server"]
 
 _HDR = struct.Struct("<Q")
 
+# opcodes (requests)
+_OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
+    _OP_SHUTDOWN = 1, 2, 3, 4, 5, 6, 7
+# opcodes (replies)
+_OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
 
-def _send_msg(sock, obj):
-    payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+_DTYPE_FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
+                "int32": 4, "int8": 5, "int64": 6, "bool": 7,
+                "bfloat16": 8}   # the headline TPU dtype (ml_dtypes)
+_FLAG_DTYPES = {v: k for k, v in _DTYPE_FLAGS.items()}
+
+
+def _np_dtype(name):
+    if name == "bfloat16":
+        import ml_dtypes
+        return _np.dtype(ml_dtypes.bfloat16)
+    return _np.dtype(name)
+
+
+# -- frame primitives --------------------------------------------------
+
+def _pack_key(key):
+    b = str(key).encode()
+    return struct.pack("<H", len(b)) + b
+
+
+def _unpack_key(buf, off):
+    (n,) = struct.unpack_from("<H", buf, off)
+    off += 2
+    return buf[off:off + n].decode(), off + n
+
+
+def _pack_tensor(arr):
+    arr = _np.ascontiguousarray(arr)
+    dname = str(arr.dtype)
+    if dname not in _DTYPE_FLAGS:
+        raise TypeError(f"dtype {dname} not wire-encodable")
+    head = struct.pack("<BB", _DTYPE_FLAGS[dname], arr.ndim)
+    head += struct.pack(f"<{arr.ndim}q", *arr.shape) if arr.ndim else b""
+    return head + arr.tobytes()
+
+
+def _unpack_tensor(buf, off):
+    flag, ndim = struct.unpack_from("<BB", buf, off)
+    off += 2
+    shape = struct.unpack_from(f"<{ndim}q", buf, off) if ndim else ()
+    off += 8 * ndim
+    dtype = _np_dtype(_FLAG_DTYPES[flag])
+    count = int(_np.prod(shape)) if ndim else 1
+    arr = _np.frombuffer(buf, dtype=dtype, count=count,
+                         offset=off).reshape(shape)
+    return arr, off + count * dtype.itemsize
+
+
+def _pack_text(s):
+    b = s.encode()
+    return struct.pack("<I", len(b)) + b
+
+
+def _unpack_text(buf, off):
+    (n,) = struct.unpack_from("<I", buf, off)
+    off += 4
+    return buf[off:off + n].decode(), off + n
+
+
+def _send_frame(sock, payload):
     sock.sendall(_HDR.pack(len(payload)) + payload)
 
 
@@ -44,14 +125,50 @@ def _recv_exact(sock, n):
     return bytes(buf)
 
 
-def _recv_msg(sock):
+def _recv_frame(sock):
     (n,) = _HDR.unpack(_recv_exact(sock, _HDR.size))
-    return pickle.loads(_recv_exact(sock, n))
+    return _recv_exact(sock, n)
 
+
+# -- optimizer conf (typed, code-free) ---------------------------------
+
+def _serialize_optimizer_conf(opt):
+    """Registry name + JSON-scalar hyper-parameters. Raises on optimizers
+    whose config can't be expressed as data (e.g. a live lr_scheduler
+    object) — the reference shipped pickled objects here; we refuse to
+    put executable payloads on the wire."""
+    from ..base import MXNetError
+    conf = {}
+    for k, v in vars(opt).items():
+        try:
+            json.dumps(v)
+        except TypeError:
+            if k.startswith("_"):
+                continue        # runtime state, rebuilt server-side
+            raise MXNetError(
+                f"dist_async set_optimizer: attribute {k!r} of "
+                f"{type(opt).__name__} is not JSON-encodable; the binary "
+                "PS protocol ships optimizer CONFIG, not objects. Use "
+                "scalar hyper-parameters (schedulers run worker-side).")
+        else:
+            conf[k] = v
+    return json.dumps({"class": type(opt).__name__.lower(), "conf": conf})
+
+
+def _deserialize_optimizer_conf(blob):
+    from .. import optimizer as _opt
+    d = json.loads(blob)
+    opt = _opt.create(d["class"])
+    for k, v in d["conf"].items():
+        setattr(opt, k, v)
+    return opt
+
+
+# -- addressing --------------------------------------------------------
 
 def default_ps_addr():
-    """Server address: MXTPU_PS_ADDR, or the coordinator host with a fixed
-    port offset (launch.py exports MXTPU_COORDINATOR for every role)."""
+    """Single-server address: MXTPU_PS_ADDR, or the coordinator host with
+    a fixed port offset (launch.py exports MXTPU_COORDINATOR)."""
     addr = os.environ.get("MXTPU_PS_ADDR")
     if addr:
         host, port = addr.rsplit(":", 1)
@@ -61,10 +178,29 @@ def default_ps_addr():
     return host, int(port) + 1000
 
 
+def ps_addrs():
+    """All server addresses: MXTPU_PS_ADDRS="h0:p0,h1:p1,..." (exported by
+    launch.py -s N), else the single default address."""
+    multi = os.environ.get("MXTPU_PS_ADDRS")
+    if multi:
+        out = []
+        for a in multi.split(","):
+            host, port = a.strip().rsplit(":", 1)
+            out.append((host, int(port)))
+        return out
+    return [default_ps_addr()]
+
+
+def key_to_server(key, num_servers):
+    """Deterministic key -> server assignment (the ps-lite key-range
+    role). crc32, NOT hash(): PYTHONHASHSEED must not move keys."""
+    return zlib.crc32(str(key).encode()) % num_servers
+
+
 class PSServer:
-    """The server role. One instance runs (as a daemon thread pool) inside
-    worker 0's process — matching the reference's default of co-locating
-    servers with workers under ``launch.py -n N -s N`` on one host."""
+    """The server role. Runs as a daemon thread pool inside worker 0's
+    process (default single-server mode) or as a standalone process
+    (``python -m mxnet_tpu.kvstore.ps_server`` under launch.py -s N)."""
 
     def __init__(self, host, port, num_workers):
         self._table = {}          # key -> np.ndarray (the live weights)
@@ -96,16 +232,17 @@ class PSServer:
     def _serve(self, conn):
         try:
             while True:
-                msg = _recv_msg(conn)
+                frame = _recv_frame(conn)
                 try:
-                    done = self._handle(conn, msg)
+                    done = self._handle(conn, frame)
                 except (ConnectionError, OSError):
                     raise
                 except Exception as e:  # noqa: BLE001 — reply, don't die
                     # e.g. KeyError on push/pull of an uninitialized key:
                     # the worker gets a diagnosable PS error instead of a
                     # dead connection
-                    _send_msg(conn, ("err", f"{type(e).__name__}: {e}"))
+                    _send_frame(conn, bytes([_OP_ERR]) + _pack_text(
+                        f"{type(e).__name__}: {e}"))
                     done = False
                 if done:
                     return
@@ -114,21 +251,22 @@ class PSServer:
         finally:
             conn.close()
 
-    def _handle(self, conn, msg):
-        """Serve one message; returns True when the server should stop.
-        Key lookups may raise (KeyError on an uninitialized key) — the
-        caller converts that to an ("err", ...) reply."""
-        op = msg[0]
-        if op == "init":
-            _, key, value = msg
+    def _handle(self, conn, frame):
+        """Serve one frame; returns True when the server should stop."""
+        op = frame[0]
+        off = 1
+        if op == _OP_INIT:
+            key, off = _unpack_key(frame, off)
+            value, _ = _unpack_tensor(frame, off)
             with self._lock:
                 # reference InitImpl: first init wins (worker 0 inits
                 # first under launch.py ordering)
                 if key not in self._table:
                     self._table[key] = _np.array(value)
-            _send_msg(conn, ("ok",))
-        elif op == "push":
-            _, key, grad = msg
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_PUSH:
+            key, off = _unpack_key(frame, off)
+            grad, _ = _unpack_tensor(frame, off)
             with self._lock:
                 w = self._table[key]
                 if self._updater is not None:
@@ -138,22 +276,23 @@ class PSServer:
                 else:
                     w += grad
                 self._push_count[key] = self._push_count.get(key, 0) + 1
-            _send_msg(conn, ("ok",))
-        elif op == "pull":
-            _, key = msg
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_PULL:
+            key, off = _unpack_key(frame, off)
             with self._lock:
                 value = self._table[key].copy()
-            _send_msg(conn, ("ok", value))
-        elif op == "set_optimizer":
-            _, blob = msg
-            optimizer = pickle.loads(blob)
+            _send_frame(conn, bytes([_OP_OK_TENSOR]) + _pack_tensor(value))
+        elif op == _OP_SET_OPT:
+            conf, _ = _unpack_text(frame, off)
+            updater = _ServerUpdater(_deserialize_optimizer_conf(conf))
             with self._lock:
-                self._updater = _ServerUpdater(optimizer)
-            _send_msg(conn, ("ok",))
-        elif op == "stats":
+                self._updater = updater
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_STATS:
             with self._lock:
-                _send_msg(conn, ("ok", dict(self._push_count)))
-        elif op == "barrier":
+                stats = json.dumps(self._push_count)
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(stats))
+        elif op == _OP_BARRIER:
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_count += 1
@@ -164,13 +303,14 @@ class PSServer:
                 else:
                     while self._barrier_gen == gen:
                         self._barrier_cv.wait(timeout=60)
-            _send_msg(conn, ("ok",))
-        elif op == "shutdown":
-            _send_msg(conn, ("ok",))
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_SHUTDOWN:
+            _send_frame(conn, bytes([_OP_OK]))
             self._sock.close()
             return True
         else:
-            _send_msg(conn, ("err", f"unknown op {op!r}"))
+            _send_frame(conn, bytes([_OP_ERR]) + _pack_text(
+                f"unknown opcode {op}"))
         return False
 
 
@@ -183,7 +323,7 @@ class _ServerUpdater:
         self._states = {}
 
     def __call__(self, key, grad, weight):
-        from ..ndarray.ndarray import NDArray, array
+        from ..ndarray.ndarray import array
         w = array(weight)
         g = array(_np.asarray(grad))
         if key not in self._states:
@@ -193,7 +333,8 @@ class _ServerUpdater:
 
 
 class PSClient:
-    """Worker-side connection (the ps::KVWorker role)."""
+    """Worker-side connection to ONE server (the ps::KVWorker role; the
+    kvstore owns one client per server and routes by key_to_server)."""
 
     def __init__(self, host, port, retries=60):
         last = None
@@ -215,36 +356,64 @@ class PSClient:
                                   f"{last}")
         self._lock = threading.Lock()
 
-    def _rpc(self, *msg):
+    def _rpc(self, payload):
         with self._lock:
-            _send_msg(self._sock, msg)
-            resp = _recv_msg(self._sock)
-        if resp[0] != "ok":
-            raise RuntimeError(f"PS error: {resp[1:]}" )
-        return resp[1] if len(resp) > 1 else None
+            _send_frame(self._sock, payload)
+            resp = _recv_frame(self._sock)
+        op = resp[0]
+        if op == _OP_OK:
+            return None
+        if op == _OP_OK_TENSOR:
+            arr, _ = _unpack_tensor(resp, 1)
+            return arr
+        if op == _OP_OK_TEXT:
+            text, _ = _unpack_text(resp, 1)
+            return json.loads(text)
+        text, _ = _unpack_text(resp, 1)
+        raise RuntimeError(f"PS error: {text}")
 
     def init(self, key, value):
-        return self._rpc("init", key, _np.asarray(value))
+        return self._rpc(bytes([_OP_INIT]) + _pack_key(key)
+                         + _pack_tensor(_np.asarray(value)))
 
     def push(self, key, grad):
-        return self._rpc("push", key, _np.asarray(grad))
+        return self._rpc(bytes([_OP_PUSH]) + _pack_key(key)
+                         + _pack_tensor(_np.asarray(grad)))
 
     def pull(self, key):
-        return self._rpc("pull", key)
+        return self._rpc(bytes([_OP_PULL]) + _pack_key(key))
 
     def set_optimizer(self, optimizer):
-        return self._rpc("set_optimizer",
-                         pickle.dumps(optimizer,
-                                      protocol=pickle.HIGHEST_PROTOCOL))
+        return self._rpc(bytes([_OP_SET_OPT]) + _pack_text(
+            _serialize_optimizer_conf(optimizer)))
 
     def stats(self):
-        return self._rpc("stats")
+        return self._rpc(bytes([_OP_STATS]))
 
     def barrier(self):
-        return self._rpc("barrier")
+        return self._rpc(bytes([_OP_BARRIER]))
 
     def close(self):
         try:
             self._sock.close()
         except OSError:
             pass
+
+
+def _server_main():
+    """Standalone server role: ``python -m mxnet_tpu.kvstore.ps_server``
+    (spawned by launch.py -s N with MXTPU_SERVER_ID / MXTPU_PS_ADDRS /
+    MXTPU_NUM_PROCESSES in env). Serves until killed by the launcher."""
+    sid = int(os.environ.get("MXTPU_SERVER_ID", "0"))
+    addrs = ps_addrs()
+    host, port = addrs[sid]
+    num_workers = int(os.environ.get("MXTPU_NUM_PROCESSES", "1"))
+    PSServer("0.0.0.0", port, num_workers)
+    print(f"[ps_server {sid}] serving on {host}:{port} "
+          f"({num_workers} workers)", flush=True)
+    while True:
+        time.sleep(3600)
+
+
+if __name__ == "__main__":
+    _server_main()

@@ -38,8 +38,11 @@ def main():
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("-n", "--num-workers", type=int, required=True)
     ap.add_argument("-s", "--num-servers", type=int, default=0,
-                    help="accepted for reference-CLI compat; the TPU "
-                         "backend has no parameter servers")
+                    help="parameter-server processes for dist_async "
+                         "(reference DMLC_NUM_SERVER); keys shard across "
+                         "them by crc32. 0 = no server role (dist_sync "
+                         "needs none; dist_async then runs one server "
+                         "inside worker 0)")
     ap.add_argument("--launcher", choices=["local", "ssh"], default="local")
     ap.add_argument("-H", "--hostfile", default=None,
                     help="one host per line (ssh launcher)")
@@ -62,9 +65,25 @@ def main():
         if not hosts:
             hosts = [f"host{i}" for i in range(args.num_workers)]
         coord = f"{hosts[0]}:{port}"
+        ps_env = ""
         print("# zero-egress image: run these on each host")
+        if args.num_servers > 0:
+            addrs = ",".join(
+                f"{hosts[s % len(hosts)]}:{port + 1000 + s}"
+                for s in range(args.num_servers))
+            ps_env = f"MXTPU_PS_ADDRS={addrs} "
+            for sid in range(args.num_servers):
+                env = (f"DMLC_ROLE=server "
+                       f"DMLC_NUM_WORKER={args.num_workers} "
+                       f"DMLC_NUM_SERVER={args.num_servers} "
+                       f"{ps_env}MXTPU_SERVER_ID={sid} "
+                       f"MXTPU_NUM_PROCESSES={args.num_workers}")
+                print(f"ssh {hosts[sid % len(hosts)]} '{env} "
+                      f"{sys.executable} -m mxnet_tpu.kvstore.ps_server'")
         for rank in range(args.num_workers):
             env = (f"DMLC_ROLE=worker DMLC_NUM_WORKER={args.num_workers} "
+                   f"DMLC_NUM_SERVER={args.num_servers} "
+                   f"{ps_env}"
                    f"DMLC_WORKER_ID={rank} "
                    f"MXTPU_COORDINATOR={coord} "
                    f"MXTPU_NUM_PROCESSES={args.num_workers} "
@@ -73,8 +92,31 @@ def main():
                   f"{' '.join(cmd)}'")
         return 0
 
+    ps_addrs = ""
+    if args.num_servers > 0:
+        ps_addrs = ",".join(f"127.0.0.1:{_free_port()}"
+                            for _ in range(args.num_servers))
+
     procs = []
     try:
+        for sid in range(args.num_servers):
+            env = dict(os.environ)
+            env.update({
+                "DMLC_ROLE": "server",
+                "DMLC_NUM_WORKER": str(args.num_workers),
+                "DMLC_NUM_SERVER": str(args.num_servers),
+                "MXTPU_PS_ADDRS": ps_addrs,
+                "MXTPU_SERVER_ID": str(sid),
+                "MXTPU_NUM_PROCESSES": str(args.num_workers),
+                "JAX_PLATFORMS": "cpu",
+            })
+            for kv in args.env:
+                k, _, v = kv.partition("=")
+                env[k] = v
+            procs.append(subprocess.Popen(
+                [sys.executable, "-m", "mxnet_tpu.kvstore.ps_server"],
+                env=env))
+        workers = []
         for rank in range(args.num_workers):
             env = dict(os.environ)
             env.update({
@@ -88,12 +130,16 @@ def main():
                 # local fake cluster runs on CPU (SURVEY.md §4 technique 3)
                 "JAX_PLATFORMS": "cpu",
             })
+            if ps_addrs:
+                env["MXTPU_PS_ADDRS"] = ps_addrs
             for kv in args.env:
                 k, _, v = kv.partition("=")
                 env[k] = v
-            procs.append(subprocess.Popen(cmd, env=env))
+            p = subprocess.Popen(cmd, env=env)
+            procs.append(p)
+            workers.append(p)
         rc = 0
-        for p in procs:
+        for p in workers:     # servers serve until torn down below
             rc = p.wait() or rc
         return rc
     finally:
