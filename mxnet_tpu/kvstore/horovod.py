@@ -1,0 +1,46 @@
+"""Horovod / BytePS adapter facades.
+
+Reference [>=1.6]: python/mxnet/kvstore/horovod.py and byteps.py — thin
+KVStore adapters that re-route push/pull onto horovod.mxnet /
+byteps.mxnet allreduce so `--kv-store horovod` scripts run unchanged.
+
+On TPU there is no Horovod or BytePS daemon to adapt to: XLA collectives
+over ICI/DCN already ARE the allreduce engine both of those libraries
+exist to provide. The facades therefore map onto the synchronous
+in-graph store (KVStoreDistTPUSync): `mx.kv.create('horovod')` and
+`mx.kv.create('byteps')` keep working for migrating scripts, with the
+same push=allreduce / pull=read semantics the adapters had — rank/size
+come from jax.distributed instead of hvd.rank()/bps.rank().
+"""
+from __future__ import annotations
+
+from .kvstore import KVStoreDistTPUSync
+
+__all__ = ["KVStoreHorovod", "KVStoreBytePS"]
+
+
+class KVStoreHorovod(KVStoreDistTPUSync):
+    """`--kv-store horovod` compatibility (reference kvstore/horovod.py).
+
+    The reference adapter forbade a server-side optimizer (horovod has no
+    servers; the update runs in the worker) — same constraint here."""
+
+    @property
+    def type(self):
+        return "horovod"
+
+    def set_optimizer(self, optimizer):
+        from ..base import MXNetError
+        raise MXNetError(
+            f"kvstore '{self.type}' does not run a server-side optimizer "
+            "(reference adapter behavior): update_on_kvstore is "
+            "False — apply the optimizer in the worker (gluon.Trainer "
+            "does this automatically).")
+
+
+class KVStoreBytePS(KVStoreHorovod):
+    """`--kv-store byteps` compatibility (reference kvstore/byteps.py)."""
+
+    @property
+    def type(self):
+        return "byteps"

@@ -886,4 +886,9 @@ def create(name="local"):
         return KVStoreDistTPUSync()
     if name == "dist_async":
         return KVStoreDistAsync()
+    if name in ("horovod", "byteps"):
+        # reference >=1.6 adapter facades (kvstore/horovod.py, byteps.py):
+        # on TPU the XLA collectives already play the allreduce role
+        from .horovod import KVStoreHorovod, KVStoreBytePS
+        return KVStoreHorovod() if name == "horovod" else KVStoreBytePS()
     raise MXNetError(f"unknown KVStore type {name!r}")
