@@ -306,8 +306,10 @@ class Module(BaseModule):
             if "rescale_grad" not in params and self._batch_size:
                 n = 1
                 if self._kvstore is not None and \
-                        "dist" in self._kvstore.type and \
-                        "_sync" in self._kvstore.type:
+                        (("dist" in self._kvstore.type and
+                          "_sync" in self._kvstore.type) or
+                         # the adapter facades SUM like a dist sync store
+                         self._kvstore.type in ("horovod", "byteps")):
                     n = self._kvstore.num_workers
                 params["rescale_grad"] = 1.0 / (self._batch_size * n)
             optimizer = opt_mod.create(optimizer, **params)
@@ -320,6 +322,10 @@ class Module(BaseModule):
             self._update_on_kvstore = os.environ.get(
                 "MXTPU_UPDATE_ON_KVSTORE",
                 os.environ.get("MXNET_UPDATE_ON_KVSTORE", "1")) == "1"
+            if self._kvstore.type in ("horovod", "byteps"):
+                # reference model/module force update_on_kvstore=False for
+                # the adapters (no server to run the optimizer on)
+                self._update_on_kvstore = False
             if self._kvstore.type == "dist_async" and \
                     not self._update_on_kvstore:
                 # the PS table holds WEIGHTS; a pushpull would hand the

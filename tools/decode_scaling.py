@@ -52,12 +52,14 @@ def sweep(n_images=512, edge=224, threads=(1, 2, 4, 8), repeats=2,
             pf = native.NativePrefetcher(
                 rec_path + ".rec", np.arange(n_images), batch,
                 n_threads=n, mode="image", edge=edge)
-            t0 = time.perf_counter()
-            consumed = 0
-            for data_u8, labels in pf:
-                consumed += data_u8.shape[0]
-            dt = time.perf_counter() - t0
-            pf.close()
+            try:
+                t0 = time.perf_counter()
+                consumed = 0
+                for data_u8, labels in pf:
+                    consumed += data_u8.shape[0]
+                dt = time.perf_counter() - t0
+            finally:
+                pf.close()   # a decode error must not leak the C++ pool
             best = max(best, consumed / dt)
         results.append({"threads": n, "img_s": round(best, 1)})
     return results
