@@ -637,8 +637,11 @@ def _load_tpu_cache() -> dict | None:
 
 
 def main() -> int:
-    attempts = int(os.environ.get("MXTPU_BENCH_PROBE_ATTEMPTS", "2"))
-    timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "150"))
+    # 6 x 120s probes with 45s backoff (~16 min worst case when wedged,
+    # seconds when healthy): round-3 lost its driver-witnessed TPU number
+    # to a tunnel that healed shortly after a 5-minute window gave up
+    attempts = int(os.environ.get("MXTPU_BENCH_PROBE_ATTEMPTS", "6"))
+    timeout = float(os.environ.get("MXTPU_BENCH_PROBE_TIMEOUT", "120"))
     error = None
 
     platform = None
@@ -655,7 +658,7 @@ def main() -> int:
             if platform is not None:
                 break
             if i < attempts - 1:
-                time.sleep(min(5.0 * (i + 1), 15.0))
+                time.sleep(min(15.0 * (i + 1), 45.0))
     if platform is None:
         error = (f"backend probe failed after {attempts} attempts "
                  f"({timeout:.0f}s timeout each); falling back to CPU")
