@@ -211,8 +211,25 @@ def _scan_backward(q, k, v, out, lse, g, causal, sm_scale, bk):
 def _use_pallas(lq, lk, d):
     if jax.default_backend() != "tpu":
         return None
-    bq = _pick_block(lq)
-    bk = _pick_block(lk)
+    import os
+
+    def _pref(var):
+        # tuning knobs (MXTPU_FLASH_BQ/BK): preferred block sizes for the
+        # kernel autotune sweep; clamped to >=128 so a too-small value
+        # still falls back to a valid divisor instead of silently
+        # disabling the kernel, and malformed values are named
+        raw = os.environ.get(var, "512")
+        try:
+            return max(int(raw), 128)
+        except ValueError as e:
+            from ..base import MXNetError
+            raise MXNetError(
+                f"{var}={raw!r} is not an integer block size") from e
+
+    pref_q = _pref("MXTPU_FLASH_BQ")
+    pref_k = _pref("MXTPU_FLASH_BK")
+    bq = _pick_block(lq, pref_q)
+    bk = _pick_block(lk, pref_k)
     # d=64 is fine: Mosaic pads the lane dim; BERT-base heads (768/12) hit
     # this. Verified on TPU v5e vs the scan path (max abs diff 1.8e-7 f32).
     if bq is None or bk is None or d % 64:
