@@ -68,6 +68,9 @@ from . import name
 from . import attribute
 from . import engine
 from . import rtc
+from . import rnn
+from . import monitor
+from .monitor import Monitor
 from . import image
 from . import parallel
 

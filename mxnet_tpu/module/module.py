@@ -94,6 +94,8 @@ class BaseModule:
         if not isinstance(eval_metric, metric_mod.EvalMetric):
             eval_metric = metric_mod.create(eval_metric)
         validation_metric = validation_metric or eval_metric
+        if monitor is not None:
+            self.install_monitor(monitor)
 
         for epoch in range(begin_epoch, num_epoch):
             tic = time.time()
@@ -101,8 +103,12 @@ class BaseModule:
             nbatch = 0
             train_data.reset()
             for batch in train_data:
+                if monitor is not None:
+                    monitor.tic()
                 self.forward_backward(batch)
                 self.update()
+                if monitor is not None:
+                    monitor.toc_print()
                 self.update_metric(eval_metric, batch.label)
                 if batch_end_callback is not None:
                     params = BatchEndParam(epoch, nbatch, eval_metric)
@@ -152,6 +158,10 @@ class BaseModule:
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()
+
+    def install_monitor(self, mon):
+        """Attach a mx.monitor.Monitor (reference Module.install_monitor)."""
+        mon.install(self)
 
 
 def _as_list(x):

@@ -1,0 +1,108 @@
+"""``mx.monitor.Monitor`` — periodic tensor statistics during training.
+
+Reference: python/mxnet/monitor.py — hooked every executor op output via
+the C++ monitor callback and printed ``stat_func`` per tensor every
+``interval`` batches (the classic exploding-gradient hunt).
+
+TPU-native scope: XLA fuses op internals away, so the observable surface
+is the executor boundary — arguments (weights), gradients, auxiliary
+states, and outputs. That covers the reference Monitor's dominant uses
+(weight/grad scale tracking); per-internal-op activations need
+``MXTPU_EAGER=1`` (every op dispatches eagerly) + ``mx.profiler``
+instead, which is the documented NaN/blowup workflow (docs/API.md env
+table, MXTPU_DEBUG_NANS).
+"""
+from __future__ import annotations
+
+import logging
+import re
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(arr):
+    return _np.abs(arr).mean()
+
+
+class Monitor:
+    """Collect statistics of params/grads/aux/outputs every N batches.
+
+    Usage (reference pattern)::
+
+        mon = mx.monitor.Monitor(interval=10, pattern=".*weight.*")
+        mod.install_monitor(mon)
+        ...
+        mon.tic()
+        mod.forward_backward(batch)
+        for name, stat in mon.toc():
+            ...
+        # or mon.toc_print()
+    """
+
+    def __init__(self, interval, stat_func=None, pattern=".*", sort=False):
+        self.interval = int(interval)
+        self.stat_func = stat_func or _default_stat
+        self.re_pattern = re.compile(pattern)
+        self.sort = sort
+        self.step = 0
+        self.activated = False
+        self._module = None
+        self.queue = []
+
+    def install(self, module):
+        """Wired by Module.install_monitor."""
+        self._module = module
+
+    def tic(self):
+        """Arm collection for this batch if the interval says so."""
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+        self.step += 1
+
+    def _collect(self):
+        if self._module is None:
+            raise MXNetError("Monitor not installed; call "
+                             "module.install_monitor(monitor) first")
+        mod = self._module
+        # BucketingModule: the live executor belongs to the current bucket
+        mod = getattr(mod, "_curr_module", None) or mod
+        exe = getattr(mod, "_exec", None)
+        if exe is None:
+            raise MXNetError("Monitor: module is not bound yet")
+        sources = [("", exe.arg_dict),
+                   ("_grad", getattr(exe, "grad_dict", {}) or {}),
+                   ("_aux", getattr(exe, "aux_dict", {}) or {})]
+        for suffix, d in sources:
+            for name, arr in d.items():
+                full = name + suffix
+                if arr is not None and self.re_pattern.match(full):
+                    self.queue.append(
+                        (self.step, full,
+                         self.stat_func(_np.asarray(arr.asnumpy()))))
+        for i, out in enumerate(mod.get_outputs()):
+            full = f"output{i}"
+            if self.re_pattern.match(full):
+                self.queue.append(
+                    (self.step, full,
+                     self.stat_func(_np.asarray(out.asnumpy()))))
+
+    def toc(self):
+        """Return [(step, name, stat)] for an armed batch, else []."""
+        if not self.activated:
+            return []
+        self._collect()
+        self.activated = False
+        res = self.queue
+        self.queue = []
+        if self.sort:
+            res = sorted(res, key=lambda x: x[1])
+        return res
+
+    def toc_print(self):
+        for step, name, stat in self.toc():
+            logging.info("Batch: %7d %30s %s", step, name, stat)

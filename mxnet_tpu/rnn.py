@@ -1,0 +1,151 @@
+"""``mx.rnn`` — legacy (pre-Gluon) RNN namespace.
+
+Reference: python/mxnet/rnn/ (rnn_cell.py with symbolic cells,
+io.py with BucketSentenceIter, rnn.py with checkpoint helpers) — the API
+the reference's ``example/rnn`` bucketing LSTM uses.
+
+TPU-native disposition (SURVEY.md §3/§7 "BucketingModule + gluon.rnn
+unrolling"): the cell classes ARE the gluon cells (same math, tape/jit
+aware) re-exported under their legacy names; ``BucketSentenceIter``
+feeds ``BucketingModule`` exactly like the reference's. The legacy
+symbolic ``sym_gen``-style flow maps to BucketingModule whose
+``sym_gen`` builds through ``mx.sym`` or a gluon block per bucket.
+Checkpoint helpers delegate to the shared NDArray container
+(``mx.nd.save``/``load`` read AND write the reference's .params format).
+"""
+from __future__ import annotations
+
+import bisect
+
+import numpy as _np
+
+from .base import MXNetError
+from .gluon.rnn import (RNNCell, LSTMCell, GRUCell, SequentialRNNCell,
+                        BidirectionalCell, DropoutCell, ResidualCell,
+                        ZoneoutCell)
+from . import io as _io
+from . import ndarray as _nd
+
+__all__ = ["RNNCell", "LSTMCell", "GRUCell", "SequentialRNNCell",
+           "BidirectionalCell", "DropoutCell", "ResidualCell",
+           "ZoneoutCell", "BucketSentenceIter",
+           "save_rnn_checkpoint", "load_rnn_checkpoint"]
+
+
+class BucketSentenceIter(_io.DataIter):
+    """Bucketed variable-length sequence iterator (reference
+    python/mxnet/rnn/io.py): sentences are padded up to the smallest
+    bucket that fits and batched per bucket; each batch carries
+    ``bucket_key`` so BucketingModule switches executors."""
+
+    def __init__(self, sentences, batch_size, buckets=None, invalid_label=-1,
+                 data_name="data", label_name="softmax_label", dtype="float32",
+                 layout="NT"):
+        super().__init__(batch_size)
+        if not buckets:
+            # reference default: one bucket per observed length with enough
+            # sentences to fill at least one batch
+            counts = {}
+            for s in sentences:
+                counts[len(s)] = counts.get(len(s), 0) + 1
+            buckets = sorted(k for k, n in counts.items()
+                             if n >= batch_size) or \
+                [max(len(s) for s in sentences)]
+        self.buckets = sorted(buckets)
+        self.data_name = data_name
+        self.label_name = label_name
+        self.invalid_label = invalid_label
+        self.batch_size = batch_size
+        self._dtype = dtype
+        if layout not in ("NT", "TN"):
+            raise MXNetError(f"layout must be 'NT' or 'TN', got {layout!r}")
+        self.layout = layout          # TN = time-major (reference example)
+
+        self._data = [[] for _ in self.buckets]
+        for s in sentences:
+            i = bisect.bisect_left(self.buckets, len(s))
+            if i >= len(self.buckets):
+                continue              # longer than the largest bucket: drop
+            row = _np.full((self.buckets[i],), invalid_label, _np.float32)
+            row[:len(s)] = s
+            self._data[i].append(row)
+        self._data = [_np.asarray(rows, dtype=_np.float32)
+                      for rows in self._data]
+        self.default_bucket_key = max(self.buckets)
+        self._plan = []               # (bucket_idx, start) batches
+        self.reset()
+
+    def _shape(self, t):
+        return (self.batch_size, t) if self.layout == "NT" \
+            else (t, self.batch_size)
+
+    @property
+    def provide_data(self):
+        return [_io.DataDesc(self.data_name,
+                             self._shape(self.default_bucket_key),
+                             self._dtype, layout=self.layout)]
+
+    @property
+    def provide_label(self):
+        return [_io.DataDesc(self.label_name,
+                             self._shape(self.default_bucket_key),
+                             self._dtype, layout=self.layout)]
+
+    def reset(self):
+        self._plan = []
+        for i, rows in enumerate(self._data):
+            for start in range(0, len(rows) - self.batch_size + 1,
+                               self.batch_size):
+                self._plan.append((i, start))
+        _np.random.shuffle(self._plan)
+        self._cursor = 0
+
+    def next(self):
+        if self._cursor >= len(self._plan):
+            raise StopIteration
+        i, start = self._plan[self._cursor]
+        self._cursor += 1
+        rows = self._data[i][start:start + self.batch_size]
+        # language-model convention: label is data shifted left one step
+        label = _np.full_like(rows, self.invalid_label)
+        label[:, :-1] = rows[:, 1:]
+        if self.layout == "TN":
+            rows, label = rows.T, label.T
+        return _io.DataBatch(
+            data=[_nd.array(rows, dtype=self._dtype)],
+            label=[_nd.array(label, dtype=self._dtype)],
+            bucket_key=self.buckets[i],
+            provide_data=[_io.DataDesc(
+                self.data_name, self._shape(self.buckets[i]),
+                self._dtype, layout=self.layout)],
+            provide_label=[_io.DataDesc(
+                self.label_name, self._shape(self.buckets[i]),
+                self._dtype, layout=self.layout)])
+
+
+def save_rnn_checkpoint(cells, prefix, epoch, symbol=None, arg_params=None,
+                        aux_params=None):
+    """Reference rnn.save_rnn_checkpoint: the cells' params merged into
+    the checkpoint alongside symbol/arg/aux — delegates to the shared
+    module.save_checkpoint_arrays so nothing passed is dropped."""
+    from .module.module import save_checkpoint_arrays
+    params = dict(arg_params or {})
+    for cell in cells if isinstance(cells, (list, tuple)) else [cells]:
+        for name, p in cell.collect_params().items():
+            params[name] = p.data()
+    save_checkpoint_arrays(prefix, epoch, symbol, params, aux_params)
+
+
+def load_rnn_checkpoint(cells, prefix, epoch):
+    """Reference rnn.load_rnn_checkpoint: restore cell params in place and
+    return (symbol, arg_params, aux_params) like mx.model.load_checkpoint
+    (the resume-training pattern unpacks the triple)."""
+    from .module.module import load_checkpoint
+    symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+    for cell in cells if isinstance(cells, (list, tuple)) else [cells]:
+        for name, p in cell.collect_params().items():
+            if name not in arg_params:
+                raise MXNetError(f"parameter {name} not in checkpoint "
+                                 f"{prefix}-{epoch:04d}.params")
+            p.set_data(arg_params[name])
+    return symbol, arg_params, aux_params

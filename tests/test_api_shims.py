@@ -71,3 +71,113 @@ def test_filter_sampler():
     loader = gdata.DataLoader(ds, batch_size=2, sampler=fs)
     (batch,) = list(loader)
     assert batch.asnumpy().tolist() == [3.0, 4.0]
+
+
+def test_legacy_rnn_namespace():
+    """mx.rnn (reference python/mxnet/rnn/): cells re-exported, bucketed
+    sentence iterator feeds BucketingModule-style batches."""
+    import numpy as np
+    assert mx.rnn.LSTMCell is mx.gluon.rnn.LSTMCell
+    rng = np.random.RandomState(0)
+    sentences = [list(rng.randint(1, 50, size=n))
+                 for n in rng.randint(3, 12, size=60)]
+    it = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                   buckets=[5, 10, 15])
+    assert it.default_bucket_key == 15
+    seen = 0
+    for batch in it:
+        seen += 1
+        assert batch.bucket_key in (5, 10, 15)
+        assert batch.data[0].shape == (4, batch.bucket_key)
+        d = batch.data[0].asnumpy()
+        l = batch.label[0].asnumpy()
+        # label is data shifted one step left
+        np.testing.assert_array_equal(l[:, :-1], d[:, 1:])
+    assert seen > 0
+    it.reset()
+    assert sum(1 for _ in it) == seen
+
+    # cell checkpoint helpers roundtrip through the shared container
+    import tempfile, os
+    cell = mx.rnn.LSTMCell(8)
+    cell.initialize()
+    x = mx.nd.ones((2, 4))
+    states = cell.begin_state(batch_size=2)
+    cell(x, states)
+    prefix = os.path.join(tempfile.mkdtemp(), "rnnckpt")
+    mx.rnn.save_rnn_checkpoint(cell, prefix, 3,
+                               aux_params={"extra": mx.nd.array([7.0])})
+    cell2 = mx.rnn.LSTMCell(8, prefix=cell.prefix)
+    cell2.initialize()
+    cell2(x, cell2.begin_state(batch_size=2))
+    sym, args, aux = mx.rnn.load_rnn_checkpoint(cell2, prefix, 3)
+    assert aux["extra"].asnumpy()[0] == 7.0   # aux survives the roundtrip
+    for name, p in cell.collect_params().items():
+        np.testing.assert_array_equal(
+            cell2.collect_params()[name].data().asnumpy(),
+            p.data().asnumpy())
+
+    # time-major layout (the reference bucketing example uses 'TN')
+    it_tn = mx.rnn.BucketSentenceIter(sentences, batch_size=4,
+                                      buckets=[5, 10, 15], layout="TN")
+    b = next(iter(it_tn))
+    assert b.data[0].shape == (b.bucket_key, 4)
+    assert it_tn.provide_data[0].shape == (15, 4)
+
+
+def test_monitor_collects_weight_and_grad_stats():
+    """mx.monitor.Monitor (reference python/mxnet/monitor.py) over the
+    Module executor boundary."""
+    import numpy as np
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    out = mx.sym.SoftmaxOutput(out, name="softmax")
+    mod = mx.mod.Module(out, data_names=("data",),
+                        label_names=("softmax_label",))
+    mod.bind(data_shapes=[("data", (2, 5))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer(kvstore=None)
+    mon = mx.monitor.Monitor(interval=2, pattern=".*fc.*")
+    mod.install_monitor(mon)
+    batch = mx.io.DataBatch(data=[mx.nd.ones((2, 5))],
+                            label=[mx.nd.array([0.0, 1.0])])
+    stats_per_step = []
+    for _ in range(4):
+        mon.tic()
+        mod.forward_backward(batch)
+        mod.update()
+        stats_per_step.append(mon.toc())
+    # armed on steps 0 and 2 only (interval=2)
+    assert len(stats_per_step[0]) > 0 and len(stats_per_step[2]) > 0
+    assert stats_per_step[1] == [] and stats_per_step[3] == []
+    names = {n for _, n, _ in stats_per_step[0]}
+    assert any(n.endswith("_grad") for n in names), names
+    assert any(not n.endswith("_grad") for n in names), names
+    for _, _, stat in stats_per_step[0]:
+        assert np.isfinite(stat)
+
+
+def test_monitor_on_bucketing_module():
+    """Monitor must reach the CURRENT bucket's executor (review finding:
+    BucketingModule has no _exec of its own)."""
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc_shared",
+                                   flatten=False)
+        pooled = mx.sym.mean(fc, axis=1, name="pool")
+        out = mx.sym.SoftmaxOutput(pooled, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16)
+    mod.bind(data_shapes=[("data", (2, 16, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mon = mx.monitor.Monitor(interval=1, pattern=".*fc.*")
+    mod.install_monitor(mon)
+    mon.tic()
+    mod.forward(mx.io.DataBatch(data=[mx.nd.ones((2, 16, 6))],
+                                label=[mx.nd.zeros((2,))], bucket_key=16),
+                is_train=False)
+    stats = mon.toc()
+    assert stats and all(len(t) == 3 for t in stats)
