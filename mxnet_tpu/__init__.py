@@ -71,6 +71,7 @@ from . import rtc
 from . import rnn
 from . import monitor
 from .monitor import Monitor
+from . import model
 from . import image
 from . import parallel
 

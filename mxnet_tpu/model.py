@@ -1,0 +1,44 @@
+"""``mx.model`` — legacy model-layer helpers.
+
+Reference: python/mxnet/model.py — home of ``save_checkpoint`` /
+``load_checkpoint`` (the canonical checkpoint functions every tutorial
+calls), ``BatchEndParam`` (the namedtuple handed to batch callbacks),
+and the deprecated ``FeedForward`` estimator.
+
+The living implementations sit with Module (module/module.py); this
+module keeps the reference import paths working. ``FeedForward`` was
+deprecated in the reference well before the fork point with the
+instruction to use Module — here that deprecation is terminal: the
+class raises with the Module migration recipe instead of shipping a
+second training loop.
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .module.module import (BatchEndParam, load_checkpoint,
+                            save_checkpoint_arrays)
+
+__all__ = ["BatchEndParam", "load_checkpoint", "save_checkpoint",
+           "FeedForward"]
+
+
+def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
+                    remove_amp_cast=True):
+    """Reference mx.model.save_checkpoint(prefix, epoch, sym, args, aux):
+    writes prefix-symbol.json + prefix-NNNN.params."""
+    save_checkpoint_arrays(prefix, epoch, symbol, arg_params, aux_params)
+
+
+class FeedForward:
+    """Deprecated in the reference (mx.model.FeedForward -> mx.mod.Module);
+    kept as a named landing spot with the migration recipe."""
+
+    def __init__(self, *args, **kwargs):
+        raise MXNetError(
+            "FeedForward was deprecated in the reference in favor of "
+            "mx.mod.Module, which this framework implements in full: "
+            "Module(symbol, data_names, label_names).fit(train_iter, "
+            "eval_data=..., num_epoch=...). See docs/MIGRATION.md.")
+
+    create = __init__
+    load = __init__

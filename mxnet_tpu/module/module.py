@@ -189,6 +189,7 @@ class Module(BaseModule):
         self._batch_size = None
         self._mesh = None   # multi-device DP: set by bind when len(ctx) > 1
         self._preloaded_params = None   # set by Module.load
+        self._preload_opt_states = None  # set by Module.load(...states)
         self._group2ctxs = group2ctxs
 
     # -- bind -----------------------------------------------------------
@@ -358,6 +359,9 @@ class Module(BaseModule):
             if self._kvstore.num_workers > 1:
                 self._kvstore.pull(keys, out=arrs)
         self.optimizer_initialized = True
+        if self._preload_opt_states is not None:
+            self.load_optimizer_states(self._preload_opt_states)
+            self._preload_opt_states = None
 
     def _trainable_names(self):
         return [n for n in self.symbol.list_arguments()
@@ -438,12 +442,47 @@ class Module(BaseModule):
         arg_params, aux_params = self.get_params()
         save_checkpoint_arrays(prefix, epoch, self.symbol, arg_params,
                                aux_params)
+        if save_optimizer_states:
+            self.save_optimizer_states(f"{prefix}-{epoch:04d}.states")
+
+    def save_optimizer_states(self, fname):
+        """Reference Module.save_optimizer_states: momentum/Adam state per
+        trainable param. Serialized through the shared NDArray container
+        (state:<idx>:<component> keys) — same format family as .params,
+        no pickle."""
+        assert self.optimizer_initialized, "init_optimizer first"
+        flat = {}
+        for idx, st in self._updater_states.items():
+            comps = st if isinstance(st, (list, tuple)) else [st]
+            for j, c in enumerate(comps):
+                if c is not None:
+                    flat[f"state:{idx}:{j}"] = c
+        nd_utils.save(fname, flat)
+
+    def load_optimizer_states(self, fname):
+        """Reference Module.load_optimizer_states (after init_optimizer)."""
+        assert self.optimizer_initialized, "init_optimizer first"
+        loaded = nd_utils.load(fname)
+        for key, arr in loaded.items():
+            _, idx, j = key.split(":")
+            idx, j = int(idx), int(j)
+            if idx not in self._updater_states:
+                name = self._trainable_names()[idx]
+                self._updater_states[idx] = self._optimizer.create_state(
+                    idx, self._exec.arg_dict[name])
+            st = self._updater_states[idx]
+            target = st[j] if isinstance(st, (list, tuple)) else st
+            target._set_data(arr.data)
 
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
         mod = Module(symbol, **kwargs)
         mod._preloaded_params = (arg_params, aux_params)
+        if load_optimizer_states:
+            # applied once the optimizer exists (reference defers the same
+            # way: preload_opt_states -> init_optimizer)
+            mod._preload_opt_states = f"{prefix}-{epoch:04d}.states"
         return mod
 
     @property

@@ -62,14 +62,24 @@ class BucketSentenceIter(_io.DataIter):
         self.layout = layout          # TN = time-major (reference example)
 
         self._data = [[] for _ in self.buckets]
+        n_discarded = 0
         for s in sentences:
             i = bisect.bisect_left(self.buckets, len(s))
             if i >= len(self.buckets):
-                continue              # longer than the largest bucket: drop
-            row = _np.full((self.buckets[i],), invalid_label, _np.float32)
+                n_discarded += 1      # longer than the largest bucket
+                continue
+            # rows are built in the REQUESTED dtype: a float32 staging
+            # buffer would round token ids >= 2^24
+            row = _np.full((self.buckets[i],), invalid_label,
+                           _np.dtype(dtype))
             row[:len(s)] = s
             self._data[i].append(row)
-        self._data = [_np.asarray(rows, dtype=_np.float32)
+        if n_discarded:
+            import logging
+            logging.warning(
+                "BucketSentenceIter: discarded %d sentences longer than "
+                "the largest bucket (%d)", n_discarded, max(self.buckets))
+        self._data = [_np.asarray(rows, dtype=_np.dtype(dtype))
                       for rows in self._data]
         self.default_bucket_key = max(self.buckets)
         self._plan = []               # (bucket_idx, start) batches
