@@ -2,3 +2,4 @@
 from . import nn
 from . import estimator
 from . import rnn
+from . import data

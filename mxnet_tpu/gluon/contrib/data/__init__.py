@@ -1,0 +1,40 @@
+"""``gluon.contrib.data`` — contrib samplers & datasets.
+
+Reference: python/mxnet/gluon/contrib/data/ (sampler.py IntervalSampler;
+text.py WikiText datasets). The text datasets needed downloads; in this
+zero-egress build they are gated like the other network-backed loaders
+(`MXTPU_SYNTHETIC_DATA=1` covers vision; text corpora must be local).
+"""
+from __future__ import annotations
+
+from ....base import MXNetError
+from ...data.dataloader import Sampler
+
+__all__ = ["IntervalSampler"]
+
+
+class IntervalSampler(Sampler):
+    """Sample i, i+k, i+2k, ... for each offset i in [0, k) — the
+    strided-interleave sampler (reference contrib/data/sampler.py).
+
+    With rollover=True (default) every element is visited once, offset
+    by offset; with rollover=False only the offset-0 stride is yielded.
+    """
+
+    def __init__(self, length, interval, rollover=True):
+        if interval > length:
+            raise MXNetError(
+                f"interval {interval} must be <= length {length}")
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        offsets = range(self._interval) if self._rollover else [0]
+        for i in offsets:
+            yield from range(i, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))

@@ -82,6 +82,12 @@ class KVStore:
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
         raise MXNetError(f"row_sparse_pull not supported by {self.type}")
 
+    def send_command_to_servers(self, head, body):
+        """Reference KVStore.send_command_to_servers: ps-lite controller
+        messages. No-op on serverless stores (matching the reference,
+        where only dist stores have servers to talk to); dist_async
+        forwards to every server over the typed binary protocol."""
+
     @staticmethod
     def _local_reduce(vs):
         """CommDevice::Reduce over per-device copies. row_sparse values
@@ -744,6 +750,10 @@ class KVStoreDistAsync(KVStoreLocal):
     def per_server_stats(self):
         """Per-server push counters (observability for the key sharding)."""
         return [c.stats() for c in self._clients]
+
+    def send_command_to_servers(self, head, body):
+        for c in self._clients:
+            c.send_command(head, body)
 
     def barrier(self):
         self._client.barrier()

@@ -48,7 +48,7 @@ _HDR = struct.Struct("<Q")
 
 # opcodes (requests)
 _OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
-    _OP_SHUTDOWN = 1, 2, 3, 4, 5, 6, 7
+    _OP_SHUTDOWN, _OP_CMD, _OP_CMDLOG = 1, 2, 3, 4, 5, 6, 7, 8, 9
 # opcodes (replies)
 _OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
 
@@ -207,6 +207,9 @@ class PSServer:
         self._updater = None      # server-side optimizer (set_optimizer;
                                   # per-key state lives in _ServerUpdater)
         self._push_count = {}     # key -> applied pushes (incl. stale)
+        from collections import deque
+        self._commands = deque(maxlen=64)   # recent controller messages,
+                                            # readable via _OP_CMDLOG
         self._lock = threading.Lock()
         self._num_workers = num_workers
         self._barrier_gen = 0
@@ -304,6 +307,24 @@ class PSServer:
                     while self._barrier_gen == gen:
                         self._barrier_cv.wait(timeout=60)
             _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_CMD:
+            # reference send_command_to_servers(head, body): ps-lite
+            # kController messages. Typed here: head int + body text.
+            # Built-in head 0 + "lr:<x>" retunes the server optimizer
+            # (the reference's canonical mid-training use); the last 64
+            # commands are readable via PSClient.command_log().
+            (head,) = struct.unpack_from("<i", frame, off)
+            body, _ = _unpack_text(frame, off + 4)
+            with self._lock:
+                self._commands.append((head, body))
+                if head == 0 and body.startswith("lr:") and \
+                        self._updater is not None:
+                    self._updater.set_learning_rate(float(body[3:]))
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_CMDLOG:
+            with self._lock:
+                log = json.dumps(list(self._commands))
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(log))
         elif op == _OP_SHUTDOWN:
             _send_frame(conn, bytes([_OP_OK]))
             self._sock.close()
@@ -321,6 +342,9 @@ class _ServerUpdater:
     def __init__(self, optimizer):
         self._optimizer = optimizer
         self._states = {}
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
 
     def __call__(self, key, grad, weight):
         from ..ndarray.ndarray import array
@@ -389,6 +413,14 @@ class PSClient:
 
     def stats(self):
         return self._rpc(bytes([_OP_STATS]))
+
+    def send_command(self, head, body):
+        return self._rpc(bytes([_OP_CMD]) + struct.pack("<i", int(head))
+                         + _pack_text(str(body)))
+
+    def command_log(self):
+        """Recent (head, body) controller messages this server received."""
+        return self._rpc(bytes([_OP_CMDLOG]))
 
     def barrier(self):
         return self._rpc(bytes([_OP_BARRIER]))

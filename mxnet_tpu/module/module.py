@@ -447,10 +447,13 @@ class Module(BaseModule):
 
     def save_optimizer_states(self, fname):
         """Reference Module.save_optimizer_states: momentum/Adam state per
-        trainable param. Serialized through the shared NDArray container
-        (state:<idx>:<component> keys) — same format family as .params,
-        no pickle."""
+        trainable param. With update_on_kvstore the state lives in the
+        STORE's updater — delegate there (reference does the same);
+        otherwise serialize the local states through the shared NDArray
+        container (state:<idx>:<component> keys) — no pickle."""
         assert self.optimizer_initialized, "init_optimizer first"
+        if self._update_on_kvstore and self._kvstore is not None:
+            return self._kvstore.save_optimizer_states(fname)
         flat = {}
         for idx, st in self._updater_states.items():
             comps = st if isinstance(st, (list, tuple)) else [st]
@@ -462,6 +465,8 @@ class Module(BaseModule):
     def load_optimizer_states(self, fname):
         """Reference Module.load_optimizer_states (after init_optimizer)."""
         assert self.optimizer_initialized, "init_optimizer first"
+        if self._update_on_kvstore and self._kvstore is not None:
+            return self._kvstore.load_optimizer_states(fname)
         loaded = nd_utils.load(fname)
         for key, arr in loaded.items():
             _, idx, j = key.split(":")

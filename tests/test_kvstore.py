@@ -319,3 +319,17 @@ def test_horovod_byteps_adapter_facades():
         np.testing.assert_allclose(v.asnumpy(), [1.0, 2.0, 3.0])
         with pytest.raises(mx.MXNetError, match="server-side"):
             kv.set_optimizer(mx.optimizer.SGD())
+
+
+def test_interval_sampler_and_send_command():
+    """gluon.contrib.data.IntervalSampler + KVStore.send_command_to_servers
+    (reference contrib/data/sampler.py, kvstore.py controller messages)."""
+    from mxnet_tpu.gluon.contrib.data import IntervalSampler
+    s = IntervalSampler(10, 3)
+    order = list(s)
+    assert sorted(order) == list(range(10)) and len(s) == 10
+    assert order[:4] == [0, 3, 6, 9]
+    s2 = IntervalSampler(10, 3, rollover=False)
+    assert list(s2) == [0, 3, 6, 9] and len(s2) == 4
+    # serverless stores: documented no-op
+    mx.kv.create("local").send_command_to_servers(0, "anything")

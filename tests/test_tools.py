@@ -241,3 +241,32 @@ def test_launch_ssh_emits_server_role_lines(tmp_path):
     assert r.stdout.count("DMLC_ROLE=server") == 2, r.stdout
     assert r.stdout.count("mxnet_tpu.kvstore.ps_server") == 2
     assert r.stdout.count("MXTPU_PS_ADDRS=") == 4   # servers + workers
+
+
+def test_dist_async_send_command_retunes_server_lr(tmp_path):
+    """send_command_to_servers(0, 'lr:x') reaches the server optimizer
+    (reference ps-lite kController use)."""
+    script = tmp_path / "worker.py"
+    script.write_text(
+        "import os, sys\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "import numpy as np\n"
+        "import mxnet_tpu as mx\n"
+        "kv = mx.kv.create('dist_async')\n"
+        "kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.1))\n"
+        "kv.init('w', mx.nd.ones((2,)))\n"
+        "kv.push('w', mx.nd.ones((2,)))    # lr 0.1 -> w = 0.9\n"
+        "kv.send_command_to_servers(0, 'lr:0.5')\n"
+        "kv.push('w', mx.nd.ones((2,)))    # lr 0.5 -> w = 0.4\n"
+        "out = mx.nd.zeros((2,))\n"
+        "kv.pull('w', out=out)\n"
+        "np.testing.assert_allclose(out.asnumpy(), [0.4, 0.4], rtol=1e-5)\n"
+        "log = kv._clients[0].command_log()\n"
+        "assert log == [[0, 'lr:0.5']], log\n"
+        "print('CMD_OK')\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "launch.py"),
+         "-n", "1", "--launcher", "local", sys.executable, str(script)],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "CMD_OK" in r.stdout, r.stdout + r.stderr
