@@ -1,0 +1,104 @@
+"""Bucketed LSTM language model — the reference ``example/rnn`` workflow.
+
+The classic reference pipeline, end to end on the TPU rebuild:
+``mx.rnn.BucketSentenceIter`` (variable-length sentences, bucketed +
+padded) feeding ``mx.mod.BucketingModule`` whose per-bucket symbol uses
+the FUSED ``mx.sym.RNN`` op (packed parameter vector, the cuDNN-RNN
+surface — here one lax.scan per direction compiled by XLA).
+
+Synthetic corpus: each sentence is a ramp t, t+1, t+2, ... (mod V), so
+next-token prediction is exactly learnable; training drives per-token
+accuracy from ~1/V to >0.9.
+
+Run: PYTHONPATH= JAX_PLATFORMS=cpu python examples/bucketing_lstm.py
+"""
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+
+VOCAB, EMBED, HIDDEN = 20, 16, 32
+BATCH, BUCKETS = 8, [6, 10, 14]
+GATES = 4   # lstm
+
+
+def make_corpus(n=160, seed=0):
+    rng = np.random.RandomState(seed)
+    sentences = []
+    for _ in range(n):
+        length = rng.choice([5, 6, 9, 10, 13, 14])
+        start = rng.randint(0, VOCAB)
+        sentences.append([(start + t) % VOCAB for t in range(length)])
+    return sentences
+
+
+def sym_gen(seq_len):
+    """Per-bucket symbol; all buckets share every parameter (embedding,
+    packed LSTM vector, output FC) because the names match."""
+    n_params = (GATES * HIDDEN * EMBED      # W_i2h
+                + GATES * HIDDEN * HIDDEN   # W_h2h
+                + 2 * GATES * HIDDEN)       # b_i2h, b_h2h
+    data = mx.sym.var("data")
+    label = mx.sym.var("softmax_label")
+    emb = mx.sym.Embedding(data, input_dim=VOCAB, output_dim=EMBED,
+                           name="embed")
+    seq = mx.sym.transpose(emb, axes=(1, 0, 2))     # (T, B, E) seq-major
+    par = mx.sym.var("lstm_params", shape=(n_params,))
+    h0 = mx.sym.zeros(shape=(1, BATCH, HIDDEN))
+    c0 = mx.sym.zeros(shape=(1, BATCH, HIDDEN))
+    out = mx.sym.RNN(seq, par, h0, c0, state_size=HIDDEN, num_layers=1,
+                     mode="lstm", name="lstm")      # (T, B, H)
+    flat = mx.sym.reshape(out, shape=(-1, HIDDEN))
+    logits = mx.sym.FullyConnected(flat, num_hidden=VOCAB, name="pred")
+    lab = mx.sym.reshape(mx.sym.transpose(label), shape=(-1,))
+    # padding positions carry label -1: use_ignore zeroes their gradient
+    sm = mx.sym.SoftmaxOutput(logits, lab, use_ignore=True,
+                              ignore_label=-1, name="softmax")
+    return sm, ("data",), ("softmax_label",)
+
+
+def token_accuracy(mod, it):
+    """Per-token next-token accuracy over one pass (padding excluded)."""
+    correct = total = 0
+    it.reset()
+    for batch in it:
+        mod.forward(batch, is_train=False)   # auto bucket switch
+        probs = mod.get_outputs()[0].asnumpy()      # (T*B, V)
+        labels = batch.label[0].asnumpy().T.reshape(-1)
+        mask = labels >= 0
+        pred = probs.argmax(axis=1)
+        correct += int((pred[mask] == labels[mask]).sum())
+        total += int(mask.sum())
+    return correct / max(total, 1)
+
+
+def main():
+    corpus = make_corpus()
+    train_it = mx.rnn.BucketSentenceIter(corpus, BATCH, buckets=BUCKETS)
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=max(BUCKETS))
+    mod.bind(data_shapes=train_it.provide_data,
+             label_shapes=train_it.provide_label, for_training=True)
+    mod.init_params(initializer=mx.init.Xavier())
+    mod.init_optimizer(optimizer="adam",
+                       optimizer_params={"learning_rate": 0.02})
+
+    acc0 = token_accuracy(mod, train_it)
+    for epoch in range(6):
+        train_it.reset()
+        for batch in train_it:
+            mod.forward_backward(batch)      # auto bucket switch
+            mod.update()
+        print(f"epoch {epoch} done")
+    acc = token_accuracy(mod, train_it)
+    assert acc > 0.9, f"bucketed LSTM failed to learn the ramp: {acc}"
+    assert acc > acc0 + 0.5
+    print(f"bucketing LSTM OK: accuracy {acc0:.3f} -> {acc:.3f}")
+
+
+if __name__ == "__main__":
+    main()

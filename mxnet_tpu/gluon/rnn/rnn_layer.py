@@ -16,7 +16,7 @@ from ...base import MXNetError
 from ...ndarray.ndarray import NDArray, apply_nary
 from ..block import HybridBlock
 
-__all__ = ["RNN", "LSTM", "GRU"]
+__all__ = ["RNN", "LSTM", "GRU", "run_fused_rnn"]
 
 
 def _cell_step(mode, x_t, states, wih, whh, bih, bhh):
@@ -50,6 +50,53 @@ def _cell_step(mode, x_t, states, wih, whh, bih, bhh):
         h_new = (1 - z) * n + z * h
         return h_new, (h_new,)
     raise MXNetError(f"unknown rnn mode {mode}")
+
+
+def run_fused_rnn(mode, data, state_arrs, weights, biases, num_layers,
+                  ndir, dropout=0.0, training=False, drop_key=None):
+    """The shared multi-layer (bi)directional recurrence core — ONE
+    lax.scan per direction. Called by both the gluon fused layer and the
+    packed-vector ``nd.RNN`` op, so the two stay equivalent by
+    construction (same gate order, dropout placement, carry shapes).
+
+    data: (T, B, I) sequence-major raw jax array. state_arrs: (h0[, c0])
+    each (L*ndir, B, H). weights/biases: per layer*dir lists of
+    (wih, whh) / (bih, bhh). Returns (out, h_stack[, c_stack]).
+    """
+    layer_in = data
+    h_out, c_out = [], []
+    for layer in range(num_layers):
+        dir_outs = []
+        for d in range(ndir):
+            idx = layer * ndir + d
+            wih, whh = weights[idx]
+            bih, bhh = biases[idx]
+            init = tuple(s[idx] for s in state_arrs)
+            seq = layer_in if d == 0 else jnp.flip(layer_in, 0)
+
+            def step(carry, x_t, wih=wih, whh=whh, bih=bih, bhh=bhh):
+                h_new, new_states = _cell_step(mode, x_t, carry,
+                                               wih, whh, bih, bhh)
+                return new_states, h_new
+
+            final, out_seq = lax.scan(step, init, seq)
+            if d == 1:
+                out_seq = jnp.flip(out_seq, 0)
+            dir_outs.append(out_seq)
+            h_out.append(final[0])
+            if mode == "lstm":
+                c_out.append(final[1])
+        layer_in = dir_outs[0] if ndir == 1 else \
+            jnp.concatenate(dir_outs, axis=-1)
+        if dropout and training and layer < num_layers - 1:
+            keep = jax.random.bernoulli(
+                jax.random.fold_in(drop_key, layer),
+                1.0 - dropout, layer_in.shape)
+            layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
+    outs = (layer_in, jnp.stack(h_out))
+    if mode == "lstm":
+        outs = outs + (jnp.stack(c_out),)
+    return outs
 
 
 class _RNNLayer(HybridBlock):
@@ -157,41 +204,16 @@ class _RNNLayer(HybridBlock):
             state_arrs = flat[:n_states]
             weight_arrs = flat[n_states:]
             data = x if layout == "TNC" else jnp.swapaxes(x, 0, 1)
-            layer_in = data
-            h_out, c_out = [], []
-            wi = 0
-            for layer in range(num_layers):
-                dir_outs = []
-                for d in range(ndir):
-                    wih, whh, bih, bhh = weight_arrs[wi:wi + 4]
-                    wi += 4
-                    idx = layer * ndir + d
-                    init = tuple(s[idx] for s in state_arrs)
-                    seq = layer_in if d == 0 else jnp.flip(layer_in, 0)
-
-                    def step(carry, x_t):
-                        h_new, new_states = _cell_step(mode, x_t, carry,
-                                                       wih, whh, bih, bhh)
-                        return new_states, h_new
-                    final, out_seq = lax.scan(step, init, seq)
-                    if d == 1:
-                        out_seq = jnp.flip(out_seq, 0)
-                    dir_outs.append(out_seq)
-                    h_out.append(final[0])
-                    if mode == "lstm":
-                        c_out.append(final[1])
-                layer_in = dir_outs[0] if ndir == 1 else \
-                    jnp.concatenate(dir_outs, axis=-1)
-                if dropout and training and layer < num_layers - 1:
-                    keep = jax.random.bernoulli(
-                        jax.random.fold_in(drop_key, layer),
-                        1.0 - dropout, layer_in.shape)
-                    layer_in = jnp.where(keep, layer_in / (1.0 - dropout), 0.0)
-            out = layer_in if layout == "TNC" else jnp.swapaxes(layer_in, 0, 1)
-            outs = (out, jnp.stack(h_out))
-            if mode == "lstm":
-                outs = outs + (jnp.stack(c_out),)
-            return outs
+            weights = [(weight_arrs[i], weight_arrs[i + 1])
+                       for i in range(0, len(weight_arrs), 4)]
+            biases = [(weight_arrs[i + 2], weight_arrs[i + 3])
+                      for i in range(0, len(weight_arrs), 4)]
+            outs = run_fused_rnn(mode, data, state_arrs, weights, biases,
+                                 num_layers, ndir, dropout, training,
+                                 drop_key)
+            out = outs[0] if layout == "TNC" else \
+                jnp.swapaxes(outs[0], 0, 1)
+            return (out,) + outs[1:]
 
         n_out = 2 + (1 if mode == "lstm" else 0)
         results = apply_nary(fn, [inputs] + list(states) + params,

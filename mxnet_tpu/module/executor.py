@@ -90,10 +90,16 @@ class Executor:
             if s._op is None and s._outputs is None:
                 if s._name in shape_env:
                     return tuple(shape_env[s._name].shape)
+                declared = getattr(s, "_declared_shape", None)
+                if declared is not None:
+                    created[s._name] = declared
+                    shape_env[s._name] = jax.ShapeDtypeStruct(
+                        declared, jnp.float32)
+                    return declared
                 raise MXNetError(
                     f"cannot infer shape for unbound variable '{s._name}' "
-                    "(not produced by a parameterized op; bind it "
-                    "explicitly)")
+                    "(not produced by a parameterized op; declare "
+                    "var(shape=...) or bind it explicitly)")
             if s._outputs is not None:
                 return shape_of(s._outputs[0])
             return _infer_node(s)

@@ -1067,11 +1067,84 @@ def RNN(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, mode="lstm", bidirectional=False, p=0.0,
         state_outputs=False, projection_size=None, sequence_length=None,
         use_sequence_length=False):
-    """Fused RNN op is realised at the Gluon layer via lax.scan
-    (gluon/rnn/rnn_layer.py); this symbol exists for API-surface parity.
-    Reference: src/operator/rnn.cc."""
-    raise MXNetError("nd.RNN: use gluon.rnn.{RNN,LSTM,GRU} on the TPU "
-                     "rebuild (lax.scan-based fused path)")
+    """Fused multi-layer (bi)directional RNN over a FLAT parameter vector
+    (reference src/operator/rnn.cc / cuDNN RNN).
+
+    data: (T, B, I) sequence-major. parameters: the reference's packed
+    1-D vector — all weights first (per layer, per direction: W_i2h
+    [G*H, in], W_h2h [G*H, H]), then all biases in the same order
+    (b_i2h, b_h2h each [G*H]). state: (L*dir, B, H); state_cell for
+    lstm. Returns out (T, B, H*dir), plus final states when
+    state_outputs=True. The recurrence is ONE lax.scan per direction —
+    the same compiled shape the gluon fused layer uses (identical
+    _cell_step gate order, so gluon weights flattened into this layout
+    reproduce gluon outputs bit-for-bit)."""
+    if projection_size is not None or use_sequence_length:
+        raise MXNetError("nd.RNN: projection_size/use_sequence_length "
+                         "are not supported (reference cuDNN-only paths)")
+    from ..gluon.rnn.rnn_layer import run_fused_rnn
+    from .. import _tape
+    gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}.get(mode)
+    if gates is None:
+        raise MXNetError(f"nd.RNN: unknown mode {mode!r}")
+    if mode == "lstm" and state_cell is None:
+        raise MXNetError("nd.RNN: lstm mode requires state_cell")
+    dirs = 2 if bidirectional else 1
+    T, B, I = data.shape
+    H = int(state_size) if state_size else state.shape[-1]
+    if state.shape[0] != num_layers * dirs:
+        raise MXNetError(
+            f"nd.RNN: state has {state.shape[0]} layer slots, need "
+            f"num_layers*dirs = {num_layers * dirs}")
+    expected = _builtins.sum(          # `sum` is the reduction op here
+        gates * H * (I if layer == 0 else H * dirs) + gates * H * H
+        + 2 * gates * H
+        for layer in range(num_layers) for _ in range(dirs))
+    n_given = int(_np.prod(getattr(parameters, "shape", (len(parameters),))))
+    if n_given != expected:
+        raise MXNetError(
+            f"nd.RNN: packed parameter vector has {n_given} values, "
+            f"layout needs {expected} (mode={mode}, num_layers="
+            f"{num_layers}, bidirectional={bidirectional}, I={I}, H={H})")
+    training = _tape.is_training()
+    # hoist the dropout key OUT of the traced fn: tape replay re-executes
+    # fn, and a fresh next_key() there would regenerate different masks
+    drop_key = None
+    if p and training and num_layers > 1:
+        from . import random as _rnd
+        drop_key = _rnd.next_key()
+
+    def fn(x, w, *state_arrs):
+        # unpack the packed vector with static python offsets
+        offs = 0
+        weights, biases = [], []
+        for layer in range(num_layers):
+            in_sz = I if layer == 0 else H * dirs
+            for _ in range(dirs):
+                wih = w[offs:offs + gates * H * in_sz] \
+                    .reshape(gates * H, in_sz)
+                offs += gates * H * in_sz
+                whh = w[offs:offs + gates * H * H].reshape(gates * H, H)
+                offs += gates * H * H
+                weights.append((wih, whh))
+        for layer in range(num_layers):
+            for _ in range(dirs):
+                bih = w[offs:offs + gates * H]
+                offs += gates * H
+                bhh = w[offs:offs + gates * H]
+                offs += gates * H
+                biases.append((bih, bhh))
+        return run_fused_rnn(mode, x, state_arrs, weights, biases,
+                             num_layers, dirs, p, training, drop_key)
+
+    inputs = [data, _nd(parameters, data), state]
+    if mode == "lstm":
+        inputs.append(state_cell)
+    n_out = 3 if mode == "lstm" else 2
+    results = apply_nary(fn, inputs, n_out=n_out, name="RNN")
+    if state_outputs:
+        return results
+    return results[0]
 
 
 # ======================================================================

@@ -59,8 +59,13 @@ class Symbol:
 
     # -- construction ---------------------------------------------------
     @staticmethod
-    def _var(name, **kwargs):
+    def _var(name, shape=None, **kwargs):
         sym = Symbol(None, [], {}, name=name)
+        if shape is not None:
+            # reference mx.sym.var(shape=...): a declared shape lets the
+            # executor materialize vars no _PARAM_SHAPE_RULES entry covers
+            # (e.g. the packed RNN parameter vector)
+            sym._declared_shape = tuple(int(s) for s in shape)
         return sym
 
     @property
@@ -232,6 +237,12 @@ class Symbol:
             node = {"op": s._op or "null", "name": s._name,
                     "attrs": {k: str(v) for k, v in s._kwargs.items()},
                     "inputs": arg_ids}
+            declared = getattr(s, "_declared_shape", None)
+            if declared is not None:
+                # var(shape=...) must survive the round-trip or reloaded
+                # graphs can't materialize the variable (e.g. nd.RNN's
+                # packed parameter vector)
+                node["shape"] = list(declared)
             if s._attrs:
                 # AttrScope attrs (ctx_group etc.) must survive the json
                 # round-trip or reloaded models lose their model-parallel
@@ -305,7 +316,7 @@ def _apply_nd_op(opname, args, kwargs):
 
 
 def var(name, shape=None, dtype=None, init=None, **kwargs):
-    return Symbol._var(name)
+    return Symbol._var(name, shape=shape)
 
 
 Variable = var
@@ -331,7 +342,7 @@ def load_json(json_str):
     built = []
     for node in nodes:
         if node["op"] == "null":
-            v = var(node["name"])
+            v = var(node["name"], shape=node.get("shape"))
             if node.get("node_attrs"):
                 v._attrs = dict(node["node_attrs"])
             built.append(v)

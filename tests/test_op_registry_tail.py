@@ -343,3 +343,61 @@ def test_nadam_update_cumulative_schedule():
         w_ref -= lr * g_bar / (np.sqrt(v_ref / (1 - b2 ** t)) + eps)
         nd.nadam_update(w, nd.array([g]), m, v, lr=lr, t=t)
         np.testing.assert_allclose(w.asnumpy(), [w_ref], rtol=1e-6)
+
+
+def test_fused_rnn_op_matches_gluon_layer():
+    """nd.RNN (reference src/operator/rnn.cc packed-parameter fused op)
+    must reproduce the gluon fused layer bit-for-bit when fed the same
+    weights flattened into the reference layout."""
+    from mxnet_tpu import gluon
+
+    rng = np.random.RandomState(5)
+    T, B, I, H, L = 6, 3, 4, 5, 2
+    for mode, cls, bidir in (("lstm", gluon.rnn.LSTM, False),
+                             ("gru", gluon.rnn.GRU, True),
+                             ("rnn_relu", gluon.rnn.RNN, False)):
+        dirs = 2 if bidir else 1
+        layer = cls(H, num_layers=L, layout="TNC", bidirectional=bidir) \
+            if mode != "rnn_relu" else cls(H, num_layers=L, layout="TNC")
+        layer.initialize()
+        x = nd.array(rng.randn(T, B, I).astype(np.float32))
+        states = layer.begin_state(batch_size=B)
+        out_ref = layer(x, states)
+        out_ref, states_ref = out_ref if isinstance(out_ref, tuple) \
+            else (out_ref, None)
+
+        # flatten weights into the reference packed layout: all weights
+        # (layer-major, dir-major: i2h, h2h), then all biases
+        flat = []
+        dirl = ["l", "r"] if dirs == 2 else ["l"]
+        for part in ("weight", "bias"):
+            for li in range(L):
+                for d in dirl:
+                    for kind in ("i2h", "h2h"):
+                        arr = getattr(layer,
+                                      f"{d}{li}_{kind}_{part}").data()
+                        flat.append(arr.asnumpy().ravel())
+        params = nd.array(np.concatenate(flat))
+
+        kw = {}
+        if mode == "lstm":
+            kw["state_cell"] = states[1]
+        res = nd.RNN(x, params, states[0], num_layers=L, mode=mode,
+                     bidirectional=bidir, state_outputs=True,
+                     state_size=H, **kw)
+        out = res[0]
+        np.testing.assert_allclose(out.asnumpy(), out_ref.asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=mode)
+        # final hidden states also agree
+        np.testing.assert_allclose(res[1].asnumpy(),
+                                   states_ref[0].asnumpy(),
+                                   rtol=1e-5, atol=1e-6, err_msg=mode)
+    # grads flow through the packed vector (inputs fixed OUTSIDE the
+    # closure: the numeric check re-evaluates it many times)
+    xg = nd.array(rng.randn(3, 2, 4).astype(np.float32))
+    h0, c0 = nd.zeros((1, 2, 3)), nd.zeros((1, 2, 3))
+    check_numeric_gradient(
+        lambda pp: nd.RNN(xg, pp, h0, state_cell=c0, state_size=3,
+                          mode="lstm").sum(),
+        [nd.array(rng.randn(4 * 3 * 4 + 4 * 3 * 3 + 2 * 4 * 3)
+                  .astype(np.float32) * 0.1)])
