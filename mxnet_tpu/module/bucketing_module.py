@@ -27,7 +27,6 @@ class BucketingModule(BaseModule):
         self._buckets = {}
         self._curr_module = None
         self._curr_bucket_key = None
-        self._opt_config = None
 
     @property
     def symbol(self):
@@ -54,23 +53,76 @@ class BucketingModule(BaseModule):
         mod = self._gen_module(bucket_key)
         if not mod.binded:
             mod.bind(data_shapes, label_shapes, self.for_training)
-            if self._curr_module.params_initialized:
-                arg, aux = self._curr_module.get_params()
-                mod.init_params(arg_params=arg, aux_params=aux,
-                                force_init=True)
-                mod.params_initialized = True
-            if self._opt_config is not None:
-                mod.init_optimizer(**self._opt_config)
+            if self._buckets[self._default_bucket_key].params_initialized:
+                self._share_into(mod)
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
 
+    def _share_into(self, mod):
+        """All buckets train ONE parameter storage. The reference bound
+        bucket executors with shared_module (one memory pool); here the
+        new bucket's executor ADOPTS the default bucket's NDArray handles
+        — mutation-on-handle makes every optimizer update visible to
+        every bucket — and its optimizer/kvstore state, so momentum and
+        update counts don't fragment per bucket either."""
+        src = self._buckets[self._default_bucket_key]
+        src_args = src._exec.arg_dict
+        skip = set(getattr(mod, "_data_names", ())) | \
+            set(getattr(mod, "_label_names", ()))
+        for name in list(mod._exec.arg_dict):
+            if name in skip:
+                continue
+            if name not in src_args:
+                # reference constraint (bucketing_module.py shared exec
+                # groups): the default bucket's symbol must own EVERY
+                # parameter — a bucket-private param would train a silent
+                # uninitialized copy
+                raise MXNetError(
+                    f"bucket parameter '{name}' does not exist in the "
+                    f"default bucket ({self._default_bucket_key}); choose "
+                    "default_bucket_key so its symbol contains all "
+                    "parameters (reference BucketingModule requires the "
+                    "same)")
+            if tuple(mod._exec.arg_dict[name].shape) != \
+                    tuple(src_args[name].shape):
+                raise MXNetError(
+                    f"bucket parameter '{name}' has shape "
+                    f"{mod._exec.arg_dict[name].shape} but the shared "
+                    f"storage is {src_args[name].shape}; sym_gen must "
+                    "produce length-independent parameters")
+            mod._exec.arg_dict[name] = src_args[name]
+        mod.params_initialized = True
+        if src.optimizer_initialized:
+            if mod._trainable_names() != src._trainable_names():
+                # updater state and kvstore keys are positional indices
+                # into list_arguments() — a different order would apply
+                # momentum to the wrong weights
+                raise MXNetError(
+                    "bucket symbols list their parameters in a different "
+                    "order than the default bucket; sym_gen must build "
+                    "the graph deterministically so argument order "
+                    "matches across buckets")
+            mod._optimizer = src._optimizer
+            mod._updater_states = src._updater_states
+            mod._kvstore = src._kvstore
+            mod._update_on_kvstore = src._update_on_kvstore
+            mod._batch_size = src._batch_size
+            mod.optimizer_initialized = True
+
     def init_params(self, **kwargs):
-        self._curr_module.init_params(**kwargs)
+        # params live on the DEFAULT bucket's module; every other bucket
+        # shares its handles (see _share_into)
+        self._buckets[self._default_bucket_key].init_params(**kwargs)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key and mod.binded:
+                self._share_into(mod)
         self.params_initialized = True
 
     def init_optimizer(self, **kwargs):
-        self._opt_config = kwargs
-        self._curr_module.init_optimizer(**kwargs)
+        self._buckets[self._default_bucket_key].init_optimizer(**kwargs)
+        for key, mod in self._buckets.items():
+            if key != self._default_bucket_key and mod.binded:
+                self._share_into(mod)
         self.optimizer_initialized = True
 
     def forward(self, data_batch, is_train=None):
@@ -85,8 +137,10 @@ class BucketingModule(BaseModule):
         self._curr_module.backward(out_grads)
 
     def update(self):
+        # all buckets alias ONE parameter storage (_share_into adopts the
+        # default bucket's NDArray handles), so updating through the
+        # current bucket updates every bucket
         self._curr_module.update()
-        # weights are shared through get/set on switch; nothing else needed
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._curr_module.update_metric(eval_metric, labels)

@@ -349,3 +349,50 @@ def test_group2ctxs_manual_model_parallel():
     m = mx.metric.Accuracy()
     mod.score(val, m)
     assert m.get()[1] > 0.9
+
+
+def test_bucketing_module_shares_params_across_buckets():
+    """Reference BucketingModule binds bucket executors with shared
+    storage: training on one bucket MUST be visible in every other
+    (round-4 fix: buckets previously trained private copies)."""
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        fc = mx.sym.FullyConnected(data, num_hidden=4, name="fc_shared",
+                                   flatten=False)
+        pooled = mx.sym.mean(fc, axis=1, name="pool")
+        out = mx.sym.SoftmaxOutput(pooled, name="softmax")
+        return out, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=16)
+    mod.bind(data_shapes=[("data", (2, 16, 6))],
+             label_shapes=[("softmax_label", (2,))])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    def batch(key):
+        return mx.io.DataBatch(
+            data=[nd.ones((2, key, 6))], label=[nd.zeros((2,))],
+            bucket_key=key,
+            provide_data=[mx.io.DataDesc("data", (2, key, 6))],
+            provide_label=[mx.io.DataDesc("softmax_label", (2,))])
+
+    mod.forward(batch(8), is_train=False)    # bucket 8 exists up front
+    before = mod._buckets[8]._exec.arg_dict["fc_shared_weight"] \
+        .asnumpy().copy()
+    for _ in range(5):
+        mod.forward_backward(batch(16))
+        mod.update()
+    w16 = mod._buckets[16]._exec.arg_dict["fc_shared_weight"].asnumpy()
+    assert not np.allclose(w16, before)
+    w8 = mod._buckets[8]._exec.arg_dict["fc_shared_weight"].asnumpy()
+    np.testing.assert_array_equal(w8, w16)
+    # and the other direction, optimizer state shared too
+    for _ in range(2):
+        mod.forward_backward(batch(8))
+        mod.update()
+    np.testing.assert_array_equal(
+        mod._buckets[16]._exec.arg_dict["fc_shared_weight"].asnumpy(),
+        mod._buckets[8]._exec.arg_dict["fc_shared_weight"].asnumpy())
+    assert mod._buckets[8]._updater_states is \
+        mod._buckets[16]._updater_states
