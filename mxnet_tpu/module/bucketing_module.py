@@ -52,56 +52,39 @@ class BucketingModule(BaseModule):
     def switch_bucket(self, bucket_key, data_shapes, label_shapes=None):
         mod = self._gen_module(bucket_key)
         if not mod.binded:
-            mod.bind(data_shapes, label_shapes, self.for_training)
-            if self._buckets[self._default_bucket_key].params_initialized:
+            src = self._buckets[self._default_bucket_key]
+            # shared_module bind: the bucket executor adopts the default
+            # bucket's parameter arrays directly (no throwaway zero
+            # allocation) — reference BucketingModule does the same
+            mod.bind(data_shapes, label_shapes, self.for_training,
+                     shared_module=src if src.params_initialized else None)
+            if src.params_initialized:
                 self._share_into(mod)
         self._curr_module = mod
         self._curr_bucket_key = bucket_key
 
     def _share_into(self, mod):
-        """All buckets train ONE parameter storage. The reference bound
-        bucket executors with shared_module (one memory pool); here the
-        new bucket's executor ADOPTS the default bucket's NDArray handles
-        — mutation-on-handle makes every optimizer update visible to
-        every bucket — and its optimizer/kvstore state, so momentum and
-        update counts don't fragment per bucket either."""
+        """All buckets train ONE parameter storage (adopted at bind via
+        shared_module, or here for buckets bound before init_params) and
+        ONE optimizer/kvstore state. State keys are parameter NAMES
+        (Module.update), so buckets whose parameters are a SUBSET of the
+        default bucket's work like the reference."""
         src = self._buckets[self._default_bucket_key]
         src_args = src._exec.arg_dict
-        skip = set(getattr(mod, "_data_names", ())) | \
-            set(getattr(mod, "_label_names", ()))
+        io_names = set(mod._data_names) | set(mod._label_names)
         for name in list(mod._exec.arg_dict):
-            if name in skip:
+            if name in io_names:
                 continue
             if name not in src_args:
-                # reference constraint (bucketing_module.py shared exec
-                # groups): the default bucket's symbol must own EVERY
-                # parameter — a bucket-private param would train a silent
-                # uninitialized copy
                 raise MXNetError(
                     f"bucket parameter '{name}' does not exist in the "
                     f"default bucket ({self._default_bucket_key}); choose "
-                    "default_bucket_key so its symbol contains all "
-                    "parameters (reference BucketingModule requires the "
+                    "default_bucket_key so its symbol owns every "
+                    "parameter (reference BucketingModule requires the "
                     "same)")
-            if tuple(mod._exec.arg_dict[name].shape) != \
-                    tuple(src_args[name].shape):
-                raise MXNetError(
-                    f"bucket parameter '{name}' has shape "
-                    f"{mod._exec.arg_dict[name].shape} but the shared "
-                    f"storage is {src_args[name].shape}; sym_gen must "
-                    "produce length-independent parameters")
             mod._exec.arg_dict[name] = src_args[name]
         mod.params_initialized = True
         if src.optimizer_initialized:
-            if mod._trainable_names() != src._trainable_names():
-                # updater state and kvstore keys are positional indices
-                # into list_arguments() — a different order would apply
-                # momentum to the wrong weights
-                raise MXNetError(
-                    "bucket symbols list their parameters in a different "
-                    "order than the default bucket; sym_gen must build "
-                    "the graph deterministically so argument order "
-                    "matches across buckets")
             mod._optimizer = src._optimizer
             mod._updater_states = src._updater_states
             mod._kvstore = src._kvstore
@@ -149,4 +132,6 @@ class BucketingModule(BaseModule):
         return self._curr_module.get_outputs()
 
     def get_params(self):
-        return self._curr_module.get_params()
+        # params live on the DEFAULT bucket's module (the superset);
+        # reading from a subset bucket would drop parameters silently
+        return self._buckets[self._default_bucket_key].get_params()

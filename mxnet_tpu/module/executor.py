@@ -17,6 +17,16 @@ from .. import autograd
 __all__ = ["Executor"]
 
 
+class _SymSlot:
+    """Marks a symbol-input position (with its inferred shape) during
+    shape materialization, so literal tuple arguments survive."""
+
+    __slots__ = ("shape",)
+
+    def __init__(self, shape):
+        self.shape = tuple(shape)
+
+
 class Executor:
     def __init__(self, symbol, ctx=None, shapes=None, args=None,
                  args_grad=None, grad_req="write", label_shapes=None,
@@ -144,20 +154,23 @@ class Executor:
             arg_protos = []
             for a in s._args:
                 if isinstance(a, Symbol):
-                    arg_protos.append(shape_of(a))
+                    # marker class, NOT a raw tuple: literal tuple args
+                    # (e.g. reshape's positional shape) must pass through
+                    # untouched instead of being mistaken for array slots
+                    arg_protos.append(_SymSlot(shape_of(a)))
                 else:
                     arg_protos.append(a)
 
             def run(*arrs):
                 it = iter(arrs)
-                vals = [NDArray(next(it)) if isinstance(p, tuple) else p
+                vals = [NDArray(next(it)) if isinstance(p, _SymSlot) else p
                         for p in arg_protos]
                 out = _apply_nd_op(s._op, vals, s._kwargs)
                 outs = out if isinstance(out, list) else [out]
                 return tuple(o.data for o in outs)
 
-            protos = [jax.ShapeDtypeStruct(p, jnp.float32)
-                      for p in arg_protos if isinstance(p, tuple)]
+            protos = [jax.ShapeDtypeStruct(p.shape, jnp.float32)
+                      for p in arg_protos if isinstance(p, _SymSlot)]
             with _tape.trace_scope():
                 out_shapes = jax.eval_shape(run, *protos)
             shape = tuple(out_shapes[s._out_index or 0].shape)

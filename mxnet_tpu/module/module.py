@@ -217,8 +217,27 @@ class Module(BaseModule):
                 reqs[n] = "null"
             else:
                 reqs[n] = grad_req
+        shared_args = None
+        if shared_module is not None:
+            # reference shared_module bind: this executor ADOPTS the other
+            # module's parameter arrays (one storage, mutation-on-handle)
+            # instead of allocating its own; the shared module's symbol
+            # must own every parameter of this one
+            io_names = set(self._data_names) | set(self._label_names)
+            src = shared_module._exec.arg_dict
+            shared_args = {n: src[n] for n in arg_names
+                           if n in src and n not in io_names}
+            missing = [n for n in arg_names
+                       if n not in io_names and n not in src]
+            if missing:
+                raise MXNetError(
+                    f"shared_module does not own parameters {missing}; "
+                    "the sharing module's symbol must be a parameter "
+                    "superset (reference Module.bind(shared_module=...) "
+                    "requires the same)")
         self._exec = Executor(self.symbol, self._context, shapes,
-                              grad_req=reqs, group2ctxs=self._group2ctxs)
+                              args=shared_args, grad_req=reqs,
+                              group2ctxs=self._group2ctxs)
         # parameter shapes follow from the data shapes via the executor's
         # InferShape remnant (SURVEY.md §2.1 Symbol/nnvm row)
         self._exec._materialize_params()
@@ -352,12 +371,11 @@ class Module(BaseModule):
             # broadcast rank 0's values (bucketed — one collective per
             # 25MB, not per param) so all workers start identical
             # (SURVEY.md §3.5 "worker 0: kv.init -> broadcast")
-            names = self._trainable_names()
-            keys = list(range(len(names)))
+            names = self._trainable_names()   # name keys, see update()
             arrs = [self._exec.arg_dict[n] for n in names]
-            self._kvstore.init(keys, arrs)
+            self._kvstore.init(names, arrs)
             if self._kvstore.num_workers > 1:
-                self._kvstore.pull(keys, out=arrs)
+                self._kvstore.pull(names, out=arrs)
         self.optimizer_initialized = True
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
@@ -393,12 +411,15 @@ class Module(BaseModule):
         optimizer applies it — either way N dist workers stay bitwise in
         step (r2 missing #4a)."""
         assert self.optimizer_initialized
+        # keys are parameter NAMES (not positions): updater state and
+        # kvstore slots then stay correct when modules with different
+        # parameter subsets share an optimizer (BucketingModule buckets)
         keys, arrs, grads = [], [], []
-        for i, name in enumerate(self._trainable_names()):
+        for name in self._trainable_names():
             grad = self._exec.grad_dict.get(name)
             if grad is None:
                 continue
-            keys.append(i)
+            keys.append(name)
             arrs.append(self._exec.arg_dict[name])
             grads.append(grad)
         if not keys:
@@ -412,10 +433,12 @@ class Module(BaseModule):
             return
         if self._kvstore is not None:
             self._kvstore.pushpull(keys, grads, out=grads)
-        for i, arr, grad in zip(keys, arrs, grads):
-            if i not in self._updater_states:
-                self._updater_states[i] = self._optimizer.create_state(i, arr)
-            self._optimizer.update(i, arr, grad, self._updater_states[i])
+        for name, arr, grad in zip(keys, arrs, grads):
+            if name not in self._updater_states:
+                self._updater_states[name] = \
+                    self._optimizer.create_state(name, arr)
+            self._optimizer.update(name, arr, grad,
+                                   self._updater_states[name])
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         eval_metric.update(labels, self.get_outputs())
@@ -455,11 +478,11 @@ class Module(BaseModule):
         if self._update_on_kvstore and self._kvstore is not None:
             return self._kvstore.save_optimizer_states(fname)
         flat = {}
-        for idx, st in self._updater_states.items():
+        for name, st in self._updater_states.items():
             comps = st if isinstance(st, (list, tuple)) else [st]
             for j, c in enumerate(comps):
                 if c is not None:
-                    flat[f"state:{idx}:{j}"] = c
+                    flat[f"state:{j}:{name}"] = c
         nd_utils.save(fname, flat)
 
     def load_optimizer_states(self, fname):
@@ -469,13 +492,12 @@ class Module(BaseModule):
             return self._kvstore.load_optimizer_states(fname)
         loaded = nd_utils.load(fname)
         for key, arr in loaded.items():
-            _, idx, j = key.split(":")
-            idx, j = int(idx), int(j)
-            if idx not in self._updater_states:
-                name = self._trainable_names()[idx]
-                self._updater_states[idx] = self._optimizer.create_state(
-                    idx, self._exec.arg_dict[name])
-            st = self._updater_states[idx]
+            _, j, name = key.split(":", 2)
+            j = int(j)
+            if name not in self._updater_states:
+                self._updater_states[name] = self._optimizer.create_state(
+                    name, self._exec.arg_dict[name])
+            st = self._updater_states[name]
             target = st[j] if isinstance(st, (list, tuple)) else st
             target._set_data(arr.data)
 
