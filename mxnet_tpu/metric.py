@@ -17,15 +17,16 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["EvalMetric", "CompositeEvalMetric", "Accuracy", "TopKAccuracy",
            "F1", "MCC", "MAE", "MSE", "RMSE", "CrossEntropy", "Perplexity",
-           "PearsonCorrelation", "Loss", "CustomMetric", "np", "create",
-           "register"]
+           "NegativeLogLikelihood", "PearsonCorrelation", "Loss",
+           "CustomMetric", "np", "create", "register"]
 
 register, _create_registered, _REGISTRY = registry_create("metric")
 
 
 # short names the reference accepts (python/mxnet/metric.py aliases)
 _ALIASES = {"acc": "accuracy", "ce": "crossentropy",
-            "top_k_acc": "topkaccuracy", "top_k_accuracy": "topkaccuracy"}
+            "top_k_acc": "topkaccuracy", "top_k_accuracy": "topkaccuracy",
+            "nll_loss": "negativeloglikelihood"}
 
 
 def create(metric, *args, **kwargs):
@@ -291,6 +292,17 @@ class CrossEntropy(EvalMetric):
             prob = pred[_np.arange(label.shape[0]), label]
             self.sum_metric += float((-_np.log(prob + self.eps)).sum())
             self.num_inst += label.shape[0]
+
+
+@register
+class NegativeLogLikelihood(CrossEntropy):
+    """Reference metric.NegativeLogLikelihood: same accumulation as
+    CrossEntropy under its canonical name/alias."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", output_names=None,
+                 label_names=None):
+        super().__init__(eps=eps, name=name, output_names=output_names,
+                         label_names=label_names)
 
 
 @register

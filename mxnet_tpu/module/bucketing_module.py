@@ -82,8 +82,25 @@ class BucketingModule(BaseModule):
                     "default_bucket_key so its symbol owns every "
                     "parameter (reference BucketingModule requires the "
                     "same)")
+            if tuple(mod._exec.arg_dict[name].shape) != \
+                    tuple(src_args[name].shape):
+                raise MXNetError(
+                    f"bucket parameter '{name}' has shape "
+                    f"{mod._exec.arg_dict[name].shape} but the shared "
+                    f"storage is {src_args[name].shape}; sym_gen must "
+                    "produce length-independent parameters")
             mod._exec.arg_dict[name] = src_args[name]
         mod.params_initialized = True
+        if src._kvstore is not None and src._kvstore.num_workers > 1 and \
+                set(mod._trainable_names()) != set(src._trainable_names()):
+            # multi-process sync stores allreduce a coalesced bucket per
+            # step: workers on different buckets pushing different key
+            # sets would desynchronize the collective
+            raise MXNetError(
+                "bucket symbols use different parameter SETS; with a "
+                "multi-worker sync kvstore every bucket must push the "
+                "same keys (use identical parameters across buckets, or "
+                "dist_async)")
         if src.optimizer_initialized:
             mod._optimizer = src._optimizer
             mod._updater_states = src._updater_states

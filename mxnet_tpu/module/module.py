@@ -219,6 +219,11 @@ class Module(BaseModule):
                 reqs[n] = grad_req
         shared_args = None
         if shared_module is not None:
+            if shared_module._exec is None:
+                raise MXNetError(
+                    "shared_module must be bound (and initialized) before "
+                    "it can share parameters — reference Module.bind "
+                    "asserts the same precondition")
             # reference shared_module bind: this executor ADOPTS the other
             # module's parameter arrays (one storage, mutation-on-handle)
             # instead of allocating its own; the shared module's symbol
@@ -486,14 +491,31 @@ class Module(BaseModule):
         nd_utils.save(fname, flat)
 
     def load_optimizer_states(self, fname):
-        """Reference Module.load_optimizer_states (after init_optimizer)."""
+        """Reference Module.load_optimizer_states (after init_optimizer).
+        Accepts both the current name-keyed format (state:<j>:<name>) and
+        the earlier positional one (state:<idx>:<j>); kvstore-side states
+        saved with positional keys are remapped to names on load."""
         assert self.optimizer_initialized, "init_optimizer first"
+        names = self._trainable_names()
         if self._update_on_kvstore and self._kvstore is not None:
-            return self._kvstore.load_optimizer_states(fname)
+            self._kvstore.load_optimizer_states(fname)
+            updater = self._kvstore._updater
+            remapped = {}
+            for k, v in updater.states.items():
+                if isinstance(k, int) and 0 <= k < len(names):
+                    remapped[names[k]] = v      # legacy positional key
+                else:
+                    remapped[k] = v
+            updater.states = remapped
+            return
         loaded = nd_utils.load(fname)
         for key, arr in loaded.items():
-            _, j, name = key.split(":", 2)
-            j = int(j)
+            _, a, b = key.split(":", 2)
+            if b.isdigit():
+                # legacy state:<idx>:<j>
+                name, j = names[int(a)], int(b)
+            else:
+                j, name = int(a), b
             if name not in self._updater_states:
                 self._updater_states[name] = self._optimizer.create_state(
                     name, self._exec.arg_dict[name])

@@ -238,3 +238,26 @@ def with_seed(seed=None):
                 raise
         return wrapper
     return decorate
+
+
+def list_gpus():
+    """Reference test_utils.list_gpus: usable GPU indices. This build
+    targets TPU — there are never CUDA GPUs; TPU devices live behind
+    mx.tpu()/mx.context.num_tpus()."""
+    return []
+
+
+def download(url, fname=None, dirname=None, overwrite=False):
+    """Reference test_utils.download (test-data fetcher). Zero-egress
+    build: resolves only files that already exist locally."""
+    import os as _os
+    fname = fname or url.split("/")[-1]
+    if dirname:
+        fname = _os.path.join(dirname, fname)
+    if _os.path.exists(fname):
+        # overwrite would require re-fetching, which this build cannot do;
+        # the existing local copy is the only usable answer either way
+        return fname
+    raise MXNetError(
+        f"download() is unavailable (no network access) and {fname!r} "
+        "does not exist locally; place the file there first.")
