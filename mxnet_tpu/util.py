@@ -5,25 +5,47 @@ import functools
 import os
 
 __all__ = ["makedirs", "set_np", "reset_np", "is_np_array", "use_np",
+           "set_np_shape", "is_np_shape", "use_np_shape", "use_np_array",
            "getenv", "setenv", "get_gpu_count", "get_gpu_memory"]
 
 _NP_ARRAY = False
+_NP_SHAPE = False
 
 
 def makedirs(d):
     os.makedirs(os.path.expanduser(d), exist_ok=True)
 
 
+def set_np_shape(active=True):
+    """Reference util.set_np_shape: zero-dim/zero-size shape semantics.
+    jax.numpy always HAS them; the flag tracks the user intent so
+    is_np_shape() answers like the reference. Returns the previous
+    setting."""
+    global _NP_SHAPE
+    prev = _NP_SHAPE
+    _NP_SHAPE = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _NP_SHAPE
+
+
 def set_np(shape=True, array=True):
-    """numpy-semantics switch. jax.numpy is already numpy-semantics, so this
-    only flips the flag consulted by is_np_array()."""
+    """numpy-semantics switch. jax.numpy is already numpy-semantics, so
+    this only maintains the two flags — linked like the reference, which
+    forbids array semantics without shape semantics."""
+    if array and not shape:
+        raise ValueError(
+            "np-array semantics require np-shape semantics "
+            "(reference util.set_np raises the same)")
     global _NP_ARRAY
+    set_np_shape(shape)
     _NP_ARRAY = array
 
 
 def reset_np():
-    global _NP_ARRAY
-    _NP_ARRAY = False
+    set_np(shape=False, array=False)
 
 
 def is_np_array():
@@ -31,15 +53,35 @@ def is_np_array():
 
 
 def use_np(func):
+    """Run func under full numpy semantics (shape + array), restoring the
+    previous flags after (reference @use_np = use_np_shape + use_np_array)."""
     @functools.wraps(func)
     def wrapper(*args, **kwargs):
-        prev = _NP_ARRAY
+        global _NP_ARRAY
+        prev_array, prev_shape = _NP_ARRAY, _NP_SHAPE
         set_np()
         try:
             return func(*args, **kwargs)
         finally:
-            set_np(array=prev)
+            set_np_shape(prev_shape)
+            _NP_ARRAY = prev_array
     return wrapper
+
+
+def use_np_shape(func):
+    """Reference decorator: run func under numpy shape semantics."""
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        prev = set_np_shape(True)
+        try:
+            return func(*args, **kwargs)
+        finally:
+            set_np_shape(prev)
+    return wrapper
+
+
+# array semantics imply shape semantics here exactly as in @use_np
+use_np_array = use_np
 
 
 def getenv(name):
