@@ -327,3 +327,37 @@ def test_module_optimizer_states_via_kvstore(tmp_path):
                               sorted(mod2.get_params()[0].items())):
         np.testing.assert_allclose(a.asnumpy(), b.asnumpy(), rtol=1e-6,
                                    err_msg=k)
+
+
+def test_util_np_flags_linked():
+    """set_np/set_np_shape keep linked flags like the reference (array
+    semantics require shape semantics)."""
+    u = mx.util
+    u.reset_np()
+    assert not u.is_np_shape() and not u.is_np_array()
+    with pytest.raises(ValueError):
+        u.set_np(shape=False, array=True)
+    u.set_np()
+    assert u.is_np_shape() and u.is_np_array()
+    u.reset_np()
+
+    @u.use_np
+    def f():
+        return u.is_np_shape(), u.is_np_array()
+
+    assert f() == (True, True)
+    assert (u.is_np_shape(), u.is_np_array()) == (False, False)
+    assert u.use_np_array is u.use_np
+
+
+def test_test_utils_download_and_list_gpus(tmp_path):
+    assert mx.test_utils.list_gpus() == []
+    p = tmp_path / "blob.bin"
+    p.write_bytes(b"x")
+    assert mx.test_utils.download("http://host/blob.bin",
+                                  fname=str(p)) == str(p)
+    assert mx.test_utils.download("http://host/blob.bin", fname=str(p),
+                                  overwrite=True) == str(p)
+    with pytest.raises(mx.MXNetError):
+        mx.test_utils.download("http://host/missing.bin",
+                               fname=str(tmp_path / "missing.bin"))
