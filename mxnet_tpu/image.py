@@ -16,12 +16,14 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, array
 from . import ndarray as nd
 
-__all__ = ["imdecode", "imencode", "imresize", "resize_short", "fixed_crop",
-           "center_crop", "random_crop", "color_normalize", "ImageIter",
-           "CreateAugmenter", "Augmenter", "ResizeAug", "ForceResizeAug",
-           "RandomCropAug", "CenterCropAug", "HorizontalFlipAug", "CastAug",
-           "ColorNormalizeAug", "ImageDetIter", "CreateDetAugmenter",
-           "DetHorizontalFlipAug", "DetBorrowAug"]
+__all__ = ["imdecode", "imencode", "imread", "imresize", "resize_short",
+           "fixed_crop", "center_crop", "random_crop", "color_normalize",
+           "ImageIter", "CreateAugmenter", "Augmenter", "ResizeAug",
+           "ForceResizeAug", "RandomCropAug", "CenterCropAug",
+           "HorizontalFlipAug", "CastAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "HueJitterAug",
+           "RandomGrayAug", "ColorNormalizeAug", "ImageDetIter",
+           "CreateDetAugmenter", "DetHorizontalFlipAug", "DetBorrowAug"]
 
 
 def _get_backend():
@@ -200,6 +202,102 @@ class HorizontalFlipAug(Augmenter):
         return src
 
 
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-b, b) (reference image.BrightnessJitterAug)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.brightness, self.brightness)
+        return src * alpha
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend toward the mean gray level (reference ContrastJitterAug)."""
+
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.contrast, self.contrast)
+        a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+        gray = (a * self._coef).sum(axis=-1, keepdims=True)
+        out = a * alpha + gray.mean() * (1.0 - alpha)
+        return array(out.astype(a.dtype))
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend toward per-pixel gray (reference SaturationJitterAug)."""
+
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + _np.random.uniform(-self.saturation, self.saturation)
+        a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+        gray = (a * self._coef).sum(axis=-1, keepdims=True)
+        return array((a * alpha + gray * (1.0 - alpha)).astype(a.dtype))
+
+
+class HueJitterAug(Augmenter):
+    """Rotate hue in YIQ space (reference HueJitterAug, the tyiq trick)."""
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+        self.tyiq = _np.array([[0.299, 0.587, 0.114],
+                               [0.596, -0.274, -0.321],
+                               [0.211, -0.523, 0.311]], _np.float32)
+        self.ityiq = _np.array([[1.0, 0.956, 0.621],
+                                [1.0, -0.272, -0.647],
+                                [1.0, -1.107, 1.705]], _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.uniform(-self.hue, self.hue)
+        u, w = _np.cos(alpha * _np.pi), _np.sin(alpha * _np.pi)
+        bt = _np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], _np.float32)
+        t = _np.dot(_np.dot(self.ityiq, bt), self.tyiq).T
+        a = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+        return array(_np.dot(a, t).astype(a.dtype))
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p collapse to 3-channel gray (reference
+    RandomGrayAug)."""
+
+    _coef = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if _np.random.rand() < self.p:
+            a = src.asnumpy() if isinstance(src, NDArray) \
+                else _np.asarray(src)
+            gray = (a * self._coef).sum(axis=-1, keepdims=True)
+            return array(_np.broadcast_to(gray, a.shape)
+                         .astype(a.dtype).copy())
+        return src
+
+
+def imread(filename, flag=1, to_rgb=True):
+    """Read an image file -> HWC uint8 NDArray (reference mx.image.imread
+    over cv::imread)."""
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag=flag, to_rgb=to_rgb)
+
+
 class ColorNormalizeAug(Augmenter):
     """(src - mean) / std (reference: image.ColorNormalizeAug)."""
 
@@ -239,6 +337,16 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if rand_gray:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = array([123.68, 116.28, 103.53])
     if std is True:

@@ -243,3 +243,110 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"parameter {name} did not match any pattern")
+
+
+@register
+class Load(Initializer):
+    """Initialize from a dict (or .params file) of pre-trained arrays,
+    delegating to ``default_init`` for missing names (reference
+    initializer.Load; used to warm-start from checkpoints)."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        super().__init__()
+        if isinstance(param, str):
+            from .ndarray.utils import load as _load
+            param = _load(param)
+        if not isinstance(param, dict):
+            raise MXNetError(
+                "Load initializer requires NAMED arrays (a dict or a "
+                ".params file saved with names)")
+        self.param = {(k[4:] if k.startswith(("arg:", "aux:")) else k): v
+                      for k, v in param.items()}
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, desc, arr):
+        name = desc if isinstance(desc, str) else str(desc)
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Load initializer: parameter {name} has shape "
+                    f"{tuple(arr.shape)} but the source is "
+                    f"{tuple(src.shape)}")
+            # accept NDArray or raw numpy; cast to the PARAM's dtype like
+            # the reference's arr[:] = src assignment
+            raw = getattr(src, "data", src)
+            arr._set_data(jnp.asarray(raw, arr.data.dtype))
+            if self.verbose:
+                import logging
+                logging.info("Initialized %s by loading", name)
+        else:
+            if self.default_init is None:
+                raise MXNetError(
+                    f"Load initializer: no value for {name} and no "
+                    "default_init given")
+            self.default_init(desc, arr)
+
+
+@register
+class FusedRNN(Initializer):
+    """Initialize the PACKED fused-RNN parameter vector (nd.RNN layout:
+    all weights layer/direction-major, then all biases — reference
+    initializer.FusedRNN over rnn_cell.FusedRNNCell). Weight chunks use
+    the wrapped initializer; biases are zeros except the LSTM forget
+    gate, set to ``forget_bias``."""
+
+    def __init__(self, init, num_hidden, num_layers, mode,
+                 bidirectional=False, forget_bias=1.0):
+        super().__init__()
+        if isinstance(init, str):
+            init = create(init)
+        self._init = init
+        self._nh = num_hidden
+        self._nl = num_layers
+        self._mode = mode
+        self._bidir = bidirectional
+        self._forget_bias = forget_bias
+        self._gates = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4,
+                       "gru": 3}[mode]
+
+    def _init_weight(self, name, arr):
+        import numpy as _onp
+        g, nh, nl = self._gates, self._nh, self._nl
+        dirs = 2 if self._bidir else 1
+        total = int(arr.shape[0]) if len(arr.shape) == 1 else int(
+            _onp.prod(arr.shape))
+        # infer the input size from the packed length
+        #   total = sum_l dirs*(g*nh*in_l + g*nh*nh) + nl*dirs*2*g*nh
+        fixed = nl * dirs * (g * nh * nh) + nl * dirs * 2 * g * nh \
+            + (nl - 1) * dirs * (g * nh * (nh * dirs))
+        rem = total - fixed
+        if rem <= 0 or rem % (dirs * g * nh):
+            raise MXNetError(
+                f"FusedRNN: packed length {total} inconsistent with "
+                f"mode={self._mode} num_hidden={nh} num_layers={nl} "
+                f"bidirectional={self._bidir}")
+        in0 = rem // (dirs * g * nh)
+        out = _onp.empty((total,), _onp.float32)
+        offs = 0
+        for layer in range(nl):
+            in_sz = in0 if layer == 0 else nh * dirs
+            for _ in range(dirs):
+                for rows, cols in ((g * nh, in_sz), (g * nh, nh)):
+                    from .ndarray.ndarray import NDArray
+                    chunk = NDArray(jnp.zeros((rows, cols), jnp.float32))
+                    self._init._init_weight(name, chunk)
+                    out[offs:offs + rows * cols] = \
+                        chunk.asnumpy().ravel()
+                    offs += rows * cols
+        for layer in range(nl):
+            for _ in range(dirs):
+                for _bias in range(2):
+                    b = _onp.zeros((g * nh,), _onp.float32)
+                    if self._mode == "lstm":
+                        # gate order i,f,g,o: forget gate is chunk 1
+                        b[nh:2 * nh] = self._forget_bias
+                    out[offs:offs + g * nh] = b
+                    offs += g * nh
+        arr._set_data(jnp.asarray(out, arr.data.dtype))

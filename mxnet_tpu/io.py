@@ -12,6 +12,7 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray, array, concatenate
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
+           "LibSVMIter",
            "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter"]
 
 
@@ -175,6 +176,93 @@ class CSVIter(NDArrayIter):
             if label_shape:
                 label = label.reshape((-1,) + tuple(label_shape))
         super().__init__(data, label, batch_size, **kwargs)
+
+
+class LibSVMIter(DataIter):
+    """Reference: io.LibSVMIter (src/io/iter_libsvm.cc) — sparse
+    ``label index:value ...`` rows batched as CSRNDArray data (memory
+    O(nnz), the sparse-training input path)."""
+
+    def __init__(self, data_libsvm, data_shape, batch_size=1,
+                 label_libsvm=None, label_shape=None, **kwargs):
+        super().__init__(batch_size)
+        ncol = int(data_shape[0]) if isinstance(data_shape, (tuple, list)) \
+            else int(data_shape)
+        labels, indptr, indices, values = [], [0], [], []
+        with open(data_libsvm) as f:
+            for line in f:
+                parts = line.split()
+                if not parts:
+                    continue
+                labels.append(float(parts[0]))
+                for tok in parts[1:]:
+                    i, _, v = tok.partition(":")
+                    idx = int(i)
+                    if idx >= ncol:
+                        raise MXNetError(
+                            f"libsvm feature index {idx} >= data_shape "
+                            f"{ncol}")
+                    indices.append(idx)
+                    values.append(float(v))
+                indptr.append(len(indices))
+        if label_libsvm is not None:
+            # separate label file (reference label_libsvm): one row per
+            # data row, dense floats, reshaped to label_shape
+            rows = []
+            with open(label_libsvm) as f:
+                for line in f:
+                    if line.strip():
+                        rows.append([float(x) for x in line.split()])
+            if len(rows) != len(labels):
+                raise MXNetError(
+                    f"label_libsvm has {len(rows)} rows, data file has "
+                    f"{len(labels)}")
+            lab = _np.asarray(rows, _np.float32)
+            if label_shape:
+                lab = lab.reshape((-1,) + tuple(label_shape))
+            elif lab.shape[-1] == 1:
+                lab = lab.reshape(-1)
+            labels = lab
+        self._labels = _np.asarray(labels, _np.float32)
+        self._indptr = _np.asarray(indptr, _np.int64)
+        self._indices = _np.asarray(indices, _np.int64)
+        self._values = _np.asarray(values, _np.float32)
+        self._ncol = ncol
+        self._n = len(labels)
+        self._cursor = 0
+        self.provide_data = [DataDesc("data", (batch_size, ncol))]
+        self.provide_label = [DataDesc("label", (batch_size,))]
+
+    def reset(self):
+        self._cursor = 0
+
+    def _rows(self, lo, hi):
+        """CSR slice for rows [lo, hi) plus their labels."""
+        start, stop = self._indptr[lo], self._indptr[hi]
+        return (self._values[start:stop], self._indptr[lo:hi + 1] - start,
+                self._indices[start:stop], self._labels[lo:hi])
+
+    def next(self):
+        from .ndarray.sparse import CSRNDArray
+        from .ndarray import array as _nd_array
+        if self._cursor >= self._n:
+            raise StopIteration
+        lo = self._cursor
+        hi = min(lo + self.batch_size, self._n)
+        self._cursor = hi
+        pad = self.batch_size - (hi - lo)
+        vals, indptr, idx, labs = self._rows(lo, hi)
+        if pad:
+            # reference iterators pad the trailing batch by wrapping to
+            # the file start; DataBatch.pad reports how many to discard
+            wvals, windptr, widx, wlabs = self._rows(0, pad)
+            vals = _np.concatenate([vals, wvals])
+            idx = _np.concatenate([idx, widx])
+            indptr = _np.concatenate([indptr,
+                                      windptr[1:] + indptr[-1]])
+            labs = _np.concatenate([labs, wlabs])
+        csr = CSRNDArray(vals, indptr, idx, (self.batch_size, self._ncol))
+        return DataBatch(data=[csr], label=[_nd_array(labs)], pad=pad)
 
 
 class ResizeIter(DataIter):

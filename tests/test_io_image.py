@@ -149,3 +149,105 @@ def test_color_jitter_transforms():
     z = mx.nd.zeros((4, 4, 3))
     np.testing.assert_allclose(
         T.RandomBrightness(0.5)(z).asnumpy(), 0.0, atol=1e-6)
+
+
+def test_color_jitter_augmenters_and_imread(tmp_path):
+    """Round-4 augmenter tail: brightness/contrast/saturation/hue/gray
+    jitters (reference image.*JitterAug) + imread."""
+    rng = np.random.RandomState(0)
+    img = mx.nd.array(rng.randint(0, 255, (8, 8, 3)).astype(np.float32))
+    for aug in (mx.image.BrightnessJitterAug(0.3),
+                mx.image.ContrastJitterAug(0.3),
+                mx.image.SaturationJitterAug(0.3),
+                mx.image.HueJitterAug(0.3)):
+        out = aug(img)
+        assert out.shape == img.shape
+        assert np.isfinite(out.asnumpy()).all()
+    gray = mx.image.RandomGrayAug(1.0)(img).asnumpy()
+    np.testing.assert_allclose(gray[..., 0], gray[..., 1], rtol=1e-5)
+    np.testing.assert_allclose(gray[..., 1], gray[..., 2], rtol=1e-5)
+    # zero-strength jitter is identity
+    np.testing.assert_allclose(
+        mx.image.BrightnessJitterAug(0.0)(img).asnumpy(), img.asnumpy())
+    # CreateAugmenter now wires the jitters in
+    augs = mx.image.CreateAugmenter((3, 8, 8), brightness=0.1, contrast=0.1,
+                                    saturation=0.1, hue=0.1, rand_gray=0.1)
+    names = {type(a).__name__ for a in augs}
+    assert {"BrightnessJitterAug", "ContrastJitterAug",
+            "SaturationJitterAug", "HueJitterAug",
+            "RandomGrayAug"} <= names
+    # imread round-trips through the backend encoder
+    cv2 = pytest.importorskip("cv2")   # PIL-backend envs skip this leg
+    path = str(tmp_path / "img.png")
+    cv2.imwrite(path, rng.randint(0, 255, (6, 6, 3)).astype(np.uint8))
+    loaded = mx.image.imread(path)
+    assert loaded.shape == (6, 6, 3)
+
+
+def test_libsvm_iter_sparse_batches(tmp_path):
+    """io.LibSVMIter (reference src/io/iter_libsvm.cc): CSR batches."""
+    from mxnet_tpu.ndarray.sparse import CSRNDArray
+    p = str(tmp_path / "t.libsvm")
+    open(p, "w").write("1 0:1.5 3:2.0\n0 1:0.5\n1 2:3.0 3:1.0\n0 0:2.5\n")
+    it = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    assert isinstance(b.data[0], CSRNDArray)
+    np.testing.assert_allclose(np.asarray(b.data[0].asnumpy()),
+                               [[1.5, 0, 0, 2.0], [0, 0.5, 0, 0]])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+    it.reset()
+    assert sum(1 for _ in it) == 2
+    with pytest.raises(mx.MXNetError):
+        open(p, "w").write("1 9:1.0\n")
+        mx.io.LibSVMIter(p, data_shape=(4,), batch_size=1)
+
+
+def test_load_and_fused_rnn_initializers():
+    """init.Load + init.FusedRNN (reference initializer.py tail)."""
+    d = {"arg:w": mx.nd.array([[1.0, 2], [3, 4]])}
+    ld = mx.init.Load(d, default_init=mx.init.Zero())
+    t = mx.nd.zeros((2, 2))
+    ld("w", t)
+    np.testing.assert_array_equal(t.asnumpy(), [[1, 2], [3, 4]])
+    t2 = mx.nd.ones((3,))
+    ld("other", t2)
+    np.testing.assert_array_equal(t2.asnumpy(), [0, 0, 0])
+    with pytest.raises(mx.MXNetError):
+        ld("w", mx.nd.zeros((3, 3)))   # shape mismatch named clearly
+
+    H, I = 3, 4
+    n = 4 * H * I + 4 * H * H + 2 * 4 * H
+    v = mx.nd.zeros((n,))
+    init = mx.init.FusedRNN(mx.init.Xavier(), H, 1, "lstm",
+                            forget_bias=1.0)
+    init("lstm_params_weight", v)
+    a = v.asnumpy()
+    assert a[:4 * H * I].std() > 0
+    bias = a[-2 * 4 * H:]
+    np.testing.assert_array_equal(bias[H:2 * H], np.ones(H))  # forget gate
+    # the initialized packed vector drives nd.RNN directly
+    out = mx.nd.RNN(mx.nd.ones((2, 2, I)), v, mx.nd.zeros((1, 2, H)),
+                    mx.nd.zeros((1, 2, H)), state_size=H, mode="lstm")
+    assert out.shape == (2, 2, H)
+
+
+def test_libsvm_iter_padding_and_label_file(tmp_path):
+    """Review findings: trailing batch pads by wrapping (pad reported),
+    separate label_libsvm file is honored."""
+    p = str(tmp_path / "d.libsvm")
+    open(p, "w").write("1 0:1.0\n2 1:2.0\n3 2:3.0\n")
+    it = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=2)
+    batches = list(it)
+    assert len(batches) == 2
+    assert batches[0].pad == 0 and batches[1].pad == 1
+    last = np.asarray(batches[1].data[0].asnumpy())
+    np.testing.assert_allclose(last[1], [1.0, 0, 0, 0])   # wrapped row 0
+    lp = str(tmp_path / "l.libsvm")
+    open(lp, "w").write("9\n8\n7\n")
+    it2 = mx.io.LibSVMIter(p, data_shape=(4,), batch_size=3,
+                           label_libsvm=lp)
+    b = next(iter(it2))
+    np.testing.assert_allclose(b.label[0].asnumpy(), [9, 8, 7])
+    with pytest.raises(mx.MXNetError, match="rows"):
+        open(lp, "w").write("9\n8\n")
+        mx.io.LibSVMIter(p, data_shape=(4,), batch_size=1, label_libsvm=lp)
