@@ -17,7 +17,7 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad",
-           "set_recording", "set_training"]
+           "get_symbol", "Function", "set_recording", "set_training"]
 
 
 is_recording = _tape.is_recording
@@ -191,3 +191,60 @@ class Function:
 def _bump_counter():
     _tape._STATE.counter += 1
     return _tape._STATE.counter
+
+
+def get_symbol(x):
+    """Rebuild the symbolic graph of a recorded imperative computation
+    (reference autograd.get_symbol / MXAutogradGetSymbol): walk the tape
+    from ``x`` and compose a Symbol whose nodes carry the recorded
+    forward closures. The result lists its leaf inputs as variables
+    (``var0``, ``var1``, ... in first-use order), prints/plots through
+    mx.viz, and BINDS — executing it replays the recorded ops.
+
+    Requires the graph to still hold its forward functions: call before
+    ``backward()`` or use ``backward(retain_graph=True)``. JSON
+    round-trips of traced graphs are not supported (closures are not
+    serializable); ``hybridize()`` + ``export()`` is the deployment path.
+    """
+    from .symbol.symbol import Symbol, var as _sym_var
+    if not isinstance(x, NDArray):
+        raise MXNetError("get_symbol expects an NDArray")
+    memo = {}         # id(leaf NDArray) -> var Symbol
+    node_memo = {}    # id(tape Node) -> base op Symbol (one per op, so a
+                      # multi-output fn executes ONCE however many
+                      # outputs are used)
+    counter = [0]
+
+    def build(arr):
+        node = arr._node
+        if node is None:
+            key = id(arr)
+            if key not in memo:
+                memo[key] = _sym_var(f"var{counter[0]}")
+                counter[0] += 1
+            return memo[key]
+        if node.fn is None:
+            if node.vjp_fn is not None:
+                # Function nodes record a custom vjp, not a replayable
+                # forward closure (autograd.Function.__call__)
+                raise MXNetError(
+                    "get_symbol: the graph contains an autograd.Function "
+                    "node, which has no replayable forward closure; "
+                    "express that op through nd/gluon ops (or CustomOp) "
+                    "to trace it")
+            raise MXNetError(
+                "get_symbol: the tape was consumed by backward(); "
+                "re-run the forward or use backward(retain_graph=True)")
+        if id(node) not in node_memo:
+            args = [build(inp) for inp in node.inputs]
+            node_memo[id(node)] = Symbol(
+                "__traced_fn__", args,
+                {"_fn": node.fn, "_n_out": node.n_out,
+                 "_name": node.name or "op"},
+                name=node.name or f"traced{counter[0]}")
+        s = node_memo[id(node)]
+        if node.n_out > 1:
+            s = s[arr._out_index]
+        return s
+
+    return build(x)

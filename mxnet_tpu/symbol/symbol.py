@@ -105,6 +105,9 @@ class Symbol:
         out = Symbol(self._op, self._args, dict(self._kwargs),
                      name=f"{self._name}[{idx}]")
         out._out_index = idx
+        # evaluation routes through the BASE symbol so a multi-output op
+        # executes once however many of its outputs are consumed
+        out._base = self
         return out
 
     def attr(self, key):
@@ -157,6 +160,9 @@ class Symbol:
             out = bindings[self._name]
         elif self._outputs is not None:
             out = [o._eval(bindings, cache, ctx_map) for o in self._outputs]
+        elif getattr(self, "_base", None) is not None:
+            out = self._base._eval(bindings, cache, ctx_map)
+            out = out[self._out_index]
         else:
             args = [a._eval(bindings, cache, ctx_map)
                     if isinstance(a, Symbol) else a for a in self._args]
@@ -226,6 +232,11 @@ class Symbol:
         index = {}
 
         def emit(s):
+            if s._op == "__traced_fn__":
+                raise MXNetError(
+                    "symbols from autograd.get_symbol cannot be saved to "
+                    "JSON (their ops are in-process closures); use "
+                    "hybridize()+export() for deployable graphs")
             if id(s) in index:
                 return index[id(s)]
             arg_ids = []
@@ -294,6 +305,20 @@ def _collect_nodes(sym):
 
 
 def _apply_nd_op(opname, args, kwargs):
+    if opname == "__traced_fn__":
+        # autograd.get_symbol nodes: the recorded forward closure IS the
+        # op (raw jax arrays in/out); n_out tells how to wrap
+        from ..ndarray.ndarray import apply_nary
+        fn = kwargs["_fn"]
+        if not callable(fn):
+            raise MXNetError(
+                "this symbol came from autograd.get_symbol and was "
+                "reloaded from JSON — traced closures are not "
+                "serializable; rebuild it with get_symbol in-process "
+                "(hybridize()+export() is the deployment path)")
+        n_out = kwargs.get("_n_out", 1)
+        return apply_nary(fn, list(args), n_out=n_out,
+                          name=kwargs.get("_name", "traced"))
     special = {
         "_plus": lambda a, b: a + b, "_minus": lambda a, b: a - b,
         "_rminus": lambda a, b: b - a, "_mul": lambda a, b: a * b,

@@ -284,3 +284,71 @@ def test_grad_multiple_variables():
                                retain_graph=True)
     assert float(gx.asnumpy()[0]) == 4.0     # y + 1
     assert float(gy.asnumpy()[0]) == 2.0     # x
+
+
+def test_get_symbol_replays_recorded_graph():
+    """autograd.get_symbol (reference MXAutogradGetSymbol): the tape
+    becomes a bindable Symbol whose execution replays the forward."""
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    w = nd.array([3.0, 4.0])
+    w.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * w + nd.sin(x)
+    s = autograd.get_symbol(y)
+    assert s.list_arguments() == ["var0", "var1"]
+    e = s.bind(None, dict(zip(s.list_arguments(), [x, w])))
+    e.forward()
+    np.testing.assert_allclose(e.outputs[0].asnumpy(), y.asnumpy(),
+                               rtol=1e-6)
+    # consumed tape (backward without retain_graph) raises with guidance
+    y.backward()
+    with pytest.raises(mx.MXNetError, match="retain_graph"):
+        autograd.get_symbol(y)
+    # works when retained
+    with autograd.record():
+        z = nd.tanh(x) * 2.0
+    z.backward(retain_graph=True)
+    s2 = autograd.get_symbol(z)
+    e2 = s2.bind(None, {"var0": x})
+    e2.forward()
+    np.testing.assert_allclose(e2.outputs[0].asnumpy(), z.asnumpy(),
+                               rtol=1e-6)
+
+
+def test_get_symbol_guards():
+    """Review findings: Function nodes get a precise diagnosis,
+    multi-output ops execute once, traced symbols refuse JSON save."""
+    from mxnet_tpu.ndarray.ndarray import apply_nary
+
+    x = nd.array([2.0]); x.attach_grad()
+
+    class Square(autograd.Function):
+        def forward(self, a):
+            return a * a
+        def backward(self, g):
+            return 2.0 * g
+
+    with autograd.record():
+        y = Square()(x) + 1.0
+    with pytest.raises(mx.MXNetError, match="Function"):
+        autograd.get_symbol(y)
+
+    # multi-output op builds ONE node however many outputs are used
+    calls = []
+    def multi(a):
+        calls.append(1)
+        return a * 2.0, a * 3.0
+    w = nd.array([1.0, 2.0]); w.attach_grad()
+    with autograd.record():
+        o = apply_nary(multi, [w], n_out=2)
+        z = o[0] * o[1]
+    s = autograd.get_symbol(z)
+    calls.clear()
+    e = s.bind(None, {"var0": w})
+    e.forward()
+    assert calls == [1], calls          # fn executed exactly once
+    np.testing.assert_allclose(e.outputs[0].asnumpy(), z.asnumpy(),
+                               rtol=1e-6)
+    with pytest.raises(mx.MXNetError, match="JSON"):
+        s.tojson()
