@@ -24,6 +24,7 @@ from . import lists
 from .loss_scaler import LossScaler
 
 __all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "list_lp16_ops", "list_fp32_ops", "convert_model",
            "convert_hybrid_block", "LossScaler"]
 
 _initialized = False
@@ -195,3 +196,51 @@ def convert_hybrid_block(block, target_dtype="bfloat16"):
     block; here parameters are cast and activations follow op lists)."""
     block.cast(target_dtype)
     return block
+
+
+def list_lp16_ops(target_dtype="bfloat16"):
+    """Reference amp.list_lp16_ops: op names cast to the low-precision
+    dtype under AMP (the list is dtype-independent here: one policy
+    table serves bf16 and fp16)."""
+    return list(lists.TARGET_DTYPE_OPS)
+
+
+def list_fp32_ops(target_dtype="bfloat16"):
+    """Reference amp.list_fp32_ops: op names pinned to fp32 under AMP
+    (dtype-independent, see list_lp16_ops)."""
+    return list(lists.FP32_OPS)
+
+
+def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
+                  target_dtype_ops=None, fp32_ops=None,
+                  conditional_fp32_ops=None, excluded_sym_names=None,
+                  cast_optional_params=False):
+    """Reference amp.convert_model(sym, args, aux): Module-API mixed
+    precision. Under XLA the cast policy is applied at DISPATCH (amp.init
+    wraps the op table), not by graph surgery, so the symbol is returned
+    unchanged; floating-point parameters are cast when
+    cast_optional_params is set. conditional_fp32_ops/excluded_sym_names
+    are accepted for reference-API compatibility (per-node graph surgery
+    does not exist here; exclude at the op level via fp32_ops)."""
+    import jax.numpy as jnp
+    from ..ndarray.ndarray import NDArray
+    if _initialized and target_dtype != _target_dtype:
+        raise MXNetError(
+            f"amp already initialized with target_dtype={_target_dtype}; "
+            f"convert_model(target_dtype={target_dtype}) cannot change "
+            "the dispatch policy mid-process")
+    init(target_dtype=target_dtype, target_precision_ops=target_dtype_ops,
+         fp32_ops=fp32_ops)
+    if cast_optional_params:
+        dt = jnp.bfloat16 if target_dtype == "bfloat16" else jnp.float16
+
+        def cast(v):
+            # float params only — integer aux (counters, index tables)
+            # must keep their dtype, same invariant as _cast_arrays
+            if jnp.issubdtype(v.data.dtype, jnp.floating):
+                return NDArray(v.data.astype(dt), v.context)
+            return v
+
+        arg_params = {k: cast(v) for k, v in arg_params.items()}
+        aux_params = {k: cast(v) for k, v in (aux_params or {}).items()}
+    return sym, arg_params, aux_params

@@ -21,7 +21,7 @@ from ..base import MXNetError
 from ..gluon import nn
 from ..gluon.block import HybridBlock
 
-__all__ = ["quantize_net", "calib_thresholds", "QuantizedDense",
+__all__ = ["quantize_net", "quantize_model", "calib_thresholds", "QuantizedDense",
            "QuantizedConv2D", "optimal_threshold_kl"]
 
 
@@ -275,3 +275,21 @@ def quantize_net(network, calib_data=None, calib_mode="naive",
                 convert(child)
     convert(network)
     return network
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   calib_data=None, calib_mode="naive", quantized_dtype="int8",
+                   **kwargs):
+    """Reference quantization.quantize_model (Module-API PTQ): quantize a
+    symbolic model. Here the symbolic graph is a facade over traced ops
+    with no node-surgery pass, so Module-level PTQ routes through the
+    Gluon path: wrap the symbol with SymbolBlock.imports / gluon, then
+    call ``quantize_net`` (the reference's own successor API for Gluon
+    models). Raises with that recipe rather than pretending to rewrite
+    the graph."""
+    raise MXNetError(
+        "quantize_model: use quantize_net on a Gluon block instead — "
+        "load the checkpoint into gluon (e.g. SymbolBlock/model_zoo), "
+        "then contrib.quantization.quantize_net(net, calib_data=...). "
+        "This build quantizes at the block level (int8 dot_general on "
+        "the MXU), not by symbol-graph surgery.")

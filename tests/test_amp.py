@@ -94,3 +94,44 @@ def test_convert_hybrid_block(amp_bf16):
     net(nd.zeros((2, 5)))
     amp.convert_hybrid_block(net)
     assert str(net.weight.data().data.dtype) == "bfloat16"
+
+
+def test_amp_lists_and_convert_model():
+    """amp.list_lp16_ops/list_fp32_ops + convert_model (reference
+    contrib/amp Module-API surface)."""
+    from mxnet_tpu import amp
+    lp16, fp32 = amp.list_lp16_ops(), amp.list_fp32_ops()
+    assert "dot" in lp16 or "FullyConnected" in lp16
+    assert len(fp32) > 0 and not set(lp16) & set(fp32)
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    args = {"fc_weight": nd.ones((3, 5)), "fc_bias": nd.zeros((3,))}
+    try:
+        s2, a2, x2 = amp.convert_model(out, args, {},
+                                       cast_optional_params=True)
+        assert s2 is out
+        assert str(a2["fc_weight"].dtype) == "bfloat16"
+    finally:
+        amp._deinit_for_tests()
+
+
+def test_convert_model_guards():
+    """Review findings: integer aux params keep their dtype; a second
+    convert_model with a DIFFERENT target dtype raises instead of
+    silently keeping the old policy; reference kwargs are accepted."""
+    from mxnet_tpu import amp
+    import numpy as np
+    data = mx.sym.Variable("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    args = {"fc_weight": nd.ones((2, 3))}
+    aux = {"step": nd.array([4], dtype="int32")}
+    try:
+        _, a2, x2 = amp.convert_model(out, args, aux,
+                                      excluded_sym_names=["fc"],
+                                      cast_optional_params=True)
+        assert str(a2["fc_weight"].dtype) == "bfloat16"
+        assert x2["step"].dtype == np.int32          # int aux untouched
+        with pytest.raises(mx.MXNetError, match="already initialized"):
+            amp.convert_model(out, args, aux, target_dtype="float16")
+    finally:
+        amp._deinit_for_tests()
