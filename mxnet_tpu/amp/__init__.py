@@ -223,24 +223,39 @@ def convert_model(sym, arg_params, aux_params, target_dtype="bfloat16",
     are accepted for reference-API compatibility (per-node graph surgery
     does not exist here; exclude at the op level via fp32_ops)."""
     import jax.numpy as jnp
-    from ..ndarray.ndarray import NDArray
-    if _initialized and target_dtype != _target_dtype:
-        raise MXNetError(
-            f"amp already initialized with target_dtype={_target_dtype}; "
-            f"convert_model(target_dtype={target_dtype}) cannot change "
-            "the dispatch policy mid-process")
+    if _initialized:
+        if target_dtype != _target_dtype:
+            raise MXNetError(
+                f"amp already initialized with target_dtype="
+                f"{_target_dtype}; convert_model(target_dtype="
+                f"{target_dtype}) cannot change the dispatch policy "
+                "mid-process")
+        if target_dtype_ops or fp32_ops:
+            # init() would silently drop these on its already-initialized
+            # fast path — refuse rather than pretend the pins applied
+            raise MXNetError(
+                "amp already initialized; convert_model cannot add "
+                "target_dtype_ops/fp32_ops to an installed policy — pass "
+                "them to the FIRST amp.init/convert_model call")
     init(target_dtype=target_dtype, target_precision_ops=target_dtype_ops,
          fp32_ops=fp32_ops)
+    aux_params = aux_params or {}
     if cast_optional_params:
-        dt = jnp.bfloat16 if target_dtype == "bfloat16" else jnp.float16
+        dt = "bfloat16" if target_dtype == "bfloat16" else "float16"
+        norm_suffixes = ("gamma", "beta", "running_mean", "running_var",
+                         "moving_mean", "moving_var")
 
-        def cast(v):
-            # float params only — integer aux (counters, index tables)
-            # must keep their dtype, same invariant as _cast_arrays
+        def cast(name, v):
+            # float params only (integer counters/index tables keep their
+            # dtype), and norm-family params stay fp32 — their ops are
+            # FP32_OPS and the reference keeps fp32-op params in fp32
+            # (a bf16 round-trip would truncate running stats for good)
+            if name.endswith(norm_suffixes):
+                return v
             if jnp.issubdtype(v.data.dtype, jnp.floating):
-                return NDArray(v.data.astype(dt), v.context)
+                return v.astype(dt)
             return v
 
-        arg_params = {k: cast(v) for k, v in arg_params.items()}
-        aux_params = {k: cast(v) for k, v in (aux_params or {}).items()}
+        arg_params = {k: cast(k, v) for k, v in arg_params.items()}
+        aux_params = {k: cast(k, v) for k, v in aux_params.items()}
     return sym, arg_params, aux_params

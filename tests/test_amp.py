@@ -126,12 +126,19 @@ def test_convert_model_guards():
     args = {"fc_weight": nd.ones((2, 3))}
     aux = {"step": nd.array([4], dtype="int32")}
     try:
+        aux["bn_running_mean"] = nd.array([0.1, 0.2])
         _, a2, x2 = amp.convert_model(out, args, aux,
                                       excluded_sym_names=["fc"],
                                       cast_optional_params=True)
         assert str(a2["fc_weight"].dtype) == "bfloat16"
         assert x2["step"].dtype == np.int32          # int aux untouched
+        assert x2["bn_running_mean"].dtype == np.float32  # norm stays fp32
         with pytest.raises(mx.MXNetError, match="already initialized"):
             amp.convert_model(out, args, aux, target_dtype="float16")
+        with pytest.raises(mx.MXNetError, match="FIRST"):
+            amp.convert_model(out, args, aux, fp32_ops=["exp"])
+        # aux_params=None normalizes to {} on every path
+        _, _, x3 = amp.convert_model(out, args, None)
+        assert x3 == {}
     finally:
         amp._deinit_for_tests()

@@ -1,0 +1,59 @@
+"""Cross-framework oracle for the fused RNN op (SURVEY §4
+check_consistency technique): torch.nn.LSTM/GRU use the same cuDNN gate
+order (i,f,g,o / r,z,n) and per-layer weight split as nd.RNN's packed
+layout, so copying torch's weights into the packed vector must
+reproduce torch's outputs and final states."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _pack_from_torch(rnn, num_layers, bidirectional):
+    """Flatten torch weights into nd.RNN's packed layout: all weights
+    (layer-, then direction-major: W_ih, W_hh), then all biases."""
+    dirs = 2 if bidirectional else 1
+    chunks = []
+    for part in ("weight", "bias"):
+        for layer in range(num_layers):
+            for d in range(dirs):
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                for kind in ("ih", "hh"):
+                    w = getattr(rnn, f"{part}_{kind}{sfx}")
+                    chunks.append(w.detach().numpy().ravel())
+    return np.concatenate(chunks)
+
+
+@pytest.mark.parametrize("mode,bidirectional,num_layers", [
+    ("lstm", False, 1), ("lstm", True, 2), ("gru", False, 2),
+])
+def test_fused_rnn_matches_torch(mode, bidirectional, num_layers):
+    T, B, I, H = 5, 3, 4, 6
+    dirs = 2 if bidirectional else 1
+    rng = np.random.RandomState(0)
+    x = rng.randn(T, B, I).astype(np.float32)
+
+    cls = torch.nn.LSTM if mode == "lstm" else torch.nn.GRU
+    tr = cls(I, H, num_layers=num_layers, bidirectional=bidirectional)
+    with torch.no_grad():
+        t_out, t_state = tr(torch.from_numpy(x))
+    packed = _pack_from_torch(tr, num_layers, bidirectional)
+
+    h0 = nd.zeros((num_layers * dirs, B, H))
+    kw = {"state_cell": nd.zeros((num_layers * dirs, B, H))} \
+        if mode == "lstm" else {}
+    res = nd.RNN(nd.array(x), nd.array(packed), h0, state_size=H,
+                 num_layers=num_layers, mode=mode,
+                 bidirectional=bidirectional, state_outputs=True, **kw)
+    np.testing.assert_allclose(res[0].asnumpy(), t_out.numpy(),
+                               rtol=1e-5, atol=1e-5)
+    t_h = (t_state[0] if mode == "lstm" else t_state).numpy()
+    np.testing.assert_allclose(res[1].asnumpy(), t_h, rtol=1e-5,
+                               atol=1e-5)
+    if mode == "lstm":
+        np.testing.assert_allclose(res[2].asnumpy(),
+                                   t_state[1].numpy(), rtol=1e-5,
+                                   atol=1e-5)
