@@ -214,3 +214,15 @@ def linalg_slogdet(A):
         sign, logdet = jnp.linalg.slogdet(a)
         return sign, logdet
     return apply_nary(fn, [A], name="linalg_slogdet", n_out=2)
+
+
+# reference exposes the family BOTH as nd.linalg_potrf (flat) and
+# nd.linalg.potrf (short name inside the submodule); mirror the aliases.
+# linalg_gemm2 lives in ops.py (it predates this module) — pull it in so
+# the short-name surface is complete.
+from .ops import linalg_gemm2  # noqa: E402
+
+for _n in list(globals()):
+    if _n.startswith("linalg_"):
+        globals()[_n[len("linalg_"):]] = globals()[_n]
+del _n

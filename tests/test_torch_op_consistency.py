@@ -1,0 +1,128 @@
+"""Systematic cross-framework consistency sweep (SURVEY §4
+check_consistency): elementwise/reduction/linalg ops against torch on
+shared inputs. Complements the per-op numeric-gradient checks with an
+independent numerical oracle."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(7)
+POS = RNG.rand(3, 4).astype(np.float32) + 0.1       # (0.1, 1.1)
+ANY = RNG.randn(3, 4).astype(np.float32)
+UNIT = np.clip(RNG.randn(3, 4).astype(np.float32), -0.99, 0.99)
+GE1 = POS + 1.0
+
+UNARY = [
+    ("exp", torch.exp, ANY), ("log", torch.log, POS),
+    ("log2", torch.log2, POS), ("log10", torch.log10, POS),
+    ("log1p", torch.log1p, POS), ("expm1", torch.expm1, ANY),
+    ("sqrt", torch.sqrt, POS), ("rsqrt", torch.rsqrt, POS),
+    ("cbrt", lambda t: torch.sign(t) * torch.abs(t) ** (1 / 3), POS),
+    ("abs", torch.abs, ANY), ("sign", torch.sign, ANY),
+    ("floor", torch.floor, ANY), ("ceil", torch.ceil, ANY),
+    ("trunc", torch.trunc, ANY), ("rint", torch.round, ANY),
+    ("sin", torch.sin, ANY), ("cos", torch.cos, ANY),
+    ("tan", torch.tan, UNIT), ("arcsin", torch.asin, UNIT),
+    ("arccos", torch.acos, UNIT), ("arctan", torch.atan, ANY),
+    ("sinh", torch.sinh, ANY), ("cosh", torch.cosh, ANY),
+    ("tanh", torch.tanh, ANY), ("arcsinh", torch.asinh, ANY),
+    ("arccosh", torch.acosh, GE1), ("arctanh", torch.atanh, UNIT),
+    ("sigmoid", torch.sigmoid, ANY), ("erf", torch.erf, ANY),
+    ("erfinv", torch.erfinv, UNIT * 0.9),
+    ("gamma", lambda t: torch.exp(torch.lgamma(t)), POS),
+    ("gammaln", torch.lgamma, POS),
+    ("relu", torch.relu, ANY),
+    ("softsign", torch.nn.functional.softsign, ANY),
+    ("reciprocal", torch.reciprocal, POS),
+]
+
+
+@pytest.mark.parametrize("name,tfn,data", UNARY,
+                         ids=[u[0] for u in UNARY])
+def test_unary_matches_torch(name, tfn, data):
+    got = getattr(nd, name)(nd.array(data)).asnumpy()
+    want = tfn(torch.from_numpy(data)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+BINARY = [
+    ("add", torch.add), ("subtract", torch.sub),
+    ("multiply", torch.mul), ("divide", torch.div),
+    ("power", torch.pow), ("maximum", torch.maximum),
+    ("minimum", torch.minimum), ("hypot", torch.hypot),
+    ("arctan2", torch.atan2), ("fmod", torch.fmod),
+    ("mod", torch.fmod),       # reference mod IS C fmod (round-4 fix)
+]
+
+
+@pytest.mark.parametrize("name,tfn", BINARY, ids=[b[0] for b in BINARY])
+def test_binary_matches_torch(name, tfn):
+    a, b = ANY, POS + 0.5
+    got = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
+    want = tfn(torch.from_numpy(a), torch.from_numpy(b)).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+
+
+REDUCE = [
+    ("sum", torch.sum), ("mean", torch.mean), ("prod", torch.prod),
+    ("max", torch.amax), ("min", torch.amin),
+]
+
+
+@pytest.mark.parametrize("name,tfn", REDUCE, ids=[r[0] for r in REDUCE])
+def test_reductions_match_torch(name, tfn):
+    x = ANY
+    got = getattr(nd, name)(nd.array(x), axis=1).asnumpy()
+    want = tfn(torch.from_numpy(x), dim=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
+    got_all = getattr(nd, name)(nd.array(x)).asnumpy()
+    want_all = tfn(torch.from_numpy(x)).numpy()
+    np.testing.assert_allclose(np.ravel(got_all), np.ravel(want_all),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_softmax_families_match_torch():
+    x = torch.from_numpy(ANY)
+    np.testing.assert_allclose(
+        nd.softmax(nd.array(ANY), axis=-1).asnumpy(),
+        torch.softmax(x, dim=-1).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.log_softmax(nd.array(ANY), axis=-1).asnumpy(),
+        torch.log_softmax(x, dim=-1).numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_linalg_matches_torch():
+    a = RNG.randn(4, 4).astype(np.float32)
+    spd = (a @ a.T + 4 * np.eye(4)).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.linalg.potrf(nd.array(spd)).asnumpy(),
+        torch.linalg.cholesky(torch.from_numpy(spd)).numpy(),
+        rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.ravel(nd.linalg.det(nd.array(spd)).asnumpy()),
+        np.ravel(torch.linalg.det(torch.from_numpy(spd)).numpy()),
+        rtol=1e-4)
+    np.testing.assert_allclose(
+        nd.linalg.inverse(nd.array(spd)).asnumpy(),
+        torch.linalg.inv(torch.from_numpy(spd)).numpy(),
+        rtol=1e-3, atol=1e-4)
+
+
+def test_conv_and_pool_match_torch():
+    x = RNG.randn(2, 3, 8, 8).astype(np.float32)
+    w = RNG.randn(5, 3, 3, 3).astype(np.float32)
+    got = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=5, stride=(2, 2), pad=(1, 1),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2,
+        padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    got = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    want = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
