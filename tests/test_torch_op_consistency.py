@@ -7,7 +7,6 @@ import pytest
 
 torch = pytest.importorskip("torch")
 
-import mxnet_tpu as mx
 from mxnet_tpu import nd
 
 RNG = np.random.RandomState(7)
@@ -61,7 +60,9 @@ BINARY = [
 
 @pytest.mark.parametrize("name,tfn", BINARY, ids=[b[0] for b in BINARY])
 def test_binary_matches_torch(name, tfn):
-    a, b = ANY, POS + 0.5
+    # positive bases: a negative base with a fractional exponent NaNs in
+    # both frameworks and equal_nan would make the comparison vacuous
+    a, b = (POS if name == "power" else ANY), POS + 0.5
     got = getattr(nd, name)(nd.array(a), nd.array(b)).asnumpy()
     want = tfn(torch.from_numpy(a), torch.from_numpy(b)).numpy()
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-6)
