@@ -265,15 +265,19 @@ class CTCLoss(Loss):
             L = lab.shape[1]
             pl = opt[0].astype(jnp.int32) if len(opt) > 0 else \
                 jnp.full((N,), T, jnp.int32)
-            ll = opt[1].astype(jnp.int32) if len(opt) > 1 else \
-                jnp.sum((lab >= 0) & (lab != 0) if False else (lab >= 0),
-                        axis=1).astype(jnp.int32)
-            if len(opt) <= 1:
-                ll = jnp.full((N,), L, jnp.int32)
-            # extended label seq with blanks (blank = 0 per MXNet default)
+            if len(opt) > 1:
+                ll = opt[1].astype(jnp.int32)
+            else:
+                # reference CTCLoss pads variable-length labels with -1;
+                # class 0 is the blank so it can never be a real label —
+                # counting lab > 0 therefore infers lengths correctly for
+                # both -1- and 0-padded label matrices
+                ll = jnp.sum(lab > 0, axis=1).astype(jnp.int32)
+            # extended label seq with blanks (blank = 0 per MXNet default);
+            # padded entries clamp to 0 so gather indices stay in range
             S = 2 * L + 1
             ext = jnp.zeros((N, S), jnp.int32)
-            ext = ext.at[:, 1::2].set(lab)
+            ext = ext.at[:, 1::2].set(jnp.maximum(lab, 0))
             neg_inf = jnp.asarray(-1e30, logp.dtype)
             alpha0 = jnp.full((N, S), neg_inf)
             alpha0 = alpha0.at[:, 0].set(logp[0, :, 0])
@@ -301,11 +305,14 @@ class CTCLoss(Loss):
                 return alpha, None
 
             alpha, _ = lax_scan(scan_body, alpha0, jnp.arange(1, T))
-            end_idx = 2 * ll - 1
+            end_idx = jnp.maximum(2 * ll - 1, 0)   # ll==0 guarded below
             last = jnp.take_along_axis(alpha, end_idx[:, None], axis=1).squeeze(1)
             last_blank = jnp.take_along_axis(alpha, (2 * ll)[:, None],
                                              axis=1).squeeze(1)
-            return -jnp.logaddexp(last, last_blank)
+            loss = -jnp.logaddexp(last, last_blank)
+            # empty target sequence (inferable now that lengths come from
+            # the padding): the only valid path is all-blank = alpha[:, 0]
+            return jnp.where(ll == 0, -alpha[:, 0], loss)
         inputs = [pred, label]
         if pred_lengths is not None:
             inputs.append(pred_lengths)
