@@ -127,3 +127,33 @@ def test_conv_and_pool_match_torch():
                      pool_type="max").asnumpy()
     want = torch.nn.functional.max_pool2d(torch.from_numpy(x), 2).numpy()
     np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_spatial_transformer_family_matches_torch():
+    """GridGenerator+BilinearSampler (= SpatialTransformer) vs torch's
+    affine_grid+grid_sample — the cuDNN convention both reference ops
+    wrap is torch's align_corners=True."""
+    x = RNG.randn(2, 3, 7, 9).astype(np.float32)
+    theta = np.stack([
+        np.array([[0.8, 0.1, 0.1], [-0.05, 0.9, -0.2]], np.float32),
+        np.array([[1.1, 0.0, -0.3], [0.0, 0.7, 0.25]], np.float32)])
+    got = nd.SpatialTransformer(nd.array(x),
+                                nd.array(theta.reshape(2, 6)),
+                                target_shape=(5, 6)).asnumpy()
+    grid = torch.nn.functional.affine_grid(
+        torch.from_numpy(theta), (2, 3, 5, 6), align_corners=True)
+    want = torch.nn.functional.grid_sample(
+        torch.from_numpy(x), grid, mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_contrib_fft_matches_torch():
+    x = RNG.randn(3, 8).astype(np.float32)
+    got = nd.contrib.fft(nd.array(x)).asnumpy()
+    tc = torch.fft.fft(torch.from_numpy(x), dim=-1)
+    want = np.stack([tc.real.numpy(), tc.imag.numpy()],
+                    axis=-1).reshape(3, 16)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    back = nd.contrib.ifft(nd.array(got)).asnumpy()
+    np.testing.assert_allclose(back / 8.0, x, rtol=1e-4, atol=1e-4)
