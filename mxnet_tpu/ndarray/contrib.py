@@ -539,16 +539,36 @@ def fused_gelu(data):
 
 def BilinearResize2D(data, height=None, width=None, scale_height=None,
                      scale_width=None, like=None, mode="size",
-                     align_corners=False):
+                     align_corners=True):
     """Bilinear resize on NCHW (reference: src/operator/contrib/
-    bilinear_resize.cc). Lowers to jax.image.resize (XLA gather+dot)."""
+    bilinear_resize.cc, whose coordinate map is (in-1)/(out-1), i.e.
+    align_corners=True — the torch interpolate convention segmentation
+    models were built against). align_corners=False falls back to the
+    half-pixel mapping (jax.image.resize)."""
     if like is not None:
         height, width = like.shape[2], like.shape[3]
 
     def fn(d):
         h = height if height is not None else int(d.shape[2] * scale_height)
         w = width if width is not None else int(d.shape[3] * scale_width)
-        return jax.image.resize(d, d.shape[:2] + (h, w), method="bilinear")
+        if not align_corners:
+            return jax.image.resize(d, d.shape[:2] + (h, w),
+                                    method="bilinear")
+        hi, wi = d.shape[2], d.shape[3]
+        # out==1 on an axis: the (in-1)/(out-1) map degenerates; the
+        # convention (torch/MXNet scale=0) samples the FIRST pixel
+        rows = jnp.linspace(0.0, hi - 1.0, h) if h > 1 else \
+            jnp.zeros((1,))
+        cols = jnp.linspace(0.0, wi - 1.0, w) if w > 1 else \
+            jnp.zeros((1,))
+        r0 = jnp.clip(jnp.floor(rows).astype(jnp.int32), 0, hi - 1)
+        r1 = jnp.clip(r0 + 1, 0, hi - 1)
+        fr = (rows - r0).astype(d.dtype)[None, None, :, None]
+        c0 = jnp.clip(jnp.floor(cols).astype(jnp.int32), 0, wi - 1)
+        c1 = jnp.clip(c0 + 1, 0, wi - 1)
+        fc = (cols - c0).astype(d.dtype)[None, None, None, :]
+        top = d[:, :, r0, :] * (1 - fr) + d[:, :, r1, :] * fr
+        return top[:, :, :, c0] * (1 - fc) + top[:, :, :, c1] * fc
 
     return apply_nary(fn, [data], name="BilinearResize2D")
 

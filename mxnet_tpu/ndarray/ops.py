@@ -945,9 +945,11 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
 @_register
 def Pooling(data, kernel=None, pool_type="max", global_pool=False,
             stride=None, pad=None, pooling_convention="valid",
-            cudnn_off=False, count_include_pad=True, layout=None):
-    """Reference: src/operator/nn/pooling.cc. Supports max/avg/sum/lp?, the
-    'valid'|'full' pooling_convention quirk (full = ceil division)."""
+            cudnn_off=False, count_include_pad=True, layout=None,
+            p_value=2):
+    """Reference: src/operator/nn/pooling.cc. Supports max/avg/sum/lp
+    (p_value in the reference's {1,2,3}) and the 'valid'|'full'
+    pooling_convention quirk (full = ceil division)."""
     def fn(d):
         nd = d.ndim - 2
         if global_pool:
@@ -956,6 +958,12 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
                 return jnp.max(d, axis=axes, keepdims=True)
             if pool_type == "sum":
                 return jnp.sum(d, axis=axes, keepdims=True)
+            if pool_type == "lp":
+                # reference pool_utils.h a_pow_p: x^p with NO abs (odd p
+                # keeps sign; negative window sums then root to NaN,
+                # reference behavior)
+                return jnp.sum(d ** p_value, axis=axes,
+                               keepdims=True) ** (1.0 / p_value)
             return jnp.mean(d, axis=axes, keepdims=True)
         k = tuple(kernel)
         s = tuple(stride) if stride else (1,) * nd
@@ -984,6 +992,11 @@ def Pooling(data, kernel=None, pool_type="max", global_pool=False,
         # identity; a traced jnp zero falls back to a generic reduce_window
         # whose linearization fails under vjp-of-jit (hybridize + record)
         zero = _np.zeros((), d.dtype)
+        if pool_type == "lp":
+            # reference lp pooling: (sum x^p)^(1/p), no abs (see above)
+            sp = lax.reduce_window(d ** p_value, zero, lax.add,
+                                   window, strides, padding)
+            return (sp ** (1.0 / p_value)).astype(d.dtype)
         ssum = lax.reduce_window(d, zero, lax.add, window, strides, padding)
         if pool_type == "sum":
             return ssum

@@ -1,0 +1,144 @@
+"""Second torch-oracle batch: conv variants, pooling conventions,
+bilinear resize, ordering ops — the places where framework conventions
+subtly diverge."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(11)
+
+
+def test_conv1d_and_conv3d_match_torch():
+    x1 = RNG.randn(2, 3, 12).astype(np.float32)
+    w1 = RNG.randn(4, 3, 5).astype(np.float32)
+    got = nd.Convolution(nd.array(x1), nd.array(w1), None, kernel=(5,),
+                         num_filter=4, stride=(2,), pad=(2,),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv1d(
+        torch.from_numpy(x1), torch.from_numpy(w1), stride=2,
+        padding=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    x3 = RNG.randn(1, 2, 5, 6, 7).astype(np.float32)
+    w3 = RNG.randn(3, 2, 3, 3, 3).astype(np.float32)
+    got = nd.Convolution(nd.array(x3), nd.array(w3), None,
+                         kernel=(3, 3, 3), num_filter=3, stride=(1, 2, 2),
+                         pad=(1, 1, 1), no_bias=True).asnumpy()
+    want = torch.nn.functional.conv3d(
+        torch.from_numpy(x3), torch.from_numpy(w3), stride=(1, 2, 2),
+        padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_grouped_and_dilated_conv_match_torch():
+    x = RNG.randn(2, 4, 9, 9).astype(np.float32)
+    w = RNG.randn(6, 2, 3, 3).astype(np.float32)   # groups=2
+    got = nd.Convolution(nd.array(x), nd.array(w), None, kernel=(3, 3),
+                         num_filter=6, num_group=2, pad=(1, 1),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(w), padding=1,
+        groups=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    wd = RNG.randn(5, 4, 3, 3).astype(np.float32)
+    got = nd.Convolution(nd.array(x), nd.array(wd), None, kernel=(3, 3),
+                         num_filter=5, dilate=(2, 2), pad=(2, 2),
+                         no_bias=True).asnumpy()
+    want = torch.nn.functional.conv2d(
+        torch.from_numpy(x), torch.from_numpy(wd), padding=2,
+        dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_avg_pool_padding_conventions_match_torch():
+    """MXNet avg pooling with padding EXCLUDES pad positions from the
+    divisor when count_include_pad=False and includes them by default —
+    both must match torch's corresponding flags."""
+    x = RNG.randn(2, 3, 7, 7).astype(np.float32)
+    for include in (True, False):
+        got = nd.Pooling(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                         pad=(1, 1), pool_type="avg",
+                         count_include_pad=include).asnumpy()
+        want = torch.nn.functional.avg_pool2d(
+            torch.from_numpy(x), 3, stride=2, padding=1,
+            count_include_pad=include).numpy()
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5,
+                                   err_msg=f"include={include}")
+
+
+def test_global_and_lp_pooling_match_torch():
+    x = RNG.randn(2, 3, 6, 5).astype(np.float32)
+    got = nd.Pooling(nd.array(x), global_pool=True,
+                     pool_type="avg").asnumpy()
+    want = torch.nn.functional.adaptive_avg_pool2d(
+        torch.from_numpy(x), 1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+    got = nd.Pooling(nd.array(np.abs(x)), kernel=(2, 2), stride=(2, 2),
+                     pool_type="lp", p_value=2).asnumpy()
+    want = torch.nn.functional.lp_pool2d(
+        torch.from_numpy(np.abs(x)), 2, 2, stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_bilinear_resize_matches_torch():
+    x = RNG.randn(2, 3, 5, 7).astype(np.float32)
+    got = nd.contrib.BilinearResize2D(nd.array(x), height=9,
+                                      width=11).asnumpy()
+    want = torch.nn.functional.interpolate(
+        torch.from_numpy(x), size=(9, 11), mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_topk_and_sort_match_torch():
+    x = RNG.randn(4, 9).astype(np.float32)
+    tx = torch.from_numpy(x)
+    got = nd.topk(nd.array(x), k=3, ret_typ="value", axis=-1).asnumpy()
+    want = torch.topk(tx, 3, dim=-1).values.numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    got_i = nd.topk(nd.array(x), k=3, ret_typ="indices",
+                    axis=-1).asnumpy()
+    want_i = torch.topk(tx, 3, dim=-1).indices.numpy()
+    np.testing.assert_array_equal(got_i.astype(np.int64), want_i)
+    np.testing.assert_allclose(
+        nd.sort(nd.array(x), axis=-1).asnumpy(),
+        torch.sort(tx, dim=-1).values.numpy(), rtol=1e-6)
+    np.testing.assert_array_equal(
+        nd.argsort(nd.array(x), axis=-1).asnumpy().astype(np.int64),
+        torch.argsort(tx, dim=-1, stable=True).numpy())
+
+
+def test_gather_scatter_match_torch():
+    data = RNG.randn(5, 4).astype(np.float32)
+    idx = np.array([[0, 2], [1, 3]], np.float32)     # (ndim=2, n=2)
+    got = nd.gather_nd(nd.array(data), nd.array(idx)).asnumpy()
+    want = data[[0, 2], [1, 3]]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+    got = nd.one_hot(nd.array([1.0, 3.0]), depth=5).asnumpy()
+    want = torch.nn.functional.one_hot(
+        torch.tensor([1, 3]), 5).numpy().astype(np.float32)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_lp_pool_signed_and_resize_degenerate():
+    """Review findings: lp pooling is x^p (no abs — odd p keeps sign,
+    reference pool_utils.h); align_corners resize to out=1 samples the
+    FIRST pixel, not the half-pixel interior."""
+    x = np.array([[[[-1.0, 1.0], [2.0, -2.0]]]], np.float32)
+    got = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="lp", p_value=1).asnumpy()
+    want = torch.nn.functional.lp_pool2d(
+        torch.from_numpy(x), 1, 2, stride=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5)   # sum = 0, not 6
+
+    y = RNG.randn(1, 2, 4, 6).astype(np.float32)
+    got = nd.contrib.BilinearResize2D(nd.array(y), height=1,
+                                      width=3).asnumpy()
+    want = torch.nn.functional.interpolate(
+        torch.from_numpy(y), size=(1, 3), mode="bilinear",
+        align_corners=True).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
