@@ -918,26 +918,52 @@ def Deconvolution(data, weight, bias=None, kernel=None, stride=None,
                   dilate=None, pad=None, adj=None, target_shape=None,
                   num_filter=None, num_group=1, no_bias=True, workspace=None,
                   layout=None, cudnn_off=False, cudnn_tune=None):
-    """Transposed conv. Reference: src/operator/nn/deconvolution.cc."""
+    """Transposed conv. Reference: src/operator/nn/deconvolution.cc.
+
+    Lowered as ONE grouped ``lax.conv_general_dilated`` (lhs-dilated by
+    stride — the textbook transposed-conv-as-conv identity), so groups,
+    stride, dilation and adj all compose in a single XLA conv the MXU
+    tiles directly."""
     nd = len(kernel)
     stride = tuple(stride) if stride else (1,) * nd
+    dilate_ = tuple(dilate) if dilate else (1,) * nd
     pad_ = tuple(pad) if pad else (0,) * nd
-    adj_ = tuple(adj) if adj else (0,) * nd
+    keff = [dilate_[i] * (kernel[i] - 1) + 1 for i in range(nd)]
+    if target_shape is not None:
+        # reference: target_shape overrides adj to hit the exact size
+        ts = tuple(target_shape)
+        in_sp = data.shape[2:]
+        adj_ = tuple(
+            ts[i] - ((in_sp[i] - 1) * stride[i] - 2 * pad_[i] + keff[i])
+            for i in range(nd))
+        if any(a < 0 or a >= stride[i] for i, a in enumerate(adj_)):
+            raise MXNetError(
+                f"Deconvolution: target_shape {ts} unreachable from input "
+                f"{tuple(in_sp)} with kernel/stride/pad/dilate given")
+    else:
+        adj_ = tuple(adj) if adj else (0,) * nd
     inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
+
     def fn(d, w, *b):
-        # deconv forward == gradient of conv wrt input: lhs-dilate by stride,
-        # pad with (k-1-p), flip + transpose kernel (transpose_kernel=True).
-        # MXNet output size: (in-1)*s - 2p + k + adj
-        padding = [(kernel[i] - 1 - pad_[i],
-                    kernel[i] - 1 - pad_[i] + adj_[i]) for i in range(nd)]
-        y = lax.conv_transpose(
-            d, w,
-            strides=stride,
-            padding=padding,
-            dimension_numbers=_conv_dn(nd),
-            transpose_kernel=True)
+        # deconv forward == gradient of conv wrt input: lhs-dilate by
+        # stride, pad with (k_eff-1-p), spatially flip the kernel and swap
+        # its (in, out/g) dims per group. Output size:
+        # (in-1)*s - 2p + k_eff + adj
+        g = num_group
+        in_g = w.shape[0] // g
+        out_g = w.shape[1]
+        wk = w.reshape((g, in_g, out_g) + w.shape[2:])
+        wk = jnp.swapaxes(wk, 1, 2)
+        wk = wk.reshape((g * out_g, in_g) + w.shape[2:])
+        wk = jnp.flip(wk, axis=tuple(range(2, 2 + nd)))
+        padding = [(keff[i] - 1 - pad_[i],
+                    keff[i] - 1 - pad_[i] + adj_[i]) for i in range(nd)]
+        y = lax.conv_general_dilated(
+            d, wk, window_strides=(1,) * nd, padding=padding,
+            lhs_dilation=stride, rhs_dilation=dilate_,
+            dimension_numbers=_conv_dn(nd), feature_group_count=g)
         if b:
-            y = y + b[0].reshape((1, -1) + (1,) * nd)
+            y = y + b[0].reshape((1, -1) + (1,) * nd).astype(y.dtype)
         return y
     return apply_nary(fn, inputs, name="Deconvolution")
 

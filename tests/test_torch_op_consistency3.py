@@ -1,0 +1,120 @@
+"""Third torch-oracle batch: LRN, InstanceNorm, activation families,
+sequence ops, op-level Deconvolution."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+from mxnet_tpu import nd
+
+RNG = np.random.RandomState(13)
+
+
+def test_lrn_matches_torch():
+    x = RNG.rand(2, 7, 5, 5).astype(np.float32) + 0.1
+    got = nd.LRN(nd.array(x), nsize=5, alpha=1e-4, beta=0.75,
+                 knorm=2.0).asnumpy()
+    want = torch.nn.functional.local_response_norm(
+        torch.from_numpy(x), size=5, alpha=1e-4, beta=0.75, k=2.0).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_instance_norm_matches_torch():
+    x = RNG.randn(3, 4, 6, 5).astype(np.float32)
+    g = RNG.rand(4).astype(np.float32) + 0.5
+    b = RNG.randn(4).astype(np.float32)
+    got = nd.InstanceNorm(nd.array(x), nd.array(g), nd.array(b),
+                          eps=1e-5).asnumpy()
+    want = torch.nn.functional.instance_norm(
+        torch.from_numpy(x), weight=torch.from_numpy(g),
+        bias=torch.from_numpy(b), eps=1e-5).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_activation_families_match_torch():
+    x = RNG.randn(3, 8).astype(np.float32)
+    tx = torch.from_numpy(x)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="leaky", slope=0.2).asnumpy(),
+        torch.nn.functional.leaky_relu(tx, 0.2).numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="elu", slope=1.0).asnumpy(),
+        torch.nn.functional.elu(tx, 1.0).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="selu").asnumpy(),
+        torch.nn.functional.selu(tx).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.LeakyReLU(nd.array(x), act_type="gelu").asnumpy(),
+        torch.nn.functional.gelu(tx).numpy(), rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        nd.Activation(nd.array(x), act_type="softrelu").asnumpy(),
+        torch.nn.functional.softplus(tx).numpy(), rtol=1e-5, atol=1e-6)
+    np.testing.assert_allclose(
+        nd.hard_sigmoid(nd.array(x)).asnumpy(),
+        torch.clamp(tx * 0.2 + 0.5, 0, 1).numpy(),   # reference alpha=0.2
+        rtol=1e-5, atol=1e-6)
+
+
+def test_sequence_ops_match_manual():
+    x = RNG.randn(6, 3, 4).astype(np.float32)      # (T, B, C)
+    lens = np.array([2.0, 6.0, 4.0], np.float32)
+    got = nd.SequenceMask(nd.array(x), nd.array(lens),
+                          use_sequence_length=True, value=-1.0).asnumpy()
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[L:, b, :] = -1.0
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = nd.SequenceLast(nd.array(x), nd.array(lens),
+                          use_sequence_length=True).asnumpy()
+    want = np.stack([x[int(L) - 1, b] for b, L in enumerate(lens)])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    got = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    want = x.copy()
+    for b, L in enumerate(lens.astype(int)):
+        want[:L, b, :] = x[:L, b, :][::-1]
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+
+def test_deconvolution_op_matches_torch():
+    x = RNG.randn(2, 3, 6, 6).astype(np.float32)
+    w = RNG.randn(3, 4, 4, 4).astype(np.float32)
+    got = nd.Deconvolution(nd.array(x), nd.array(w), None, kernel=(4, 4),
+                          num_filter=4, stride=(2, 2), pad=(1, 1),
+                          adj=(0, 0), no_bias=True).asnumpy()
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2,
+        padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+    # grouped deconvolution
+    wg = RNG.randn(4, 2, 3, 3).astype(np.float32)
+    xg = RNG.randn(2, 4, 5, 5).astype(np.float32)
+    got = nd.Deconvolution(nd.array(xg), nd.array(wg), None, kernel=(3, 3),
+                          num_filter=4, num_group=2, pad=(1, 1),
+                          no_bias=True).asnumpy()
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(xg), torch.from_numpy(wg), padding=1,
+        groups=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # dilation threads through (review finding: it was silently ignored)
+    wd = RNG.randn(3, 4, 3, 3).astype(np.float32)
+    got = nd.Deconvolution(nd.array(x), nd.array(wd), None, kernel=(3, 3),
+                          num_filter=4, stride=(2, 2), pad=(1, 1),
+                          dilate=(2, 2), no_bias=True).asnumpy()
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(wd), stride=2, padding=1,
+        dilation=2).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    # target_shape overrides adj to hit the exact output size
+    got = nd.Deconvolution(nd.array(x), nd.array(w), None, kernel=(4, 4),
+                          num_filter=4, stride=(2, 2), pad=(1, 1),
+                          target_shape=(13, 13), no_bias=True).asnumpy()
+    assert got.shape == (2, 4, 13, 13)
+    want = torch.nn.functional.conv_transpose2d(
+        torch.from_numpy(x), torch.from_numpy(w), stride=2, padding=1,
+        output_padding=1).numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
