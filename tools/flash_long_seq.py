@@ -59,7 +59,7 @@ def _child():
                               jax.nn.softmax(s, axis=-1), v)
         fn = jax.jit(naive)
 
-    out = {"impl": impl, "L": L}
+    out = {"impl": impl, "L": L, "platform": jax.devices()[0].platform}
     try:
         fn(q, k, v).block_until_ready()     # compile + first run
         t0 = time.perf_counter()
@@ -82,27 +82,50 @@ def _child():
     print("CHILD " + json.dumps(out), flush=True)
 
 
+def child_env(impl, L, bh=8, base=None):
+    """Env for one (impl, L) child — the single source of the child
+    protocol (also used by tools/tpu_queue_runner.py)."""
+    env = dict(base if base is not None else os.environ)
+    env.update({"MXTPU_FLASH_CHILD": "1", "MXTPU_FLASH_IMPL": impl,
+                "MXTPU_FLASH_L": str(L), "MXTPU_FLASH_BH": str(bh),
+                # prepend REPO, KEEP the ambient path (axon sitecustomize
+                # must stay importable for TPU); no empty components — an
+                # empty PYTHONPATH element means cwd and can shadow stdlib
+                "PYTHONPATH": os.pathsep.join(
+                    [REPO] + [p for p in
+                              env.get("PYTHONPATH", "").split(os.pathsep)
+                              if p])})
+    return env
+
+
+def parse_child_line(text):
+    """Extract the child's CHILD-prefixed JSON result, or None."""
+    for line in text.splitlines():
+        if line.startswith("CHILD "):
+            try:
+                return json.loads(line[6:])
+            except ValueError:
+                return None
+    return None
+
+
 def sweep(ls=(2048, 4096, 8192), bh=8, impls=("flash", "scan", "naive")):
     results = []
     for L in ls:
         for impl in impls:
-            env = dict(os.environ)
-            env.update({"MXTPU_FLASH_CHILD": "1", "MXTPU_FLASH_IMPL": impl,
-                        "MXTPU_FLASH_L": str(L), "MXTPU_FLASH_BH": str(bh),
-                        "PYTHONPATH": REPO})
             try:
                 r = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],
-                    capture_output=True, text=True, timeout=900, env=env)
+                    capture_output=True, text=True, timeout=900,
+                    env=child_env(impl, L, bh))
             except subprocess.TimeoutExpired:
                 # a hung config must not discard the results already won
                 results.append({"impl": impl, "L": L, "ok": False,
                                 "error": "timeout (900s)"})
                 continue
-            line = [l for l in r.stdout.splitlines()
-                    if l.startswith("CHILD ")]
-            if line:
-                results.append(json.loads(line[0][6:]))
+            parsed = parse_child_line(r.stdout)
+            if parsed is not None:
+                results.append(parsed)
             else:
                 results.append({"impl": impl, "L": L, "ok": False,
                                 "error": (r.stderr or "no output")[-200:]})

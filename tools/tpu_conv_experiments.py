@@ -56,6 +56,7 @@ def run_config(name, batch, s2d, layout, iters=20, warmup=3):
     dt = time.perf_counter() - t0
     img_s = batch * iters / dt
     out = {"config": name, "batch": batch, "s2d_stem": s2d,
+           "platform": jax.devices()[0].platform,
            "conv_layout": layout or "NCHW",
            "img_per_sec": round(img_s, 2),
            "step_ms": round(dt / iters * 1e3, 2),

@@ -1,0 +1,392 @@
+"""Opportunistic serial runner for the queued on-chip experiments (round 4).
+
+The axon tunnel to the single TPU chip heals and wedges unpredictably
+(rounds 3-4 both lost measurement windows to it).  This runner turns the
+verify-skill runbook queue into a state machine so measurements happen the
+moment the tunnel answers, without a human in the loop:
+
+  1. conv_matrix  — tools/tpu_conv_experiments.py, ONE config per child
+                    process (s2d/NHWC/batch knobs; winner picked here)
+  2. bench        — python bench.py with the winning knobs exported;
+                    refreshes .bench_last_tpu.json (full payload incl.
+                    tpu_bandwidth + flash evidence + scaling projection)
+  3. flash_sweep  — tools/flash_long_seq.py (flash vs scan vs naive,
+                    L in {2k,4k,8k}, peak-HBM per config)
+  4. bert128      — MXTPU_BENCH_MODEL=bert MXTPU_BENCH_BERT_BATCH=128
+                    (cache-safe: bench.py only caches model=all runs)
+
+Rules encoded from .claude/skills/verify/SKILL.md:
+  - ONE TPU client at a time; every step is a subprocess and the runner
+    refuses to start while another known TPU client is alive.
+  - Before each step the tunnel is probed with a real matmul in a
+    throwaway subprocess; on failure the runner sleeps and retries
+    rather than launching a doomed client.
+  - Timeouts terminate children with SIGTERM then a grace period before
+    SIGKILL (hard kills have wedged the relay for hours).
+
+State lives in .tpu_queue/state.json; completed steps are skipped on
+restart, so the runner is safe to re-launch any time.  The conv-matrix
+winner is written to <repo>/.bench_knobs.json, which is DELIBERATELY
+git-tracked evidence: the driver's round-end `python bench.py` picks the
+measured best config up from it (bench._apply_knobs_file).
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+QDIR = os.path.join(REPO, ".tpu_queue")
+STATE = os.path.join(QDIR, "state.json")
+
+PROBE_SRC = (
+    "import jax, jax.numpy as jnp;"
+    "d = jax.devices();"
+    "v = jnp.ones((256, 256)) @ jnp.ones((256, 256));"
+    "v.block_until_ready();"
+    "print('PROBE_OK', d[0].platform)"
+)
+
+CONV_CONFIGS = ["base", "s2d", "nhwc", "s2d_nhwc",
+                "b256", "b256_s2d", "b256_s2d_nhwc"]
+
+
+def _log(msg: str) -> None:
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+
+
+def _load_state() -> dict:
+    try:
+        with open(STATE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {"done": {}, "conv_results": []}
+
+
+def _save_state(st: dict) -> None:
+    os.makedirs(QDIR, exist_ok=True)
+    with open(STATE + ".tmp", "w") as f:
+        json.dump(st, f, indent=1)
+    os.replace(STATE + ".tmp", STATE)
+
+
+def _other_tpu_clients() -> list[str]:
+    """Best-effort scan for known TPU-client processes we didn't start."""
+    try:
+        out = subprocess.run(["ps", "-eo", "pid,args"], capture_output=True,
+                             text=True, timeout=10).stdout
+    except Exception:  # noqa: BLE001
+        return []
+    me = os.getpid()
+    hits = []
+    for line in out.splitlines():
+        parts = line.strip().split(None, 2)
+        if len(parts) < 3:
+            continue
+        pid, exe, rest = parts[0], parts[1], parts[2]
+        # only python processes RUNNING one of the client scripts — the
+        # driver's own command line merely MENTIONS these names in its
+        # prompt text and must not count as a client
+        if "python" not in os.path.basename(exe):
+            continue
+        args_head = rest.split("--", 1)[0]
+        if any(k in args_head for k in ("tpu_conv_experiments",
+                                        "flash_long_seq", "bench.py")):
+            if pid.isdigit() and int(pid) != me:
+                hits.append(line.strip())
+    return hits
+
+
+def _run_child(cmd: list[str], env: dict, timeout: float,
+               log_path: str) -> tuple[int | None, str]:
+    """Run a TPU-client subprocess with graceful timeout termination.
+
+    Returns (returncode or None on timeout, captured stdout)."""
+    with open(log_path, "a") as logf:
+        logf.write(f"\n=== {time.strftime('%F %T')} {' '.join(cmd)}\n")
+        logf.flush()
+        # own session so a timeout can terminate the whole process GROUP —
+        # some client tools spawn their own subprocess children
+        p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                             stderr=logf, text=True, cwd=REPO,
+                             start_new_session=True)
+        deadline = time.time() + timeout
+        chunks: list[str] = []
+        # raw chunk reads — readline() could block past the deadline on a
+        # wedged child that flushed a partial line (the exact failure mode
+        # this runner exists to escape)
+        import selectors
+        fd = p.stdout.fileno()
+        os.set_blocking(fd, False)
+        sel = selectors.DefaultSelector()
+        sel.register(p.stdout, selectors.EVENT_READ)
+        while True:
+            if p.poll() is not None:
+                while True:   # drain what the pipe still holds
+                    try:
+                        data = os.read(fd, 65536)
+                    except (BlockingIOError, OSError):
+                        break
+                    if not data:
+                        break
+                    text = data.decode("utf-8", "replace")
+                    chunks.append(text)
+                    logf.write(text)
+                return p.returncode, "".join(chunks)
+            if time.time() > deadline:
+                break
+            for _ in sel.select(timeout=5.0):
+                try:
+                    data = os.read(fd, 65536).decode("utf-8", "replace")
+                except BlockingIOError:
+                    continue
+                if data:
+                    chunks.append(data)
+                    logf.write(data)
+                    logf.flush()
+        # timed out: SIGTERM the group, grace, then SIGKILL as last resort
+        _log(f"timeout after {timeout:.0f}s: TERM -> group {p.pid}")
+        try:
+            os.killpg(p.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            _log(f"no exit after TERM; KILL -> group {p.pid}")
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                pass
+        return None, "".join(chunks)
+
+
+def _probe(timeout: float = 150.0) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE_SRC],
+                           capture_output=True, text=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        return False
+    return r.returncode == 0 and "PROBE_OK" in r.stdout and \
+        "cpu" not in r.stdout.split("PROBE_OK", 1)[1]
+
+
+def _wait_for_tunnel(st: dict) -> None:
+    back = 120.0
+    while True:
+        others = _other_tpu_clients()
+        if others:
+            _log(f"waiting: another TPU client is alive: {others[0][:100]}")
+            time.sleep(60)
+            continue
+        if _probe():
+            _log("tunnel probe OK")
+            return
+        st.setdefault("probe_failures", 0)
+        st["probe_failures"] += 1
+        _save_state(st)
+        _log(f"tunnel probe failed (#{st['probe_failures']}); "
+             f"sleeping {back:.0f}s")
+        time.sleep(back)
+        back = min(back * 1.5, 900.0)
+
+
+def _json_lines(text: str) -> list[dict]:
+    out = []
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                pass
+    return out
+
+
+def step_conv_matrix(st: dict) -> None:
+    done_cfgs = {r["config"] for r in st["conv_results"] if "error" not in r}
+    for cfg in CONV_CONFIGS:
+        if cfg in done_cfgs:
+            continue
+        _wait_for_tunnel(st)
+        # MXTPU_EXP_CHILD runs ONE config in-process (no grandchildren
+        # to orphan when a stuck client must be terminated)
+        env = dict(os.environ, MXTPU_EXP_CHILD=cfg)
+        rc, out = _run_child(
+            [sys.executable, "tools/tpu_conv_experiments.py"], env,
+            timeout=1500.0, log_path=os.path.join(QDIR, "conv.log"))
+        lines = [l for l in _json_lines(out) if l.get("config") == cfg]
+        if lines and "img_per_sec" in lines[-1] \
+                and lines[-1].get("platform") == "tpu":
+            st["conv_results"] = [r for r in st["conv_results"]
+                                  if r.get("config") != cfg] + [lines[-1]]
+            _log(f"conv config {cfg}: {lines[-1]['img_per_sec']} img/s")
+        else:
+            # a CPU-fallback number must NOT be recorded as a measurement
+            err = (f"platform={lines[-1].get('platform')}" if lines
+                   else f"rc={rc}")
+            st["conv_results"] = [r for r in st["conv_results"]
+                                  if r.get("config") != cfg] + \
+                [{"config": cfg, "error": err, "out": out[-200:]}]
+            _log(f"conv config {cfg} FAILED ({err})")
+        _save_state(st)
+    ok = [r for r in st["conv_results"] if "img_per_sec" in r]
+    if len(ok) == len(CONV_CONFIGS):
+        # only a full matrix marks the step done; a restart retries the
+        # configs that failed or ran on the wrong platform
+        st["done"]["conv_matrix"] = True
+    if ok:
+        best = max(ok, key=lambda r: r["img_per_sec"])
+        st["best_conv"] = best
+        _log(f"conv matrix best: {json.dumps(best)}")
+        # bake the measured winner into bench.py's defaults so the
+        # driver's plain `python bench.py` runs the best config
+        knobs = {"resnet_s2d": 1 if best.get("s2d_stem") else 0,
+                 # NCHW is the no-knob default; only a non-default layout
+                 # becomes an env export in bench._apply_knobs_file
+                 "conv_layout": (best["conv_layout"]
+                                 if best.get("conv_layout") not in
+                                 (None, "NCHW") else None),
+                 "batch": best.get("batch"),
+                 "measured_img_per_sec": best.get("img_per_sec"),
+                 "measured_at": time.strftime("%F %T")}
+        with open(os.path.join(REPO, ".bench_knobs.json"), "w") as f:
+            json.dump(knobs, f, indent=1)
+    _save_state(st)
+
+
+def step_bench(st: dict) -> None:
+    _wait_for_tunnel(st)
+    # winner knobs flow through .bench_knobs.json alone (bench.py's
+    # _apply_knobs_file) — no env duplication to drift from it
+    env = dict(os.environ)
+    env["MXTPU_BENCH_PROBE_ATTEMPTS"] = "2"   # runner already probed
+    rc, out = _run_child([sys.executable, "bench.py"], env, timeout=2700.0,
+                         log_path=os.path.join(QDIR, "bench.log"))
+    lines = _json_lines(out)
+    if lines:
+        st["bench_last_line"] = lines[-1]
+        plat = lines[-1].get("platform")
+        _log(f"bench platform={plat} "
+             f"value={lines[-1].get('value')}")
+        if plat == "tpu":
+            st["done"]["bench"] = True
+    _save_state(st)
+
+
+FLASH_LS = (2048, 4096, 8192, 16384, 32768)
+
+
+def step_flash_sweep(st: dict) -> None:
+    """One (impl, L) config per direct child process, probe-gated.
+
+    16k/32k rows are the footprint evidence: naive's (L,L) bf16 scores
+    hit 8*32768^2*2 = 17 GB > the v5e's 16 GB HBM while flash stays
+    O(L*D)."""
+    from tools.flash_long_seq import child_env, parse_child_line, summarize
+    results = st.setdefault("flash_results", [])
+    done = {(r["impl"], r["L"]) for r in results
+            if (r.get("ok") or r.get("oom")) and r.get("platform") == "tpu"}
+    for L in FLASH_LS:
+        for impl in ("flash", "scan", "naive"):
+            if (impl, L) in done:
+                continue
+            _wait_for_tunnel(st)
+            rc, out = _run_child(
+                [sys.executable, "tools/flash_long_seq.py"],
+                child_env(impl, L), timeout=900.0,
+                log_path=os.path.join(QDIR, "flash.log"))
+            r = parse_child_line(out)
+            if r is None:
+                r = {"impl": impl, "L": L, "ok": False,
+                     "error": f"rc={rc} (timeout or crash)"}
+            elif r.get("platform") != "tpu":
+                r = {"impl": impl, "L": L, "ok": False,
+                     "error": f"platform={r.get('platform')} (not tpu)"}
+            results[:] = [x for x in results
+                          if (x["impl"], x["L"]) != (impl, L)] + [r]
+            _log(f"flash {impl}@L={L}: "
+                 f"{r.get('ms', r.get('error', 'oom'))}")
+            _save_state(st)
+    st["flash_summary"] = summarize(results)
+    measured = {(r["impl"], r["L"]) for r in results
+                if (r.get("ok") or r.get("oom"))
+                and r.get("platform") == "tpu"}
+    if len(measured) == len(FLASH_LS) * 3:
+        st["done"]["flash_sweep"] = True
+    _save_state(st)
+
+
+def step_bert128(st: dict) -> None:
+    _wait_for_tunnel(st)
+    env = dict(os.environ, MXTPU_BENCH_MODEL="bert",
+               MXTPU_BENCH_BERT_BATCH="128",
+               MXTPU_BENCH_PROBE_ATTEMPTS="2")
+    rc, out = _run_child([sys.executable, "bench.py"], env, timeout=2700.0,
+                         log_path=os.path.join(QDIR, "bert128.log"))
+    lines = _json_lines(out)
+    if lines:
+        st["bert128"] = lines[-1]
+        if lines[-1].get("platform") == "tpu":
+            st["done"]["bert128"] = True
+            _log(f"bert128: {lines[-1].get('value')} samples/s")
+    _save_state(st)
+
+
+STEPS = [("conv_matrix", step_conv_matrix), ("bench", step_bench),
+         ("flash_sweep", step_flash_sweep), ("bert128", step_bert128)]
+
+
+_LOCK_FD = None   # held for process lifetime; flock dies with the process
+
+
+def _acquire_lock() -> bool:
+    """One runner per machine: a second instance (whose probes and clients
+    the process scan cannot see) must refuse to start.  flock, not a
+    pidfile — the kernel releases it on ANY exit, and a recycled pid
+    cannot fake liveness."""
+    global _LOCK_FD
+    import fcntl
+    lock = os.path.join(QDIR, "runner.lock")
+    _LOCK_FD = open(lock, "w")
+    try:
+        fcntl.flock(_LOCK_FD, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        _log(f"another runner holds {lock}; exiting")
+        return False
+    _LOCK_FD.write(str(os.getpid()))
+    _LOCK_FD.flush()
+    return True
+
+
+def main() -> int:
+    os.makedirs(QDIR, exist_ok=True)
+    if not _acquire_lock():
+        return 1
+    st = _load_state()
+    only = os.environ.get("MXTPU_QUEUE_STEPS")
+    wanted = only.split(",") if only else [n for n, _ in STEPS]
+    for name, fn in STEPS:
+        if name not in wanted:
+            continue
+        if st["done"].get(name):
+            _log(f"step {name}: already done, skipping")
+            continue
+        _log(f"step {name}: starting")
+        fn(st)
+    _log("queue complete: " + json.dumps(st.get("done", {})))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
