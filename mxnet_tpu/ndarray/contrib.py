@@ -25,7 +25,9 @@ __all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
            "BilinearResize2D", "AdaptiveAvgPooling2D",
            "DeformableConvolution",
            "boolean_mask", "index_copy", "index_array", "allclose",
-           "gradientmultiplier", "fft", "ifft", "count_sketch"]
+           "gradientmultiplier", "fft", "ifft", "count_sketch",
+           "quadratic", "div_sqrt_dim", "edge_id",
+           "Proposal", "MultiProposal"]
 
 
 def _corner(box, fmt):
@@ -793,3 +795,183 @@ def count_sketch(data, h, s, out_dim=None, processing_batch_size=32):
         return out.reshape(d.shape[:-1] + (out_dim,))
     return apply_nary(fn, [data, _as_nd(h, data), _as_nd(s, data)],
                       name="count_sketch")
+
+
+def quadratic(data, a=0.0, b=0.0, c=0.0):
+    """a*x^2 + b*x + c elementwise (reference contrib/quadratic_op.cc —
+    the tutorial op; kept for API parity and example code)."""
+    def fn(d):
+        return a * d * d + b * d + c
+    return apply_nary(fn, [data], name="quadratic")
+
+
+def div_sqrt_dim(data):
+    """data / sqrt(last_dim) — attention-logit scaling helper (reference
+    contrib/transformer.cc div_sqrt_dim)."""
+    def fn(d):
+        return d / jnp.sqrt(jnp.asarray(d.shape[-1], d.dtype))
+    return apply_nary(fn, [data], name="div_sqrt_dim")
+
+
+def edge_id(data, u, v):
+    """Edge ids of (u[i], v[i]) pairs in a CSR adjacency matrix, -1 when
+    absent (reference contrib/dgl_graph.cc EdgeID).  Host-side numpy —
+    graph bookkeeping is data-prep, not device compute, here exactly as
+    in the reference (CPU-only op there too)."""
+    import numpy as np
+    from .sparse import CSRNDArray
+    if not isinstance(data, CSRNDArray):
+        raise MXNetError("edge_id expects a CSRNDArray adjacency")
+    indptr = np.asarray(data._indptr)
+    cols = np.asarray(data._indices_csr)
+    uu = np.asarray(getattr(u, "asnumpy", lambda: u)()).astype(np.int64)
+    vv = np.asarray(getattr(v, "asnumpy", lambda: v)()).astype(np.int64)
+    out = np.full(uu.shape, -1.0, np.float32)
+    for i, (ru, cv) in enumerate(zip(uu.ravel(), vv.ravel())):
+        lo, hi = indptr[ru], indptr[ru + 1]
+        hits = np.nonzero(cols[lo:hi] == cv)[0]
+        if hits.size:
+            out.ravel()[i] = float(lo + hits[0])
+    from .ndarray import array as _array
+    return _array(out)
+
+
+def _generate_anchors(stride, scales, ratios):
+    """Base anchors for one feature cell (reference
+    contrib/proposal.cc GenerateAnchors): base box [0,0,stride-1,stride-1]
+    enumerated over ratios then scales, centered on the cell."""
+    base = jnp.asarray([0.0, 0.0, stride - 1.0, stride - 1.0])
+    w = base[2] - base[0] + 1.0
+    h = base[3] - base[1] + 1.0
+    cx = base[0] + 0.5 * (w - 1.0)
+    cy = base[1] + 0.5 * (h - 1.0)
+    anchors = []
+    for r in ratios:
+        size = w * h
+        ws = jnp.round(jnp.sqrt(size / r))
+        hs = jnp.round(ws * r)
+        for s in scales:
+            wss, hss = ws * s, hs * s
+            anchors.append(jnp.stack([cx - 0.5 * (wss - 1.0),
+                                      cy - 0.5 * (hss - 1.0),
+                                      cx + 0.5 * (wss - 1.0),
+                                      cy + 0.5 * (hss - 1.0)]))
+    return jnp.stack(anchors)          # (A, 4)
+
+
+def _proposal_one(scores, deltas, im_info, anchors, stride,
+                  pre_nms, post_nms, thresh, min_size):
+    """Static-shape RPN proposal for ONE image: shift anchors over the
+    grid, apply deltas, clip, min-size filter, top-k + fixed-trip NMS."""
+    A = anchors.shape[0]
+    H, W = scores.shape[-2:]
+    shift_x = jnp.arange(W) * stride
+    shift_y = jnp.arange(H) * stride
+    sx, sy = jnp.meshgrid(shift_x, shift_y)            # (H, W)
+    shifts = jnp.stack([sx, sy, sx, sy], axis=-1).reshape(-1, 1, 4)
+    boxes = (anchors[None] + shifts).reshape(-1, 4)     # (H*W*A, 4)
+    # deltas (4A, H, W) -> (H*W*A, 4); scores (A, H, W) -> (H*W*A,)
+    d = deltas.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+    s = scores.reshape(A, H, W).transpose(1, 2, 0).reshape(-1)
+    # bbox transform inv (center-offset parameterization)
+    widths = boxes[:, 2] - boxes[:, 0] + 1.0
+    heights = boxes[:, 3] - boxes[:, 1] + 1.0
+    ctr_x = boxes[:, 0] + 0.5 * (widths - 1.0)
+    ctr_y = boxes[:, 1] + 0.5 * (heights - 1.0)
+    px = d[:, 0] * widths + ctr_x
+    py = d[:, 1] * heights + ctr_y
+    pw = jnp.exp(jnp.clip(d[:, 2], -10.0, 10.0)) * widths
+    ph = jnp.exp(jnp.clip(d[:, 3], -10.0, 10.0)) * heights
+    prop = jnp.stack([px - 0.5 * (pw - 1.0), py - 0.5 * (ph - 1.0),
+                      px + 0.5 * (pw - 1.0), py + 0.5 * (ph - 1.0)],
+                     axis=-1)
+    # clip to image, drop boxes under the scaled min size
+    hlim, wlim = im_info[0] - 1.0, im_info[1] - 1.0
+    prop = jnp.stack([jnp.clip(prop[:, 0], 0.0, wlim),
+                      jnp.clip(prop[:, 1], 0.0, hlim),
+                      jnp.clip(prop[:, 2], 0.0, wlim),
+                      jnp.clip(prop[:, 3], 0.0, hlim)], axis=-1)
+    ms = min_size * im_info[2]
+    keepable = ((prop[:, 2] - prop[:, 0] + 1.0 >= ms) &
+                (prop[:, 3] - prop[:, 1] + 1.0 >= ms))
+    s = jnp.where(keepable, s, -jnp.inf)
+
+    k = min(pre_nms, prop.shape[0])
+    top_s, top_i = jax.lax.top_k(s, k)
+    top_b = prop[top_i]
+    # IoU in the op's own +1-pixel area convention (matches the widths/
+    # min-size math above; _pairwise_iou's x2-x1 areas would zero out
+    # 1-pixel boxes and flip borderline suppression decisions)
+    l, r = top_b[:, None, :], top_b[None, :, :]
+    tl = jnp.maximum(l[..., :2], r[..., :2])
+    br = jnp.minimum(l[..., 2:], r[..., 2:])
+    wh = jnp.maximum(br - tl + 1.0, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    area = ((top_b[:, 2] - top_b[:, 0] + 1.0) *
+            (top_b[:, 3] - top_b[:, 1] + 1.0))
+    iou = inter / jnp.maximum(area[:, None] + area[None, :] - inter, 1e-12)
+
+    def body(i, keep):
+        sup = (iou[i] > thresh) & (jnp.arange(k) > i)
+        return jnp.where(keep[i] & jnp.isfinite(top_s[i]),
+                         keep & ~sup, keep)
+
+    keep = lax.fori_loop(0, k, body, jnp.ones(k, bool))
+    keep = keep & jnp.isfinite(top_s)
+    # stable selection of the first post_nms kept boxes, zero-padded
+    rank = jnp.cumsum(keep) - 1
+    sel = jnp.where(keep & (rank < post_nms), rank, post_nms)
+    out_b = jnp.zeros((post_nms + 1, 4)).at[sel].set(top_b)[:post_nms]
+    out_s = jnp.zeros((post_nms + 1,)).at[sel].set(top_s)[:post_nms]
+    return out_b, out_s
+
+
+def MultiProposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
+                  rpn_post_nms_top_n=300, threshold=0.7, rpn_min_size=16,
+                  scales=(4, 8, 16, 32), ratios=(0.5, 1, 2),
+                  feature_stride=16, output_score=False, iou_loss=False):
+    """Batched RPN proposal generation (reference
+    contrib/multi_proposal.cc): anchors + deltas -> clipped, NMS-pruned
+    rois (B*post_n, 5) with batch index in column 0.  Static shapes
+    throughout (top_k + fixed-trip NMS) so the op jits on TPU.
+    ``iou_loss`` is not supported (niche IoU-parameterized variant)."""
+    if iou_loss:
+        raise MXNetError("MultiProposal: iou_loss=True is not supported; "
+                         "use the default bbox-delta parameterization")
+    A = len(scales) * len(ratios)
+    anchors = _generate_anchors(float(feature_stride),
+                                [float(s) for s in scales],
+                                [float(r) for r in ratios])
+
+    def fn(cp, bp, info):
+        B = cp.shape[0]
+        fg = cp[:, A:, :, :]        # (B, A, H, W) foreground scores
+
+        def one(args):
+            return _proposal_one(args[0], args[1], args[2], anchors,
+                                 float(feature_stride),
+                                 int(rpn_pre_nms_top_n),
+                                 int(rpn_post_nms_top_n),
+                                 float(threshold), float(rpn_min_size))
+
+        boxes, scores = jax.vmap(one)((fg, bp, info))
+        bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype),
+                          int(rpn_post_nms_top_n))
+        rois = jnp.concatenate(
+            [bidx[:, None], boxes.reshape(-1, 4)], axis=-1)
+        if output_score:
+            return rois, scores.reshape(-1, 1)
+        return rois
+
+    n_out = 2 if output_score else 1
+    return apply_nary(fn, [cls_prob, bbox_pred, im_info], n_out=n_out,
+                      name="MultiProposal")
+
+
+def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
+    """Single-image RPN proposal op (reference contrib/proposal.cc);
+    batch must be 1 — use MultiProposal for batched inputs."""
+    if cls_prob.shape[0] != 1:
+        raise MXNetError("Proposal expects batch size 1; "
+                         "use MultiProposal for batched inputs")
+    return MultiProposal(cls_prob, bbox_pred, im_info, **kwargs)

@@ -1599,20 +1599,29 @@ def _group_pairs(arrays, per_weight):
     return [arrays[i * per_weight:(i + 1) * per_weight] for i in range(n)]
 
 
+def _check_num_weights(name, groups, num_weights):
+    """Validate the reference API's num_weights kwarg against the group
+    count implied by the flat array list."""
+    if num_weights is not None and num_weights != len(groups):
+        raise MXNetError(f"{name}: num_weights {num_weights} != "
+                         f"{len(groups)} weight groups passed")
+
+
 @_register
 def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
-                     clip_gradient=None, out=None):
+                     clip_gradient=None, num_weights=None, out=None):
     """Fused group SGD: arrays = (w0, g0, w1, g1, ...). ONE dispatch /
     XLA program updates every weight (the reference's multi-tensor-apply);
     weights are updated in place on their handles and returned."""
     groups = _group_pairs(list(arrays), 2)
+    _check_num_weights("multi_sgd_update", groups, num_weights)
     def fn(*flat):
         outs = []
         for i in range(0, len(flat), 2):
             w, g = flat[i], flat[i + 1]
             lr, wd = lrs[i // 2], wds[i // 2]
             g = g * rescale_grad
-            if clip_gradient is not None:
+            if clip_gradient is not None and clip_gradient > 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             outs.append(w - lr * (g + wd * w))
         # apply_nary with n_out=1 expects a bare array, not a 1-tuple
@@ -1627,18 +1636,19 @@ def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
 
 @_register
 def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.9, rescale_grad=1.0,
-                         clip_gradient=None, out=None):
+                         clip_gradient=None, num_weights=None, out=None):
     """Fused group SGD+momentum: arrays = (w0, g0, m0, w1, g1, m1, ...);
     weights AND momenta update in place (optimizer_op.cc
     multi_sgd_mom_update)."""
     groups = _group_pairs(list(arrays), 3)
+    _check_num_weights("multi_sgd_mom_update", groups, num_weights)
     def fn(*flat):
         outs = []
         for i in range(0, len(flat), 3):
             w, g, m = flat[i], flat[i + 1], flat[i + 2]
             lr, wd = lrs[i // 3], wds[i // 3]
             g = g * rescale_grad
-            if clip_gradient is not None:
+            if clip_gradient is not None and clip_gradient > 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             new_m = momentum * m - lr * (g + wd * w)
             outs.append(w + new_m)
@@ -1665,7 +1675,7 @@ def multi_lamb_update(*arrays, lrs, wds, beta1=0.9, beta2=0.999,
             w, g, mean, var = flat[i:i + 4]
             lr, wd = lrs[i // 4], wds[i // 4]
             g = g * rescale_grad
-            if clip_gradient is not None:
+            if clip_gradient is not None and clip_gradient > 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             new_mean = beta1 * mean + (1 - beta1) * g
             new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -3148,3 +3158,163 @@ def IdentityAttachKLSparseReg(data, sparseness_target=0.1, penalty=0.001,
 
     fwd.defvjp(fwd_fwd, fwd_bwd)
     return apply_nary(fwd, [data], name="IdentityAttachKLSparseReg")
+
+
+# ======================================================================
+# Round-4 registry tail: remaining sample_* distributions, multi-tensor
+# mixed-precision updates, legacy utility ops
+# ======================================================================
+
+def _gamma_poisson(key_gamma, key_poisson, gshape, gscale, out_shape, dtype):
+    """NB sampling via the Gamma-Poisson mixture: lam ~ Gamma(shape, scale)
+    then x ~ Poisson(lam) — the standard reparameterization (reference
+    draws NB directly in src/operator/random/sampler.h; the mixture is
+    exactly the same marginal and maps onto jax primitives)."""
+    lam = jax.random.gamma(key_gamma, gshape, out_shape) * gscale
+    draws = jax.random.poisson(key_poisson, lam, shape=out_shape)
+    return draws.astype(_dtype_of(dtype) if dtype else jnp.float32)
+
+
+@_register
+def sample_negative_binomial(k, p, shape=None, dtype=None, ctx=None):
+    """Per-element NB(k successes, success prob p) draws (reference
+    sample_negative_binomial in src/operator/random/multisample_op.cc);
+    counts failures before the k-th success, mean k*(1-p)/p."""
+    from . import random as _rnd
+    k = _nd(k)
+    p = _nd(p, k)
+    out_shape = _sample_shape(k.shape, shape)
+
+    def fn(kk, pp):
+        bshape = kk.shape + (1,) * (len(out_shape) - kk.ndim)
+        kb = jnp.broadcast_to(kk.reshape(bshape), out_shape)
+        pb = jnp.broadcast_to(pp.reshape(bshape), out_shape)
+        return _gamma_poisson(_rnd.next_key(), _rnd.next_key(),
+                              kb, (1.0 - pb) / jnp.maximum(pb, 1e-12),
+                              out_shape, dtype)
+
+    return apply_nary(fn, [k, p], name="sample_negative_binomial")
+
+
+@_register
+def sample_generalized_negative_binomial(mu, alpha, shape=None, dtype=None,
+                                         ctx=None):
+    """Per-element generalized NB(mean mu, dispersion alpha) draws
+    (reference sample_generalized_negative_binomial): equivalent to
+    NB with k = 1/alpha, p = 1/(1 + mu*alpha)."""
+    from . import random as _rnd
+    mu = _nd(mu)
+    alpha = _nd(alpha, mu)
+    out_shape = _sample_shape(mu.shape, shape)
+
+    def fn(m, a):
+        bshape = m.shape + (1,) * (len(out_shape) - m.ndim)
+        mb = jnp.broadcast_to(m.reshape(bshape), out_shape)
+        ab = jnp.broadcast_to(a.reshape(bshape), out_shape)
+        ab = jnp.maximum(ab, 1e-12)
+        return _gamma_poisson(_rnd.next_key(), _rnd.next_key(),
+                              1.0 / ab, mb * ab, out_shape, dtype)
+
+    return apply_nary(fn, [mu, alpha], name="sample_generalized_"
+                                            "negative_binomial")
+
+
+@_register
+def multi_mp_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
+                        clip_gradient=None, num_weights=None, out=None):
+    """Fused group mixed-precision SGD: arrays = (w0, g0, w32_0, ...).
+    The fp32 master weight carries the update; the low-precision weight
+    is its cast (reference optimizer_op.cc multi_mp_sgd_update)."""
+    groups = _group_pairs(list(arrays), 3)
+    _check_num_weights("multi_mp_sgd_update", groups, num_weights)
+
+    def fn(*flat):
+        outs = []
+        for i in range(0, len(flat), 3):
+            w, g, w32 = flat[i], flat[i + 1], flat[i + 2]
+            lr, wd = lrs[i // 3], wds[i // 3]
+            g32 = g.astype(jnp.float32) * rescale_grad
+            if clip_gradient is not None and clip_gradient > 0:
+                g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+            new32 = w32 - lr * (g32 + wd * w32)
+            outs.append(new32.astype(w.dtype))
+            outs.append(new32)
+        return tuple(outs)
+
+    updated = apply_nary(fn, list(arrays), n_out=2 * len(groups),
+                         name="multi_mp_sgd_update")
+    for gi, (w, _, w32) in enumerate(groups):
+        w._set_data(updated[2 * gi].data)
+        w32._set_data(updated[2 * gi + 1].data)
+    return [updated[2 * i] for i in range(len(groups))]
+
+
+@_register
+def multi_mp_sgd_mom_update(*arrays, lrs, wds, momentum=0.9,
+                            rescale_grad=1.0, clip_gradient=None,
+                            num_weights=None, out=None):
+    """Fused group mixed-precision SGD+momentum: arrays =
+    (w0, g0, mom0, w32_0, ...); momentum and master weight stay fp32
+    (reference multi_mp_sgd_mom_update)."""
+    groups = _group_pairs(list(arrays), 4)
+    _check_num_weights("multi_mp_sgd_mom_update", groups, num_weights)
+
+    def fn(*flat):
+        outs = []
+        for i in range(0, len(flat), 4):
+            w, g, m, w32 = flat[i], flat[i + 1], flat[i + 2], flat[i + 3]
+            lr, wd = lrs[i // 4], wds[i // 4]
+            g32 = g.astype(jnp.float32) * rescale_grad
+            if clip_gradient is not None and clip_gradient > 0:
+                g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
+            new_m = momentum * m - lr * (g32 + wd * w32)
+            new32 = w32 + new_m
+            outs.append(new32.astype(w.dtype))
+            outs.append(new_m)
+            outs.append(new32)
+        return tuple(outs)
+
+    updated = apply_nary(fn, list(arrays), n_out=3 * len(groups),
+                         name="multi_mp_sgd_mom_update")
+    for gi, (w, _, m, w32) in enumerate(groups):
+        w._set_data(updated[3 * gi].data)
+        m._set_data(updated[3 * gi + 1].data)
+        w32._set_data(updated[3 * gi + 2].data)
+    return [updated[3 * i] for i in range(len(groups))]
+
+
+@_register
+def reset_arrays(*arrays, num_arrays=None):
+    """Zero every array in place in one dispatch (reference
+    contrib/reset_arrays.cc — gradient clearing for grad_req='add')."""
+    if num_arrays is not None and num_arrays != len(arrays):
+        raise MXNetError(f"reset_arrays: num_arrays {num_arrays} != "
+                         f"{len(arrays)} arrays passed")
+    for a in arrays:
+        a._set_data(jnp.zeros_like(a.data))
+    return None
+
+
+@_register
+def one_hot_encode(indices, out):
+    """Legacy one-hot writer: out[i, indices[i]] = 1, everything else 0
+    (reference mx.nd.onehot_encode / ndarray_function.cc OnehotEncode).
+    ``out`` supplies the class count and receives the result in place."""
+    if out.ndim != 2 or indices.ndim != 1:
+        raise MXNetError("one_hot_encode expects indices (N,), out (N, C)")
+    n, c = out.shape
+    if indices.shape[0] != n:
+        raise MXNetError(f"one_hot_encode: indices length "
+                         f"{indices.shape[0]} != out rows {n}")
+
+    def fn(idx):
+        return jax.nn.one_hot(idx.astype(jnp.int32), c,
+                              dtype=_dtype_of(out.dtype))
+
+    res = apply_nary(fn, [indices], name="one_hot_encode")
+    out._set_data(res.data)
+    return out
+
+
+onehot_encode = one_hot_encode
+__all__.append("onehot_encode")

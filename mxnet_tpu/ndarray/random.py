@@ -22,6 +22,7 @@ from .ndarray import NDArray, _put, _dtype_of
 
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
            "exponential", "poisson", "shuffle", "multinomial", "bernoulli",
+           "negative_binomial", "generalized_negative_binomial",
            "next_key", "current_key"]
 
 
@@ -122,6 +123,27 @@ def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None):
 def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None):
     data = jax.random.poisson(next_key(), lam, _shape(shape)).astype(
         _dtype_of(dtype))
+    return _wrap(data, ctx, out)
+
+
+def negative_binomial(k=1, p=1, shape=None, dtype=None, ctx=None, out=None):
+    """NB(k, p) draws via the Gamma-Poisson mixture (reference
+    mx.nd.random.negative_binomial); failures before the k-th success."""
+    from .ops import _gamma_poisson   # single home for the mixture math
+    data = _gamma_poisson(next_key(), next_key(), float(k),
+                          (1.0 - p) / max(p, 1e-12), _shape(shape), dtype)
+    return _wrap(data, ctx, out)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None):
+    """Generalized NB(mean mu, dispersion alpha) — NB with k=1/alpha,
+    p=1/(1+mu*alpha) (reference mx.nd.random.generalized_negative_binomial).
+    alpha=0 degenerates to Poisson(mu)."""
+    from .ops import _gamma_poisson
+    a = max(float(alpha), 1e-12)
+    data = _gamma_poisson(next_key(), next_key(), 1.0 / a, mu * a,
+                          _shape(shape), dtype)
     return _wrap(data, ctx, out)
 
 

@@ -1,0 +1,199 @@
+"""Round-4 op-registry tail, second batch: the remaining sample_*
+distributions (negative binomial family), fused mixed-precision
+multi-tensor SGD, legacy utility ops, and the RPN proposal contrib ops.
+Reference: src/operator/random/multisample_op.cc, optimizer_op.cc
+(multi_mp_sgd*), contrib/reset_arrays.cc, ndarray_function.cc
+(OnehotEncode), contrib/proposal.cc, contrib/multi_proposal.cc,
+contrib/quadratic_op.cc, contrib/transformer.cc (div_sqrt_dim),
+contrib/dgl_graph.cc (EdgeID)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_sample_negative_binomial_moments():
+    mx.random.seed(7)
+    k = nd.array([10.0, 50.0])
+    p = nd.array([0.5, 0.2])
+    s = nd.sample_negative_binomial(k, p, shape=40000).asnumpy()
+    # mean k(1-p)/p, var k(1-p)/p^2
+    np.testing.assert_allclose(s.mean(axis=1), [10.0, 200.0], rtol=0.05)
+    np.testing.assert_allclose(s.var(axis=1), [20.0, 1000.0], rtol=0.1)
+    assert (s >= 0).all() and np.allclose(s, np.round(s))
+
+
+def test_sample_generalized_negative_binomial_moments():
+    mx.random.seed(11)
+    mu = nd.array([4.0, 9.0])
+    alpha = nd.array([0.25, 0.1])
+    s = nd.sample_generalized_negative_binomial(
+        mu, alpha, shape=40000).asnumpy()
+    np.testing.assert_allclose(s.mean(axis=1), [4.0, 9.0], rtol=0.05)
+    # var = mu + alpha * mu^2
+    np.testing.assert_allclose(s.var(axis=1), [8.0, 17.1], rtol=0.1)
+
+
+def test_random_negative_binomial_namespace():
+    mx.random.seed(5)
+    s = nd.random.negative_binomial(k=20, p=0.4, shape=(20000,)).asnumpy()
+    np.testing.assert_allclose(s.mean(), 20 * 0.6 / 0.4, rtol=0.05)
+    g = nd.random.generalized_negative_binomial(
+        mu=3.0, alpha=0.5, shape=(20000,)).asnumpy()
+    np.testing.assert_allclose(g.mean(), 3.0, rtol=0.05)
+    np.testing.assert_allclose(g.var(), 3.0 + 0.5 * 9.0, rtol=0.12)
+
+
+def test_multi_mp_sgd_update_matches_fp32_master():
+    w = nd.array(np.ones(6), dtype="float16")
+    g = nd.array(np.full(6, 0.5), dtype="float16")
+    w32 = nd.array(np.ones(6), dtype="float32")
+    nd.multi_mp_sgd_update(w, g, w32, lrs=[0.1], wds=[0.01])
+    expect32 = 1.0 - 0.1 * (0.5 + 0.01 * 1.0)
+    np.testing.assert_allclose(w32.asnumpy(), expect32, rtol=1e-6)
+    np.testing.assert_allclose(w.asnumpy(), expect32, rtol=1e-3)
+    assert w.dtype == np.float16 and w32.dtype == np.float32
+
+
+def test_multi_mp_sgd_mom_update_two_groups():
+    ws = [nd.array(np.ones(4), dtype="float16") for _ in range(2)]
+    gs = [nd.array(np.full(4, 1.0), dtype="float16") for _ in range(2)]
+    ms = [nd.zeros((4,)) for _ in range(2)]
+    w32s = [nd.array(np.ones(4), dtype="float32") for _ in range(2)]
+    arrays = []
+    for i in range(2):
+        arrays += [ws[i], gs[i], ms[i], w32s[i]]
+    nd.multi_mp_sgd_mom_update(*arrays, lrs=[0.1, 0.2], wds=[0.0, 0.0],
+                               momentum=0.9)
+    # step 1: m = -lr*g; w32 += m
+    np.testing.assert_allclose(ms[0].asnumpy(), -0.1, rtol=1e-6)
+    np.testing.assert_allclose(w32s[1].asnumpy(), 0.8, rtol=1e-6)
+    np.testing.assert_allclose(ws[1].asnumpy(), 0.8, rtol=1e-3)
+
+
+def test_reset_arrays_zeroes_in_place():
+    a = nd.array(np.arange(6.0))
+    b = nd.ones((2, 3))
+    nd.reset_arrays(a, b, num_arrays=2)
+    assert (a.asnumpy() == 0).all() and (b.asnumpy() == 0).all()
+    with pytest.raises(mx.MXNetError):
+        nd.reset_arrays(a, num_arrays=3)
+
+
+def test_one_hot_encode_legacy():
+    idx = nd.array([0.0, 2.0, 1.0])
+    out = nd.zeros((3, 4))
+    ret = nd.one_hot_encode(idx, out)
+    expect = np.eye(4)[[0, 2, 1]]
+    np.testing.assert_array_equal(out.asnumpy(), expect)
+    assert ret is out
+    assert nd.onehot_encode is nd.one_hot_encode
+
+
+def test_contrib_quadratic_and_div_sqrt_dim():
+    x = nd.array(np.random.RandomState(0).randn(3, 8).astype(np.float32))
+    q = nd.contrib.quadratic(x, a=2.0, b=-1.0, c=0.5).asnumpy()
+    np.testing.assert_allclose(
+        q, 2 * x.asnumpy() ** 2 - x.asnumpy() + 0.5, rtol=1e-6)
+    d = nd.contrib.div_sqrt_dim(x).asnumpy()
+    np.testing.assert_allclose(d, x.asnumpy() / np.sqrt(8.0), rtol=1e-6)
+
+
+def test_contrib_edge_id_csr():
+    import mxnet_tpu.ndarray.sparse as sp
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 4]], dtype=np.float32)
+    csr = sp.csr_matrix(dense)
+    eid = nd.contrib.edge_id(csr, nd.array([0, 1, 1, 2, 0]),
+                             nd.array([1, 0, 2, 2, 0]))
+    np.testing.assert_array_equal(eid.asnumpy(), [0, 1, 2, 3, -1])
+
+
+def _proposal_inputs(B, A, H, W, seed=0):
+    rng = np.random.RandomState(seed)
+    cls = nd.array(rng.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox = nd.array(((rng.rand(B, 4 * A, H, W) - 0.5) * 0.2)
+                    .astype(np.float32))
+    info = nd.array(np.tile([64.0, 64.0, 1.0], (B, 1)).astype(np.float32))
+    return cls, bbox, info
+
+
+def test_multi_proposal_shapes_and_validity():
+    cls, bbox, info = _proposal_inputs(2, 12, 4, 4)
+    rois, scores = nd.contrib.MultiProposal(
+        cls, bbox, info, rpn_pre_nms_top_n=50, rpn_post_nms_top_n=10,
+        output_score=True)
+    r, s = rois.asnumpy(), scores.asnumpy()
+    assert r.shape == (20, 5) and s.shape == (20, 1)
+    # batch index column, box validity, image clipping
+    assert set(r[:10, 0]) == {0.0} and set(r[10:, 0]) == {1.0}
+    assert (r[:, 1:3] <= r[:, 3:5]).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, 1:] <= 63).all()
+
+
+def test_multi_proposal_nms_suppresses_overlaps():
+    # duplicate score maps across anchors -> heavy overlap; NMS must keep
+    # far fewer than pre_nms boxes at a tight threshold
+    cls, bbox, info = _proposal_inputs(1, 12, 6, 6, seed=3)
+    rois = nd.contrib.MultiProposal(
+        cls, bbox, info, rpn_pre_nms_top_n=100, rpn_post_nms_top_n=40,
+        threshold=0.5).asnumpy()
+    boxes = rois[:, 1:]
+    nonzero = boxes[(boxes != 0).any(axis=1)]
+    # pairwise IoU among survivors stays under the threshold
+    x1, y1, x2, y2 = nonzero.T
+    area = np.maximum(x2 - x1, 0) * np.maximum(y2 - y1, 0)
+    for i in range(len(nonzero)):
+        for j in range(i + 1, len(nonzero)):
+            xx1, yy1 = max(x1[i], x1[j]), max(y1[i], y1[j])
+            xx2, yy2 = min(x2[i], x2[j]), min(y2[i], y2[j])
+            inter = max(xx2 - xx1, 0) * max(yy2 - yy1, 0)
+            iou = inter / max(area[i] + area[j] - inter, 1e-9)
+            assert iou <= 0.5 + 1e-5
+
+
+def test_proposal_single_image_and_batch_guard():
+    cls, bbox, info = _proposal_inputs(1, 12, 4, 4)
+    r = nd.contrib.Proposal(cls, bbox, info, rpn_pre_nms_top_n=30,
+                            rpn_post_nms_top_n=8)
+    assert r.shape == (8, 5)
+    cls2, bbox2, info2 = _proposal_inputs(2, 12, 4, 4)
+    with pytest.raises(mx.MXNetError):
+        nd.contrib.Proposal(cls2, bbox2, info2)
+    with pytest.raises(mx.MXNetError):
+        nd.contrib.MultiProposal(cls, bbox, info, iou_loss=True)
+
+
+def test_multi_sgd_clip_sentinel_and_num_weights():
+    # clip_gradient=-1.0 is the reference's no-clip sentinel, NOT a bound
+    w = nd.array(np.ones(4)); g = nd.array(np.full(4, 0.5))
+    w32 = nd.array(np.ones(4))
+    nd.multi_mp_sgd_update(w, g, w32, lrs=[0.1], wds=[0.0],
+                           clip_gradient=-1.0, num_weights=1)
+    np.testing.assert_allclose(w32.asnumpy(), 0.95, rtol=1e-6)
+    w2 = nd.array(np.ones(4)); g2 = nd.array(np.full(4, 0.5))
+    nd.multi_sgd_update(w2, g2, lrs=[0.1], wds=[0.0], clip_gradient=-1.0,
+                        num_weights=1)
+    np.testing.assert_allclose(w2.asnumpy(), 0.95, rtol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        nd.multi_sgd_update(w2, g2, lrs=[0.1], wds=[0.0], num_weights=3)
+
+
+def test_one_hot_encode_shape_mismatch_raises():
+    with pytest.raises(mx.MXNetError):
+        nd.one_hot_encode(nd.array([0.0, 1.0]), nd.zeros((5, 3)))
+
+
+def test_proposal_nms_plus_one_convention():
+    # 1-pixel boxes (x1==x2) have area 1 in the +1 convention; exact
+    # duplicates of them must suppress each other, not pass NMS with IoU 0
+    from mxnet_tpu.ndarray.contrib import _proposal_one
+    import jax.numpy as jnp
+    anchors = jnp.asarray([[0.0, 0.0, 0.0, 0.0]] * 2)   # two 1-px anchors
+    scores = jnp.ones((2, 1, 1))
+    deltas = jnp.zeros((8, 1, 1))
+    boxes, scores_out = _proposal_one(
+        scores, deltas, jnp.asarray([8.0, 8.0, 1.0]), anchors, 1.0,
+        pre_nms=2, post_nms=2, thresh=0.5, min_size=1)
+    kept = np.asarray(scores_out) > 0
+    assert kept.sum() == 1   # the duplicate was suppressed
