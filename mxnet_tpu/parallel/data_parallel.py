@@ -51,11 +51,22 @@ class DataParallelTrainer:
     """
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
-                 mesh=None, batch_axis=0, dtype=None, donate=True):
+                 mesh=None, batch_axis=0, dtype=None, donate=True,
+                 shard_updates=False):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh or current_mesh() or make_mesh({"dp": -1})
         self.batch_axis = batch_axis
+        # ZeRO-1 / "weight update sharding" (MLPerf-on-TPU-pods technique,
+        # PAPERS.md arXiv:1909.09756 / arXiv:2011.03641): shard the
+        # optimizer state and the update over 'dp' via sharding
+        # constraints, so XLA lowers the gradient all-reduce into
+        # reduce-scatter + (post-update) all-gather — identical wire
+        # bytes (ring AR == RS+AG), 1/N optimizer memory and update
+        # compute per chip
+        self._shard_updates = bool(shard_updates) and \
+            self.mesh.shape.get("dp", 1) > 1
+        self._ws_eligible = None
         params_kwargs = dict(optimizer_params or {})
         self._lr = params_kwargs.pop("learning_rate", 0.01)
         self._lr_scheduler = params_kwargs.pop("lr_scheduler", None)
@@ -94,6 +105,39 @@ class DataParallelTrainer:
             return NamedSharding(self.mesh, p.shard_spec)
         return NamedSharding(self.mesh, P())
 
+    # -- weight-update sharding helpers ---------------------------------
+    def _ws_flags(self, param_vals):
+        """Which params take the sharded update: replicated params whose
+        leading dim divides the dp axis (tp-sharded params keep their own
+        spec; oddly-shaped leftovers stay replicated — correct either
+        way, this is a memory/compute optimization, not semantics)."""
+        if self._ws_eligible is None:
+            dp = self.mesh.shape.get("dp", 1)
+            self._ws_eligible = [
+                self._shard_updates and p.shard_spec is None and
+                v.ndim >= 1 and v.shape[0] % dp == 0 and v.shape[0] >= dp
+                for p, v in zip(self._param_objs, param_vals)]
+        return self._ws_eligible
+
+    def _ws_spec(self, leaf_ndim):
+        return NamedSharding(self.mesh,
+                             P(*(["dp"] + [None] * (leaf_ndim - 1))))
+
+    def _ws_leaf_sharding(self, x, ref_dim0):
+        """The ONE predicate for how a state leaf lives under weight-update
+        sharding: per-element leaves (same leading dim as the param) are
+        dp-sharded, scalar leaves (step counters) replicated.  Shared by
+        the initial device_put and the traced constraints so the two can
+        never disagree (which would force a reshard every step)."""
+        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == ref_dim0:
+            return self._ws_spec(x.ndim)
+        return NamedSharding(self.mesh, P())
+
+    def _ws_constrain_state(self, s, ref_dim0):
+        return jax.tree.map(
+            lambda x: jax.lax.with_sharding_constraint(
+                x, self._ws_leaf_sharding(x, ref_dim0)), s)
+
     def _step_body(self):
         """The fused fwd/bwd/reduce/update body shared by the *batch and
         indexed-epoch jit entry points (single source — the two step paths
@@ -117,9 +161,25 @@ class DataParallelTrainer:
                 return jnp.mean(loss.data)
 
             loss, grads = jax.value_and_grad(loss_of)(list(param_vals))
+            ws = self._ws_flags(param_vals)
             new_params, new_state = [], []
-            for p, g, s in zip(param_vals, grads, opt_state):
-                np_, ns = rule_apply(p, g.astype(p.dtype), s, lr)
+            for p, g, s, shard in zip(param_vals, grads, opt_state, ws):
+                g = g.astype(p.dtype)
+                if shard:
+                    # constrain grad + state to 'dp' shards: XLA lowers
+                    # the grad psum into a reduce-scatter feeding a
+                    # 1/N-sized update, then the P() constraint below
+                    # all-gathers the fresh params (ZeRO-1)
+                    g = jax.lax.with_sharding_constraint(
+                        g, self._ws_spec(g.ndim))
+                    p_sh = jax.lax.with_sharding_constraint(
+                        p, self._ws_spec(p.ndim))
+                    s = self._ws_constrain_state(s, p.shape[0])
+                    np_, ns = rule_apply(p_sh, g, s, lr)
+                    np_ = jax.lax.with_sharding_constraint(
+                        np_, NamedSharding(self.mesh, P()))
+                else:
+                    np_, ns = rule_apply(p, g, s, lr)
                 new_params.append(np_)
                 new_state.append(ns)
             return new_params, new_state, loss
@@ -221,10 +281,16 @@ class DataParallelTrainer:
                     self._param_vals[i] = jax.device_put(
                         p.data().data, self._param_sharding(p))
         if self._opt_state is None:
+            ws = self._ws_flags(self._param_vals)
+            def put(x, shard, dim0):
+                if shard:
+                    return jax.device_put(x, self._ws_leaf_sharding(x, dim0))
+                return jax.device_put(x, NamedSharding(self.mesh, P()))
             self._opt_state = [
-                jax.tree.map(lambda x: jax.device_put(
-                    x, NamedSharding(self.mesh, P())), self._rule_init(v))
-                for v in self._param_vals]
+                jax.tree.map(
+                    lambda x, s=shard, d=v.shape[0] if v.ndim else 1:
+                    put(x, s, d), self._rule_init(v))
+                for v, shard in zip(self._param_vals, ws)]
 
     def step_indexed(self, epoch_handle, i):
         """One fused train step on batch ``i`` of a resident epoch
