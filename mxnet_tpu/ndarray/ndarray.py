@@ -86,6 +86,13 @@ def _check_narrowing(np_arr):
                 "MXTPU_INT64=1 for true 64-bit tensors", stacklevel=3)
 
 
+def _ndarray_from_numpy(host):
+    """Unpickle target for NDArray.__reduce__ (module-level so pickle can
+    resolve it by name; materializes on the unpickler's default device)."""
+    import jax.numpy as jnp
+    return NDArray(jnp.asarray(host))
+
+
 class NDArray:
     """An n-dimensional array on a device context.
 
@@ -114,6 +121,15 @@ class NDArray:
     def _sync_handles(self):
         """Buffers waitall() must block on (sparse overrides: no densify)."""
         return (self._data,)
+
+    def __reduce__(self):
+        """Pickle as host numpy (reference NDArrays pickle via their
+        binary blob, python/mxnet/ndarray/ndarray.py __reduce__).  Device
+        placement is process-local state: the unpickling process
+        re-materializes on ITS default device — which is what DataLoader
+        process workers need (host-only children, accelerator parent)."""
+        import numpy as _host_np
+        return (_ndarray_from_numpy, (_host_np.asarray(self._data),))
 
     # ------------------------------------------------------------------
     # basic properties

@@ -69,6 +69,13 @@ class RowSparseNDArray(BaseSparseNDArray):
         self._sparse_stale = False
         super().__init__(_LAZY, ctx or current_context())
 
+    def __reduce__(self):
+        """Pickle the COMPRESSED representation (base NDArray.__reduce__
+        would densify and come back dense, losing stype)."""
+        return (_row_sparse_from_host,
+                (_np.asarray(self._values), _np.asarray(self._indices),
+                 self._dense_shape))
+
     # -- lazy dense view ------------------------------------------------
     @property
     def _data(self):
@@ -174,6 +181,12 @@ class CSRNDArray(BaseSparseNDArray):
         self._dense_shape = tuple(int(s) for s in shape)
         self._dense_cache = None
         super().__init__(_LAZY, ctx or current_context())
+
+    def __reduce__(self):
+        """Pickle the compressed CSR triple, not the dense view."""
+        return (_csr_from_host,
+                (_np.asarray(self._values_csr), self._indptr.copy(),
+                 self._indices_csr.copy(), self._dense_shape))
 
     @property
     def _data(self):
@@ -323,3 +336,11 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     from . import ops as _ops
     return _ops.dot(lhs, rhs, transpose_a=transpose_a,
                     transpose_b=transpose_b)
+
+def _row_sparse_from_host(values, indices, shape):
+    """Unpickle target: re-materialize on the unpickler's default device."""
+    return RowSparseNDArray(jnp.asarray(values), jnp.asarray(indices), shape)
+
+
+def _csr_from_host(values, indptr, indices, shape):
+    return CSRNDArray(jnp.asarray(values), indptr, indices, shape)

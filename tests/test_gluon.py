@@ -560,3 +560,35 @@ def test_identity_and_concatenate():
         loss = cat(x).sum()
     loss.backward()
     assert isinstance(nn.Concatenate(axis=1), nn.HybridConcatenate)
+
+
+def test_dataloader_process_workers():
+    """thread_pool=False: true worker PROCESSES (reference default
+    semantics) — spawned with CPU-only jax, dataset shipped via pickle
+    (NDArray.__reduce__ -> numpy), batches returned as numpy and
+    re-materialized in the parent."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    ds = gluon.data.ArrayDataset(
+        mx.nd.array(np.arange(48).reshape(24, 2)),
+        mx.nd.array(np.arange(24)))
+    loader = gluon.data.DataLoader(ds, batch_size=4, num_workers=2,
+                                   thread_pool=False)
+    for _ in range(2):   # pool persists across epochs
+        seen = []
+        for data, label in loader:
+            assert data.shape == (4, 2)
+            seen.append(label.asnumpy())
+        assert np.concatenate(seen).tolist() == list(range(24))
+    del loader
+
+
+def test_ndarray_pickle_roundtrip():
+    import pickle
+    import numpy as np
+    import mxnet_tpu as mx
+    a = mx.nd.array(np.arange(6.0).reshape(2, 3))
+    b = pickle.loads(pickle.dumps(a))
+    assert isinstance(b, mx.nd.NDArray)
+    np.testing.assert_array_equal(a.asnumpy(), b.asnumpy())

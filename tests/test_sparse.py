@@ -286,3 +286,21 @@ def test_embedding_sparse_grad_survives_hybridize():
     # eval forward still uses the jitted path (no grads involved)
     out = net(ids)
     assert out.shape == (1, 3, 3)
+
+
+def test_sparse_pickle_preserves_stype():
+    """Base NDArray pickles via numpy; sparse subclasses must round-trip
+    their COMPRESSED representation, not a densified base NDArray."""
+    import pickle
+    import numpy as np
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.array([[0, 1, 0], [2, 0, 3], [0, 0, 4]], dtype=np.float32)
+    c2 = pickle.loads(pickle.dumps(sp.csr_matrix(dense)))
+    assert isinstance(c2, sp.CSRNDArray)
+    np.testing.assert_array_equal(c2.asnumpy(), dense)
+    r = sp.row_sparse_array((np.array([[1., 2.], [3., 4.]]),
+                             np.array([0, 2])), shape=(4, 2))
+    r2 = pickle.loads(pickle.dumps(r))
+    assert isinstance(r2, sp.RowSparseNDArray)
+    np.testing.assert_array_equal(np.asarray(r2.indices.data), [0, 2])
+    np.testing.assert_array_equal(r2.asnumpy(), r.asnumpy())

@@ -34,6 +34,8 @@ def _child():
     import numpy as np
     import jax
     import jax.numpy as jnp
+    from bench import _enable_compile_cache
+    _enable_compile_cache()   # retries after tunnel hiccups skip recompiles
     from mxnet_tpu.ops.flash_attention import _flash, _scan_forward
 
     impl = os.environ["MXTPU_FLASH_IMPL"]

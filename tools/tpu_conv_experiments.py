@@ -25,6 +25,8 @@ def run_config(name, batch, s2d, layout, iters=20, warmup=3):
         os.environ.pop("MXTPU_CONV_LAYOUT", None)
 
     import jax
+    from bench import _enable_compile_cache
+    _enable_compile_cache()   # retries after tunnel hiccups skip recompiles
     import mxnet_tpu as mx
     from mxnet_tpu import gluon
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
