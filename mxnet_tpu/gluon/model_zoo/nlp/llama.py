@@ -269,6 +269,39 @@ class LlamaForCausalLM(HybridBlock):
 
         return apply_nary(fn, [x, w], name="tied_lm_head")
 
+    def fused_ce_loss(self, tokens, targets, block=2048,
+                      ignore_index=None):
+        """Per-token CE via the blocked fused head
+        (ops/blocked_cross_entropy.py): the (B, L, V) logit tensor is
+        never materialized — O(B*L*block) activation memory, the
+        long-context memory lever on the loss side (remat covers the
+        trunk side). Single-path head only: with a column-TP lm_head the
+        vocab is sharded and the blocked logsumexp would need a psum per
+        block — use the standard logits path there."""
+        from ....base import MXNetError
+        from ....ndarray.ndarray import apply_nary
+        from ....ops.blocked_cross_entropy import \
+            fused_linear_cross_entropy as f
+        if self.cfg.tensor_parallel:
+            raise MXNetError("fused_ce_loss: vocab is column-sharded "
+                             "under tensor_parallel; use the logits path")
+        import jax.numpy as jnp
+        x = self.model(tokens)
+        w = (self.model.embed.weight.data() if self.lm_head is None
+             else self.lm_head.weight.data())
+
+        def fn(h, wv, t):
+            d = h.shape[-1]
+            # both storage layouts are (V, d): lm_head Dense and the tied
+            # embedding — transpose unconditionally (a layout change
+            # fails loudly in the matmul instead of silently sniffing)
+            loss = f(h.reshape(-1, d), wv.T,
+                     t.reshape(-1).astype(jnp.int32), block=block,
+                     ignore_index=ignore_index)
+            return loss.reshape(h.shape[:-1])
+
+        return apply_nary(fn, [x, w, targets], name="fused_ce_loss")
+
     # ------------------------------------------------------------------
     # KV-cache autoregressive decoding
     # ------------------------------------------------------------------

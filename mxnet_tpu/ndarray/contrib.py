@@ -27,7 +27,7 @@ __all__ = ["box_iou", "box_nms", "box_encode", "box_decode",
            "boolean_mask", "index_copy", "index_array", "allclose",
            "gradientmultiplier", "fft", "ifft", "count_sketch",
            "quadratic", "div_sqrt_dim", "edge_id",
-           "Proposal", "MultiProposal"]
+           "Proposal", "MultiProposal", "fused_linear_cross_entropy"]
 
 
 def _corner(box, fmt):
@@ -975,3 +975,21 @@ def Proposal(cls_prob, bbox_pred, im_info, **kwargs):
         raise MXNetError("Proposal expects batch size 1; "
                          "use MultiProposal for batched inputs")
     return MultiProposal(cls_prob, bbox_pred, im_info, **kwargs)
+
+
+def fused_linear_cross_entropy(data, weight, targets, block=2048,
+                               ignore_index=None):
+    """Fused LM-head + CE with blocked vocabulary: per-token loss of
+    ``softmax(data @ weight)`` without ever materializing the (N, V)
+    logits (O(N*block) peak memory, backward recomputes block softmax).
+    See mxnet_tpu/ops/blocked_cross_entropy.py; the reference computes CE
+    on materialized logits (src/operator/nn/softmax.cc) — this is the
+    TPU-first large-vocab/long-context replacement."""
+    from ..ops.blocked_cross_entropy import fused_linear_cross_entropy as f
+
+    def fn(x, w, t):
+        return f(x, w, t.astype(jnp.int32), block=block,
+                 ignore_index=ignore_index)
+
+    return apply_nary(fn, [data, weight, _as_nd(targets, data)],
+                      name="fused_linear_cross_entropy")

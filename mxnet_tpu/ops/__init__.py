@@ -5,5 +5,6 @@ The reference accelerates its hot ops with hand-written CUDA/cuDNN
 covers what XLA won't fuse well — starting with flash attention.
 """
 from .flash_attention import flash_attention
+from .blocked_cross_entropy import fused_linear_cross_entropy
 
-__all__ = ["flash_attention"]
+__all__ = ["flash_attention", "fused_linear_cross_entropy"]
