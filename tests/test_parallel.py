@@ -271,3 +271,45 @@ def test_weight_update_sharding_matches_replicated():
         np.testing.assert_allclose(pr.data().asnumpy(),
                                    ps.data().asnumpy(), rtol=1e-4,
                                    atol=1e-5)
+
+
+@needs8
+def test_step_accum_matches_single_big_batch():
+    """In-graph gradient accumulation: n_micro microbatches through
+    lax.scan + one update == one big-batch step (for batch-independent
+    models; BN would differ by design)."""
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    def build():
+        np.random.seed(0)
+        net = gluon.nn.Dense(8)
+        net.initialize()
+        net(nd.zeros((2, 16)))
+        for p in net.collect_params().values():
+            p.set_data(nd.array(np.random.RandomState(1)
+                                .randn(*p.shape).astype(np.float32)))
+        return net
+
+    x = nd.array(np.random.RandomState(2).randn(16, 16).astype(np.float32))
+    y = nd.array(np.random.RandomState(3).randint(0, 8, (16,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+
+    with mesh_scope(mesh):
+        big = DataParallelTrainer(build(), loss_fn, "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh)
+        loss_big = big.step(x, y)
+        acc = DataParallelTrainer(build(), loss_fn, "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh)
+        loss_acc = acc.step_accum(x, y, n_micro=4)
+
+    np.testing.assert_allclose(loss_acc.asnumpy(), loss_big.asnumpy(),
+                               rtol=1e-5)
+    for (_, pb), (_, pa) in zip(
+            sorted(big.block.collect_params().items()),
+            sorted(acc.block.collect_params().items())):
+        np.testing.assert_allclose(pb.data().asnumpy(),
+                                   pa.data().asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
+    with pytest.raises(mx.MXNetError):
+        acc.step_accum(x, y, n_micro=5)   # 16 % 5 != 0
