@@ -29,3 +29,67 @@ def moveaxis(data, source, destination):
     import jax.numpy as jnp
     from .ndarray import _apply1
     return _apply1(data, lambda d: jnp.moveaxis(d, source, destination))
+
+
+def _dense_tostype(self, stype):
+    """Dense -> requested storage (reference NDArray.tostype over
+    cast_storage, src/operator/tensor/cast_storage.cc; sparse classes
+    override with their own conversions)."""
+    if stype == "default":
+        # reference cast_storage always returns a NEW array
+        return self.copy()
+    from .sparse import row_sparse_array, csr_matrix
+    if stype == "row_sparse":
+        return row_sparse_array(self)
+    if stype == "csr":
+        return csr_matrix(self)
+    from ..base import MXNetError
+    raise MXNetError(f"unknown storage type {stype!r}")
+
+
+from .ndarray import NDArray as _NDArrayCls
+
+if not hasattr(_NDArrayCls, "tostype"):
+    _NDArrayCls.tostype = _dense_tostype
+
+
+# ----------------------------------------------------------------------
+# Registry-driven method surface: the reference autogenerates NDArray
+# methods from the op registry (python/mxnet/ndarray/ndarray.py autogen
+# block); same idea here — every listed op whose first positional arg is
+# the array becomes a method, forwarding to the tape-integrated op (NOT
+# a raw jnp call, so autograd/vjp semantics are identical either way).
+# ----------------------------------------------------------------------
+
+_METHOD_FORWARD_OPS = [
+    "flip", "diag", "sort", "argsort", "sign", "round", "rint", "ceil",
+    "floor", "trunc", "fix", "square", "rsqrt", "cbrt", "log2", "log10",
+    "log1p", "expm1", "sin", "cos", "tan", "arcsin", "arccos", "arctan",
+    "degrees", "radians", "sinh", "cosh", "arcsinh", "arccosh", "arctanh",
+    "slice", "slice_like", "pad", "batch_dot", "nansum", "nanprod",
+    "moments", "shape_array", "size_array", "split", "one_hot", "take",
+    "pick", "repeat", "tile", "norm", "erf", "erfinv", "gamma",
+    "gammaln", "reciprocal",
+]
+
+
+def _make_op_method(_op, _name):
+    def method(self, *args, **kwargs):
+        return _op(self, *args, **kwargs)
+    method.__name__ = _name
+    method.__doc__ = (f"Method form of ``mx.nd.{_name}`` (reference "
+                      f"autogen NDArray method surface).")
+    return method
+
+
+import sys as _sys
+_this = _sys.modules[__name__]
+for _name in _METHOD_FORWARD_OPS:
+    if not hasattr(_this, _name):
+        # fail CLOSED: a renamed/misspelled op must break the import,
+        # not silently drop the method
+        raise ImportError(f"_METHOD_FORWARD_OPS lists unknown op {_name!r}")
+    if not hasattr(_NDArrayCls, _name):
+        setattr(_NDArrayCls, _name, _make_op_method(getattr(_this, _name),
+                                                    _name))
+del _sys, _this, _name

@@ -56,6 +56,8 @@ relu = _unary_factory("relu", jax.nn.relu)
 sigmoid = _unary_factory("sigmoid", jax.nn.sigmoid)
 softsign = _unary_factory("softsign", jax.nn.soft_sign)
 tanh = _unary_factory("tanh", jnp.tanh)
+degrees = _unary_factory("degrees", jnp.degrees)
+radians = _unary_factory("radians", jnp.radians)
 exp = _unary_factory("exp", jnp.exp)
 log = _unary_factory("log", jnp.log)
 log2 = _unary_factory("log2", jnp.log2)

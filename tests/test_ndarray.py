@@ -285,3 +285,34 @@ def test_global_pool_sum():
     assert abs(float(out.asnumpy().ravel()[0]) - 16.0) < 1e-6
     out = mx.nd.Pooling(x, pool_type="avg", global_pool=True)
     assert abs(float(out.asnumpy().ravel()[0]) - 1.0) < 1e-6
+
+
+def test_registry_driven_method_surface():
+    """Reference autogen parity: op registry entries exposed as NDArray
+    methods, forwarding to the tape-integrated ops."""
+    import numpy as np
+    from mxnet_tpu import autograd
+    a = nd.array(np.array([[4.0, 1.0], [9.0, 16.0]]))
+    for name in ["flip", "diag", "sort", "argsort", "sign", "round",
+                 "ceil", "floor", "square", "rsqrt", "log2", "sin",
+                 "cos", "tan", "sinh", "pad", "batch_dot", "nansum",
+                 "moments", "shape_array", "tile", "norm", "degrees",
+                 "radians", "tostype", "slice"]:
+        assert hasattr(a, name), name
+    np.testing.assert_allclose(a.square().asnumpy(), a.asnumpy() ** 2)
+    np.testing.assert_allclose(a.sort().asnumpy(), np.sort(a.asnumpy()))
+    np.testing.assert_allclose(
+        nd.array([np.pi]).degrees().asnumpy(), [180.0], rtol=1e-6)
+    # the method form records on the tape exactly like the op form
+    a.attach_grad()
+    with autograd.record():
+        y = a.square().sum()
+    y.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), 2 * a.asnumpy())
+    # dense -> sparse storage conversion
+    from mxnet_tpu.ndarray import sparse as sp
+    r = a.tostype("row_sparse")
+    assert isinstance(r, sp.RowSparseNDArray)
+    np.testing.assert_allclose(r.asnumpy(), a.asnumpy())
+    c = a.tostype("csr")
+    assert isinstance(c, sp.CSRNDArray)
