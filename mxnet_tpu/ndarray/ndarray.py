@@ -713,6 +713,20 @@ def _resolve_reshape(cur, shape):
 
 def _convert_index(key):
     if isinstance(key, NDArray):
+        if key._data.dtype == jnp.bool_:
+            # boolean-mask indexing (reference NDArray supports it via
+            # np-compat semantics): keep the mask a mask — casting it to
+            # int32 would silently reinterpret it as integer indices.
+            # The result shape is data-dependent (number of True
+            # entries), legal eagerly but not under a jit trace.
+            import jax.core as _core
+            if isinstance(key._data, _core.Tracer):
+                raise MXNetError(
+                    "boolean-mask indexing has a data-dependent result "
+                    "shape and cannot appear inside a jit-traced "
+                    "function; use nd.where / contrib.boolean_mask with "
+                    "static shapes instead")
+            return key._data
         return key._data.astype(jnp.int32)
     if isinstance(key, tuple):
         return tuple(_convert_index(k) for k in key)

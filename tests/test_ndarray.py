@@ -316,3 +316,30 @@ def test_registry_driven_method_surface():
     np.testing.assert_allclose(r.asnumpy(), a.asnumpy())
     c = a.tostype("csr")
     assert isinstance(c, sp.CSRNDArray)
+
+
+def test_boolean_mask_indexing():
+    """bool-DTYPE NDArray keys mask (np-compat); float comparison
+    results keep the legacy integer-gather semantics (reference mx.nd
+    comparisons return float 0/1 and never meant masking)."""
+    import numpy as np
+    a = nd.array(np.arange(24.0).reshape(4, 6))
+    mask = (a > 10).astype("bool")
+    np.testing.assert_allclose(a[mask].asnumpy(),
+                               np.arange(24.0)[np.arange(24.0) > 10])
+    b = nd.array(np.arange(6.0))
+    b[(b > 3).astype("bool")] = 0.0
+    np.testing.assert_allclose(b.asnumpy(), [0, 1, 2, 3, 0, 0])
+    # numpy bool keys work directly
+    assert a[np.array([True, False, True, False])].shape == (2, 6)
+    # a bool mask inside jit has a data-dependent shape -> clear error
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.ndarray import NDArray
+
+    def traced(d, m):
+        return NDArray(d)[NDArray(m)]
+
+    with pytest.raises(mx.MXNetError):
+        jax.jit(lambda d, m: traced(d, m).data)(
+            a.data, mask.data)
