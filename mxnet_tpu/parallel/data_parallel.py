@@ -140,6 +140,21 @@ class DataParallelTrainer:
             lambda x: jax.lax.with_sharding_constraint(
                 x, self._ws_leaf_sharding(x, ref_dim0)), s)
 
+    def _eff_bax(self, ndim):
+        """Effective batch axis for an input of the given rank: arrays
+        with fewer dims than batch_axis+1 (e.g. rank-1 labels under a
+        time-major batch_axis=1) carry their batch on the LAST axis."""
+        return self.batch_axis if ndim > self.batch_axis else \
+            max(ndim - 1, 0)
+
+    def _batch_sharding(self, b):
+        if not b.ndim:
+            return NamedSharding(self.mesh, P())
+        ax = self._eff_bax(b.ndim)
+        spec = [None] * b.ndim
+        spec[ax] = "dp"
+        return NamedSharding(self.mesh, P(*spec))
+
     def _make_loss_of(self):
         """The traced fwd+loss closure — ONE source for every step
         variant (plain, indexed, accumulating)."""
@@ -223,11 +238,13 @@ class DataParallelTrainer:
         update logic come from the same _make_loss_of/_apply_updates the
         plain step uses (single source, cannot diverge)."""
         loss_of = self._make_loss_of()
-        bax = self.batch_axis
 
         def split_micro(b):
-            # split the BATCH axis into n_micro leading scan slices,
-            # preserving the original layout within each microbatch
+            # split each array's own effective BATCH axis into n_micro
+            # leading scan slices, preserving the layout within each
+            # microbatch (rank-1 labels under batch_axis=1 split on
+            # axis 0 — see _eff_bax)
+            bax = self._eff_bax(b.ndim)
             s = b.shape
             b = b.reshape(s[:bax] + (n_micro, s[bax] // n_micro)
                           + s[bax + 1:])
@@ -269,7 +286,7 @@ class DataParallelTrainer:
             raise MXNetError("step_accum: n_micro must be >= 1")
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
-        bax = self.batch_axis
+        bax = self._eff_bax(inputs[-1].ndim)
         if inputs[-1].shape[bax] % n_micro:
             raise MXNetError(
                 f"step_accum: batch axis {bax} size "
@@ -277,18 +294,17 @@ class DataParallelTrainer:
                 f"{n_micro}")
         if self._param_objs is None:
             # one-microbatch probe resolves deferred shapes (sliced on
-            # the batch axis); skipped entirely once params exist
+            # each input's own effective batch axis); skipped once
+            # params exist
             probe = [NDArray(jnp.take(
-                b, jnp.arange(max(1, b.shape[bax] // n_micro)), axis=bax))
-                for b in inputs[:-1]]
+                b, jnp.arange(max(1, b.shape[self._eff_bax(b.ndim)]
+                                  // n_micro)),
+                axis=self._eff_bax(b.ndim))) for b in inputs[:-1]]
             params = self._collect(*probe)
         else:
             params = self._param_objs
-        mesh = self.mesh
-        inputs = [jax.device_put(b, NamedSharding(
-            mesh, P(*([None] * self.batch_axis +
-                      (["dp"] if b.ndim else [])))))
-            for b in inputs]
+        inputs = [jax.device_put(b, self._batch_sharding(b))
+                  for b in inputs]
         self._ensure_device_state(params)
         jitted = self._jit_accum_cache.get(n_micro)
         if jitted is None:
@@ -335,9 +351,8 @@ class DataParallelTrainer:
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
         mesh = self.mesh
-        inputs = [jax.device_put(b, NamedSharding(
-            mesh, P(*([None] * self.batch_axis + (["dp"] if b.ndim else [])))))
-            for b in inputs]
+        inputs = [jax.device_put(b, self._batch_sharding(b))
+                  for b in inputs]
         self._ensure_device_state(params)
         if self._jitted is None:
             self._build()

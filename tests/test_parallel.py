@@ -313,3 +313,55 @@ def test_step_accum_matches_single_big_batch():
                                    atol=1e-6)
     with pytest.raises(mx.MXNetError):
         acc.step_accum(x, y, n_micro=5)   # 16 % 5 != 0
+
+
+@needs8
+def test_step_accum_batch_axis_1():
+    """Accumulation must split the BATCH axis, not axis 0: a time-major
+    (T, B) input microbatched on axis 1 equals the big-batch step."""
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    class TimeMajorMLP(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = gluon.nn.Dense(8, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            # x: (T, B, F) -> mean over time -> (B, 8)
+            return self.d(x).mean(axis=0)
+
+    def build():
+        np.random.seed(0)
+        net = TimeMajorMLP()
+        net.initialize()
+        net(nd.zeros((4, 2, 6)))
+        for p in net.collect_params().values():
+            p.set_data(nd.array(np.random.RandomState(1)
+                                .randn(*p.shape).astype(np.float32)))
+        return net
+
+    x = nd.array(np.random.RandomState(2).randn(4, 16, 6)
+                 .astype(np.float32))      # (T=4, B=16, F)
+    y = nd.array(np.random.RandomState(3).randint(0, 8, (16,)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+
+    with mesh_scope(mesh):
+        big = DataParallelTrainer(build(), loss_fn, "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh,
+                                  batch_axis=1)
+        loss_big = big.step(x, y)
+        acc = DataParallelTrainer(build(), loss_fn, "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh,
+                                  batch_axis=1)
+        loss_acc = acc.step_accum(x, y, n_micro=2)
+
+    np.testing.assert_allclose(loss_acc.asnumpy(), loss_big.asnumpy(),
+                               rtol=1e-5)
+    for (_, pb), (_, pa) in zip(
+            sorted(big.block.collect_params().items()),
+            sorted(acc.block.collect_params().items())):
+        np.testing.assert_allclose(pb.data().asnumpy(),
+                                   pa.data().asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
