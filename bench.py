@@ -540,6 +540,51 @@ def _tpu_bandwidth() -> dict:
     return out
 
 
+def _bench_decode() -> dict:
+    """Autoregressive decode throughput (tokens/s) through the Llama
+    KV-cache path (gluon/model_zoo/nlp/llama.py generate(): one jitted
+    lax.scan, O(T) attention against the cache).  The reference era
+    served generation as repeated full forwards; this is the serving-side
+    counterpart of the training headlines."""
+    import jax
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+
+    on_cpu = jax.devices()[0].platform == "cpu"
+    if on_cpu:   # smoke scale
+        cfg = LlamaConfig(vocab_size=256, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128, max_seq_len=128)
+        batch, prefix, new = 2, 8, 16
+    else:
+        # ~0.5B-class decoder: big enough that the MXU/HBM balance is
+        # representative, small enough to compile fast over the tunnel
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          num_layers=8, num_heads=16, num_kv_heads=8,
+                          intermediate_size=2816, max_seq_len=512)
+        batch, prefix, new = 8, 32, 96
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    toks = mx.nd.array(np.random.RandomState(0)
+                       .randint(0, cfg.vocab_size, (batch, prefix)))
+    net(toks)                                      # materialize params
+    out = net.generate(toks, max_new_tokens=new)   # compile + warmup
+    out.asnumpy()
+    t0 = time.perf_counter()
+    reps = 3
+    for _ in range(reps):
+        out = net.generate(toks, max_new_tokens=new)
+    out.asnumpy()
+    dt = (time.perf_counter() - t0) / reps
+    return {"model": "llama-decode", "batch": batch, "prefix": prefix,
+            "new_tokens": new, "hidden": cfg.hidden_size,
+            "layers": cfg.num_layers,
+            "tokens_per_sec": round(batch * new / dt, 1),
+            "ms_per_token": round(dt / new * 1e3, 3)}
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -625,6 +670,11 @@ def _run_bench() -> dict:
             result["extra"]["tpu_bandwidth"] = _tpu_bandwidth()
         except Exception as e:  # noqa: BLE001
             result["extra"]["tpu_bandwidth"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["llama_decode"] = _bench_decode()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["llama_decode"] = {
                 "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(result)
         return result

@@ -53,11 +53,13 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, batch_axis=0, dtype=None, donate=True,
-                 shard_updates=False):
+                 shard_updates=False, label_batch_axis=None):
         self.block = block
         self.loss_fn = loss_fn
         self.mesh = mesh or current_mesh() or make_mesh({"dp": -1})
         self.batch_axis = batch_axis
+        self._label_bax = (batch_axis if label_batch_axis is None
+                           else label_batch_axis)
         # ZeRO-1 / "weight update sharding" (MLPerf-on-TPU-pods technique,
         # PAPERS.md arXiv:1909.09756 / arXiv:2011.03641): shard the
         # optimizer state and the update over 'dp' via sharding
@@ -140,17 +142,28 @@ class DataParallelTrainer:
             lambda x: jax.lax.with_sharding_constraint(
                 x, self._ws_leaf_sharding(x, ref_dim0)), s)
 
-    def _eff_bax(self, ndim):
-        """Effective batch axis for an input of the given rank: arrays
-        with fewer dims than batch_axis+1 (e.g. rank-1 labels under a
-        time-major batch_axis=1) carry their batch on the LAST axis."""
-        return self.batch_axis if ndim > self.batch_axis else \
-            max(ndim - 1, 0)
+    def _eff_bax(self, ndim, is_label=False):
+        """Effective batch axis for an array of the given rank.
 
-    def _batch_sharding(self, b):
+        Inputs carry the batch on ``batch_axis``; the label carries it
+        on ``label_batch_axis`` (defaults to batch_axis).  Rank-1 arrays
+        are per-sample vectors whatever the nominal axis (classic (B,)
+        labels under time-major batch_axis=1).  Rank>=2 arrays MUST have
+        their batch on the configured axis — that is the API contract; a
+        (B, C) soft-label under time-major data needs
+        ``label_batch_axis=0``, it cannot be inferred from shape."""
+        ax = self._label_bax if is_label else self.batch_axis
+        if ndim <= 1:
+            return 0
+        if ax >= ndim:
+            raise MXNetError(
+                f"batch axis {ax} out of range for rank-{ndim} array")
+        return ax
+
+    def _batch_sharding(self, b, is_label=False):
         if not b.ndim:
             return NamedSharding(self.mesh, P())
-        ax = self._eff_bax(b.ndim)
+        ax = self._eff_bax(b.ndim, is_label)
         spec = [None] * b.ndim
         spec[ax] = "dp"
         return NamedSharding(self.mesh, P(*spec))
@@ -239,12 +252,12 @@ class DataParallelTrainer:
         plain step uses (single source, cannot diverge)."""
         loss_of = self._make_loss_of()
 
-        def split_micro(b):
+        def split_micro(b, is_label=False):
             # split each array's own effective BATCH axis into n_micro
             # leading scan slices, preserving the layout within each
             # microbatch (rank-1 labels under batch_axis=1 split on
             # axis 0 — see _eff_bax)
-            bax = self._eff_bax(b.ndim)
+            bax = self._eff_bax(b.ndim, is_label)
             s = b.shape
             b = b.reshape(s[:bax] + (n_micro, s[bax] // n_micro)
                           + s[bax + 1:])
@@ -253,7 +266,7 @@ class DataParallelTrainer:
         def train_step(param_vals, opt_state, lr, key, *batch):
             inputs, label = list(batch[:-1]), batch[-1]
             micro_in = [split_micro(b) for b in inputs]
-            micro_lab = split_micro(label)
+            micro_lab = split_micro(label, is_label=True)
             keys = jax.random.split(key, n_micro)
 
             def scan_step(carry, xs):
@@ -286,7 +299,7 @@ class DataParallelTrainer:
             raise MXNetError("step_accum: n_micro must be >= 1")
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
-        bax = self._eff_bax(inputs[-1].ndim)
+        bax = self._eff_bax(inputs[-1].ndim, is_label=True)
         if inputs[-1].shape[bax] % n_micro:
             raise MXNetError(
                 f"step_accum: batch axis {bax} size "
@@ -303,8 +316,9 @@ class DataParallelTrainer:
             params = self._collect(*probe)
         else:
             params = self._param_objs
-        inputs = [jax.device_put(b, self._batch_sharding(b))
-                  for b in inputs]
+        inputs = [jax.device_put(b, self._batch_sharding(
+            b, is_label=(i == len(inputs) - 1)))
+            for i, b in enumerate(inputs)]
         self._ensure_device_state(params)
         jitted = self._jit_accum_cache.get(n_micro)
         if jitted is None:
@@ -350,9 +364,9 @@ class DataParallelTrainer:
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
-        mesh = self.mesh
-        inputs = [jax.device_put(b, self._batch_sharding(b))
-                  for b in inputs]
+        inputs = [jax.device_put(b, self._batch_sharding(
+            b, is_label=(i == len(inputs) - 1)))
+            for i, b in enumerate(inputs)]
         self._ensure_device_state(params)
         if self._jitted is None:
             self._build()
@@ -383,8 +397,15 @@ class DataParallelTrainer:
                          else superdata)
         sl = jnp.asarray(superlabel.data if isinstance(superlabel, NDArray)
                          else superlabel)
-        spec_d = P(*([None, "dp"] + [None] * (sd.ndim - 2)))
-        spec_l = P(*([None, "dp"] + [None] * (sl.ndim - 2)))
+        def epoch_spec(a, is_label=False):
+            # leading epoch axis replicated; the within-batch sharding
+            # follows the same _eff_bax rule as step()/step_accum()
+            inner = [None] * (a.ndim - 1)
+            inner[self._eff_bax(a.ndim - 1, is_label)] = "dp"
+            return P(*([None] + inner))
+
+        spec_d = epoch_spec(sd)
+        spec_l = epoch_spec(sl, is_label=True)
         # caller owns the handle; dropping it frees the device buffers
         return (jax.device_put(sd, NamedSharding(mesh, spec_d)),
                 jax.device_put(sl, NamedSharding(mesh, spec_l)))

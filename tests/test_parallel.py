@@ -365,3 +365,60 @@ def test_step_accum_batch_axis_1():
         np.testing.assert_allclose(pb.data().asnumpy(),
                                    pa.data().asnumpy(), rtol=1e-5,
                                    atol=1e-6)
+
+
+@needs8
+def test_step_accum_label_batch_axis():
+    """(B, C) soft labels under time-major data need label_batch_axis=0;
+    the trainer must honor it rather than shredding the class axis."""
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    class TimeMajorMLP(gluon.HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.d = gluon.nn.Dense(8, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            return self.d(x).mean(axis=0)
+
+    class SoftCE(gluon.loss.Loss):
+        def __init__(self, **kw):
+            super().__init__(None, 0, **kw)
+
+        def hybrid_forward(self, F, pred, label):
+            return -(label * F.log_softmax(pred, axis=-1)).sum(axis=-1)
+
+    def build():
+        np.random.seed(0)
+        net = TimeMajorMLP()
+        net.initialize()
+        net(nd.zeros((4, 2, 6)))
+        for p in net.collect_params().values():
+            p.set_data(nd.array(np.random.RandomState(1)
+                                .randn(*p.shape).astype(np.float32)))
+        return net
+
+    x = nd.array(np.random.RandomState(2).randn(4, 16, 6)
+                 .astype(np.float32))
+    soft = np.random.RandomState(3).rand(16, 8).astype(np.float32)
+    soft /= soft.sum(1, keepdims=True)
+    y = nd.array(soft)
+    mesh = make_mesh({"dp": 8})
+    with mesh_scope(mesh):
+        big = DataParallelTrainer(build(), SoftCE(), "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh,
+                                  batch_axis=1, label_batch_axis=0)
+        loss_big = big.step(x, y)
+        acc = DataParallelTrainer(build(), SoftCE(), "sgd",
+                                  {"learning_rate": 0.1}, mesh=mesh,
+                                  batch_axis=1, label_batch_axis=0)
+        loss_acc = acc.step_accum(x, y, n_micro=2)
+    np.testing.assert_allclose(loss_acc.asnumpy(), loss_big.asnumpy(),
+                               rtol=1e-5)
+    for (_, pb), (_, pa) in zip(
+            sorted(big.block.collect_params().items()),
+            sorted(acc.block.collect_params().items())):
+        np.testing.assert_allclose(pb.data().asnumpy(),
+                                   pa.data().asnumpy(), rtol=1e-5,
+                                   atol=1e-6)
