@@ -168,6 +168,13 @@ class DataParallelTrainer:
         spec[ax] = "dp"
         return NamedSharding(self.mesh, P(*spec))
 
+    def _put_batch(self, inputs):
+        """device_put every batch array with its batch sharding; the
+        LAST array is the label (single convention for step/step_accum)."""
+        return [jax.device_put(b, self._batch_sharding(
+            b, is_label=(i == len(inputs) - 1)))
+            for i, b in enumerate(inputs)]
+
     def _make_loss_of(self):
         """The traced fwd+loss closure — ONE source for every step
         variant (plain, indexed, accumulating)."""
@@ -316,9 +323,7 @@ class DataParallelTrainer:
             params = self._collect(*probe)
         else:
             params = self._param_objs
-        inputs = [jax.device_put(b, self._batch_sharding(
-            b, is_label=(i == len(inputs) - 1)))
-            for i, b in enumerate(inputs)]
+        inputs = self._put_batch(inputs)
         self._ensure_device_state(params)
         jitted = self._jit_accum_cache.get(n_micro)
         if jitted is None:
@@ -364,9 +369,7 @@ class DataParallelTrainer:
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
-        inputs = [jax.device_put(b, self._batch_sharding(
-            b, is_label=(i == len(inputs) - 1)))
-            for i, b in enumerate(inputs)]
+        inputs = self._put_batch(inputs)
         self._ensure_device_state(params)
         if self._jitted is None:
             self._build()
