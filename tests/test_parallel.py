@@ -422,3 +422,31 @@ def test_step_accum_label_batch_axis():
         np.testing.assert_allclose(pb.data().asnumpy(),
                                    pa.data().asnumpy(), rtol=1e-5,
                                    atol=1e-6)
+
+
+@needs8
+def test_amp_zero1_accum_interaction():
+    """bf16 AMP + ZeRO-1 sharded updates + in-graph accumulation compose
+    in one trainer: loss descends across mixed step kinds."""
+    from mxnet_tpu import amp
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+    amp.init(target_dtype="bfloat16")
+    try:
+        np.random.seed(0)
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+        net.initialize()
+        net.hybridize()
+        x = nd.array(np.random.randn(16, 16).astype(np.float32))
+        y = nd.array(np.random.randint(0, 8, (16,)))
+        mesh = make_mesh({"dp": 8})
+        with mesh_scope(mesh):
+            tr = DataParallelTrainer(
+                net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+                {"learning_rate": 1e-2}, mesh=mesh, shard_updates=True)
+            l1 = float(tr.step(x, y).asnumpy())
+            tr.step_accum(x, y, n_micro=4)
+            l3 = float(tr.step(x, y).asnumpy())
+        assert l3 < l1, (l1, l3)
+    finally:
+        amp._deinit_for_tests()   # restore default precision policy
