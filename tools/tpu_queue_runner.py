@@ -373,19 +373,28 @@ def main() -> int:
     os.makedirs(QDIR, exist_ok=True)
     if not _acquire_lock():
         return 1
-    st = _load_state()
     only = os.environ.get("MXTPU_QUEUE_STEPS")
-    wanted = only.split(",") if only else [n for n, _ in STEPS]
-    for name, fn in STEPS:
-        if name not in wanted:
-            continue
-        if st["done"].get(name):
-            _log(f"step {name}: already done, skipping")
-            continue
-        _log(f"step {name}: starting")
-        fn(st)
-    _log("queue complete: " + json.dumps(st.get("done", {})))
-    return 0
+    # perpetual: transient per-config failures (half-healed tunnel,
+    # flaky compiles) retry on the next pass instead of needing a human
+    # relaunch; exits only when every wanted step is done
+    while True:
+        st = _load_state()
+        wanted = only.split(",") if only else [n for n, _ in STEPS]
+        for name, fn in STEPS:
+            if name not in wanted:
+                continue
+            if st["done"].get(name):
+                _log(f"step {name}: already done, skipping")
+                continue
+            _log(f"step {name}: starting")
+            fn(st)
+        pending = [n for n in wanted if not st["done"].get(n)]
+        if not pending:
+            _log("queue complete: " + json.dumps(st.get("done", {})))
+            return 0
+        _log(f"pass finished with pending steps {pending}; "
+             f"sleeping 600s before the next pass")
+        time.sleep(600)
 
 
 if __name__ == "__main__":
