@@ -181,9 +181,25 @@ def _probe(timeout: float = 150.0) -> bool:
         "cpu" not in r.stdout.split("PROBE_OK", 1)[1]
 
 
+_DEADLINE = float(os.environ.get("MXTPU_QUEUE_DEADLINE", "0") or 0)
+
+
+class _DeadlineReached(Exception):
+    pass
+
+
+def _check_deadline() -> None:
+    """The driver runs its own bench at round end — this runner must not
+    be holding the chip then. Past the deadline, stop cleanly between
+    steps/configs (never mid-child)."""
+    if _DEADLINE and time.time() > _DEADLINE:
+        raise _DeadlineReached
+
+
 def _wait_for_tunnel(st: dict) -> None:
     back = 120.0
     while True:
+        _check_deadline()
         others = _other_tpu_clients()
         if others:
             _log(f"waiting: another TPU client is alive: {others[0][:100]}")
@@ -380,14 +396,19 @@ def main() -> int:
     while True:
         st = _load_state()
         wanted = only.split(",") if only else [n for n, _ in STEPS]
-        for name, fn in STEPS:
-            if name not in wanted:
-                continue
-            if st["done"].get(name):
-                _log(f"step {name}: already done, skipping")
-                continue
-            _log(f"step {name}: starting")
-            fn(st)
+        try:
+            for name, fn in STEPS:
+                if name not in wanted:
+                    continue
+                if st["done"].get(name):
+                    _log(f"step {name}: already done, skipping")
+                    continue
+                _log(f"step {name}: starting")
+                fn(st)
+        except _DeadlineReached:
+            _log("deadline reached: standing down so the driver's own "
+                 "bench owns the chip")
+            return 0
         pending = [n for n in wanted if not st["done"].get(n)]
         if not pending:
             _log("queue complete: " + json.dumps(st.get("done", {})))
