@@ -44,6 +44,26 @@ def save(fname, data):
     metas = []
     payloads = []
     for name, arr in zip(names, arrays):
+        segs = _sparse_segments(arr)
+        if segs is not None:
+            stype, dtype_name, parts = segs
+            seg_meta, raw = [], b""
+            for part in parts:
+                p = _np.ascontiguousarray(part)
+                seg_meta.append({"shape": list(p.shape),
+                                 "dtype": str(p.dtype),
+                                 "nbytes": p.nbytes})
+                raw += p.tobytes()
+            # stype + segments: sparse arrays round-trip their COMPRESSED
+            # representation (reference NDARRAY_V2 stores stype per
+            # record, src/ndarray/ndarray.cc).  NOTE: containers holding
+            # sparse records need this reader version or newer — an
+            # older _load_native would error on the short payload
+            metas.append({"name": name, "shape": list(arr.shape),
+                          "dtype": dtype_name, "stype": stype,
+                          "segments": seg_meta, "nbytes": len(raw)})
+            payloads.append(raw)
+            continue
         np_arr = _np.ascontiguousarray(_to_numpy_raw(arr))
         metas.append({"name": name, "shape": list(np_arr.shape),
                       "dtype": _dtype_name(arr), "nbytes": np_arr.nbytes})
@@ -60,6 +80,36 @@ def save(fname, data):
 def _dtype_name(arr):
     d = arr.data.dtype
     return str(d)
+
+
+def _sparse_segments(arr):
+    """(stype, dtype, [numpy parts]) for sparse arrays, None for dense.
+
+    Goes through the .values/.indices PROPERTIES (not the private
+    slots): they refresh the compressed pair after dense-path writes,
+    and .dtype never materializes the dense view."""
+    from .sparse import RowSparseNDArray, CSRNDArray
+    if isinstance(arr, RowSparseNDArray):
+        return ("row_sparse", str(arr.dtype),
+                [_np.asarray(arr.values.data),
+                 _np.asarray(arr.indices.data)])
+    if isinstance(arr, CSRNDArray):
+        return ("csr", str(arr.dtype),
+                [_np.asarray(arr.values.data),
+                 _np.asarray(arr.indptr.data),
+                 _np.asarray(arr.indices.data)])
+    return None
+
+
+def _from_sparse_segments(m, parts):
+    # same reconstruction the pickle path uses — one home for it
+    from .sparse import _row_sparse_from_host, _csr_from_host
+    shape = tuple(m["shape"])
+    if m["stype"] == "row_sparse":
+        return _row_sparse_from_host(parts[0], parts[1], shape)
+    if m["stype"] == "csr":
+        return _csr_from_host(parts[0], parts[1], parts[2], shape)
+    raise MXNetError(f"unknown stype {m['stype']!r} in container")
 
 
 def _to_numpy_raw(arr):
@@ -86,6 +136,22 @@ def _load_native(blob):
     off = 16 + hlen
     out_list, out_dict, named = [], {}, False
     for m in metas:
+        if m.get("stype"):
+            parts = []
+            seg_off = off
+            for seg in m["segments"]:
+                cnt = int(_np.prod(seg["shape"])) if seg["shape"] else 1
+                parts.append(_np.frombuffer(
+                    blob, dtype=seg["dtype"], count=cnt,
+                    offset=seg_off).reshape(seg["shape"]))
+                seg_off += seg["nbytes"]
+            off += m["nbytes"]
+            arr = _from_sparse_segments(m, parts)
+            if m["name"]:
+                named = True
+                out_dict[m["name"]] = arr
+            out_list.append(arr)
+            continue
         dtype = m["dtype"] if m["dtype"] != "bfloat16" else "float32"
         np_arr = _np.frombuffer(blob, dtype=dtype, count=int(_np.prod(m["shape"])) if m["shape"] else 1,
                                 offset=off).reshape(m["shape"])

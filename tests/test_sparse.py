@@ -304,3 +304,45 @@ def test_sparse_pickle_preserves_stype():
     assert isinstance(r2, sp.RowSparseNDArray)
     np.testing.assert_array_equal(np.asarray(r2.indices.data), [0, 2])
     np.testing.assert_array_equal(r2.asnumpy(), r.asnumpy())
+
+
+def test_nd_save_load_preserves_stype():
+    """nd.save/load round-trips sparse arrays with their storage type
+    (reference NDARRAY_V2 stores stype per record); dense entries in the
+    same container are unaffected."""
+    import os
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse as sp
+    d = tempfile.mkdtemp()
+    f = os.path.join(d, "mixed.params")
+    dense = np.array([[0, 1], [2, 0], [0, 0]], dtype=np.float32)
+    mx.nd.save(f, {"r": sp.row_sparse_array(dense),
+                   "c": sp.csr_matrix(dense),
+                   "w": mx.nd.ones((2, 2))})
+    out = mx.nd.load(f)
+    assert isinstance(out["r"], sp.RowSparseNDArray)
+    assert isinstance(out["c"], sp.CSRNDArray)
+    assert type(out["w"]) is mx.nd.NDArray
+    np.testing.assert_array_equal(out["r"].asnumpy(), dense)
+    np.testing.assert_array_equal(out["c"].asnumpy(), dense)
+    np.testing.assert_array_equal(np.asarray(out["r"].indices.data),
+                                  [0, 1])
+
+
+def test_nd_save_after_dense_write_saves_fresh_values():
+    """A dense-path write marks the compressed pair stale; save must
+    serialize the REFRESHED values, not the stale ones."""
+    import os
+    import tempfile
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.array([[0.0, 1.0], [2.0, 0.0]], dtype=np.float32)
+    r = sp.row_sparse_array(dense)
+    r += 1.0    # dense-path mutation
+    f = os.path.join(tempfile.mkdtemp(), "fresh.params")
+    mx.nd.save(f, {"r": r})
+    out = mx.nd.load(f)["r"]
+    np.testing.assert_array_equal(out.asnumpy(), dense + 1.0)
