@@ -1,0 +1,103 @@
+"""Unit tests for the TPU measurement queue runner's pure logic
+(tools/tpu_queue_runner.py) — the state machine that lands the on-chip
+numbers must itself be trustworthy: JSON-line parsing, state
+round-trips, platform gating of the conv winner, and the knobs-file
+contract bench.py consumes (bench._apply_knobs_file)."""
+import json
+import os
+
+import pytest
+
+from tools import tpu_queue_runner as qr
+from tools.flash_long_seq import child_env, parse_child_line
+
+
+def test_json_lines_parsing():
+    text = ("garbage\n"
+            '{"config": "base", "img_per_sec": 100.0}\n'
+            "WARNING: noise\n"
+            '{"best": {"config": "s2d"}}\n'
+            "{broken json\n")
+    lines = qr._json_lines(text)
+    assert len(lines) == 2
+    assert lines[0]["config"] == "base"
+    assert "best" in lines[1]
+
+
+def test_state_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(qr, "QDIR", str(tmp_path))
+    monkeypatch.setattr(qr, "STATE", str(tmp_path / "state.json"))
+    st = qr._load_state()
+    assert st == {"done": {}, "conv_results": []}
+    st["done"]["conv_matrix"] = True
+    st["conv_results"].append({"config": "base", "img_per_sec": 1.0})
+    qr._save_state(st)
+    st2 = qr._load_state()
+    assert st2 == st
+    # corrupt state falls back to empty, not a crash
+    (tmp_path / "state.json").write_text("{broken")
+    assert qr._load_state() == {"done": {}, "conv_results": []}
+
+
+def test_flash_child_env_preserves_ambient_pythonpath():
+    env = child_env("flash", 2048, bh=4,
+                    base={"PYTHONPATH": "/ambient/site:", "OTHER": "x"})
+    parts = env["PYTHONPATH"].split(os.pathsep)
+    assert parts[0] == qr.REPO
+    assert "/ambient/site" in parts
+    assert "" not in parts          # empty component would mean cwd
+    assert env["MXTPU_FLASH_IMPL"] == "flash"
+    assert env["MXTPU_FLASH_L"] == "2048"
+    assert env["OTHER"] == "x"
+
+
+def test_parse_child_line_contract():
+    assert parse_child_line("noise\nCHILD {\"impl\": \"scan\", \"L\": 8}\n")\
+        == {"impl": "scan", "L": 8}
+    assert parse_child_line("no child line") is None
+    assert parse_child_line("CHILD {broken") is None
+
+
+def test_conv_winner_knobs_contract(tmp_path, monkeypatch):
+    """step_conv_matrix's knobs output must be exactly what
+    bench._apply_knobs_file consumes: NCHW normalizes to null (no env
+    export), s2d flag 0/1, batch passthrough."""
+    import bench
+    monkeypatch.setattr(qr, "QDIR", str(tmp_path))
+    monkeypatch.setattr(qr, "STATE", str(tmp_path / "state.json"))
+    monkeypatch.setattr(qr, "REPO", str(tmp_path))
+    # simulate a completed matrix in state and run only the winner logic
+    st = {"done": {}, "conv_results": [
+        {"config": "base", "batch": 128, "s2d_stem": False,
+         "conv_layout": "NCHW", "img_per_sec": 2000.0, "platform": "tpu"},
+        {"config": "b256_s2d", "batch": 256, "s2d_stem": True,
+         "conv_layout": "NCHW", "img_per_sec": 2500.0, "platform": "tpu"},
+    ]}
+    ok = [r for r in st["conv_results"] if "img_per_sec" in r]
+    best = max(ok, key=lambda r: r["img_per_sec"])
+    knobs = {"resnet_s2d": 1 if best.get("s2d_stem") else 0,
+             "conv_layout": (best["conv_layout"]
+                             if best.get("conv_layout") not in
+                             (None, "NCHW") else None),
+             "batch": best.get("batch")}
+    kf = tmp_path / ".bench_knobs.json"
+    kf.write_text(json.dumps(knobs))
+    monkeypatch.setattr(bench, "_KNOBS", str(kf))
+    for v in ("MXTPU_RESNET_S2D", "MXTPU_CONV_LAYOUT", "MXTPU_BENCH_BATCH"):
+        monkeypatch.delenv(v, raising=False)
+    bench._apply_knobs_file()
+    assert os.environ["MXTPU_RESNET_S2D"] == "1"
+    assert os.environ["MXTPU_BENCH_BATCH"] == "256"
+    # NCHW stored as null -> no layout export at all
+    assert "MXTPU_CONV_LAYOUT" not in os.environ
+    for v in ("MXTPU_RESNET_S2D", "MXTPU_BENCH_BATCH"):
+        os.environ.pop(v, None)
+
+
+def test_runner_rejects_non_tpu_conv_rows():
+    """The gate that keeps CPU-fallback rows out of best_conv."""
+    rows = [{"config": "base", "img_per_sec": 5.0, "platform": "cpu"},
+            {"config": "s2d", "img_per_sec": 2000.0, "platform": "tpu"}]
+    accepted = [r for r in rows
+                if "img_per_sec" in r and r.get("platform") == "tpu"]
+    assert [r["config"] for r in accepted] == ["s2d"]
