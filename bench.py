@@ -627,10 +627,20 @@ def _run_bench() -> dict:
             result["extra"]["scaling_projection"] = \
                 _scaling_projection(cached["result"])
         result["extra"]["queued_tpu_experiments"] = (
-            "tools/tpu_conv_experiments.py (ResNet MFU matrix), "
-            "tools/flash_long_seq.py (flash vs scan vs naive at 2k-8k), "
-            "tools/bandwidth + bench.py rerun — see "
-            ".claude/skills/verify/SKILL.md")
+            "tools/tpu_queue_runner.py owns the queue (conv MFU matrix "
+            "-> bench refresh -> flash long-seq 2k-32k with naive-OOM "
+            "footprint -> bert batch-128), probe-gated with resumable "
+            "state in .tpu_queue/state.json; the probe trail in "
+            ".tpu_queue/runner.log documents tunnel health over time")
+        try:   # attach the probe trail itself as fallback evidence
+            qlog = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                ".tpu_queue", "runner.log")
+            with open(qlog) as f:
+                tail = f.readlines()[-8:]
+            result["extra"]["tunnel_probe_trail"] = [l.strip()
+                                                     for l in tail]
+        except OSError:
+            pass
         return result
     profile = os.environ.get("MXTPU_BENCH_PROFILE", "") == "1"
     if profile:
