@@ -1,0 +1,153 @@
+"""Gluon loss zoo vs torch.nn oracles (values AND input gradients).
+
+Reference loss semantics live in python/mxnet/gluon/loss.py; each case
+maps the MXNet convention onto the torch equivalent (reduction='none',
+matching weights/margins) so a numerical disagreement is a bug, not a
+convention mismatch.  Complements tests/test_loss_metric.py's manual
+formulas with an independent cross-framework implementation.
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+
+nd = mx.nd
+
+
+def _pair(shape, seed=0, positive=False):
+    rng = np.random.RandomState(seed)
+    a = rng.randn(*shape).astype(np.float32)
+    b = rng.randn(*shape).astype(np.float32)
+    if positive:
+        a, b = np.abs(a) + 0.1, np.abs(b) + 0.1
+    return a, b
+
+
+def _grads(loss_cls, pred_np, label_np, torch_loss, **kw):
+    """(mx per-sample loss, mx dpred) and (torch loss, torch dpred)."""
+    pred = nd.array(pred_np)
+    pred.attach_grad()
+    with autograd.record():
+        l = loss_cls(**kw)(pred, nd.array(label_np))
+        l.mean().backward()
+    tp = torch.tensor(pred_np, requires_grad=True)
+    tl = torch_loss(tp, torch.tensor(label_np))
+    tl.mean().backward()
+    return (l.asnumpy(), pred.grad.asnumpy(),
+            tl.detach().numpy(), tp.grad.numpy())
+
+
+def test_l2_matches_torch_mse():
+    p, y = _pair((8, 5))
+    # MXNet L2Loss = 0.5 * (p - y)^2, mean over non-batch axes
+    ml, mg, tl, tg = _grads(
+        gluon.loss.L2Loss, p, y,
+        lambda a, b: 0.5 * torch.nn.MSELoss(reduction="none")(a, b)
+        .mean(dim=1))
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_l1_matches_torch():
+    p, y = _pair((6, 4), seed=1)
+    ml, mg, tl, tg = _grads(
+        gluon.loss.L1Loss, p, y,
+        lambda a, b: torch.nn.L1Loss(reduction="none")(a, b).mean(dim=1))
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_huber_matches_torch_smooth_l1():
+    p, y = _pair((10, 3), seed=2)
+    rho = 0.7
+    # MXNet HuberLoss(rho): where(|d|>rho, |d|-rho/2, d^2/(2 rho)) ==
+    # torch smooth_l1(beta=rho) exactly
+    ml, mg, tl, tg = _grads(
+        gluon.loss.HuberLoss, p, y,
+        lambda a, b: torch.nn.SmoothL1Loss(
+            reduction="none", beta=rho)(a, b).mean(dim=1),
+        rho=rho)
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_sigmoid_bce_matches_torch():
+    p, _ = _pair((7, 4), seed=3)
+    y = (np.random.RandomState(4).rand(7, 4) > 0.5).astype(np.float32)
+    ml, mg, tl, tg = _grads(
+        gluon.loss.SigmoidBinaryCrossEntropyLoss, p, y,
+        lambda a, b: torch.nn.BCEWithLogitsLoss(reduction="none")(a, b)
+        .mean(dim=1))
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_kldiv_matches_torch():
+    rng = np.random.RandomState(5)
+    # MXNet KLDivLoss(from_logits=True): pred are LOG-probs, label probs
+    logits = rng.randn(5, 6).astype(np.float32)
+    logp = logits - np.log(np.exp(logits).sum(1, keepdims=True))
+    y = rng.rand(5, 6).astype(np.float32)
+    y /= y.sum(1, keepdims=True)
+    ml, mg, tl, tg = _grads(
+        gluon.loss.KLDivLoss, logp, y,
+        lambda a, b: torch.nn.KLDivLoss(reduction="none")(a, b)
+        .mean(dim=1),
+        from_logits=True)
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_softmax_ce_matches_torch():
+    rng = np.random.RandomState(6)
+    p = rng.randn(9, 5).astype(np.float32)
+    y = rng.randint(0, 5, (9,)).astype(np.float32)
+    ml, mg, tl, tg = _grads(
+        gluon.loss.SoftmaxCrossEntropyLoss, p, y,
+        lambda a, b: torch.nn.CrossEntropyLoss(reduction="none")(
+            a, b.long()))
+    np.testing.assert_allclose(ml, tl, rtol=1e-5)
+    np.testing.assert_allclose(mg, tg, rtol=1e-5, atol=1e-7)
+
+
+def test_poisson_nll_matches_torch():
+    rng = np.random.RandomState(7)
+    pred = np.abs(rng.randn(6, 3)).astype(np.float32) + 0.1
+    target = rng.poisson(2.0, (6, 3)).astype(np.float32)
+    # MXNet PoissonNLLLoss(from_logits=False): loss = pred - t*log(pred),
+    # returned as the SCALAR mean (reference gluon/loss.py returns
+    # F.mean(loss), unlike the per-sample losses)
+    ml, mg, tl, tg = _grads(
+        gluon.loss.PoissonNLLLoss, pred, target,
+        lambda a, b: torch.nn.PoissonNLLLoss(
+            log_input=False, full=False, reduction="mean",
+            eps=1e-08)(a, b),
+        from_logits=False)
+    np.testing.assert_allclose(ml, tl, rtol=1e-4)
+    np.testing.assert_allclose(mg, tg, rtol=1e-4, atol=1e-6)
+
+
+def test_triplet_matches_torch():
+    rng = np.random.RandomState(8)
+    anchor = rng.randn(5, 8).astype(np.float32)
+    pos = rng.randn(5, 8).astype(np.float32)
+    neg = rng.randn(5, 8).astype(np.float32)
+    margin = 1.0
+    a = nd.array(anchor)
+    a.attach_grad()
+    with autograd.record():
+        l = gluon.loss.TripletLoss(margin=margin)(
+            a, nd.array(pos), nd.array(neg))
+        l.mean().backward()
+    ta = torch.tensor(anchor, requires_grad=True)
+    # MXNet TripletLoss uses SQUARED distances (sum((a-p)^2 - (a-n)^2))
+    tl = torch.relu(((ta - torch.tensor(pos)) ** 2).sum(1)
+                    - ((ta - torch.tensor(neg)) ** 2).sum(1) + margin)
+    tl.mean().backward()
+    np.testing.assert_allclose(l.asnumpy(), tl.detach().numpy(),
+                               rtol=1e-5)
+    np.testing.assert_allclose(a.grad.asnumpy(), ta.grad.numpy(),
+                               rtol=1e-5, atol=1e-7)
