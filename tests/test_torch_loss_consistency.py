@@ -151,3 +151,44 @@ def test_triplet_matches_torch():
                                rtol=1e-5)
     np.testing.assert_allclose(a.grad.asnumpy(), ta.grad.numpy(),
                                rtol=1e-5, atol=1e-7)
+
+
+@pytest.mark.parametrize("opt_name,torch_cls,wd", [
+    ("adam", "Adam", 0.0),
+    ("adam", "Adam", 0.01),     # L2-coupled wd: both fold wd into grad
+    ("adamw", "AdamW", 0.01),   # decoupled wd
+])
+def test_adam_family_training_dynamics_match_torch(opt_name, torch_cls, wd):
+    """5 full training steps of gluon.Trainer vs torch.optim on the same
+    quadratic objective — independent cross-framework check of the
+    optimizer kernels (bias correction, eps placement, wd coupling)."""
+    W0 = (np.arange(9.0).reshape(3, 3) / 10 + 0.1).astype(np.float32)
+
+    net = gluon.nn.Dense(3, use_bias=False, in_units=3, flatten=False)
+    net.initialize()
+    net.weight.set_data(nd.array(W0))
+    params = {"learning_rate": 0.01}
+    if wd:
+        params["wd"] = wd
+    tr = gluon.Trainer(net.collect_params(), opt_name, params)
+    x = nd.array(np.ones((2, 3), np.float32))
+    for _ in range(5):
+        with autograd.record():
+            loss = (net(x) ** 2).mean()
+        loss.backward()
+        tr.step(1)
+
+    lin = torch.nn.Linear(3, 3, bias=False)
+    with torch.no_grad():
+        lin.weight.copy_(torch.tensor(W0))
+    topt = getattr(torch.optim, torch_cls)(lin.parameters(), lr=0.01,
+                                           weight_decay=wd)
+    tx = torch.ones(2, 3)
+    for _ in range(5):
+        topt.zero_grad()
+        (lin(tx) ** 2).mean().backward()
+        topt.step()
+
+    np.testing.assert_allclose(net.weight.data().asnumpy(),
+                               lin.weight.detach().numpy(),
+                               rtol=1e-5, atol=1e-6)
