@@ -101,3 +101,42 @@ def test_runner_rejects_non_tpu_conv_rows():
     accepted = [r for r in rows
                 if "img_per_sec" in r and r.get("platform") == "tpu"]
     assert [r["config"] for r in accepted] == ["s2d"]
+
+
+def test_run_child_timeout_kills_process_group(tmp_path):
+    """A child that spawns its own grandchild and hangs must be fully
+    reaped on timeout (group SIGTERM), with captured partial output."""
+    import subprocess
+    import sys
+    import time
+    script = tmp_path / "slow_child.py"
+    script.write_text(
+        "import subprocess, sys, time\n"
+        "print('CHILD_STARTED', flush=True)\n"
+        "grand = subprocess.Popen([sys.executable, '-c',"
+        " 'import time; time.sleep(300)'])\n"
+        "time.sleep(300)\n")
+    t0 = time.time()
+    rc, out = qr._run_child([sys.executable, str(script)],
+                            dict(os.environ), timeout=3.0,
+                            log_path=str(tmp_path / "log.txt"))
+    took = time.time() - t0
+    assert rc is None                      # timeout, not exit
+    assert "CHILD_STARTED" in out          # partial output captured
+    assert took < 40                       # TERM path, not a hang
+    # the whole process group (incl. the grandchild) is gone
+    time.sleep(0.5)
+    ps = subprocess.run(["ps", "-eo", "args"], capture_output=True,
+                        text=True).stdout
+    assert "slow_child.py" not in ps
+    assert "time.sleep(300)" not in ps
+
+
+def test_run_child_normal_exit(tmp_path):
+    import sys
+    rc, out = qr._run_child(
+        [sys.executable, "-c", "print('{\"x\": 1}')"],
+        dict(os.environ), timeout=30.0,
+        log_path=str(tmp_path / "log.txt"))
+    assert rc == 0
+    assert qr._json_lines(out) == [{"x": 1}]
