@@ -86,6 +86,9 @@ def _cpu_fallback_subprocess(timeout: float = 900.0) -> dict | None:
     env["JAX_PLATFORMS"] = "cpu"
     env["PYTHONPATH"] = os.path.dirname(os.path.abspath(__file__))
     env["MXTPU_BENCH_CPU_SMOKE"] = "1"   # placeholder numbers, keep it quick
+    # the child must NOT append its compact headline: this parser takes the
+    # LAST json line, and the parent re-compacts (and re-prints) anyway
+    env["MXTPU_BENCH_NO_COMPACT"] = "1"
     try:
         r = subprocess.run(
             [sys.executable, os.path.abspath(__file__)],
@@ -701,6 +704,97 @@ def _run_bench() -> dict:
 
 _TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_last_tpu.json")
+_BENCH_FULL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                           ".bench_full.json")
+_HEADLINE_BUDGET = 1500
+
+
+def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
+    """Serialize the driver-parsed FINAL stdout line, guaranteed small.
+
+    The driver reads only a ~2KB tail window of stdout (round-4 lesson:
+    the 1,827-byte r03 line parsed; the ~3.5KB r04 fallback recorded
+    `parsed: null`), so the last line must stay under budget no matter
+    how much evidence the run produced.  The full payload goes to an
+    earlier stdout line and to `.bench_full.json`; this line carries the
+    headline metric plus scalar summaries, added in priority order with
+    the serialized size re-checked after every addition.
+    """
+    compact = {k: result[k] for k in
+               ("metric", "value", "unit", "vs_baseline") if k in result}
+    extra = result.get("extra") or {}
+    cands = []
+    for k in ("platform", "mfu", "tflops_delivered", "batch", "dtype",
+              "data", "s2d_stem", "flops_source"):
+        if k in result:
+            cands.append((k, result[k]))
+    if "error" in result:
+        err = str(result["error"])
+        cands.append(("error",
+                      err if len(err) <= 160 else err[:157] + "..."))
+
+    def _num(d, *path):
+        for p in path:
+            if not isinstance(d, dict):
+                return None
+            d = d.get(p)
+        ok = isinstance(d, (int, float)) and not isinstance(d, bool)
+        return d if ok else None
+
+    named = (
+        ("bert_samples_s", ("bert", "value")),
+        ("bert_mfu", ("bert", "mfu")),
+        ("rec_img_s", ("resnet_rec_pipeline", "value")),
+        ("decode_tok_s", ("llama_decode", "tokens_per_sec")),
+        ("tpu_h2d_gb_s", ("tpu_bandwidth", "h2d_gb_s")),
+        ("tpu_hbm_gb_s", ("tpu_bandwidth", "hbm_copy_gb_s")),
+        ("kv_per_key_speedup", ("kvstore_bandwidth", "per_key_speedup")),
+    )
+    for name, path in named:
+        v = _num(extra, *path)
+        if v is not None:
+            cands.append((name, v))
+    proj = extra.get("scaling_projection")
+    if isinstance(proj, dict):
+        for row in proj.get("projection", []):
+            if isinstance(row, dict) and row.get("chips") in (8, 256):
+                v = row.get("projected_efficiency")
+                if v is not None:
+                    cands.append((f"proj_eff_{row['chips']}", v))
+    lk = result.get("last_known_tpu")
+    if isinstance(lk, dict):
+        lkr = lk.get("result") or {}
+        lkc = {"cached_at": lk.get("cached_at")}
+        for k in ("value", "mfu", "batch", "dtype"):
+            if k in lkr:
+                lkc[k] = lkr[k]
+        v = _num(lkr.get("extra") or {}, "bert", "value")
+        if v is not None:
+            lkc["bert_samples_s"] = v
+        cands.append(("last_known_tpu", lkc))
+    # generic sweep: future extras (memory-lever measurements, new
+    # sweeps) surface automatically as long as they are scalars, one or
+    # two levels deep, and the budget still allows them
+    handled = {"bert", "resnet_rec_pipeline", "llama_decode",
+               "tpu_bandwidth", "kvstore_bandwidth", "scaling_projection"}
+    for k in sorted(extra):
+        if k in handled:
+            continue
+        v = extra[k]
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            cands.append((k, v))
+        elif isinstance(v, dict):
+            for k2 in sorted(v):
+                v2 = v[k2]
+                if isinstance(v2, (int, float)) and \
+                        not isinstance(v2, bool):
+                    cands.append((f"{k}.{k2}", v2))
+    for k, v in cands:
+        trial = dict(compact)
+        trial[k] = v
+        if len(json.dumps(trial)) <= budget:
+            compact = trial
+    return json.dumps(compact)
 _KNOBS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                       ".bench_knobs.json")
 
@@ -802,7 +896,16 @@ def main() -> int:
         _save_tpu_cache(result)
     if error is not None:
         result["error"] = error
-    print(json.dumps(result))
+    # Full payload: artifact file + an EARLIER stdout line (the driver's
+    # ~2KB tail window must only ever contain the compact headline below)
+    try:
+        with open(_BENCH_FULL, "w") as f:
+            json.dump(result, f, indent=1)
+    except OSError:
+        pass
+    print(json.dumps(result), flush=True)
+    if os.environ.get("MXTPU_BENCH_NO_COMPACT", "") != "1":
+        print(_compact_line(result), flush=True)
     return 0
 
 

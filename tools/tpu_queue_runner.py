@@ -141,15 +141,27 @@ def _run_child(cmd: list[str], env: dict, timeout: float,
                 return p.returncode, "".join(chunks)
             if time.time() > deadline:
                 break
+            eof = False
             for _ in sel.select(timeout=5.0):
                 try:
-                    data = os.read(fd, 65536).decode("utf-8", "replace")
+                    raw = os.read(fd, 65536)
                 except BlockingIOError:
                     continue
-                if data:
-                    chunks.append(data)
-                    logf.write(data)
-                    logf.flush()
+                if not raw:
+                    # EOF while the child lives: the fd stays readable
+                    # forever, so select() would return instantly every
+                    # loop — a tight CPU spin for up to the full step
+                    # timeout. Drop to plain poll+sleep instead.
+                    eof = True
+                    break
+                data = raw.decode("utf-8", "replace")
+                chunks.append(data)
+                logf.write(data)
+                logf.flush()
+            if eof:
+                sel.unregister(p.stdout)
+                while p.poll() is None and time.time() <= deadline:
+                    time.sleep(5.0)
         # timed out: SIGTERM the group, grace, then SIGKILL as last resort
         _log(f"timeout after {timeout:.0f}s: TERM -> group {p.pid}")
         try:
