@@ -14,6 +14,10 @@ import re
 import sys
 
 
+# NOTE: mxnet_tpu/gluon/data/dataloader.py::_load_cpu_pinned carries an
+# inlined copy of this treatment for spawned DataLoader workers (this
+# module is not importable there without first importing the package,
+# which would initialize jax pre-pin). Keep both in sync.
 def force_cpu(n_devices: int | None = None) -> None:
     """Pin this process to CPU JAX, optionally with ``n_devices`` virtual
     host devices. Must run before the first backend initialization; safe to

@@ -12,6 +12,7 @@ recordio reader (src/) covers the IO-bound case.
 """
 from __future__ import annotations
 
+import os
 import threading
 import queue as _queue
 
@@ -151,10 +152,37 @@ def _load_cpu_pinned(payload_bytes):
     a backend on unpickle) is touched.  Because the pin rides inside the
     pickle itself, it holds no matter when or how the worker was spawned
     — including Pool's respawn of a dead worker, where no parent-side env
-    juggling could be in effect."""
+    juggling could be in effect.
+
+    The env var alone is NOT enough on accelerator hosts: a sitecustomize
+    may have force-registered the accelerator plugin at interpreter start,
+    and backend discovery initializes every REGISTERED plugin even under
+    JAX_PLATFORMS=cpu — on a wedged tunnel that hangs the worker at batch
+    0.  So this replicates the full force_cpu treatment (_cpu_defense.py):
+    scrub the sitecustomize path, pop non-cpu backend factories, and pin
+    the already-imported jax config."""
     import os
     import pickle
+    import sys
     os.environ["JAX_PLATFORMS"] = "cpu"
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    # Mirrors repo-root _cpu_defense.force_cpu — keep the two in sync.
+    # It cannot be imported here: the repo-root module is not on a spawned
+    # worker's path, and a package-internal copy would run
+    # mxnet_tpu/__init__ (-> jax) before the pin, defeating it.
+    if "jax" in sys.modules:   # plugin already registered: env pin too late
+        try:
+            from jax._src import xla_bridge as _xb
+            for _name in list(getattr(_xb, "_backend_factories", {})):
+                if _name not in ("cpu", "interpreter"):
+                    _xb._backend_factories.pop(_name, None)
+        except Exception:
+            pass
+        try:
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
     return pickle.loads(payload_bytes)
 
 
@@ -272,14 +300,34 @@ class DataLoader:
         if self._mp_pool is None:
             import multiprocessing as mp
             ctx = mp.get_context("spawn")
-            # the CPU pin travels INSIDE the initargs pickle
-            # (_CpuPinnedPayload): it executes in the child before the
-            # dataset is unpickled, for the initial spawn AND for any
-            # worker Pool respawns later — no parent env juggling
-            self._mp_pool = ctx.Pool(
-                self._num_workers, initializer=_mp_worker_init,
-                initargs=(_CpuPinnedPayload(self._dataset),
-                          _CpuPinnedPayload(self._batchify_fn)))
+            # Two-layer CPU pin for the spawned workers:
+            #  1. HERE, around the spawn: JAX_PLATFORMS=cpu in the
+            #     parent's os.environ and the accelerator sitecustomize
+            #     scrubbed from PYTHONPATH.  Children inherit the env at
+            #     exec — BEFORE their sitecustomize could import jax and
+            #     register the accelerator plugin (a registered plugin
+            #     initializes even under JAX_PLATFORMS=cpu and must never
+            #     race the parent for the chip).
+            #  2. Inside the initargs pickle (_CpuPinnedPayload), which
+            #     re-applies the full pin at unpickle time — covers Pool's
+            #     respawn of a dead worker, where (1) is long restored.
+            saved = {k: os.environ.get(k)
+                     for k in ("JAX_PLATFORMS", "PYTHONPATH")}
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            pp = os.environ.get("PYTHONPATH", "")
+            os.environ["PYTHONPATH"] = os.pathsep.join(
+                p for p in pp.split(os.pathsep) if ".axon_site" not in p)
+            try:
+                self._mp_pool = ctx.Pool(
+                    self._num_workers, initializer=_mp_worker_init,
+                    initargs=(_CpuPinnedPayload(self._dataset),
+                              _CpuPinnedPayload(self._batchify_fn)))
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
         return self._mp_pool
 
     def _process_iter(self):

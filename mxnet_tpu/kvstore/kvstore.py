@@ -671,7 +671,8 @@ class KVStoreDistAsync(KVStoreLocal):
     def __init__(self):
         super().__init__()
         import os
-        from .ps_server import PSServer, PSClient, ps_addrs, key_to_server
+        from .ps_server import (PSServer, PSClient, ps_addrs,
+                                key_to_server, heartbeat_timeout)
         self._rank = int(os.environ.get("MXTPU_PROCESS_ID", "0"))
         self._size = int(os.environ.get("MXTPU_NUM_PROCESSES", "1"))
         self._key_to_server = key_to_server
@@ -687,6 +688,13 @@ class KVStoreDistAsync(KVStoreLocal):
         # ranges -> crc32 hash here); barriers coordinate on server 0
         self._clients = [PSClient(h, p) for h, p in addrs]
         self._client = self._clients[0]
+        # failure detection (reference PS_HEARTBEAT_TIMEOUT): when the
+        # timeout env is set, every worker beats every server; a silent
+        # worker is declared dead server-side, async training continues,
+        # and barriers abort cleanly naming the dead rank
+        if heartbeat_timeout() > 0:
+            for c in self._clients:
+                c.start_heartbeat(self._rank)
 
     def _client_for(self, key):
         return self._clients[self._key_to_server(key, len(self._clients))]

@@ -28,6 +28,14 @@ reference's key-to-server assignment role. Barriers coordinate on server 0.
 Async semantics preserved: each push is applied to the live table the
 moment it arrives (stale gradients included); pulls return the newest
 weights; no global step barrier exists anywhere on the training path.
+
+Failure detection (reference ps-lite heartbeat, SURVEY §5.3): with
+``MXTPU_PS_HEARTBEAT_TIMEOUT`` (or the reference-named
+``PS_HEARTBEAT_TIMEOUT``) seconds set, workers beat each server from a
+dedicated socket; a worker silent past the timeout is declared dead and
+logged, dist_async keeps serving the survivors (async degrade), and
+barriers abort with a clean error naming the dead rank instead of
+hanging. 0 (the default) disables, matching ps-lite.
 """
 from __future__ import annotations
 
@@ -49,6 +57,7 @@ _HDR = struct.Struct("<Q")
 # opcodes (requests)
 _OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
     _OP_SHUTDOWN, _OP_CMD, _OP_CMDLOG = 1, 2, 3, 4, 5, 6, 7, 8, 9
+_OP_HEARTBEAT, _OP_HEALTH = 10, 11
 # opcodes (replies)
 _OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
 
@@ -197,12 +206,24 @@ def key_to_server(key, num_servers):
     return zlib.crc32(str(key).encode()) % num_servers
 
 
+def heartbeat_timeout():
+    """Configured failure-detection timeout in seconds; 0 = disabled.
+    One reader for the env pair so server, client, and kvstore can never
+    disagree about whether detection is on."""
+    return float(os.environ.get("MXTPU_PS_HEARTBEAT_TIMEOUT",
+                                os.environ.get("PS_HEARTBEAT_TIMEOUT", "0"))
+                 or 0)
+
+
+_ENV_HB_TIMEOUT = heartbeat_timeout   # PSServer.__init__'s kwarg shadows it
+
+
 class PSServer:
     """The server role. Runs as a daemon thread pool inside worker 0's
     process (default single-server mode) or as a standalone process
     (``python -m mxnet_tpu.kvstore.ps_server`` under launch.py -s N)."""
 
-    def __init__(self, host, port, num_workers):
+    def __init__(self, host, port, num_workers, heartbeat_timeout=None):
         self._table = {}          # key -> np.ndarray (the live weights)
         self._updater = None      # server-side optimizer (set_optimizer;
                                   # per-key state lives in _ServerUpdater)
@@ -215,6 +236,13 @@ class PSServer:
         self._barrier_gen = 0
         self._barrier_count = 0
         self._barrier_cv = threading.Condition()
+        # failure detection (reference ps-lite heartbeat: workers beat,
+        # PS_HEARTBEAT_TIMEOUT seconds of silence marks a node dead).
+        # 0 disables, like ps-lite's default.
+        self._hb_timeout = heartbeat_timeout if heartbeat_timeout \
+            is not None else _ENV_HB_TIMEOUT()
+        self._last_seen = {}      # rank -> last heartbeat time
+        self._dead = {}           # rank -> time declared dead
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -222,6 +250,39 @@ class PSServer:
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
+        if self._hb_timeout > 0:
+            threading.Thread(target=self._monitor_loop, daemon=True).start()
+
+    def _monitor_loop(self):
+        """Declare workers dead after heartbeat silence (the ps-lite
+        Heartbeat/UpdateHeartbeat role). dist_async keeps serving the
+        survivors — async tolerates stragglers and deaths by design —
+        but barrier waiters are woken so they can abort with a clean
+        error instead of hanging forever on a rank that will never
+        arrive."""
+        tick = max(0.2, self._hb_timeout / 4.0)
+        while self._sock.fileno() != -1:   # dies with the listen socket
+            time.sleep(tick)
+            now = time.time()
+            newly_dead = []
+            with self._lock:
+                for rank, seen in self._last_seen.items():
+                    if rank not in self._dead and \
+                            now - seen > self._hb_timeout:
+                        self._dead[rank] = now
+                        newly_dead.append((rank, now - seen))
+            for rank, age in newly_dead:
+                print(f"[ps_server] worker rank {rank} declared DEAD: "
+                      f"no heartbeat for {age:.1f}s "
+                      f"(timeout {self._hb_timeout:.1f}s); dist_async "
+                      f"continues with the remaining workers", flush=True)
+            if newly_dead:
+                with self._barrier_cv:
+                    self._barrier_cv.notify_all()
+
+    def dead_workers(self):
+        with self._lock:
+            return sorted(self._dead)
 
     def _accept_loop(self):
         while True:
@@ -296,6 +357,19 @@ class PSServer:
                 stats = json.dumps(self._push_count)
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(stats))
         elif op == _OP_BARRIER:
+            # a declared-dead worker can never arrive: abort with a clean
+            # error naming the rank instead of hanging the survivors
+            # (reference ps-lite Barrier simply hangs; SURVEY §5.3 asks
+            # for the detected-failure upgrade)
+            dead = self.dead_workers()
+            if dead:
+                _send_frame(conn, bytes([_OP_ERR]) + _pack_text(
+                    f"barrier aborted: worker rank(s) {dead} declared "
+                    f"dead (no heartbeat within {self._hb_timeout:.1f}s); "
+                    f"a {self._num_workers}-worker barrier cannot "
+                    f"complete"))
+                return False
+            aborted = None
             with self._barrier_cv:
                 gen = self._barrier_gen
                 self._barrier_count += 1
@@ -305,8 +379,21 @@ class PSServer:
                     self._barrier_cv.notify_all()
                 else:
                     while self._barrier_gen == gen:
-                        self._barrier_cv.wait(timeout=60)
-            _send_frame(conn, bytes([_OP_OK]))
+                        dead = self.dead_workers()
+                        if dead:
+                            self._barrier_count = max(
+                                0, self._barrier_count - 1)
+                            aborted = dead
+                            break
+                        self._barrier_cv.wait(timeout=5)
+            if aborted is not None:
+                _send_frame(conn, bytes([_OP_ERR]) + _pack_text(
+                    f"barrier aborted: worker rank(s) {aborted} declared "
+                    f"dead (no heartbeat within {self._hb_timeout:.1f}s); "
+                    f"a {self._num_workers}-worker barrier cannot "
+                    f"complete"))
+            else:
+                _send_frame(conn, bytes([_OP_OK]))
         elif op == _OP_CMD:
             # reference send_command_to_servers(head, body): ps-lite
             # kController messages. Typed here: head int + body text.
@@ -325,6 +412,29 @@ class PSServer:
             with self._lock:
                 log = json.dumps(list(self._commands))
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(log))
+        elif op == _OP_HEARTBEAT:
+            (rank,) = struct.unpack_from("<i", frame, off)
+            with self._lock:
+                self._last_seen[rank] = time.time()
+                if rank in self._dead:
+                    # a beat from a "dead" rank: it was only slow (or the
+                    # launcher restarted it) — log the rejoin, async mode
+                    # simply resumes applying its pushes
+                    del self._dead[rank]
+                    print(f"[ps_server] worker rank {rank} heartbeat "
+                          f"resumed; marking alive again", flush=True)
+            _send_frame(conn, bytes([_OP_OK]))
+        elif op == _OP_HEALTH:
+            now = time.time()
+            with self._lock:
+                health = {"alive": {str(r): round(now - t, 2)
+                                    for r, t in self._last_seen.items()
+                                    if r not in self._dead},
+                          "dead": sorted(self._dead),
+                          "heartbeat_timeout": self._hb_timeout,
+                          "num_workers": self._num_workers}
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
+                json.dumps(health)))
         elif op == _OP_SHUTDOWN:
             _send_frame(conn, bytes([_OP_OK]))
             self._sock.close()
@@ -379,6 +489,8 @@ class PSClient:
             raise ConnectionError(f"cannot reach PS at {host}:{port}: "
                                   f"{last}")
         self._lock = threading.Lock()
+        self._addr = (host, port)
+        self._hb_stop = None      # threading.Event while beating
 
     def _rpc(self, payload):
         with self._lock:
@@ -394,7 +506,8 @@ class PSClient:
             text, _ = _unpack_text(resp, 1)
             return json.loads(text)
         text, _ = _unpack_text(resp, 1)
-        raise RuntimeError(f"PS error: {text}")
+        from ..base import MXNetError
+        raise MXNetError(f"PS error: {text}")
 
     def init(self, key, value):
         return self._rpc(bytes([_OP_INIT]) + _pack_key(key)
@@ -425,7 +538,62 @@ class PSClient:
     def barrier(self):
         return self._rpc(bytes([_OP_BARRIER]))
 
+    def health(self):
+        """Server's liveness view: {alive: {rank: age_s}, dead: [ranks],
+        heartbeat_timeout, num_workers}."""
+        return self._rpc(bytes([_OP_HEALTH]))
+
+    def start_heartbeat(self, rank, interval=None):
+        """Beat this worker's rank to the server from a daemon thread.
+
+        Uses its OWN socket: the RPC socket can legitimately block for
+        minutes inside barrier()/pull() under self._lock, and a heartbeat
+        that queues behind a blocked barrier would read as death — the
+        exact false positive ps-lite's separate heartbeat path avoids.
+        No-op if already beating."""
+        if self._hb_stop is not None:
+            return
+        if interval is None:
+            interval = float(
+                os.environ.get("MXTPU_PS_HEARTBEAT_INTERVAL", "0") or 0)
+        if interval <= 0:
+            timeout = heartbeat_timeout()
+            interval = max(0.1, timeout / 3.0) if timeout > 0 else 1.0
+        stop = threading.Event()
+        self._hb_stop = stop
+        payload = bytes([_OP_HEARTBEAT]) + struct.pack("<i", int(rank))
+
+        def _beat():
+            sock = None
+            while not stop.is_set():
+                try:
+                    if sock is None:
+                        sock = socket.create_connection(self._addr,
+                                                        timeout=30)
+                    _send_frame(sock, payload)
+                    _recv_frame(sock)
+                except OSError:
+                    # server gone or restarting: retry next tick (worker
+                    # liveness is the launcher's job, not ours)
+                    try:
+                        if sock is not None:
+                            sock.close()
+                    except OSError:
+                        pass
+                    sock = None
+                stop.wait(interval)
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+        threading.Thread(target=_beat, daemon=True).start()
+
     def close(self):
+        if self._hb_stop is not None:
+            self._hb_stop.set()
+            self._hb_stop = None
         try:
             self._sock.close()
         except OSError:

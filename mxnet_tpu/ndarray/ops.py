@@ -1297,7 +1297,7 @@ def sgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
                lazy_update=True, out=None):
     def fn(w, g):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         g = g + wd * w
         return w - lr * g
@@ -1313,7 +1313,7 @@ def sgd_mom_update(weight, grad, mom, lr, momentum=0.0, wd=0.0,
                    out=None):
     def fn(w, g, m):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         g = g + wd * w
         m_new = momentum * m - lr * g
@@ -1332,7 +1332,7 @@ def adam_update(weight, grad, mean, var, lr, beta1=0.9, beta2=0.999,
                 lazy_update=True, out=None):
     def fn(w, g, m, v):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         g = g + wd * w
         m_new = beta1 * m + (1 - beta1) * g
@@ -1623,7 +1623,7 @@ def multi_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
             w, g = flat[i], flat[i + 1]
             lr, wd = lrs[i // 2], wds[i // 2]
             g = g * rescale_grad
-            if clip_gradient is not None and clip_gradient > 0:
+            if clip_gradient is not None and clip_gradient >= 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             outs.append(w - lr * (g + wd * w))
         # apply_nary with n_out=1 expects a bare array, not a 1-tuple
@@ -1650,7 +1650,7 @@ def multi_sgd_mom_update(*arrays, lrs, wds, momentum=0.9, rescale_grad=1.0,
             w, g, m = flat[i], flat[i + 1], flat[i + 2]
             lr, wd = lrs[i // 3], wds[i // 3]
             g = g * rescale_grad
-            if clip_gradient is not None and clip_gradient > 0:
+            if clip_gradient is not None and clip_gradient >= 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             new_m = momentum * m - lr * (g + wd * w)
             outs.append(w + new_m)
@@ -1677,7 +1677,7 @@ def multi_lamb_update(*arrays, lrs, wds, beta1=0.9, beta2=0.999,
             w, g, mean, var = flat[i:i + 4]
             lr, wd = lrs[i // 4], wds[i // 4]
             g = g * rescale_grad
-            if clip_gradient is not None and clip_gradient > 0:
+            if clip_gradient is not None and clip_gradient >= 0:
                 g = jnp.clip(g, -clip_gradient, clip_gradient)
             new_mean = beta1 * mean + (1 - beta1) * g
             new_var = beta2 * var + (1 - beta2) * jnp.square(g)
@@ -2552,7 +2552,7 @@ random_pdf_dirichlet = _pdf_op(
 
 def _prep_grad(g, w, wd, rescale_grad, clip_gradient):
     g = g * rescale_grad
-    if clip_gradient is not None and clip_gradient > 0:
+    if clip_gradient is not None and clip_gradient >= 0:
         g = jnp.clip(g, -clip_gradient, clip_gradient)
     return g + wd * w
 
@@ -2562,7 +2562,7 @@ def signsgd_update(weight, grad, lr, wd=0.0, rescale_grad=1.0,
                    clip_gradient=-1.0, out=None):
     def fn(w, g):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         return (1 - lr * wd) * w - lr * jnp.sign(g)
     new_w = apply_nary(fn, [weight, grad], name="signsgd_update")
@@ -2635,7 +2635,7 @@ def ftrl_update(weight, grad, z, n, lr, lamda1=0.01, beta=1.0, wd=0.0,
                 rescale_grad=1.0, clip_gradient=-1.0, out=None):
     def fn(w, g, zz, nn_):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         n_new = nn_ + jnp.square(g)
         sigma = (jnp.sqrt(n_new) - jnp.sqrt(nn_)) / lr
@@ -2780,7 +2780,7 @@ def lamb_update_phase1(weight, grad, mean, var, t, beta1=0.9, beta2=0.999,
     mean/var in place like the reference op."""
     def fn(w, g, m, v):
         g = g * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         m_new = beta1 * m + (1 - beta1) * g
         v_new = beta2 * v + (1 - beta2) * jnp.square(g)
@@ -2879,7 +2879,7 @@ def mp_lamb_update_phase1(weight, grad, mean, var, weight32, t, beta1=0.9,
     """fp32-master LAMB phase 1: statistics and direction in fp32."""
     def fn(w, g, m, v, w32):
         g = g.astype(jnp.float32) * rescale_grad
-        if clip_gradient > 0:
+        if clip_gradient >= 0:
             g = jnp.clip(g, -clip_gradient, clip_gradient)
         m_new = beta1 * m + (1 - beta1) * g
         v_new = beta2 * v + (1 - beta2) * jnp.square(g)
@@ -3236,7 +3236,7 @@ def multi_mp_sgd_update(*arrays, lrs, wds, rescale_grad=1.0,
             w, g, w32 = flat[i], flat[i + 1], flat[i + 2]
             lr, wd = lrs[i // 3], wds[i // 3]
             g32 = g.astype(jnp.float32) * rescale_grad
-            if clip_gradient is not None and clip_gradient > 0:
+            if clip_gradient is not None and clip_gradient >= 0:
                 g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
             new32 = w32 - lr * (g32 + wd * w32)
             outs.append(new32.astype(w.dtype))
@@ -3267,7 +3267,7 @@ def multi_mp_sgd_mom_update(*arrays, lrs, wds, momentum=0.9,
             w, g, m, w32 = flat[i], flat[i + 1], flat[i + 2], flat[i + 3]
             lr, wd = lrs[i // 4], wds[i // 4]
             g32 = g.astype(jnp.float32) * rescale_grad
-            if clip_gradient is not None and clip_gradient > 0:
+            if clip_gradient is not None and clip_gradient >= 0:
                 g32 = jnp.clip(g32, -clip_gradient, clip_gradient)
             new_m = momentum * m - lr * (g32 + wd * w32)
             new32 = w32 + new_m

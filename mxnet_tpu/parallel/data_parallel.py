@@ -403,6 +403,12 @@ class DataParallelTrainer:
         def epoch_spec(a, is_label=False):
             # leading epoch axis replicated; the within-batch sharding
             # follows the same _eff_bax rule as step()/step_accum()
+            if a.ndim < 2:
+                raise MXNetError(
+                    f"put_epoch expects super-arrays with a leading epoch "
+                    f"axis, i.e. (n_batches, batch, ...) with ndim >= 2; "
+                    f"got shape {tuple(a.shape)}. Stack per-step batches "
+                    f"along a new axis 0 before calling put_epoch.")
             inner = [None] * (a.ndim - 1)
             inner[self._eff_bax(a.ndim - 1, is_label)] = "dp"
             return P(*([None] + inner))

@@ -1,0 +1,139 @@
+"""Hand-encoded NDARRAY_V2 fixture: breaks reader/writer circularity.
+
+The golden-fixture test in test_model_store.py generates its .params blob
+with this repo's own `save_legacy`, so a shared layout bug in reader and
+writer would cancel out and still round-trip.  Here the container bytes
+are spelled out as comment-mapped hex literals straight from the
+documented dmlc layout (reference src/ndarray/ndarray.cc Save/Load:
+uint64 file magic 0x112, uint64 reserved, uint64 count, per record
+[uint32 magic NDARRAY_V2=0xF993FAC9 (+int32 stype) or V3=0xF993FAC8,
+uint32 ndim, int64 dims, uint32 ctx dev_type, uint32 ctx dev_id, uint32
+dtype flag, raw payload], then uint64 name-count + length-prefixed
+names).  If the reader decodes names, shapes, dtypes, and exact values
+from THESE bytes, a reader bug can no longer be masked by the writer.
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+
+nd = mx.nd
+
+
+def _fixture_record_f32() -> bytes:
+    """One dense float32 (2,3) record, NDARRAY_V2 magic (with stype)."""
+    return bytes.fromhex(
+        "c9fa93f9"          # uint32 record magic 0xF993FAC9 (NDARRAY_V2)
+        "00000000"          # int32 stype 0 (kDefaultStorage = dense)
+        "02000000"          # uint32 ndim = 2
+        "0200000000000000"  # int64 dim0 = 2
+        "0300000000000000"  # int64 dim1 = 3
+        "01000000"          # uint32 ctx dev_type = 1 (cpu)
+        "00000000"          # uint32 ctx dev_id = 0
+        "00000000"          # uint32 dtype flag 0 = float32
+        # row-major payload, little-endian IEEE754 single:
+        "0000c03f"          # 1.5    (0x3FC00000)
+        "000000c0"          # -2.0   (0xC0000000)
+        "0000803e"          # 0.25   (0x3E800000)
+        "00004040"          # 3.0    (0x40400000)
+        "000000bf"          # -0.5   (0xBF000000)
+        "0000c842"          # 100.0  (0x42C80000)
+    )
+
+
+def _fixture_blob() -> bytes:
+    header = bytes.fromhex(
+        "1201000000000000"  # uint64 file magic 0x112
+        "0000000000000000"  # uint64 reserved
+        "0300000000000000"  # uint64 ndarray count = 3
+    )
+    rec2 = bytes.fromhex(   # int64 (3,) record, V3 magic (NO stype field)
+        "c8fa93f9"          # uint32 record magic 0xF993FAC8 (pre-stype)
+        "01000000"          # uint32 ndim = 1
+        "0300000000000000"  # int64 dim0 = 3
+        "01000000"          # uint32 ctx dev_type = 1 (cpu)
+        "00000000"          # uint32 ctx dev_id = 0
+        "06000000"          # uint32 dtype flag 6 = int64
+        "ffffffffffffffff"  # -1
+        "0500004000000000"  # 2**30 + 5  (0x40000005)
+        "0700000000000000"  # 7
+    )
+    rec3 = bytes.fromhex(   # float16 (1,2) record, NDARRAY_V2 magic
+        "c9fa93f9"          # record magic
+        "00000000"          # stype dense
+        "02000000"          # ndim = 2
+        "0100000000000000"  # dim0 = 1
+        "0200000000000000"  # dim1 = 2
+        "01000000"          # ctx dev_type
+        "00000000"          # ctx dev_id
+        "02000000"          # dtype flag 2 = float16
+        "003c"              # 1.0   (0x3C00)
+        "00c1"              # -2.5  (0xC100)
+    )
+    names = bytes.fromhex(
+        "0300000000000000"          # uint64 name count = 3
+        "0c00000000000000"          # uint64 len("conv0_weight") = 12
+        "636f6e76305f776569676874"  # "conv0_weight"
+        "0800000000000000"          # uint64 len("fc0_bias") = 8
+        "6663305f62696173"          # "fc0_bias"
+        "0500000000000000"          # uint64 len("gamma") = 5
+        "67616d6d61"                # "gamma"
+    )
+    return header + _fixture_record_f32() + rec2 + rec3 + names
+
+
+def test_reader_decodes_hand_encoded_bytes(tmp_path):
+    path = tmp_path / "hand_encoded.params"
+    path.write_bytes(_fixture_blob())
+    out = nd.load(str(path))
+    assert sorted(out) == ["conv0_weight", "fc0_bias", "gamma"]
+
+    w = out["conv0_weight"]
+    assert w.shape == (2, 3) and str(w.dtype) == "float32"
+    np.testing.assert_array_equal(
+        w.asnumpy(), np.array([[1.5, -2.0, 0.25], [3.0, -0.5, 100.0]],
+                              np.float32))
+
+    b = out["fc0_bias"]
+    assert b.shape == (3,)
+    # the reader decodes int64; NDArray then narrows to int32 unless the
+    # x64 switch is on (MXTPU_INT64 policy, exercised in test_int64_large)
+    import jax
+    want = "int64" if jax.config.jax_enable_x64 else "int32"
+    assert str(b.dtype) == want
+    np.testing.assert_array_equal(
+        b.asnumpy(), np.array([-1, 2 ** 30 + 5, 7], want))
+
+    g = out["gamma"]
+    assert g.shape == (1, 2) and str(g.dtype) == "float16"
+    np.testing.assert_array_equal(
+        g.asnumpy(), np.array([[1.0, -2.5]], np.float16))
+
+
+def test_load_frombuffer_matches_load(tmp_path):
+    from mxnet_tpu.ndarray.utils import load_frombuffer
+    out = load_frombuffer(_fixture_blob())
+    assert out["conv0_weight"].asnumpy()[1, 2] == 100.0
+
+
+def test_writer_reproduces_hand_encoded_record_bytes(tmp_path):
+    """save_legacy must emit byte-identical output for the same float32
+    record — pinning the WRITER to the documented layout too (a writer
+    drift would otherwise only surface when reference-era MXNet tried to
+    read our exports)."""
+    from mxnet_tpu.ndarray.utils import save_legacy
+    path = tmp_path / "writer.params"
+    save_legacy(str(path),
+                {"conv0_weight":
+                 nd.array(np.array([[1.5, -2.0, 0.25], [3.0, -0.5, 100.0]],
+                                   np.float32))})
+    blob = path.read_bytes()
+    expected = (
+        bytes.fromhex("1201000000000000"    # file magic
+                      "0000000000000000"    # reserved
+                      "0100000000000000")   # count = 1
+        + _fixture_record_f32()
+        + bytes.fromhex("0100000000000000"            # name count = 1
+                        "0c00000000000000"            # len = 12
+                        "636f6e76305f776569676874")   # "conv0_weight"
+    )
+    assert blob == expected

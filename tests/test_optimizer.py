@@ -204,3 +204,24 @@ def test_trainer_fused_group_update_parity():
     serial = build_and_train(True)
     for i, (f, s) in enumerate(zip(fused, serial)):
         np.testing.assert_allclose(f, s, rtol=1e-6, err_msg=str(i))
+
+
+def test_clip_gradient_zero_freezes_update():
+    """clip_gradient=0.0 clamps grads to zero (reference optimizer ops
+    clip whenever clip_gradient >= 0; only negative disables). A zero
+    clip must freeze the weight save for weight decay."""
+    w = nd.array(np.array([1.0, -2.0, 3.0], np.float32))
+    g = nd.array(np.array([10.0, -10.0, 5.0], np.float32))
+    out = nd.sgd_update(w, g, lr=0.5, wd=0.0, clip_gradient=0.0)
+    np.testing.assert_allclose(out.asnumpy(), [1.0, -2.0, 3.0], atol=1e-7)
+    # negative still means disabled
+    w2 = nd.array(np.array([1.0], np.float32))
+    g2 = nd.array(np.array([2.0], np.float32))
+    out2 = nd.sgd_update(w2, g2, lr=0.5, wd=0.0, clip_gradient=-1.0)
+    np.testing.assert_allclose(out2.asnumpy(), [0.0], atol=1e-7)
+    # multi-tensor path honors the same semantics
+    w3 = nd.array(np.array([4.0], np.float32))
+    g3 = nd.array(np.array([100.0], np.float32))
+    nd.multi_sgd_update(w3, g3, lrs=[0.5], wds=[0.0],
+                        clip_gradient=0.0, num_weights=1)
+    np.testing.assert_allclose(w3.asnumpy(), [4.0], atol=1e-7)

@@ -270,3 +270,79 @@ def test_dist_async_send_command_retunes_server_lr(tmp_path):
         capture_output=True, text=True, timeout=300, env=_cpu_env())
     assert r.returncode == 0, r.stderr + r.stdout
     assert "CMD_OK" in r.stdout, r.stdout + r.stderr
+
+
+@pytest.mark.slow
+def test_ps_heartbeat_detects_sigkilled_worker(tmp_path):
+    """Failure detection (reference ps-lite PS_HEARTBEAT_TIMEOUT,
+    SURVEY §5.3): 3 workers beat the server; one is SIGKILLed. The
+    server must declare the silent rank dead and log it, dist_async
+    push/pull must keep serving the survivors (async degrade), and a
+    barrier must abort with a clean MXNetError naming the dead rank
+    instead of hanging."""
+    import signal
+    import socket
+    import time
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore.ps_server import PSServer, PSClient
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    srv = PSServer("127.0.0.1", port, num_workers=3,
+                   heartbeat_timeout=1.5)
+    c0 = PSClient("127.0.0.1", port)
+    c0.start_heartbeat(0, interval=0.3)
+    c1 = PSClient("127.0.0.1", port)
+    c1.start_heartbeat(1, interval=0.3)
+    c0.init("w", np.ones(4, np.float32))
+
+    # rank 2 is a real process we SIGKILL mid-beat
+    script = tmp_path / "rank2.py"
+    script.write_text(
+        "import sys, time\n"
+        f"sys.path.insert(0, {REPO!r})\n"
+        "from mxnet_tpu.kvstore.ps_server import PSClient\n"
+        f"c = PSClient('127.0.0.1', {port})\n"
+        "c.start_heartbeat(2, interval=0.3)\n"
+        "print('BEATING', flush=True)\n"
+        "time.sleep(120)\n")
+    p = subprocess.Popen([sys.executable, str(script)],
+                         stdout=subprocess.PIPE, text=True, env=_cpu_env())
+    try:
+        assert p.stdout.readline().strip() == "BEATING"
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            if "2" in c0.health()["alive"]:
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError(f"rank 2 never beat: {c0.health()}")
+
+        p.send_signal(signal.SIGKILL)
+        p.wait(timeout=10)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if c0.health()["dead"] == [2]:
+                break
+            time.sleep(0.3)
+        else:
+            raise AssertionError(
+                f"rank 2 never declared dead: {c0.health()}")
+
+        # async degrade: survivors keep pushing/pulling
+        c1.push("w", np.ones(4, np.float32))
+        np.testing.assert_allclose(c0.pull("w"),
+                                   2.0 * np.ones(4, np.float32))
+        # barrier aborts cleanly, naming the dead rank
+        with pytest.raises(MXNetError, match=r"rank\(s\) \[2\]"):
+            c0.barrier()
+        assert "2" not in c0.health()["alive"]
+    finally:
+        if p.poll() is None:
+            p.kill()
+        for c in (c0, c1):
+            c.close()
+        srv._sock.close()

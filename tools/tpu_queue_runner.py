@@ -299,6 +299,9 @@ def step_bench(st: dict) -> None:
     # _apply_knobs_file) — no env duplication to drift from it
     env = dict(os.environ)
     env["MXTPU_BENCH_PROBE_ATTEMPTS"] = "2"   # runner already probed
+    # state.json wants the FULL payload, and this parser takes the last
+    # json line — suppress the driver-facing compact headline
+    env["MXTPU_BENCH_NO_COMPACT"] = "1"
     rc, out = _run_child([sys.executable, "bench.py"], env, timeout=2700.0,
                          log_path=os.path.join(QDIR, "bench.log"))
     lines = _json_lines(out)
@@ -359,7 +362,8 @@ def step_bert128(st: dict) -> None:
     _wait_for_tunnel(st)
     env = dict(os.environ, MXTPU_BENCH_MODEL="bert",
                MXTPU_BENCH_BERT_BATCH="128",
-               MXTPU_BENCH_PROBE_ATTEMPTS="2")
+               MXTPU_BENCH_PROBE_ATTEMPTS="2",
+               MXTPU_BENCH_NO_COMPACT="1")   # keep the full last line
     rc, out = _run_child([sys.executable, "bench.py"], env, timeout=2700.0,
                          log_path=os.path.join(QDIR, "bert128.log"))
     lines = _json_lines(out)
