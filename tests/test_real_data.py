@@ -85,3 +85,16 @@ def test_real_data_convergence_floor(tmp_path):
         metric.update([batch.label[0]], [net(batch.data[0])])
     acc = metric.get()[1]
     assert acc > 0.95, f"real-digits val acc {acc}"
+
+
+@pytest.mark.slow
+def test_ssd_detection_convergence_floor():
+    """Detection end-to-end (reference example/ssd acceptance surface,
+    SURVEY §2.4): anchors -> MultiBoxTarget -> joint CE + smooth-L1 ->
+    Trainer steps -> NMS eval. The loss must drop by half and the top-1
+    detection (class match + IoU >= 0.5 after in-graph NMS) must clear
+    a 0.6 floor on the synthetic single-object set."""
+    from examples.ssd_train import train
+    out = train(steps=160, batch=16, lr=0.002, seed=0, log_every=0)
+    assert out["last_loss"] < 0.6 * out["first_loss"], out
+    assert out["det_acc"] >= 0.6, out
