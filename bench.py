@@ -635,6 +635,9 @@ def _run_bench() -> dict:
             "footprint -> bert batch-128), probe-gated with resumable "
             "state in .tpu_queue/state.json; the probe trail in "
             ".tpu_queue/runner.log documents tunnel health over time")
+        ml = _load_memlevers()
+        if ml is not None:   # measured on-chip lever numbers survive the
+            result["extra"]["memory_levers"] = ml   # fallback too
         try:   # attach the probe trail itself as fallback evidence
             qlog = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 ".tpu_queue", "runner.log")
@@ -695,6 +698,9 @@ def _run_bench() -> dict:
             result["extra"]["llama_decode"] = {
                 "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(result)
+        ml = _load_memlevers()
+        if ml is not None:
+            result["extra"]["memory_levers"] = ml
         return result
     finally:
         if profile:
@@ -811,7 +817,9 @@ def _apply_knobs_file() -> None:
         return
     for env_name, key in (("MXTPU_RESNET_S2D", "resnet_s2d"),
                           ("MXTPU_CONV_LAYOUT", "conv_layout"),
-                          ("MXTPU_BENCH_BATCH", "batch")):
+                          ("MXTPU_BENCH_BATCH", "batch"),
+                          ("MXTPU_FLASH_BQ", "flash_bq"),
+                          ("MXTPU_FLASH_BK", "flash_bk")):
         v = k.get(key)
         if v is not None and env_name not in os.environ:
             os.environ[env_name] = str(v)
@@ -824,6 +832,21 @@ def _save_tpu_cache(result: dict) -> None:
                        "result": result}, f)
     except OSError:
         pass
+
+
+_MEMLEVERS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          ".bench_memlevers.json")
+
+
+def _load_memlevers() -> dict | None:
+    """Measured memory-lever summary written by the queue runner
+    (tools/memory_levers.py summarize); committed evidence like
+    .bench_knobs.json."""
+    try:
+        with open(_MEMLEVERS) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
 
 
 def _load_tpu_cache() -> dict | None:

@@ -256,18 +256,26 @@ min_axis = min
 __all__ += ["sum_axis", "max_axis", "min_axis"]
 
 
+def _arg_index_dtype():
+    """Reference argmax/argmin return FLOAT indices; float32 cannot
+    represent indices past 2^24 exactly (and rounds 2^31+k to 2^31), so
+    the int64 build widens to float64."""
+    import jax
+    return jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+
+
 @_register
 def argmax(data, axis=None, keepdims=False):
     return apply_nary(
-        lambda d: jnp.argmax(d, axis=axis, keepdims=keepdims).astype(jnp.float32),
-        [data], name="argmax")
+        lambda d: jnp.argmax(d, axis=axis, keepdims=keepdims)
+        .astype(_arg_index_dtype()), [data], name="argmax")
 
 
 @_register
 def argmin(data, axis=None, keepdims=False):
     return apply_nary(
-        lambda d: jnp.argmin(d, axis=axis, keepdims=keepdims).astype(jnp.float32),
-        [data], name="argmin")
+        lambda d: jnp.argmin(d, axis=axis, keepdims=keepdims)
+        .astype(_arg_index_dtype()), [data], name="argmin")
 
 
 @_register

@@ -26,11 +26,12 @@ class LRScheduler:
 
     def get_warmup_lr(self, num_update):
         assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = (self.warmup_final_lr - self.warmup_begin_lr) * \
-                float(num_update) / float(self.warmup_steps)
-            return self.warmup_begin_lr + increase
-        return self.warmup_begin_lr
+        if self.warmup_mode != "linear":
+            return self.warmup_begin_lr
+        # linear ramp: begin_lr -> final_lr over warmup_steps updates
+        frac = num_update / self.warmup_steps
+        return (1.0 - frac) * self.warmup_begin_lr + \
+            frac * self.warmup_final_lr
 
     def __call__(self, num_update):
         raise NotImplementedError

@@ -140,3 +140,27 @@ def test_run_child_normal_exit(tmp_path):
         log_path=str(tmp_path / "log.txt"))
     assert rc == 0
     assert qr._json_lines(out) == [{"x": 1}]
+
+
+def test_memory_levers_ce_smoke_and_summary():
+    """memory_levers children on CPU smoke scale: fused and naive CE
+    agree on the loss, and summarize() flattens results into the scalar
+    dict bench.py attaches."""
+    from tools.memory_levers import run_config, summarize, MATRIX
+    fused = run_config("ce_fused_32k", "ce", impl="fused", vocab=32768,
+                       tokens=8192)
+    naive = run_config("ce_naive_32k", "ce", impl="naive", vocab=32768,
+                       tokens=8192)
+    assert not fused["oom"] and not naive["oom"]
+    assert abs(fused["loss"] - naive["loss"]) < 0.05, (fused, naive)
+    zero1 = {"config": "zero1", "kind": "zero1", "platform": "cpu",
+             "param_mb": 102.2, "adam_state_mb": 204.4,
+             "adam_state_mb_per_chip_zero1_dp8": 25.6,
+             "adam_state_mb_per_chip_zero1_dp256": 0.8}
+    s = summarize([fused, naive, zero1,
+                   {"config": "ce_naive_oom32k", "kind": "ce",
+                    "platform": "tpu", "oom": True, "expected_oom": True}])
+    assert s["ce_fused_32k_ms"] == fused["ms_per_step"]
+    assert s["ce_naive_32ktok_oom"] is True
+    assert s["zero1_dp256_state_mb"] == 0.8
+    assert set(MATRIX) >= {"accum_base", "ce_fused_128k", "zero1"}

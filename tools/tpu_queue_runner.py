@@ -97,7 +97,8 @@ def _other_tpu_clients() -> list[str]:
             continue
         args_head = rest.split("--", 1)[0]
         if any(k in args_head for k in ("tpu_conv_experiments",
-                                        "flash_long_seq", "bench.py")):
+                                        "flash_long_seq", "bench.py",
+                                        "memory_levers")):
             if pid.isdigit() and int(pid) != me:
                 hits.append(line.strip())
     return hits
@@ -279,16 +280,23 @@ def step_conv_matrix(st: dict) -> None:
         _log(f"conv matrix best: {json.dumps(best)}")
         # bake the measured winner into bench.py's defaults so the
         # driver's plain `python bench.py` runs the best config
-        knobs = {"resnet_s2d": 1 if best.get("s2d_stem") else 0,
-                 # NCHW is the no-knob default; only a non-default layout
-                 # becomes an env export in bench._apply_knobs_file
-                 "conv_layout": (best["conv_layout"]
-                                 if best.get("conv_layout") not in
-                                 (None, "NCHW") else None),
-                 "batch": best.get("batch"),
-                 "measured_img_per_sec": best.get("img_per_sec"),
-                 "measured_at": time.strftime("%F %T")}
-        with open(os.path.join(REPO, ".bench_knobs.json"), "w") as f:
+        knobs_path = os.path.join(REPO, ".bench_knobs.json")
+        try:   # read-merge-write: flash_autotune keys must survive
+            with open(knobs_path) as f:
+                knobs = json.load(f)
+        except (OSError, ValueError):
+            knobs = {}
+        knobs.update({
+            "resnet_s2d": 1 if best.get("s2d_stem") else 0,
+            # NCHW is the no-knob default; only a non-default layout
+            # becomes an env export in bench._apply_knobs_file
+            "conv_layout": (best["conv_layout"]
+                            if best.get("conv_layout") not in
+                            (None, "NCHW") else None),
+            "batch": best.get("batch"),
+            "measured_img_per_sec": best.get("img_per_sec"),
+            "measured_at": time.strftime("%F %T")})
+        with open(knobs_path, "w") as f:
             json.dump(knobs, f, indent=1)
     _save_state(st)
 
@@ -358,6 +366,101 @@ def step_flash_sweep(st: dict) -> None:
     _save_state(st)
 
 
+def step_memory_levers(st: dict) -> None:
+    """One memory-lever config per child process (tools/memory_levers.py
+    MATRIX): in-graph grad accumulation, blocked fused CE vs naive
+    (incl. the size where naive must OOM), ZeRO-1 footprint report.
+    Winner summary -> .bench_memlevers.json, which bench.py attaches."""
+    from tools.memory_levers import MATRIX, summarize
+    results = st.setdefault("memlever_results", [])
+    done = {r["config"] for r in results
+            if r.get("platform") == "tpu" and "error" not in r}
+    for cfg in MATRIX:
+        if cfg in done:
+            continue
+        _wait_for_tunnel(st)
+        env = dict(os.environ, MXTPU_EXP_CHILD=cfg)
+        rc, out = _run_child(
+            [sys.executable, "tools/memory_levers.py"], env,
+            timeout=1500.0, log_path=os.path.join(QDIR, "memlevers.log"))
+        lines = [l for l in _json_lines(out) if l.get("config") == cfg]
+        if lines and lines[-1].get("platform") == "tpu":
+            r = lines[-1]
+            _log(f"memlever {cfg}: "
+                 f"{r.get('ms_per_step', r.get('oom', '?'))}")
+        else:
+            r = {"config": cfg,
+                 "error": (f"platform={lines[-1].get('platform')}"
+                           if lines else f"rc={rc}"),
+                 "out": out[-200:]}
+            _log(f"memlever {cfg} FAILED ({r['error']})")
+        results[:] = [x for x in results if x.get("config") != cfg] + [r]
+        _save_state(st)
+    ok = {r["config"] for r in results
+          if r.get("platform") == "tpu" and "error" not in r}
+    if len(ok) == len(MATRIX):
+        st["done"]["memory_levers"] = True
+    if ok:
+        summary = summarize([r for r in results if "error" not in r])
+        summary["measured_at"] = time.strftime("%F %T")
+        st["memlever_summary"] = summary
+        with open(os.path.join(REPO, ".bench_memlevers.json"), "w") as f:
+            json.dump(summary, f, indent=1)
+    _save_state(st)
+
+
+FLASH_TUNE = [(256, 256), (256, 512), (512, 256), (512, 512),
+              (512, 1024), (1024, 512), (1024, 1024)]
+
+
+def step_flash_autotune(st: dict) -> None:
+    """Sweep Pallas flash-attention block sizes (MXTPU_FLASH_BQ/BK) at
+    L=4096 and bake the fastest pair into .bench_knobs.json (the manual
+    follow-up the verify runbook used to list)."""
+    from tools.flash_long_seq import child_env, parse_child_line
+    results = st.setdefault("flash_tune_results", [])
+    done = {(r["bq"], r["bk"]) for r in results if r.get("ok")}
+    for bq, bk in FLASH_TUNE:
+        if (bq, bk) in done:
+            continue
+        _wait_for_tunnel(st)
+        env = child_env("flash", 4096)
+        env["MXTPU_FLASH_BQ"] = str(bq)
+        env["MXTPU_FLASH_BK"] = str(bk)
+        rc, out = _run_child(
+            [sys.executable, "tools/flash_long_seq.py"], env,
+            timeout=900.0, log_path=os.path.join(QDIR, "flashtune.log"))
+        r = parse_child_line(out)
+        if r and r.get("ok") and r.get("platform") == "tpu":
+            rec = {"bq": bq, "bk": bk, "ms": r["ms"], "ok": True}
+            _log(f"flash tune bq={bq} bk={bk}: {r['ms']} ms")
+        else:
+            rec = {"bq": bq, "bk": bk, "ok": False,
+                   "error": (f"platform={r.get('platform')}" if r
+                             else f"rc={rc}")}
+            _log(f"flash tune bq={bq} bk={bk} FAILED ({rec['error']})")
+        results[:] = [x for x in results
+                      if (x["bq"], x["bk"]) != (bq, bk)] + [rec]
+        _save_state(st)
+    ok = [r for r in results if r.get("ok")]
+    if len(ok) == len(FLASH_TUNE):
+        st["done"]["flash_autotune"] = True
+    if ok:
+        best = min(ok, key=lambda r: r["ms"])
+        st["flash_tune_best"] = best
+        knobs_path = os.path.join(REPO, ".bench_knobs.json")
+        try:
+            with open(knobs_path) as f:
+                knobs = json.load(f)
+        except (OSError, ValueError):
+            knobs = {}
+        knobs["flash_bq"], knobs["flash_bk"] = best["bq"], best["bk"]
+        knobs["flash_tuned_at"] = time.strftime("%F %T")
+        with open(knobs_path, "w") as f:
+            json.dump(knobs, f, indent=1)
+    _save_state(st)
+
+
 def step_bert128(st: dict) -> None:
     _wait_for_tunnel(st)
     env = dict(os.environ, MXTPU_BENCH_MODEL="bert",
@@ -376,7 +479,10 @@ def step_bert128(st: dict) -> None:
 
 
 STEPS = [("conv_matrix", step_conv_matrix), ("bench", step_bench),
-         ("flash_sweep", step_flash_sweep), ("bert128", step_bert128)]
+         ("memory_levers", step_memory_levers),
+         ("flash_sweep", step_flash_sweep),
+         ("flash_autotune", step_flash_autotune),
+         ("bert128", step_bert128)]
 
 
 _LOCK_FD = None   # held for process lifetime; flock dies with the process
