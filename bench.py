@@ -596,13 +596,35 @@ def _bench_decode() -> dict:
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
-def _scaling_projection(resnet_result: dict) -> dict:
-    """ICI ring-allreduce roofline from a measured ResNet step (shared by
-    the live-TPU and cached-fallback paths so the two can't diverge)."""
+def _scaling_projection(resnet_result: dict, rec_result: dict = None) -> dict:
+    """ICI+DCN+input-feed roofline from a measured ResNet step (shared by
+    the live-TPU and cached-fallback paths so the two can't diverge).
+
+    The 512-chip row exists to exercise the DCN term (two v5e slices);
+    the BASELINE metric itself is 8->256, inside one ICI domain.  The
+    input-feed cap uses this host's measured decode ceiling scaled to a
+    real v5e pod host (ct5lp-hightpu-4t: 112 vCPUs vs this host's
+    os.cpu_count()), with the scale disclosed in the inputs block.
+    """
     try:
         from tools.scaling_efficiency import project_ici_scaling
         step_ms = resnet_result["batch"] / resnet_result["value"] * 1e3
-        return project_ici_scaling(round(step_ms, 2), _RESNET50_GRAD_BYTES)
+        kw = {}
+        try:
+            pipe = (rec_result or {}).get("input_pipeline") or {}
+            sweep = pipe.get("decode_thread_sweep") or []
+            best = max(r["img_s"] for r in sweep)
+            # cores recorded WITH the sweep (bench stores host_cores at
+            # measurement time): a cached payload replayed on a different
+            # box must scale by the cores that produced the img/s number
+            cores = pipe.get("host_cores") or os.cpu_count() or 1
+            kw = {"host_decode_imgs_per_sec": best,
+                  "per_chip_imgs_per_sec": resnet_result["value"],
+                  "host_core_scale": 112.0 / cores}
+        except (ValueError, KeyError, TypeError, AttributeError):
+            pass  # no measured sweep in this payload: feed cap unmodeled
+        return project_ici_scaling(round(step_ms, 2), _RESNET50_GRAD_BYTES,
+                                   chips=(8, 64, 256, 512), **kw)
     except Exception as e:  # noqa: BLE001 — record, never void the bench
         return {"error": f"{type(e).__name__}: {e}"}
 
@@ -627,8 +649,10 @@ def _run_bench() -> dict:
         # the queued on-chip experiment list the verify skill maintains
         cached = _load_tpu_cache()
         if cached:
-            result["extra"]["scaling_projection"] = \
-                _scaling_projection(cached["result"])
+            result["extra"]["scaling_projection"] = _scaling_projection(
+                cached["result"],
+                cached["result"].get("extra", {}).get(
+                    "resnet_rec_pipeline"))
         result["extra"]["queued_tpu_experiments"] = (
             "tools/tpu_queue_runner.py owns the queue (conv MFU matrix "
             "-> bench refresh -> flash long-seq 2k-32k with naive-OOM "
@@ -697,7 +721,8 @@ def _run_bench() -> dict:
         except Exception as e:  # noqa: BLE001
             result["extra"]["llama_decode"] = {
                 "error": f"{type(e).__name__}: {e}"}
-        result["extra"]["scaling_projection"] = _scaling_projection(result)
+        result["extra"]["scaling_projection"] = _scaling_projection(
+            result, rec)
         ml = _load_memlevers()
         if ml is not None:
             result["extra"]["memory_levers"] = ml
