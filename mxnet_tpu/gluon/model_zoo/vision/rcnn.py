@@ -63,13 +63,16 @@ class RPN(HybridBlock):
     """Region proposal network head + static proposal selection."""
 
     def __init__(self, channels=256, stride=16, pre_nms=2000, post_nms=300,
-                 nms_thresh=0.7, **kwargs):
+                 nms_thresh=0.7, scales=(8, 16, 32), ratios=(0.5, 1, 2),
+                 base_size=16, **kwargs):
         super().__init__(**kwargs)
         self._pre_nms = pre_nms
         self._post_nms = post_nms
         self._nms_thresh = nms_thresh
         with self.name_scope():
-            self.anchor_gen = RPNAnchorGenerator(stride=stride)
+            self.anchor_gen = RPNAnchorGenerator(
+                stride=stride, scales=scales, ratios=ratios,
+                base_size=base_size)
             na = self.anchor_gen.num_anchors
             self.conv = nn.Conv2D(channels, 3, 1, 1, activation="relu")
             self.score = nn.Conv2D(na, 1, 1, 0)
@@ -148,7 +151,9 @@ class FasterRCNN(HybridBlock):
     """
 
     def __init__(self, classes, backbone=None, roi_size=(7, 7), stride=16,
-                 post_nms=300, nms_thresh=0.3, score_thresh=0.05, **kwargs):
+                 post_nms=300, nms_thresh=0.3, score_thresh=0.05,
+                 rpn_scales=(8, 16, 32), rpn_ratios=(0.5, 1, 2),
+                 rpn_base_size=16, **kwargs):
         super().__init__(**kwargs)
         self.classes = list(classes)
         self.num_classes = len(self.classes)
@@ -164,7 +169,9 @@ class FasterRCNN(HybridBlock):
                 if tail in self.base._children:
                     self.base._children.pop(tail)
                     object.__delattr__(self.base, tail)
-            self.rpn = RPN(stride=stride, post_nms=post_nms)
+            self.rpn = RPN(stride=stride, post_nms=post_nms,
+                           scales=rpn_scales, ratios=rpn_ratios,
+                           base_size=rpn_base_size)
             self.top_features = nn.HybridSequential()
             self.top_features.add(nn.Dense(1024, activation="relu",
                                            flatten=True))
@@ -192,6 +199,10 @@ class FasterRCNN(HybridBlock):
         stride = self._stride
 
         def to_roi5(r):
+            # approximate joint training (Faster R-CNN paper §3.2): the
+            # box head does not backprop through proposal coordinates
+            import jax
+            r = jax.lax.stop_gradient(r)
             batch_idx = jnp.repeat(jnp.arange(b, dtype=r.dtype), n_roi)
             return jnp.concatenate(
                 [batch_idx[:, None], r.reshape(-1, 4)], axis=-1)
