@@ -621,7 +621,24 @@ def _scaling_projection(resnet_result: dict, rec_result: dict = None) -> dict:
             kw = {"host_decode_imgs_per_sec": best,
                   "per_chip_imgs_per_sec": resnet_result["value"],
                   "host_core_scale": 112.0 / cores}
-        except (ValueError, KeyError, TypeError, AttributeError):
+            # de-rate the pure core ratio by the pool's MEASURED thread
+            # scaling: marginal img/s per added thread (slope across the
+            # in-core sweep points) over the 1-thread img/s. Sweep points
+            # past the core count only measure oversubscription, not
+            # parallel efficiency, so they are excluded; with a single
+            # in-core point (1-core host) the efficiency is unmeasurable
+            # and the projection discloses the linearity assumption.
+            rows = sorted({r["threads"]: r["img_s"] for r in sweep}.items())
+            in_core = [(t, v) for t, v in rows if t <= cores]
+            if len(in_core) >= 2 and rows[0][0] >= 1:
+                per_thread_1 = rows[0][1] / rows[0][0]
+                (t_lo, v_lo), (t_hi, v_hi) = in_core[0], in_core[-1]
+                slope = (v_hi - v_lo) / (t_hi - t_lo)
+                kw["host_thread_slope_img_s"] = slope
+                kw["host_parallel_efficiency"] = max(
+                    0.0, min(1.0, slope / per_thread_1))
+        except (ValueError, KeyError, TypeError, AttributeError,
+                ZeroDivisionError):
             pass  # no measured sweep in this payload: feed cap unmodeled
         return project_ici_scaling(round(step_ms, 2), _RESNET50_GRAD_BYTES,
                                    chips=(8, 64, 256, 512), **kw)

@@ -32,14 +32,14 @@ import time
 
 import numpy as np
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import mxnet_tpu as mx                                    # noqa: E402
 from mxnet_tpu import autograd, gluon                     # noqa: E402
 from mxnet_tpu.gluon import nn                            # noqa: E402
 from mxnet_tpu.gluon.model_zoo.vision.rcnn import FasterRCNN  # noqa: E402
 from mxnet_tpu.ndarray import contrib                     # noqa: E402
-from ssd_train import synthetic_batch                     # noqa: E402
+from examples.ssd_train import synthetic_batch            # noqa: E402
 
 nd = mx.nd
 

@@ -74,6 +74,7 @@ from .monitor import Monitor
 from . import model
 from . import image
 from . import parallel
+from . import lint
 
 # mx.np / mx.npx numpy-compat front end (SURVEY.md §2.2 numpy-compat row):
 # jax.numpy already provides numpy semantics; expose it under the mx.np name.

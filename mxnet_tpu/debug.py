@@ -23,6 +23,15 @@ Reference counterparts (SURVEY §5.2 race/debug tooling, §5.6 config flags):
 Both flags are read once at ``import mxnet_tpu`` (they must configure jax
 before any computation). ``MXTPU_SEED=<n>`` seeds the global RNG at import
 so driver-launched runs are reproducible without code changes.
+
+See also ``mx.lint`` (docs/LINT.md): the static trace-safety analyzer
+(rules HB01-HB06, CLI ``tools/mxlint.py``) that catches host-sync /
+tensor-branching / retrace-storm patterns *before* any device is
+touched, and its runtime complement ``MXTPU_RETRACE_WARN=<n>`` — every
+hybridized block counts its jax.jit cache misses and warns once (with
+the offending shape/dtype signature) when a block retraces past the
+threshold. The flags here diagnose wrong *values*; ``mx.lint``
+diagnoses wrong *tracing*.
 """
 from __future__ import annotations
 

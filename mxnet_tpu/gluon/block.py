@@ -36,6 +36,7 @@ import jax.numpy as jnp
 
 from ..base import MXNetError
 from ..context import current_context
+from ..lint.retrace import RetraceMonitor
 from ..ndarray.ndarray import NDArray
 from ..ndarray import utils as nd_utils
 from .. import _tape
@@ -355,6 +356,10 @@ class CachedOp:
         self._in_avals = None    # last input signature (for export)
         self._none_pos = ()      # positions of None args (reinserted)
         self._raw = {}           # train_mode -> un-jitted pure fn
+        # retrace observability (mx.lint runtime complement): every
+        # distinct input signature is a jax.jit cache miss; the monitor
+        # warns once past MXTPU_RETRACE_WARN distinct signatures
+        self._retrace = RetraceMonitor(block.name or type(block).__name__)
 
     def _collect(self):
         if self._param_objs is None:
@@ -447,7 +452,16 @@ class CachedOp:
             self._param_objs = None
             params = self._collect()
         train = _tape.is_training()
-        jfn = self._get_jitted(train, raw=_tape._STATE.trace_depth > 0)
+        raw = _tape._STATE.trace_depth > 0
+        if not raw:
+            # one distinct (mode, shapes, dtypes) signature == one jit
+            # cache miss == one full retrace + XLA compile; the raw path
+            # inlines into an enclosing trace and has no cache of its own
+            self._retrace.record(
+                (train, self._none_pos,
+                 tuple((tuple(a.data.shape), str(a.data.dtype))
+                       for a in args)))
+        jfn = self._get_jitted(train, raw=raw)
         key = _rnd.next_key()
         n_params = len(params)
         inputs = [p.data() for p in params] + list(args)

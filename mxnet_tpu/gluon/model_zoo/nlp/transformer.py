@@ -109,8 +109,12 @@ class TransformerEncoder(HybridBlock):
         super().__init__(**kwargs)
         self._units = units
         self._max_length = max_length
-        self._pos = positional_encoding(max_length, units)
         with self.name_scope():
+            # HB04 fix: the sinusoidal table is a registered Constant
+            # threaded through the trace once, not an F.array re-upload
+            # per call
+            self.pos_embed = self.params.get_constant(
+                "pos", positional_encoding(max_length, units))
             self.dropout = nn.Dropout(dropout)
             self.cells = nn.HybridSequential(prefix="cells_")
             with self.cells.name_scope():
@@ -120,11 +124,11 @@ class TransformerEncoder(HybridBlock):
                                                 prefix=f"layer{i}_"))
             self.norm = nn.LayerNorm() if pre_norm else None
 
-    def hybrid_forward(self, F, x, mask=None):
+    def hybrid_forward(self, F, x, mask=None, pos_embed=None):
         seq_len = x.shape[1]
+        pos = F.slice_axis(pos_embed, axis=0, begin=0, end=seq_len)
         x = x * math.sqrt(self._units) + \
-            F.array(self._pos[:seq_len]).astype(x.dtype).reshape(
-                (1, seq_len, -1))
+            pos.astype(x.dtype).reshape((1, seq_len, -1))
         x = self.dropout(x)
         for cell in self.cells._children.values():
             x = cell(x, mask)
@@ -137,8 +141,10 @@ class TransformerDecoder(HybridBlock):
                  **kwargs):
         super().__init__(**kwargs)
         self._units = units
-        self._pos = positional_encoding(max_length, units)
         with self.name_scope():
+            # HB04 fix: registered Constant, not a per-call F.array upload
+            self.pos_embed = self.params.get_constant(
+                "pos", positional_encoding(max_length, units))
             self.dropout = nn.Dropout(dropout)
             self.cells = nn.HybridSequential(prefix="cells_")
             with self.cells.name_scope():
@@ -148,11 +154,12 @@ class TransformerDecoder(HybridBlock):
                                                 prefix=f"layer{i}_"))
             self.norm = nn.LayerNorm() if pre_norm else None
 
-    def hybrid_forward(self, F, x, mem, self_mask=None, mem_mask=None):
+    def hybrid_forward(self, F, x, mem, self_mask=None, mem_mask=None,
+                       pos_embed=None):
         seq_len = x.shape[1]
+        pos = F.slice_axis(pos_embed, axis=0, begin=0, end=seq_len)
         x = x * math.sqrt(self._units) + \
-            F.array(self._pos[:seq_len]).astype(x.dtype).reshape(
-                (1, seq_len, -1))
+            pos.astype(x.dtype).reshape((1, seq_len, -1))
         x = self.dropout(x)
         for cell in self.cells._children.values():
             x = cell(x, mem, self_mask, mem_mask)

@@ -1,0 +1,42 @@
+"""``mx.lint`` — trace-safety static analyzer for HybridBlocks, plus the
+runtime retrace detector.
+
+Static side: ``mx.lint.check(block_or_module)`` walks the source of
+``hybrid_forward``/``forward`` (helpers included) and reports
+framework-level diagnostics with stable rule IDs:
+
+    HB01  Python if/while/assert branching on NDArray values
+    HB02  host-sync (.asnumpy()/.item()/float(x)) inside a traced forward
+    HB03  host-materialized values fed back into ops (data-dependent
+          jit cache key -> retrace storms)
+    HB04  Parameters / fresh constant ndarrays allocated per call
+    HB05  np.random / stdlib random draws inside a traced region
+    HB06  as_in_context / device transfers in a hot forward
+
+CLI: ``python tools/mxlint.py <paths>`` (non-zero exit on violations,
+``--format=json|text``, per-line ``# mxlint: disable=HB0x``). Rule
+catalog with bad/good snippets: ``docs/LINT.md`` or ``--list-rules``.
+
+Runtime side: every ``hybridize()``'d block counts its jax.jit cache
+misses (gluon/block.py CachedOp) and emits a :class:`RetraceWarning`
+once when a block crosses ``MXTPU_RETRACE_WARN`` distinct input
+signatures — catching the dynamic retrace storms the static rules
+cannot see.
+
+This package is stdlib-only at import time so the CLI can run without
+jax; it is also re-exported as ``mxnet_tpu.lint``.
+"""
+from __future__ import annotations
+
+from .analyzer import lint_file, lint_source
+from .api import check, lint_paths
+from .report import Violation, render_json, render_text
+from .retrace import RetraceMonitor, RetraceWarning, default_threshold
+from .rules import ALL_RULE_IDS, RULES, Rule
+
+__all__ = [
+    "check", "lint_paths", "lint_source", "lint_file",
+    "Violation", "render_text", "render_json",
+    "RULES", "Rule", "ALL_RULE_IDS",
+    "RetraceMonitor", "RetraceWarning", "default_threshold",
+]

@@ -1,0 +1,825 @@
+"""AST taint analyzer for HybridBlock trace-safety (rules HB01-HB06).
+
+Works on *source*, not live objects, so ``tools/mxlint.py`` can lint a
+tree without importing it (and without importing jax). The walk:
+
+1. Index the module: top-level functions, classes, their methods and
+   base-class names. A class is "blocky" when it (transitively, within
+   the module) derives from a base whose name contains ``Block``, or
+   when it defines ``hybrid_forward`` itself.
+2. For every blocky class, analyze the entry points ``hybrid_forward``
+   and ``forward`` with their tensor arguments seeded as tainted.
+3. Propagate two taints through expressions and assignments:
+   - *tensor*: the value is (or contains) an NDArray/tracer. Branching
+     on it is HB01; converting it to a Python scalar/array is HB02.
+   - *host*: a Python value materialized FROM tensor data (the result
+     of an HB02 conversion). Feeding it back into an op argument or a
+     tensor slice bound is HB03 — the jit cache key becomes
+     data-dependent and every new value recompiles.
+   ``.shape``/``.dtype``/metadata reads and ``len(tensor)`` yield
+   *untainted* values: under jit, shapes are static per trace, so
+   shape-derived control flow and slice bounds are the supported idiom.
+4. Helper calls (``self._helper(...)`` methods and same-module
+   functions) are resolved and analyzed at the call site with the
+   caller's argument taints, so violations inside helpers reached from
+   a traced forward are reported at the helper's own lines.
+
+The analysis is deliberately framework-level: it flags ``.asnumpy()``
+where jax would only name a primitive three stack frames deep.
+"""
+from __future__ import annotations
+
+import ast
+
+from .report import Violation
+from .suppressions import parse_suppressions, is_suppressed
+
+__all__ = ["lint_source", "lint_file"]
+
+# tensor metadata reads that are static under a jit trace
+_META_ATTRS = {"shape", "dtype", "ndim", "size", "context", "ctx",
+               "stype", "grad_req", "name"}
+# methods whose call forces tensor data onto the host (HB02)
+_SYNC_METHODS = {"asnumpy", "asscalar", "item", "tolist"}
+# builtins that force a host sync when applied to a tensor (HB02)
+_SYNC_BUILTINS = {"float", "int", "bool", "complex"}
+# device-transfer methods (HB06)
+_TRANSFER_METHODS = {"as_in_context", "as_in_ctx", "copyto"}
+# names conventionally bound to the op namespace inside forwards
+_OP_NAMESPACE_NAMES = {"F", "nd", "npx"}
+# module roots whose ``.random`` submodule is host RNG (HB05)
+_HOST_RNG_ROOTS = {"np", "numpy", "_np", "onp"}
+
+
+class _Taint:
+    """tensor: the value IS a tensor/tracer (bool() on it is unsafe).
+    host: a Python value materialized from tensor data (HB03 source).
+    container: a Python tuple/list/dict possibly HOLDING tensors —
+    truthiness is a safe len() check, but elements are tensors."""
+    __slots__ = ("tensor", "host", "container")
+
+    def __init__(self, tensor=False, host=False, container=False):
+        self.tensor = tensor
+        self.host = host
+        self.container = container
+
+    def __or__(self, other):
+        return _Taint(self.tensor or other.tensor,
+                      self.host or other.host,
+                      self.container or other.container)
+
+    @property
+    def clean(self):
+        return not (self.tensor or self.host or self.container)
+
+
+_NONE = _Taint()
+_TENSOR = _Taint(tensor=True)
+_HOST = _Taint(host=True)
+_CONTAINER = _Taint(container=True)
+
+# predicates over python structure: static under a trace, return py bool
+_STRUCTURE_BUILTINS = {"isinstance", "hasattr", "callable", "issubclass"}
+
+
+def _base_names(classdef):
+    names = []
+    for b in classdef.bases:
+        if isinstance(b, ast.Name):
+            names.append(b.id)
+        elif isinstance(b, ast.Attribute):
+            names.append(b.attr)
+    return names
+
+
+def _dotted(node):
+    """'np.random.uniform' for an Attribute chain of Names, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class _ModuleIndex:
+    def __init__(self, tree):
+        self.functions = {}
+        self.classes = {}
+        self.op_namespaces = set(_OP_NAMESPACE_NAMES)
+        self.rng_names = set()      # `from random import randint` etc.
+        self._blocky_cache = {}
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = node
+            elif isinstance(node, ast.ClassDef):
+                self.classes[node.name] = node
+            elif isinstance(node, ast.Import):
+                for a in node.names:
+                    alias = a.asname or a.name.split(".")[0]
+                    if "ndarray" in a.name or a.name.startswith("jax.numpy"):
+                        self.op_namespaces.add(alias)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if mod == "random" or mod.endswith(".random") and \
+                        mod.split(".")[0] in _HOST_RNG_ROOTS:
+                    for a in node.names:
+                        self.rng_names.add(a.asname or a.name)
+                if mod.endswith("ndarray"):
+                    for a in node.names:
+                        if a.name in ("ndarray", "ops"):
+                            self.op_namespaces.add(a.asname or a.name)
+
+    def methods_of(self, class_name):
+        """Own + same-module-inherited methods, derived-most first."""
+        out = {}
+        seen = set()
+        stack = [class_name]
+        while stack:
+            name = stack.pop(0)
+            if name in seen or name not in self.classes:
+                continue
+            seen.add(name)
+            cd = self.classes[name]
+            for item in cd.body:
+                if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    out.setdefault(item.name, (name, item))
+            stack.extend(_base_names(cd))
+        return out
+
+    def is_blocky(self, class_name):
+        if class_name in self._blocky_cache:
+            return self._blocky_cache[class_name]
+        self._blocky_cache[class_name] = False       # cycle guard
+        cd = self.classes.get(class_name)
+        result = False
+        if cd is not None:
+            if any(isinstance(i, (ast.FunctionDef, ast.AsyncFunctionDef))
+                   and i.name == "hybrid_forward" for i in cd.body):
+                result = True
+            else:
+                for base in _base_names(cd):
+                    if "Block" in base or self.is_blocky(base):
+                        result = True
+                        break
+        self._blocky_cache[class_name] = result
+        return result
+
+
+class _FunctionAnalyzer(ast.NodeVisitor):
+    """Taint walk of one function body. ``env`` maps local names to
+    _Taint; violations accumulate into the shared collector."""
+
+    def __init__(self, collector, index, path, class_name, func_name,
+                 env, op_names, depth):
+        self.c = collector
+        self.index = index
+        self.path = path
+        self.class_name = class_name
+        self.func_name = func_name
+        self.env = env
+        self.op_names = op_names       # names bound to the op namespace
+        self.depth = depth
+        self.return_taint = _NONE
+
+    # -- plumbing -------------------------------------------------------
+
+    def _report(self, rule, node, message):
+        self.c.add(Violation(rule=rule, path=self.path, line=node.lineno,
+                             col=node.col_offset, message=message,
+                             block=self.class_name, func=self.func_name))
+
+    def _lookup(self, name):
+        return self.env.get(name, _NONE)
+
+    def _assign(self, target, taint):
+        if isinstance(target, ast.Name):
+            self.env[target.id] = taint
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._assign(elt, taint)
+        elif isinstance(target, ast.Starred):
+            # `x, *rest = ...`: rest is a python LIST of the remaining
+            # elements — container semantics, not a bare tensor
+            self._assign(target.value,
+                         _CONTAINER if (taint.tensor or taint.container)
+                         else taint)
+        # attribute/subscript targets: no local binding to track
+
+    # -- expression taint -----------------------------------------------
+
+    def ev(self, node):  # noqa: C901 — one dispatch table, kept flat
+        if node is None:
+            return _NONE
+        if isinstance(node, ast.Name):
+            return self._lookup(node.id)
+        if isinstance(node, ast.Constant):
+            return _NONE
+        if isinstance(node, ast.Attribute):
+            base = self.ev(node.value)
+            if base.tensor and node.attr in _META_ATTRS:
+                return _NONE           # static shape/dtype metadata
+            if base.tensor:
+                return _TENSOR         # x.T and friends
+            return _Taint(host=base.host)
+        if isinstance(node, ast.Subscript):
+            base = self.ev(node.value)
+            idx = self.ev(node.slice)
+            if base.tensor:
+                if idx.host and not idx.tensor:
+                    self._report(
+                        "HB03", node,
+                        "tensor sliced with a host-materialized value: "
+                        "the slice bound is baked into the trace, so the "
+                        "jit cache key becomes data-dependent")
+                return _TENSOR
+            if base.container:
+                # args[1:] stays a container; args[0] is an element
+                return _CONTAINER if isinstance(node.slice, ast.Slice) \
+                    else _TENSOR
+            return _Taint(host=base.host or idx.host)
+        if isinstance(node, ast.Slice):
+            return self.ev(node.lower) | self.ev(node.upper) | \
+                self.ev(node.step)
+        if isinstance(node, ast.BinOp):
+            return self.ev(node.left) | self.ev(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.ev(node.operand)
+        if isinstance(node, ast.Compare):
+            t = self.ev(node.left)
+            for cmp_ in node.comparators:
+                t = t | self.ev(cmp_)
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return _NONE     # identity check: no bool() on the tracer
+            return t
+        if isinstance(node, ast.BoolOp):
+            t = _NONE
+            for v in node.values:
+                t = t | self.ev(v)
+            if t.tensor:
+                self._report(
+                    "HB01", node,
+                    "`and`/`or` on an NDArray calls bool() on it: "
+                    "TracerBoolConversionError under jax.jit; use "
+                    "F.logical_and/F.logical_or or F.where")
+            return t
+        if isinstance(node, ast.IfExp):
+            test = self.ev(node.test)
+            if test.tensor or test.host:
+                self._report(
+                    "HB01", node,
+                    "conditional expression branches on "
+                    + ("an NDArray value" if test.tensor
+                       else "a host-synced tensor value")
+                    + "; use F.where to keep both branches in-graph")
+            return self.ev(node.body) | self.ev(node.orelse)
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            t = _NONE
+            for elt in node.elts:
+                t = t | self.ev(elt)
+            if t.tensor or t.container:
+                # a python tuple OF tensors: truthiness is a len() check
+                return _Taint(host=t.host, container=True)
+            return t
+        if isinstance(node, ast.Dict):
+            t = _NONE
+            for k, v in zip(node.keys, node.values):
+                t = t | self.ev(k) | self.ev(v)
+            if t.tensor or t.container:
+                return _Taint(host=t.host, container=True)
+            return t
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            return self._ev_comp(node, node.elt)
+        if isinstance(node, ast.DictComp):
+            t1 = self._ev_comp(node, node.key)
+            t2 = self._ev_comp(node, node.value)
+            return t1 | t2
+        if isinstance(node, ast.Call):
+            return self._ev_call(node)
+        if isinstance(node, ast.Starred):
+            return self.ev(node.value)
+        if isinstance(node, ast.JoinedStr):
+            for v in node.values:
+                self.ev(v)
+            return _NONE
+        if isinstance(node, ast.FormattedValue):
+            return self.ev(node.value)
+        if isinstance(node, ast.Lambda):
+            return _NONE               # not called here; body unanalyzed
+        if isinstance(node, ast.Await):
+            return self.ev(node.value)
+        # anything else: walk children conservatively, untainted result
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self.ev(child)
+        return _NONE
+
+    def _ev_comp(self, comp, *elts):
+        saved = dict(self.env)
+        try:
+            for gen in comp.generators:
+                self._assign(gen.target, self.ev(gen.iter))
+                for cond in gen.ifs:
+                    t = self.ev(cond)
+                    if t.tensor:
+                        self._report(
+                            "HB01", cond,
+                            "comprehension filter branches on an NDArray "
+                            "value (bool() on a tracer)")
+            t = _NONE
+            for e in elts:
+                t = t | self.ev(e)
+            return t
+        finally:
+            self.env = saved
+
+    # -- calls ----------------------------------------------------------
+
+    def _check_op_args(self, node, op_desc):
+        """HB03: host-materialized values fed into an op call."""
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            t = self.ev(arg)
+            if t.host and not t.tensor:
+                self._report(
+                    "HB03", arg,
+                    f"host-materialized value passed to {op_desc}: the "
+                    "value is baked into the trace, so the jit cache key "
+                    "becomes data-dependent (a retrace per distinct value)")
+
+    def _arg_taints(self, node):
+        pos = [self.ev(a) for a in node.args]
+        kw = {k.arg: self.ev(k.value) for k in node.keywords
+              if k.arg is not None}
+        return pos, kw
+
+    def _ev_call(self, node):  # noqa: C901
+        func = node.func
+        # ---- builtins --------------------------------------------------
+        if isinstance(func, ast.Name):
+            fname = func.id
+            if fname in _SYNC_BUILTINS:
+                t = _NONE
+                for a in node.args:
+                    t = t | self.ev(a)
+                if t.tensor:
+                    self._report(
+                        "HB02", node,
+                        f"`{fname}()` on an NDArray forces a device->host "
+                        "sync (TracerArrayConversionError under jax.jit); "
+                        "keep the value on device or derive it from .shape")
+                    return _HOST
+                return _Taint(host=t.host)
+            if fname == "len" or fname in _STRUCTURE_BUILTINS:
+                for a in node.args:
+                    self.ev(a)
+                return _NONE           # len/isinstance/...: static python
+            if fname in ("tuple", "list", "set", "sorted", "reversed"):
+                t = _NONE
+                for a in node.args:
+                    t = t | self.ev(a)
+                return _CONTAINER if (t.tensor or t.container) else t
+            if fname == "Parameter":
+                self._report(
+                    "HB04", node,
+                    "Parameter created inside forward: it is re-allocated "
+                    "every call and never registered for training; create "
+                    "it in __init__")
+                self._arg_taints(node)
+                return _TENSOR
+            if fname in self.index.rng_names:
+                self._report(
+                    "HB05", node,
+                    f"host RNG `{fname}()` inside a traced forward is "
+                    "drawn once at trace time and baked in as a constant; "
+                    "use F.random.* (threads the per-call PRNG key)")
+                self._arg_taints(node)
+                return _HOST
+            # same-module helper?
+            helper = self.index.functions.get(fname)
+            if helper is not None:
+                pos, kw = self._arg_taints(node)
+                return self.c.analyze_helper(
+                    helper, None, fname, pos, kw, self.op_names,
+                    self.depth + 1)
+            # unknown plain call: tensor-in -> assume tensor-out
+            pos, kw = self._arg_taints(node)
+            t = _NONE
+            for x in list(pos) + list(kw.values()):
+                t = t | x
+            return _TENSOR if t.tensor else _Taint(host=t.host)
+
+        if not isinstance(func, ast.Attribute):
+            # e.g. (lambda ...)(...) — evaluate args, untainted result
+            self._arg_taints(node)
+            return _NONE
+
+        # ---- attribute calls ------------------------------------------
+        attr = func.attr
+        recv = func.value
+        dotted = _dotted(func)
+
+        # HB05: np.random.* / random.* draws
+        if dotted:
+            parts = dotted.split(".")
+            root = parts[0]
+            if (root == "random" and len(parts) == 2) or \
+                    (root in _HOST_RNG_ROOTS and len(parts) >= 3
+                     and parts[1] == "random"):
+                self._report(
+                    "HB05", node,
+                    f"host RNG `{dotted}()` inside a traced forward is "
+                    "drawn once at trace time and baked in as a constant; "
+                    "use F.random.* (threads the per-call PRNG key)")
+                self._arg_taints(node)
+                return _HOST
+
+        recv_taint = self.ev(recv)
+
+        # HB02: sync methods on tensors
+        if attr in _SYNC_METHODS and (recv_taint.tensor or
+                                      self._looks_tensorish(recv)):
+            self._report(
+                "HB02", node,
+                f"`.{attr}()` forces a device->host sync inside a traced "
+                "forward (blocks the pipeline; fails under jax.jit)")
+            self._arg_taints(node)
+            return _HOST
+
+        # HB06: device transfers on tensors
+        if attr in _TRANSFER_METHODS and recv_taint.tensor:
+            self._report(
+                "HB06", node,
+                f"`.{attr}()` device transfer inside a hot forward: pins "
+                "placement against the mesh and serializes the pipeline; "
+                "move data before the forward")
+            self._arg_taints(node)
+            return _TENSOR
+
+        # HB04: self.params.get(...) in forward
+        if attr in ("get", "get_constant") and \
+                isinstance(recv, ast.Attribute) and recv.attr == "params" \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            self._report(
+                "HB04", node,
+                f"`self.params.{attr}(...)` inside forward allocates a "
+                "parameter per call (baked into every trace, never "
+                "trained); declare it in __init__")
+            self._arg_taints(node)
+            return _TENSOR
+
+        # op-namespace calls: F.xxx(...), nd.xxx(...), F.random.xxx(...)
+        ns_root = dotted.split(".")[0] if dotted else None
+        if ns_root in self.op_names or ns_root in self.index.op_namespaces:
+            if attr == "array":
+                args_t = [self.ev(a) for a in node.args]
+                if args_t and args_t[0].clean:
+                    self._report(
+                        "HB04", node,
+                        f"`{dotted}([...])` creates a fresh constant "
+                        "ndarray on every call — it is baked into every "
+                        "trace; build it once in __init__ "
+                        "(params.get_constant) or hoist it to module "
+                        "level")
+            self._check_op_args(node, f"op `{dotted}`")
+            return _TENSOR
+
+        # param.data() / param.grad() hand back the underlying NDArray
+        if attr in ("data", "grad", "list_data") and not node.args \
+                and not node.keywords and not recv_taint.host:
+            return _TENSOR
+
+        # method call on a tensor: x.reshape(...), x.sum() ...
+        if recv_taint.tensor:
+            self._check_op_args(node, f"tensor method `.{attr}`")
+            return _TENSOR
+
+        # self.helper(...) — same-class method or child-block call
+        if isinstance(recv, ast.Name) and recv.id == "self":
+            methods = self.index.methods_of(self.class_name)
+            if attr in methods:
+                owner, fn = methods[attr]
+                pos, kw = self._arg_taints(node)
+                return self.c.analyze_helper(
+                    fn, owner, attr, pos, kw, self.op_names,
+                    self.depth + 1)
+            # child block: tensor-in -> tensor-out
+            self._check_op_args(node, f"block `self.{attr}`")
+            pos, kw = self._arg_taints(node)
+            t = _NONE
+            for x in list(pos) + list(kw.values()):
+                t = t | x
+            return _TENSOR if t.tensor else _NONE
+
+        # anything else: evaluate args; propagate host taint
+        pos, kw = self._arg_taints(node)
+        t = recv_taint
+        for x in list(pos) + list(kw.values()):
+            t = t | x
+        return _TENSOR if t.tensor else _Taint(host=t.host)
+
+    def _looks_tensorish(self, node):
+        """`.asnumpy()` on an untracked receiver (e.g. an attribute or a
+        fresh call result) still syncs; only suppress for names we know
+        are plain Python."""
+        if isinstance(node, ast.Name):
+            return False               # known-untainted local
+        if isinstance(node, ast.Constant):
+            return False
+        if isinstance(node, ast.Call):
+            return self.ev(node).tensor
+        return False
+
+    # -- statements ------------------------------------------------------
+
+    def visit_Assign(self, node):
+        taint = self.ev(node.value)
+        for target in node.targets:
+            # evaluate subscript/attribute targets for their own hits
+            if isinstance(target, (ast.Subscript, ast.Attribute)):
+                self.ev(target)
+            self._assign(target, taint)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None:
+            self._assign(node.target, self.ev(node.value))
+
+    def visit_AugAssign(self, node):
+        taint = self.ev(node.value)
+        if isinstance(node.target, ast.Name):
+            taint = taint | self._lookup(node.target.id)
+        self._assign(node.target, taint)
+
+    def _check_branch(self, test, kind):
+        t = self.ev(test)
+        if t.tensor:
+            self._report(
+                "HB01", test,
+                f"Python `{kind}` on an NDArray value: bool() on a "
+                "tracer raises under jax.jit; branch on static shapes or "
+                "use F.where to keep both sides in-graph")
+        elif t.host:
+            self._report(
+                "HB01", test,
+                f"Python `{kind}` on a host-synced tensor value: the "
+                "branch taken is baked into the trace, so the compiled "
+                "program silently depends on this call's data")
+
+    def visit_If(self, node):
+        self._check_branch(node.test, "if")
+        saved = dict(self.env)
+        for stmt in node.body:
+            self.visit(stmt)
+        env_body = self.env
+        self.env = dict(saved)
+        for stmt in node.orelse:
+            self.visit(stmt)
+        # merge: a name tainted on either path stays tainted
+        for k, v in env_body.items():
+            self.env[k] = self.env.get(k, _NONE) | v
+
+    def visit_While(self, node):
+        self._check_branch(node.test, "while")
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def visit_Assert(self, node):
+        self._check_branch(node.test, "assert")
+        if node.msg is not None:
+            self.ev(node.msg)
+
+    def visit_For(self, node):
+        self._assign_loop_target(node.target, node.iter)
+        for stmt in node.body + node.orelse:
+            self.visit(stmt)
+
+    def _assign_loop_target(self, target, iter_node):
+        """Element-wise taint for the `for i, (a, b) in enumerate(zip(..))`
+        idiom: the enumerate counter is a plain int, and each zip slot
+        carries only its own iterable's taint."""
+        if isinstance(iter_node, ast.Call) and \
+                isinstance(iter_node.func, ast.Name) and \
+                isinstance(target, (ast.Tuple, ast.List)):
+            fname = iter_node.func.id
+            if fname == "enumerate" and len(target.elts) == 2 \
+                    and iter_node.args:
+                self._assign(target.elts[0], _NONE)
+                self._assign_loop_target(target.elts[1], iter_node.args[0])
+                return
+            if fname == "zip" and len(target.elts) == len(iter_node.args):
+                for elt, arg in zip(target.elts, iter_node.args):
+                    t = self.ev(arg)
+                    self._assign(elt, _TENSOR if t.container else t)
+                return
+        t = self.ev(iter_node)
+        # iterating a container of tensors yields tensors
+        self._assign(target, _TENSOR if t.container else t)
+
+    def visit_Return(self, node):
+        if node.value is not None:
+            self.return_taint = self.return_taint | self.ev(node.value)
+
+    def visit_Expr(self, node):
+        self.ev(node.value)
+
+    def visit_With(self, node):
+        for item in node.items:
+            self.ev(item.context_expr)
+            if item.optional_vars is not None:
+                self._assign(item.optional_vars, _NONE)
+        for stmt in node.body:
+            self.visit(stmt)
+
+    def visit_Try(self, node):
+        for stmt in (node.body + node.orelse + node.finalbody):
+            self.visit(stmt)
+        for handler in node.handlers:
+            for stmt in handler.body:
+                self.visit(stmt)
+
+    def visit_FunctionDef(self, node):
+        # closures defined inside forward usually run under the same
+        # trace (branch fns, scan bodies): analyze the body in the
+        # current environment
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Raise(self, node):
+        if node.exc is not None:
+            self.ev(node.exc)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            if isinstance(t, ast.Name):
+                self.env.pop(t.id, None)
+
+    def generic_visit(self, node):
+        # fall through for statements not handled above
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.visit(child)
+            elif isinstance(child, ast.expr):
+                self.ev(child)
+
+
+_MAX_HELPER_DEPTH = 8
+
+
+class _Collector:
+    def __init__(self, index, path):
+        self.index = index
+        self.path = path
+        self.violations = []
+        self._seen = set()
+        self._helper_memo = set()
+
+    def add(self, v):
+        key = (v.rule, v.path, v.line, v.col, v.message)
+        if key not in self._seen:
+            self._seen.add(key)
+            self.violations.append(v)
+
+    def _seed_env(self, fn, class_name, pos_taints, kw_taints,
+                  entry_all_tensor):
+        """Bind call-site taints (or all-tensor for entry points) to the
+        function's parameters."""
+        env = {}
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        skip = 0
+        if class_name is not None and params and params[0] == "self":
+            skip = 1
+        if fn.name == "hybrid_forward" and len(params) > skip:
+            skip += 1                 # the F op-namespace argument
+        # params with a non-None constant default (causal=False, axis=1)
+        # are static config flags, not tensors
+        n_def = len(args.defaults)
+        static_flags = set()
+        if n_def:
+            for a, d in zip(params[-n_def:], args.defaults):
+                if isinstance(d, ast.Constant) and d.value is not None:
+                    static_flags.add(a)
+        for i, name in enumerate(params[skip:]):
+            if entry_all_tensor:
+                env[name] = _NONE if name in static_flags else _TENSOR
+            elif i < len(pos_taints):
+                env[name] = pos_taints[i]
+            elif name in kw_taints:
+                env[name] = kw_taints[name]
+            else:
+                env[name] = _NONE
+        for a, d in zip(args.kwonlyargs, args.kw_defaults):
+            if isinstance(d, ast.Constant) and d.value is not None:
+                static_flags.add(a.arg)
+        for a in args.kwonlyargs:
+            if entry_all_tensor:
+                env[a.arg] = _NONE if a.arg in static_flags else _TENSOR
+            else:
+                env[a.arg] = kw_taints.get(a.arg, _NONE)
+        if args.vararg is not None:
+            # *args is a python TUPLE of tensors: `if args:` is a safe
+            # len() check, while iteration/indexing yields tensors
+            if entry_all_tensor:
+                env[args.vararg.arg] = _CONTAINER
+            else:
+                extra = pos_taints[len(params) - skip:]
+                t = _NONE
+                for x in extra:
+                    t = t | x
+                env[args.vararg.arg] = _CONTAINER \
+                    if (t.tensor or t.container) else t
+        if args.kwarg is not None:
+            env[args.kwarg.arg] = _CONTAINER if entry_all_tensor else _NONE
+        return env
+
+    def _op_names_for(self, fn, class_name):
+        ops = set()
+        args = fn.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        if fn.name == "hybrid_forward":
+            idx = 1 if (class_name is not None and params
+                        and params[0] == "self") else 0
+            if len(params) > idx:
+                ops.add(params[idx])   # whatever the F arg is called
+        return ops
+
+    def analyze_entry(self, fn, class_name):
+        env = self._seed_env(fn, class_name, [], {}, entry_all_tensor=True)
+        ops = self._op_names_for(fn, class_name)
+        an = _FunctionAnalyzer(self, self.index, self.path, class_name or "",
+                               fn.name, env, ops, depth=0)
+        for stmt in fn.body:
+            an.visit(stmt)
+        return an.return_taint
+
+    def analyze_helper(self, fn, class_name, name, pos_taints, kw_taints,
+                       op_names, depth):
+        if depth > _MAX_HELPER_DEPTH:
+            return _TENSOR
+        sig = (id(fn),
+               tuple((t.tensor, t.host) for t in pos_taints),
+               tuple(sorted((k, t.tensor, t.host)
+                            for k, t in kw_taints.items())))
+        tensor_out = any(t.tensor for t in pos_taints) or \
+            any(t.tensor for t in kw_taints.values())
+        if sig in self._helper_memo:
+            # already analyzed with this taint signature; approximate the
+            # return taint without re-reporting
+            return _TENSOR if tensor_out else _NONE
+        self._helper_memo.add(sig)
+        env = self._seed_env(fn, class_name, pos_taints, kw_taints,
+                             entry_all_tensor=False)
+        ops = set(op_names) | self._op_names_for(fn, class_name)
+        an = _FunctionAnalyzer(self, self.index, self.path,
+                               class_name or "", name, env, ops, depth)
+        for stmt in fn.body:
+            an.visit(stmt)
+        return an.return_taint
+
+
+def lint_source(source, path="<string>", only_classes=None, rules=None):
+    """Lint python source; returns a list of Violations (suppressions
+    applied). ``only_classes`` restricts reporting to those class names;
+    ``rules`` restricts to a subset of rule IDs."""
+    tree = ast.parse(source, filename=path)
+    index = _ModuleIndex(tree)
+    collector = _Collector(index, path)
+    for cname in index.classes:
+        if only_classes is not None and cname not in only_classes:
+            continue
+        if not index.is_blocky(cname):
+            continue
+        methods = index.methods_of(cname)
+        for entry in ("hybrid_forward", "forward"):
+            owner_fn = methods.get(entry)
+            if owner_fn is None:
+                continue
+            owner, fn = owner_fn
+            if owner != cname:
+                continue              # inherited: reported on the owner
+            collector.analyze_entry(fn, cname)
+    suppressed, _unknown = parse_suppressions(source)
+    src_lines = source.splitlines()
+    out = []
+    for v in sorted(collector.violations,
+                    key=lambda v: (v.line, v.col, v.rule)):
+        if rules is not None and v.rule not in rules:
+            continue
+        if is_suppressed(suppressed, v.line, v.rule):
+            continue
+        text = src_lines[v.line - 1].strip() if v.line <= len(src_lines) \
+            else ""
+        out.append(Violation(rule=v.rule, path=v.path, line=v.line,
+                             col=v.col, message=v.message, block=v.block,
+                             func=v.func, source_line=text))
+    return out
+
+
+def lint_file(path, rules=None):
+    with open(path, encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, path=path, rules=rules)

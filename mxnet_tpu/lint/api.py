@@ -1,0 +1,113 @@
+"""``mx.lint.check`` — lint live Blocks, classes, and modules.
+
+Resolves the source of the object with ``inspect`` and runs the AST
+analyzer (analyzer.py) over it, so the result is identical to the CLI
+run on the defining file. The gluon import is deferred so the lint
+package stays importable standalone (tools/mxlint.py loads it without
+importing mxnet_tpu or jax).
+"""
+from __future__ import annotations
+
+import inspect
+import os
+import types
+
+from .analyzer import lint_file, lint_source
+
+__all__ = ["check", "lint_paths"]
+
+
+def _lint_class(cls, seen_modules, out, rules):
+    """Lint every class along ``cls``'s MRO that defines a forward,
+    skipping the framework base classes themselves."""
+    for klass in cls.__mro__:
+        if klass.__module__.endswith("gluon.block") or klass is object:
+            continue                   # Block/HybridBlock bases
+        defines_fwd = any(m in vars(klass)
+                          for m in ("hybrid_forward", "forward"))
+        if not defines_fwd:
+            continue
+        mod = inspect.getmodule(klass)
+        if mod is None:
+            continue
+        key = (mod.__name__, klass.__name__)
+        if key in seen_modules:
+            continue
+        seen_modules.add(key)
+        try:
+            source = inspect.getsource(mod)
+            path = inspect.getsourcefile(mod) or f"<{mod.__name__}>"
+        except (OSError, TypeError):
+            continue                   # dynamically defined: no source
+        out.extend(lint_source(source, path=path,
+                               only_classes={klass.__name__}, rules=rules))
+
+
+def check(block_or_module, rules=None, recursive=True):
+    """Statically check a HybridBlock instance, Block subclass, or a
+    python module for trace-safety violations (rules HB01-HB06).
+
+    Returns a list of :class:`mxnet_tpu.lint.Violation`, empty when the
+    target is trace-clean. ``rules`` restricts checking to a subset of
+    rule IDs; ``recursive`` (instances only) also checks the classes of
+    all child blocks.
+
+    Examples
+    --------
+    >>> net = model_zoo.vision.resnet18_v1()
+    >>> assert not mx.lint.check(net)
+    >>> mx.lint.check(mxnet_tpu.gluon.model_zoo.vision.yolo)
+    """
+    if rules is not None:
+        rules = {r.upper() for r in rules}
+    out = []
+    seen = set()
+    if isinstance(block_or_module, types.ModuleType):
+        try:
+            source = inspect.getsource(block_or_module)
+            path = inspect.getsourcefile(block_or_module) \
+                or f"<{block_or_module.__name__}>"
+        except (OSError, TypeError):
+            return []
+        return lint_source(source, path=path, rules=rules)
+    if isinstance(block_or_module, type):
+        _lint_class(block_or_module, seen, out, rules)
+        return _dedupe(out)
+    # instance: its class, plus children when recursive
+    cls = type(block_or_module)
+    _lint_class(cls, seen, out, rules)
+    if recursive:
+        stack = list(getattr(block_or_module, "_children", {}).values())
+        while stack:
+            child = stack.pop()
+            _lint_class(type(child), seen, out, rules)
+            stack.extend(getattr(child, "_children", {}).values())
+    return _dedupe(out)
+
+
+def _dedupe(violations):
+    seen = set()
+    out = []
+    for v in violations:
+        key = (v.rule, v.path, v.line, v.col)
+        if key not in seen:
+            seen.add(key)
+            out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.col, v.rule))
+
+
+def lint_paths(paths, rules=None):
+    """Lint files and directories (recursing into ``*.py``). Returns
+    (violations, files_checked). Unreadable/unparsable files raise."""
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                files.extend(os.path.join(root, n)
+                             for n in sorted(names) if n.endswith(".py"))
+        else:
+            files.append(p)
+    out = []
+    for f in files:
+        out.extend(lint_file(f, rules=rules))
+    return _dedupe(out), len(files)

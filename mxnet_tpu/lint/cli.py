@@ -1,0 +1,96 @@
+"""``mxlint`` CLI entry point (see tools/mxlint.py).
+
+    python tools/mxlint.py <paths...> [--format=text|json] [--rules=HB01,..]
+
+Exit codes: 0 clean, 1 violations found, 2 usage/IO error. The tool is
+pure AST analysis — it never imports the linted code (and never imports
+jax), so it is safe on any tree and in minimal CI images.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .api import lint_paths
+from .report import render_json, render_text
+from .rules import ALL_RULE_IDS, RULES
+from .suppressions import parse_suppressions
+
+
+def _parse_rules(spec):
+    if not spec:
+        return None
+    rules = set()
+    for raw in spec.split(","):
+        rid = raw.strip().upper()
+        if rid not in RULES:
+            raise SystemExit(
+                f"mxlint: unknown rule {raw!r} (known: "
+                f"{', '.join(ALL_RULE_IDS)})")
+        rules.add(rid)
+    return rules
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="mxlint",
+        description="Trace-safety static analyzer for HybridBlocks "
+                    "(rules HB01-HB06; see docs/LINT.md)")
+    ap.add_argument("paths", nargs="+",
+                    help="python files or directories to lint")
+    ap.add_argument("--format", choices=("text", "json"), default="text",
+                    help="diagnostic output format (default: text)")
+    ap.add_argument("--rules", default=None, metavar="HB0x,HB0y",
+                    help="only check these rule IDs")
+    ap.add_argument("--list-rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in ALL_RULE_IDS:
+            r = RULES[rid]
+            print(f"{rid} ({r.title}): {r.summary}\n")
+        return 0
+
+    rules = _parse_rules(args.rules)
+    try:
+        violations, n_files = lint_paths(args.paths, rules=rules)
+    except OSError as e:
+        print(f"mxlint: {e}", file=sys.stderr)
+        return 2
+    except SyntaxError as e:
+        print(f"mxlint: syntax error: {e}", file=sys.stderr)
+        return 2
+
+    # surface suppression typos (a misspelled ID must not hide a rule)
+    for p in _iter_files(args.paths):
+        try:
+            with open(p, encoding="utf-8") as f:
+                _, unknown = parse_suppressions(f.read())
+        except OSError:
+            continue
+        for line, bad in unknown:
+            print(f"mxlint: warning: {p}:{line}: unknown rule {bad!r} in "
+                  f"suppression comment", file=sys.stderr)
+
+    if args.format == "json":
+        print(render_json(violations, files_checked=n_files))
+    else:
+        print(render_text(violations))
+    return 1 if violations else 0
+
+
+def _iter_files(paths):
+    import os
+    for p in paths:
+        if os.path.isdir(p):
+            for root, _dirs, names in os.walk(p):
+                for n in sorted(names):
+                    if n.endswith(".py"):
+                        yield os.path.join(root, n)
+        else:
+            yield p
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,56 @@
+"""Violation record + text/JSON rendering for ``mx.lint``.
+
+Kept stdlib-only: ``tools/mxlint.py`` loads the lint package standalone
+(no jax import) so it can run in CI images without an accelerator.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+
+from .rules import RULES
+
+
+@dataclass(frozen=True)
+class Violation:
+    """One rule hit, anchored to source. ``block``/``func`` locate the
+    HybridBlock class and the forward/helper the hit was found in."""
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    block: str = ""
+    func: str = ""
+    source_line: str = field(default="", compare=False)
+
+    @property
+    def title(self):
+        return RULES[self.rule].title if self.rule in RULES else self.rule
+
+    def format_text(self):
+        where = self.block and f" [in {self.block}.{self.func}]" or ""
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"({self.title}) {self.message}{where}")
+
+
+def render_text(violations):
+    lines = [v.format_text() for v in violations]
+    n = len(violations)
+    lines.append(f"{n} violation{'s' if n != 1 else ''} found"
+                 if n else "clean: no trace-safety violations")
+    return "\n".join(lines)
+
+
+def render_json(violations, files_checked=None):
+    by_rule = {}
+    for v in violations:
+        by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+    payload = {
+        "violations": [asdict(v) for v in violations],
+        "count": len(violations),
+        "by_rule": by_rule,
+    }
+    if files_checked is not None:
+        payload["files_checked"] = files_checked
+    return json.dumps(payload, indent=2)

@@ -1,0 +1,74 @@
+"""Runtime retrace detector — the dynamic complement to the static rules.
+
+The static analyzer can't see shapes that only exist at runtime: a
+data loader that emits a different sequence length every batch defeats
+HB03's static view entirely. This module counts jax.jit cache misses
+per hybridized block (every distinct input shape/dtype signature is one
+retrace + recompile) and warns ONCE per block when the count crosses a
+threshold — the observable symptom of the retrace storms that dominate
+TPU-pod utilization loss (arXiv:2011.03641 §4).
+
+Wired into ``gluon/block.py`` ``CachedOp.__call__``; tune with
+``MXTPU_RETRACE_WARN=<n>`` (default 3: the warning fires on the 4th
+distinct signature; 0 disables). The fix is usually shape bucketing
+(pad to a small set of shapes — see BucketingModule) or hoisting the
+shape-varying prefix out of the hybridized block.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["RetraceWarning", "RetraceMonitor", "default_threshold"]
+
+
+class RetraceWarning(UserWarning):
+    """A hybridized block is retracing/recompiling excessively."""
+
+
+def default_threshold():
+    """MXTPU_RETRACE_WARN env (distinct signatures tolerated before the
+    warning; 0 disables the detector)."""
+    try:
+        return int(os.environ.get("MXTPU_RETRACE_WARN", "3"))
+    except ValueError:
+        return 3
+
+
+class RetraceMonitor:
+    """Tracks distinct (train, shapes, dtypes) signatures for one
+    CachedOp. Each new signature is a jax.jit cache miss: a full
+    retrace + XLA compile. ``record`` is O(1) per call (set lookup)."""
+
+    def __init__(self, name, threshold=None):
+        self.name = name
+        self.threshold = default_threshold() if threshold is None \
+            else threshold
+        self.signatures = set()
+        self.calls = 0
+        self.warned = False
+
+    @property
+    def misses(self):
+        return len(self.signatures)
+
+    def record(self, signature):
+        """Record one call; returns True when this signature is new
+        (i.e. this call pays a retrace)."""
+        self.calls += 1
+        if signature in self.signatures:
+            return False
+        self.signatures.add(signature)
+        if (not self.warned and self.threshold > 0
+                and len(self.signatures) > self.threshold):
+            self.warned = True
+            warnings.warn(
+                f"block '{self.name}' has retraced "
+                f"{len(self.signatures)} times in {self.calls} calls "
+                f"(every distinct input signature recompiles under "
+                f"jax.jit); newest signature: {signature!r}. Pad inputs "
+                f"to a fixed set of shapes (shape bucketing) or run "
+                f"`mx.lint.check` on the block for data-dependent "
+                f"patterns. Tune with MXTPU_RETRACE_WARN=<n> (0 "
+                f"disables).", RetraceWarning, stacklevel=3)
+        return True

@@ -1,0 +1,97 @@
+"""``mx.lint`` rule catalog — trace-safety rules for HybridBlocks.
+
+Each rule has a stable ID (HB01..HB06) used in diagnostics and in
+``# mxlint: disable=HB0x`` suppression comments. The catalog carries a
+one-line summary plus a bad/good snippet pair; ``docs/LINT.md`` renders
+the same catalog for humans.
+
+Why these six: ``hybridize()`` compiles ``hybrid_forward`` with
+``jax.jit`` (gluon/block.py CachedOp). Anything that forces the traced
+values onto the host (HB01/HB02), makes the jit cache key depend on
+tensor *data* rather than shapes (HB03), re-allocates constants or
+parameters per trace (HB04), draws host randomness inside the trace
+(HB05), or moves data across devices mid-forward (HB06) either throws a
+``TracerBoolConversionError`` deep inside jax, silently serializes the
+device, or triggers the retrace/recompile storms that dominate TPU-pod
+utilization loss (arXiv:2011.03641 §4; ROADMAP north star).
+"""
+from __future__ import annotations
+
+from collections import namedtuple
+
+Rule = namedtuple("Rule", ["id", "title", "summary", "bad", "good"])
+
+RULES = {
+    "HB01": Rule(
+        "HB01", "python-branch-on-tensor",
+        "Python `if`/`while`/`assert`/`and`/`or` on an NDArray value: "
+        "under jax.jit the value is an abstract tracer, so `bool()` "
+        "raises TracerBoolConversionError (or forces a host sync in "
+        "eager mode). Branch on static shapes, or compute both sides "
+        "and select with F.where.",
+        "if x > 0:\n    x = x * 2",
+        "x = F.where(x > 0, x * 2, x)      # stays in-graph\n"
+        "if x.shape[0] > 4: ...            # shapes are static: fine"),
+    "HB02": Rule(
+        "HB02", "host-sync-in-forward",
+        "Host-sync conversion (`.asnumpy()`, `.asscalar()`, `.item()`, "
+        "`.tolist()`, or `float()`/`int()`/`bool()` on a tensor) inside "
+        "a traced forward: blocks the device pipeline and fails under "
+        "jax.jit (TracerArrayConversionError).",
+        "scale = float(F.max(x))           # device->host round-trip\n"
+        "return x / scale",
+        "return x / F.max(x)               # stays on device\n"
+        "n = int(x.shape[1])               # shape metadata: fine"),
+    "HB03": Rule(
+        "HB03", "data-dependent-cache-key",
+        "A host-materialized value (from `.item()`/`.asnumpy()`/`int()` "
+        "on a tensor) fed back into an op argument or tensor slice: the "
+        "jit cache key becomes data-dependent, so every new *value* "
+        "compiles a new program (retrace storm).",
+        "k = int(F.sum(mask))\n"
+        "top = F.slice_axis(x, axis=0, begin=0, end=k)",
+        "top = F.slice_axis(x, axis=0, begin=0,\n"
+        "                   end=x.shape[0] // 2)   # shape-derived: one\n"
+        "                                          # trace per shape"),
+    "HB04": Rule(
+        "HB04", "alloc-in-forward",
+        "Allocating a `Parameter` (`self.params.get(...)`) or a fresh "
+        "constant ndarray (`F.array([...])` on non-tensor data) inside "
+        "forward: the constant is re-created and baked into every "
+        "trace; parameters created per-call never train. Create them in "
+        "`__init__` (Parameter/Constant) and close over them.",
+        "def hybrid_forward(self, F, x):\n"
+        "    w = F.array([0.299, 0.587, 0.114])\n"
+        "    return F.dot(x, w)",
+        "# __init__: self.w = self.params.get_constant('w', [...])\n"
+        "def hybrid_forward(self, F, x, w):\n"
+        "    return F.dot(x, w)\n"
+        "y = F.zeros_like(x)               # shaped like an input: fine"),
+    "HB05": Rule(
+        "HB05", "host-rng-in-forward",
+        "`np.random.*` / stdlib `random.*` draw inside a traced "
+        "forward: the draw happens once at trace time and is baked into "
+        "the compiled program as a constant — every call replays the "
+        "same 'random' numbers. Use `F.random.*`, which threads the "
+        "per-call PRNG key through the trace.",
+        "noise = F.array(np.random.randn(4))\n"
+        "return x + noise",
+        "return x + F.random.normal(shape=(4,))   # fresh per call"),
+    "HB06": Rule(
+        "HB06", "device-transfer-in-forward",
+        "`as_in_context`/`copyto` device transfer in a hot forward: "
+        "inside a trace it pins placement against the mesh sharding "
+        "(and eagerly it serializes H2D/D2H per call). Move data before "
+        "the forward; let jit/shard_map place values.",
+        "x = x.as_in_context(mx.cpu())\n"
+        "return self.body(x)",
+        "# transfer once, outside forward:\n"
+        "# data = data.as_in_context(ctx)  (in the input pipeline)\n"
+        "return self.body(x)"),
+}
+
+ALL_RULE_IDS = tuple(sorted(RULES))
+
+
+def is_valid_rule(rule_id):
+    return rule_id in RULES

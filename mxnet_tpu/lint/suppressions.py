@@ -1,0 +1,53 @@
+"""Per-line ``# mxlint: disable=HB0x`` suppression comments.
+
+Syntax (on the offending line, after the code):
+
+    y = x.asnumpy()          # mxlint: disable=HB02
+    k = int(F.sum(m))        # mxlint: disable=HB02,HB03  -- justification
+    if x > 0: ...            # mxlint: disable            (all rules)
+
+A bare ``disable`` (or ``disable=all``) suppresses every rule on that
+line. Unknown rule IDs in a suppression are reported as a warning by the
+CLI rather than silently ignored, so typos don't hide real violations.
+"""
+from __future__ import annotations
+
+import re
+
+from .rules import is_valid_rule
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*mxlint:\s*disable(?:\s*=\s*(?P<ids>[A-Za-z0-9_,\s]+?))?\s*(?:--|#|$)")
+
+
+def parse_suppressions(source):
+    """Map line number (1-based) -> set of suppressed rule IDs, where
+    ``{"all"}`` means every rule. Also returns a list of
+    (line, bad_id) for unknown rule IDs."""
+    suppressed = {}
+    unknown = []
+    for lineno, text in enumerate(source.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(text)
+        if not m:
+            continue
+        ids = m.group("ids")
+        if not ids or ids.strip().lower() == "all":
+            suppressed[lineno] = {"all"}
+            continue
+        rules = set()
+        for raw in ids.split(","):
+            rid = raw.strip().upper()
+            if not rid:
+                continue
+            if is_valid_rule(rid):
+                rules.add(rid)
+            else:
+                unknown.append((lineno, raw.strip()))
+        if rules:
+            suppressed[lineno] = rules
+    return suppressed, unknown
+
+
+def is_suppressed(suppressed, line, rule):
+    rules = suppressed.get(line)
+    return bool(rules) and ("all" in rules or rule in rules)

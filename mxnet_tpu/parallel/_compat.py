@@ -1,0 +1,26 @@
+"""jax version compatibility for the parallel package.
+
+``shard_map`` moved from ``jax.experimental.shard_map`` to top-level
+``jax.shard_map`` (and its ``check_rep`` kwarg became ``check_vma``)
+across jax releases; the rest of this package targets the new surface.
+This shim lets the package import and run on both, so a jax downgrade
+in the base image doesn't take out ``import mxnet_tpu`` (parallel is
+imported from the package root).
+"""
+from __future__ import annotations
+
+try:                                    # jax >= 0.5: top-level export
+    from jax import shard_map as _shard_map
+    _NEEDS_KWARG_SHIM = False
+except ImportError:                     # jax 0.4.x: experimental module
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _NEEDS_KWARG_SHIM = True
+
+__all__ = ["shard_map"]
+
+
+def shard_map(f, *, check_vma=None, **kwargs):
+    if check_vma is not None:
+        kwargs["check_vma" if not _NEEDS_KWARG_SHIM
+               else "check_rep"] = check_vma
+    return _shard_map(f, **kwargs)

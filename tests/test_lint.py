@@ -1,0 +1,455 @@
+"""mx.lint: trace-safety static analyzer + runtime retrace detector.
+
+Per rule HB01-HB06: one seeded-violation fixture and one clean
+near-miss (the pattern a naive matcher would false-positive on).
+Plus: suppression comments, CLI exit codes / JSON format, the live
+``mx.lint.check`` object API, the model-zoo self-lint gate, and the
+CachedOp retrace warning (fires on shape churn, silent when stable).
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import warnings
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.lint import (RetraceWarning, check, lint_source)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint(body):
+    """Lint a hybrid_forward body (module scaffolding added)."""
+    src = ("import numpy as np\n"
+           "import random\n"
+           "class Fixture(HybridBlock):\n"
+           "    def hybrid_forward(self, F, x, mask=None):\n"
+           + textwrap.indent(textwrap.dedent(body), " " * 8))
+    return lint_source(src, path="<fixture>")
+
+
+def _rules(violations):
+    return sorted({v.rule for v in violations})
+
+
+# ----------------------------------------------------------------------
+# HB01 — python branching on tensor values
+# ----------------------------------------------------------------------
+
+def test_hb01_if_on_tensor():
+    assert "HB01" in _rules(_lint("""
+        if x > 0:
+            x = x * 2
+        return x
+    """))
+
+
+def test_hb01_while_and_assert_on_tensor():
+    out = _lint("""
+        assert F.sum(x) > 0
+        while x < 10:
+            x = x + 1
+        return x
+    """)
+    assert [v.rule for v in out].count("HB01") == 2
+
+
+def test_hb01_boolop_on_tensor():
+    assert "HB01" in _rules(_lint("""
+        y = (x > 0) and (x < 1)
+        return y
+    """))
+
+
+def test_hb01_clean_near_miss_shape_branch():
+    # branching on static shape metadata and `is None` identity checks
+    # is THE supported idiom — zero findings
+    assert _lint("""
+        if x.shape[0] > 4 and mask is None:
+            x = F.relu(x)
+        return x
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# HB02 — host sync inside a traced forward
+# ----------------------------------------------------------------------
+
+def test_hb02_asnumpy():
+    assert "HB02" in _rules(_lint("""
+        host = x.asnumpy()
+        return F.relu(x)
+    """))
+
+
+def test_hb02_float_builtin():
+    assert "HB02" in _rules(_lint("""
+        scale = float(F.max(x))
+        return x / scale
+    """))
+
+
+def test_hb02_clean_near_miss_shape_int():
+    # int() over shape metadata never touches tensor data
+    assert _lint("""
+        n = int(x.shape[1])
+        m = len(x)
+        return F.reshape(x, (m, n))
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# HB03 — host-materialized values fed back into ops
+# ----------------------------------------------------------------------
+
+def test_hb03_synced_scalar_into_op():
+    out = _lint("""
+        k = int(F.sum(mask))
+        return F.slice_axis(x, axis=0, begin=0, end=k)
+    """)
+    assert "HB02" in _rules(out) and "HB03" in _rules(out)
+
+
+def test_hb03_synced_scalar_into_tensor_slice():
+    assert "HB03" in _rules(_lint("""
+        k = x.asnumpy().max()
+        return x[:k]
+    """))
+
+
+def test_hb03_clean_near_miss_shape_derived_bound():
+    # shape-derived bounds retrace once per SHAPE (inherent to jit),
+    # not once per VALUE — clean
+    assert _lint("""
+        half = x.shape[0] // 2
+        return F.slice_axis(x, axis=0, begin=0, end=half)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# HB04 — per-call Parameter / constant ndarray allocation
+# ----------------------------------------------------------------------
+
+def test_hb04_params_get_in_forward():
+    assert "HB04" in _rules(_lint("""
+        w = self.params.get("w", shape=(4, 4))
+        return F.dot(x, w.data())
+    """))
+
+
+def test_hb04_constant_array_in_forward():
+    assert "HB04" in _rules(_lint("""
+        w = F.array([0.299, 0.587, 0.114])
+        return F.dot(x, w)
+    """))
+
+
+def test_hb04_clean_near_miss_zeros_like():
+    # input-shaped allocations are traced ops, not baked constants
+    assert _lint("""
+        y = F.zeros_like(x)
+        return F.concat(x, y, dim=0)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# HB05 — host RNG inside a traced region
+# ----------------------------------------------------------------------
+
+def test_hb05_np_random():
+    assert "HB05" in _rules(_lint("""
+        noise = F.array(np.random.randn(4))
+        return x + noise
+    """))
+
+
+def test_hb05_stdlib_random():
+    assert "HB05" in _rules(_lint("""
+        if random.random() > 0.5:
+            x = x * 2
+        return x
+    """))
+
+
+def test_hb05_clean_near_miss_f_random():
+    # F.random threads the per-call PRNG key through the trace
+    assert _lint("""
+        return x + F.random.normal(shape=(4,))
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# HB06 — device transfers in a hot forward
+# ----------------------------------------------------------------------
+
+def test_hb06_as_in_context():
+    assert "HB06" in _rules(_lint("""
+        y = x.as_in_context(cpu())
+        return y
+    """))
+
+
+def test_hb06_copyto():
+    assert "HB06" in _rules(_lint("""
+        y = x.copyto(cpu())
+        return y
+    """))
+
+
+def test_hb06_clean_near_miss_context_read():
+    # reading .context is metadata, not a transfer
+    assert _lint("""
+        ctx = x.context
+        return F.relu(x)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# helpers are resolved from the traced forward
+# ----------------------------------------------------------------------
+
+def test_violation_found_in_same_class_helper():
+    src = textwrap.dedent("""
+        class Net(HybridBlock):
+            def _postprocess(self, F, y):
+                return y.asnumpy()
+            def hybrid_forward(self, F, x):
+                return self._postprocess(F, F.relu(x))
+    """)
+    out = lint_source(src, path="<helper>")
+    assert _rules(out) == ["HB02"]
+    assert out[0].func == "_postprocess"
+
+
+def test_violation_found_in_module_helper():
+    src = textwrap.dedent("""
+        def decode(F, y):
+            return float(F.max(y))
+        class Net(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return decode(F, x)
+    """)
+    assert _rules(lint_source(src, path="<helper>")) == ["HB02"]
+
+
+def test_non_block_classes_are_ignored():
+    src = textwrap.dedent("""
+        class Loss:
+            def __call__(self, x):
+                return float(x.sum())
+    """)
+    assert lint_source(src, path="<nonblock>") == []
+
+
+# ----------------------------------------------------------------------
+# suppressions
+# ----------------------------------------------------------------------
+
+def test_suppression_comment_silences_rule():
+    out = _lint("""
+        host = x.asnumpy()  # mxlint: disable=HB02
+        return F.relu(x)
+    """)
+    assert out == []
+
+
+def test_suppression_is_rule_specific():
+    # HB02 suppressed, but the HB03 on the same construct still fires
+    out = _lint("""
+        k = int(F.sum(mask))  # mxlint: disable=HB02
+        return F.slice_axis(x, axis=0, begin=0, end=k)
+    """)
+    assert _rules(out) == ["HB03"]
+
+
+def test_bare_suppression_silences_all():
+    out = _lint("""
+        k = int(F.sum(mask))  # mxlint: disable
+        return F.slice_axis(x, axis=0, begin=0,
+                            end=k)  # mxlint: disable=HB03
+    """)
+    assert out == []
+
+
+# ----------------------------------------------------------------------
+# live-object API
+# ----------------------------------------------------------------------
+
+class _BadSyncBlock(gluon.HybridBlock):
+    def hybrid_forward(self, F, x):
+        if x.asnumpy().sum() > 0:   # seeded: HB01 + HB02
+            return x * 2
+        return x
+
+
+class _CleanBlock(gluon.HybridBlock):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.dense = nn.Dense(4)
+
+    def hybrid_forward(self, F, x):
+        if x.shape[0] > 2:
+            x = F.relu(x)
+        return self.dense(x)
+
+
+def test_check_flags_bad_instance():
+    rules = {v.rule for v in check(_BadSyncBlock())}
+    assert "HB02" in rules and "HB01" in rules
+
+
+def test_check_accepts_class_and_clean_instance():
+    assert check(_BadSyncBlock)          # class object works too
+    net = _CleanBlock()
+    assert check(net) == []              # recursive: includes nn.Dense
+
+
+def test_check_accepts_module():
+    from mxnet_tpu.gluon.model_zoo.vision import resnet
+    assert check(resnet) == []
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+
+_CLI_BAD = textwrap.dedent("""
+    class Net(HybridBlock):
+        def hybrid_forward(self, F, x):
+            return float(F.max(x))
+""")
+
+_CLI_CLEAN = textwrap.dedent("""
+    class Net(HybridBlock):
+        def hybrid_forward(self, F, x):
+            return F.relu(x)
+""")
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "mxlint.py"), *args],
+        capture_output=True, text=True)
+
+
+def test_cli_exit_codes(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_CLI_BAD)
+    clean = tmp_path / "clean.py"
+    clean.write_text(_CLI_CLEAN)
+    r = _run_cli(str(bad))
+    assert r.returncode == 1
+    assert "HB02" in r.stdout
+    r = _run_cli(str(clean))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_cli_json_format(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(_CLI_BAD)
+    r = _run_cli(str(bad), "--format=json")
+    assert r.returncode == 1
+    payload = json.loads(r.stdout)
+    assert payload["count"] == len(payload["violations"]) >= 1
+    v = payload["violations"][0]
+    assert v["rule"] == "HB02" and v["path"] == str(bad)
+    assert payload["by_rule"]["HB02"] >= 1
+
+
+def test_cli_warns_on_unknown_suppression(tmp_path):
+    f = tmp_path / "typo.py"
+    f.write_text(textwrap.dedent("""
+        class Net(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return float(F.max(x))  # mxlint: disable=HB99
+    """))
+    r = _run_cli(str(f))
+    assert r.returncode == 1            # typo must not hide the rule
+    assert "HB99" in r.stderr
+
+
+# ----------------------------------------------------------------------
+# model zoo self-lint: the zoo is certified trace-clean (tier-1 gate)
+# ----------------------------------------------------------------------
+
+def _zoo_modules():
+    import importlib
+    import pkgutil
+    import mxnet_tpu.gluon.model_zoo as zoo
+    for pkg in ("mxnet_tpu.gluon.model_zoo.vision",
+                "mxnet_tpu.gluon.model_zoo.nlp"):
+        parent = importlib.import_module(pkg)
+        yield parent
+        for info in pkgutil.iter_modules(parent.__path__):
+            yield importlib.import_module(f"{pkg}.{info.name}")
+    yield zoo
+
+
+def test_model_zoo_is_trace_clean():
+    """New zoo models can't regress trace-safety: mx.lint.check over every
+    vision + nlp module must report zero violations."""
+    dirty = {}
+    for mod in _zoo_modules():
+        found = check(mod)
+        if found:
+            dirty[mod.__name__] = [v.format_text() for v in found]
+    assert not dirty, f"model zoo trace-safety regressions: {dirty}"
+
+
+def test_cli_model_zoo_clean():
+    """The acceptance-criteria command verbatim: mxlint over the zoo
+    exits 0 without importing the framework."""
+    r = _run_cli(os.path.join(REPO, "mxnet_tpu", "gluon", "model_zoo"))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+# ----------------------------------------------------------------------
+# runtime retrace detector (gluon/block.py CachedOp)
+# ----------------------------------------------------------------------
+
+def test_retrace_warning_fires_on_shape_churn():
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for b in range(1, 6):            # 5 distinct input shapes
+            net(mx.nd.ones((b, 7)))
+    hits = [x for x in w if issubclass(x.category, RetraceWarning)]
+    assert len(hits) == 1                # warned once, not per miss
+    msg = str(hits[0].message)
+    assert "retraced" in msg and "float32" in msg
+    mon = net._cached_op._retrace
+    assert mon.misses == 5 and mon.warned
+
+
+def test_retrace_detector_silent_when_shape_stable():
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for _ in range(12):              # one signature, many calls
+            net(mx.nd.ones((4, 7)))
+    assert not [x for x in w if issubclass(x.category, RetraceWarning)]
+    mon = net._cached_op._retrace
+    assert mon.misses == 1 and mon.calls == 12
+
+
+def test_retrace_threshold_env(monkeypatch):
+    monkeypatch.setenv("MXTPU_RETRACE_WARN", "0")   # 0 disables
+    net = nn.Dense(3)
+    net.initialize()
+    net.hybridize()
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        for b in range(1, 8):
+            net(mx.nd.ones((b, 5)))
+    assert not [x for x in w if issubclass(x.category, RetraceWarning)]

@@ -88,6 +88,20 @@ def test_real_data_convergence_floor(tmp_path):
 
 
 @pytest.mark.slow
+def test_rcnn_detection_convergence_floor():
+    """Faster R-CNN end-to-end (reference example/rcnn acceptance surface,
+    SURVEY §2.4) at reduced steps: covers the joint RPN+head loss wiring
+    and the train-mode stop_gradient branch (proposals are
+    coordinate-detached in the net). The loss must halve and the top-1
+    detection (class match + IoU >= 0.5 after in-graph NMS) must clear
+    the 0.5 floor on the synthetic single-object set."""
+    from examples.rcnn_train import train
+    out = train(steps=160, batch=8, lr=0.002, seed=0, log_every=0)
+    assert out["last_loss"] < 0.5 * out["first_loss"], out
+    assert out["det_acc"] >= 0.5, out
+
+
+@pytest.mark.slow
 def test_ssd_detection_convergence_floor():
     """Detection end-to-end (reference example/ssd acceptance surface,
     SURVEY §2.4): anchors -> MultiBoxTarget -> joint CE + smooth-L1 ->

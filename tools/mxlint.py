@@ -1,0 +1,43 @@
+#!/usr/bin/env python
+"""mxlint — trace-safety static analyzer for HybridBlocks.
+
+    python tools/mxlint.py mxnet_tpu/gluon/model_zoo
+    python tools/mxlint.py my_model.py --format=json
+    python tools/mxlint.py --list-rules
+
+Exit codes: 0 clean, 1 violations, 2 usage/IO error. Loads
+``mxnet_tpu/lint`` as a standalone package so linting never imports the
+framework (or jax) — the tool works in minimal CI images and on trees
+that don't import cleanly.
+"""
+import importlib.util
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_lint_pkg():
+    try:
+        # installed / repo-root-on-path case: the real package, but only
+        # if mxnet_tpu itself is already imported (avoid pulling in jax)
+        if "mxnet_tpu" in sys.modules:
+            from mxnet_tpu import lint
+            return lint
+    except ImportError:
+        pass
+    pkg_dir = os.path.join(ROOT, "mxnet_tpu", "lint")
+    spec = importlib.util.spec_from_file_location(
+        "mxnet_tpu_lint_standalone", os.path.join(pkg_dir, "__init__.py"),
+        submodule_search_locations=[pkg_dir])
+    pkg = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = pkg
+    spec.loader.exec_module(pkg)
+    return pkg
+
+
+if __name__ == "__main__":
+    import importlib
+    lint = _load_lint_pkg()
+    cli = importlib.import_module(lint.__name__ + ".cli")
+    sys.exit(cli.main())
