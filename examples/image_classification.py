@@ -70,10 +70,17 @@ def main():
     ap.add_argument("--num-epochs", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.1)
     ap.add_argument("--kv-store", default="local")
+    ap.add_argument("--no-prefetch", action="store_true",
+                    help="disable the input-prefetch thread")
     args = ap.parse_args()
 
     logging.basicConfig(level=logging.INFO)
     train, val = synthetic_iters(args.network, args.batch_size)
+    if not args.no_prefetch:
+        # overlap batch preparation with the step (the reference's
+        # PrefetchingIter pattern, now backed by io.DevicePrefetcher —
+        # docs/INPUT_PIPELINE.md)
+        train = mx.io.PrefetchingIter(train)
     mod = mx.mod.Module(get_symbol(args.network),
                         data_names=("data",),
                         label_names=("softmax_label",))

@@ -27,7 +27,12 @@ net.hybridize()
 to_tensor = transforms.ToTensor()
 train_ds = datasets.MNIST(train=True, synthetic=True, size=2000).transform_first(lambda d: to_tensor(d))
 val_ds = datasets.MNIST(train=False, synthetic=True, size=500).transform_first(lambda d: to_tensor(d))
-train_loader = gluon.data.DataLoader(train_ds, batch_size=100, shuffle=True)
+# prefetch_to_device: a worker thread ships batch N+1 to the device
+# while the step consumes batch N (docs/INPUT_PIPELINE.md); batches
+# arrive device-resident, and Trainer.step below runs the donated
+# fused group update automatically
+train_loader = gluon.data.DataLoader(train_ds, batch_size=100, shuffle=True,
+                                     prefetch_to_device=True)
 val_loader = gluon.data.DataLoader(val_ds, batch_size=100)
 
 trainer = gluon.Trainer(net.collect_params(), 'sgd',
@@ -38,8 +43,7 @@ metric = mx.metric.Accuracy()
 for epoch in range(8):
     metric.reset()
     for data, label in train_loader:
-        data = data.as_in_context(ctx)
-        label = label.as_in_context(ctx)
+        # already device-resident via prefetch_to_device
         with autograd.record():
             out = net(data)
             L = loss_fn(out, label)

@@ -244,14 +244,21 @@ class DataLoader:
     batchify_fn must pickle, workers are spawned with a fresh CPU-only
     jax (never the parent's accelerator), and batches return as numpy.
     ``num_workers=0`` means synchronous.
+
+    ``prefetch_to_device=True`` chains an ``io.DevicePrefetcher`` after
+    batching: a worker thread ships batch N+1 to the device (sharded
+    over an active ``parallel`` mesh) while the training step consumes
+    batch N — see docs/INPUT_PIPELINE.md.
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=True, timeout=120):
+                 prefetch=None, thread_pool=True, timeout=120,
+                 prefetch_to_device=False):
         self._dataset = dataset
         self._timeout = timeout
+        self._prefetch_to_device = prefetch_to_device
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError(
@@ -279,6 +286,20 @@ class DataLoader:
         self._mp_pool = None
 
     def __iter__(self):
+        if self._prefetch_to_device:
+            # overlap H2D with consumer compute: batches arrive already
+            # device-resident (sharded over an active parallel mesh) —
+            # see io.DevicePrefetcher / docs/INPUT_PIPELINE.md
+            from ...io import DevicePrefetcher
+            pf = DevicePrefetcher(self._host_iter(), depth=2)
+            try:
+                yield from pf
+            finally:
+                pf.close()
+        else:
+            yield from self._host_iter()
+
+    def _host_iter(self):
         from ... import debug as _debug
         if self._num_workers == 0 or _debug.determinism_enabled():
             # MXTPU_ENFORCE_DETERMINISM: random transforms draw from the

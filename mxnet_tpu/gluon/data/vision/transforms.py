@@ -23,7 +23,7 @@ __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CenterCrop",
 
 def _to_np(x):
     if isinstance(x, NDArray):
-        return x.asnumpy()
+        return x.asnumpy()  # mxlint: disable=HB02 -- host-side eager Block
     return _np.asarray(x)
 
 
@@ -51,7 +51,7 @@ class ToTensor(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype("float32")
-        if np_x.max() > 1.5:
+        if np_x.max() > 1.5:  # mxlint: disable=HB01 -- host numpy, not a tracer
             np_x = np_x / 255.0
         if np_x.ndim == 3:
             np_x = np_x.transpose(2, 0, 1)
@@ -134,9 +134,9 @@ class RandomCrop(Block):
                            mode="constant")
         h, w = np_x.shape[:2]
         cw, ch = self._size
-        x0 = _np.random.randint(0, max(w - cw, 0) + 1)
-        y0 = _np.random.randint(0, max(h - ch, 0) + 1)
-        return array(np_x[y0:y0 + ch, x0:x0 + cw])
+        x0 = _np.random.randint(0, max(w - cw, 0) + 1)  # mxlint: disable=HB05 -- host-side eager Block
+        y0 = _np.random.randint(0, max(h - ch, 0) + 1)  # mxlint: disable=HB05 -- host-side eager Block
+        return array(np_x[y0:y0 + ch, x0:x0 + cw])  # mxlint: disable=HB03 -- host-side eager Block
 
 
 class RandomResizedCrop(Block):
@@ -152,14 +152,14 @@ class RandomResizedCrop(Block):
         h, w = np_x.shape[:2]
         area = h * w
         for _ in range(10):
-            target_area = _np.random.uniform(*self._scale) * area
-            aspect = _np.random.uniform(*self._ratio)
+            target_area = _np.random.uniform(*self._scale) * area  # mxlint: disable=HB05 -- host-side eager Block
+            aspect = _np.random.uniform(*self._ratio)  # mxlint: disable=HB05 -- host-side eager Block
             cw = int(round(_np.sqrt(target_area * aspect)))
             ch = int(round(_np.sqrt(target_area / aspect)))
-            if cw <= w and ch <= h:
-                x0 = _np.random.randint(0, w - cw + 1)
-                y0 = _np.random.randint(0, h - ch + 1)
-                crop = np_x[y0:y0 + ch, x0:x0 + cw]
+            if cw <= w and ch <= h:  # mxlint: disable=HB01 -- host-side eager Block
+                x0 = _np.random.randint(0, w - cw + 1)  # mxlint: disable=HB05 -- host-side eager Block
+                y0 = _np.random.randint(0, h - ch + 1)  # mxlint: disable=HB05 -- host-side eager Block
+                crop = np_x[y0:y0 + ch, x0:x0 + cw]  # mxlint: disable=HB03 -- host-side eager Block
                 return array(_resize_np(crop, self._size))
         return array(_resize_np(np_x, self._size))
 
@@ -167,7 +167,7 @@ class RandomResizedCrop(Block):
 class RandomFlipLeftRight(Block):
     def forward(self, x):
         np_x = _to_np(x)
-        if _np.random.rand() < 0.5:
+        if _np.random.rand() < 0.5:  # mxlint: disable=HB01,HB05 -- host-side eager Block
             np_x = np_x[:, ::-1].copy()
         return array(np_x)
 
@@ -175,7 +175,7 @@ class RandomFlipLeftRight(Block):
 class RandomFlipTopBottom(Block):
     def forward(self, x):
         np_x = _to_np(x)
-        if _np.random.rand() < 0.5:
+        if _np.random.rand() < 0.5:  # mxlint: disable=HB01,HB05 -- host-side eager Block
             np_x = np_x[::-1].copy()
         return array(np_x)
 
@@ -189,7 +189,7 @@ class RandomBrightness(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
-        alpha = 1.0 + _np.random.uniform(-self._b, self._b)
+        alpha = 1.0 + _np.random.uniform(-self._b, self._b)  # mxlint: disable=HB05 -- host-side eager Block
         return array(np_x * alpha)
 
 
@@ -211,7 +211,7 @@ class RandomContrast(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
-        alpha = 1.0 + _np.random.uniform(-self._c, self._c)
+        alpha = 1.0 + _np.random.uniform(-self._c, self._c)  # mxlint: disable=HB05 -- host-side eager Block
         # reference blends with the LUMINANCE mean (image.random_contrast),
         # not the unweighted channel mean
         gray = (np_x * _GRAY_COEF).sum(axis=-1).mean()
@@ -227,7 +227,7 @@ class RandomSaturation(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
-        alpha = 1.0 + _np.random.uniform(-self._s, self._s)
+        alpha = 1.0 + _np.random.uniform(-self._s, self._s)  # mxlint: disable=HB05 -- host-side eager Block
         gray = (np_x * _GRAY_COEF).sum(axis=-1, keepdims=True)
         return array(np_x * alpha + gray * (1.0 - alpha))
 
@@ -241,7 +241,7 @@ class RandomHue(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
-        alpha = _np.random.uniform(-self._h, self._h) * _np.pi
+        alpha = _np.random.uniform(-self._h, self._h) * _np.pi  # mxlint: disable=HB05 -- host-side eager Block
         u, w = _np.cos(alpha), _np.sin(alpha)
         rot = _np.array([[1.0, 0.0, 0.0],
                          [0.0, u, -w],
@@ -267,7 +267,7 @@ class RandomColorJitter(Block):
             self._ts.append(RandomHue(hue))
 
     def forward(self, x):
-        order = _np.random.permutation(len(self._ts))
+        order = _np.random.permutation(len(self._ts))  # mxlint: disable=HB05 -- host-side eager Block
         for i in order:
             x = self._ts[int(i)](x)
         return x
@@ -287,6 +287,6 @@ class RandomLighting(Block):
 
     def forward(self, x):
         np_x = _to_np(x).astype(_np.float32)
-        a = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)
+        a = _np.random.normal(0, self._alpha, size=(3,)).astype(_np.float32)  # mxlint: disable=HB05 -- host-side eager Block
         rgb = (self._EIGVEC * a * self._EIGVAL).sum(axis=1)
         return array(np_x + rgb)

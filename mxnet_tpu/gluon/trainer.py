@@ -10,6 +10,9 @@ parameter (or one fused multi-tensor update via `fuse=True`).
 """
 from __future__ import annotations
 
+import os
+import warnings
+
 import jax
 import jax.numpy as jnp
 
@@ -19,6 +22,72 @@ from .. import optimizer as opt
 from .parameter import ParameterDict, Parameter
 
 __all__ = ["Trainer"]
+
+
+def _fused_adapter(optimizer):
+    """(kernel_name, hyper, pack, unpack) bridging an eager Optimizer's
+    state containers to the functional ``optimizer.fused_rule`` kernels,
+    for the donated-jit step path; ``None`` -> optimizer not supported
+    (eager per-param path runs instead).
+
+    ``pack(i, state)`` builds the kernel-format pytree from the eager
+    state WITHOUT copying (same underlying jax arrays); ``unpack(i,
+    state, new_state)`` writes the kernel's outputs back into the eager
+    containers so ``save_states``/``load_states`` keep working
+    unchanged.
+    """
+    from .. import optimizer as opt_mod
+    t = type(optimizer)
+    if t in (opt_mod.SGD, opt_mod.NAG):
+        mom = optimizer.momentum
+
+        def pack(i, s):
+            return {"mom": s.data} if mom else {}
+
+        def unpack(i, s, ns):
+            if mom:
+                s._set_data(ns["mom"])
+        name = "nag" if t is opt_mod.NAG else "sgd"
+        return name, {"momentum": mom}, pack, unpack
+    if t in (opt_mod.Adam, opt_mod.AdamW):
+        def pack(i, s):
+            mean, var = s
+            return {"m": mean.data, "v": var.data}
+
+        def unpack(i, s, ns):
+            mean, var = s
+            mean._set_data(ns["m"])
+            var._set_data(ns["v"])
+        name = "adamw" if t is opt_mod.AdamW else "adam"
+        return (name, {"beta1": optimizer.beta1, "beta2": optimizer.beta2,
+                       "epsilon": optimizer.epsilon}, pack, unpack)
+    return None
+
+
+def _fused_aux(optimizer):
+    """Per-param host scalar the kernel needs beyond (p, g, s, lr, wd):
+    Adam's bias-correction step count (eager Adam passes t-1 and the
+    kernel increments — see Adam.update).  Shipped stacked in ONE device
+    vector and injected as state key ``aux_key`` inside the trace."""
+    from .. import optimizer as opt_mod
+    if type(optimizer) in (opt_mod.Adam, opt_mod.AdamW):
+        return "t", lambda i: optimizer._index_update_count[i] - 1
+    return None, None
+
+
+def _state_shape_ok(optimizer, state):
+    """Phase-1 sanity check that an EXISTING eager state matches what the
+    adapter's pack() expects (a loaded/custom state in another layout
+    falls back to the exact eager path instead of crashing)."""
+    from .. import optimizer as opt_mod
+    t = type(optimizer)
+    if t in (opt_mod.SGD, opt_mod.NAG):
+        return (state is None) == (optimizer.momentum == 0.0) and \
+            (state is None or isinstance(state, NDArray))
+    if t in (opt_mod.Adam, opt_mod.AdamW):
+        return isinstance(state, tuple) and len(state) == 2 and \
+            all(isinstance(x, NDArray) for x in state)
+    return False
 
 
 class Trainer:
@@ -48,6 +117,7 @@ class Trainer:
         self._kv_initialized = False
         self._states = {}
         self._update_on_kvstore = update_on_kvstore
+        self._fused_jit_cache = {}
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -133,6 +203,115 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
+    def _get_fused_jit(self, apply_fn, aux_key, key):
+        """ONE donated XLA program updating the whole parameter group:
+        old params and optimizer state are donated (buffers reused for
+        the outputs — no per-step param copy), and XLA fuses the N
+        elementwise update chains into one launch.  lr/wd/aux/rescale
+        enter as device arrays so hyperparameter and step-count changes
+        never retrace."""
+        jitted = self._fused_jit_cache.get(key)
+        if jitted is None:
+            def group_update(params, grads, states, lr_vec, wd_vec,
+                             aux_vec, rescale):
+                # lr/wd/aux arrive stacked in ONE device array each (one
+                # H2D per step however many params there are); the
+                # per-param slice is a traced op inside the program
+                new_ps, new_ss = [], []
+                for j, (p, g, s) in enumerate(zip(params, grads,
+                                                  states)):
+                    g = g * rescale.astype(g.dtype)
+                    if aux_key is not None:
+                        s = dict(s)
+                        s[aux_key] = aux_vec[j]
+                    # scalars cast to the param dtype: the eager path's
+                    # python floats promote WEAKLY (bf16 params stay
+                    # bf16); strong f32 scalars would widen them
+                    np_, ns = apply_fn(p, g, s,
+                                       lr_vec[j].astype(p.dtype),
+                                       wd_vec[j].astype(p.dtype))
+                    new_ps.append(np_)
+                    new_ss.append(ns)
+                return new_ps, new_ss
+            jitted = jax.jit(group_update, donate_argnums=(0, 2))
+            self._fused_jit_cache[key] = jitted
+        return jitted
+
+    def _fused_jit_update(self, ignore_stale_grad):
+        """Fused, jitted, donated update for the whole parameter group
+        (the Trainer-side half of the overlapped-pipeline tentpole; the
+        fully fused fwd/bwd/update lives in parallel.DataParallelTrainer).
+        Falls back (returns False) for optimizers without a functional
+        kernel, sparse/accumulating grads, multi-precision, or
+        unexpected loaded state layouts — the exact eager path then
+        runs.  Disable with MXTPU_FUSED_STEP=0."""
+        from ..ndarray import sparse as _sp
+        optimizer = self._optimizer
+        if os.environ.get("MXTPU_FUSED_STEP", "1") == "0" or \
+                optimizer.multi_precision:
+            return False
+        adapter = _fused_adapter(optimizer)
+        if adapter is None:
+            return False
+        name, hyper, pack, unpack = adapter
+        # phase 1: qualification only — nothing is mutated, so bailing
+        # to the per-param path cannot double-count updates
+        idxs, params = [], []
+        for i, param in enumerate(self._params):
+            if param.grad_req == "null" or param._data is None:
+                continue
+            if param._data._grad is None or not param._data._grad_fresh:
+                if ignore_stale_grad:
+                    continue
+                return False      # per-param path raises the right error
+            if param.grad_req == "add" or \
+                    isinstance(param._data._grad, _sp.RowSparseNDArray):
+                return False      # sparse/accumulating grads: exact path
+            if i in self._states and \
+                    not _state_shape_ok(optimizer, self._states[i]):
+                return False      # foreign state layout: exact path
+            idxs.append(i)
+            params.append(param)
+        if not idxs:
+            return True
+        # phase 2: commit — counters/lr/wd evaluated once per param
+        # (identical bookkeeping to the eager loop), then one jit call
+        for i in idxs:
+            optimizer._update_count(i)
+            if i not in self._states:
+                self._states[i] = optimizer.create_state_multi_precision(
+                    i, self._params[i].data())
+        lr_vec = jnp.asarray([optimizer._get_lr(i) for i in idxs],
+                             jnp.float32)
+        wd_vec = jnp.asarray([optimizer._get_wd(i) for i in idxs],
+                             jnp.float32)
+        aux_key, aux_fn = _fused_aux(optimizer)
+        aux_vec = jnp.asarray(
+            [aux_fn(i) for i in idxs] if aux_fn else [0] * len(idxs),
+            jnp.int32)
+        pvals = [p._data._data for p in params]
+        gvals = [p._data._grad for p in params]
+        svals = [pack(i, self._states[i]) for i in idxs]
+        key = (name, tuple(sorted(hyper.items())),
+               optimizer.clip_gradient, aux_key,
+               tuple((v.shape, str(v.dtype)) for v in pvals),
+               tuple(tuple(sorted(s)) for s in svals))
+        _, apply_fn = opt.fused_rule(
+            name, clip_gradient=optimizer.clip_gradient, **hyper)
+        jitted = self._get_fused_jit(apply_fn, aux_key, key)
+        rescale = jnp.asarray(optimizer.rescale_grad, jnp.float32)
+        with warnings.catch_warnings():
+            # donation is a TPU/GPU optimization; CPU ignores it with a
+            # UserWarning that would spam every step
+            warnings.filterwarnings("ignore", message=".*[Dd]onat")
+            new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec, wd_vec,
+                                    aux_vec, rescale)
+        for i, param, np_, ns in zip(idxs, params, new_ps, new_ss):
+            param._data._set_data(np_)
+            unpack(i, self._states[i], ns)
+            param._data._grad_fresh = False
+        return True
+
     def _fused_group_update(self, ignore_stale_grad):
         """ONE multi-tensor op for the whole parameter group (reference
         multi_sgd_mom_update, src/operator/optimizer_op.cc): collapses N
@@ -190,6 +369,8 @@ class Trainer:
         return True
 
     def _update(self, ignore_stale_grad=False):
+        if self._fused_jit_update(ignore_stale_grad):
+            return
         if self._fused_group_update(ignore_stale_grad):
             return
         for i, param in enumerate(self._params):

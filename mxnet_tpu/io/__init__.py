@@ -1,19 +1,25 @@
-"""``mx.io`` — legacy DataIter API.
+"""``mx.io`` — data iterators + the overlapped input pipeline.
 
 Reference: python/mxnet/io/ (NDArrayIter, CSVIter, ImageRecordIter wrapper,
 DataBatch, DataDesc) — SURVEY.md §2.2 "mx.io". Used by the Module API and
 reference example scripts.
+
+The pipeline layer (``io/prefetch.py``: :class:`DevicePrefetcher`,
+:class:`AsyncDecodeIter`) overlaps host decode, H2D transfer, and device
+compute — see docs/INPUT_PIPELINE.md.
 """
 from __future__ import annotations
 
 import numpy as _np
 
-from .base import MXNetError
-from .ndarray.ndarray import NDArray, array, concatenate
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array, concatenate
+from .prefetch import DevicePrefetcher, AsyncDecodeIter, PipelineStats
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter",
-           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter"]
+           "ResizeIter", "PrefetchingIter", "ImageRecordIter", "MNISTIter",
+           "DevicePrefetcher", "AsyncDecodeIter", "PipelineStats"]
 
 
 class DataDesc:
@@ -243,8 +249,8 @@ class LibSVMIter(DataIter):
                 self._indices[start:stop], self._labels[lo:hi])
 
     def next(self):
-        from .ndarray.sparse import CSRNDArray
-        from .ndarray import array as _nd_array
+        from ..ndarray.sparse import CSRNDArray
+        from ..ndarray import array as _nd_array
         if self._cursor >= self._n:
             raise StopIteration
         lo = self._cursor
@@ -306,31 +312,43 @@ class ResizeIter(DataIter):
 
 
 class PrefetchingIter(DataIter):
-    """Thread-prefetch wrapper (reference io.PrefetchingIter)."""
+    """Thread-prefetch wrapper (reference io.PrefetchingIter).
+
+    Backed by :class:`DevicePrefetcher` in host-only mode: a worker
+    thread pulls batch N+1 from the backing iter while the consumer
+    holds batch N (the reference's iter_prefetcher.h double buffer).
+    """
 
     def __init__(self, iters, rename_data=None, rename_label=None):
-        import threading
-        import queue
         if not isinstance(iters, (list, tuple)):
             iters = [iters]
         assert len(iters) == 1, "only one backing iter supported"
         self.iter = iters[0]
         super().__init__(self.iter.batch_size)
-        self._queue = queue.Queue(maxsize=2)
-        self._thread = None
+        self._pf = DevicePrefetcher(self.iter, depth=2, to_device=False)
 
     def reset(self):
-        self.iter.reset()
+        self._pf.reset()
 
     def __iter__(self):
-        for batch in self.iter:
-            yield batch
+        return self
+
+    def __next__(self):
+        return self._pf.next()
 
     def next(self):
-        return self.iter.next()
+        return self._pf.next()
 
-    def iter_next(self):
-        return self.iter.iter_next()
+    def close(self):
+        self._pf.close()
+
+    @property
+    def provide_data(self):
+        return self.iter.provide_data
+
+    @property
+    def provide_label(self):
+        return self.iter.provide_label
 
 
 class ImageRecordIter(DataIter):
@@ -344,8 +362,8 @@ class ImageRecordIter(DataIter):
                  mean_r=0.0, mean_g=0.0, mean_b=0.0, std_r=1.0, std_g=1.0,
                  std_b=1.0, preprocess_threads=4, path_imgidx=None, **kwargs):
         super().__init__(batch_size)
-        from . import recordio
-        from .gluon.data.dataset import RecordFileDataset
+        from .. import recordio
+        from ..gluon.data.dataset import RecordFileDataset
         self._dataset = RecordFileDataset(path_imgrec)
         self._data_shape = tuple(data_shape)
         self._shuffle = shuffle
@@ -359,10 +377,11 @@ class ImageRecordIter(DataIter):
         self._n_threads = preprocess_threads
         # Native C++ decode+prefetch pipeline (src/prefetch.cc) when the
         # library is built and the target shape is square RGB.
-        from .utils import native as _native
+        from ..utils import native as _native
         c, h, w = self._data_shape
         self._use_native = (_native.available() and c == 3 and h == w)
         self._native_iter = None
+        self._async_iter = None   # pure-Python threaded decode fan-out
         self.reset()
 
     @property
@@ -378,7 +397,7 @@ class ImageRecordIter(DataIter):
         if self._shuffle:
             _np.random.shuffle(self._order)
         if self._use_native:
-            from .utils import native as _native
+            from ..utils import native as _native
             if self._native_iter is None:
                 self._native_iter = _native.NativePrefetcher(
                     self._path_imgrec, self._order, self.batch_size,
@@ -386,9 +405,32 @@ class ImageRecordIter(DataIter):
                     edge=self._data_shape[1], label_width=self._label_width)
             else:  # reuse the open mmap'd reader; just reschedule
                 self._native_iter.reset(self._order)
+        else:
+            self._reset_async()
+
+    def _reset_async(self):
+        """(Re)build the threaded decode fan-out for the pure-Python path
+        so ``preprocess_threads`` is actually honored (it used to be
+        accepted and ignored here — the bench's ``decode_threads: 1``).
+        Determinism mode keeps decode synchronous: per-sample host RNG
+        (rand_mirror) draws must happen in a fixed order."""
+        from .. import debug as _debug
+        if self._async_iter is not None:
+            self._async_iter.close()
+            self._async_iter = None
+        if self._n_threads > 1 and not _debug.determinism_enabled():
+            self._async_iter = AsyncDecodeIter(
+                self._decode_sample, self._order, self.batch_size,
+                n_workers=self._n_threads, lookahead=2)
 
     def iter_next(self):
         return self._pos + self.batch_size <= len(self._dataset)
+
+    def close(self):
+        """Shut down the threaded decode fan-out (no leaked workers)."""
+        if self._async_iter is not None:
+            self._async_iter.close()
+            self._async_iter = None
 
     def _next_native(self):
         batch, labels = next(self._native_iter)  # raises StopIteration at end
@@ -403,29 +445,39 @@ class ImageRecordIter(DataIter):
         lab = labels[:, 0] if self._label_width == 1 else labels
         return DataBatch(data=[array(img)], label=[array(lab)], pad=0)
 
+    def _decode_sample(self, ds_idx):
+        """Decode + preprocess ONE record (thread-safe: recordio readers
+        hand out per-thread file handles, cv2/PIL decode releases the
+        GIL).  Same preprocessing as the native pipeline
+        (src/prefetch.cc): short-side resize then center crop to exactly
+        (h, w)."""
+        from .. import recordio, image
+        rec = self._dataset[int(ds_idx)]
+        header, img_bytes = recordio.unpack(rec)
+        img = image.imdecode(img_bytes)
+        c, h, w = self._data_shape
+        img = image.resize_short(img, min(h, w))
+        img, _ = image.center_crop(img, (w, h))
+        img = img.asnumpy().astype("float32").transpose(2, 0, 1)
+        if self._rand_mirror and _np.random.rand() < 0.5:
+            img = img[:, :, ::-1]
+        img = (img - self._mean) / self._std
+        label = header.label
+        return img, float(label if _np.isscalar(label) else label[0])
+
     def next(self):
-        from . import recordio, image
         if not self.iter_next():
             raise StopIteration
         if self._use_native:
             return self._next_native()
-        datas, labels = [], []
-        for i in range(self._pos, self._pos + self.batch_size):
-            rec = self._dataset[self._order[i]]
-            header, img_bytes = recordio.unpack(rec)
-            img = image.imdecode(img_bytes)
-            # Same preprocessing as the native pipeline (src/prefetch.cc):
-            # short-side resize then center crop to exactly (h, w).
-            c, h, w = self._data_shape
-            img = image.resize_short(img, min(h, w))
-            img, _ = image.center_crop(img, (w, h))
-            img = img.asnumpy().astype("float32").transpose(2, 0, 1)
-            if self._rand_mirror and _np.random.rand() < 0.5:
-                img = img[:, :, ::-1]
-            img = (img - self._mean) / self._std
-            datas.append(img)
-            label = header.label
-            labels.append(float(label if _np.isscalar(label) else label[0]))
+        if self._async_iter is not None:
+            samples = next(self._async_iter)   # in-order batch
+        else:
+            samples = [self._decode_sample(self._order[i])
+                       for i in range(self._pos,
+                                      self._pos + self.batch_size)]
+        datas = [img for img, _ in samples]
+        labels = [lab for _, lab in samples]
         self._pos += self.batch_size
         return DataBatch(data=[array(_np.stack(datas))],
                          label=[array(_np.asarray(labels))], pad=0)
@@ -436,7 +488,7 @@ class MNISTIter(NDArrayIter):
 
     def __init__(self, image=None, label=None, batch_size=128, shuffle=True,
                  flat=False, **kwargs):
-        from .gluon.data.vision.datasets import MNIST
+        from ..gluon.data.vision.datasets import MNIST
         train = image is None or "train" in str(image)
         ds = MNIST(train=train)
         data = ds._data.asnumpy().transpose(0, 3, 1, 2)

@@ -1,0 +1,461 @@
+"""Overlapped input pipeline: decode -> H2D -> compute run concurrently.
+
+The MLPerf TPU-pod lesson (PAPERS.md: Kumar et al. on MLPerf-0.6 TPU-v3
+pods and "Exploring the limits of Concurrency in ML Training on Google
+TPUs"): at pod scale the step time is set by whichever of {host decode,
+H2D transfer, device compute} is slowest — *if* they are pipelined.  Run
+serially they add up.  This module provides the two pipeline stages the
+reference framework ran inside its C++ engine (iter_prefetcher.h +
+threaded decode pool):
+
+``AsyncDecodeIter``
+    fans a per-sample decode function out over a thread pool and yields
+    in-order batches — the host-side stage.  JPEG decode in cv2/PIL
+    releases the GIL, so threads scale to the core count.
+
+``DevicePrefetcher``
+    double-buffers batches onto the device: a background thread
+    ``jax.device_put``s batch N+1 (onto the active ``parallel`` mesh's
+    data sharding when one is present) and *blocks on the transfer in
+    the worker* while the consumer's step computes on batch N.  The
+    consumer always receives device-resident arrays.
+
+Both record per-stage wall time (decode / H2D / consumer compute /
+consumer stall) in a ``PipelineStats`` so ``bench.py`` can report the
+``input_pipeline`` block with an ``overlap_efficiency`` figure, and both
+emit ``mx.profiler`` spans (``pipeline:decode`` / ``pipeline:h2d`` /
+``pipeline:stall``) while a profile is running.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import queue as _queue
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray
+
+__all__ = ["DevicePrefetcher", "AsyncDecodeIter", "PipelineStats"]
+
+
+class PipelineStats:
+    """Wall-time accumulator for the pipeline stages.
+
+    ``decode`` / ``h2d`` are measured in the producer thread, ``compute``
+    / ``stall`` in the consumer thread; because the stages overlap, the
+    stage totals may legitimately sum to more than the elapsed wall
+    time — that surplus *is* the overlap.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.decode_s = 0.0
+        self.h2d_s = 0.0
+        self.compute_s = 0.0
+        self.stall_s = 0.0
+        self.batches = 0
+        self.h2d_bytes = 0
+
+    def add(self, stage, dt, nbytes=0):
+        with self._lock:
+            setattr(self, stage + "_s", getattr(self, stage + "_s") + dt)
+            if stage == "h2d":
+                self.h2d_bytes += nbytes
+                self.batches += 1
+
+    def summary(self):
+        """Per-stage ms/batch plus ``overlap_efficiency`` — the fraction
+        of consumer wall time spent computing rather than stalled
+        waiting for input (1.0 = input pipeline fully hidden)."""
+        n = max(self.batches, 1)
+        busy = self.compute_s + self.stall_s
+        out = {
+            "batches": self.batches,
+            "decode_ms_per_batch": round(self.decode_s / n * 1e3, 2),
+            "h2d_ms_per_batch": round(self.h2d_s / n * 1e3, 2),
+            "compute_ms_per_batch": round(self.compute_s / n * 1e3, 2),
+            "stall_ms_per_batch": round(self.stall_s / n * 1e3, 2),
+            "overlap_efficiency": round(self.compute_s / busy, 4)
+            if busy > 0 else None,
+        }
+        if self.h2d_bytes and self.h2d_s > 0:
+            out["h2d_gb_s"] = round(self.h2d_bytes / self.h2d_s / 1e9, 2)
+        return out
+
+
+def _profiler_span(name, t0, t1):
+    from .. import profiler
+    profiler.record_span(name, t0, t1)
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetcher
+# ---------------------------------------------------------------------------
+
+class _EndOfStream:
+    pass
+
+
+class _WorkerFailure:
+    def __init__(self, exc):
+        self.exc = exc
+
+
+_END = _EndOfStream()
+
+
+class DevicePrefetcher:
+    """Iterator wrapper that stages batches onto the device ahead of use.
+
+    A background thread pulls batch N+1 from ``source``, ``device_put``s
+    every array leaf (sharded over the mesh data axis when a mesh is
+    given or a ``parallel.mesh_scope`` is active) and *blocks on the
+    transfer in the worker thread*, so by the time the consumer asks for
+    it the batch is already device-resident.  With ``depth=2`` this is
+    classic double buffering: H2D of batch N+1 overlaps compute of N.
+
+    ``source`` may yield ``io.DataBatch``es, (nested) tuples/lists of
+    arrays, or single arrays; leaves may be numpy arrays, NDArrays, or
+    jax arrays.  Structure is preserved; array leaves come back as
+    device-resident :class:`NDArray`.
+
+    Contract (tested under ``JAX_PLATFORMS=cpu``):
+
+    * batches arrive in source order;
+    * ``StopIteration`` propagates when the source is exhausted (and
+      keeps raising on further calls);
+    * an exception raised by the source or the transfer surfaces in the
+      consumer at the position it occurred;
+    * after exhaustion/close() the worker thread is joined — no leaked
+      threads.
+    """
+
+    def __init__(self, source, depth=2, mesh=None, sharding=None,
+                 batch_axis=0, data_axis=None, timeout=600.0,
+                 to_device=True):
+        if depth < 1:
+            raise MXNetError("DevicePrefetcher: depth must be >= 1")
+        self._source = source
+        self._depth = depth
+        self._timeout = timeout
+        self._batch_axis = batch_axis
+        self._sharding = sharding
+        self._to_device = to_device
+        if mesh is None and sharding is None and to_device:
+            from ..parallel.mesh import current_mesh
+            mesh = current_mesh()
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self.stats = PipelineStats()
+        self._queue = None
+        self._thread = None
+        self._stop = threading.Event()
+        self._finished = False
+        self._last_yield = None
+
+    # -- sharding -------------------------------------------------------
+    def _leaf_sharding(self, x):
+        if self._sharding is not None:
+            return self._sharding(x) if callable(self._sharding) \
+                else self._sharding
+        if self._mesh is None:
+            return None
+        from ..parallel.mesh import batch_sharding
+        return batch_sharding(self._mesh, getattr(x, "ndim", 0),
+                              batch_axis=self._batch_axis,
+                              data_axis=self._data_axis)
+
+    def _put_leaf(self, x):
+        import jax
+        raw = x.data if isinstance(x, NDArray) else x
+        if not hasattr(raw, "ndim"):       # scalars, bucket keys, ...
+            return x
+        sharding = self._leaf_sharding(raw)
+        if sharding is None:
+            dev = jax.device_put(raw)
+        else:
+            dev = jax.device_put(raw, sharding)
+        return NDArray(dev)
+
+    def _nbytes(self, x):
+        raw = x.data if isinstance(x, NDArray) else x
+        return getattr(raw, "nbytes", 0)
+
+    def _transfer(self, item):
+        if not self._to_device:
+            # host-only prefetch (legacy io.PrefetchingIter semantics):
+            # the worker's time-in-source is still the decode stat
+            return item, 0
+        from . import DataBatch
+
+        def rec(obj):
+            if isinstance(obj, DataBatch):
+                return DataBatch(
+                    data=None if obj.data is None else
+                    [self._put_leaf(d) for d in obj.data],
+                    label=None if obj.label is None else
+                    [self._put_leaf(l) for l in obj.label],
+                    pad=obj.pad, index=obj.index,
+                    bucket_key=obj.bucket_key,
+                    provide_data=obj.provide_data,
+                    provide_label=obj.provide_label)
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(rec(o) for o in obj)
+            return self._put_leaf(obj)
+
+        def leaves(obj):
+            if isinstance(obj, DataBatch):
+                for part in (obj.data or []) + (obj.label or []):
+                    yield part
+            elif isinstance(obj, (list, tuple)):
+                for o in obj:
+                    yield from leaves(o)
+            else:
+                yield obj
+
+        nbytes = sum(self._nbytes(l) for l in leaves(item))
+        out = rec(item)
+        # block in THIS (worker) thread: the consumer must never pay the
+        # transfer latency, and the timing below stays honest
+        for leaf in leaves(out):
+            if isinstance(leaf, NDArray):
+                try:
+                    leaf.data.block_until_ready()
+                except AttributeError:
+                    pass
+        return out, nbytes
+
+    # -- worker ---------------------------------------------------------
+    def _enqueue(self, item):
+        """put() that stays responsive to stop(); False if stopping."""
+        while not self._stop.is_set():
+            try:
+                self._queue.put(item, timeout=0.05)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _worker(self):
+        try:
+            it = iter(self._source)
+        except Exception as e:  # noqa: BLE001 — surface in consumer
+            self._enqueue(_WorkerFailure(e))
+            return
+        while not self._stop.is_set():
+            t0 = time.perf_counter()
+            try:
+                item = next(it)
+            except StopIteration:
+                self._enqueue(_END)
+                return
+            except Exception as e:  # noqa: BLE001 — surface in consumer
+                self._enqueue(_WorkerFailure(e))
+                return
+            t1 = time.perf_counter()
+            try:
+                dev_item, nbytes = self._transfer(item)
+            except Exception as e:  # noqa: BLE001 — surface in consumer
+                self._enqueue(_WorkerFailure(e))
+                return
+            t2 = time.perf_counter()
+            self.stats.add("decode", t1 - t0)
+            self.stats.add("h2d", t2 - t1, nbytes)
+            _profiler_span("pipeline:decode", t0, t1)
+            _profiler_span("pipeline:h2d", t1, t2)
+            if not self._enqueue((dev_item,)):
+                return
+
+    def _ensure_started(self):
+        if self._thread is None and not self._finished:
+            self._queue = _queue.Queue(maxsize=self._depth)
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._worker, name="mxtpu-device-prefetch",
+                daemon=True)
+            self._thread.start()
+
+    # -- consumer -------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._finished:
+            raise StopIteration
+        self._ensure_started()
+        now = time.perf_counter()
+        if self._last_yield is not None:
+            self.stats.add("compute", now - self._last_yield)
+        try:
+            got = self._queue.get(timeout=self._timeout)
+        except _queue.Empty:
+            self.close()
+            raise MXNetError(
+                f"DevicePrefetcher: no batch after {self._timeout}s "
+                f"(worker stalled or source hung)")
+        t_got = time.perf_counter()
+        self.stats.add("stall", t_got - now)
+        _profiler_span("pipeline:stall", now, t_got)
+        if got is _END:
+            self._shutdown()
+            raise StopIteration
+        if isinstance(got, _WorkerFailure):
+            self._shutdown()
+            raise got.exc
+        self._last_yield = t_got
+        return got[0]
+
+    def next(self):
+        return self.__next__()
+
+    def __len__(self):
+        return len(self._source)
+
+    # -- lifecycle ------------------------------------------------------
+    def _shutdown(self):
+        self._finished = True
+        self._stop.set()
+        # unblock a worker stuck in put(); queue may hold device arrays
+        while self._queue is not None:
+            try:
+                self._queue.get_nowait()
+            except _queue.Empty:
+                break
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+
+    def close(self):
+        """Stop the worker and join it. Idempotent."""
+        self._shutdown()
+        close = getattr(self._source, "close", None)
+        if callable(close):
+            try:
+                close()
+            except Exception:  # noqa: BLE001 — best-effort source cleanup
+                pass
+
+    def reset(self):
+        """Restart from the source's beginning (source must support
+        ``reset``)."""
+        self._shutdown()
+        reset = getattr(self._source, "reset", None)
+        if callable(reset):
+            reset()
+        self._finished = False
+        self._last_yield = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self._stop.set()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
+
+
+# ---------------------------------------------------------------------------
+# AsyncDecodeIter
+# ---------------------------------------------------------------------------
+
+class AsyncDecodeIter:
+    """Fan per-sample decode out over ``n_workers`` threads, yield
+    in-order batches.
+
+    ``sample_fn(index)`` decodes one sample (any pickling-free value);
+    ``order`` is the index sequence; batches of ``batch_size`` samples
+    are submitted ``lookahead`` batches ahead of the consumer, so worker
+    threads decode batch N+1..N+lookahead while the consumer holds batch
+    N.  Sample-level parallelism *within* a batch comes for free from
+    the shared pool.
+
+    Exceptions raised by ``sample_fn`` surface at the consumer in batch
+    order; ``close()`` cancels pending work and shuts the pool down.
+    """
+
+    def __init__(self, sample_fn, order, batch_size, n_workers=4,
+                 lookahead=2, drop_last=True):
+        from concurrent.futures import ThreadPoolExecutor
+        if batch_size < 1:
+            raise MXNetError("AsyncDecodeIter: batch_size must be >= 1")
+        self._fn = sample_fn
+        order = list(order)
+        n = len(order) - (len(order) % batch_size if drop_last else 0)
+        self._plan = [order[i:i + batch_size]
+                      for i in range(0, n, batch_size)]
+        self._n_workers = max(1, int(n_workers))
+        self._lookahead = max(1, lookahead)
+        self._pool = ThreadPoolExecutor(
+            max_workers=self._n_workers,
+            thread_name_prefix="mxtpu-decode")
+        self._pending = []          # FIFO of [futures] per batch
+        self._next_submit = 0
+        self._closed = False
+        self.stats = PipelineStats()
+
+    def _fill(self):
+        while self._next_submit < len(self._plan) and \
+                len(self._pending) < self._lookahead:
+            futs = [self._pool.submit(self._fn, i)
+                    for i in self._plan[self._next_submit]]
+            self._pending.append(futs)
+            self._next_submit += 1
+
+    def __iter__(self):
+        return self
+
+    def __len__(self):
+        return len(self._plan)
+
+    def __next__(self):
+        if self._closed:
+            raise StopIteration
+        self._fill()
+        if not self._pending:
+            self.close()
+            raise StopIteration
+        futs = self._pending.pop(0)
+        t0 = time.perf_counter()
+        try:
+            results = [f.result() for f in futs]
+        except BaseException:
+            self.close()
+            raise
+        t1 = time.perf_counter()
+        self.stats.add("decode", t1 - t0)
+        _profiler_span("pipeline:decode-wait", t0, t1)
+        self._fill()       # keep the pool primed while consumer computes
+        return results
+
+    def next(self):
+        return self.__next__()
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        for futs in self._pending:
+            for f in futs:
+                f.cancel()
+        self._pending = []
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

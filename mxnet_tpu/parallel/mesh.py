@@ -17,7 +17,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "local_mesh", "distributed_init", "mesh_scope",
-           "current_mesh", "data_sharding", "replicate_sharding", "P"]
+           "current_mesh", "data_sharding", "replicate_sharding",
+           "batch_sharding", "P"]
 
 _STATE = threading.local()
 
@@ -114,3 +115,28 @@ def data_sharding(mesh, ndim, axis=0, data_axis="dp"):
 
 def replicate_sharding(mesh):
     return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh, ndim, batch_axis=0, data_axis=None):
+    """NamedSharding for an input-batch array of rank ``ndim``.
+
+    Splits the batch axis over the mesh's data axis; rank-1 arrays
+    (per-sample label vectors) always split on axis 0 whatever the
+    nominal ``batch_axis`` (same convention as
+    ``DataParallelTrainer._eff_bax``); scalars replicate.  ``data_axis``
+    defaults to ``'dp'`` when the mesh has one, else the first mesh
+    axis.  Used by ``io.DevicePrefetcher`` to land prefetched batches
+    directly on their step-time sharding — no device-side reshard when
+    the step consumes them.
+    """
+    if data_axis is None:
+        data_axis = "dp" if "dp" in mesh.axis_names else mesh.axis_names[0]
+    if ndim == 0:
+        return NamedSharding(mesh, P())
+    ax = batch_axis if ndim > 1 else 0
+    if ax >= ndim:
+        raise MXNetError(
+            f"batch axis {ax} out of range for rank-{ndim} array")
+    spec = [None] * ndim
+    spec[ax] = data_axis
+    return NamedSharding(mesh, P(*spec))

@@ -12,6 +12,7 @@ from __future__ import annotations
 import ctypes
 import os
 import struct
+import threading
 
 import numpy as _np
 
@@ -53,11 +54,41 @@ class MXRecordIO:
         else:
             raise MXNetError(f"Invalid flag {self.flag}")
         self.pid = os.getpid()
+        # per-thread read handles: seek+read pairs from concurrent decode
+        # workers (io.AsyncDecodeIter) must not race on one descriptor
+        self._tl = threading.local()
+        self._tl_handles = []
+        self._tl_lock = threading.Lock()
+
+    def _read_fid(self):
+        """File handle private to the calling thread (read mode only).
+
+        The creating thread keeps the original ``self.fid``; every other
+        thread gets its own lazily-opened descriptor, closed with the
+        reader."""
+        if self.writable:
+            return self.fid
+        fid = getattr(self._tl, "fid", None)
+        if fid is None or fid.closed:
+            if threading.current_thread() is threading.main_thread() and \
+                    self.fid is not None and not self.fid.closed:
+                fid = self.fid
+            else:
+                fid = open(self.uri, "rb")
+                with self._tl_lock:
+                    self._tl_handles.append(fid)
+            self._tl.fid = fid
+        return fid
 
     def close(self):
         if self.fid is not None and not self.fid.closed:
             self.fid.close()
         self.fid = None
+        with getattr(self, "_tl_lock", threading.Lock()):
+            for fid in getattr(self, "_tl_handles", []):
+                if not fid.closed:
+                    fid.close()
+            self._tl_handles = []
 
     def __del__(self):
         try:
@@ -68,6 +99,10 @@ class MXRecordIO:
     def __getstate__(self):
         d = dict(self.__dict__)
         d["fid"] = None
+        # thread-local handles cannot pickle; reopened lazily per thread
+        d.pop("_tl", None)
+        d.pop("_tl_handles", None)
+        d.pop("_tl_lock", None)
         return d
 
     def __setstate__(self, d):
@@ -92,29 +127,33 @@ class MXRecordIO:
 
     def read(self):
         assert not self.writable
-        header = self.fid.read(4)
+        return self._read_from(self.fid)
+
+    def _read_from(self, fid):
+        """Read one record from ``fid`` (any thread's handle)."""
+        header = fid.read(4)
         if len(header) < 4:
             return None
         (magic,) = _KMAGIC_STRUCT.unpack(header)
         if magic != _MAGIC:
-            raise MXNetError(f"RecordIO magic mismatch at {self.fid.tell()}")
-        (lrec,) = _LREC_STRUCT.unpack(self.fid.read(4))
+            raise MXNetError(f"RecordIO magic mismatch at {fid.tell()}")
+        (lrec,) = _LREC_STRUCT.unpack(fid.read(4))
         cflag, length = _decode_lrec(lrec)
-        buf = self.fid.read(length)
+        buf = fid.read(length)
         pad = (4 - length % 4) % 4
         if pad:
-            self.fid.read(pad)
+            fid.read(pad)
         if cflag != 0:
             # multi-part record: keep reading continuation parts
             parts = [buf]
             while cflag in (1, 2):
-                (magic,) = _KMAGIC_STRUCT.unpack(self.fid.read(4))
-                (lrec,) = _LREC_STRUCT.unpack(self.fid.read(4))
+                (magic,) = _KMAGIC_STRUCT.unpack(fid.read(4))
+                (lrec,) = _LREC_STRUCT.unpack(fid.read(4))
                 cflag, length = _decode_lrec(lrec)
-                parts.append(self.fid.read(length))
+                parts.append(fid.read(length))
                 pad = (4 - length % 4) % 4
                 if pad:
-                    self.fid.read(pad)
+                    fid.read(pad)
                 if cflag == 3:
                     break
             buf = b"".join(parts)
@@ -156,8 +195,11 @@ class MXIndexedRecordIO(MXRecordIO):
         self.fid.seek(self.idx[idx])
 
     def read_idx(self, idx):
-        self.seek(idx)
-        return self.read()
+        """Random read by key — safe to call from multiple threads
+        concurrently (each thread seeks its own handle)."""
+        fid = self._read_fid()
+        fid.seek(self.idx[idx])
+        return self._read_from(fid)
 
     def write_idx(self, idx, buf):
         key = self.key_type(idx)

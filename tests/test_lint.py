@@ -410,6 +410,18 @@ def test_cli_model_zoo_clean():
     assert r.returncode == 0, r.stdout + r.stderr
 
 
+def test_cli_whole_package_clean():
+    """Tier-1 lint gate (ISSUE 2 satellite): ``tools/mxlint.py
+    mxnet_tpu/`` over the ENTIRE package must exit 0, so any PR that
+    introduces a trace-safety violation anywhere in the framework fails
+    the suite — the PR-1 linter actually gates regressions now.
+    Intentional host-side code (eager data-pipeline Blocks) carries
+    per-line ``# mxlint: disable`` justifications instead of being
+    exempted wholesale."""
+    r = _run_cli(os.path.join(REPO, "mxnet_tpu"))
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 # ----------------------------------------------------------------------
 # runtime retrace detector (gluon/block.py CachedOp)
 # ----------------------------------------------------------------------

@@ -345,6 +345,9 @@ def test_nadam_update_cumulative_schedule():
         np.testing.assert_allclose(w.asnumpy(), [w_ref], rtol=1e-6)
 
 
+@pytest.mark.slow   # ~28 s (second-heaviest non-slow test): tier-1
+# headroom under the 870 s timeout; RNN-vs-torch parity still gates via
+# test_torch_rnn_consistency.py
 def test_fused_rnn_op_matches_gluon_layer():
     """nd.RNN (reference src/operator/rnn.cc packed-parameter fused op)
     must reproduce the gluon fused layer bit-for-bit when fed the same

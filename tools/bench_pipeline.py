@@ -74,7 +74,15 @@ class RecBatchFeeder:
         os.makedirs(os.path.dirname(rec_path), exist_ok=True)
         if not os.path.exists(rec_path + ".rec"):
             generate_rec(rec_path, n_images, edge=edge, classes=classes)
-        n_threads = n_threads or os.cpu_count() or 1
+        # decode-pool width: explicit arg > MXTPU_DECODE_THREADS env >
+        # one thread per host core (the ImageRecordIter
+        # preprocess_threads knob, wired through for the bench)
+        n_threads = n_threads or \
+            int(os.environ.get("MXTPU_DECODE_THREADS", "0")) or \
+            os.cpu_count() or 1
+        self.n_threads = n_threads
+        self.n_images = n_images
+        self.rec_path = rec_path
 
         pf = native.NativePrefetcher(
             rec_path + ".rec", np.arange(n_images), batch,
@@ -115,6 +123,28 @@ class RecBatchFeeder:
         sd = np.stack([b for b, _ in self._batches])
         sl = np.stack([l for _, l in self._batches]).astype(np.float32)
         return sd, sl
+
+    def stream(self, n_batches):
+        """Freshly-decoded (uint8 NHWC, f32 labels) batches, decode ON
+        the clock: feeds io.DevicePrefetcher for the overlapped-pipeline
+        measurement (decode runs in the C++ pool, H2D in the prefetch
+        worker, compute in the consumer — all concurrent).  Cycles the
+        .rec file until ``n_batches`` full batches were yielded."""
+        from mxnet_tpu.utils import native
+        left = n_batches
+        while left > 0:
+            pf = native.NativePrefetcher(
+                self.rec_path + ".rec", np.arange(self.n_images),
+                self.batch, n_threads=self.n_threads, mode="image",
+                edge=self.edge)
+            try:
+                for data_u8, labels in pf:
+                    if left <= 0 or len(data_u8) < self.batch:
+                        break
+                    yield data_u8, labels[:, 0].astype(np.float32)
+                    left -= 1
+            finally:
+                pf.close()
 
 
 def wrap_preproc(net):
