@@ -244,10 +244,18 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         net = wrap_preproc(net)
     net.initialize()
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    mesh = make_mesh({"dp": 1}, devices=jax.devices()[:1])
+    # MXTPU_BENCH_DP>1: time the ZeRO-1 sharded-sync pipeline over a dp
+    # mesh (reduce-scatter + sharded update + all-gather) and measure
+    # its collectives into the `comm` block; default stays the 1-chip
+    # per-device number the baseline tracks
+    dp = max(1, min(int(os.environ.get("MXTPU_BENCH_DP", "1")),
+                    len(jax.devices())))
+    if batch % dp:
+        dp = 1
+    mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
     trainer = DataParallelTrainer(net, loss_fn, "sgd",
                                   {"learning_rate": 0.1, "momentum": 0.9},
-                                  mesh=mesh)
+                                  mesh=mesh, shard_updates=dp > 1)
 
     if feeder is not None:
         # Real-data path: epoch uploaded once (timed), then per-step
@@ -317,6 +325,15 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
     }
     if feeder is not None:
         result["input_pipeline"] = feeder.stats
+    try:
+        # per-step `comm` block (parallel/zero.py schema): bytes on the
+        # wire, MEASURED collective ms + est ICI GB/s when the sharded
+        # pipeline runs (dp>1); zeros on CPU/dp=1 so the schema ships —
+        # and is regression-tested — everywhere (tests/test_bench_line.py)
+        result["comm"] = trainer.comm_stats(measure=dp > 1,
+                                            step_ms=dt / iters * 1e3)
+    except Exception as e:  # noqa: BLE001 — observability never voids the bench
+        result["comm"] = {"error": f"{type(e).__name__}: {e}"}
     import jax.numpy as jnp
     from mxnet_tpu.ndarray import random as _rnd
     jitted = jit_args = None
@@ -818,6 +835,18 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         err = str(result["error"])
         cands.append(("error",
                       err if len(err) <= 160 else err[:157] + "..."))
+    comm = result.get("comm") or {}
+    if comm.get("zero1"):
+        # sharded-sync evidence (zeros-only CPU blocks stay out of the
+        # budget; the full block always lands in .bench_full.json)
+        for name, key in (("comm_ms", "collective_ms"),
+                          ("comm_gb_s", "est_ici_gb_s"),
+                          ("comm_wire", "wire_dtype"),
+                          ("comm_mb_reduced", None)):
+            v = (round(comm.get("bytes_reduced_per_step", 0) / 1e6, 1)
+                 if key is None else comm.get(key))
+            if v is not None:
+                cands.append((name, v))
 
     def _num(d, *path):
         for p in path:

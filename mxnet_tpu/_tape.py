@@ -372,6 +372,7 @@ def _apply_grad_req(arr, g):
             # dense accumulator already exists (attach_grad default)
             arr._grad = prev.at[g.indices].add(g.values)
             arr._grad_fresh = True
+            arr._grad_reduced = False
             return
         g = g.dedup()
         arr._grad = RowSparseNDArray(g.values, g.indices, g.shape, arr._ctx)
@@ -384,3 +385,4 @@ def _apply_grad_req(arr, g):
     else:
         arr._grad = g
     arr._grad_fresh = True
+    arr._grad_reduced = False

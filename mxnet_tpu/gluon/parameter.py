@@ -192,6 +192,7 @@ class Parameter:
             else:
                 self._data._grad = jnp.zeros(self._data.shape,
                                              self._data.data.dtype)
+            self._data._grad_reduced = False   # new accumulation cycle
 
     def set_data(self, data):
         if isinstance(data, NDArray):

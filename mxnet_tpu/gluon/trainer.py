@@ -161,26 +161,13 @@ class Trainer:
         if self._kvstore is None or self._kvstore.num_workers <= 1 and \
                 type(self._kvstore).__name__ == "KVStoreLocal":
             return
-        # one batched pushpull: the dist store coalesces the list into
-        # BIGARRAY_BOUND-sized buckets — one wire round per bucket instead
-        # of one per tensor
-        keys, grads, params = [], [], []
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null" and param._data is not None and \
-                    param._data._grad is not None:
-                keys.append(i)
-                grads.append(param.grad())
-                params.append(param)
-        if keys:
-            self._kvstore.pushpull(keys, grads, out=grads)
-            for param, grad in zip(params, grads):
-                if grad.stype == "row_sparse":
-                    # keep the compressed pair — .data here would
-                    # materialize a vocab-sized dense grad and disable the
-                    # optimizer's lazy row update
-                    param._data._grad = grad
-                else:
-                    param._data._grad = grad.data
+        # ONE implementation shared with parallel.all_reduce_gradients
+        # (they used to be drifting copies): one batched pushpull, the
+        # dist store coalesces into BIGARRAY_BOUND buckets, and each
+        # accumulated gradient (grad_req='add') is reduced exactly once
+        # per cycle — allreduce_grads() then step() can't double-count.
+        from ..parallel.data_parallel import all_reduce_gradients
+        all_reduce_gradients(self._params, kvstore=self._kvstore)
 
     def allreduce_grads(self):
         if not self._kv_initialized:
@@ -203,15 +190,45 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._update(ignore_stale_grad)
 
-    def _get_fused_jit(self, apply_fn, aux_key, key):
+    def _sharded_update_mesh(self):
+        """Ambient dp mesh for weight-update sharding of the fused step
+        (arXiv:1909.09756 — MLPerf's TPU-pod trick): when training under
+        ``mesh_scope`` with a dp axis, the group update computes each
+        eligible parameter's new value on a 1/N shard per chip (with the
+        optimizer state living sharded) and all-gathers the result.
+        ``MXTPU_SHARDED_SYNC=0`` kills it; no mesh -> exact old path."""
+        from ..parallel.mesh import current_mesh
+        from ..parallel import zero as _zero
+        mesh = current_mesh()
+        if mesh is None or "dp" not in mesh.axis_names or \
+                mesh.shape["dp"] <= 1 or not _zero.sharded_sync_enabled():
+            return None
+        return mesh
+
+    def _get_fused_jit(self, apply_fn, aux_key, key, mesh=None):
         """ONE donated XLA program updating the whole parameter group:
         old params and optimizer state are donated (buffers reused for
         the outputs — no per-step param copy), and XLA fuses the N
         elementwise update chains into one launch.  lr/wd/aux/rescale
         enter as device arrays so hyperparameter and step-count changes
-        never retrace."""
+        never retrace.  With ``mesh`` (see :meth:`_sharded_update_mesh`)
+        the per-param update is sharded over 'dp' — XLA lowers the
+        grad feed into a slice per chip and all-gathers the fresh
+        params, the eager-trainer half of the ZeRO-1 pipeline."""
         jitted = self._fused_jit_cache.get(key)
         if jitted is None:
+            if mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                dp = mesh.shape["dp"]
+
+                def ws_spec(ndim):
+                    return NamedSharding(
+                        mesh, P(*(["dp"] + [None] * (ndim - 1))))
+
+                def shardable(x):
+                    return getattr(x, "ndim", 0) >= 1 and \
+                        x.shape[0] % dp == 0 and x.shape[0] >= dp
+
             def group_update(params, grads, states, lr_vec, wd_vec,
                              aux_vec, rescale):
                 # lr/wd/aux arrive stacked in ONE device array each (one
@@ -224,12 +241,27 @@ class Trainer:
                     if aux_key is not None:
                         s = dict(s)
                         s[aux_key] = aux_vec[j]
+                    sharded = mesh is not None and shardable(p)
+                    if sharded:
+                        p = jax.lax.with_sharding_constraint(
+                            p, ws_spec(p.ndim))
+                        g = jax.lax.with_sharding_constraint(
+                            g, ws_spec(g.ndim))
+                        s = {k: jax.lax.with_sharding_constraint(
+                                v, ws_spec(v.ndim)) if shardable(v) else v
+                             for k, v in s.items()}
                     # scalars cast to the param dtype: the eager path's
                     # python floats promote WEAKLY (bf16 params stay
                     # bf16); strong f32 scalars would widen them
                     np_, ns = apply_fn(p, g, s,
                                        lr_vec[j].astype(p.dtype),
                                        wd_vec[j].astype(p.dtype))
+                    if sharded:
+                        # all-gather the fresh params; state STAYS
+                        # sharded across steps (1/N optimizer HBM)
+                        np_ = jax.lax.with_sharding_constraint(
+                            np_, NamedSharding(
+                                mesh, P(*([None] * np_.ndim))))
                     new_ps.append(np_)
                     new_ss.append(ns)
                 return new_ps, new_ss
@@ -292,20 +324,61 @@ class Trainer:
         pvals = [p._data._data for p in params]
         gvals = [p._data._grad for p in params]
         svals = [pack(i, self._states[i]) for i in idxs]
+        mesh = self._sharded_update_mesh()
+        if mesh is not None:
+            # values committed off-mesh (fresh eager backward grads,
+            # first-step params/state) conflict with the in-program
+            # sharding constraints; re-place them replicated on the
+            # mesh.  Leaves already living on the mesh — params and the
+            # dp-sharded state after step 1 — pass through untouched, so
+            # the steady state pays one device_put for the grads only.
+            from jax.sharding import NamedSharding, PartitionSpec as _P
+            rep = NamedSharding(mesh, _P())
+
+            def _place(x):
+                sh = getattr(x, "sharding", None)
+                if isinstance(sh, NamedSharding) and sh.mesh == mesh:
+                    return x
+                return jax.device_put(x, rep)
+
+            orig_shardings = [v.sharding for v in pvals]
+            pvals = [_place(v) for v in pvals]
+            gvals = [_place(v) for v in gvals]
+            svals = [{k: _place(v) for k, v in s.items()} for s in svals]
         key = (name, tuple(sorted(hyper.items())),
                optimizer.clip_gradient, aux_key,
                tuple((v.shape, str(v.dtype)) for v in pvals),
-               tuple(tuple(sorted(s)) for s in svals))
+               tuple(tuple(sorted(s)) for s in svals),
+               None if mesh is None else tuple(sorted(mesh.shape.items())))
         _, apply_fn = opt.fused_rule(
             name, clip_gradient=optimizer.clip_gradient, **hyper)
-        jitted = self._get_fused_jit(apply_fn, aux_key, key)
+        jitted = self._get_fused_jit(apply_fn, aux_key, key, mesh=mesh)
         rescale = jnp.asarray(optimizer.rescale_grad, jnp.float32)
         with warnings.catch_warnings():
             # donation is a TPU/GPU optimization; CPU ignores it with a
             # UserWarning that would spam every step
             warnings.filterwarnings("ignore", message=".*[Dd]onat")
-            new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec, wd_vec,
-                                    aux_vec, rescale)
+            try:
+                new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
+                                        wd_vec, aux_vec, rescale)
+            except Exception:  # noqa: BLE001 — sharded lowering can fail
+                # (e.g. values committed to an incompatible device set);
+                # the replicated program is always valid. Lowering
+                # failures happen before buffers are donated.
+                if mesh is None:
+                    raise
+                jitted = self._get_fused_jit(apply_fn, aux_key,
+                                             key + ("replicated",))
+                new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
+                                        wd_vec, aux_vec, rescale)
+        if mesh is not None:
+            # fresh params return to their pre-update placement so the
+            # next eager forward never mixes device sets; only the
+            # optimizer state stays resident on the mesh (the 1/N HBM
+            # saving lives there, and it re-enters the next update
+            # without a transfer)
+            new_ps = [jax.device_put(v, sh)
+                      for v, sh in zip(new_ps, orig_shardings)]
         for i, param, np_, ns in zip(idxs, params, new_ps, new_ss):
             param._data._set_data(np_)
             unpack(i, self._states[i], ns)

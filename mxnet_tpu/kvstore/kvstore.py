@@ -38,6 +38,13 @@ from .. import optimizer as opt
 # ring all-reduce across the 'w' mesh axis (the O(payload) wire path)
 _sum_stacked = jax.jit(lambda x: jnp.sum(x, axis=0))
 
+# ONE compiled program per pushpull signature: reduces every key's
+# per-device copies in a single dispatch (the fused eager pushpull —
+# the old push-then-pull pair paid two; acknowledged perf cliff below)
+_fused_reduce = jax.jit(
+    lambda vss: [v[0] if len(v) == 1 else jnp.sum(jnp.stack(v), axis=0)
+                 for v in vss])
+
 __all__ = ["KVStore", "KVStoreLocal", "KVStoreTPUSync", "KVStoreDistTPUSync",
            "KVStoreDistAsync", "create"]
 
@@ -340,6 +347,61 @@ class KVStoreTPUSync(KVStoreLocal):
                 f"scope (shard_map the training step over the mesh, or "
                 f"set_data_axis() to your axis name)") from e
 
+    def pushpull_scatter(self, key, value, priority=0):
+        """Reduce-scatter-aware in-graph pushpull (ISSUE 3 tentpole):
+        called with *traced* values inside ``shard_map``, each chip
+        contributes its local gradient and receives only its 1/N
+        contiguous shard of the cross-chip SUM — ``lax.psum_scatter``
+        instead of the full ``psum``, half the ring wire bytes of an
+        all-reduce and the entry point for ZeRO-style sharded updates
+        (parallel/zero.py owns the bucketed pipeline; this is the
+        kvstore-facade spelling).  Values must be flat with length
+        divisible by the axis size.  The EAGER path is unchanged: no
+        mesh axis is bound outside a trace, so it falls back to the
+        fused full pushpull and returns the full reduced values.
+
+        Returns the shard (traced) / full value (eager) NDArray, or the
+        list of them for a key list."""
+        keys, values = self._canon(key, value)
+        if not _contains_tracer(values):
+            outs = [NDArray(jnp.zeros_like(_listify(v)[0].data))
+                    for v in values]
+            self.pushpull(key, value,
+                          out=outs if isinstance(key, (list, tuple))
+                          else outs[0], priority=priority)
+            return outs if isinstance(key, (list, tuple)) else outs[0]
+        if self._updater is not None:
+            raise MXNetError(
+                "update-on-kvstore is a host-side path; pushpull_scatter "
+                "supports updater=None only")
+        from ..ndarray.sparse import RowSparseNDArray
+        shards = []
+        for k, v in zip(keys, values):
+            if str(k) not in self._store:
+                raise MXNetError(
+                    f"key {k} not initialized (call init first)")
+            red = self._local_reduce(_listify(v))
+            if isinstance(red, RowSparseNDArray):
+                raise MXNetError(
+                    "row_sparse values are not supported on the in-graph "
+                    "reduce-scatter path; push them eagerly (outside jit)")
+            flat = jnp.ravel(red.data)
+            try:
+                shard = lax.psum_scatter(flat, self._data_axis, tiled=True)
+            except (NameError, AssertionError) as e:
+                raise MXNetError(
+                    f"pushpull_scatter requires a '{self._data_axis}' "
+                    f"mesh axis in scope (shard_map the step over the "
+                    f"mesh, or set_data_axis())") from e
+            except ValueError as e:
+                raise MXNetError(
+                    f"pushpull_scatter: key {k} has {flat.shape[0]} "
+                    f"elements, not divisible by the "
+                    f"'{self._data_axis}' axis size (pad the bucket — "
+                    f"parallel/zero.py BucketPlan does)") from e
+            shards.append(NDArray(shard))
+        return shards if isinstance(key, (list, tuple)) else shards[0]
+
     def _push_traced(self, keys, values):
         from ..ndarray.sparse import RowSparseNDArray
         if self._updater is not None:
@@ -384,6 +446,37 @@ class KVStoreTPUSync(KVStoreLocal):
             else:
                 super().pull(k, out=o, priority=priority,
                              ignore_sparse=ignore_sparse)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused eager pushpull (ISSUE 3 satellite): ONE jitted reduce
+        covering every key in the call, with the store and ``out``
+        aliasing the same reduced arrays — a single dispatch where the
+        push-then-pull composition paid two (the SURVEY §7 eager
+        dispatch cliff acknowledged in the module docstring).  Traced
+        values (in-graph psum), updater-on-store, and sparse values
+        keep the exact push/pull composition."""
+        keys, values = self._canon(key, value)
+        if _contains_tracer(values) or self._updater is not None:
+            return super().pushpull(key, value, out=out, priority=priority)
+        from ..ndarray.sparse import RowSparseNDArray
+        vss = []
+        for k, v in zip(keys, values):
+            vs = _listify(v)
+            if str(k) not in self._store or \
+                    any(isinstance(x, RowSparseNDArray) for x in vs):
+                return super().pushpull(key, value, out=out,
+                                        priority=priority)
+            vss.append([x.data for x in vs])
+        self._traced_store.clear()
+        merged = _fused_reduce(vss)
+        outs = out if out is not None else value
+        _, outs_l = self._canon(key, outs)
+        for k, m, o in zip(keys, merged, outs_l):
+            self._store[str(k)]._set_data(m)
+            for dst in _listify(o):
+                dst._set_data(m)     # alias, not a copy: zero dispatches
+        return out
+
 
 class KVStoreDistTPUSync(KVStoreTPUSync):
     """Multi-host synchronous store over jax.distributed.
@@ -477,7 +570,6 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
                 self._store[str(k)]._set_data(r)
 
     def push(self, key, value, priority=0):
-        from ..ndarray.sparse import RowSparseNDArray
         keys, values = self._canon(key, value)
         if _contains_tracer(values):
             # inside a jitted step: stay in-graph as a psum over the global
@@ -487,30 +579,57 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
             # applies to the eager path; in-graph, XLA owns the collective.
             return self._push_traced(keys, values)
         self._traced_store.clear()   # scrub leftovers of an aborted trace
-        sparse_done = {}
-        merged = []
-        dense_keys = []
+        self._eager_push(keys, values)
+
+    def pushpull(self, key, value, out=None, priority=0):
+        """Fused eager pushpull over the dist wire: the reduce (ONE
+        jitted dispatch for all dense keys), the cross-process hop, and
+        the store/out writes happen in a single pass — push-then-pull
+        paid a second dispatch round just to copy the stored values out.
+        Traced values and updater-on-kvstore keep the composition."""
+        keys, values = self._canon(key, value)
+        if _contains_tracer(values) or self._updater is not None:
+            return KVStore.pushpull(self, key, value, out=out,
+                                    priority=priority)
+        self._traced_store.clear()
+        outs = out if out is not None else value
+        _, outs_l = self._canon(key, outs)
+        self._eager_push(keys, values, outs=outs_l)
+        return out
+
+    def _eager_push(self, keys, values, outs=None):
+        """Shared eager wire path for push/pushpull: per-device reduce
+        (one fused jit for every dense key), cross-process transport
+        (compressed / bucketed-allreduce / allgather fallback), one
+        write pass into the store — and into ``outs``, aliasing the
+        same arrays (the pushpull fusion)."""
+        from ..ndarray.sparse import RowSparseNDArray
+        done = {}                     # str key -> reduced value
+        dense_keys, dense_vss = [], []
         for k, v in zip(keys, values):
-            red = self._local_reduce(_listify(v))
-            if isinstance(red, RowSparseNDArray):
-                if self._size > 1:
-                    red = self._allgather_sparse(red)
-                sparse_done[str(k)] = red
+            vs = _listify(v)
+            if any(isinstance(x, RowSparseNDArray) for x in vs):
+                red = self._local_reduce(vs)
+                if isinstance(red, RowSparseNDArray):
+                    if self._size > 1:
+                        red = self._allgather_sparse(red)
+                    done[str(k)] = red
+                else:
+                    # mixed sparse+dense copies densify in _local_reduce
+                    dense_keys.append(str(k))
+                    dense_vss.append([red.data])
             else:
-                merged.append(red.data)
-                dense_keys.append(k)
-        for k, red in sparse_done.items():
-            if self._updater is not None:
-                self._updater(int(k) if k.isdigit() else k, red,
-                              self._store[k])
-            else:
-                self._store[k] = red
-        keys = dense_keys
+                if str(k) not in self._store:
+                    raise MXNetError(
+                        f"key {k} not initialized (call init first)")
+                dense_keys.append(str(k))
+                dense_vss.append([x.data for x in vs])
+        merged = list(_fused_reduce(dense_vss)) if dense_vss else []
         if self._compression is not None:
             payloads = []   # per-key packed uint8 codes
             shapes = []
-            for k, m in zip(keys, merged):
-                packed, shape = self._compression.compress(str(k), m)
+            for k, m in zip(dense_keys, merged):
+                packed, shape = self._compression.compress(k, m)
                 payloads.append(packed)
                 shapes.append(shape)
             if self._size > 1:
@@ -532,13 +651,28 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
                 gathered = self._bucketed_allgather(merged)
                 merged = [jnp.sum(jnp.stack(list(worker_vals)), axis=0)
                           for worker_vals in gathered]
-        for k, m in zip(keys, merged):
-            k = str(k)
+        done.update(zip(dense_keys, merged))
+        for k in [str(k) for k in keys]:
+            red = done[k]
             if self._updater is not None:
-                self._updater(int(k) if k.isdigit() else k, NDArray(m),
+                grad = red if isinstance(red, RowSparseNDArray) \
+                    else NDArray(red)
+                self._updater(int(k) if k.isdigit() else k, grad,
                               self._store[k])
+            elif isinstance(red, RowSparseNDArray):
+                # replace semantics, like KVStoreLocal.push
+                self._store[k] = red
             else:
-                self._store[k]._set_data(m)
+                self._store[k]._set_data(red)
+        if outs is not None:
+            for k, o in zip([str(k) for k in keys], outs):
+                stored = self._store[k]
+                for dst in _listify(o):
+                    if isinstance(stored, RowSparseNDArray) and \
+                            isinstance(dst, RowSparseNDArray):
+                        stored.copyto(dst)           # stays O(nnz)
+                    else:
+                        dst._set_data(stored.data)
 
     def _global_mesh(self):
         """Mesh over EVERY device of every process — the in-graph
@@ -635,13 +769,13 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
             if len({flats[i].dtype for i in idxs}) > 1:
                 # mixed dtypes can't concat; gather individually
                 for i in idxs:
-                    g = multihost_utils.process_allgather(flats[i])
+                    g = multihost_utils.process_allgather(flats[i])  # mxlint: disable=HB07 -- mixed-dtype fallback within ONE bucket; the common path below is batched
                     per_key[i] = [g[w].reshape(arrays[i].shape)
                                   for w in range(g.shape[0])]
                 continue
             concat = jnp.concatenate([flats[i] for i in idxs]) \
                 if len(idxs) > 1 else flats[idxs[0]]
-            g = multihost_utils.process_allgather(concat)  # (workers, n)
+            g = multihost_utils.process_allgather(concat)  # (workers, n)  # mxlint: disable=HB07 -- one DCN round per >=BIGARRAY_BOUND bucket IS the batching
             offset = 0
             for i in idxs:
                 n = flats[i].size

@@ -12,6 +12,8 @@ framework-level diagnostics with stable rule IDs:
     HB04  Parameters / fresh constant ndarrays allocated per call
     HB05  np.random / stdlib random draws inside a traced region
     HB06  as_in_context / device transfers in a hot forward
+    HB07  eager collectives (kvstore push/pull/pushpull, process_allgather)
+          inside Python loops — module-wide, not just forwards
 
 CLI: ``python tools/mxlint.py <paths>`` (non-zero exit on violations,
 ``--format=json|text``, per-line ``# mxlint: disable=HB0x``). Rule

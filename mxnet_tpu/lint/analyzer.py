@@ -668,6 +668,75 @@ class _FunctionAnalyzer(ast.NodeVisitor):
 
 _MAX_HELPER_DEPTH = 8
 
+# -- HB07: eager collectives inside Python loops (module-wide pass) -----
+
+# kvstore-style data-plane methods; receiver name must look like a
+# kvstore binding (`kv`, `kvstore`, `self._kvstore`, ...) to fire
+_EAGER_COLLECTIVE_METHODS = {"push", "pull", "pushpull", "broadcast"}
+
+
+def _is_eager_collective(node):
+    func = node.func
+    if isinstance(func, ast.Name):
+        return func.id == "process_allgather"
+    if not isinstance(func, ast.Attribute):
+        return False
+    if func.attr == "process_allgather":
+        return True
+    if func.attr in _EAGER_COLLECTIVE_METHODS:
+        dotted = _dotted(func.value)
+        return bool(dotted) and any("kv" in part.lower()
+                                    for part in dotted.split("."))
+    return False
+
+
+class _LoopCollectiveScanner(ast.NodeVisitor):
+    """HB07 walks EVERY function in the module (training scripts and
+    helpers, not just HybridBlock forwards): an eager collective
+    dispatched once per loop iteration pays one wire round per key —
+    the SURVEY §7 bandwidth cliff the batched/bucketed APIs exist to
+    avoid.  Comprehensions are exempt only because the offending
+    real-world shape is the per-parameter for-loop."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.loop_depth = 0
+        self.func_stack = ["<module>"]
+
+    def _loop(self, node):
+        self.loop_depth += 1
+        try:
+            self.generic_visit(node)
+        finally:
+            self.loop_depth -= 1
+
+    visit_For = visit_While = visit_AsyncFor = _loop
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node):
+        if self.loop_depth > 0 and _is_eager_collective(node):
+            name = node.func.attr if isinstance(node.func, ast.Attribute) \
+                else node.func.id
+            self.c.add(Violation(
+                rule="HB07", path=self.path, line=node.lineno,
+                col=node.col_offset,
+                message=f"eager collective `{name}` inside a Python "
+                        "loop: one dispatch + wire round per iteration "
+                        "(O(n_keys) bandwidth cliff); batch the keys "
+                        "into one call (the store buckets them) or move "
+                        "the collective in-graph",
+                block="", func=self.func_stack[-1]))
+        self.generic_visit(node)
+
 
 class _Collector:
     def __init__(self, index, path):
@@ -802,6 +871,9 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
             if owner != cname:
                 continue              # inherited: reported on the owner
             collector.analyze_entry(fn, cname)
+    if only_classes is None:
+        # HB07 is module-wide (any function), not forward-scoped
+        _LoopCollectiveScanner(collector, path).visit(tree)
     suppressed, _unknown = parse_suppressions(source)
     src_lines = source.splitlines()
     out = []

@@ -45,7 +45,7 @@ def _lint_class(cls, seen_modules, out, rules):
 
 def check(block_or_module, rules=None, recursive=True):
     """Statically check a HybridBlock instance, Block subclass, or a
-    python module for trace-safety violations (rules HB01-HB06).
+    python module for trace-safety violations (rules HB01-HB07).
 
     Returns a list of :class:`mxnet_tpu.lint.Violation`, empty when the
     target is trace-clean. ``rules`` restricts checking to a subset of

@@ -88,6 +88,21 @@ RULES = {
         "# transfer once, outside forward:\n"
         "# data = data.as_in_context(ctx)  (in the input pipeline)\n"
         "return self.body(x)"),
+    "HB07": Rule(
+        "HB07", "eager-collective-in-loop",
+        "An eager collective (kvstore `push`/`pull`/`pushpull`/"
+        "`broadcast`, `multihost_utils.process_allgather`) inside a "
+        "Python `for`/`while` loop: each iteration pays a full dispatch "
+        "+ wire round, so bandwidth craters O(n_keys) (SURVEY.md §7 "
+        "perf cliff). Batch the keys into ONE call — the stores "
+        "coalesce a key list into BIGARRAY_BOUND-sized buckets — or "
+        "move the collective in-graph (traced push lowers to one "
+        "psum).  Applies to any function, not just forwards.",
+        "for i, p in enumerate(params):\n"
+        "    kv.pushpull(i, p.grad(), out=p.grad())",
+        "keys = list(range(len(params)))\n"
+        "grads = [p.grad() for p in params]\n"
+        "kv.pushpull(keys, grads, out=grads)   # one bucketed round"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

@@ -102,7 +102,7 @@ class NDArray:
     """
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_node", "_out_index",
-                 "_grad_fresh", "_grad_of", "__weakref__")
+                 "_grad_fresh", "_grad_reduced", "_grad_of", "__weakref__")
 
     # make NDArray win against numpy array in reflected ops
     __array_priority__ = 1000.0
@@ -113,6 +113,10 @@ class NDArray:
         self._grad = None
         self._grad_req = "null"
         self._grad_fresh = False
+        # True once the cross-worker sum ran for the CURRENT accumulated
+        # gradient; re-armed whenever autograd writes fresh gradient data
+        # (all_reduce_gradients must reduce once per cycle, grad_req='add')
+        self._grad_reduced = False
         self._grad_of = None
         self._node = None
         self._out_index = 0

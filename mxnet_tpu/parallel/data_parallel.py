@@ -4,9 +4,21 @@ This is the TPU replacement for the reference's hot loop
 (SURVEY.md §3.2 TPU mapping): `record -> forward -> backward ->
 kvstore.push/pull -> optimizer.update` becomes ONE jit(train_step) with
 donated params/optimizer state. The batch is sharded over the mesh 'dp'
-axis; parameters are replicated (or tp-sharded via their Parameter.shard
-spec); XLA inserts the gradient all-reduce over ICI automatically from the
-sharding algebra — no NCCL, no push/pull (SURVEY.md §2.6).
+axis. Two gradient-sync pipelines exist:
+
+- default: parameters replicated, XLA inserts the gradient all-reduce
+  over ICI automatically from the sharding algebra (SURVEY.md §2.6).
+- ``shard_updates=True`` (ZeRO-1, ISSUE 3 tentpole): the step runs as a
+  ``shard_map`` over 'dp' — per-chip fwd/bwd, gradients flattened into
+  size-bounded buckets (``MXTPU_COMM_BUCKET_MB``), an explicit
+  reduce-scatter (optionally quantized on the wire via
+  ``MXTPU_COMM_DTYPE=bf16|int8``), a 1/N-sized optimizer update against
+  bucket-sharded optimizer state, and one all-gather of the fresh
+  parameters per bucket.  Same ring wire bytes as all-reduce
+  (RS+AG == AR), 1/N optimizer HBM and update compute per chip, and
+  few/large collectives instead of one per tensor (parallel/zero.py;
+  arXiv:1909.09756 weight-update sharding, arXiv:2506.17615 EQuARX).
+  ``MXTPU_SHARDED_SYNC=0`` is the kill switch back to the psum path.
 """
 from __future__ import annotations
 
@@ -23,7 +35,9 @@ from ..ndarray.ndarray import NDArray
 from ..ndarray import random as _rnd
 from .. import _tape
 from ..gluon.parameter import _bind_params
+from ._compat import shard_map
 from .mesh import current_mesh, make_mesh
+from . import zero as _zero
 
 __all__ = ["DataParallelTrainer", "all_reduce_gradients"]
 
@@ -60,16 +74,14 @@ class DataParallelTrainer:
         self.batch_axis = batch_axis
         self._label_bax = (batch_axis if label_batch_axis is None
                            else label_batch_axis)
-        # ZeRO-1 / "weight update sharding" (MLPerf-on-TPU-pods technique,
-        # PAPERS.md arXiv:1909.09756 / arXiv:2011.03641): shard the
-        # optimizer state and the update over 'dp' via sharding
-        # constraints, so XLA lowers the gradient all-reduce into
-        # reduce-scatter + (post-update) all-gather — identical wire
-        # bytes (ring AR == RS+AG), 1/N optimizer memory and update
-        # compute per chip
+        # ZeRO-1 sharded gradient sync (see module docstring). Resolved
+        # lazily in _zero1_active(): needs the optimizer rule (elementwise
+        # kernels only) and the parameter shard specs (pure-dp only).
         self._shard_updates = bool(shard_updates) and \
             self.mesh.shape.get("dp", 1) > 1
-        self._ws_eligible = None
+        self._zero1 = None              # tri-state; resolved lazily
+        self._plan = None               # zero.BucketPlan once params known
+        self._comm_dtype = _zero.comm_dtype()   # read ONCE at construction
         params_kwargs = dict(optimizer_params or {})
         self._lr = params_kwargs.pop("learning_rate", 0.01)
         self._lr_scheduler = params_kwargs.pop("lr_scheduler", None)
@@ -80,6 +92,7 @@ class DataParallelTrainer:
             raise MXNetError(
                 f"DataParallelTrainer supports {sorted(_RULES)}; for "
                 f"'{optimizer}' use gluon.Trainer (eager path)")
+        self._rule_name = name
         self._rule_init, _kernel_apply = fused_rule(
             name, clip_gradient=clip, **params_kwargs)
         self._rule_apply = lambda p, g, s, lr: _kernel_apply(p, g, s, lr, wd)
@@ -89,6 +102,7 @@ class DataParallelTrainer:
         self._jitted = None
         self._jitted_indexed = None
         self._jit_accum_cache = {}
+        self._jit_zero1_cache = {}
         self._num_update = 0
         self._donate = donate
 
@@ -108,39 +122,6 @@ class DataParallelTrainer:
         if p.shard_spec is not None:
             return NamedSharding(self.mesh, p.shard_spec)
         return NamedSharding(self.mesh, P())
-
-    # -- weight-update sharding helpers ---------------------------------
-    def _ws_flags(self, param_vals):
-        """Which params take the sharded update: replicated params whose
-        leading dim divides the dp axis (tp-sharded params keep their own
-        spec; oddly-shaped leftovers stay replicated — correct either
-        way, this is a memory/compute optimization, not semantics)."""
-        if self._ws_eligible is None:
-            dp = self.mesh.shape.get("dp", 1)
-            self._ws_eligible = [
-                self._shard_updates and p.shard_spec is None and
-                v.ndim >= 1 and v.shape[0] % dp == 0 and v.shape[0] >= dp
-                for p, v in zip(self._param_objs, param_vals)]
-        return self._ws_eligible
-
-    def _ws_spec(self, leaf_ndim):
-        return NamedSharding(self.mesh,
-                             P(*(["dp"] + [None] * (leaf_ndim - 1))))
-
-    def _ws_leaf_sharding(self, x, ref_dim0):
-        """The ONE predicate for how a state leaf lives under weight-update
-        sharding: per-element leaves (same leading dim as the param) are
-        dp-sharded, scalar leaves (step counters) replicated.  Shared by
-        the initial device_put and the traced constraints so the two can
-        never disagree (which would force a reshard every step)."""
-        if getattr(x, "ndim", 0) >= 1 and x.shape[0] == ref_dim0:
-            return self._ws_spec(x.ndim)
-        return NamedSharding(self.mesh, P())
-
-    def _ws_constrain_state(self, s, ref_dim0):
-        return jax.tree.map(
-            lambda x: jax.lax.with_sharding_constraint(
-                x, self._ws_leaf_sharding(x, ref_dim0)), s)
 
     def _eff_bax(self, ndim, is_label=False):
         """Effective batch axis for an array of the given rank.
@@ -168,6 +149,15 @@ class DataParallelTrainer:
         spec[ax] = "dp"
         return NamedSharding(self.mesh, P(*spec))
 
+    def _batch_spec(self, ndim, is_label=False):
+        """The PartitionSpec twin of :meth:`_batch_sharding` (shard_map
+        in_specs need bare specs, not NamedShardings)."""
+        if not ndim:
+            return P()
+        spec = [None] * ndim
+        spec[self._eff_bax(ndim, is_label)] = "dp"
+        return P(*spec)
+
     def _put_batch(self, inputs):
         """device_put every batch array with its batch sharding; the
         LAST array is the label (single convention for step/step_accum)."""
@@ -177,7 +167,7 @@ class DataParallelTrainer:
 
     def _make_loss_of(self):
         """The traced fwd+loss closure — ONE source for every step
-        variant (plain, indexed, accumulating)."""
+        variant (plain, indexed, accumulating), replicated or sharded."""
         block = self.block
         loss_fn = self.loss_fn
         params = self._param_objs
@@ -196,29 +186,14 @@ class DataParallelTrainer:
         return loss_of
 
     def _apply_updates(self, param_vals, grads, opt_state, lr):
-        """The optimizer update incl. ZeRO-1 sharding constraints — ONE
-        source for every step variant (VERDICT r1 #6: duplicated update
-        loops silently diverged once; never again)."""
+        """The replicated optimizer update — ONE source for every
+        psum-path step variant (VERDICT r1 #6: duplicated update loops
+        silently diverged once; never again).  The ZeRO-1 pipeline has
+        its own single source, :meth:`_zero1_sync_update`."""
         rule_apply = self._rule_apply
-        ws = self._ws_flags(param_vals)
         new_params, new_state = [], []
-        for p, g, s, shard in zip(param_vals, grads, opt_state, ws):
-            g = g.astype(p.dtype)
-            if shard:
-                # constrain grad + state to 'dp' shards: XLA lowers
-                # the grad psum into a reduce-scatter feeding a
-                # 1/N-sized update, then the P() constraint below
-                # all-gathers the fresh params (ZeRO-1)
-                g = jax.lax.with_sharding_constraint(
-                    g, self._ws_spec(g.ndim))
-                p_sh = jax.lax.with_sharding_constraint(
-                    p, self._ws_spec(p.ndim))
-                s = self._ws_constrain_state(s, p.shape[0])
-                np_, ns = rule_apply(p_sh, g, s, lr)
-                np_ = jax.lax.with_sharding_constraint(
-                    np_, NamedSharding(self.mesh, P()))
-            else:
-                np_, ns = rule_apply(p, g, s, lr)
+        for p, g, s in zip(param_vals, grads, opt_state):
+            np_, ns = rule_apply(p, g.astype(p.dtype), s, lr)
             new_params.append(np_)
             new_state.append(ns)
         return new_params, new_state
@@ -258,17 +233,7 @@ class DataParallelTrainer:
         update logic come from the same _make_loss_of/_apply_updates the
         plain step uses (single source, cannot diverge)."""
         loss_of = self._make_loss_of()
-
-        def split_micro(b, is_label=False):
-            # split each array's own effective BATCH axis into n_micro
-            # leading scan slices, preserving the layout within each
-            # microbatch (rank-1 labels under batch_axis=1 split on
-            # axis 0 — see _eff_bax)
-            bax = self._eff_bax(b.ndim, is_label)
-            s = b.shape
-            b = b.reshape(s[:bax] + (n_micro, s[bax] // n_micro)
-                          + s[bax + 1:])
-            return jnp.moveaxis(b, bax, 0)
+        split_micro = self._micro_splitter(n_micro)
 
         def train_step(param_vals, opt_state, lr, key, *batch):
             inputs, label = list(batch[:-1]), batch[-1]
@@ -297,6 +262,19 @@ class DataParallelTrainer:
         donate = (0, 1) if self._donate else ()
         return jax.jit(train_step, donate_argnums=donate)
 
+    def _micro_splitter(self, n_micro):
+        def split_micro(b, is_label=False):
+            # split each array's own effective BATCH axis into n_micro
+            # leading scan slices, preserving the layout within each
+            # microbatch (rank-1 labels under batch_axis=1 split on
+            # axis 0 — see _eff_bax)
+            bax = self._eff_bax(b.ndim, is_label)
+            s = b.shape
+            b = b.reshape(s[:bax] + (n_micro, s[bax] // n_micro)
+                          + s[bax + 1:])
+            return jnp.moveaxis(b, bax, 0)
+        return split_micro
+
     def step_accum(self, *batch, n_micro):
         """One fused update from ``n_micro`` microbatches: batch arrays
         carry n_micro * B elements on ``batch_axis`` and are consumed
@@ -323,12 +301,23 @@ class DataParallelTrainer:
             params = self._collect(*probe)
         else:
             params = self._param_objs
-        inputs = self._put_batch(inputs)
         self._ensure_device_state(params)
-        jitted = self._jit_accum_cache.get(n_micro)
-        if jitted is None:
-            jitted = self._build_accum(n_micro)
-            self._jit_accum_cache[n_micro] = jitted
+        if self._zero1_active():
+            dp = self.mesh.shape["dp"]
+            b = inputs[-1].shape[bax]
+            if b % dp or (b // dp) % n_micro:
+                raise MXNetError(
+                    f"step_accum under shard_updates: batch {b} must "
+                    f"split evenly over dp={dp} chips x n_micro="
+                    f"{n_micro} microbatches (set MXTPU_SHARDED_SYNC=0 "
+                    f"or adjust the batch)")
+            jitted = self._get_zero1_jit("accum", inputs, n_micro=n_micro)
+        else:
+            jitted = self._jit_accum_cache.get(n_micro)
+            if jitted is None:
+                jitted = self._build_accum(n_micro)
+                self._jit_accum_cache[n_micro] = jitted
+        inputs = self._put_batch(inputs)
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
         new_params, self._opt_state, loss = jitted(
@@ -353,6 +342,146 @@ class DataParallelTrainer:
         donate = (0, 1) if self._donate else ()
         self._jitted_indexed = jax.jit(train_step, donate_argnums=donate)
 
+    # -- ZeRO-1 sharded gradient sync (the bucketed RS+AG pipeline) -----
+    def _zero1_active(self):
+        """Resolve (once) whether the sharded pipeline runs: needs
+        ``shard_updates=True``, dp > 1, the kill switch off, an
+        elementwise update rule (sgd/nag/adam/adamw/rmsprop — lamb/lars
+        need per-parameter norms and keep the psum path), and pure data
+        parallelism (any tp-sharded parameter falls back)."""
+        if self._zero1 is None:
+            self._zero1 = (
+                self._shard_updates
+                and _zero.sharded_sync_enabled()
+                and self._rule_name in _zero.ZERO1_RULES
+                and self._param_objs is not None
+                and all(p.shard_spec is None for p in self._param_objs))
+        return self._zero1
+
+    def _zero1_ensure_plan(self):
+        if self._plan is None:
+            self._plan = _zero.BucketPlan(
+                [tuple(v.shape) for v in self._param_vals],
+                self.mesh.shape["dp"])
+        return self._plan
+
+    def _zero1_state_spec_tree(self):
+        """shard_map specs for the bucket optimizer state: vector leaves
+        (per-element state) shard over 'dp', scalar leaves (step
+        counters) replicate."""
+        return jax.tree.map(
+            lambda x: P("dp") if getattr(x, "ndim", 0) >= 1 else P(),
+            self._opt_state)
+
+    def _zero1_sync_update(self, param_vals, grads, opt_local, lr, key):
+        """Bucketed reduce-scatter -> 1/N optimizer update -> all-gather.
+        Runs INSIDE shard_map ('dp' bound); ``grads`` are this chip's
+        LOCAL gradients, ``opt_local`` the local 1/dp state shards.  ONE
+        source for plain/accum/indexed sharded steps."""
+        plan = self._plan
+        dp = self.mesh.shape["dp"]
+        mode = self._comm_dtype
+        idx = lax.axis_index("dp")
+        gflats = plan.flatten(grads)
+        pflats = plan.flatten(param_vals)
+        new_pflats, new_state = [], []
+        for b in range(plan.n_buckets):
+            ls = plan.shard_length(b)
+            gshard = _zero.reduce_scatter_bucket(
+                gflats[b], jax.random.fold_in(key, b), dp, mode)
+            pshard = lax.dynamic_slice(pflats[b], (idx * ls,), (ls,))
+            np_, ns = self._rule_apply(pshard, gshard, opt_local[b], lr)
+            new_pflats.append(lax.all_gather(np_, "dp", tiled=True))
+            new_state.append(ns)
+        return plan.unflatten(new_pflats, param_vals), new_state
+
+    def _get_zero1_jit(self, kind, inputs, n_micro=None):
+        """Build (and cache per input-rank signature) the jitted
+        shard_map step.  Unlike the psum path, shard_map needs the
+        in/out specs — hence ranks — up front; jit would retrace per
+        shape anyway, so this costs nothing extra."""
+        self._zero1_ensure_plan()
+        sig = (kind, n_micro, tuple(b.ndim for b in inputs))
+        jitted = self._jit_zero1_cache.get(sig)
+        if jitted is not None:
+            return jitted
+        loss_of = self._make_loss_of()
+        mesh = self.mesh
+        n_in = len(inputs)
+
+        def local_grads(param_vals, lr, key, inputs, label):
+            if kind == "accum":
+                split_micro = self._micro_splitter(n_micro)
+                micro_in = [split_micro(b) for b in inputs]
+                micro_lab = split_micro(label, is_label=True)
+                keys = jax.random.split(key, n_micro)
+
+                def scan_step(carry, xs):
+                    acc, loss_sum = carry
+                    *mb, lab, k = xs
+                    loss, grads = jax.value_and_grad(loss_of)(
+                        list(param_vals), k, mb, lab)
+                    acc = [a + g.astype(jnp.float32)
+                           for a, g in zip(acc, grads)]
+                    return (acc, loss_sum + loss), None
+
+                init = ([jnp.zeros(v.shape, jnp.float32)
+                         for v in param_vals], jnp.zeros((), jnp.float32))
+                (acc, loss_sum), _ = lax.scan(
+                    scan_step, init, tuple(micro_in) + (micro_lab, keys))
+                return [g / n_micro for g in acc], loss_sum / n_micro
+            loss, grads = jax.value_and_grad(loss_of)(
+                list(param_vals), key, inputs, label)
+            return grads, loss
+
+        def local_body(param_vals, opt_local, lr, key, *batch):
+            # per-chip PRNG stream (dropout etc. draws fresh per chip)
+            key = jax.random.fold_in(key, lax.axis_index("dp"))
+            if kind == "indexed":
+                superdata, superlabel, i = batch
+                data = lax.dynamic_index_in_dim(superdata, i, 0,
+                                                keepdims=False)
+                label = lax.dynamic_index_in_dim(superlabel, i, 0,
+                                                 keepdims=False)
+                ins = [data]
+            else:
+                ins, label = list(batch[:-1]), batch[-1]
+            grads, loss = local_grads(param_vals, lr, key, ins, label)
+            loss = lax.pmean(loss, "dp")
+            new_params, new_state = self._zero1_sync_update(
+                param_vals, grads, opt_local, lr,
+                jax.random.fold_in(key, 0x5eed))
+            return new_params, new_state, loss
+
+        pspecs = [P()] * len(self._param_vals)
+        sspecs = self._zero1_state_spec_tree()
+        if kind == "indexed":
+            dspec, lspec = inputs[0], inputs[1]   # prebuilt epoch specs
+            batch_specs = (dspec, lspec, P())
+        else:
+            batch_specs = tuple(
+                self._batch_spec(b.ndim, is_label=(i == n_in - 1))
+                for i, b in enumerate(inputs))
+        in_specs = (pspecs, sspecs, P(), P()) + batch_specs
+        out_specs = (pspecs, sspecs, P())
+        wrapped = shard_map(local_body, mesh=mesh, in_specs=in_specs,
+                            out_specs=out_specs, check_vma=False)
+        donate = (0, 1) if self._donate else ()
+        jitted = jax.jit(wrapped, donate_argnums=donate)
+        self._jit_zero1_cache[sig] = jitted
+        return jitted
+
+    def _zero1_check_batch(self, inputs):
+        dp = self.mesh.shape["dp"]
+        for i, b in enumerate(inputs):
+            ax = self._eff_bax(b.ndim, is_label=(i == len(inputs) - 1))
+            if b.shape[ax] % dp:
+                raise MXNetError(
+                    f"shard_updates: batch axis {ax} size {b.shape[ax]} "
+                    f"not divisible by dp={dp} (the sharded pipeline "
+                    f"needs even shards; MXTPU_SHARDED_SYNC=0 restores "
+                    f"the psum path)")
+
     # -- public API -----------------------------------------------------
     @property
     def learning_rate(self):
@@ -369,13 +498,18 @@ class DataParallelTrainer:
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
-        inputs = self._put_batch(inputs)
         self._ensure_device_state(params)
-        if self._jitted is None:
-            self._build()
+        if self._zero1_active():
+            self._zero1_check_batch(inputs)
+            jitted = self._get_zero1_jit("plain", inputs)
+        else:
+            if self._jitted is None:
+                self._build()
+            jitted = self._jitted
+        inputs = self._put_batch(inputs)
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = self._jitted(
+        new_params, self._opt_state, loss = jitted(
             self._param_vals, self._opt_state, lr, key, *inputs)
         self._num_update += 1
         self._param_vals = list(new_params)
@@ -417,7 +551,8 @@ class DataParallelTrainer:
         spec_l = epoch_spec(sl, is_label=True)
         # caller owns the handle; dropping it frees the device buffers
         return (jax.device_put(sd, NamedSharding(mesh, spec_d)),
-                jax.device_put(sl, NamedSharding(mesh, spec_l)))
+                jax.device_put(sl, NamedSharding(mesh, spec_l)),
+                (spec_d, spec_l))
 
     def _ensure_device_state(self, params):
         """Params stay resident on device across steps (VERDICT r1 weak
@@ -435,31 +570,49 @@ class DataParallelTrainer:
                     self._param_vals[i] = jax.device_put(
                         p.data().data, self._param_sharding(p))
         if self._opt_state is None:
-            ws = self._ws_flags(self._param_vals)
-            def put(x, shard, dim0):
-                if shard:
-                    return jax.device_put(x, self._ws_leaf_sharding(x, dim0))
-                return jax.device_put(x, NamedSharding(self.mesh, P()))
-            self._opt_state = [
-                jax.tree.map(
-                    lambda x, s=shard, d=v.shape[0] if v.ndim else 1:
-                    put(x, s, d), self._rule_init(v))
-                for v, shard in zip(self._param_vals, ws)]
+            if self._zero1_active():
+                # ZeRO-1: optimizer state lives in BUCKET space, each
+                # vector leaf a flat (bucket_len,) array physically
+                # sharded 1/dp per chip; scalar leaves (step counters)
+                # replicate.  This is where the (N-1)/N optimizer-HBM
+                # saving comes from.
+                plan = self._zero1_ensure_plan()
+                shard = NamedSharding(self.mesh, P("dp"))
+                rep = NamedSharding(self.mesh, P())
+                self._opt_state = [
+                    jax.tree.map(
+                        lambda x: jax.device_put(
+                            x, shard if getattr(x, "ndim", 0) >= 1
+                            else rep),
+                        self._rule_init(
+                            jnp.zeros((plan.lengths[b],), jnp.float32)))
+                    for b in range(plan.n_buckets)]
+            else:
+                rep = NamedSharding(self.mesh, P())
+                self._opt_state = [
+                    jax.tree.map(lambda x: jax.device_put(x, rep),
+                                 self._rule_init(v))
+                    for v in self._param_vals]
 
     def step_indexed(self, epoch_handle, i):
         """One fused train step on batch ``i`` of a resident epoch
         (see :meth:`put_epoch`)."""
-        superdata, superlabel = epoch_handle
+        superdata, superlabel = epoch_handle[0], epoch_handle[1]
         if self._param_objs is None:
             # probe batch only for deferred-shape resolution on first call
             self._collect(NDArray(superdata[0]))
         params = self._param_objs
         self._ensure_device_state(params)
-        if self._jitted_indexed is None:
-            self._build_indexed()
+        if self._zero1_active():
+            spec_d, spec_l = epoch_handle[2]
+            jitted = self._get_zero1_jit("indexed", (spec_d, spec_l))
+        else:
+            if self._jitted_indexed is None:
+                self._build_indexed()
+            jitted = self._jitted_indexed
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = self._jitted_indexed(
+        new_params, self._opt_state, loss = jitted(
             self._param_vals, self._opt_state, lr, key, superdata,
             superlabel, jnp.asarray(i, jnp.int32))
         self._num_update += 1
@@ -468,22 +621,150 @@ class DataParallelTrainer:
             p._data._set_data(v)
         return NDArray(loss)
 
+    # -- observability ---------------------------------------------------
+    def comm_stats(self, measure=False, iters=10, step_ms=None):
+        """The per-step ``comm`` block (parallel/zero.py schema): static
+        wire accounting always; with ``measure=True`` and dp > 1 the
+        collective time is MEASURED by timing a jitted RS+AG-only
+        program over this trainer's real bucket shapes (``collective_ms``
+        / ``est_ici_gb_s``), and ``overlap_efficiency`` estimates how
+        much of it a ``step_ms``-long step could hide.  All fields are
+        zeros when the sharded pipeline is off — the schema survives so
+        CPU CI regression-tests it (tests/test_bench_line.py)."""
+        dp = self.mesh.shape.get("dp", 1)
+        state_rep = 0
+        if self._opt_state is not None:
+            for leaf in jax.tree.leaves(self._opt_state):
+                state_rep += leaf.size * leaf.dtype.itemsize
+        if not (self._zero1 and self._plan is not None):
+            # replicated update: every chip carries the full state copy
+            state_chip = state_rep
+            return _zero.comm_block(
+                dp=dp, wire_dtype=self._comm_dtype,
+                state_bytes_per_chip=state_chip,
+                state_bytes_replicated=state_rep)
+        plan = self._plan
+        bytes_rs = plan.wire_bytes(self._comm_dtype)
+        bytes_ag = 4 * sum(plan.lengths)
+        # per-chip state: vector leaves are dp-sharded, scalars replicate
+        state_chip = 0
+        for leaf in jax.tree.leaves(self._opt_state):
+            nbytes = leaf.size * leaf.dtype.itemsize
+            state_chip += nbytes // dp if leaf.ndim >= 1 else nbytes
+        coll_ms = gbs = overlap = 0.0
+        if measure and dp > 1:
+            coll_ms = self._measure_collectives(iters)
+            if coll_ms > 0:
+                gbs = (bytes_rs + bytes_ag) / (coll_ms / 1e3) / 1e9
+            if step_ms:
+                overlap = max(0.0, min(1.0, 1.0 - coll_ms / step_ms))
+        return _zero.comm_block(
+            dp=dp, wire_dtype=self._comm_dtype, buckets=plan.n_buckets,
+            bytes_reduced_per_step=bytes_rs,
+            bytes_gathered_per_step=bytes_ag,
+            grad_bytes_fp32=plan.grad_bytes_fp32(),
+            collective_ms=coll_ms, est_ici_gb_s=gbs,
+            overlap_efficiency=overlap, zero1=True,
+            state_bytes_per_chip=state_chip, state_bytes_replicated=state_rep)
 
-def all_reduce_gradients(params, mesh=None, axis="dp"):
-    """Eager helper: sum .grad across worker *processes* for parameters
-    trained outside the fused step (reference: trainer._allreduce_grads).
+    def _measure_collectives(self, iters=10):
+        """Wall-time a jitted program containing ONLY this trainer's
+        per-step collectives (bucketed RS + param AG) — the measured
+        ``collective_ms`` evidence for the comm block."""
+        import time
+        from .. import profiler
+        plan = self._plan
+        dp = self.mesh.shape["dp"]
+        mode = self._comm_dtype
 
-    Within one process an eagerly computed gradient already covers the full
-    local batch, so there is nothing to reduce; across processes this is a
-    real all-reduce via multihost allgather+sum (the out-of-graph KVStore
-    path — SURVEY.md §7 "in-graph collectives vs push/pull API" perf cliff).
+        def comm_only(flats, key):
+            outs = []
+            for b, f in enumerate(flats):
+                sh = _zero.reduce_scatter_bucket(
+                    f, jax.random.fold_in(key, b), dp, mode)
+                outs.append(lax.all_gather(sh, "dp", tiled=True))
+            return outs
+
+        specs = [P()] * plan.n_buckets
+        f = jax.jit(shard_map(comm_only, mesh=self.mesh,
+                              in_specs=(specs, P()), out_specs=specs,
+                              check_vma=False))
+        flats = [jnp.ones((n,), jnp.float32) for n in plan.lengths]
+        key = jax.random.PRNGKey(0)
+        jax.block_until_ready(f(flats, key))        # compile off the clock
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = f(flats, key)
+        jax.block_until_ready(out)
+        t1 = time.perf_counter()
+        profiler.record_span("comm.collectives", t0, t1)
+        return (t1 - t0) / iters * 1e3
+
+
+def all_reduce_gradients(params, mesh=None, axis="dp", kvstore=None,
+                         keys=None):
+    """Sum parameter gradients across data-parallel workers — the ONE
+    implementation behind ``gluon.Trainer.allreduce_grads`` and
+    standalone use (they used to be two drifting copies).
+
+    - With ``kvstore``: one batched ``pushpull`` over all pending keys
+      (the dist store coalesces into BIGARRAY_BOUND buckets — one wire
+      round per bucket, not per tensor).
+    - Without: a cross-*process* sum via bucketed allgather (within one
+      process an eagerly computed gradient already covers the full local
+      batch, so there is nothing to reduce).
+
+    ``grad_req='add'`` accumulation is honored: a gradient is reduced
+    exactly ONCE per accumulation cycle (tracked per-buffer; autograd
+    writing a fresh gradient or ``zero_grad`` re-arms it), so calling
+    ``allreduce_grads()`` manually and then ``step()`` — the reference's
+    documented split flow — cannot double-count the cross-worker sum.
     """
+    if keys is None:
+        keys = list(range(len(params)))
+    sel_keys, sel_params, grads = [], [], []
+    for k, p in zip(keys, params):
+        d = getattr(p, "_data", None)
+        if getattr(p, "grad_req", "write") == "null" or d is None or \
+                d._grad is None:
+            continue
+        if getattr(d, "_grad_reduced", False):
+            continue            # already summed this accumulation cycle
+        sel_keys.append(k)
+        sel_params.append(p)
+        grads.append(p.grad())
+    if not sel_keys:
+        return params
+    if kvstore is not None:
+        kvstore.pushpull(sel_keys, grads, out=grads)
+        for p, g in zip(sel_params, grads):
+            if g.stype == "row_sparse":
+                # keep the compressed pair — .data here would materialize
+                # a vocab-sized dense grad and disable the optimizer's
+                # lazy row update
+                p._data._grad = g
+            else:
+                p._data._grad = g.data
+            p._data._grad_reduced = True
+        return params
     if jax.process_count() == 1:
         return params
     from jax.experimental import multihost_utils
-    for p in params:
-        if getattr(p, "_data", None) is not None and \
-                p._data._grad is not None:
-            stacked = multihost_utils.process_allgather(p._data._grad)
-            p._data._grad = jnp.sum(stacked, axis=0)
+    from ..ndarray.sparse import RowSparseNDArray
+    if any(isinstance(p._data._grad, RowSparseNDArray)
+           for p in sel_params):
+        raise MXNetError(
+            "all_reduce_gradients: row_sparse grads need a kvstore "
+            "(dist_tpu_sync row-aware path); pass kvstore=")
+    garrs = [p._data._grad for p in sel_params]
+    plan = _zero.BucketPlan([g.shape for g in garrs], dp=1,
+                            bound_bytes=_zero.bucket_bound_bytes())
+    flats = plan.flatten(garrs)
+    summed = []
+    for flat in flats:
+        stacked = multihost_utils.process_allgather(flat)  # mxlint: disable=HB07 -- one DCN round per >=bucket-bound of payload, not per tensor
+        summed.append(jnp.sum(stacked, axis=0))
+    for p, g in zip(sel_params, plan.unflatten(summed, garrs)):
+        p._data._grad = g
+        p._data._grad_reduced = True
     return params

@@ -24,6 +24,7 @@ def _assert_headline(line: str):
 
 def _success_payload():
     """A realistic full-TPU-run payload with every extra attached."""
+    from mxnet_tpu.parallel import zero
     return {
         "metric": "resnet50_train_images_per_sec", "value": 2068.4,
         "unit": "img/s", "vs_baseline": 1.59, "platform": "tpu",
@@ -31,6 +32,14 @@ def _success_payload():
         "s2d_stem": True, "mfu": 0.235, "tflops_delivered": 46.3,
         "flops_source": "xla_cost_analysis",
         "chip_peak_tflops_bf16": 197.0,
+        "comm": zero.comm_block(
+            dp=8, wire_dtype="bf16", buckets=4, bucket_mb=32.0,
+            bytes_reduced_per_step=51_200_000,
+            bytes_gathered_per_step=102_400_000,
+            grad_bytes_fp32=102_400_000, collective_ms=1.84,
+            est_ici_gb_s=83.5, overlap_efficiency=0.97, zero1=True,
+            state_bytes_per_chip=12_800_000,
+            state_bytes_replicated=102_400_000),
         "input_pipeline": {"decode_thread_sweep": [
             {"threads": t, "img_s": 410.0} for t in (1, 2, 4, 8)]},
         "extra": {
@@ -89,6 +98,10 @@ def test_success_line_parses_and_fits():
     assert obj["value"] == 2068.4
     assert obj["platform"] == "tpu"
     assert obj["mfu"] == 0.235
+    # sharded-sync evidence survives compaction when zero1 ran
+    assert obj["comm_ms"] == 1.84
+    assert obj["comm_gb_s"] == 83.5
+    assert obj["comm_mb_reduced"] == 51.2
     # scalar summaries survive compaction
     assert obj["bert_samples_s"] == 1162.0
     assert obj["decode_tok_s"] == 9000.1
@@ -132,3 +145,54 @@ def test_minimal_error_payload():
          "unit": "img/s", "vs_baseline": 0.0})
     obj = _assert_headline(line)
     assert obj["value"] == 0.0
+
+
+# ----------------------------------------------------------------------
+# the `comm` block schema (ISSUE 3): regression-tested on CPU — the
+# sharded-sync observability must ship with every field present (zeros
+# are fine) so a TPU round can't discover a broken schema
+# ----------------------------------------------------------------------
+
+_COMM_KEYS = {
+    "zero1", "dp", "wire_dtype", "buckets", "bucket_mb",
+    "bytes_reduced_per_step", "bytes_gathered_per_step",
+    "grad_bytes_fp32", "collective_ms", "est_ici_gb_s",
+    "overlap_efficiency", "state_bytes_per_chip",
+    "state_bytes_replicated",
+}
+
+
+def test_comm_block_schema_is_stable():
+    from mxnet_tpu.parallel import zero
+    blk = zero.comm_block()
+    assert set(blk) == _COMM_KEYS
+    # defaults are all-zeros / fp32 — the CPU shape
+    assert blk["dp"] == 1 and not blk["zero1"]
+    assert blk["wire_dtype"] == "fp32"
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_pipeline_probe_emits_comm_block():
+    """tools/bench_pipeline.py emits the block end-to-end: on the forced
+    8-device CPU mesh the sharded pipeline actually runs and the
+    collective time is measured; on 1 device it's the zeros shape."""
+    import jax
+    from tools.bench_pipeline import comm_probe
+    payload = comm_probe(batch=16, iters=2)
+    comm = payload["comm"]
+    assert set(comm) == _COMM_KEYS
+    assert len(json.dumps(payload)) < 1800
+    if len(jax.devices()) >= 8:
+        assert comm["zero1"] and comm["dp"] == 8
+        assert comm["bytes_reduced_per_step"] > 0
+        assert comm["collective_ms"] > 0
+    else:
+        assert comm["bytes_reduced_per_step"] == 0
+
+
+def test_comm_mb_reduced_dropped_when_replicated():
+    """A psum-path run (zero1 False) keeps comm_* out of the headline."""
+    p = _success_payload()
+    p["comm"]["zero1"] = False
+    obj = json.loads(bench._compact_line(p))
+    assert "comm_ms" not in obj and "comm_mb_reduced" not in obj

@@ -465,3 +465,76 @@ def test_retrace_threshold_env(monkeypatch):
         for b in range(1, 8):
             net(mx.nd.ones((b, 5)))
     assert not [x for x in w if issubclass(x.category, RetraceWarning)]
+
+
+# ----------------------------------------------------------------------
+# HB07 — eager collectives inside Python loops (module-wide, ISSUE 3)
+# ----------------------------------------------------------------------
+
+def test_hb07_pushpull_in_loop():
+    out = lint_source(textwrap.dedent("""
+        def sync(kv, params):
+            for i, p in enumerate(params):
+                kv.pushpull(i, p.grad(), out=p.grad())
+    """), path="<hb07>")
+    assert _rules(out) == ["HB07"]
+    assert out[0].func == "sync"
+
+
+def test_hb07_process_allgather_in_while():
+    out = lint_source(textwrap.dedent("""
+        from jax.experimental import multihost_utils
+        def drain(flats):
+            while flats:
+                g = multihost_utils.process_allgather(flats.pop())
+    """), path="<hb07>")
+    assert _rules(out) == ["HB07"]
+
+
+def test_hb07_fires_outside_any_class():
+    # module-level training-script loop, not a HybridBlock forward
+    out = lint_source(textwrap.dedent("""
+        for epoch in range(10):
+            kvstore.push(0, grad)
+            kvstore.pull(0, out=weight)
+    """), path="<hb07>")
+    assert [v.rule for v in out] == ["HB07", "HB07"]
+
+
+def test_hb07_clean_batched_call_and_non_kv_receiver():
+    # the recommended shape: ONE batched call after list-building; and
+    # loops over non-kvstore .push (e.g. list.push) stay silent
+    out = lint_source(textwrap.dedent("""
+        def sync(kv, params):
+            keys, grads = [], []
+            for i, p in enumerate(params):
+                keys.append(i)
+                grads.append(p.grad())
+            kv.pushpull(keys, grads, out=grads)
+        def collect(stack, items):
+            for x in items:
+                stack.push(x)
+    """), path="<hb07>")
+    assert out == []
+
+
+def test_hb07_suppression():
+    out = lint_source(textwrap.dedent("""
+        def sync(kv, params):
+            for i, p in enumerate(params):
+                kv.pushpull(i, p.grad(), out=p.grad())  # mxlint: disable=HB07
+    """), path="<hb07>")
+    assert out == []
+
+
+def test_hb07_in_rule_catalog_and_package_clean():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB07" in RULES
+    # the package itself must hold the bar the rule sets (the two wire
+    # loops that ARE the bucketing carry justified suppressions)
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB07"})
+    assert n_files > 50
+    assert viol == [], [f"{v.path}:{v.line}" for v in viol]

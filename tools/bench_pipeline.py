@@ -147,6 +147,49 @@ class RecBatchFeeder:
                 pf.close()
 
 
+def comm_probe(batch=16, iters=3, in_dim=32, classes=8):
+    """Tiny synthetic DataParallelTrainer run that emits the per-step
+    ``comm`` block (parallel/zero.py schema, ISSUE 3): bytes reduced /
+    gathered per step, MEASURED collective ms and est. ICI GB/s when the
+    host exposes a dp mesh (or 8 forced CPU devices), zeros on a plain
+    single-device host — either way every schema field is present, so
+    tier-1 regression-tests the shape (tests/test_bench_line.py) without
+    a multichip host."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    ndev = len(jax.devices())
+    dp = ndev if ndev > 1 and batch % ndev == 0 else 1
+    mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(classes))
+    net.initialize()
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+        shard_updates=dp > 1)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, in_dim).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, classes, (batch,)))
+    loss = trainer.step(x, y)          # compile off the clock
+    loss.asnumpy()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = trainer.step(x, y)
+    loss.asnumpy()
+    step_ms = (time.perf_counter() - t0) / iters * 1e3
+    return {
+        "metric": "pipeline_comm_probe",
+        "dp": dp,
+        "step_ms": round(step_ms, 3),
+        "comm": trainer.comm_stats(measure=dp > 1, step_ms=step_ms),
+    }
+
+
 def wrap_preproc(net):
     """uint8 NHWC -> float NCHW in-graph, then the wrapped net; XLA fuses
     the cast/scale/layout into the first conv."""
@@ -163,3 +206,8 @@ def wrap_preproc(net):
             return self.net(x)
 
     return RecPreproc(net)
+
+
+if __name__ == "__main__":
+    import json
+    print(json.dumps(comm_probe()))
