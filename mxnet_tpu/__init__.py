@@ -75,6 +75,7 @@ from . import model
 from . import image
 from . import parallel
 from . import lint
+from . import checkpoint
 
 # mx.np / mx.npx numpy-compat front end (SURVEY.md §2.2 numpy-compat row):
 # jax.numpy already provides numpy semantics; expose it under the mx.np name.

@@ -1,38 +1,92 @@
-"""Async checkpointing: training never blocks on the filesystem.
+"""Preemption-safe checkpointing: full training state, crash-consistent.
 
 Reference context (SURVEY.md §5.4): the reference's recovery story is
-"checkpoint every epoch and restart" with synchronous `mx.nd.save`. The
-TPU-idiomatic upgrade (orbax-style async checkpoint) splits the save into
-(a) a device->host snapshot started immediately (async D2H — the step
-stream keeps running) and (b) serialization + atomic file rename on a
-background thread. `save_checkpoint_async` returns a ticket; the NEXT save
-(or `wait()`) joins the previous write, bounding the number of in-flight
-checkpoints to one — the same discipline orbax uses.
+"checkpoint every epoch and restart" with synchronous `mx.nd.save` of
+bare params.  At pod scale preemption is the steady state, not the
+exception (arXiv 1909.09756; arXiv 2011.03641 treat restartability as a
+precondition for multi-hour runs), and bare params are not enough: a
+SIGTERM mid-epoch must not lose the optimizer state, the lr/update
+counters, the data-iterator position, or the RNG stream.
+
+Three layers live here:
+
+:class:`AsyncCheckpointer`
+    orbax-style async array writes — device->host snapshot started
+    immediately, serialization + atomic rename on a background thread,
+    at most one write in flight.
+
+:class:`CheckpointManager`
+    full-training-state checkpoints as crash-consistent directories:
+    per-array CRC32s and a JSON manifest written LAST via ``os.replace``
+    (a crash at any byte leaves either the previous manifest or none —
+    never a half-trusted checkpoint), retention (``keep=N``), and
+    :meth:`~CheckpointManager.latest` that validates and SKIPS torn or
+    corrupt checkpoints instead of restoring garbage.
+
+:class:`PreemptionHandler` / :func:`run_preemptible`
+    SIGTERM/SIGINT turn into a cooperative "finish the in-flight step,
+    force-sync a final checkpoint, exit cleanly" flag instead of a
+    mid-step kill.
+
+Checkpoint layout (``<dir>/ckpt-<step:08d>/``)::
+
+    params.ndz      model parameters          (mx.nd container format)
+    trainer.ndz     optimizer state arrays    (per-parameter space —
+                                               dp-independent, see
+                                               docs/FAULT_TOLERANCE.md)
+    rng.ndz         mx PRNG key + numpy MT state
+    manifest.json   step/epoch/cursor/counters + per-file and per-array
+                    CRC32s; written last, atomically
+
+Env knobs: ``MXTPU_CKPT_KEEP`` (retention, default 3),
+``MXTPU_CKPT_ASYNC=0`` (force synchronous saves),
+``MXTPU_CKPT_TIMEOUT`` (seconds ``wait_until_finished`` blocks before
+raising :class:`CheckpointTimeout`; default: forever).
 """
 from __future__ import annotations
 
+import json
 import os
+import re
+import shutil
+import signal as _signal
 import threading
+import time
+import zlib
 
+import numpy as _np
 import jax
 
 from .base import MXNetError
 from .ndarray.ndarray import NDArray
 from .ndarray import utils as nd_utils
+from .testing import faults as _faults
 
-__all__ = ["AsyncCheckpointer", "save_checkpoint_async"]
+__all__ = ["AsyncCheckpointer", "save_checkpoint_async", "CheckpointManager",
+           "CheckpointTimeout", "PreemptionHandler", "run_preemptible"]
+
+
+class CheckpointTimeout(MXNetError):
+    """``wait()`` gave up before the writer finished — the write may
+    still complete; distinguishable from a writer *failure* (which
+    raises the writer's own wrapped error)."""
 
 
 class _Ticket:
-    def __init__(self):
+    def __init__(self, desc=""):
         self._done = threading.Event()
         self._error = None
+        self._desc = desc
         self.path = None
 
     def wait(self, timeout=None):
-        """Block until the write is durable; re-raises writer errors."""
+        """Block until the write is durable; re-raises writer errors.
+        A timeout raises :class:`CheckpointTimeout` (the write is still
+        in flight); a writer failure raises the wrapped error."""
         if not self._done.wait(timeout):
-            raise MXNetError("checkpoint write timed out")
+            raise CheckpointTimeout(
+                f"checkpoint write {self._desc or self.path} still in "
+                f"flight after {timeout}s")
         if self._error is not None:
             raise self._error
         return self.path
@@ -62,46 +116,67 @@ class AsyncCheckpointer:
         copies are started; jax arrays are immutable so the values are
         consistent even while training continues); only the host-side
         serialization happens on the thread.
-        """
-        # start non-blocking D2H for every array; immutability makes this
-        # a consistent snapshot of "now"
-        snap = {}
-        for k, v in arrays.items():
-            a = v.data if isinstance(v, NDArray) else v
-            try:
-                a.copy_to_host_async()
-            except (AttributeError, RuntimeError):
-                pass
-            # wrap the captured IMMUTABLE jax array, never the caller's
-            # mutable handle — later `w += ...` on the handle must not
-            # leak into this snapshot
-            snap[k] = NDArray(a)
 
-        self.wait_until_finished()      # at most one write in flight
-        ticket = _Ticket()
+        A failure of the *previous* write does not swallow this one:
+        the new write is started first, then the old error is re-raised
+        (with the new ticket attached as ``.pending_ticket``) so the
+        caller both learns about the lost snapshot and keeps the fresh
+        one going.
+        """
+        snap = _snapshot(arrays)
 
         def write():
             tmp = fname + ".tmp"
             try:
+                _faults.fault_point("checkpoint.write", fname)
                 nd_utils.save(tmp, snap)
-                os.replace(tmp, fname)  # atomic: readers never see a torn file
-                ticket.path = fname
-            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
-                ticket._error = MXNetError(
-                    f"async checkpoint to {fname} failed: "
-                    f"{type(e).__name__}: {e}")
+                os.replace(tmp, fname)  # atomic: no torn file visible
+            except BaseException:
                 try:
                     os.remove(tmp)
                 except OSError:
                     pass
+                raise
+            return fname
+
+        return self._submit(write, desc=fname)
+
+    def _submit(self, job, desc=""):
+        """Shared writer-thread discipline: join the previous write
+        first (at most one in flight), start ``job`` on a fresh thread,
+        and surface — without swallowing the new write — any error the
+        previous writer died with."""
+        prev_error = None
+        try:
+            self.wait_until_finished()
+        except CheckpointTimeout:
+            # previous writer still RUNNING: starting a second one would
+            # race it onto the same paths — nothing started, re-raise
+            raise
+        except MXNetError as e:
+            # previous writer FAILED: that snapshot is lost, but this
+            # one must not be — start it, then surface the old error
+            prev_error = e
+        ticket = _Ticket(desc)
+
+        def run():
+            try:
+                ticket.path = job()
+            except BaseException as e:  # noqa: BLE001 — surfaced on wait()
+                ticket._error = MXNetError(
+                    f"async checkpoint to {desc} failed: "
+                    f"{type(e).__name__}: {e}")
             finally:
                 ticket._done.set()
 
-        t = threading.Thread(target=write, daemon=True,
+        t = threading.Thread(target=run, daemon=True,
                              name="mxtpu-ckpt-writer")
         with self._lock:
             self._current = (t, ticket)
         t.start()
+        if prev_error is not None:
+            prev_error.pending_ticket = ticket
+            raise prev_error
         return ticket
 
     def wait_until_finished(self, timeout=None):
@@ -112,16 +187,34 @@ class AsyncCheckpointer:
             thread, ticket = cur
             try:
                 ticket.wait(timeout)
-            except MXNetError:
-                # writer still running (timeout): keep tracking it so the
-                # next save() joins it instead of racing a second writer
+            except CheckpointTimeout:
+                # writer still running: keep tracking it so the next
+                # save() joins it instead of racing a second writer
                 # onto the same .tmp path
-                if not ticket._done.is_set():
-                    with self._lock:
-                        if self._current is None:
-                            self._current = cur
+                with self._lock:
+                    if self._current is None:
+                        self._current = cur
                 raise
         return True
+
+
+def _snapshot(arrays):
+    """Start a non-blocking D2H for every array; immutability makes this
+    a consistent snapshot of "now".  Wraps the captured IMMUTABLE jax
+    array, never the caller's mutable handle — later ``w += ...`` on the
+    handle must not leak into the snapshot."""
+    snap = {}
+    for k, v in arrays.items():
+        a = v.data if isinstance(v, NDArray) else v
+        try:
+            a.copy_to_host_async()
+        except (AttributeError, RuntimeError):
+            pass
+        _faults.fault_point("checkpoint.d2h", k)
+        if isinstance(a, _np.ndarray):
+            a = jax.numpy.asarray(a)
+        snap[k] = NDArray(a) if hasattr(a, "dtype") else v
+    return snap
 
 
 _DEFAULT = AsyncCheckpointer()
@@ -130,3 +223,479 @@ _DEFAULT = AsyncCheckpointer()
 def save_checkpoint_async(fname, arrays):
     """Module-level convenience over a shared AsyncCheckpointer."""
     return _DEFAULT.save(fname, arrays)
+
+
+# ---------------------------------------------------------------------------
+# CRC helpers (per-array payload bytes, mirroring the nd container format)
+# ---------------------------------------------------------------------------
+
+def _payload_bytes(arr):
+    """The exact payload bytes ``nd_utils.save`` writes for this array
+    (bf16 widens to f32; sparse concatenates its compressed segments) —
+    so a CRC computed pre-write can be re-verified from the loaded
+    arrays."""
+    if not isinstance(arr, NDArray):
+        arr = NDArray(jax.numpy.asarray(arr))
+    segs = nd_utils._sparse_segments(arr)
+    if segs is not None:
+        _, _, parts = segs
+        return b"".join(_np.ascontiguousarray(p).tobytes() for p in parts)
+    return _np.ascontiguousarray(nd_utils._to_numpy_raw(arr)).tobytes()
+
+
+def _array_crcs(arrays):
+    return {k: zlib.crc32(_payload_bytes(v)) for k, v in arrays.items()}
+
+
+def _file_crc(path, chunk=1 << 20):
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                return crc
+            crc = zlib.crc32(b, crc)
+
+
+# ---------------------------------------------------------------------------
+# RNG state (mx PRNG key + numpy global MT — the streams training draws)
+# ---------------------------------------------------------------------------
+
+def _rng_state():
+    from .ndarray import random as _rnd
+    key_data = _np.asarray(jax.random.key_data(_rnd.current_key()))
+    algo, keys, pos, has_gauss, cached = _np.random.get_state()
+    arrays = {"mx_key": NDArray(jax.numpy.asarray(key_data)),
+              "np_keys": NDArray(jax.numpy.asarray(keys))}
+    meta = {"np_algo": algo, "np_pos": int(pos),
+            "np_has_gauss": int(has_gauss), "np_cached": float(cached)}
+    return arrays, meta
+
+
+def _restore_rng(arrays, meta):
+    from .ndarray import random as _rnd
+    _rnd.set_key_data(_np.asarray(arrays["mx_key"].asnumpy(),
+                                  dtype=_np.uint32))
+    _np.random.set_state((
+        meta["np_algo"],
+        _np.asarray(arrays["np_keys"].asnumpy(), dtype=_np.uint32),
+        int(meta["np_pos"]), int(meta["np_has_gauss"]),
+        float(meta["np_cached"])))
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager
+# ---------------------------------------------------------------------------
+
+_MANIFEST = "manifest.json"
+_FORMAT_VERSION = 1
+
+
+def _dp_size():
+    """Ambient dp mesh size at save time (recorded in the manifest so a
+    resumed run can reshard optimizer state when its dp differs)."""
+    from .parallel.mesh import current_mesh
+    mesh = current_mesh()
+    if mesh is not None and "dp" in mesh.axis_names:
+        return int(mesh.shape["dp"])
+    return 1
+
+
+class CheckpointManager:
+    """Atomic full-training-state checkpoints with retention + recovery.
+
+    Usage::
+
+        mgr = CheckpointManager("/ckpts", keep=3)
+        step = mgr.latest()
+        if step is not None:
+            manifest = mgr.restore(step, params=net, trainer=trainer)
+            start = manifest["step"]
+        ...
+        mgr.save(step, params=net, trainer=trainer,
+                 iterator={"epoch": e, "batch": b})
+        ...
+        mgr.wait_until_finished()
+
+    ``params`` may be a gluon ``Block``, a dict of ``Parameter``s, or a
+    dict of ``NDArray``s.  ``trainer`` is anything with the
+    ``state_dict()`` / ``load_state_dict()`` protocol (``gluon.Trainer``
+    and ``parallel.DataParallelTrainer`` both implement it; the latter
+    reshards its ZeRO-1 optimizer state when the restored dp size
+    differs from the saved one).
+    """
+
+    def __init__(self, directory, keep=None, prefix="ckpt",
+                 async_save=None):
+        self.directory = str(directory)
+        self.prefix = prefix
+        if keep is None:
+            keep = int(os.environ.get("MXTPU_CKPT_KEEP", "3"))
+        self.keep = max(1, int(keep))
+        if async_save is None:
+            async_save = os.environ.get("MXTPU_CKPT_ASYNC", "1") != "0"
+        self._async_save = bool(async_save)
+        self._writer = AsyncCheckpointer()
+        self._timeout = float(os.environ.get("MXTPU_CKPT_TIMEOUT", "0")) \
+            or None
+        os.makedirs(self.directory, exist_ok=True)
+
+    # -- naming ---------------------------------------------------------
+    def _step_dir(self, step):
+        return os.path.join(self.directory, f"{self.prefix}-{step:08d}")
+
+    def _scan(self):
+        """All on-disk (step, dir) candidates, newest first — validity
+        NOT checked here."""
+        pat = re.compile(re.escape(self.prefix) + r"-(\d+)$")
+        out = []
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return []
+        for name in names:
+            m = pat.match(name)
+            if m:
+                out.append((int(m.group(1)),
+                            os.path.join(self.directory, name)))
+        out.sort(reverse=True)
+        return out
+
+    # -- validation -----------------------------------------------------
+    def _validate(self, path):
+        """Manifest present + parses, every listed file present with the
+        recorded size and CRC32.  Returns the manifest dict or None.
+        This is what makes ``latest()`` skip torn (no/partial manifest)
+        and corrupt (flipped/truncated payload) checkpoints."""
+        mpath = os.path.join(path, _MANIFEST)
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        files = manifest.get("files")
+        if not isinstance(files, dict):
+            return None
+        for fname, rec in files.items():
+            fpath = os.path.join(path, fname)
+            try:
+                if os.path.getsize(fpath) != rec["nbytes"]:
+                    return None
+                if _file_crc(fpath) != rec["crc32"]:
+                    return None
+            except (OSError, KeyError, TypeError):
+                return None
+        return manifest
+
+    def latest(self):
+        """Newest step whose checkpoint validates; torn/corrupt
+        checkpoints are skipped (older valid ones still restore)."""
+        for step, path in self._scan():
+            if self._validate(path) is not None:
+                return step
+        return None
+
+    def steps(self):
+        """All valid steps, ascending."""
+        return sorted(step for step, path in self._scan()
+                      if self._validate(path) is not None)
+
+    def manifest(self, step):
+        """The validated manifest for ``step`` (None if torn/corrupt)."""
+        return self._validate(self._step_dir(step))
+
+    # -- save -----------------------------------------------------------
+    @staticmethod
+    def _param_arrays(params):
+        if params is None:
+            return {}
+        if hasattr(params, "_collect_params_with_prefix"):   # gluon Block
+            return {name: p.data() for name, p
+                    in params._collect_params_with_prefix().items()
+                    if p._data is not None}
+        out = {}
+        for name, v in dict(params).items():
+            out[name] = v.data() if hasattr(v, "set_data") else v
+        return out
+
+    def save(self, step, params=None, trainer=None, iterator=None,
+             extra=None, sync=False):
+        """Write checkpoint ``step``.  Device buffers are snapshotted
+        before returning; serialization runs on the writer thread unless
+        ``sync=True`` (or async saves are disabled).  Returns a ticket
+        (``.wait()``) for async saves, the checkpoint path for sync.
+
+        ``iterator`` is either a JSON-able cursor dict (e.g.
+        ``{"epoch": 2, "batch": 417}``) or an object with
+        ``state_dict()``.  ``extra`` is a JSON-able dict stored verbatim
+        in the manifest.
+        """
+        step = int(step)
+        groups = {}
+        meta = {"format": _FORMAT_VERSION, "step": step,
+                "time": time.time(), "dp": _dp_size()}
+        p_arrays = self._param_arrays(params)
+        if p_arrays:
+            groups["params"] = _snapshot(p_arrays)
+        if trainer is not None:
+            sd = trainer.state_dict()
+            groups["trainer"] = _snapshot(sd.get("arrays", {}))
+            meta["trainer_meta"] = sd.get("meta", {})
+        rng_arrays, rng_meta = _rng_state()
+        groups["rng"] = rng_arrays
+        meta["rng_meta"] = rng_meta
+        if iterator is not None:
+            cur = iterator.state_dict() \
+                if hasattr(iterator, "state_dict") else dict(iterator)
+            meta["iterator"] = cur
+        if extra is not None:
+            meta["extra"] = dict(extra)
+
+        def write():
+            return self._write(step, groups, meta)
+
+        if sync or not self._async_save:
+            # surface a previous async failure exactly like save() would
+            self._writer.wait_until_finished(self._timeout)
+            return write()
+        return self._writer._submit(write, desc=self._step_dir(step))
+
+    def _write(self, step, groups, meta):
+        path = self._step_dir(step)
+        if os.path.isdir(path):
+            shutil.rmtree(path)      # overwrite a previous torn attempt
+        os.makedirs(path, exist_ok=True)
+        files = {}
+        array_crc = {}
+        for group, arrays in groups.items():
+            fname = f"{group}.ndz"
+            fpath = os.path.join(path, fname)
+            _faults.fault_point("checkpoint.write", fpath)
+            # CRCs computed HERE, off the training thread: the snapshot
+            # arrays are immutable, so writer-side D2H is still the
+            # values of save() time
+            array_crc[group] = _array_crcs(arrays)
+            nd_utils.save(fpath, arrays)
+            files[fname] = {"nbytes": os.path.getsize(fpath),
+                            "crc32": _file_crc(fpath)}
+        manifest = dict(meta)
+        manifest["array_crc"] = array_crc
+        manifest["files"] = files
+        mpath = os.path.join(path, _MANIFEST)
+        tmp = mpath + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=1)
+            f.flush()
+            os.fsync(f.fileno())
+        # the commit point: a crash anywhere before this line leaves a
+        # manifest-less (torn) directory that latest() skips
+        _faults.fault_point("checkpoint.manifest", mpath)
+        os.replace(tmp, mpath)
+        self._retain(step)
+        return path
+
+    def _retain(self, just_written):
+        """Keep the newest ``keep`` valid checkpoints; drop older valid
+        ones and any torn leftovers older than the newest valid step."""
+        entries = self._scan()
+        valid = [(s, p) for s, p in entries
+                 if self._validate(p) is not None]
+        keep_steps = {s for s, _ in valid[:self.keep]}
+        newest_valid = valid[0][0] if valid else just_written
+        for step, path in entries:
+            if step in keep_steps:
+                continue
+            if self._validate(path) is None and step >= newest_valid:
+                continue       # possibly an in-progress write: leave it
+            try:
+                shutil.rmtree(path)
+            except OSError:
+                pass
+
+    def wait_until_finished(self, timeout=None):
+        """Join the in-flight write (re-raising its error)."""
+        return self._writer.wait_until_finished(
+            timeout if timeout is not None else self._timeout)
+
+    # -- restore --------------------------------------------------------
+    def _load_group(self, path, manifest, group):
+        fname = f"{group}.ndz"
+        if fname not in manifest.get("files", {}):
+            return {}
+        arrays = nd_utils.load(os.path.join(path, fname))
+        want = manifest.get("array_crc", {}).get(group, {})
+        got = _array_crcs(arrays)
+        for name, crc in want.items():
+            if got.get(name) != crc:
+                raise MXNetError(
+                    f"checkpoint {path}: array {group}/{name} CRC "
+                    f"mismatch (corrupt payload)")
+        return arrays
+
+    def restore(self, step=None, params=None, trainer=None,
+                restore_rng=True):
+        """Restore checkpoint ``step`` (default: :meth:`latest`).
+        Returns the manifest dict (cursor under ``"iterator"``), or
+        None when no valid checkpoint exists.
+
+        ``params``: gluon Block (set via structural names) or dict of
+        Parameters/NDArrays updated in place.  ``trainer``: restored via
+        ``load_state_dict`` — optimizer state is saved dp-independent,
+        so a trainer running at a different dp size reshards on load.
+        """
+        if step is None:
+            step = self.latest()
+            if step is None:
+                return None
+        path = self._step_dir(step)
+        manifest = self._validate(path)
+        if manifest is None:
+            raise MXNetError(
+                f"checkpoint step {step} at {path} is torn or corrupt")
+        if params is not None:
+            arrays = self._load_group(path, manifest, "params")
+            self._apply_params(params, arrays)
+        if trainer is not None:
+            arrays = self._load_group(path, manifest, "trainer")
+            trainer.load_state_dict(
+                {"arrays": arrays,
+                 "meta": manifest.get("trainer_meta", {})})
+        if restore_rng and "rng.ndz" in manifest.get("files", {}):
+            arrays = self._load_group(path, manifest, "rng")
+            _restore_rng(arrays, manifest["rng_meta"])
+        return manifest
+
+    @staticmethod
+    def _apply_params(params, arrays):
+        if hasattr(params, "_collect_params_with_prefix"):   # gluon Block
+            target = params._collect_params_with_prefix()
+            for name, value in arrays.items():
+                if name in target:
+                    target[name].set_data(value)
+                else:
+                    raise MXNetError(
+                        f"checkpoint parameter {name!r} not present in "
+                        f"the target block")
+            return
+        target = dict(params)
+        for name, value in arrays.items():
+            if name not in target:
+                raise MXNetError(
+                    f"checkpoint parameter {name!r} not present in the "
+                    f"target dict")
+            t = target[name]
+            if hasattr(t, "set_data"):
+                t.set_data(value)
+            elif isinstance(t, NDArray):
+                t._set_data(value.data)
+            else:
+                params[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Preemption handling
+# ---------------------------------------------------------------------------
+
+class PreemptionHandler:
+    """Cooperative SIGTERM/SIGINT handling: the first signal sets a flag
+    the training loop checks between steps (finish the in-flight step,
+    save, exit cleanly); a second signal raises ``KeyboardInterrupt``
+    (the operator really means it).
+
+    Installable as a context manager; signal registration silently
+    degrades to flag-only mode off the main thread (fault injection and
+    :meth:`request` still work there).
+    """
+
+    _current = None          # the installed handler (fault injection)
+
+    def __init__(self, signals=None):
+        self.signals = tuple(signals) if signals is not None else \
+            (_signal.SIGTERM, _signal.SIGINT)
+        self._event = threading.Event()
+        self.reason = None
+        self._prev = {}
+        self._installed_signals = False
+
+    # -- lifecycle ------------------------------------------------------
+    def install(self):
+        PreemptionHandler._current = self
+        try:
+            for sig in self.signals:
+                self._prev[sig] = _signal.signal(sig, self._on_signal)
+            self._installed_signals = True
+        except ValueError:       # not the main thread: flag-only mode
+            self._prev.clear()
+        return self
+
+    def uninstall(self):
+        if self._installed_signals:
+            for sig, prev in self._prev.items():
+                try:
+                    _signal.signal(sig, prev)
+                except (ValueError, TypeError):
+                    pass
+            self._prev.clear()
+            self._installed_signals = False
+        if PreemptionHandler._current is self:
+            PreemptionHandler._current = None
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+    @classmethod
+    def installed(cls):
+        """The currently installed handler (None outside a scope)."""
+        return cls._current
+
+    # -- signaling ------------------------------------------------------
+    def _on_signal(self, signum, frame):
+        if self._event.is_set():
+            raise KeyboardInterrupt(
+                f"second signal {signum} during preemption drain")
+        self.request(reason=f"signal {signum}")
+
+    def request(self, reason="requested"):
+        """Flip the preemption flag (signal handler, fault injector, or
+        orchestration code)."""
+        self.reason = reason
+        self._event.set()
+
+    @property
+    def requested(self):
+        return self._event.is_set()
+
+    def wait(self, timeout=None):
+        return self._event.wait(timeout)
+
+    def check_step(self, step):
+        """Per-step hook: runs the ``train.step`` fault point (so
+        ``inject("train.step", at=K, mode=preempt)`` and the
+        ``MXTPU_FAULT_INJECT`` env hook can deliver a simulated
+        preemption at step K) and returns whether preemption is
+        requested."""
+        _faults.fault_point("train.step", int(step))
+        return self.requested
+
+
+def run_preemptible(loop, manager=None, signals=None):
+    """Run ``loop(handler)`` under preemption protection.
+
+    Installs a :class:`PreemptionHandler` for the call's duration; the
+    loop checks ``handler.requested`` (or ``handler.check_step(step)``)
+    between steps, saves its final checkpoint via the manager, and
+    returns.  Afterwards the manager's in-flight async write is joined
+    so the process never exits with a half-written checkpoint.
+
+    Returns ``(preempted, result)``.
+    """
+    handler = PreemptionHandler(signals=signals)
+    with handler:
+        result = loop(handler)
+    if manager is not None:
+        manager.wait_until_finished()
+    return handler.requested, result

@@ -202,41 +202,138 @@ class Estimator:
                                           {"learning_rate": 0.01})
         self.stop_training = False
         self.current_epoch = 0
+        self.global_step = 0
+        self.preempted = False
 
     def prepare_loss_and_metrics(self):
         return self.train_metrics
 
+    def _resume(self, resume, manager):
+        """Restore the newest valid checkpoint (or step ``resume`` when
+        an int) into net + trainer + RNG; returns (start_epoch,
+        skip_batches) — the mid-epoch cursor to fast-forward to."""
+        if manager is None:
+            raise MXNetError(
+                'fit(resume=...) needs a checkpoint_manager')
+        step = None if resume == "auto" else int(resume)
+        manifest = manager.restore(step, params=self.net,
+                                   trainer=self.trainer)
+        if manifest is None:        # cold start: nothing saved yet
+            return 0, 0
+        self.global_step = int(manifest["step"])
+        cursor = manifest.get("iterator", {})
+        start_epoch = int(cursor.get("epoch", 0))
+        self.current_epoch = start_epoch
+        return start_epoch, int(cursor.get("batch", 0))
+
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
-            batches=None):
+            batches=None, resume=None, checkpoint_manager=None,
+            checkpoint_every=None):
+        """Train; with ``checkpoint_manager`` the loop is preemption-safe:
+
+        - ``checkpoint_every=N`` saves the full training state (params,
+          optimizer state, lr/update counters, iterator cursor, RNG)
+          every N global steps (async — the step never blocks on disk);
+        - SIGTERM/SIGINT finish the in-flight step, force-sync a final
+          checkpoint, and stop cleanly (``.preempted`` set);
+        - ``resume="auto"`` (or an int step) restores the newest valid
+          checkpoint — torn/corrupt ones are skipped — and fast-forwards
+          the data iterator to the saved mid-epoch cursor.
+        """
+        from ... import checkpoint as ckpt_mod
         if epochs is None and batches is None:
             raise MXNetError("specify epochs or batches")
+        start_epoch = skip_batches = 0
+        self.preempted = False
+        if resume is not None:
+            start_epoch, skip_batches = self._resume(
+                resume, checkpoint_manager)
         handlers = list(event_handlers or [])
-        handlers.append(StoppingHandler(epochs, batches))
+        stopping = StoppingHandler(epochs, batches)
+        handlers.append(stopping)
         handlers.append(MetricHandler(self.train_metrics))
         for h in handlers:
             if isinstance(h, TrainBegin):
                 h.train_begin(self)
-        self.stop_training = False
-        while not self.stop_training:
-            for h in handlers:
-                if isinstance(h, EpochBegin):
-                    h.epoch_begin(self)
-            for batch in train_data:
-                data, label = batch[0], batch[1]
-                with autograd.record():
-                    pred = self.net(data)
-                    loss = self.loss(pred, label)
-                loss.backward()
-                self.trainer.step(data.shape[0])
+        # resume-aware stopping: epochs/batches count TOTAL training
+        # progress, not progress-since-restart
+        stopping.current_epoch = start_epoch
+        stopping.current_batch = self.global_step
+        self.stop_training = (
+            (stopping.max_epoch is not None
+             and start_epoch >= stopping.max_epoch)
+            or (stopping.max_batch is not None
+                and self.global_step >= stopping.max_batch))
+        preempt = None
+        if checkpoint_manager is not None:
+            preempt = ckpt_mod.PreemptionHandler().install()
+        try:
+            while not self.stop_training:
                 for h in handlers:
-                    if isinstance(h, BatchEnd):
-                        h.batch_end(self, pred=pred, label=label, loss=loss)
-                if self.stop_training:
-                    break
+                    if isinstance(h, EpochBegin):
+                        h.epoch_begin(self)
+                batch_idx = 0
+                epoch_done = True
+                for batch in train_data:
+                    if skip_batches:
+                        # fast-forward to the saved mid-epoch cursor
+                        # (RNG was restored, so a deterministic pipeline
+                        # replays the same batches)
+                        skip_batches -= 1
+                        batch_idx += 1
+                        continue
+                    data, label = batch[0], batch[1]
+                    with autograd.record():
+                        pred = self.net(data)
+                        loss = self.loss(pred, label)
+                    loss.backward()
+                    self.trainer.step(data.shape[0])
+                    self.global_step += 1
+                    batch_idx += 1
+                    for h in handlers:
+                        if isinstance(h, BatchEnd):
+                            h.batch_end(self, pred=pred, label=label,
+                                        loss=loss)
+                    preempted = preempt is not None and \
+                        preempt.check_step(self.global_step)
+                    if checkpoint_manager is not None and (
+                            preempted or (checkpoint_every and
+                                          self.global_step %
+                                          checkpoint_every == 0)):
+                        # the in-flight step is DONE; a preemption save
+                        # is synchronous — the process may be about to
+                        # die and must not exit with a half-write
+                        checkpoint_manager.save(
+                            self.global_step, params=self.net,
+                            trainer=self.trainer,
+                            iterator={"epoch": self.current_epoch,
+                                      "batch": batch_idx},
+                            sync=preempted)
+                    if preempted:
+                        self.preempted = True
+                        self.stop_training = True
+                    if self.stop_training:
+                        epoch_done = not self.preempted
+                        break
+                if self.preempted:
+                    break           # mid-epoch: no epoch_end bookkeeping
+                for h in handlers:
+                    if isinstance(h, EpochEnd):
+                        h.epoch_end(self)
+                self.current_epoch += 1
+                if epoch_done and checkpoint_manager is not None and \
+                        checkpoint_every is None:
+                    # default cadence: one checkpoint per finished epoch
+                    checkpoint_manager.save(
+                        self.global_step, params=self.net,
+                        trainer=self.trainer,
+                        iterator={"epoch": self.current_epoch,
+                                  "batch": 0})
             for h in handlers:
-                if isinstance(h, EpochEnd):
-                    h.epoch_end(self)
-            self.current_epoch += 1
-        for h in handlers:
-            if isinstance(h, TrainEnd):
-                h.train_end(self)
+                if isinstance(h, TrainEnd):
+                    h.train_end(self)
+        finally:
+            if preempt is not None:
+                preempt.uninstall()
+            if checkpoint_manager is not None:
+                checkpoint_manager.wait_until_finished()

@@ -465,6 +465,76 @@ class Trainer:
             if param.grad_req == "add":
                 param.zero_grad()
 
+    # -- checkpoint protocol (mx.checkpoint.CheckpointManager) ----------
+    def _counters(self):
+        return {
+            "num_update": self._optimizer.num_update,
+            "begin_num_update": self._optimizer.begin_num_update,
+            "index_update_count": dict(self._optimizer._index_update_count),
+        }
+
+    def _set_counters(self, counters):
+        self._optimizer.num_update = counters.get("num_update", 0)
+        self._optimizer.begin_num_update = counters.get(
+            "begin_num_update", 0)
+        self._optimizer._index_update_count = {
+            int(k): v for k, v
+            in counters.get("index_update_count", {}).items()}
+
+    @staticmethod
+    def _encode_state(s, key, arrays):
+        """JSON-able layout descriptor + flat array dict for one param's
+        optimizer state (NDArray leaves, arbitrarily nested tuples —
+        multi-precision states nest (inner, master))."""
+        if s is None:
+            return None
+        if isinstance(s, NDArray):
+            arrays[key] = s
+            return "nd"
+        if isinstance(s, tuple):
+            return ["tuple", [Trainer._encode_state(x, f"{key}.{j}", arrays)
+                              for j, x in enumerate(s)]]
+        raise MXNetError(
+            f"cannot checkpoint optimizer state leaf of type {type(s)}")
+
+    @staticmethod
+    def _decode_state(desc, key, arrays):
+        if desc is None:
+            return None
+        if desc == "nd":
+            return arrays[key]
+        kind, items = desc
+        if kind == "tuple":
+            return tuple(Trainer._decode_state(d, f"{key}.{j}", arrays)
+                         for j, d in enumerate(items))
+        raise MXNetError(f"unknown optimizer state descriptor {desc!r}")
+
+    def state_dict(self):
+        """Full trainer state as ``{"arrays": {name: NDArray}, "meta":
+        json-able}`` — the CheckpointManager protocol.  Arrays are
+        host-materializable whatever their device placement (the
+        shard_updates mesh-resident state gathers on D2H), so the saved
+        form is dp-independent."""
+        arrays = {}
+        layout = {}
+        for i, s in self._states.items():
+            layout[str(i)] = self._encode_state(s, f"opt/{i}", arrays)
+        meta = {"kind": "gluon.Trainer",
+                "optimizer": type(self._optimizer).__name__,
+                "layout": layout, "counters": self._counters()}
+        return {"arrays": arrays, "meta": meta}
+
+    def load_state_dict(self, d):
+        """Inverse of :meth:`state_dict` onto this (possibly fresh)
+        trainer; the fused/sharded update paths re-place restored host
+        arrays onto the mesh on their next step."""
+        arrays, meta = d["arrays"], d["meta"]
+        states = {}
+        for k, desc in meta.get("layout", {}).items():
+            states[int(k)] = self._decode_state(desc, f"opt/{k}", arrays)
+        self._states = states
+        self._set_counters(meta.get("counters", {}))
+
     def save_states(self, fname):
         """Reference: Trainer.save_states (optimizer state incl. update
         counts — Adam/LAMB bias correction and lr schedules depend on them)."""

@@ -57,6 +57,16 @@ class DataIter:
     def reset(self):
         pass
 
+    # -- checkpoint cursor protocol (docs/FAULT_TOLERANCE.md) -----------
+    def state_dict(self):
+        """JSON-able resume cursor. Base iterators report nothing; the
+        estimator-level (epoch, batch) cursor still covers them via
+        skip-ahead replay."""
+        return {}
+
+    def set_state(self, state):
+        """Restore a :meth:`state_dict` cursor. Unknown keys ignored."""
+
     def next(self):
         if self.iter_next():
             return DataBatch(data=self.getdata(), label=self.getlabel(),
@@ -167,6 +177,15 @@ class NDArrayIter(DataIter):
                 self.cursor + self.batch_size > self.num_data:
             return self.cursor + self.batch_size - self.num_data
         return 0
+
+    def state_dict(self):
+        """Resume cursor: the batch cursor into this epoch's (already
+        shuffled) order.  Shuffle order itself reproduces from the
+        checkpointed numpy RNG state, not from here."""
+        return {"cursor": int(self.cursor)}
+
+    def set_state(self, state):
+        self.cursor = int(state.get("cursor", -self.batch_size))
 
 
 class CSVIter(NDArrayIter):
@@ -425,6 +444,34 @@ class ImageRecordIter(DataIter):
 
     def iter_next(self):
         return self._pos + self.batch_size <= len(self._dataset)
+
+    def state_dict(self):
+        """Resume cursor: sample position within this epoch's order.
+        The order itself reproduces from the checkpointed numpy RNG
+        (shuffle draws come from ``np.random``)."""
+        return {"pos": int(self._pos)}
+
+    def set_state(self, state):
+        """Reposition to a :meth:`state_dict` cursor: the next batch
+        decoded is the one the interrupted run would have decoded (the
+        threaded decode fan-out is rebuilt from the cursor so already-
+        consumed samples are not re-decoded)."""
+        pos = int(state.get("pos", 0))
+        if pos % self.batch_size:
+            raise MXNetError(
+                f"ImageRecordIter.set_state: pos {pos} is not a batch "
+                f"boundary (batch_size {self.batch_size})")
+        self._pos = pos
+        if self._async_iter is not None:
+            self._async_iter.close()
+            self._async_iter = None
+        if not self._use_native:
+            from .. import debug as _debug
+            if self._n_threads > 1 and not _debug.determinism_enabled():
+                self._async_iter = AsyncDecodeIter(
+                    self._decode_sample, self._order[pos:],
+                    self.batch_size, n_workers=self._n_threads,
+                    lookahead=2)
 
     def close(self):
         """Shut down the threaded decode fan-out (no leaked workers)."""

@@ -154,6 +154,11 @@ class DevicePrefetcher:
         self._stop = threading.Event()
         self._finished = False
         self._last_yield = None
+        self._consumed = 0      # batches DELIVERED to the consumer: the
+                                # honest resume cursor (the worker reads
+                                # ahead of it by up to `depth` batches)
+        self._skip = 0          # set_state replay-skip, applied by the
+                                # worker on ITS source iterator
 
     # -- sharding -------------------------------------------------------
     def _leaf_sharding(self, x):
@@ -241,6 +246,13 @@ class DevicePrefetcher:
     def _worker(self):
         try:
             it = iter(self._source)
+            while self._skip > 0:   # set_state replay-skip (sources
+                self._skip -= 1     # without their own cursor)
+                try:
+                    next(it)
+                except StopIteration:
+                    self._skip = 0
+                    break
         except Exception as e:  # noqa: BLE001 — surface in consumer
             self._enqueue(_WorkerFailure(e))
             return
@@ -305,6 +317,7 @@ class DevicePrefetcher:
             self._shutdown()
             raise got.exc
         self._last_yield = t_got
+        self._consumed += 1
         return got[0]
 
     def next(self):
@@ -346,6 +359,41 @@ class DevicePrefetcher:
             reset()
         self._finished = False
         self._last_yield = None
+        self._consumed = 0
+        self._skip = 0
+
+    # -- checkpoint cursor protocol -------------------------------------
+    @property
+    def batches_consumed(self):
+        return self._consumed
+
+    def state_dict(self):
+        """Resume cursor: batches DELIVERED (not the worker's read-ahead
+        position — up to ``depth`` prefetched-but-unconsumed batches must
+        be replayed, not skipped).  Includes the source's own cursor when
+        it has one."""
+        state = {"batches_consumed": self._consumed}
+        src_state = getattr(self._source, "state_dict", None)
+        if callable(src_state):
+            s = src_state()
+            if s:
+                state["source"] = s
+        return state
+
+    def set_state(self, state):
+        """Reposition: reset, then either hand the source its own cursor
+        (no replay decode) or have the worker skip-replay
+        ``batches_consumed`` batches on ITS iterator (never through the
+        device stage)."""
+        self.reset()
+        n = int(state.get("batches_consumed", 0))
+        src_set = getattr(self._source, "set_state", None)
+        if "source" in state and callable(src_set):
+            src_set(state["source"])
+            self._skip = 0
+        else:
+            self._skip = n
+        self._consumed = n
 
     def __enter__(self):
         return self

@@ -241,6 +241,9 @@ class PSServer:
         # 0 disables, like ps-lite's default.
         self._hb_timeout = heartbeat_timeout if heartbeat_timeout \
             is not None else _ENV_HB_TIMEOUT()
+        self._now = time.time     # injectable clock: the fault-harness
+                                  # tests drive death detection with a
+                                  # FakeClock instead of real sleeps
         self._last_seen = {}      # rank -> last heartbeat time
         self._dead = {}           # rank -> time declared dead
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -263,22 +266,32 @@ class PSServer:
         tick = max(0.2, self._hb_timeout / 4.0)
         while self._sock.fileno() != -1:   # dies with the listen socket
             time.sleep(tick)
-            now = time.time()
-            newly_dead = []
-            with self._lock:
-                for rank, seen in self._last_seen.items():
-                    if rank not in self._dead and \
-                            now - seen > self._hb_timeout:
-                        self._dead[rank] = now
-                        newly_dead.append((rank, now - seen))
-            for rank, age in newly_dead:
-                print(f"[ps_server] worker rank {rank} declared DEAD: "
-                      f"no heartbeat for {age:.1f}s "
-                      f"(timeout {self._hb_timeout:.1f}s); dist_async "
-                      f"continues with the remaining workers", flush=True)
-            if newly_dead:
-                with self._barrier_cv:
-                    self._barrier_cv.notify_all()
+            self._scan_dead()
+
+    def _scan_dead(self, now=None):
+        """ONE death-detection pass: declare every rank silent past the
+        timeout dead, log it, and wake barrier waiters.  Factored out of
+        the monitor loop so the fault-injection tests can drive it
+        deterministically (``now`` from a FakeClock) — no wall-clock
+        sleeps.  Returns the ranks newly declared dead."""
+        if now is None:
+            now = self._now()
+        newly_dead = []
+        with self._lock:
+            for rank, seen in self._last_seen.items():
+                if rank not in self._dead and \
+                        now - seen > self._hb_timeout:
+                    self._dead[rank] = now
+                    newly_dead.append((rank, now - seen))
+        for rank, age in newly_dead:
+            print(f"[ps_server] worker rank {rank} declared DEAD: "
+                  f"no heartbeat for {age:.1f}s "
+                  f"(timeout {self._hb_timeout:.1f}s); dist_async "
+                  f"continues with the remaining workers", flush=True)
+        if newly_dead:
+            with self._barrier_cv:
+                self._barrier_cv.notify_all()
+        return [rank for rank, _ in newly_dead]
 
     def dead_workers(self):
         with self._lock:
@@ -415,7 +428,7 @@ class PSServer:
         elif op == _OP_HEARTBEAT:
             (rank,) = struct.unpack_from("<i", frame, off)
             with self._lock:
-                self._last_seen[rank] = time.time()
+                self._last_seen[rank] = self._now()
                 if rank in self._dead:
                     # a beat from a "dead" rank: it was only slow (or the
                     # launcher restarted it) — log the rejoin, async mode
@@ -425,7 +438,7 @@ class PSServer:
                           f"resumed; marking alive again", flush=True)
             _send_frame(conn, bytes([_OP_OK]))
         elif op == _OP_HEALTH:
-            now = time.time()
+            now = self._now()
             with self._lock:
                 health = {"alive": {str(r): round(now - t, 2)
                                     for r, t in self._last_seen.items()
@@ -543,6 +556,19 @@ class PSClient:
         heartbeat_timeout, num_workers}."""
         return self._rpc(bytes([_OP_HEALTH]))
 
+    def beat_once(self, rank):
+        """Send ONE heartbeat for ``rank`` synchronously over the RPC
+        socket (deterministic tests; the production path is the
+        :meth:`start_heartbeat` thread).  Honors the
+        ``ps.heartbeat.drop`` fault point — an armed drop simulates a
+        silent worker without killing anything.  Returns False when the
+        beat was dropped."""
+        from ..testing import faults as _faults
+        if _faults.fault_point("ps.heartbeat.drop", rank) == "drop":
+            return False
+        self._rpc(bytes([_OP_HEARTBEAT]) + struct.pack("<i", int(rank)))
+        return True
+
     def start_heartbeat(self, rank, interval=None):
         """Beat this worker's rank to the server from a daemon thread.
 
@@ -564,9 +590,14 @@ class PSClient:
         payload = bytes([_OP_HEARTBEAT]) + struct.pack("<i", int(rank))
 
         def _beat():
+            from ..testing import faults as _faults
             sock = None
             while not stop.is_set():
                 try:
+                    if _faults.fault_point("ps.heartbeat.drop",
+                                           rank) == "drop":
+                        stop.wait(interval)    # silent worker simulation
+                        continue
                     if sock is None:
                         sock = socket.create_connection(self._addr,
                                                         timeout=30)

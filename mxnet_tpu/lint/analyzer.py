@@ -49,6 +49,9 @@ _TRANSFER_METHODS = {"as_in_context", "as_in_ctx", "copyto"}
 _OP_NAMESPACE_NAMES = {"F", "nd", "npx"}
 # module roots whose ``.random`` submodule is host RNG (HB05)
 _HOST_RNG_ROOTS = {"np", "numpy", "_np", "onp"}
+# host process-control calls that must never live in a forward (HB08)
+_SIGNAL_CALLS = {"signal.signal", "signal.raise_signal", "signal.alarm",
+                 "os.kill", "os.killpg"}
 
 
 class _Taint:
@@ -434,6 +437,17 @@ class _FunctionAnalyzer(ast.NodeVisitor):
                     "use F.random.* (threads the per-call PRNG key)")
                 self._arg_taints(node)
                 return _HOST
+            if dotted in _SIGNAL_CALLS:
+                self._report(
+                    "HB08", node,
+                    f"`{dotted}()` inside a traced forward: host "
+                    "process control runs once at trace time (never on "
+                    "replay) and signal registration is main-thread-"
+                    "only; install handlers at startup "
+                    "(mx.checkpoint.PreemptionHandler), keep forwards "
+                    "pure")
+                self._arg_taints(node)
+                return _NONE
 
         recv_taint = self.ev(recv)
 

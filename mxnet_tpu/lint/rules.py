@@ -103,6 +103,22 @@ RULES = {
         "keys = list(range(len(params)))\n"
         "grads = [p.grad() for p in params]\n"
         "kv.pushpull(keys, grads, out=grads)   # one bucketed round"),
+    "HB08": Rule(
+        "HB08", "signal-in-forward",
+        "`signal.signal` / `signal.raise_signal` / `os.kill` / "
+        "`os.killpg` inside a HybridBlock forward: host-side process "
+        "control is a side effect — under jax.jit it runs once at "
+        "trace time (never again on replay), and signal handler "
+        "registration is only legal on the main thread while traces "
+        "may run anywhere. Install handlers at startup "
+        "(mx.checkpoint.PreemptionHandler) and keep forwards pure.",
+        "def hybrid_forward(self, F, x):\n"
+        "    signal.signal(signal.SIGTERM, self._on_term)\n"
+        "    return self.body(x)",
+        "# startup, outside any forward:\n"
+        "# with mx.checkpoint.PreemptionHandler() as h: ...\n"
+        "def hybrid_forward(self, F, x):\n"
+        "    return self.body(x)"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

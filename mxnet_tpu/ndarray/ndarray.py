@@ -220,6 +220,8 @@ class NDArray:
     # ------------------------------------------------------------------
     def asnumpy(self):
         """Sync point: reference MXNDArraySyncCopyToCPU → WaitForVar."""
+        from ..testing import faults as _faults
+        _faults.fault_point("ndarray.d2h")
         return _np.asarray(jax.device_get(self._data))
 
     def asscalar(self):

@@ -23,7 +23,7 @@ from .ndarray import NDArray, _put, _dtype_of
 __all__ = ["seed", "uniform", "normal", "randn", "randint", "gamma",
            "exponential", "poisson", "shuffle", "multinomial", "bernoulli",
            "negative_binomial", "generalized_negative_binomial",
-           "next_key", "current_key"]
+           "next_key", "current_key", "get_key_data", "set_key_data"]
 
 
 class _RandState(threading.local):
@@ -74,6 +74,18 @@ class trace_key_scope:
 
 def current_key():
     return _STATE.key
+
+
+def get_key_data():
+    """Serializable uint32 view of the global PRNG key (checkpointing:
+    ``mx.checkpoint.CheckpointManager`` snapshots the RNG stream so a
+    resumed run replays the exact draws an uninterrupted one makes)."""
+    return jax.random.key_data(_STATE.key)
+
+
+def set_key_data(data):
+    """Inverse of :func:`get_key_data`: restore the global PRNG key."""
+    _STATE.key = jax.random.wrap_key_data(jnp.asarray(data, jnp.uint32))
 
 
 def _shape(shape):

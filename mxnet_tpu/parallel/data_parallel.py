@@ -621,6 +621,144 @@ class DataParallelTrainer:
             p._data._set_data(v)
         return NDArray(loss)
 
+    # -- checkpoint protocol (mx.checkpoint.CheckpointManager) ----------
+    def _require_params(self):
+        if self._param_objs is None:
+            params = sorted(self.block.collect_params().items())
+            if any(p._data is None for _, p in params):
+                raise MXNetError(
+                    "DataParallelTrainer state restore needs resolved "
+                    "parameter shapes: restore the net's parameters "
+                    "first (CheckpointManager does params before "
+                    "trainer) or run one forward")
+            self._param_objs = [p for _, p in params]
+        self._ensure_device_state(self._param_objs)
+        return self._param_objs
+
+    def state_dict(self):
+        """Optimizer state in PER-PARAMETER space — dp-independent, so a
+        resumed run with a different dp size (or with ``shard_updates``
+        toggled) rebuckets/reshards on load instead of being stuck with
+        the saved topology.  ZeRO-1 bucket vectors are sliced back to
+        per-parameter arrays (the D2H gathers the 1/dp shards); bucket
+        scalars (e.g. Adam's ``t``) are identical across buckets and
+        saved once."""
+        from ..ndarray.ndarray import NDArray as _ND
+        arrays, leaves = {}, {}
+        if self._opt_state is not None:
+            params = self._param_objs
+            if self._zero1_active():
+                plan = self._zero1_ensure_plan()
+                full = {}       # bucket id -> {leaf: host flat vector}
+                for b, state_b in enumerate(self._opt_state):
+                    full[b] = {}
+                    for name, leaf in state_b.items():
+                        if getattr(leaf, "ndim", 0) >= 1:
+                            full[b][name] = _np.asarray(
+                                jax.device_get(leaf))
+                            leaves[name] = "vec"
+                        elif name not in leaves:
+                            arrays[f"opt_scalar/{name}"] = _ND(
+                                jnp.asarray(leaf))
+                            leaves[name] = "scalar"
+                for i, p in enumerate(params):
+                    b, off, n = plan.param_span(i)
+                    for name, vec in full[b].items():
+                        arrays[f"opt/{i}/{name}"] = _ND(jnp.asarray(
+                            vec[off:off + n].reshape(plan.shapes[i])))
+            else:
+                for i, state in enumerate(self._opt_state):
+                    for name, leaf in state.items():
+                        if getattr(leaf, "ndim", 0) >= 1:
+                            arrays[f"opt/{i}/{name}"] = _ND(leaf)
+                            leaves[name] = "vec"
+                        else:
+                            arrays[f"opt/{i}/{name}"] = _ND(
+                                jnp.asarray(leaf))
+                            leaves.setdefault(name, "per_param_scalar")
+        meta = {"kind": "parallel.DataParallelTrainer",
+                "rule": self._rule_name,
+                "num_update": int(self._num_update),
+                "saved_dp": int(self.mesh.shape.get("dp", 1)),
+                "zero1": bool(self._opt_state is not None
+                              and self._zero1_active()),
+                "leaves": leaves}
+        return {"arrays": arrays, "meta": meta}
+
+    def load_state_dict(self, d):
+        """Inverse of :meth:`state_dict`, resharding for THIS trainer's
+        topology: under ZeRO-1 the per-parameter arrays are re-flattened
+        into this dp size's bucket plan (padding recomputed) and
+        device_put 1/dp-sharded; replicated mode loads per-parameter
+        trees.  A checkpoint saved at dp=8 restores at dp=2 (or 1) and
+        vice versa."""
+        arrays, meta = d["arrays"], d["meta"]
+        self._num_update = int(meta.get("num_update", 0))
+        leaves = meta.get("leaves", {})
+        if not leaves:
+            return                  # no optimizer state yet at save time
+        params = self._require_params()
+
+        def host(a):
+            return _np.asarray(a.asnumpy())
+
+        if self._zero1_active():
+            plan = self._zero1_ensure_plan()
+            shard = NamedSharding(self.mesh, P("dp"))
+            rep = NamedSharding(self.mesh, P())
+            # template fixes the leaf set + dtypes for this rule
+            template = self._rule_init(jnp.zeros((1,), jnp.float32))
+            new_state = []
+            for b in range(plan.n_buckets):
+                state_b = {}
+                for name in template:
+                    if leaves.get(name) == "vec":
+                        flat = _np.zeros((plan.lengths[b],), _np.float32)
+                        for i in plan.buckets[b]:
+                            _, off, n = plan.param_span(i)
+                            flat[off:off + n] = host(
+                                arrays[f"opt/{i}/{name}"]).reshape(-1)
+                        state_b[name] = jax.device_put(
+                            jnp.asarray(flat), shard)
+                    else:
+                        # bucket scalar: ``opt_scalar/<name>`` (zero1
+                        # save) or any per-param copy (replicated save —
+                        # all params share the value, e.g. Adam's t)
+                        key = f"opt_scalar/{name}" \
+                            if f"opt_scalar/{name}" in arrays \
+                            else f"opt/0/{name}"
+                        val = host(arrays[key]).reshape(())
+                        state_b[name] = jax.device_put(
+                            jnp.asarray(val, template[name].dtype), rep)
+                new_state.append(state_b)
+            self._opt_state = new_state
+        else:
+            rep = NamedSharding(self.mesh, P())
+            new_state = []
+            for i, v in enumerate(self._param_vals):
+                template = self._rule_init(v)
+                state_i = {}
+                for name, tleaf in template.items():
+                    if tleaf.ndim >= 1:
+                        src = host(arrays[f"opt/{i}/{name}"])
+                        state_i[name] = jax.device_put(
+                            jnp.asarray(src, tleaf.dtype).reshape(
+                                tleaf.shape), rep)
+                    else:
+                        key = f"opt/{i}/{name}" \
+                            if f"opt/{i}/{name}" in arrays \
+                            else f"opt_scalar/{name}"
+                        state_i[name] = jax.device_put(
+                            jnp.asarray(host(arrays[key]).reshape(()),
+                                        tleaf.dtype), rep)
+                new_state.append(state_i)
+            self._opt_state = new_state
+        # params themselves were restored into the block; re-place them
+        # on the mesh so the next step starts from the restored values
+        self._param_vals = [
+            jax.device_put(p.data().data, self._param_sharding(p))
+            for p in params]
+
     # -- observability ---------------------------------------------------
     def comm_stats(self, measure=False, iters=10, step_ms=None):
         """The per-step ``comm`` block (parallel/zero.py schema): static

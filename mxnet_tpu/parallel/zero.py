@@ -122,6 +122,16 @@ class BucketPlan:
     def shard_length(self, b):
         return self.lengths[b] // self.dp
 
+    def param_span(self, i):
+        """``(bucket_id, offset, size)`` of parameter ``i``'s span in
+        bucket space — the state-resharding primitive
+        (docs/FAULT_TOLERANCE.md): checkpoint save slices bucket-space
+        optimizer-state vectors back to per-parameter arrays with this,
+        and restore re-flattens them into whatever dp size's plan the
+        resumed run built (padding never hits disk)."""
+        b, off = self.offsets[i]
+        return b, off, self.sizes[i]
+
     def flatten(self, arrays):
         """Per-bucket flat f32 arrays (concat in plan order + zero pad)."""
         out = []

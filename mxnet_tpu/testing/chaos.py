@@ -1,0 +1,191 @@
+"""Kill-and-resume chaos smoke: the fault-tolerance layer end to end.
+
+``python -m mxnet_tpu.testing.chaos`` (or ``tools/tpu_queue_runner.py
+--chaos``) runs, on the simulated CPU mesh, the exact scenario the
+acceptance bar demands — in one process, deterministically:
+
+1. **Reference run**: N training steps, uninterrupted; final params +
+   optimizer state recorded.
+2. **Chaos run**: same seed/data.  The checkpoint writer is killed on
+   its first attempt (the save must survive via the next one), a
+   simulated preemption fires at step K, the preemption save goes
+   through, and the newest checkpoint is then CORRUPTED on disk — so
+   resume must fall back to the previous valid one and replay forward.
+3. **Resume**: a fresh net/trainer auto-resumes from ``latest()``
+   (skipping the corrupted checkpoint), trains to N total steps, and
+   must match the reference run BITWISE (params and optimizer state).
+
+Runs the scenario twice: plain ``gluon.Trainer`` and
+``DataParallelTrainer(shard_updates=True)``.  Prints one JSON verdict
+line; exit code 0 only if every check passed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+import numpy as _np
+
+
+def _make_data(seed, n_batches=8, batch=16, din=8, dout=4):
+    rng = _np.random.RandomState(seed)
+    xs = rng.randn(n_batches, batch, din).astype(_np.float32)
+    ys = rng.randn(n_batches, batch, dout).astype(_np.float32)
+    return xs, ys
+
+
+def _build(mode, dout=4):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    mx.random.seed(1234)
+    _np.random.seed(1234)
+    net = gluon.nn.Dense(dout)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    if mode == "sharded":
+        trainer = parallel.DataParallelTrainer(
+            net, loss_fn, "adam", {"learning_rate": 0.05},
+            shard_updates=True)
+
+        def step(x, y):
+            return trainer.step(mx.nd.array(x), mx.nd.array(y))
+    else:
+        trainer = gluon.Trainer(net.collect_params(), "adam",
+                                {"learning_rate": 0.05})
+
+        def step(x, y):
+            from mxnet_tpu import autograd
+            xb, yb = mx.nd.array(x), mx.nd.array(y)
+            with autograd.record():
+                loss = loss_fn(net(xb), yb)
+            loss.backward()
+            trainer.step(xb.shape[0])
+            return loss
+    return net, trainer, step
+
+
+def _params_of(net):
+    return {name: p.data().asnumpy()
+            for name, p in net._collect_params_with_prefix().items()}
+
+
+def _state_of(trainer):
+    sd = trainer.state_dict()
+    return {k: v.asnumpy() for k, v in sd["arrays"].items()}
+
+
+def _bitwise(a, b):
+    return set(a) == set(b) and \
+        all(_np.array_equal(a[k], b[k]) for k in a)
+
+
+def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None):
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.checkpoint import CheckpointManager, run_preemptible
+    from mxnet_tpu.testing import faults
+
+    ckdir = os.path.join(workdir, f"ckpt-{mode}")
+    xs, ys = _make_data(99)
+    result = {"mode": mode, "preempt_at": preempt_at,
+              "total_steps": total_steps}
+
+    # 1. reference: uninterrupted
+    net, trainer, step = _build(mode)
+    for i in range(total_steps):
+        step(xs[i], ys[i])
+    ref_params, ref_state = _params_of(net), _state_of(trainer)
+
+    # 2. chaos run: writer killed on attempt 1, preempted at step K
+    net, trainer, step = _build(mode)
+    mgr = CheckpointManager(ckdir, keep=3)
+    writer_died = False
+
+    def loop(handler):
+        nonlocal writer_died
+        for i in range(total_steps):
+            step(xs[i], ys[i])
+            done = i + 1
+            if handler.check_step(done):
+                # preemption: force-sync the final checkpoint and stop
+                mgr.save(done, params=net, trainer=trainer,
+                         iterator={"batch": done}, sync=True)
+                return done
+            if done == 1:
+                # kill THIS save's writer thread; the error must surface
+                # on the NEXT save without dropping that next snapshot
+                with faults.inject("checkpoint.write", times=1):
+                    t1 = mgr.save(done, params=net, trainer=trainer,
+                                  iterator={"batch": done})
+                    # writer must HIT the armed fault before it disarms;
+                    # the error stays unconsumed for the next save
+                    t1._done.wait(30)
+            else:
+                try:
+                    ticket = mgr.save(done, params=net, trainer=trainer,
+                                      iterator={"batch": done})
+                except MXNetError as e:
+                    writer_died = True   # previous writer's death
+                    ticket = getattr(e, "pending_ticket", None)
+                if ticket is not None:
+                    ticket.wait()
+        return total_steps
+
+    with faults.inject("train.step", at=preempt_at,
+                       action=faults.preempt_action):
+        preempted, stopped_at = run_preemptible(loop, mgr)
+    result["writer_kill_surfaced"] = writer_died
+    result["preempted_at"] = stopped_at
+    result["preempted"] = preempted
+
+    # 3. corrupt the newest checkpoint: latest() must skip to an older one
+    newest = mgr.latest()
+    faults.corrupt_file(os.path.join(
+        mgr._step_dir(newest), "params.ndz"))
+    fallback = mgr.latest()
+    result["corrupt_skipped"] = {"newest": newest, "fallback": fallback,
+                                 "ok": fallback is not None
+                                 and fallback < newest}
+
+    # 4. resume from the surviving checkpoint, replay to total_steps
+    net, trainer, step = _build(mode)
+    # resolve shapes before trainer state restore
+    import mxnet_tpu as mx
+    net(mx.nd.array(xs[0]))
+    manifest = mgr.restore(params=net, trainer=trainer)
+    start = manifest["iterator"]["batch"]
+    result["resumed_from"] = manifest["step"]
+    for i in range(start, total_steps):
+        step(xs[i], ys[i])
+    result["params_bitwise"] = _bitwise(ref_params, _params_of(net))
+    result["state_bitwise"] = _bitwise(ref_state, _state_of(trainer))
+    result["ok"] = bool(
+        result["params_bitwise"] and result["state_bitwise"]
+        and result["corrupt_skipped"]["ok"] and preempted
+        and writer_died)
+    return result
+
+
+def main(argv=None):
+    # the smoke must run anywhere — force the simulated CPU mesh exactly
+    # like tests/conftest.py does
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    workdir = tempfile.mkdtemp(prefix="mxtpu-chaos-")
+    try:
+        results = [run_scenario(mode, workdir=workdir)
+                   for mode in ("plain", "sharded")]
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    ok = all(r["ok"] for r in results)
+    print(json.dumps({"chaos": results, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -25,3 +25,37 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" in flags:
     n = None  # caller already chose a device count; keep it
 force_cpu(n)
+
+import threading
+
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def no_leaked_nondaemon_threads():
+    """Fail any test that leaves a live NON-daemon thread behind
+    (leaked checkpoint writers, heartbeat loops, decode pools —
+    ThreadPoolExecutor workers are non-daemon, so an unclosed pool
+    would otherwise hang the run at interpreter exit and only show up
+    as a CI timeout).  Daemon threads are excluded: the framework's
+    long-lived service threads (PS accept loops, prefetchers) are
+    deliberately daemonic."""
+    before = {t.ident for t in threading.enumerate()}
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t.is_alive() and not t.daemon and t.ident not in before
+              and t is not threading.current_thread()]
+    if not leaked:
+        return
+    # grace: threads mid-shutdown (e.g. a pool drained by close()) get
+    # a moment to exit before we call it a leak — one SHARED 2 s budget,
+    # not 2 s per thread
+    import time as _time
+    end = _time.monotonic() + 2.0
+    for t in leaked:
+        t.join(timeout=max(0.0, end - _time.monotonic()))
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, (
+        "test leaked live non-daemon thread(s): "
+        + ", ".join(repr(t.name) for t in leaked)
+        + " — close() your iterators/pools or mark the thread daemon")

@@ -1,0 +1,159 @@
+"""Fault-injection harness semantics (mxnet_tpu/testing/faults.py) and
+the deterministic PS heartbeat death path it enables."""
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.testing import faults
+
+
+def test_fault_point_is_noop_when_unarmed():
+    assert faults.fault_point("nothing.armed") is None
+    assert faults.active() == []
+
+
+def test_inject_at_and_times_hit_counting():
+    fired = []
+    with faults.inject("x", action=lambda p: fired.append(p),
+                       at=2, times=2):
+        for i in range(5):
+            faults.fault_point("x", f"hit{i}")
+    assert fired == ["hit1", "hit2"]      # hits 2 and 3 only
+    assert faults.fault_point("x") is None  # disarmed on scope exit
+
+
+def test_inject_step_indexed_matching_for_int_payloads():
+    """With an integer payload and at=K, the fault fires when the
+    PAYLOAD reaches K (step semantics), not on the K-th call."""
+    fired = []
+    with faults.inject("train.step", at=7,
+                       action=lambda p: fired.append(p)):
+        for step in (1, 2, 3, 7, 8):
+            faults.fault_point("train.step", step)
+    assert fired == [7, 8]
+
+
+def test_inject_default_raises_fault_injected():
+    with faults.inject("boom"):
+        with pytest.raises(faults.FaultInjected):
+            faults.fault_point("boom")
+    assert issubclass(faults.FaultInjected, MXNetError)
+
+
+def test_inject_custom_exception_and_nesting_restores_previous():
+    with faults.inject("y", exc=OSError("disk full")):
+        with faults.inject("y", exc=ValueError("inner")):
+            with pytest.raises(ValueError):
+                faults.fault_point("y")
+        with pytest.raises(OSError, match="disk full"):
+            faults.fault_point("y")
+    assert faults.fault_point("y") is None
+
+
+def test_env_hook_parses_spec(monkeypatch):
+    monkeypatch.setenv("MXTPU_FAULT_INJECT",
+                       "a.b:at=2:times=1, c.d:mode=drop")
+    faults.reset()
+    monkeypatch.setattr(faults, "_env_parsed", False)
+    assert faults.fault_point("a.b") is None          # hit 1: below at
+    with pytest.raises(faults.FaultInjected, match="a.b"):
+        faults.fault_point("a.b")                     # hit 2: fires
+    assert faults.fault_point("a.b") is None          # times=1 spent
+    assert faults.fault_point("c.d") == "drop"
+    faults.reset()
+
+
+def test_file_corruption_helpers(tmp_path):
+    p = str(tmp_path / "blob.bin")
+    payload = bytes(range(256)) * 8
+    with open(p, "wb") as f:
+        f.write(payload)
+    faults.corrupt_file(p)
+    with open(p, "rb") as f:
+        corrupted = f.read()
+    assert len(corrupted) == len(payload) and corrupted != payload
+    faults.truncate_file(p, keep_bytes=16)
+    assert os.path.getsize(p) == 16
+
+
+def test_fake_clock():
+    clock = faults.FakeClock(100.0)
+    assert clock() == 100.0
+    assert clock.advance(5.5) == 105.5
+    assert clock() == 105.5
+
+
+# ----------------------------------------------------------------------
+# Deterministic PS heartbeat death path (satellite: replaces wall-clock
+# sleeps with an injected clock + heartbeat-drop fault)
+# ----------------------------------------------------------------------
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_ps_heartbeat_death_path_deterministic():
+    """Rank 1 goes silent (heartbeat-drop fault), the injected clock
+    advances past the timeout, ONE explicit scan declares it dead,
+    barriers abort naming the rank, survivors keep push/pulling, and a
+    resumed beat rejoins — zero wall-clock sleeps anywhere."""
+    from mxnet_tpu.kvstore.ps_server import PSServer, PSClient
+
+    clock = faults.FakeClock(1000.0)
+    port = _free_port()
+    srv = PSServer("127.0.0.1", port, num_workers=2,
+                   heartbeat_timeout=5.0)
+    srv._now = clock                 # injectable clock: the monitor
+    # thread keeps ticking against the frozen time, harmlessly
+    c0 = PSClient("127.0.0.1", port)
+    c1 = PSClient("127.0.0.1", port)
+    try:
+        assert c0.beat_once(0) and c1.beat_once(1)
+        assert srv._scan_dead() == []          # both fresh
+
+        clock.advance(3.0)
+        assert c0.beat_once(0)                 # rank 0 refreshes
+        with faults.inject("ps.heartbeat.drop", action="drop"):
+            assert not c1.beat_once(1)         # rank 1 silently dropped
+        clock.advance(3.0)                     # rank 1 silent for 6 s
+        assert srv._scan_dead() == [1]
+        assert srv.dead_workers() == [1]
+
+        health = c0.health()
+        assert health["dead"] == [1]
+        assert "0" in health["alive"]
+
+        # barrier aborts cleanly, naming the dead rank — no hang
+        with pytest.raises(MXNetError, match=r"rank\(s\) \[1\]"):
+            c0.barrier()
+
+        # async degrade: the survivor keeps pushing/pulling
+        c0.init("w", np.ones(4, np.float32))
+        c0.push("w", np.ones(4, np.float32))
+        np.testing.assert_allclose(c0.pull("w"),
+                                   2.0 * np.ones(4, np.float32))
+
+        # the "dead" rank beats again: rejoin, barrier works again
+        assert c1.beat_once(1)
+        assert srv.dead_workers() == []
+        import threading
+        done = []
+        t = threading.Thread(target=lambda: done.append(c0.barrier()),
+                             daemon=True)
+        t.start()
+        time.sleep(0.05)            # let rank 0 park in the barrier
+        c1.barrier()                # rank 1 completes it
+        t.join(10)
+        assert not t.is_alive()
+    finally:
+        c0.close()
+        c1.close()
+        srv._sock.close()

@@ -538,3 +538,64 @@ def test_hb07_in_rule_catalog_and_package_clean():
     viol, n_files = lint_paths([pkg], rules={"HB07"})
     assert n_files > 50
     assert viol == [], [f"{v.path}:{v.line}" for v in viol]
+
+
+# ----------------------------------------------------------------------
+# HB08 — signal/process control inside forwards (ISSUE 4)
+# ----------------------------------------------------------------------
+
+def test_hb08_signal_signal_in_forward():
+    out = lint_source(textwrap.dedent("""
+        import signal
+        class Net(HybridBlock):
+            def hybrid_forward(self, F, x):
+                signal.signal(signal.SIGTERM, self._on_term)
+                return x * 2
+    """), path="<hb08>")
+    assert _rules(out) == ["HB08"]
+    assert "PreemptionHandler" in out[0].message
+
+
+def test_hb08_os_kill_in_forward_helper():
+    # reached THROUGH the forward via a self-helper: still flagged
+    out = lint_source(textwrap.dedent("""
+        import os, signal
+        class Net(HybridBlock):
+            def _poke(self, x):
+                os.kill(os.getpid(), signal.SIGUSR1)
+                return x
+            def hybrid_forward(self, F, x):
+                return self._poke(x)
+    """), path="<hb08>")
+    assert _rules(out) == ["HB08"]
+
+
+def test_hb08_clean_outside_forward_and_startup_use():
+    # signal handling at module level / in __init__ / in plain classes
+    # is the SUPPORTED pattern (PreemptionHandler) — no HB08
+    out = lint_source(textwrap.dedent("""
+        import signal, os
+        signal.signal(signal.SIGTERM, lambda s, f: None)
+        class Runner:
+            def run(self):
+                os.kill(os.getpid(), signal.SIGTERM)
+        class Net(HybridBlock):
+            def __init__(self):
+                signal.signal(signal.SIGINT, self._h)
+            def hybrid_forward(self, F, x):
+                return x + 1
+    """), path="<hb08>")
+    assert out == []
+
+
+def test_hb08_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB08" in RULES
+    out = lint_source(textwrap.dedent("""
+        import signal
+        class Net(HybridBlock):
+            def hybrid_forward(self, F, x):
+                signal.signal(signal.SIGTERM, self._h)  # mxlint: disable=HB08
+                return x
+    """), path="<hb08>")
+    assert out == []

@@ -24,6 +24,10 @@ Rules encoded from .claude/skills/verify/SKILL.md:
   - Timeouts terminate children with SIGTERM then a grace period before
     SIGKILL (hard kills have wedged the relay for hours).
 
+``--chaos`` runs the fault-tolerance smoke instead (CPU mesh, no TPU,
+no queue lock): kill-the-writer + preempt-at-K + corrupt-newest +
+auto-resume with bitwise parity (mxnet_tpu/testing/chaos.py).
+
 State lives in .tpu_queue/state.json; completed steps are skipped on
 restart, so the runner is safe to re-launch any time.  The conv-matrix
 winner is written to <repo>/.bench_knobs.json, which is DELIBERATELY
@@ -478,6 +482,31 @@ def step_bert128(st: dict) -> None:
     _save_state(st)
 
 
+def run_chaos() -> int:
+    """``--chaos``: the fault-tolerance smoke (mxnet_tpu.testing.chaos)
+    in a child process on the simulated CPU mesh — kill the checkpoint
+    writer, preempt at step K, corrupt the newest checkpoint, auto-
+    resume, and demand bitwise parity with an uninterrupted run.  Needs
+    no TPU and takes no queue lock: safe to run any time, including
+    while the measurement queue owns the chip."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    _log("chaos smoke: starting (CPU mesh, ~1 min)")
+    r = subprocess.run(
+        [sys.executable, "-m", "mxnet_tpu.testing.chaos"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
+    verdicts = _json_lines(r.stdout)
+    if r.returncode == 0 and verdicts and verdicts[-1].get("ok"):
+        _log("chaos smoke: OK " + json.dumps(verdicts[-1]))
+        return 0
+    _log(f"chaos smoke: FAILED rc={r.returncode}\n"
+         f"{r.stdout[-2000:]}\n{r.stderr[-2000:]}")
+    return 1
+
+
 STEPS = [("conv_matrix", step_conv_matrix), ("bench", step_bench),
          ("memory_levers", step_memory_levers),
          ("flash_sweep", step_flash_sweep),
@@ -508,6 +537,8 @@ def _acquire_lock() -> bool:
 
 
 def main() -> int:
+    if "--chaos" in sys.argv[1:]:
+        return run_chaos()
     os.makedirs(QDIR, exist_ok=True)
     if not _acquire_lock():
         return 1
