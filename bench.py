@@ -330,8 +330,23 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         # wire, MEASURED collective ms + est ICI GB/s when the sharded
         # pipeline runs (dp>1); zeros on CPU/dp=1 so the schema ships —
         # and is regression-tested — everywhere (tests/test_bench_line.py)
+        overlap_stats = None
+        if dp > 1 and os.environ.get("MXTPU_BENCH_OVERLAP_PROBE",
+                                     "1") != "0":
+            # with-vs-without-overlap probe (ISSUE 5): times the
+            # overlapped / barrier-monolithic / compute-only builds of
+            # the sharded step -> exposed_comm_ms + overlap_frac.
+            # Costs three extra step compiles; MXTPU_BENCH_OVERLAP_PROBE=0
+            # keeps the dp run but skips the probe on slow hosts
+            if feeder is not None:
+                pd, pl = mx.nd.array(sd[0]), mx.nd.array(sl[0])
+            else:
+                pd, pl = data, label
+            overlap_stats = trainer.overlap_probe(pd, pl,
+                                                  iters=min(iters, 5))
         result["comm"] = trainer.comm_stats(measure=dp > 1,
-                                            step_ms=dt / iters * 1e3)
+                                            step_ms=dt / iters * 1e3,
+                                            overlap_stats=overlap_stats)
     except Exception as e:  # noqa: BLE001 — observability never voids the bench
         result["comm"] = {"error": f"{type(e).__name__}: {e}"}
     import jax.numpy as jnp
@@ -842,6 +857,8 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         for name, key in (("comm_ms", "collective_ms"),
                           ("comm_gb_s", "est_ici_gb_s"),
                           ("comm_wire", "wire_dtype"),
+                          ("comm_exposed_ms", "exposed_comm_ms"),
+                          ("comm_overlap_frac", "overlap_frac"),
                           ("comm_mb_reduced", None)):
             v = (round(comm.get("bytes_reduced_per_step", 0) / 1e6, 1)
                  if key is None else comm.get(key))

@@ -27,6 +27,14 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+if _os.environ.get("MXTPU_LHS", "0") == "1":
+    # XLA latency-hiding scheduler (backward-overlapped comm, ISSUE 5):
+    # XLA_FLAGS must be set before the backend initializes, i.e. before
+    # anything below runs a jax computation
+    from .runtime import apply_lhs_flags as _apply_lhs_flags
+    _apply_lhs_flags()
+
 from ._dist_init import maybe_init_distributed as _maybe_init_distributed
 _maybe_init_distributed()   # must precede any jax computation
 

@@ -24,7 +24,8 @@ import jax.numpy as jnp
 from .base import MXNetError
 
 __all__ = ["is_recording", "is_training", "set_recording", "set_training",
-           "apply_op", "backward", "mark_variable", "Node"]
+           "apply_op", "backward", "mark_variable", "Node",
+           "register_grad_ready_hook"]
 
 
 class _TapeState(threading.local):
@@ -34,6 +35,9 @@ class _TapeState(threading.local):
         self.counter = 0
         # inside a jit trace we must not record (pure replay), see CachedOp
         self.trace_depth = 0
+        # autograd.grad() temporarily hijacks _grad/_grad_req on its
+        # variables; grad-ready hooks must not observe that scratch state
+        self.hooks_disabled = False
 
 
 _STATE = _TapeState()
@@ -205,6 +209,69 @@ def _accumulate(slot, value):
     return value if slot is None else slot + value
 
 
+# ---------------------------------------------------------------------------
+# grad-ready hooks (ISSUE 5 tentpole): fire per variable, in backward order,
+# the moment its gradient is FINAL — no remaining tape node can still
+# contribute.  parallel.OverlapScheduler hangs per-bucket gradient
+# communication off these so collectives overlap the rest of backprop
+# instead of waiting for the whole backward (arXiv:2011.03641 §4).
+# ---------------------------------------------------------------------------
+
+_HOOK_COUNTER = [0]
+
+
+class _HookHandle:
+    """Returned by :func:`register_grad_ready_hook`; ``remove()``
+    unregisters."""
+
+    __slots__ = ("_arr", "_key")
+
+    def __init__(self, arr, key):
+        self._arr = arr
+        self._key = key
+
+    def remove(self):
+        hooks = getattr(self._arr, "_grad_hooks", None)
+        if hooks:
+            hooks.pop(self._key, None)
+
+
+def register_grad_ready_hook(arr, fn):
+    """Register ``fn(arr)`` to run when ``arr``'s gradient is finalized
+    by a backward pass (after grad_req write/add is applied, so
+    ``arr._grad`` holds the finished value).  Hooks fire in backward
+    order — variables used late in the forward fire first.  Returns a
+    handle with ``remove()``."""
+    if arr._grad_hooks is None:
+        arr._grad_hooks = {}
+    _HOOK_COUNTER[0] += 1
+    key = _HOOK_COUNTER[0]
+    arr._grad_hooks[key] = fn
+    return _HookHandle(arr, key)
+
+
+def _finalize_leaf(arr, g):
+    """Apply grad_req and fire the variable's grad-ready hooks."""
+    _apply_grad_req(arr, g)
+    hooks = arr._grad_hooks
+    if hooks and not _STATE.hooks_disabled:
+        for fn in list(hooks.values()):
+            fn(arr)
+
+
+class suppress_grad_hooks:
+    """Scope that keeps grad-ready hooks from firing (autograd.grad)."""
+
+    def __enter__(self):
+        self._prev = _STATE.hooks_disabled
+        _STATE.hooks_disabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _STATE.hooks_disabled = self._prev
+        return False
+
+
 def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
     """Run the reverse pass from ``heads``.
 
@@ -243,7 +310,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
 
     if not live:
         for arr, g in leaf_grads.values():
-            _apply_grad_req(arr, g)
+            _finalize_leaf(arr, g)
         return
 
     # Collect the subgraph reachable from the heads (the tape holds no
@@ -262,10 +329,35 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             if inp._node is not None and inp._node.vjp_fn is not None:
                 stack.append(inp._node)
 
+    # Per-leaf pending contribution counts: a grad-capable leaf is FINAL
+    # (ready to fire its hooks) once every reachable node that lists it
+    # as an input has been visited by the walk below.  Counted per input
+    # POSITION, matching the zip(node.inputs, in_grads) delivery loop.
+    pending = {}
+    for node in reachable.values():
+        for inp in node.inputs:
+            if inp._grad_req != "null":
+                pending[id(inp)] = pending.get(id(inp), 0) + 1
+
+    def _maybe_finalize(arr):
+        if pending.get(id(arr), 0) == 0 and id(arr) in leaf_grads:
+            a, g = leaf_grads.pop(id(arr))
+            _finalize_leaf(a, g)
+
+    # head-seeded leaves with no upstream contributions are final now
+    for arr, _ in list(leaf_grads.values()):
+        _maybe_finalize(arr)
+
     # Walk reachable nodes newest->oldest; skip nodes with no cotangent.
     for node in sorted(reachable.values(), key=lambda n: n.order,
                        reverse=True):
         if node.vjp_fn is None or all(g is None for g in node.out_grads):
+            # visiting still retires this node's pending contributions —
+            # a skipped node can never deliver a cotangent later
+            for inp in node.inputs:
+                if inp._grad_req != "null":
+                    pending[id(inp)] -= 1
+                    _maybe_finalize(inp)
             continue
         cotangents = tuple(
             jnp.zeros(node.out_protos[k][0], node.out_protos[k][1])
@@ -298,6 +390,13 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             # supports non-leaf variables)
             if inp._grad_req != "null":
                 _leaf_accumulate(inp, g)
+        # this node's contributions are delivered: retire them and fire
+        # grad-ready hooks for any leaf that just became final — this IS
+        # the backward-order firing the overlap scheduler keys off
+        for inp in node.inputs:
+            if inp._grad_req != "null":
+                pending[id(inp)] -= 1
+                _maybe_finalize(inp)
         # cotangent slots are consumed by this pass either way; only the
         # pullback/inputs survive under retain_graph
         node.out_grads = [None] * node.n_out
@@ -307,7 +406,7 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
             node.inputs = []
 
     for arr, g in leaf_grads.values():
-        _apply_grad_req(arr, g)
+        _finalize_leaf(arr, g)
 
 
 def replay_function(heads, variables):

@@ -17,13 +17,34 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
            "is_training", "mark_variables", "backward", "grad",
-           "get_symbol", "Function", "set_recording", "set_training"]
+           "get_symbol", "Function", "set_recording", "set_training",
+           "register_grad_ready_hook"]
 
 
 is_recording = _tape.is_recording
 is_training = _tape.is_training
 set_recording = _tape.set_recording
 set_training = _tape.set_training
+
+
+def register_grad_ready_hook(variable, fn):
+    """Register ``fn(ndarray)`` to fire when ``variable``'s gradient is
+    finalized by ``backward()`` — in backward order, after grad_req
+    write/add applied, so ``.grad`` holds the finished value inside the
+    hook.  ``variable`` may be an NDArray or a gluon ``Parameter``.
+    Returns a handle with ``remove()``.
+
+    This is the eager half of the backward-overlapped communication
+    pipeline (parallel.OverlapScheduler dispatches per-bucket gradient
+    collectives from these hooks while backprop is still running)."""
+    arr = getattr(variable, "_data", None)
+    if not isinstance(arr, NDArray):
+        arr = variable
+    if not isinstance(arr, NDArray):
+        raise MXNetError(
+            "register_grad_ready_hook expects an NDArray or an "
+            f"initialized Parameter, got {type(variable)}")
+    return _tape.register_grad_ready_hook(arr, fn)
 
 
 class _RecordingStateScope:
@@ -111,12 +132,15 @@ def grad(heads, variables, head_grads=None, retain_graph=None,
         outs = outs if isinstance(outs, list) else [outs]
         return outs[0] if single else outs
     # stash current grads/reqs, run a scoped backward, then restore
+    # (grad-ready hooks stay quiet: the scratch _grad state is not a
+    # training gradient and must not trigger overlap dispatch)
     saved = [(v._grad, v._grad_req) for v in var_list]
     for v in var_list:
         v._grad = None
         v._grad_req = "write"
-    _tape.backward(heads, head_grads, retain_graph=bool(retain_graph),
-                   train_mode=train_mode)
+    with _tape.suppress_grad_hooks():
+        _tape.backward(heads, head_grads, retain_graph=bool(retain_graph),
+                       train_mode=train_mode)
     grads = []
     for v, (old_g, old_req) in zip(var_list, saved):
         if v._grad is None:

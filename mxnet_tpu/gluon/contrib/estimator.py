@@ -226,9 +226,23 @@ class Estimator:
         self.current_epoch = start_epoch
         return start_epoch, int(cursor.get("batch", 0))
 
+    def _epoch_source(self, train_data, prefetch_to_device, prefetch_depth):
+        """Per-epoch batch source: with device prefetch requested, wrap
+        ``train_data`` in an ``io.DevicePrefetcher`` (depth =
+        ``prefetch_depth`` or the ``MXTPU_PREFETCH_DEPTH`` default) so
+        batch N+1's H2D overlaps batch N's step.  Returns
+        ``(iterable, closer)`` — the closer joins the worker thread at
+        epoch end."""
+        if not prefetch_to_device and prefetch_depth is None:
+            return train_data, None
+        from ...io import DevicePrefetcher
+        pf = DevicePrefetcher(iter(train_data), depth=prefetch_depth)
+        return pf, pf.close
+
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, resume=None, checkpoint_manager=None,
-            checkpoint_every=None):
+            checkpoint_every=None, prefetch_to_device=False,
+            prefetch_depth=None):
         """Train; with ``checkpoint_manager`` the loop is preemption-safe:
 
         - ``checkpoint_every=N`` saves the full training state (params,
@@ -239,6 +253,11 @@ class Estimator:
         - ``resume="auto"`` (or an int step) restores the newest valid
           checkpoint — torn/corrupt ones are skipped — and fast-forwards
           the data iterator to the saved mid-epoch cursor.
+
+        ``prefetch_to_device=True`` (or an explicit ``prefetch_depth=N``)
+        stages batches onto the device through an ``io.DevicePrefetcher``
+        so H2D overlaps the step; depth defaults to
+        ``MXTPU_PREFETCH_DEPTH`` (2).
         """
         from ... import checkpoint as ckpt_mod
         if epochs is None and batches is None:
@@ -274,7 +293,9 @@ class Estimator:
                         h.epoch_begin(self)
                 batch_idx = 0
                 epoch_done = True
-                for batch in train_data:
+                epoch_src, epoch_close = self._epoch_source(
+                    train_data, prefetch_to_device, prefetch_depth)
+                for batch in epoch_src:
                     if skip_batches:
                         # fast-forward to the saved mid-epoch cursor
                         # (RNG was restored, so a deterministic pipeline
@@ -315,6 +336,8 @@ class Estimator:
                     if self.stop_training:
                         epoch_done = not self.preempted
                         break
+                if epoch_close is not None:
+                    epoch_close()   # join the prefetch worker (idempotent)
                 if self.preempted:
                     break           # mid-epoch: no epoch_end bookkeeping
                 for h in handlers:

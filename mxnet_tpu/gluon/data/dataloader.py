@@ -248,17 +248,22 @@ class DataLoader:
     ``prefetch_to_device=True`` chains an ``io.DevicePrefetcher`` after
     batching: a worker thread ships batch N+1 to the device (sharded
     over an active ``parallel`` mesh) while the training step consumes
-    batch N — see docs/INPUT_PIPELINE.md.
+    batch N — see docs/INPUT_PIPELINE.md.  ``prefetch_depth=`` sets how
+    many batches the device stage reads ahead (default:
+    ``MXTPU_PREFETCH_DEPTH`` env, else 2 — double buffering).
     """
 
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
                  prefetch=None, thread_pool=True, timeout=120,
-                 prefetch_to_device=False):
+                 prefetch_to_device=False, prefetch_depth=None):
         self._dataset = dataset
         self._timeout = timeout
         self._prefetch_to_device = prefetch_to_device
+        # device-stage read-ahead depth (batches staged on device beyond
+        # the one being consumed); None -> MXTPU_PREFETCH_DEPTH, default 2
+        self._prefetch_depth = prefetch_depth
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError(
@@ -291,7 +296,8 @@ class DataLoader:
             # device-resident (sharded over an active parallel mesh) —
             # see io.DevicePrefetcher / docs/INPUT_PIPELINE.md
             from ...io import DevicePrefetcher
-            pf = DevicePrefetcher(self._host_iter(), depth=2)
+            pf = DevicePrefetcher(self._host_iter(),
+                                  depth=self._prefetch_depth)
             try:
                 yield from pf
             finally:

@@ -160,6 +160,9 @@ class Parameter:
     # ------------------------------------------------------------------
     def data(self, ctx=None):
         self._check_initialized()
+        if _USE_ORDER_RECORDERS:
+            for rec in _USE_ORDER_RECORDERS:
+                rec.note(self)
         override = _TRACE_BINDINGS.get(id(self))
         if override is not None:
             return override
@@ -267,6 +270,35 @@ class Constant(Parameter):
 
 # trace-time parameter value overrides (set by CachedOp while tracing)
 _TRACE_BINDINGS = {}
+
+# active forward use-order recorders (see record_param_use); a plain list
+# so Parameter.data() pays one falsy check when none are active
+_USE_ORDER_RECORDERS = []
+
+
+class record_param_use:
+    """Scope recording the order parameters are FIRST accessed in a
+    forward — the reverse of backward gradient-ready order, which is
+    what a backward-ordered ``zero.BucketPlan(fill_order=...)`` needs
+    (parallel.DataParallelTrainer probes one abstract forward under
+    this to plan overlap-friendly buckets)."""
+
+    def __init__(self):
+        self.order = []          # Parameter objects, first-use order
+        self._seen = set()
+
+    def note(self, param):
+        if id(param) not in self._seen:
+            self._seen.add(id(param))
+            self.order.append(param)
+
+    def __enter__(self):
+        _USE_ORDER_RECORDERS.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _USE_ORDER_RECORDERS.remove(self)
+        return False
 
 
 class _bind_params:

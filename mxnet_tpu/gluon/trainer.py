@@ -118,6 +118,11 @@ class Trainer:
         self._states = {}
         self._update_on_kvstore = update_on_kvstore
         self._fused_jit_cache = {}
+        # backward-overlapped gradient communication (ISSUE 5): an
+        # OverlapScheduler dispatching per-bucket kvstore rounds from
+        # autograd grad-ready hooks; armed in _init_kvstore when the
+        # store actually spans workers (MXTPU_OVERLAP_COMM=0 kills it)
+        self._overlap = None
 
     def _init_optimizer(self, optimizer, optimizer_params):
         param_dict = {i: param for i, param in enumerate(self._params)}
@@ -144,6 +149,13 @@ class Trainer:
             for i, p in enumerate(self._params):
                 if p._data is not None and p.grad_req != "null":
                     self._kvstore.init(i, p.data())
+        from ..parallel import zero as _zero
+        if self._kvstore is not None and \
+                getattr(self._kvstore, "num_workers", 1) > 1 and \
+                _zero.overlap_comm_enabled():
+            from ..parallel.overlap import OverlapScheduler
+            self._overlap = OverlapScheduler(
+                self._params, kvstore=self._kvstore).install()
         self._kv_initialized = True
 
     @property
@@ -158,6 +170,12 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     def _all_reduce_grads(self):
+        if self._overlap is not None:
+            # buckets whose grads finished during backward already went
+            # out (async); this launches stragglers and waits ONLY on
+            # the tail bucket.  Reduced grads carry _grad_reduced, so
+            # the batched pass below cannot double-count them.
+            self._overlap.finish()
         if self._kvstore is None or self._kvstore.num_workers <= 1 and \
                 type(self._kvstore).__name__ == "KVStoreLocal":
             return

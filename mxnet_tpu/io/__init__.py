@@ -14,7 +14,8 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array, concatenate
-from .prefetch import DevicePrefetcher, AsyncDecodeIter, PipelineStats
+from .prefetch import (DevicePrefetcher, AsyncDecodeIter, PipelineStats,
+                       default_prefetch_depth)
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "LibSVMIter",

@@ -37,7 +37,24 @@ import numpy as _np
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 
-__all__ = ["DevicePrefetcher", "AsyncDecodeIter", "PipelineStats"]
+__all__ = ["DevicePrefetcher", "AsyncDecodeIter", "PipelineStats",
+           "default_prefetch_depth"]
+
+
+def default_prefetch_depth():
+    """Prefetch depth when the caller does not pass one:
+    ``MXTPU_PREFETCH_DEPTH`` (>= 1), default 2 (double buffering)."""
+    import os
+    try:
+        depth = int(os.environ.get("MXTPU_PREFETCH_DEPTH", "2"))
+    except ValueError:
+        raise MXNetError(
+            f"MXTPU_PREFETCH_DEPTH={os.environ['MXTPU_PREFETCH_DEPTH']!r}"
+            f": expected an integer >= 1")
+    if depth < 1:
+        raise MXNetError(
+            f"MXTPU_PREFETCH_DEPTH must be >= 1, got {depth}")
+    return depth
 
 
 class PipelineStats:
@@ -132,9 +149,11 @@ class DevicePrefetcher:
       threads.
     """
 
-    def __init__(self, source, depth=2, mesh=None, sharding=None,
+    def __init__(self, source, depth=None, mesh=None, sharding=None,
                  batch_axis=0, data_axis=None, timeout=600.0,
                  to_device=True):
+        if depth is None:
+            depth = default_prefetch_depth()
         if depth < 1:
             raise MXNetError("DevicePrefetcher: depth must be >= 1")
         self._source = source

@@ -752,6 +752,65 @@ class _LoopCollectiveScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+# -- HB09: host sync between backward() and trainer.step() --------------
+
+# method calls that force a host round-trip mid-training-loop
+_HB09_SYNC_METHODS = _SYNC_METHODS | {"wait_to_read", "waitall"}
+
+
+class _BackwardStepScanner(ast.NodeVisitor):
+    """HB09: within any Python loop (the training loop), a host-sync
+    call issued AFTER ``backward()`` but BEFORE the matching
+    ``.step(...)`` serializes the step: the sync drains the whole
+    backward, so overlapped per-bucket gradient communication
+    (parallel.OverlapScheduler grad-ready hooks) and the async step
+    dispatch both stall behind it.  Scans every loop in the module;
+    nested scans dedup through the collector."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _scan_loop(self, node):
+        calls = []
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute):
+                calls.append(sub)
+        calls.sort(key=lambda c: (c.lineno, c.col_offset))
+        armed = False
+        for call in calls:
+            attr = call.func.attr
+            if attr == "backward":
+                armed = True
+            elif attr == "step" and armed:
+                armed = False
+            elif armed and attr in _HB09_SYNC_METHODS:
+                self.c.add(Violation(
+                    rule="HB09", path=self.path, line=call.lineno,
+                    col=call.col_offset,
+                    message=f"host sync `.{attr}()` between backward() "
+                            "and trainer.step() in a training loop: the "
+                            "sync drains the backward before step can "
+                            "dispatch, serializing the step and "
+                            "defeating backward-overlapped gradient "
+                            "communication; move the read after step()",
+                    block="", func=self.func_stack[-1]))
+        self.generic_visit(node)
+
+    visit_For = visit_While = visit_AsyncFor = _scan_loop
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -886,8 +945,9 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
                 continue              # inherited: reported on the owner
             collector.analyze_entry(fn, cname)
     if only_classes is None:
-        # HB07 is module-wide (any function), not forward-scoped
+        # HB07/HB09 are module-wide (any function), not forward-scoped
         _LoopCollectiveScanner(collector, path).visit(tree)
+        _BackwardStepScanner(collector, path).visit(tree)
     suppressed, _unknown = parse_suppressions(source)
     src_lines = source.splitlines()
     out = []

@@ -119,6 +119,22 @@ RULES = {
         "# with mx.checkpoint.PreemptionHandler() as h: ...\n"
         "def hybrid_forward(self, F, x):\n"
         "    return self.body(x)"),
+    "HB09": Rule(
+        "HB09", "host-sync-between-backward-and-step",
+        "A host sync (`.asnumpy()`/`.asscalar()`/`.item()`/`.tolist()`/"
+        "`.wait_to_read()`) between `backward()` and `trainer.step()` in "
+        "a training loop: the sync blocks the host until the whole "
+        "backward drains, so per-bucket gradient collectives dispatched "
+        "from grad-ready hooks (parallel.OverlapScheduler) — and the "
+        "async step dispatch itself — serialize behind it, defeating "
+        "comm/compute overlap. Read the loss AFTER step() (the value is "
+        "identical; the sync then overlaps the next dispatch).",
+        "loss.backward()\n"
+        "print(loss.asnumpy())          # host sync: backward drains,\n"
+        "trainer.step(batch_size)       # bucket comm can't overlap",
+        "loss.backward()\n"
+        "trainer.step(batch_size)       # step dispatches async\n"
+        "print(loss.asnumpy())          # sync AFTER the dispatches"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

@@ -102,7 +102,8 @@ class NDArray:
     """
 
     __slots__ = ("_data", "_ctx", "_grad", "_grad_req", "_node", "_out_index",
-                 "_grad_fresh", "_grad_reduced", "_grad_of", "__weakref__")
+                 "_grad_fresh", "_grad_reduced", "_grad_of", "_grad_hooks",
+                 "__weakref__")
 
     # make NDArray win against numpy array in reflected ops
     __array_priority__ = 1000.0
@@ -118,6 +119,9 @@ class NDArray:
         # (all_reduce_gradients must reduce once per cycle, grad_req='add')
         self._grad_reduced = False
         self._grad_of = None
+        # {key: fn} grad-ready hooks (autograd.register_grad_ready_hook);
+        # None until the first registration — the common case pays nothing
+        self._grad_hooks = None
         self._node = None
         self._out_index = 0
         _LIVE_ARRAYS.add(self)

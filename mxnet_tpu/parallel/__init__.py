@@ -18,8 +18,10 @@ scaling is mesh-sharded jit:
                        dist_async capability, §2.5 last row)
 """
 from .mesh import (make_mesh, local_mesh, distributed_init, mesh_scope,
-                   current_mesh, data_sharding, replicate_sharding)
+                   current_mesh, data_sharding, replicate_sharding,
+                   batch_sharding)
 from .data_parallel import DataParallelTrainer, all_reduce_gradients
+from .overlap import OverlapScheduler
 from .tensor_parallel import (shard_params_tp, tp_spec_for_param,
                               ParallelDense, ParallelEmbedding)
 from .ring_attention import ring_attention, ring_attention_local, \

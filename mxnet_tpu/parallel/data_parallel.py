@@ -82,6 +82,9 @@ class DataParallelTrainer:
         self._zero1 = None              # tri-state; resolved lazily
         self._plan = None               # zero.BucketPlan once params known
         self._comm_dtype = _zero.comm_dtype()   # read ONCE at construction
+        # backward-overlapped comm (ISSUE 5): read ONCE, like the wire
+        # dtype — a mid-training env flip must not re-plan the buckets
+        self._overlap_comm = _zero.overlap_comm_enabled()
         params_kwargs = dict(optimizer_params or {})
         self._lr = params_kwargs.pop("learning_rate", 0.01)
         self._lr_scheduler = params_kwargs.pop("lr_scheduler", None)
@@ -301,6 +304,8 @@ class DataParallelTrainer:
             params = self._collect(*probe)
         else:
             params = self._param_objs
+        if self._zero1_active():
+            self._zero1_ensure_plan(inputs)
         self._ensure_device_state(params)
         if self._zero1_active():
             dp = self.mesh.shape["dp"]
@@ -358,12 +363,64 @@ class DataParallelTrainer:
                 and all(p.shard_spec is None for p in self._param_objs))
         return self._zero1
 
-    def _zero1_ensure_plan(self):
+    def _zero1_ensure_plan(self, probe_inputs=None):
+        """Build the bucket plan once.  With overlap on and a batch
+        signature available, the fill order is the REVERSE of the
+        forward parameter-use order (one abstract trace, no FLOPs) —
+        buckets then complete early-to-late during the XLA backward, so
+        each bucket's reduce-scatter is data-ready long before the
+        backward finishes and the latency-hiding scheduler
+        (``MXTPU_LHS=1``) can sink it under the remaining compute.
+        ``MXTPU_OVERLAP_COMM=0`` (or no batch: checkpoint restore)
+        keeps PR 3's declaration-order fill bitwise."""
         if self._plan is None:
+            order = None
+            if self._overlap_comm and probe_inputs is not None:
+                order = self._probe_backward_order(probe_inputs)
             self._plan = _zero.BucketPlan(
-                [tuple(v.shape) for v in self._param_vals],
-                self.mesh.shape["dp"])
+                [tuple(p.shape) for p in self._param_objs],
+                self.mesh.shape["dp"], fill_order=order)
         return self._plan
+
+    def _probe_backward_order(self, inputs):
+        """Parameter indices in expected backward gradient-ready order:
+        record first-use order over ONE abstract forward
+        (``jax.eval_shape`` — trace only, nothing computes) and reverse
+        it.  Returns None (declaration order) if the probe cannot run."""
+        from ..gluon.parameter import record_param_use
+        params = self._param_objs
+        # the abstract forward can WRITE tracers into parameter state
+        # (batch-norm running stats update through _set_data during the
+        # trace); snapshot the raw buffers and restore unconditionally,
+        # or the leaked tracers blow up the next device_put
+        snapshot = [(p._data, p._data._data) for p in params
+                    if p._data is not None]
+        try:
+            loss_of = self._make_loss_of()
+
+            def struct(a):
+                return jax.ShapeDtypeStruct(tuple(a.shape), a.dtype)
+
+            pv = [jax.ShapeDtypeStruct(tuple(p.shape),
+                                       p.data().data.dtype)
+                  for p in params]
+            rec = record_param_use()
+            with rec:
+                jax.eval_shape(
+                    loss_of, pv, jax.random.PRNGKey(0),
+                    [struct(b) for b in inputs[:-1]], struct(inputs[-1]))
+            pos = {id(p): i for i, p in enumerate(params)}
+            used = [pos[id(p)] for p in rec.order if id(p) in pos]
+            rest = [i for i in range(len(params)) if i not in set(used)]
+            # params used EARLIEST in forward get their grads LAST;
+            # never-used params (frozen branches) go to the tail buckets
+            return list(reversed(used)) + rest if used else None
+        except Exception:  # noqa: BLE001 — the probe is an optimization,
+            # never a correctness gate; declaration order always works
+            return None
+        finally:
+            for arr, raw in snapshot:
+                arr._data = raw
 
     def _zero1_state_spec_tree(self):
         """shard_map specs for the bucket optimizer state: vector leaves
@@ -373,35 +430,68 @@ class DataParallelTrainer:
             lambda x: P("dp") if getattr(x, "ndim", 0) >= 1 else P(),
             self._opt_state)
 
-    def _zero1_sync_update(self, param_vals, grads, opt_local, lr, key):
+    def _zero1_sync_update(self, param_vals, grads, opt_local, lr, key,
+                           comm_mode="overlap"):
         """Bucketed reduce-scatter -> 1/N optimizer update -> all-gather.
         Runs INSIDE shard_map ('dp' bound); ``grads`` are this chip's
         LOCAL gradients, ``opt_local`` the local 1/dp state shards.  ONE
-        source for plain/accum/indexed sharded steps."""
+        source for plain/accum/indexed sharded steps.
+
+        ``comm_mode`` exists for the with-vs-without-overlap probe
+        (:meth:`overlap_probe`):
+
+        - ``"overlap"`` (the training path): each bucket's flat gradient
+          — and therefore its reduce-scatter — is data-dependent ONLY on
+          that bucket's own parameters' grads, so with a backward-ordered
+          plan the latency-hiding scheduler can launch bucket b's
+          collective while buckets b+1.. are still in backward compute.
+        - ``"mono"``: an ``optimization_barrier`` ties every bucket's
+          payload to ALL gradients and chains the buckets, modeling the
+          PR 3 all-comm-after-backward schedule.
+        - ``"none"``: collectives replaced by shape-identical local ops
+          (slice / tile) — the pure-compute baseline the probe subtracts.
+        """
         plan = self._plan
         dp = self.mesh.shape["dp"]
         mode = self._comm_dtype
         idx = lax.axis_index("dp")
         gflats = plan.flatten(grads)
         pflats = plan.flatten(param_vals)
+        if comm_mode == "mono":
+            # every bucket now depends on the WHOLE backward
+            gflats = list(lax.optimization_barrier(tuple(gflats)))
         new_pflats, new_state = [], []
+        prev_shard = None
         for b in range(plan.n_buckets):
             ls = plan.shard_length(b)
-            gshard = _zero.reduce_scatter_bucket(
-                gflats[b], jax.random.fold_in(key, b), dp, mode)
+            gflat = gflats[b]
+            if comm_mode == "mono" and prev_shard is not None:
+                # serialize bucket b's collective behind bucket b-1's
+                gflat, _ = lax.optimization_barrier((gflat, prev_shard))
+            if comm_mode == "none":
+                gshard = lax.dynamic_slice(gflat, (idx * ls,), (ls,))
+            else:
+                gshard = _zero.reduce_scatter_bucket(
+                    gflat, jax.random.fold_in(key, b), dp, mode)
+            prev_shard = gshard
             pshard = lax.dynamic_slice(pflats[b], (idx * ls,), (ls,))
             np_, ns = self._rule_apply(pshard, gshard, opt_local[b], lr)
-            new_pflats.append(lax.all_gather(np_, "dp", tiled=True))
+            if comm_mode == "none":
+                new_pflats.append(jnp.tile(np_, dp))
+            else:
+                new_pflats.append(lax.all_gather(np_, "dp", tiled=True))
             new_state.append(ns)
         return plan.unflatten(new_pflats, param_vals), new_state
 
-    def _get_zero1_jit(self, kind, inputs, n_micro=None):
+    def _get_zero1_jit(self, kind, inputs, n_micro=None,
+                       comm_mode="overlap", donate=None):
         """Build (and cache per input-rank signature) the jitted
         shard_map step.  Unlike the psum path, shard_map needs the
         in/out specs — hence ranks — up front; jit would retrace per
         shape anyway, so this costs nothing extra."""
         self._zero1_ensure_plan()
-        sig = (kind, n_micro, tuple(b.ndim for b in inputs))
+        sig = (kind, n_micro, tuple(b.ndim for b in inputs), comm_mode,
+               donate)
         jitted = self._jit_zero1_cache.get(sig)
         if jitted is not None:
             return jitted
@@ -450,7 +540,7 @@ class DataParallelTrainer:
             loss = lax.pmean(loss, "dp")
             new_params, new_state = self._zero1_sync_update(
                 param_vals, grads, opt_local, lr,
-                jax.random.fold_in(key, 0x5eed))
+                jax.random.fold_in(key, 0x5eed), comm_mode=comm_mode)
             return new_params, new_state, loss
 
         pspecs = [P()] * len(self._param_vals)
@@ -466,8 +556,10 @@ class DataParallelTrainer:
         out_specs = (pspecs, sspecs, P())
         wrapped = shard_map(local_body, mesh=mesh, in_specs=in_specs,
                             out_specs=out_specs, check_vma=False)
-        donate = (0, 1) if self._donate else ()
-        jitted = jax.jit(wrapped, donate_argnums=donate)
+        if donate is None:
+            donate = self._donate
+        jitted = jax.jit(wrapped,
+                         donate_argnums=(0, 1) if donate else ())
         self._jit_zero1_cache[sig] = jitted
         return jitted
 
@@ -498,6 +590,10 @@ class DataParallelTrainer:
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
+        if self._zero1_active():
+            # plan BEFORE device state: the bucket-sharded optimizer
+            # state is laid out in plan (fill-order) space
+            self._zero1_ensure_plan(inputs)
         self._ensure_device_state(params)
         if self._zero1_active():
             self._zero1_check_batch(inputs)
@@ -602,6 +698,8 @@ class DataParallelTrainer:
             # probe batch only for deferred-shape resolution on first call
             self._collect(NDArray(superdata[0]))
         params = self._param_objs
+        if self._zero1_active():
+            self._zero1_ensure_plan([superdata[0], superlabel[0]])
         self._ensure_device_state(params)
         if self._zero1_active():
             spec_d, spec_l = epoch_handle[2]
@@ -760,7 +858,86 @@ class DataParallelTrainer:
             for p in params]
 
     # -- observability ---------------------------------------------------
-    def comm_stats(self, measure=False, iters=10, step_ms=None):
+    def overlap_probe(self, *batch, iters=5):
+        """The with-vs-without-overlap probe (ISSUE 5): time three
+        structurally different builds of THIS trainer's sharded step on
+        ``batch`` —
+
+        - *overlapped* (the training graph): per-bucket reduce-scatter
+          data-dependent only on its own grads, free to ride under
+          backward compute;
+        - *monolithic*: ``optimization_barrier`` pins every collective
+          behind the whole backward and chains the buckets (the PR 3
+          schedule);
+        - *compute-only*: collectives swapped for shape-identical local
+          ops — the baseline both are measured against.
+
+        Returns ``exposed_comm_ms`` (comm left on the overlapped step's
+        critical path) and ``overlap_frac`` (share of the serialized
+        comm the overlap hides: ``1 - exposed / (mono - compute)``).
+        All probe programs are compiled WITHOUT donation, so trainer
+        state is untouched.  Zeros when the sharded pipeline is off
+        (CPU / dp=1 / kill switch)."""
+        import time
+        from .. import profiler
+        out = {"exposed_comm_ms": 0.0, "overlap_frac": 0.0,
+               "overlapped_step_ms": 0.0, "monolithic_step_ms": 0.0,
+               "compute_only_step_ms": 0.0}
+        inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                  for b in batch]
+        params = self._collect(*[NDArray(b) for b in inputs[:-1]])
+        if self._zero1_active():
+            self._zero1_ensure_plan(inputs)
+        self._ensure_device_state(params)
+        if not self._zero1_active() or self.mesh.shape.get("dp", 1) <= 1:
+            return out
+        self._zero1_check_batch(inputs)
+        dev_inputs = self._put_batch(inputs)
+        key = jax.random.PRNGKey(7)
+        lr = jnp.asarray(self.learning_rate, jnp.float32)
+        t_all0 = time.perf_counter()
+        # tracing the probe variants can write tracers into parameter
+        # state (batch-norm running stats update during the trace); the
+        # probe discards its results, so restore the raw buffers after —
+        # unlike step(), nothing overwrites them with concrete values
+        snapshot = [(p._data, p._data._data) for p in params
+                    if p._data is not None]
+        try:
+            for mode, field in (("none", "compute_only_step_ms"),
+                                ("overlap", "overlapped_step_ms"),
+                                ("mono", "monolithic_step_ms")):
+                f = self._get_zero1_jit("plain", inputs, comm_mode=mode,
+                                        donate=False)
+                res = f(self._param_vals, self._opt_state, lr, key,
+                        *dev_inputs)
+                jax.block_until_ready(res)      # compile off the clock
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    res = f(self._param_vals, self._opt_state, lr, key,
+                            *dev_inputs)
+                jax.block_until_ready(res)
+                out[field] = round(
+                    (time.perf_counter() - t0) / iters * 1e3, 3)
+        finally:
+            for arr, raw in snapshot:
+                arr._data = raw
+        profiler.record_span("overlap.probe", t_all0, time.perf_counter())
+        comp = out["compute_only_step_ms"]
+        exposed = max(0.0, out["overlapped_step_ms"] - comp)
+        serial = max(exposed, out["monolithic_step_ms"] - comp)
+        out["exposed_comm_ms"] = round(exposed, 3)
+        if exposed == 0.0:
+            # the step DOES contain the collectives (zero1 ran), yet the
+            # overlapped build costs no more than pure compute: the comm
+            # is fully hidden at this measurement's resolution
+            out["overlap_frac"] = 1.0
+        elif serial > 0:
+            out["overlap_frac"] = round(
+                max(0.0, min(1.0, 1.0 - exposed / serial)), 4)
+        return out
+
+    def comm_stats(self, measure=False, iters=10, step_ms=None,
+                   overlap_stats=None):
         """The per-step ``comm`` block (parallel/zero.py schema): static
         wire accounting always; with ``measure=True`` and dp > 1 the
         collective time is MEASURED by timing a jitted RS+AG-only
@@ -796,6 +973,7 @@ class DataParallelTrainer:
                 gbs = (bytes_rs + bytes_ag) / (coll_ms / 1e3) / 1e9
             if step_ms:
                 overlap = max(0.0, min(1.0, 1.0 - coll_ms / step_ms))
+        ov = overlap_stats or {}
         return _zero.comm_block(
             dp=dp, wire_dtype=self._comm_dtype, buckets=plan.n_buckets,
             bytes_reduced_per_step=bytes_rs,
@@ -803,6 +981,9 @@ class DataParallelTrainer:
             grad_bytes_fp32=plan.grad_bytes_fp32(),
             collective_ms=coll_ms, est_ici_gb_s=gbs,
             overlap_efficiency=overlap, zero1=True,
+            overlap_comm=self._overlap_comm,
+            exposed_comm_ms=ov.get("exposed_comm_ms", 0.0),
+            overlap_frac=ov.get("overlap_frac", 0.0),
             state_bytes_per_chip=state_chip, state_bytes_replicated=state_rep)
 
     def _measure_collectives(self, iters=10):

@@ -38,9 +38,9 @@ from jax import lax
 from ..base import MXNetError
 
 __all__ = ["BucketPlan", "bucket_bound_bytes", "comm_dtype",
-           "sharded_sync_enabled", "reduce_scatter_bucket",
-           "quantize_int8", "dequantize_int8", "int8_roundtrip_error",
-           "comm_block", "ZERO1_RULES"]
+           "sharded_sync_enabled", "overlap_comm_enabled",
+           "reduce_scatter_bucket", "quantize_int8", "dequantize_int8",
+           "int8_roundtrip_error", "comm_block", "ZERO1_RULES"]
 
 #: fused-rule kernels that are elementwise in the parameter, so the
 #: update can run on an arbitrary flat shard of the bucket.  lamb/lars
@@ -70,6 +70,14 @@ def sharded_sync_enabled():
     return os.environ.get("MXTPU_SHARDED_SYNC", "1") != "0"
 
 
+def overlap_comm_enabled():
+    """Backward-overlapped gradient communication (ISSUE 5 tentpole):
+    ``MXTPU_OVERLAP_COMM=0`` kills the overlap — bucket plans fall back
+    to declaration-order fill and the eager OverlapScheduler stands
+    down, reproducing the PR 3 monolithic-sync behavior bitwise."""
+    return os.environ.get("MXTPU_OVERLAP_COMM", "1") != "0"
+
+
 class BucketPlan:
     """Greedy coalescing of parameter tensors into flat f32 buckets.
 
@@ -78,9 +86,18 @@ class BucketPlan:
     bound gets its own bucket), and every bucket is zero-padded so its
     flat length divides ``dp`` — each chip's shard is exactly
     ``length // dp`` elements, no edge-chip special case.
+
+    ``fill_order`` (ISSUE 5 tentpole) is a permutation of parameter
+    indices in expected *backward gradient-ready* order
+    (reverse-topological: parameters used last in the forward first).
+    Buckets are filled in that order, so during backprop bucket 0's
+    gradients finish first, bucket 1's next, ... — each bucket's
+    reduce-scatter can launch while the rest of the backward is still
+    computing (:attr:`ready_order`).  ``None`` keeps declaration-order
+    fill (the PR 3 monolithic layout; ``MXTPU_OVERLAP_COMM=0``).
     """
 
-    def __init__(self, shapes, dp, bound_bytes=None):
+    def __init__(self, shapes, dp, bound_bytes=None, fill_order=None):
         if dp < 1:
             raise MXNetError(f"BucketPlan: dp must be >= 1, got {dp}")
         bound = bound_bytes if bound_bytes is not None \
@@ -95,9 +112,20 @@ class BucketPlan:
                 n *= int(d)
             sizes.append(n)
         self.sizes = sizes
+        if fill_order is None:
+            order = list(range(len(sizes)))
+            self.fill_order = None
+        else:
+            order = [int(i) for i in fill_order]
+            if sorted(order) != list(range(len(sizes))):
+                raise MXNetError(
+                    f"BucketPlan: fill_order must be a permutation of "
+                    f"0..{len(sizes) - 1}, got {fill_order!r}")
+            self.fill_order = tuple(order)
         self.buckets = []          # list of lists of param indices
         cur, cur_n = [], 0
-        for i, n in enumerate(sizes):
+        for i in order:
+            n = sizes[i]
             if cur and cur_n + n > bound_elems:
                 self.buckets.append(cur)
                 cur, cur_n = [], 0
@@ -118,6 +146,16 @@ class BucketPlan:
     @property
     def n_buckets(self):
         return len(self.buckets)
+
+    @property
+    def ready_order(self):
+        """Bucket ids in backward gradient-completion order.  Buckets are
+        created in fill order, so when the plan was built with a
+        backward ``fill_order`` this is simply ``(0, 1, ...)`` — bucket 0
+        completes (and can launch its reduce-scatter) first.  Without a
+        ``fill_order`` completion order is unknown; the same tuple is
+        returned as the monolithic-dispatch order."""
+        return tuple(range(self.n_buckets))
 
     def shard_length(self, b):
         return self.lengths[b] // self.dp
@@ -236,10 +274,17 @@ def comm_block(dp=1, wire_dtype="fp32", buckets=0, bucket_mb=None,
                bytes_reduced_per_step=0, bytes_gathered_per_step=0,
                grad_bytes_fp32=0, collective_ms=0.0, est_ici_gb_s=0.0,
                overlap_efficiency=0.0, zero1=False,
-               state_bytes_per_chip=0, state_bytes_replicated=0):
+               state_bytes_per_chip=0, state_bytes_replicated=0,
+               overlap_comm=False, exposed_comm_ms=0.0, overlap_frac=0.0):
     """The per-step ``comm`` block schema.  Every field is always
     present (zeros on CPU / dp=1) so tier-1 regression-tests the shape
-    (tests/test_bench_line.py) without needing a multichip host."""
+    (tests/test_bench_line.py) without needing a multichip host.
+
+    ``exposed_comm_ms`` / ``overlap_frac`` (ISSUE 5) come from the
+    with-vs-without-overlap probe
+    (``DataParallelTrainer.overlap_probe``): exposed = time the
+    overlapped step still spends on communication beyond its pure
+    compute, overlap_frac = 1 - exposed / total serialized comm."""
     return {
         "zero1": bool(zero1),
         "dp": int(dp),
@@ -253,6 +298,9 @@ def comm_block(dp=1, wire_dtype="fp32", buckets=0, bucket_mb=None,
         "collective_ms": round(float(collective_ms), 3),
         "est_ici_gb_s": round(float(est_ici_gb_s), 2),
         "overlap_efficiency": round(float(overlap_efficiency), 4),
+        "overlap_comm": bool(overlap_comm),
+        "exposed_comm_ms": round(float(exposed_comm_ms), 3),
+        "overlap_frac": round(float(overlap_frac), 4),
         "state_bytes_per_chip": int(state_bytes_per_chip),
         "state_bytes_replicated": int(state_bytes_replicated),
     }

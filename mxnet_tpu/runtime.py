@@ -1,13 +1,77 @@
-"""``mx.runtime`` — feature detection.
+"""``mx.runtime`` — feature detection + XLA scheduler flag plumbing.
 
 Reference: python/mxnet/runtime.py over src/libinfo.cc feature flags
-("CUDA", "CUDNN", "MKLDNN", ...). The TPU rebuild reports its own substrate.
+("CUDA", "CUDNN", "MKLDNN", ...). The TPU rebuild reports its own substrate,
+and additionally owns the XLA *latency-hiding scheduler* flags
+(:func:`lhs_flags` / ``MXTPU_LHS=1``) that let the compiler sink the
+backward-overlapped gradient collectives (parallel/overlap.py, ISSUE 5)
+under remaining backprop compute.
 """
 from __future__ import annotations
 
+import os
+
 import jax
 
-__all__ = ["Feature", "Features", "feature_list"]
+__all__ = ["Feature", "Features", "feature_list", "lhs_flags",
+           "apply_lhs_flags"]
+
+
+# The flag set the TPU scaling playbook enables for comm/compute overlap
+# (arXiv:2011.03641's "overlap gradient summation with backprop", done by
+# the compiler): the latency-hiding scheduler itself plus async lowering
+# of the collectives it reorders.  Harmless elsewhere: XLA ignores
+# backend-inapplicable flags on CPU/GPU backends.
+_LHS_FLAGS = (
+    "--xla_tpu_enable_latency_hiding_scheduler=true",
+    "--xla_enable_async_all_gather=true",
+    "--xla_enable_async_collective_permute=true",
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+)
+
+
+def lhs_flags():
+    """The XLA latency-hiding-scheduler flag strings (tuple).  These let
+    XLA launch a bucket's reduce-scatter as soon as its gradients exist
+    and hide the wire time under remaining backward compute — the
+    compiler half of the backward-overlapped comm pipeline (the graph
+    half is the backward-ordered ``zero.BucketPlan``)."""
+    return _LHS_FLAGS
+
+
+def _tpu_backend_plausible(env):
+    """True when the process can plausibly initialize a TPU backend.
+    The gate matters: CPU/GPU builds of XLA *fatally abort* on unknown
+    ``--xla_tpu_*`` flags, so the LHS flags may only go into XLA_FLAGS
+    where libtpu will consume them."""
+    platforms = env.get("JAX_PLATFORMS", "")
+    if "tpu" in platforms:
+        return True
+    if platforms:            # explicitly pinned elsewhere (cpu, cuda)
+        return False
+    import importlib.util
+    return importlib.util.find_spec("libtpu") is not None
+
+
+def apply_lhs_flags(env=None, force=False):
+    """Append :func:`lhs_flags` to ``XLA_FLAGS`` in ``env`` (default
+    ``os.environ``), skipping flags already present.  Must run BEFORE
+    the XLA backend initializes (first jax computation) to take effect;
+    ``MXTPU_LHS=1`` triggers this automatically at ``import mxnet_tpu``.
+    No-op on non-TPU hosts unless ``force=True`` — the flags are
+    TPU-backend-specific and a CPU/GPU XLA build aborts on them.
+    Returns the resulting ``XLA_FLAGS`` value."""
+    env = os.environ if env is None else env
+    current = env.get("XLA_FLAGS", "")
+    if not force and not _tpu_backend_plausible(env):
+        return current
+    missing = [f for f in _LHS_FLAGS
+               if f.split("=")[0] not in current]
+    if missing:
+        current = (current + " " + " ".join(missing)).strip()
+        env["XLA_FLAGS"] = current
+    return current
 
 
 class Feature:

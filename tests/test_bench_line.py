@@ -157,7 +157,8 @@ _COMM_KEYS = {
     "zero1", "dp", "wire_dtype", "buckets", "bucket_mb",
     "bytes_reduced_per_step", "bytes_gathered_per_step",
     "grad_bytes_fp32", "collective_ms", "est_ici_gb_s",
-    "overlap_efficiency", "state_bytes_per_chip",
+    "overlap_efficiency", "overlap_comm", "exposed_comm_ms",
+    "overlap_frac", "state_bytes_per_chip",
     "state_bytes_replicated",
 }
 
@@ -169,6 +170,9 @@ def test_comm_block_schema_is_stable():
     # defaults are all-zeros / fp32 — the CPU shape
     assert blk["dp"] == 1 and not blk["zero1"]
     assert blk["wire_dtype"] == "fp32"
+    # ISSUE 5 overlap fields: present with zero defaults (CPU shape)
+    assert blk["exposed_comm_ms"] == 0.0 and blk["overlap_frac"] == 0.0
+    assert blk["overlap_comm"] is False
     assert json.loads(json.dumps(blk)) == blk
 
 
@@ -188,6 +192,30 @@ def test_pipeline_probe_emits_comm_block():
         assert comm["collective_ms"] > 0
     else:
         assert comm["bytes_reduced_per_step"] == 0
+
+
+def test_overlap_probe_emits_schema_and_timings():
+    """tools/bench_pipeline.py overlap_probe: the comm block carries the
+    with-vs-without-overlap fields end-to-end.  On the forced 8-device
+    CPU mesh the three step builds (overlapped / monolithic /
+    compute-only) actually compile and time; zeros are allowed on CPU —
+    the SCHEMA is the tier-1 contract, the >0 numbers are TPU evidence."""
+    import jax
+    from tools.bench_pipeline import overlap_probe
+    payload = overlap_probe(batch=16, iters=2)
+    comm = payload["comm"]
+    assert set(comm) == _COMM_KEYS
+    assert len(json.dumps(payload)) < 1800
+    assert comm["exposed_comm_ms"] >= 0.0
+    assert 0.0 <= comm["overlap_frac"] <= 1.0
+    if len(jax.devices()) >= 8:
+        assert comm["zero1"] and comm["overlap_comm"]
+        ov = payload["overlap"]
+        for k in ("overlapped_step_ms", "monolithic_step_ms",
+                  "compute_only_step_ms"):
+            assert ov[k] > 0
+    else:
+        assert comm["exposed_comm_ms"] == 0.0
 
 
 def test_comm_mb_reduced_dropped_when_replicated():

@@ -599,3 +599,83 @@ def test_hb08_suppression_and_catalog():
                 return x
     """), path="<hb08>")
     assert out == []
+
+
+# ----------------------------------------------------------------------
+# HB09 — host sync between backward() and trainer.step() (ISSUE 5)
+# ----------------------------------------------------------------------
+
+def test_hb09_asnumpy_between_backward_and_step():
+    out = lint_source(textwrap.dedent("""
+        def train(net, trainer, loader, loss_fn):
+            for data, label in loader:
+                with autograd.record():
+                    loss = loss_fn(net(data), label)
+                loss.backward()
+                print(loss.asnumpy())
+                trainer.step(data.shape[0])
+    """), path="<hb09>")
+    assert _rules(out) == ["HB09"]
+    assert "asnumpy" in out[0].message and out[0].func == "train"
+
+
+def test_hb09_item_and_wait_to_read_flagged():
+    out = lint_source(textwrap.dedent("""
+        for batch in loader:
+            loss.backward()
+            running += loss.item()
+            loss.wait_to_read()
+            trainer.step(64)
+    """), path="<hb09>")
+    assert [v.rule for v in out] == ["HB09", "HB09"]
+
+
+def test_hb09_sync_after_step_is_clean():
+    # the supported shape: step() dispatches async, THEN read the loss
+    out = lint_source(textwrap.dedent("""
+        def train(trainer, loader):
+            for data, label in loader:
+                with autograd.record():
+                    loss = loss_fn(net(data), label)
+                loss.backward()
+                trainer.step(data.shape[0])
+                total += float(loss.asnumpy())
+    """), path="<hb09>")
+    assert out == []
+
+
+def test_hb09_outside_loop_and_no_backward_clean():
+    # a one-off eval sync (no loop) and a loop with no backward at all
+    # must stay silent — the rule targets the training hot loop only
+    out = lint_source(textwrap.dedent("""
+        loss.backward()
+        print(loss.asnumpy())
+        trainer.step(1)
+        def evaluate(metric, loader):
+            for data, label in loader:
+                metric.update(label, net(data).asnumpy())
+    """), path="<hb09>")
+    assert out == []
+
+
+def test_hb09_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB09" in RULES
+    out = lint_source(textwrap.dedent("""
+        for batch in loader:
+            loss.backward()
+            log(loss.asnumpy())  # mxlint: disable=HB09
+            trainer.step(8)
+    """), path="<hb09>")
+    assert out == []
+
+
+def test_hb09_package_is_clean():
+    """The framework's own training loops (estimator.fit, examples in
+    docstrings are not scanned) must hold the bar the rule sets."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB09"})
+    assert n_files > 50
+    assert viol == [], [f"{v.path}:{v.line}" for v in viol]

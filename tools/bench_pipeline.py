@@ -147,7 +147,7 @@ class RecBatchFeeder:
                 pf.close()
 
 
-def comm_probe(batch=16, iters=3, in_dim=32, classes=8):
+def comm_probe(batch=16, iters=3, in_dim=32, classes=8, overlap=False):
     """Tiny synthetic DataParallelTrainer run that emits the per-step
     ``comm`` block (parallel/zero.py schema, ISSUE 3): bytes reduced /
     gathered per step, MEASURED collective ms and est. ICI GB/s when the
@@ -182,12 +182,31 @@ def comm_probe(batch=16, iters=3, in_dim=32, classes=8):
         loss = trainer.step(x, y)
     loss.asnumpy()
     step_ms = (time.perf_counter() - t0) / iters * 1e3
-    return {
-        "metric": "pipeline_comm_probe",
+    ov = None
+    if overlap and dp > 1:
+        # with-vs-without-overlap build timings (ISSUE 5): overlapped /
+        # barrier-monolithic / compute-only -> exposed_comm_ms,
+        # overlap_frac (zeros on a 1-device host)
+        ov = trainer.overlap_probe(x, y, iters=iters)
+    payload = {
+        "metric": "pipeline_overlap_probe" if overlap
+        else "pipeline_comm_probe",
         "dp": dp,
         "step_ms": round(step_ms, 3),
-        "comm": trainer.comm_stats(measure=dp > 1, step_ms=step_ms),
+        "comm": trainer.comm_stats(measure=dp > 1, step_ms=step_ms,
+                                   overlap_stats=ov),
     }
+    if ov is not None:
+        payload["overlap"] = ov
+    return payload
+
+
+def overlap_probe(batch=16, iters=3, in_dim=32, classes=8):
+    """``comm_probe`` plus the backward-overlap exposure measurement —
+    the CLI evidence command for BENCH rounds
+    (``python tools/bench_pipeline.py overlap_probe``)."""
+    return comm_probe(batch=batch, iters=iters, in_dim=in_dim,
+                      classes=classes, overlap=True)
 
 
 def wrap_preproc(net):
@@ -210,4 +229,11 @@ def wrap_preproc(net):
 
 if __name__ == "__main__":
     import json
-    print(json.dumps(comm_probe()))
+    cmd = sys.argv[1] if len(sys.argv) > 1 else "comm_probe"
+    if cmd == "overlap_probe":
+        print(json.dumps(overlap_probe()))
+    elif cmd == "comm_probe":
+        print(json.dumps(comm_probe()))
+    else:
+        raise SystemExit(
+            f"unknown subcommand {cmd!r}: expected comm_probe|overlap_probe")
