@@ -24,7 +24,11 @@ parses the last line; BERT result is both its own earlier line and the
 "extra.bert" field of the last).  TPU bring-up is probed in a subprocess
 with timeout+retry (a wedged axon tunnel hangs jax.devices() forever); on
 persistent failure it falls back to CPU with a loud "cpu-fallback" platform
-marker (VERDICT r2 weak #8).
+marker (VERDICT r2 weak #8).  ONE exception, by explicit opt-in:
+MXTPU_BENCH_REQUIRE_TPU=1 turns a non-TPU backend into a fail-fast exit 2
+(still prints its JSON + compact lines) — no CPU fallback numbers exist to
+be misread (the r04/r05 lesson).  Every run stamps platform_requested /
+platform_actual in the payload either way.
 """
 from __future__ import annotations
 
@@ -300,18 +304,38 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
             # a gate; the serial numbers above already stand
             feeder.stats["overlap_error"] = f"{type(e).__name__}: {e}"
     else:
+        from mxnet_tpu import runtime as _rt
+        k_steps = _rt.steps_per_call()
         data = mx.nd.random.uniform(shape=(batch, 3, 224, 224))
         label = mx.nd.zeros((batch,))
         for _ in range(max(warmup, 1)):
             loss = trainer.step(data, label)
         loss.asnumpy()
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            loss = trainer.step(data, label)
-        loss.asnumpy()
-        dt = time.perf_counter() - t0
+        if k_steps > 1:
+            # multi-step compiled training (ISSUE 6): K steps scanned
+            # into ONE dispatch — the host pays the dispatch/program
+            # re-entry tax once per K steps
+            window = [(data, label)] * k_steps
+            loss = trainer.step_multi(window)      # compile off the clock
+            loss.asnumpy()
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = trainer.step_multi(window)
+            loss.asnumpy()
+            dt = time.perf_counter() - t0
+            total_steps = iters * k_steps
+        else:
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                loss = trainer.step(data, label)
+            loss.asnumpy()
+            dt = time.perf_counter() - t0
+            total_steps = iters
 
-    img_s = batch * iters / dt
+    if feeder is not None:
+        total_steps = iters
+        k_steps = 1
+    img_s = batch * total_steps / dt
     result = {
         "metric": "resnet50_train_images_per_sec",
         "value": round(img_s, 2),
@@ -322,7 +346,35 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
         "dtype": dtype,
         "data": data_mode,
         "s2d_stem": s2d,
+        "steps_per_call": k_steps,
     }
+    # dispatch tax (ISSUE 6): walltime/step minus the device time/step,
+    # the latter approximated by an 8-step scan window's amortized time
+    # (one dispatch per window => per-step host cost ~0).  "auto" runs
+    # it only on a real accelerator — an extra resnet-scan compile on a
+    # CPU smoke run isn't worth the minutes; tools/bench_pipeline.py
+    # dispatch_probe is the CPU-sized evidence path.
+    probe_mode = os.environ.get("MXTPU_BENCH_DISPATCH_PROBE", "auto")
+    result["dispatch_ms_per_step"] = None
+    if feeder is None and probe_mode != "0" and \
+            (probe_mode == "1" or platform == "tpu"):
+        try:
+            kp = 8
+            window = [(data, label)] * kp
+            loss = trainer.step_multi(window)
+            loss.asnumpy()
+            reps = max(2, min(iters, 5))
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                loss = trainer.step_multi(window)
+            loss.asnumpy()
+            amort_ms = (time.perf_counter() - t0) / (reps * kp) * 1e3
+            per_step_ms = dt / total_steps * 1e3
+            result["dispatch_ms_per_step"] = round(
+                max(0.0, per_step_ms - amort_ms), 3)
+        except Exception as e:  # noqa: BLE001 — probe is evidence, never
+            # voids the measured throughput
+            result["dispatch_probe_error"] = f"{type(e).__name__}: {e}"
     if feeder is not None:
         result["input_pipeline"] = feeder.stats
     try:
@@ -843,8 +895,10 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
     extra = result.get("extra") or {}
     cands = []
     for k in ("platform", "mfu", "tflops_delivered", "batch", "dtype",
-              "data", "s2d_stem", "flops_source"):
-        if k in result:
+              "data", "s2d_stem", "flops_source", "steps_per_call",
+              "dispatch_ms_per_step", "platform_requested",
+              "platform_actual"):
+        if k in result and result[k] is not None:
             cands.append((k, result[k]))
     if "error" in result:
         err = str(result["error"])
@@ -1004,7 +1058,9 @@ def main() -> int:
 
     platform = None
     fell_back = False
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+    requested = "cpu" if os.environ.get("JAX_PLATFORMS", "") == "cpu" \
+        else "tpu"
+    if requested == "cpu":
         # explicitly CPU-pinned: nothing to probe, but still strip the axon
         # plugin — a wedged tunnel can hang backend discovery even when the
         # requested platform is cpu (same defense as tests/conftest.py)
@@ -1017,6 +1073,23 @@ def main() -> int:
                 break
             if i < attempts - 1:
                 time.sleep(min(backoff * 2 ** i, 60.0))
+    if os.environ.get("MXTPU_BENCH_REQUIRE_TPU", "") == "1" and \
+            platform != "tpu":
+        # fail-FAST, fail-LOUD (ISSUE 6 honesty fix): rounds 4-5 fell
+        # back to CPU silently enough that CPU zeros were read as
+        # measurements.  With the flag set, a non-TPU backend is an
+        # ERROR exit — no fallback numbers to misread.
+        result = {"metric": "resnet50_train_images_per_sec", "value": 0.0,
+                  "unit": "img/s", "vs_baseline": 0.0,
+                  "platform_requested": "tpu",
+                  "platform_actual": platform or "none",
+                  "error": ("MXTPU_BENCH_REQUIRE_TPU=1: backend is "
+                            f"{platform or 'unreachable'} after "
+                            f"{attempts} probes; refusing CPU fallback")}
+        print(json.dumps(result), flush=True)
+        if os.environ.get("MXTPU_BENCH_NO_COMPACT", "") != "1":
+            print(_compact_line(result), flush=True)
+        return 2
     if platform is None:
         error = (f"backend probe failed after {attempts} attempts "
                  f"({timeout:.0f}s timeout each); falling back to CPU")
@@ -1040,6 +1113,12 @@ def main() -> int:
         if result is None:
             result = {"metric": "resnet50_train_images_per_sec",
                       "value": 0.0, "unit": "img/s", "vs_baseline": 0.0}
+    # requested-vs-actual stamps (ISSUE 6 honesty fix): the JSON carries
+    # what the round ASKED for and what it GOT, so a CPU fallback can
+    # never masquerade as an accelerator measurement in post-processing
+    result["platform_requested"] = requested
+    result["platform_actual"] = "cpu" if fell_back else \
+        (result.get("platform") or platform or "cpu")
     if fell_back:
         # LOUD marker: this number is NOT an accelerator number (r2 weak #8)
         result["platform"] = "cpu-FALLBACK"

@@ -432,8 +432,15 @@ class CheckpointManager:
         """
         step = int(step)
         groups = {}
+        from . import runtime as _runtime
         meta = {"format": _FORMAT_VERSION, "step": step,
-                "time": time.time(), "dp": _dp_size()}
+                "time": time.time(), "dp": _dp_size(),
+                # K-step compiled training (ISSUE 6): record the save
+                # cadence so a resumed run knows the cursor can only sit
+                # on this grid — the cursor itself stays in STEPS, so a
+                # resume with a different K (or K=1) fast-forwards to
+                # the exact step and re-forms its own windows
+                "steps_per_call": _runtime.steps_per_call()}
         p_arrays = self._param_arrays(params)
         if p_arrays:
             groups["params"] = _snapshot(p_arrays)

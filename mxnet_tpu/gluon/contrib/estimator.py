@@ -85,7 +85,10 @@ class MetricHandler(EpochBegin, BatchEnd):
         for m in self.metrics:
             if isinstance(m, metric_mod.Loss):
                 m.update(0, loss)
-            else:
+            elif pred is not None:
+                # the fused (DataParallelTrainer) path computes the loss
+                # in-graph and never materializes predictions; only Loss
+                # metrics can update there
                 m.update(label, pred)
 
 
@@ -239,10 +242,20 @@ class Estimator:
         pf = DevicePrefetcher(iter(train_data), depth=prefetch_depth)
         return pf, pf.close
 
+    def _train_step_eager(self, data, label):
+        """The classic gluon loop body: record/forward/backward/step.
+        Returns (pred, loss)."""
+        with autograd.record():
+            pred = self.net(data)
+            loss = self.loss(pred, label)
+        loss.backward()
+        self.trainer.step(data.shape[0])
+        return pred, loss
+
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, resume=None, checkpoint_manager=None,
             checkpoint_every=None, prefetch_to_device=False,
-            prefetch_depth=None):
+            prefetch_depth=None, steps_per_call=None):
         """Train; with ``checkpoint_manager`` the loop is preemption-safe:
 
         - ``checkpoint_every=N`` saves the full training state (params,
@@ -258,10 +271,39 @@ class Estimator:
         stages batches onto the device through an ``io.DevicePrefetcher``
         so H2D overlaps the step; depth defaults to
         ``MXTPU_PREFETCH_DEPTH`` (2).
+
+        ``steps_per_call=K`` (default: ``MXTPU_STEPS_PER_CALL``, 1) —
+        multi-step compiled training (ISSUE 6): with a fused trainer
+        (``parallel.DataParallelTrainer``, anything with ``step_multi``)
+        the loop hands K batches at a time into ONE compiled dispatch.
+        Handler calls, loss/metric flushes, checkpoint saves and the
+        preemption check all move to the scan boundaries (every K
+        steps); ``checkpoint_every`` rounds up to the next boundary.
+        Resume composes: a checkpoint written at a non-K-aligned step
+        fast-forwards per-batch and re-forms windows from there, and the
+        per-step math is bitwise the K=1 path.  K=1 keeps today's
+        per-step graphs and cadence exactly (kill-switch semantics like
+        ``MXTPU_FUSED_STEP``).  The fused trainer path computes loss
+        in-graph (no ``pred``): use Loss metrics there.  Eager
+        ``gluon.Trainer`` loops cannot compile multi-step windows; K>1
+        falls back to 1 with a warning.
         """
+        import warnings
         from ... import checkpoint as ckpt_mod
+        from ... import runtime as _runtime
         if epochs is None and batches is None:
             raise MXNetError("specify epochs or batches")
+        fused = hasattr(self.trainer, "step_multi")
+        k = int(steps_per_call) if steps_per_call is not None \
+            else _runtime.steps_per_call()
+        if k < 1:
+            raise MXNetError("steps_per_call must be >= 1")
+        if k > 1 and not fused:
+            warnings.warn(
+                "steps_per_call>1 needs a fused trainer with step_multi "
+                "(parallel.DataParallelTrainer); the eager gluon.Trainer "
+                "loop runs per-step — falling back to steps_per_call=1")
+            k = 1
         start_epoch = skip_batches = 0
         self.preempted = False
         if resume is not None:
@@ -295,35 +337,45 @@ class Estimator:
                 epoch_done = True
                 epoch_src, epoch_close = self._epoch_source(
                     train_data, prefetch_to_device, prefetch_depth)
-                for batch in epoch_src:
-                    if skip_batches:
-                        # fast-forward to the saved mid-epoch cursor
-                        # (RNG was restored, so a deterministic pipeline
-                        # replays the same batches)
-                        skip_batches -= 1
+
+                def run_window(window):
+                    """Execute a window of batches (ONE dispatch on the
+                    fused K>1 path), then per-step bookkeeping and the
+                    boundary-side checkpoint/preemption checks.  Window
+                    size 1 reproduces the classic per-step cadence
+                    exactly."""
+                    nonlocal batch_idx
+                    if fused:
+                        pairs = [(b[0], b[1]) for b in window]
+                        if len(pairs) == 1:
+                            # K=1 / tail flush: today's per-step graph
+                            results = [(None, self.trainer.step(*pairs[0]))]
+                        else:
+                            losses = self.trainer.step_multi(pairs)
+                            results = [(None, losses[i])
+                                       for i in range(len(pairs))]
+                    else:
+                        results = [self._train_step_eager(b[0], b[1])
+                                   for b in window]
+                    gs_before = self.global_step
+                    for (pred, loss), b in zip(results, window):
+                        self.global_step += 1
                         batch_idx += 1
-                        continue
-                    data, label = batch[0], batch[1]
-                    with autograd.record():
-                        pred = self.net(data)
-                        loss = self.loss(pred, label)
-                    loss.backward()
-                    self.trainer.step(data.shape[0])
-                    self.global_step += 1
-                    batch_idx += 1
-                    for h in handlers:
-                        if isinstance(h, BatchEnd):
-                            h.batch_end(self, pred=pred, label=label,
-                                        loss=loss)
+                        for h in handlers:
+                            if isinstance(h, BatchEnd):
+                                h.batch_end(self, pred=pred, label=b[1],
+                                            loss=loss)
                     preempted = preempt is not None and \
                         preempt.check_step(self.global_step)
+                    crossed = checkpoint_every and (
+                        self.global_step // checkpoint_every
+                        > gs_before // checkpoint_every)
                     if checkpoint_manager is not None and (
-                            preempted or (checkpoint_every and
-                                          self.global_step %
-                                          checkpoint_every == 0)):
-                        # the in-flight step is DONE; a preemption save
-                        # is synchronous — the process may be about to
-                        # die and must not exit with a half-write
+                            preempted or crossed):
+                        # the in-flight window is DONE (scan boundary);
+                        # a preemption save is synchronous — the process
+                        # may be about to die and must not exit with a
+                        # half-write
                         checkpoint_manager.save(
                             self.global_step, params=self.net,
                             trainer=self.trainer,
@@ -333,9 +385,30 @@ class Estimator:
                     if preempted:
                         self.preempted = True
                         self.stop_training = True
+
+                window = []
+                for batch in epoch_src:
+                    if skip_batches:
+                        # fast-forward to the saved mid-epoch cursor
+                        # (RNG was restored, so a deterministic pipeline
+                        # replays the same batches)
+                        skip_batches -= 1
+                        batch_idx += 1
+                        continue
+                    window.append(batch)
+                    if len(window) < k:
+                        continue
+                    run_window(window)
+                    window = []
                     if self.stop_training:
                         epoch_done = not self.preempted
                         break
+                if window and not self.stop_training:
+                    # tail: the epoch length was not a multiple of K —
+                    # flush the partial window (same per-step math)
+                    run_window(window)
+                    if self.stop_training:
+                        epoch_done = not self.preempted
                 if epoch_close is not None:
                     epoch_close()   # join the prefetch worker (idempotent)
                 if self.preempted:

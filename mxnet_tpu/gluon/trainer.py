@@ -287,6 +287,53 @@ class Trainer:
             self._fused_jit_cache[key] = jitted
         return jitted
 
+    def _get_flat_fused_jit(self, name, hyper, clip, aux_key, key):
+        """ONE flat-bucket program for the whole parameter group
+        (ISSUE 6: the reference's multi_sgd-style multi-tensor update):
+        params/grads/state concatenate into single flat f32 views and
+        the update runs ONCE over the bucket — on TPU as a single Pallas
+        kernel (ops/fused_update.py), elsewhere as one fused XLA chain
+        instead of one chain per parameter.  Elementwise math is
+        IDENTICAL to the per-param path (same kernel functions over the
+        same values), so results are bitwise-equal; qualification
+        happens host-side in _fused_jit_update."""
+        jitted = self._fused_jit_cache.get(key)
+        if jitted is None:
+            from ..ops.fused_update import fused_bucket_rule
+            _, bucket_apply = fused_bucket_rule(name, clip_gradient=clip,
+                                                **hyper)
+
+            def group_update_flat(params, grads, states, lr, wd, aux,
+                                  rescale):
+                shapes = [p.shape for p in params]
+                sizes = [p.size for p in params]
+                flat_p = jnp.concatenate([jnp.ravel(p) for p in params])
+                flat_g = jnp.concatenate([jnp.ravel(g) for g in grads]) \
+                    * rescale
+                state = {leaf: jnp.concatenate(
+                    [jnp.ravel(s[leaf]) for s in states])
+                    for leaf in states[0]}
+                if aux_key is not None:
+                    state[aux_key] = aux
+                new_flat, new_state = bucket_apply(flat_p, flat_g, state,
+                                                   lr, wd)
+                new_ps, new_ss = [], []
+                off = 0
+                for sh, n in zip(shapes, sizes):
+                    new_ps.append(new_flat[off:off + n].reshape(sh))
+                    # vector leaves slice back per param; scalar leaves
+                    # (adam's t) are aux-managed and unpack ignores them
+                    new_ss.append({
+                        leaf: v[off:off + n].reshape(sh)
+                        for leaf, v in new_state.items()
+                        if getattr(v, "ndim", 0) >= 1})
+                    off += n
+                return new_ps, new_ss
+
+            jitted = jax.jit(group_update_flat, donate_argnums=(0, 2))
+            self._fused_jit_cache[key] = jitted
+        return jitted
+
     def _fused_jit_update(self, ignore_stale_grad):
         """Fused, jitted, donated update for the whole parameter group
         (the Trainer-side half of the overlapped-pipeline tentpole; the
@@ -294,7 +341,12 @@ class Trainer:
         Falls back (returns False) for optimizers without a functional
         kernel, sparse/accumulating grads, multi-precision, or
         unexpected loaded state layouts — the exact eager path then
-        runs.  Disable with MXTPU_FUSED_STEP=0."""
+        runs.  Disable with MXTPU_FUSED_STEP=0.
+
+        When the whole group is uniform (same lr/wd/step count, all f32,
+        a flat-able rule) the group collapses further into ONE
+        flat-bucket update via :meth:`_get_flat_fused_jit`
+        (``MXTPU_FUSED_STEP_FLAT=0`` kills that layer only)."""
         from ..ndarray import sparse as _sp
         optimizer = self._optimizer
         if os.environ.get("MXTPU_FUSED_STEP", "1") == "0" or \
@@ -331,18 +383,28 @@ class Trainer:
             if i not in self._states:
                 self._states[i] = optimizer.create_state_multi_precision(
                     i, self._params[i].data())
-        lr_vec = jnp.asarray([optimizer._get_lr(i) for i in idxs],
-                             jnp.float32)
-        wd_vec = jnp.asarray([optimizer._get_wd(i) for i in idxs],
-                             jnp.float32)
+        lrs = [optimizer._get_lr(i) for i in idxs]
+        wds = [optimizer._get_wd(i) for i in idxs]
+        lr_vec = jnp.asarray(lrs, jnp.float32)
+        wd_vec = jnp.asarray(wds, jnp.float32)
         aux_key, aux_fn = _fused_aux(optimizer)
-        aux_vec = jnp.asarray(
-            [aux_fn(i) for i in idxs] if aux_fn else [0] * len(idxs),
-            jnp.int32)
+        auxs = [aux_fn(i) for i in idxs] if aux_fn else [0] * len(idxs)
+        aux_vec = jnp.asarray(auxs, jnp.int32)
         pvals = [p._data._data for p in params]
         gvals = [p._data._grad for p in params]
         svals = [pack(i, self._states[i]) for i in idxs]
         mesh = self._sharded_update_mesh()
+        # flat-bucket qualification (host-side: lr/wd/aux VALUES are
+        # known here): a uniform all-f32 group collapses into one
+        # flat update — bitwise the same math, one kernel walk
+        flat = (mesh is None and len(idxs) > 1
+                and os.environ.get("MXTPU_FUSED_STEP_FLAT", "1") != "0"
+                and name in ("sgd", "nag", "adam", "adamw")
+                and len(set(map(float, lrs))) == 1
+                and len(set(map(float, wds))) == 1
+                and len(set(map(int, auxs))) == 1
+                and all(v.dtype == jnp.float32 for v in pvals)
+                and all(g.dtype == jnp.float32 for g in gvals))
         if mesh is not None:
             # values committed off-mesh (fresh eager backward grads,
             # first-step params/state) conflict with the in-program
@@ -367,28 +429,40 @@ class Trainer:
                optimizer.clip_gradient, aux_key,
                tuple((v.shape, str(v.dtype)) for v in pvals),
                tuple(tuple(sorted(s)) for s in svals),
-               None if mesh is None else tuple(sorted(mesh.shape.items())))
-        _, apply_fn = opt.fused_rule(
-            name, clip_gradient=optimizer.clip_gradient, **hyper)
-        jitted = self._get_fused_jit(apply_fn, aux_key, key, mesh=mesh)
+               None if mesh is None else tuple(sorted(mesh.shape.items())),
+               "flat" if flat else "per-param")
         rescale = jnp.asarray(optimizer.rescale_grad, jnp.float32)
         with warnings.catch_warnings():
             # donation is a TPU/GPU optimization; CPU ignores it with a
             # UserWarning that would spam every step
             warnings.filterwarnings("ignore", message=".*[Dd]onat")
-            try:
-                new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
-                                        wd_vec, aux_vec, rescale)
-            except Exception:  # noqa: BLE001 — sharded lowering can fail
-                # (e.g. values committed to an incompatible device set);
-                # the replicated program is always valid. Lowering
-                # failures happen before buffers are donated.
-                if mesh is None:
-                    raise
-                jitted = self._get_fused_jit(apply_fn, aux_key,
-                                             key + ("replicated",))
-                new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
-                                        wd_vec, aux_vec, rescale)
+            if flat:
+                jitted = self._get_flat_fused_jit(
+                    name, hyper, optimizer.clip_gradient, aux_key, key)
+                new_ps, new_ss = jitted(
+                    pvals, gvals, svals,
+                    jnp.asarray(lrs[0], jnp.float32),
+                    jnp.asarray(wds[0], jnp.float32),
+                    jnp.asarray(auxs[0], jnp.int32), rescale)
+            else:
+                _, apply_fn = opt.fused_rule(
+                    name, clip_gradient=optimizer.clip_gradient, **hyper)
+                jitted = self._get_fused_jit(apply_fn, aux_key, key,
+                                             mesh=mesh)
+                try:
+                    new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
+                                            wd_vec, aux_vec, rescale)
+                except Exception:  # noqa: BLE001 — sharded lowering can
+                    # fail (e.g. values committed to an incompatible
+                    # device set); the replicated program is always
+                    # valid. Lowering failures happen before buffers are
+                    # donated.
+                    if mesh is None:
+                        raise
+                    jitted = self._get_fused_jit(apply_fn, aux_key,
+                                                 key + ("replicated",))
+                    new_ps, new_ss = jitted(pvals, gvals, svals, lr_vec,
+                                            wd_vec, aux_vec, rescale)
         if mesh is not None:
             # fresh params return to their pre-update placement so the
             # next eager forward never mixes device sets; only the

@@ -342,6 +342,36 @@ class DevicePrefetcher:
     def next(self):
         return self.__next__()
 
+    def next_k(self, k):
+        """Up to ``k`` consecutive batches as a list (the multi-step
+        feed: ``DataParallelTrainer.step_multi`` scans them in ONE
+        dispatch, ISSUE 6).  The worker keeps prefetching ahead as
+        usual, so collecting a window does not drain the pipeline.
+        Returns fewer than ``k`` at end-of-stream; raises
+        ``StopIteration`` only when not even one batch is left —
+        callers flush the partial tail window, they never lose it."""
+        if k < 1:
+            raise MXNetError("DevicePrefetcher.next_k: k must be >= 1")
+        out = []
+        for _ in range(int(k)):
+            try:
+                out.append(self.__next__())
+            except StopIteration:
+                if out:
+                    return out
+                raise
+        return out
+
+    def windows(self, k):
+        """Iterate the stream as lists of up to ``k`` batches (the last
+        window may be short) — sugar over :meth:`next_k` for K-step
+        training loops."""
+        while True:
+            try:
+                yield self.next_k(k)
+            except StopIteration:
+                return
+
     def __len__(self):
         return len(self._source)
 

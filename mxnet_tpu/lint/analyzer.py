@@ -811,6 +811,77 @@ class _BackwardStepScanner(ast.NodeVisitor):
     visit_For = visit_While = visit_AsyncFor = _scan_loop
 
 
+# -- HB10: per-step host pulls in a compiled multi-step loop -------------
+
+_HB10_SYNC_METHODS = _SYNC_METHODS | {"wait_to_read", "waitall"}
+
+
+class _MultiStepPullScanner(ast.NodeVisitor):
+    """HB10: a loop that calls ``step_multi`` runs the compiled
+    multi-step path — K steps, ONE dispatch, ONE intended host sync at
+    the scan boundary.  A host pull (``.item()``/``.asnumpy()``/... or
+    ``float()`` on a value) inside a loop NESTED in that window loop
+    runs per scanned step: K host round-trips per dispatch, the exact
+    tax the scan removes.  A single boundary pull directly in the
+    window loop stays clean.  Multiply-nested loops dedup through the
+    collector."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _calls_step_multi(loop):
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call) and \
+                    isinstance(sub.func, ast.Attribute) and \
+                    sub.func.attr == "step_multi":
+                return True
+        return False
+
+    def _flag(self, call, what):
+        self.c.add(Violation(
+            rule="HB10", path=self.path, line=call.lineno,
+            col=call.col_offset,
+            message=f"per-step host pull {what} inside a nested loop of "
+                    "a compiled multi-step training loop (step_multi): "
+                    "K host syncs per dispatch defeat the one-sync-per-"
+                    "window scan; read the (K,) losses once at the scan "
+                    "boundary and slice on the host",
+            block="", func=self.func_stack[-1]))
+
+    def _scan_window_loop(self, node):
+        if self._calls_step_multi(node):
+            inner_loops = [sub for sub in ast.walk(node)
+                           if isinstance(sub, (ast.For, ast.While,
+                                               ast.AsyncFor))
+                           and sub is not node]
+            for loop in inner_loops:
+                for sub in ast.walk(loop):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    f = sub.func
+                    if isinstance(f, ast.Attribute) and \
+                            f.attr in _HB10_SYNC_METHODS:
+                        self._flag(sub, f"`.{f.attr}()`")
+                    elif isinstance(f, ast.Name) and f.id == "float" \
+                            and sub.args:
+                        self._flag(sub, "`float()`")
+        self.generic_visit(node)
+
+    visit_For = visit_While = visit_AsyncFor = _scan_window_loop
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -945,9 +1016,11 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
                 continue              # inherited: reported on the owner
             collector.analyze_entry(fn, cname)
     if only_classes is None:
-        # HB07/HB09 are module-wide (any function), not forward-scoped
+        # HB07/HB09/HB10 are module-wide (any function), not
+        # forward-scoped
         _LoopCollectiveScanner(collector, path).visit(tree)
         _BackwardStepScanner(collector, path).visit(tree)
+        _MultiStepPullScanner(collector, path).visit(tree)
     suppressed, _unknown = parse_suppressions(source)
     src_lines = source.splitlines()
     out = []

@@ -135,6 +135,24 @@ RULES = {
         "loss.backward()\n"
         "trainer.step(batch_size)       # step dispatches async\n"
         "print(loss.asnumpy())          # sync AFTER the dispatches"),
+    "HB10": Rule(
+        "HB10", "per-step-host-pull-in-multi-step-loop",
+        "A per-step host pull of loss/metrics (`float(loss)`, "
+        "`.item()`, `.asnumpy()`, `.asscalar()`, `.tolist()`, "
+        "`.wait_to_read()`) inside a training loop that drives the "
+        "compiled multi-step path (`trainer.step_multi`, "
+        "MXTPU_STEPS_PER_CALL>1): scanning K steps into one dispatch "
+        "buys ONE host sync per window, and a pull inside a nested "
+        "per-step loop pays K syncs per dispatch — the exact per-step "
+        "host round-trip the scan exists to remove. Pull the (K,) loss "
+        "vector ONCE at the scan boundary and slice it on the host.",
+        "for window in prefetcher.windows(k):\n"
+        "    losses = trainer.step_multi(window)\n"
+        "    for l in losses:\n"
+        "        total += float(l)      # K host syncs per dispatch",
+        "for window in prefetcher.windows(k):\n"
+        "    losses = trainer.step_multi(window)\n"
+        "    total += losses.asnumpy().sum()  # ONE boundary sync"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

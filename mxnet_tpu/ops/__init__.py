@@ -6,5 +6,8 @@ covers what XLA won't fuse well — starting with flash attention.
 """
 from .flash_attention import flash_attention
 from .blocked_cross_entropy import fused_linear_cross_entropy
+from .fused_layernorm import fused_layer_norm
+from .fused_update import fused_bucket_rule
 
-__all__ = ["flash_attention", "fused_linear_cross_entropy"]
+__all__ = ["flash_attention", "fused_linear_cross_entropy",
+           "fused_layer_norm", "fused_bucket_rule"]

@@ -213,12 +213,17 @@ def _use_pallas(lq, lk, d):
         return None
     import os
 
-    def _pref(var):
-        # tuning knobs (MXTPU_FLASH_BQ/BK): preferred block sizes for the
-        # kernel autotune sweep; clamped to >=128 so a too-small value
-        # still falls back to a valid divisor instead of silently
-        # disabling the kernel, and malformed values are named
-        raw = os.environ.get(var, "512")
+    def _pref(var, legacy):
+        # tuning knobs (MXTPU_FLASH_BLOCK_Q/KV, legacy alias
+        # MXTPU_FLASH_BQ/BK): preferred block sizes for the kernel
+        # autotune sweep (tools/flash_long_seq.py --block-sweep);
+        # clamped to >=128 so a too-small value still falls back to a
+        # valid divisor instead of silently disabling the kernel, and
+        # malformed values are named
+        raw = os.environ.get(var)
+        if raw is None:
+            raw = os.environ.get(legacy, "512")
+            var = legacy
         try:
             return max(int(raw), 128)
         except ValueError as e:
@@ -226,8 +231,8 @@ def _use_pallas(lq, lk, d):
             raise MXNetError(
                 f"{var}={raw!r} is not an integer block size") from e
 
-    pref_q = _pref("MXTPU_FLASH_BQ")
-    pref_k = _pref("MXTPU_FLASH_BK")
+    pref_q = _pref("MXTPU_FLASH_BLOCK_Q", "MXTPU_FLASH_BQ")
+    pref_k = _pref("MXTPU_FLASH_BLOCK_KV", "MXTPU_FLASH_BK")
     bq = _pick_block(lq, pref_q)
     bk = _pick_block(lk, pref_k)
     # d=64 is fine: Mosaic pads the lane dim; BERT-base heads (768/12) hit

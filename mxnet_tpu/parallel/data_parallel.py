@@ -99,12 +99,21 @@ class DataParallelTrainer:
         self._rule_init, _kernel_apply = fused_rule(
             name, clip_gradient=clip, **params_kwargs)
         self._rule_apply = lambda p, g, s, lr: _kernel_apply(p, g, s, lr, wd)
+        # ZeRO-1 flat-shard updates route through the fused bucket rule:
+        # on TPU one Pallas kernel walks the whole flat bucket (ISSUE 6);
+        # everywhere else it IS the fused_rule kernel (bitwise identical)
+        from ..ops.fused_update import fused_bucket_rule
+        _, _bucket_kernel = fused_bucket_rule(
+            name, clip_gradient=clip, **params_kwargs)
+        self._bucket_apply = lambda p, g, s, lr: \
+            _bucket_kernel(p, g, s, lr, wd)
         self._param_objs = None
         self._param_vals = None   # device-resident, sharded; owned by us
         self._opt_state = None
         self._jitted = None
         self._jitted_indexed = None
         self._jit_accum_cache = {}
+        self._jit_multi_cache = {}
         self._jit_zero1_cache = {}
         self._num_update = 0
         self._donate = donate
@@ -168,6 +177,28 @@ class DataParallelTrainer:
             b, is_label=(i == len(inputs) - 1)))
             for i, b in enumerate(inputs)]
 
+    def _stacked_spec(self, ndim, is_label=False):
+        """PartitionSpec for a K-step stacked batch array (K, batch,
+        ...): leading scan axis replicated, within-batch sharding by the
+        same ``_eff_bax`` rule as :meth:`_batch_spec`."""
+        inner = [None] * (ndim - 1)
+        if ndim - 1 >= 1:
+            inner[self._eff_bax(ndim - 1, is_label)] = "dp"
+        return P(*([None] + inner))
+
+    def _put_stacked(self, steps):
+        """Stack K per-step batches along a new leading axis and place
+        them on the mesh (one H2D per input position, not one per
+        step)."""
+        n_in = len(steps[0])
+        out = []
+        for i in range(n_in):
+            stacked = jnp.stack([s[i] for s in steps])
+            sharding = NamedSharding(self.mesh, self._stacked_spec(
+                stacked.ndim, is_label=(i == n_in - 1)))
+            out.append(jax.device_put(stacked, sharding))
+        return out
+
     def _make_loss_of(self):
         """The traced fwd+loss closure — ONE source for every step
         variant (plain, indexed, accumulating), replicated or sharded."""
@@ -225,21 +256,20 @@ class DataParallelTrainer:
         donate = (0, 1) if self._donate else ()
         self._jitted = jax.jit(train_step, donate_argnums=donate)
 
-    def _build_accum(self, n_micro):
-        """Fused step with in-graph gradient accumulation: a ``lax.scan``
-        over ``n_micro`` microbatches (one microbatch's activations live
-        at a time), f32 grad accumulation, ONE optimizer update on the
-        mean grad.  Big-batch training without big-batch activation
-        memory — the reference reaches the same regime eagerly via
-        grad_req='add' + stepping every N batches (gluon/trainer.py);
-        here the whole accumulation compiles into the step.  Loss and
-        update logic come from the same _make_loss_of/_apply_updates the
-        plain step uses (single source, cannot diverge)."""
-        loss_of = self._make_loss_of()
+    def _grad_fn(self, loss_of, n_micro):
+        """``(param_vals, key, inputs, label) -> (grads, mean_loss)`` —
+        plain gradients or the ``n_micro``-microbatch accumulation scan
+        (the step_accum skeleton).  ONE source for the psum, ZeRO-1 and
+        multi-step step bodies (they can never diverge)."""
+        if n_micro <= 1:
+            def plain(param_vals, key, inputs, label):
+                loss, grads = jax.value_and_grad(loss_of)(
+                    list(param_vals), key, inputs, label)
+                return grads, loss
+            return plain
         split_micro = self._micro_splitter(n_micro)
 
-        def train_step(param_vals, opt_state, lr, key, *batch):
-            inputs, label = list(batch[:-1]), batch[-1]
+        def accum(param_vals, key, inputs, label):
             micro_in = [split_micro(b) for b in inputs]
             micro_lab = split_micro(label, is_label=True)
             keys = jax.random.split(key, n_micro)
@@ -253,17 +283,63 @@ class DataParallelTrainer:
                        for a, g in zip(acc, grads)]
                 return (acc, loss_sum + loss), None
 
-            init = ([jnp.zeros(v.shape, jnp.float32) for v in param_vals],
-                    jnp.zeros((), jnp.float32))
+            init = ([jnp.zeros(v.shape, jnp.float32)
+                     for v in param_vals], jnp.zeros((), jnp.float32))
             (acc, loss_sum), _ = lax.scan(
                 scan_step, init, tuple(micro_in) + (micro_lab, keys))
-            mean_grads = [g / n_micro for g in acc]
+            return [g / n_micro for g in acc], loss_sum / n_micro
+        return accum
+
+    def _build_accum(self, n_micro):
+        """Fused step with in-graph gradient accumulation: a ``lax.scan``
+        over ``n_micro`` microbatches (one microbatch's activations live
+        at a time), f32 grad accumulation, ONE optimizer update on the
+        mean grad.  Big-batch training without big-batch activation
+        memory — the reference reaches the same regime eagerly via
+        grad_req='add' + stepping every N batches (gluon/trainer.py);
+        here the whole accumulation compiles into the step.  Loss and
+        update logic come from the same _grad_fn/_apply_updates the
+        plain step uses (single source, cannot diverge)."""
+        grad_fn = self._grad_fn(self._make_loss_of(), n_micro)
+
+        def train_step(param_vals, opt_state, lr, key, *batch):
+            inputs, label = list(batch[:-1]), batch[-1]
+            mean_grads, mean_loss = grad_fn(param_vals, key, inputs,
+                                            label)
             new_params, new_state = self._apply_updates(
                 param_vals, mean_grads, opt_state, lr)
-            return new_params, new_state, loss_sum / n_micro
+            return new_params, new_state, mean_loss
 
         donate = (0, 1) if self._donate else ()
         return jax.jit(train_step, donate_argnums=donate)
+
+    def _build_multi(self, n_steps, n_micro):
+        """K = ``n_steps`` training steps lowered into ONE XLA program
+        (ISSUE 6 tentpole): a ``lax.scan`` over device-resident batches
+        with ALL carry state — params, optimizer slots — donated, so the
+        host dispatches once per K steps instead of once per step.
+        Per-step lrs and PRNG keys arrive as stacked (K,) vectors drawn
+        host-side from the SAME streams the per-step path uses, so K>1
+        matches K=1 bitwise (the per-step math is _grad_fn +
+        _apply_updates, the exact single-step bodies)."""
+        grad_fn = self._grad_fn(self._make_loss_of(), n_micro)
+
+        def train_multi(param_vals, opt_state, lrs, keys, *stacked):
+            def one_step(carry, xs):
+                pv, st = carry
+                lr, key = xs[0], xs[1]
+                batch = list(xs[2:])
+                grads, loss = grad_fn(pv, key, batch[:-1], batch[-1])
+                new_p, new_s = self._apply_updates(pv, grads, st, lr)
+                return (new_p, new_s), loss
+
+            (new_params, new_state), losses = lax.scan(
+                one_step, (list(param_vals), opt_state),
+                (lrs, keys) + tuple(stacked))
+            return new_params, new_state, losses
+
+        donate = (0, 1) if self._donate else ()
+        return jax.jit(train_multi, donate_argnums=donate)
 
     def _micro_splitter(self, n_micro):
         def split_micro(b, is_label=False):
@@ -475,7 +551,9 @@ class DataParallelTrainer:
                     gflat, jax.random.fold_in(key, b), dp, mode)
             prev_shard = gshard
             pshard = lax.dynamic_slice(pflats[b], (idx * ls,), (ls,))
-            np_, ns = self._rule_apply(pshard, gshard, opt_local[b], lr)
+            # flat 1/N shard update: ONE fused kernel walks the bucket
+            # (Pallas on TPU, the identical fused_rule chain elsewhere)
+            np_, ns = self._bucket_apply(pshard, gshard, opt_local[b], lr)
             if comm_mode == "none":
                 new_pflats.append(jnp.tile(np_, dp))
             else:
@@ -483,71 +561,76 @@ class DataParallelTrainer:
             new_state.append(ns)
         return plan.unflatten(new_pflats, param_vals), new_state
 
-    def _get_zero1_jit(self, kind, inputs, n_micro=None,
+    def _get_zero1_jit(self, kind, inputs, n_micro=None, n_steps=None,
                        comm_mode="overlap", donate=None):
         """Build (and cache per input-rank signature) the jitted
         shard_map step.  Unlike the psum path, shard_map needs the
         in/out specs — hence ranks — up front; jit would retrace per
         shape anyway, so this costs nothing extra."""
         self._zero1_ensure_plan()
-        sig = (kind, n_micro, tuple(b.ndim for b in inputs), comm_mode,
-               donate)
+        sig = (kind, n_micro, n_steps, tuple(b.ndim for b in inputs),
+               comm_mode, donate)
         jitted = self._jit_zero1_cache.get(sig)
         if jitted is not None:
             return jitted
-        loss_of = self._make_loss_of()
         mesh = self.mesh
         n_in = len(inputs)
+        grad_fn = self._grad_fn(self._make_loss_of(),
+                                n_micro if kind in ("accum", "multi")
+                                and n_micro else 1)
 
-        def local_grads(param_vals, lr, key, inputs, label):
-            if kind == "accum":
-                split_micro = self._micro_splitter(n_micro)
-                micro_in = [split_micro(b) for b in inputs]
-                micro_lab = split_micro(label, is_label=True)
-                keys = jax.random.split(key, n_micro)
-
-                def scan_step(carry, xs):
-                    acc, loss_sum = carry
-                    *mb, lab, k = xs
-                    loss, grads = jax.value_and_grad(loss_of)(
-                        list(param_vals), k, mb, lab)
-                    acc = [a + g.astype(jnp.float32)
-                           for a, g in zip(acc, grads)]
-                    return (acc, loss_sum + loss), None
-
-                init = ([jnp.zeros(v.shape, jnp.float32)
-                         for v in param_vals], jnp.zeros((), jnp.float32))
-                (acc, loss_sum), _ = lax.scan(
-                    scan_step, init, tuple(micro_in) + (micro_lab, keys))
-                return [g / n_micro for g in acc], loss_sum / n_micro
-            loss, grads = jax.value_and_grad(loss_of)(
-                list(param_vals), key, inputs, label)
-            return grads, loss
-
-        def local_body(param_vals, opt_local, lr, key, *batch):
+        def local_step(param_vals, opt_local, lr, key, ins, label):
+            """One sharded step: per-chip grads -> pmean loss -> the
+            bucketed RS -> 1/N update -> AG pipeline.  Shared by every
+            kind; the multi-step scan body IS this function."""
             # per-chip PRNG stream (dropout etc. draws fresh per chip)
             key = jax.random.fold_in(key, lax.axis_index("dp"))
-            if kind == "indexed":
-                superdata, superlabel, i = batch
-                data = lax.dynamic_index_in_dim(superdata, i, 0,
-                                                keepdims=False)
-                label = lax.dynamic_index_in_dim(superlabel, i, 0,
-                                                 keepdims=False)
-                ins = [data]
-            else:
-                ins, label = list(batch[:-1]), batch[-1]
-            grads, loss = local_grads(param_vals, lr, key, ins, label)
+            grads, loss = grad_fn(param_vals, key, ins, label)
             loss = lax.pmean(loss, "dp")
             new_params, new_state = self._zero1_sync_update(
                 param_vals, grads, opt_local, lr,
                 jax.random.fold_in(key, 0x5eed), comm_mode=comm_mode)
             return new_params, new_state, loss
 
+        if kind == "multi":
+            def local_body(param_vals, opt_local, lrs, keys, *stacked):
+                def one_step(carry, xs):
+                    pv, st = carry
+                    lr, key = xs[0], xs[1]
+                    batch = list(xs[2:])
+                    new_p, new_s, loss = local_step(
+                        pv, st, lr, key, batch[:-1], batch[-1])
+                    return (new_p, new_s), loss
+
+                (pv, st), losses = lax.scan(
+                    one_step, (list(param_vals), opt_local),
+                    (lrs, keys) + tuple(stacked))
+                return pv, st, losses
+        else:
+            def local_body(param_vals, opt_local, lr, key, *batch):
+                if kind == "indexed":
+                    superdata, superlabel, i = batch
+                    data = lax.dynamic_index_in_dim(superdata, i, 0,
+                                                    keepdims=False)
+                    label = lax.dynamic_index_in_dim(superlabel, i, 0,
+                                                     keepdims=False)
+                    ins = [data]
+                else:
+                    ins, label = list(batch[:-1]), batch[-1]
+                return local_step(param_vals, opt_local, lr, key, ins,
+                                  label)
+
         pspecs = [P()] * len(self._param_vals)
         sspecs = self._zero1_state_spec_tree()
         if kind == "indexed":
             dspec, lspec = inputs[0], inputs[1]   # prebuilt epoch specs
             batch_specs = (dspec, lspec, P())
+        elif kind == "multi":
+            # per-step batches stacked on a leading replicated K axis;
+            # the within-batch sharding follows the same _eff_bax rule
+            batch_specs = tuple(
+                self._stacked_spec(b.ndim + 1, is_label=(i == n_in - 1))
+                for i, b in enumerate(inputs))
         else:
             batch_specs = tuple(
                 self._batch_spec(b.ndim, is_label=(i == n_in - 1))
@@ -612,6 +695,85 @@ class DataParallelTrainer:
         for p, v in zip(params, new_params):
             p._data._set_data(v)
         return NDArray(loss)
+
+    def step_multi(self, batches, n_micro=1):
+        """K training steps in ONE compiled dispatch (ISSUE 6 tentpole).
+
+        ``batches``: sequence of K per-step batches, each the same
+        ``(*inputs, label)`` tuple :meth:`step` takes (all K must share
+        shapes — the scan is one trace).  ``n_micro`` > 1 composes with
+        in-graph gradient accumulation: each of the K steps is itself a
+        ``step_accum``-style microbatch scan.  Returns the (K,) vector
+        of per-step losses as one NDArray — read it AFTER the dispatch
+        returns; one host sync per K steps is the point.
+
+        Bitwise contract: K steps through here produce exactly the
+        params/optimizer state/losses of K consecutive ``step`` (or
+        ``step_accum``) calls — per-step lrs and PRNG keys are drawn
+        host-side from the same streams, and the step body is the same
+        ``_grad_fn``/update code.  ``MXTPU_STEPS_PER_CALL=1`` (the
+        default) keeps K-aware loops (estimator/bench) on the per-step
+        entry points, restoring today's graphs exactly.
+        """
+        batches = list(batches)
+        k = len(batches)
+        if k < 1:
+            raise MXNetError("step_multi: need at least one batch")
+        if n_micro < 1:
+            raise MXNetError("step_multi: n_micro must be >= 1")
+        steps = [[b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                  for b in bt] for bt in batches]
+        first = steps[0]
+        n_in = len(first)
+        for s in steps[1:]:
+            if len(s) != n_in or any(
+                    tuple(a.shape) != tuple(b.shape) or a.dtype != b.dtype
+                    for a, b in zip(s, first)):
+                raise MXNetError(
+                    "step_multi: all K batches must share shapes/dtypes "
+                    "(one scan trace covers the whole window)")
+        bax = self._eff_bax(first[-1].ndim, is_label=True)
+        if first[-1].shape[bax] % n_micro:
+            raise MXNetError(
+                f"step_multi: batch axis {bax} size "
+                f"{first[-1].shape[bax]} not divisible by n_micro "
+                f"{n_micro}")
+        params = self._collect(*[NDArray(b) for b in first[:-1]])
+        if self._zero1_active():
+            self._zero1_ensure_plan(first)
+        self._ensure_device_state(params)
+        if self._zero1_active():
+            self._zero1_check_batch(first)
+            dp = self.mesh.shape["dp"]
+            if n_micro > 1 and (first[-1].shape[bax] // dp) % n_micro:
+                raise MXNetError(
+                    f"step_multi under shard_updates: batch "
+                    f"{first[-1].shape[bax]} must split evenly over "
+                    f"dp={dp} chips x n_micro={n_micro} microbatches")
+            jitted = self._get_zero1_jit("multi", first, n_micro=n_micro,
+                                         n_steps=k)
+        else:
+            jitted = self._jit_multi_cache.get((k, n_micro))
+            if jitted is None:
+                jitted = self._build_multi(k, n_micro)
+                self._jit_multi_cache[(k, n_micro)] = jitted
+        stacked = self._put_stacked(steps)
+        # per-step keys/lrs drawn from the SAME host streams the K=1
+        # path uses — this is what makes K>1 bitwise-match K=1
+        keys = jnp.stack([_rnd.next_key() for _ in range(k)])
+        if self._lr_scheduler is not None:
+            lrs = [float(self._lr_scheduler(self._num_update + i))
+                   for i in range(k)]
+        else:
+            lrs = [self._lr] * k
+        lrs = jnp.asarray(lrs, jnp.float32)
+        new_params, self._opt_state, losses = jitted(
+            self._param_vals, self._opt_state, lrs, keys, *stacked)
+        self._num_update += k
+        self._param_vals = list(new_params)
+        for p, v in zip(params, new_params):
+            p._data._set_data(v)
+        return NDArray(losses)
 
     def put_epoch(self, superdata, superlabel):
         """Upload an epoch of batches to device once: superdata
@@ -880,9 +1042,12 @@ class DataParallelTrainer:
         (CPU / dp=1 / kill switch)."""
         import time
         from .. import profiler
-        out = {"exposed_comm_ms": 0.0, "overlap_frac": 0.0,
-               "overlapped_step_ms": 0.0, "monolithic_step_ms": 0.0,
-               "compute_only_step_ms": 0.0}
+        # None = NOT measured (pipeline off) — a 0.0 here would read as
+        # "measured: comm is free", which the r04/r05 CPU-fallback rounds
+        # showed gets mistaken for evidence
+        out = {"exposed_comm_ms": None, "overlap_frac": None,
+               "overlapped_step_ms": None, "monolithic_step_ms": None,
+               "compute_only_step_ms": None}
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
@@ -966,7 +1131,7 @@ class DataParallelTrainer:
         for leaf in jax.tree.leaves(self._opt_state):
             nbytes = leaf.size * leaf.dtype.itemsize
             state_chip += nbytes // dp if leaf.ndim >= 1 else nbytes
-        coll_ms = gbs = overlap = 0.0
+        coll_ms = gbs = overlap = None     # None = not measured
         if measure and dp > 1:
             coll_ms = self._measure_collectives(iters)
             if coll_ms > 0:
@@ -982,8 +1147,8 @@ class DataParallelTrainer:
             collective_ms=coll_ms, est_ici_gb_s=gbs,
             overlap_efficiency=overlap, zero1=True,
             overlap_comm=self._overlap_comm,
-            exposed_comm_ms=ov.get("exposed_comm_ms", 0.0),
-            overlap_frac=ov.get("overlap_frac", 0.0),
+            exposed_comm_ms=ov.get("exposed_comm_ms"),
+            overlap_frac=ov.get("overlap_frac"),
             state_bytes_per_chip=state_chip, state_bytes_replicated=state_rep)
 
     def _measure_collectives(self, iters=10):

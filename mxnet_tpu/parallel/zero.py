@@ -272,19 +272,30 @@ def reduce_scatter_bucket(flat, key, dp, mode="fp32", axis="dp"):
 
 def comm_block(dp=1, wire_dtype="fp32", buckets=0, bucket_mb=None,
                bytes_reduced_per_step=0, bytes_gathered_per_step=0,
-               grad_bytes_fp32=0, collective_ms=0.0, est_ici_gb_s=0.0,
-               overlap_efficiency=0.0, zero1=False,
+               grad_bytes_fp32=0, collective_ms=None, est_ici_gb_s=None,
+               overlap_efficiency=None, zero1=False,
                state_bytes_per_chip=0, state_bytes_replicated=0,
-               overlap_comm=False, exposed_comm_ms=0.0, overlap_frac=0.0):
+               overlap_comm=False, exposed_comm_ms=None,
+               overlap_frac=None):
     """The per-step ``comm`` block schema.  Every field is always
-    present (zeros on CPU / dp=1) so tier-1 regression-tests the shape
-    (tests/test_bench_line.py) without needing a multichip host.
+    present so tier-1 regression-tests the shape
+    (tests/test_bench_line.py) without needing a multichip host — but
+    MEASURED fields (``collective_ms``, ``est_ici_gb_s``,
+    ``overlap_efficiency``, ``exposed_comm_ms``, ``overlap_frac``) are
+    ``null`` when nothing was measured (CPU / dp=1 / probe skipped)
+    instead of 0: the rounds-4/5 silent CPU fallback taught us that a
+    zero in a measured field reads as "measured: no comm cost", which
+    is a lie (ISSUE 6 honesty fix).  Static wire accounting stays
+    integer-zeros — those are genuinely computed, not measured.
 
     ``exposed_comm_ms`` / ``overlap_frac`` (ISSUE 5) come from the
     with-vs-without-overlap probe
     (``DataParallelTrainer.overlap_probe``): exposed = time the
     overlapped step still spends on communication beyond its pure
     compute, overlap_frac = 1 - exposed / total serialized comm."""
+    def _r(x, n):
+        return None if x is None else round(float(x), n)
+
     return {
         "zero1": bool(zero1),
         "dp": int(dp),
@@ -295,12 +306,12 @@ def comm_block(dp=1, wire_dtype="fp32", buckets=0, bucket_mb=None,
         "bytes_reduced_per_step": int(bytes_reduced_per_step),
         "bytes_gathered_per_step": int(bytes_gathered_per_step),
         "grad_bytes_fp32": int(grad_bytes_fp32),
-        "collective_ms": round(float(collective_ms), 3),
-        "est_ici_gb_s": round(float(est_ici_gb_s), 2),
-        "overlap_efficiency": round(float(overlap_efficiency), 4),
+        "collective_ms": _r(collective_ms, 3),
+        "est_ici_gb_s": _r(est_ici_gb_s, 2),
+        "overlap_efficiency": _r(overlap_efficiency, 4),
         "overlap_comm": bool(overlap_comm),
-        "exposed_comm_ms": round(float(exposed_comm_ms), 3),
-        "overlap_frac": round(float(overlap_frac), 4),
+        "exposed_comm_ms": _r(exposed_comm_ms, 3),
+        "overlap_frac": _r(overlap_frac, 4),
         "state_bytes_per_chip": int(state_bytes_per_chip),
         "state_bytes_replicated": int(state_bytes_replicated),
     }

@@ -14,7 +14,31 @@ import os
 import jax
 
 __all__ = ["Feature", "Features", "feature_list", "lhs_flags",
-           "apply_lhs_flags"]
+           "apply_lhs_flags", "steps_per_call"]
+
+
+def steps_per_call():
+    """Training steps lowered into ONE compiled dispatch
+    (``MXTPU_STEPS_PER_CALL``, default 1 = today's one-dispatch-per-step
+    behavior — the kill switch, same semantics as ``MXTPU_FUSED_STEP``).
+    K > 1 makes K-step-capable loops (``estimator.fit`` over a
+    ``DataParallelTrainer``, bench.py) drive
+    ``DataParallelTrainer.step_multi`` — K steps scanned device-resident
+    per host dispatch, so the per-step eager dispatch + program
+    re-entry tax is paid once per K steps (arXiv:2011.03641 host-bound
+    concurrency ceiling; arXiv:1909.09756 keeps many steps device-
+    resident per launch)."""
+    from .base import MXNetError
+    raw = os.environ.get("MXTPU_STEPS_PER_CALL", "1")
+    try:
+        k = int(raw)
+    except ValueError:
+        raise MXNetError(
+            f"MXTPU_STEPS_PER_CALL={raw!r}: expected an integer >= 1")
+    if k < 1:
+        raise MXNetError(
+            f"MXTPU_STEPS_PER_CALL must be >= 1, got {k}")
+    return k
 
 
 # The flag set the TPU scaling playbook enables for comm/compute overlap

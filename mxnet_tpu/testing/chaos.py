@@ -82,15 +82,29 @@ def _bitwise(a, b):
         all(_np.array_equal(a[k], b[k]) for k in a)
 
 
-def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None):
+def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
+                 resume_steps_per_call=1):
+    """``resume_steps_per_call`` > 1 (ISSUE 6): the RESUME phase drives
+    ``step_multi`` windows of that size instead of per-step calls — the
+    surviving checkpoint sits at a step that is NOT a multiple of K
+    (written mid-scan-window relative to the resumed run's grid), so
+    this asserts that a non-K-aligned resume reproduces the K=1
+    reference curve bitwise (partial tail windows included).  Needs a
+    trainer with ``step_multi`` (the sharded mode)."""
     from mxnet_tpu.base import MXNetError
     from mxnet_tpu.checkpoint import CheckpointManager, run_preemptible
     from mxnet_tpu.testing import faults
 
-    ckdir = os.path.join(workdir, f"ckpt-{mode}")
+    k_resume = int(resume_steps_per_call)
+    if k_resume > 1 and mode != "sharded":
+        raise MXNetError(
+            "resume_steps_per_call>1 needs the sharded "
+            "(DataParallelTrainer) scenario — gluon.Trainer is eager")
+    ckdir = os.path.join(workdir, f"ckpt-{mode}-k{k_resume}")
     xs, ys = _make_data(99)
     result = {"mode": mode, "preempt_at": preempt_at,
-              "total_steps": total_steps}
+              "total_steps": total_steps,
+              "resume_steps_per_call": k_resume}
 
     # 1. reference: uninterrupted
     net, trainer, step = _build(mode)
@@ -157,8 +171,19 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None):
     manifest = mgr.restore(params=net, trainer=trainer)
     start = manifest["iterator"]["batch"]
     result["resumed_from"] = manifest["step"]
-    for i in range(start, total_steps):
-        step(xs[i], ys[i])
+    if k_resume > 1:
+        # K-step compiled replay from a mid-window checkpoint: windows
+        # re-form at the resumed step; the tail window may be short
+        i = start
+        while i < total_steps:
+            w = min(k_resume, total_steps - i)
+            trainer.step_multi(
+                [(mx.nd.array(xs[j]), mx.nd.array(ys[j]))
+                 for j in range(i, i + w)])
+            i += w
+    else:
+        for i in range(start, total_steps):
+            step(xs[i], ys[i])
     result["params_bitwise"] = _bitwise(ref_params, _params_of(net))
     result["state_bitwise"] = _bitwise(ref_state, _state_of(trainer))
     result["ok"] = bool(
@@ -180,6 +205,10 @@ def main(argv=None):
     try:
         results = [run_scenario(mode, workdir=workdir)
                    for mode in ("plain", "sharded")]
+        # ISSUE 6: resume from the (non-K-aligned) surviving checkpoint
+        # with K=4 multi-step windows — must still match K=1 bitwise
+        results.append(run_scenario("sharded", workdir=workdir,
+                                    resume_steps_per_call=4))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     ok = all(r["ok"] for r in results)

@@ -28,8 +28,10 @@ def _success_payload():
     return {
         "metric": "resnet50_train_images_per_sec", "value": 2068.4,
         "unit": "img/s", "vs_baseline": 1.59, "platform": "tpu",
+        "platform_requested": "tpu", "platform_actual": "tpu",
         "batch": 256, "dtype": "bf16", "data": "synthetic",
         "s2d_stem": True, "mfu": 0.235, "tflops_delivered": 46.3,
+        "steps_per_call": 16, "dispatch_ms_per_step": 0.41,
         "flops_source": "xla_cost_analysis",
         "chip_peak_tflops_bf16": 197.0,
         "comm": zero.comm_block(
@@ -98,6 +100,9 @@ def test_success_line_parses_and_fits():
     assert obj["value"] == 2068.4
     assert obj["platform"] == "tpu"
     assert obj["mfu"] == 0.235
+    # multi-step compiled training evidence (ISSUE 6) survives
+    assert obj["steps_per_call"] == 16
+    assert obj["dispatch_ms_per_step"] == 0.41
     # sharded-sync evidence survives compaction when zero1 ran
     assert obj["comm_ms"] == 1.84
     assert obj["comm_gb_s"] == 83.5
@@ -167,12 +172,19 @@ def test_comm_block_schema_is_stable():
     from mxnet_tpu.parallel import zero
     blk = zero.comm_block()
     assert set(blk) == _COMM_KEYS
-    # defaults are all-zeros / fp32 — the CPU shape
+    # static accounting defaults are zeros / fp32 — the CPU shape
     assert blk["dp"] == 1 and not blk["zero1"]
     assert blk["wire_dtype"] == "fp32"
-    # ISSUE 5 overlap fields: present with zero defaults (CPU shape)
-    assert blk["exposed_comm_ms"] == 0.0 and blk["overlap_frac"] == 0.0
+    # MEASURED fields are null when nothing measured (ISSUE 6 honesty
+    # fix: a CPU zero must not read as "measured: comm is free")
+    for k in ("collective_ms", "est_ici_gb_s", "overlap_efficiency",
+              "exposed_comm_ms", "overlap_frac"):
+        assert blk[k] is None, k
     assert blk["overlap_comm"] is False
+    # measured values still round-trip as numbers
+    blk2 = zero.comm_block(collective_ms=1.8444, overlap_frac=0.51234)
+    assert blk2["collective_ms"] == 1.844
+    assert blk2["overlap_frac"] == 0.5123
     assert json.loads(json.dumps(blk)) == blk
 
 
@@ -192,6 +204,8 @@ def test_pipeline_probe_emits_comm_block():
         assert comm["collective_ms"] > 0
     else:
         assert comm["bytes_reduced_per_step"] == 0
+        # nothing measured on 1 device: null, not a fake zero
+        assert comm["collective_ms"] is None
 
 
 def test_overlap_probe_emits_schema_and_timings():
@@ -206,16 +220,18 @@ def test_overlap_probe_emits_schema_and_timings():
     comm = payload["comm"]
     assert set(comm) == _COMM_KEYS
     assert len(json.dumps(payload)) < 1800
-    assert comm["exposed_comm_ms"] >= 0.0
-    assert 0.0 <= comm["overlap_frac"] <= 1.0
     if len(jax.devices()) >= 8:
         assert comm["zero1"] and comm["overlap_comm"]
+        assert comm["exposed_comm_ms"] >= 0.0
+        assert 0.0 <= comm["overlap_frac"] <= 1.0
         ov = payload["overlap"]
         for k in ("overlapped_step_ms", "monolithic_step_ms",
                   "compute_only_step_ms"):
             assert ov[k] > 0
     else:
-        assert comm["exposed_comm_ms"] == 0.0
+        # probe could not run: nulls, never fake zeros (ISSUE 6)
+        assert comm["exposed_comm_ms"] is None
+        assert comm["overlap_frac"] is None
 
 
 def test_comm_mb_reduced_dropped_when_replicated():
@@ -224,3 +240,64 @@ def test_comm_mb_reduced_dropped_when_replicated():
     p["comm"]["zero1"] = False
     obj = json.loads(bench._compact_line(p))
     assert "comm_ms" not in obj and "comm_mb_reduced" not in obj
+
+
+def test_null_measured_fields_stay_out_of_headline():
+    """A zero1 block whose measured fields are null (nothing measured)
+    must not put nulls — or fake zeros — into the compact line."""
+    from mxnet_tpu.parallel import zero
+    p = _success_payload()
+    p["comm"] = zero.comm_block(dp=8, zero1=True, buckets=4,
+                                bytes_reduced_per_step=1000)
+    p["dispatch_ms_per_step"] = None
+    obj = json.loads(bench._compact_line(p))
+    assert "comm_ms" not in obj and "comm_overlap_frac" not in obj
+    assert "dispatch_ms_per_step" not in obj
+    assert obj["comm_mb_reduced"] == 0.0   # static accounting still real
+
+
+# ----------------------------------------------------------------------
+# multi-step dispatch evidence (ISSUE 6): the dispatch_probe subcommand
+# and the steps_per_call plumbing
+# ----------------------------------------------------------------------
+
+def test_dispatch_probe_schema_and_monotone_shrink():
+    """K steps scanned into one dispatch must shrink the per-step
+    dispatch tax monotonically K=1 -> 16 on CPU — the acceptance
+    criterion the probe exists to demonstrate."""
+    from tools.bench_pipeline import dispatch_probe
+    payload = dispatch_probe(ks=(1, 4, 16), steps=32, repeats=2)
+    assert payload["metric"] == "pipeline_dispatch_probe"
+    assert len(json.dumps(payload)) < 1800
+    rows = {r["k"]: r for r in payload["rows"]}
+    assert set(rows) == {1, 4, 16}
+    for r in rows.values():
+        assert r["step_ms"] > 0
+        assert r["dispatch_ms_per_step"] >= 0.0
+    # small absolute slack: sub-0.02ms jitter must not flake the gate
+    eps = 0.02
+    assert rows[1]["dispatch_ms_per_step"] >= \
+        rows[4]["dispatch_ms_per_step"] - eps
+    assert rows[4]["dispatch_ms_per_step"] >= \
+        rows[16]["dispatch_ms_per_step"] - eps
+    # the headline claim: one-dispatch-per-step pays measurably more
+    # host time than 16-steps-per-dispatch
+    assert rows[1]["step_ms"] >= rows[16]["step_ms"]
+
+
+def test_require_tpu_fail_fast_refuses_cpu(monkeypatch, capsys):
+    """MXTPU_BENCH_REQUIRE_TPU=1 on a non-TPU host: error exit, no CPU
+    fallback numbers, platform stamps in the JSON."""
+    monkeypatch.setenv("MXTPU_BENCH_REQUIRE_TPU", "1")
+    monkeypatch.setenv("MXTPU_BENCH_PROBE_TIMEOUT", "30")
+    monkeypatch.setenv("MXTPU_PROBE_RETRIES", "1")
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: "cpu")
+    rc = bench.main()
+    assert rc == 2
+    lines = [l for l in capsys.readouterr().out.splitlines() if l]
+    obj = json.loads(lines[0])
+    assert obj["platform_requested"] == "tpu"
+    assert obj["platform_actual"] == "cpu"
+    assert "REQUIRE_TPU" in obj["error"]
+    _assert_headline(lines[-1])

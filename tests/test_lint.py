@@ -678,4 +678,79 @@ def test_hb09_package_is_clean():
     pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
     viol, n_files = lint_paths([pkg], rules={"HB09"})
     assert n_files > 50
+
+
+# ----------------------------------------------------------------------
+# HB10 — per-step host pull in a compiled multi-step loop (ISSUE 6)
+# ----------------------------------------------------------------------
+
+def test_hb10_per_step_pull_in_nested_loop_flagged():
+    out = lint_source(textwrap.dedent("""
+        def train(trainer, pf, k):
+            for window in pf.windows(k):
+                losses = trainer.step_multi(window)
+                for l in losses:
+                    total = total + float(l)
+                    log(l.asnumpy())
+    """), path="<hb10>")
+    assert [v.rule for v in out] == ["HB10", "HB10"]
+    assert out[0].func == "train"
+    assert "float" in out[0].message or "float" in out[1].message
+
+
+def test_hb10_boundary_pull_is_clean():
+    # the SUPPORTED shape: one host sync per scan window
+    out = lint_source(textwrap.dedent("""
+        for window in pf.windows(k):
+            losses = trainer.step_multi(window)
+            total += losses.asnumpy().sum()
+    """), path="<hb10>")
+    assert out == []
+
+
+def test_hb10_per_step_loops_without_step_multi_are_clean():
+    # an ordinary per-step loop reading its loss is HB09/HB10-clean —
+    # there is no scan window being defeated
+    out = lint_source(textwrap.dedent("""
+        for batch in loader:
+            loss = trainer.step(batch[0], batch[1])
+            for m in metrics:
+                m.update(0, loss.asnumpy())
+    """), path="<hb10>")
+    assert out == []
+
+
+def test_hb10_wait_to_read_and_item_flagged():
+    out = lint_source(textwrap.dedent("""
+        while not done:
+            losses = trainer.step_multi(window)
+            for i in range(len(losses)):
+                running += losses[i].item()
+                losses[i].wait_to_read()
+    """), path="<hb10>")
+    assert [v.rule for v in out] == ["HB10", "HB10"]
+
+
+def test_hb10_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB10" in RULES
+    assert RULES["HB10"].bad and RULES["HB10"].good
+    out = lint_source(textwrap.dedent("""
+        for window in pf.windows(k):
+            losses = trainer.step_multi(window)
+            for l in losses:
+                log(l.asnumpy())  # mxlint: disable=HB10
+    """), path="<hb10>")
+    assert out == []
+
+
+def test_hb10_package_is_clean():
+    """The framework's own multi-step loops (estimator windows, bench,
+    chaos resume, dispatch probe) must hold the bar the rule sets."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB10"})
+    assert viol == []
+    assert n_files > 50
     assert viol == [], [f"{v.path}:{v.line}" for v in viol]

@@ -1,0 +1,316 @@
+"""Multi-step compiled training (ISSUE 6 tentpole).
+
+K training steps lowered into ONE XLA program
+(``DataParallelTrainer.step_multi``): a ``lax.scan`` over
+device-resident batches with donated carry.  The acceptance contract is
+BITWISE: K>1 must reproduce K=1 exactly in fp32 on the 8-device CPU
+mesh for the plain (psum), sharded (ZeRO-1) and accumulating trainers;
+``MXTPU_STEPS_PER_CALL=1`` (the default) must keep today's per-step
+graphs; and a checkpoint written at a non-K-aligned step must resume
+into K-windows onto the same loss curve (the chaos scenario extended to
+``steps_per_call=4``).
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+nd = mx.nd
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+def _build(shard, optimizer="adam", opt_args=None, dout=8):
+    # two builds inside ONE test must get identical auto names (the
+    # conftest fixture only resets the global counters per test)
+    from mxnet_tpu.gluon import block as _blk
+    _blk._GLOBAL_COUNTERS.clear()
+    mx.random.seed(11)
+    np.random.seed(11)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(dout))
+    net.initialize()
+    tr = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), optimizer,
+        dict(opt_args or {"learning_rate": 0.01}), shard_updates=shard)
+    return net, tr
+
+
+def _data(n=6, batch=16, din=12, classes=8, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = [rs.randn(batch, din).astype(np.float32) for _ in range(n)]
+    ys = [rs.randint(0, classes, (batch,)) for _ in range(n)]
+    return xs, ys
+
+
+def _params_of(net):
+    return {k: p.data().asnumpy()
+            for k, p in net.collect_params().items()}
+
+
+def _state_of(tr):
+    return {k: v.asnumpy() for k, v in tr.state_dict()["arrays"].items()}
+
+
+def _assert_bitwise(a, b):
+    assert set(a) == set(b)
+    for k in a:
+        assert np.array_equal(a[k], b[k]), f"{k} diverged"
+
+
+# ----------------------------------------------------------------------
+# K-step vs 1-step bitwise parity
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_step_multi_matches_per_step_bitwise(shard):
+    if shard and len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    xs, ys = _data()
+    net1, tr1 = _build(shard)
+    losses1 = [float(tr1.step(nd.array(x), nd.array(y)).asnumpy())
+               for x, y in zip(xs, ys)]
+    p1, s1 = _params_of(net1), _state_of(tr1)
+
+    net2, tr2 = _build(shard)
+    losses2 = []
+    for i in range(0, 6, 3):
+        out = tr2.step_multi([(nd.array(xs[j]), nd.array(ys[j]))
+                              for j in range(i, i + 3)])
+        assert out.shape == (3,)
+        losses2 += list(np.asarray(out.asnumpy()).ravel())
+    _assert_bitwise(p1, _params_of(net2))
+    _assert_bitwise(s1, _state_of(tr2))
+    assert np.array_equal(np.asarray(losses1, np.float32),
+                          np.asarray(losses2, np.float32))
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_step_multi_accum_matches_step_accum_bitwise(shard):
+    """n_accum > 1 composition: each of the K scanned steps is itself a
+    microbatch-accumulation scan."""
+    if shard and len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    xs, ys = _data(n=4, batch=32)
+    net1, tr1 = _build(shard, "sgd", {"learning_rate": 0.05,
+                                      "momentum": 0.9})
+    for x, y in zip(xs, ys):
+        tr1.step_accum(nd.array(x), nd.array(y), n_micro=2)
+    p1, s1 = _params_of(net1), _state_of(tr1)
+
+    net2, tr2 = _build(shard, "sgd", {"learning_rate": 0.05,
+                                      "momentum": 0.9})
+    for i in range(0, 4, 2):
+        tr2.step_multi([(nd.array(xs[j]), nd.array(ys[j]))
+                        for j in range(i, i + 2)], n_micro=2)
+    _assert_bitwise(p1, _params_of(net2))
+    _assert_bitwise(s1, _state_of(tr2))
+
+
+def test_step_multi_uneven_windows_and_window_of_one():
+    """Window boundaries are free: [4, 2] windows and [1]-windows both
+    reproduce the per-step run (partial tail windows are how epoch
+    lengths that don't divide K flush)."""
+    xs, ys = _data()
+    net1, tr1 = _build(False)
+    for x, y in zip(xs, ys):
+        tr1.step(nd.array(x), nd.array(y))
+    p1 = _params_of(net1)
+
+    net2, tr2 = _build(False)
+    tr2.step_multi([(nd.array(xs[j]), nd.array(ys[j]))
+                    for j in range(4)])
+    tr2.step_multi([(nd.array(xs[j]), nd.array(ys[j]))
+                    for j in range(4, 6)])
+    _assert_bitwise(p1, _params_of(net2))
+
+    net3, tr3 = _build(False)
+    for x, y in zip(xs, ys):
+        tr3.step_multi([(nd.array(x), nd.array(y))])
+    _assert_bitwise(p1, _params_of(net3))
+
+
+def test_step_multi_lr_schedule_advances_per_step():
+    """The per-step lr vector must track the scheduler exactly as K=1
+    does — lrs are evaluated host-side per scanned step."""
+    sched = lambda n: 0.1 / (1 + n)            # noqa: E731
+
+    xs, ys = _data(n=4)
+    net1, tr1 = _build(False, "sgd", {"learning_rate": 0.1,
+                                      "lr_scheduler": sched})
+    for x, y in zip(xs, ys):
+        tr1.step(nd.array(x), nd.array(y))
+    net2, tr2 = _build(False, "sgd", {"learning_rate": 0.1,
+                                      "lr_scheduler": sched})
+    tr2.step_multi([(nd.array(x), nd.array(y))
+                    for x, y in zip(xs, ys)])
+    assert tr2._num_update == 4
+    _assert_bitwise(_params_of(net1), _params_of(net2))
+
+
+def test_step_multi_validation_errors():
+    xs, ys = _data(n=2)
+    _, tr = _build(False)
+    with pytest.raises(MXNetError, match="at least one"):
+        tr.step_multi([])
+    with pytest.raises(MXNetError, match="share shapes"):
+        tr.step_multi([(nd.array(xs[0]), nd.array(ys[0])),
+                       (nd.array(xs[1][:8]), nd.array(ys[1][:8]))])
+    with pytest.raises(MXNetError, match="n_micro"):
+        tr.step_multi([(nd.array(xs[0]), nd.array(ys[0]))], n_micro=5)
+
+
+# ----------------------------------------------------------------------
+# kill switch: MXTPU_STEPS_PER_CALL default keeps today's graphs
+# ----------------------------------------------------------------------
+
+def test_steps_per_call_env_default_and_validation(monkeypatch):
+    from mxnet_tpu import runtime
+    monkeypatch.delenv("MXTPU_STEPS_PER_CALL", raising=False)
+    assert runtime.steps_per_call() == 1
+    monkeypatch.setenv("MXTPU_STEPS_PER_CALL", "4")
+    assert runtime.steps_per_call() == 4
+    monkeypatch.setenv("MXTPU_STEPS_PER_CALL", "0")
+    with pytest.raises(MXNetError):
+        runtime.steps_per_call()
+    monkeypatch.setenv("MXTPU_STEPS_PER_CALL", "nope")
+    with pytest.raises(MXNetError):
+        runtime.steps_per_call()
+
+
+def test_kill_switch_keeps_per_step_graphs(monkeypatch):
+    """K=1 (the default) must never even build the scan program — the
+    estimator drives the same per-step entry point as before."""
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu import metric as metric_mod
+    monkeypatch.delenv("MXTPU_STEPS_PER_CALL", raising=False)
+    xs, ys = _data(n=4)
+    net, tr = _build(False)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[metric_mod.Loss()], trainer=tr)
+    data = [(nd.array(x), nd.array(y)) for x, y in zip(xs, ys)]
+    est.fit(data, epochs=1)
+    assert tr._jit_multi_cache == {}       # scan path never compiled
+    assert est.global_step == 4
+
+
+# ----------------------------------------------------------------------
+# estimator: K-step windows
+# ----------------------------------------------------------------------
+
+def _fit_params(steps_per_call, n=6):
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu import metric as metric_mod
+    xs, ys = _data(n=n)
+    net, tr = _build(False)
+    loss_metric = metric_mod.Loss()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[loss_metric], trainer=tr)
+    data = [(nd.array(x), nd.array(y)) for x, y in zip(xs, ys)]
+    est.fit(data, epochs=1, steps_per_call=steps_per_call)
+    assert est.global_step == n
+    return _params_of(net), loss_metric.get_name_value()[0][1]
+
+
+def test_estimator_k_windows_match_per_step_bitwise():
+    p1, m1 = _fit_params(steps_per_call=1)
+    p2, m2 = _fit_params(steps_per_call=2)
+    _assert_bitwise(p1, p2)
+    assert np.isclose(m1, m2, rtol=1e-6)
+    # epoch length 6 NOT divisible by K=4: tail window flushes
+    p3, _ = _fit_params(steps_per_call=4)
+    _assert_bitwise(p1, p3)
+
+
+def test_estimator_k_windows_checkpoint_boundary(tmp_path):
+    """checkpoint_every rounds up to the next scan boundary, and the
+    saved iterator cursor counts STEPS (so any K can resume it)."""
+    from mxnet_tpu.gluon.contrib.estimator import Estimator
+    from mxnet_tpu import metric as metric_mod
+    from mxnet_tpu.checkpoint import CheckpointManager
+    xs, ys = _data(n=6)
+    net, tr = _build(False)
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=[metric_mod.Loss()], trainer=tr)
+    data = [(nd.array(x), nd.array(y)) for x, y in zip(xs, ys)]
+    mgr = CheckpointManager(str(tmp_path), keep=10)
+    est.fit(data, epochs=1, steps_per_call=4,
+            checkpoint_manager=mgr, checkpoint_every=3)
+    mgr.wait_until_finished()
+    # every=3 with K=4 windows -> saves at the boundaries 4 and 6 (the
+    # multiples 3 and 6 round up), plus nothing mid-window
+    steps = mgr.steps()
+    assert 4 in steps and steps[-1] == 6
+    man = mgr.manifest(4)
+    assert man["iterator"]["batch"] == 4
+    assert man["steps_per_call"] == 1      # env default recorded
+
+
+def test_chaos_resume_with_k4_windows(tmp_path):
+    """The acceptance scenario: kill/corrupt leaves the newest VALID
+    checkpoint at a step that is NOT a multiple of 4; resuming with
+    steps_per_call=4 (partial tail window included) must reproduce the
+    K=1 reference run bitwise."""
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 virtual devices")
+    from mxnet_tpu.testing.chaos import run_scenario
+    r = run_scenario("sharded", workdir=str(tmp_path),
+                     resume_steps_per_call=4)
+    assert r["resumed_from"] % 4 != 0      # genuinely mid-scan-window
+    assert r["params_bitwise"] and r["state_bitwise"]
+    assert r["ok"]
+
+
+# ----------------------------------------------------------------------
+# prefetcher window feed
+# ----------------------------------------------------------------------
+
+def test_prefetcher_next_k_and_windows():
+    from mxnet_tpu.io import DevicePrefetcher
+    src = [(np.full((2, 3), i, np.float32), np.full((2,), i, np.float32))
+           for i in range(5)]
+    pf = DevicePrefetcher(iter(src), depth=2)
+    w1 = pf.next_k(2)
+    assert len(w1) == 2
+    assert float(w1[0][0].asnumpy()[0, 0]) == 0.0
+    assert float(w1[1][0].asnumpy()[0, 0]) == 1.0
+    w2 = pf.next_k(2)
+    tail = pf.next_k(2)                    # only one batch left
+    assert len(w2) == 2 and len(tail) == 1
+    with pytest.raises(StopIteration):
+        pf.next_k(2)
+    pf.close()
+
+    pf = DevicePrefetcher(iter(src), depth=2)
+    sizes = [len(w) for w in pf.windows(3)]
+    assert sizes == [3, 2]
+    pf.close()
+    with pytest.raises(MXNetError):
+        DevicePrefetcher(iter(src), depth=1).next_k(0)
+
+
+def test_prefetcher_feeds_step_multi_end_to_end():
+    """DevicePrefetcher -> next_k -> step_multi: the intended loop shape
+    (device-resident window, one dispatch, one boundary sync)."""
+    from mxnet_tpu.io import DevicePrefetcher
+    xs, ys = _data(n=4)
+    net1, tr1 = _build(False)
+    for x, y in zip(xs, ys):
+        tr1.step(nd.array(x), nd.array(y))
+    p1 = _params_of(net1)
+
+    net2, tr2 = _build(False)
+    pf = DevicePrefetcher(iter(list(zip(xs, ys))), depth=2)
+    for window in pf.windows(2):
+        losses = tr2.step_multi(window)
+        assert losses.shape == (len(window),)
+    pf.close()
+    _assert_bitwise(p1, _params_of(net2))

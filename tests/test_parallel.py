@@ -254,10 +254,11 @@ def test_weight_update_sharding_matches_replicated():
             assert stats["state_bytes_per_chip"] * 8 == \
                 stats["state_bytes_replicated"]
             # the compiled step must contain the explicit collectives
-            # (cache key: kind, n_micro, input ranks, comm mode, donate)
+            # (cache key: kind, n_micro, n_steps, input ranks, comm
+            # mode, donate)
             jitted = dpt._jit_zero1_cache[
-                ("plain", None, (x.data.ndim, y.data.ndim), "overlap",
-                 None)]
+                ("plain", None, None, (x.data.ndim, y.data.ndim),
+                 "overlap", None)]
             key = jax.random.PRNGKey(0)
             hlo = jitted.lower(
                 dpt._param_vals, dpt._opt_state,

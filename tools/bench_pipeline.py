@@ -209,6 +209,69 @@ def overlap_probe(batch=16, iters=3, in_dim=32, classes=8):
                       classes=classes, overlap=True)
 
 
+def dispatch_probe(ks=(1, 4, 16), steps=48, batch=16, in_dim=32,
+                   classes=8, repeats=3):
+    """Per-step dispatch overhead vs window size K (ISSUE 6 evidence):
+    the same tiny model trained with K steps scanned into ONE dispatch
+    (``DataParallelTrainer.step_multi``) for K in ``ks``.  Walltime per
+    step shrinks as K grows because the host dispatch + program-
+    re-entry tax is paid once per window; ``dispatch_ms_per_step`` =
+    walltime/step − device time/step, the device time estimated from
+    the most-amortized window (best-of-``repeats`` timings).  On CPU
+    the absolute numbers are small but the K=1 → K=16 monotone shrink
+    is the tier-1-testable contract (tests/test_bench_line.py)."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import make_mesh
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+    ndev = len(jax.devices())
+    dp = ndev if ndev > 1 and batch % ndev == 0 else 1
+    mesh = make_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(64, activation="relu"),
+            gluon.nn.Dense(classes))
+    net.initialize()
+    trainer = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9}, mesh=mesh,
+        shard_updates=dp > 1)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(batch, in_dim).astype(np.float32))
+    y = mx.nd.array(rng.randint(0, classes, (batch,)))
+
+    def run_k(k):
+        n_windows = max(1, steps // k)
+        if k == 1:
+            call = lambda: trainer.step(x, y)           # noqa: E731
+        else:
+            window = [(x, y)] * k
+            call = lambda: trainer.step_multi(window)   # noqa: E731
+        call().asnumpy()                    # compile off the clock
+        best = None
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            for _ in range(n_windows):
+                loss = call()
+            loss.asnumpy()
+            ms = (time.perf_counter() - t0) / (n_windows * k) * 1e3
+            best = ms if best is None else min(best, ms)
+        return best
+
+    per_step = {k: run_k(k) for k in ks}
+    device_est = min(per_step.values())
+    rows = [{"k": k, "step_ms": round(per_step[k], 3),
+             "dispatch_ms_per_step": round(
+                 max(0.0, per_step[k] - device_est), 3)} for k in ks]
+    return {"metric": "pipeline_dispatch_probe", "dp": dp,
+            "steps_per_round": steps,
+            "device_ms_per_step_est": round(device_est, 3),
+            "rows": rows,
+            "note": "device est = fastest per-step time across window "
+                    "sizes (the largest window amortizes dispatch ~0)"}
+
+
 def wrap_preproc(net):
     """uint8 NHWC -> float NCHW in-graph, then the wrapped net; XLA fuses
     the cast/scale/layout into the first conv."""
@@ -234,6 +297,9 @@ if __name__ == "__main__":
         print(json.dumps(overlap_probe()))
     elif cmd == "comm_probe":
         print(json.dumps(comm_probe()))
+    elif cmd == "dispatch_probe":
+        print(json.dumps(dispatch_probe()))
     else:
         raise SystemExit(
-            f"unknown subcommand {cmd!r}: expected comm_probe|overlap_probe")
+            f"unknown subcommand {cmd!r}: expected "
+            f"comm_probe|overlap_probe|dispatch_probe")

@@ -84,10 +84,16 @@ def _child():
     print("CHILD " + json.dumps(out), flush=True)
 
 
-def child_env(impl, L, bh=8, base=None):
+def child_env(impl, L, bh=8, base=None, block_q=None, block_kv=None):
     """Env for one (impl, L) child — the single source of the child
-    protocol (also used by tools/tpu_queue_runner.py)."""
+    protocol (also used by tools/tpu_queue_runner.py).  ``block_q`` /
+    ``block_kv`` pin the Pallas block-size knobs (MXTPU_FLASH_BLOCK_Q/
+    KV) for the autotune sweep."""
     env = dict(base if base is not None else os.environ)
+    if block_q is not None:
+        env["MXTPU_FLASH_BLOCK_Q"] = str(block_q)
+    if block_kv is not None:
+        env["MXTPU_FLASH_BLOCK_KV"] = str(block_kv)
     env.update({"MXTPU_FLASH_CHILD": "1", "MXTPU_FLASH_IMPL": impl,
                 "MXTPU_FLASH_L": str(L), "MXTPU_FLASH_BH": str(bh),
                 # prepend REPO, KEEP the ambient path (axon sitecustomize
@@ -134,6 +140,52 @@ def sweep(ls=(2048, 4096, 8192), bh=8, impls=("flash", "scan", "naive")):
     return results
 
 
+_BLOCK_GRID = ((128, 128), (256, 256), (512, 512), (256, 512),
+               (512, 256), (512, 1024), (1024, 512))
+
+
+def block_sweep(L=2048, bh=8, blocks=_BLOCK_GRID):
+    """Autotune the Pallas flash block sizes at sequence length ``L``
+    (ISSUE 6 satellite — the 1.03x follow-up): run the flash impl once
+    per (BLOCK_Q, BLOCK_KV) candidate, each in its own subprocess with
+    ``MXTPU_FLASH_BLOCK_Q/KV`` pinned, and report every timing plus the
+    winner — so the TPU re-measure round ships the best measured config
+    (bench.py reads it back through .bench_knobs.json flash_bq/flash_bk)
+    instead of the untuned default."""
+    results = []
+    for bq, bkv in blocks:
+        if bq > L or bkv > L:
+            continue
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                capture_output=True, text=True, timeout=900,
+                env=child_env("flash", L, bh, block_q=bq, block_kv=bkv))
+        except subprocess.TimeoutExpired:
+            results.append({"block_q": bq, "block_kv": bkv, "ok": False,
+                            "error": "timeout (900s)"})
+            continue
+        parsed = parse_child_line(r.stdout)
+        if parsed is None:
+            parsed = {"ok": False,
+                      "error": (r.stderr or "no output")[-200:]}
+        parsed.update({"block_q": bq, "block_kv": bkv})
+        results.append(parsed)
+    timed = [r for r in results if r.get("ok") and "ms" in r]
+    best = min(timed, key=lambda r: r["ms"]) if timed else None
+    out = {"L": L, "bh": bh, "sweep": results}
+    if best is not None:
+        out["best"] = {"block_q": best["block_q"],
+                       "block_kv": best["block_kv"], "ms": best["ms"]}
+        default = next((r for r in timed
+                        if r["block_q"] == 512 and r["block_kv"] == 512),
+                       None)
+        if default is not None and best["ms"] > 0:
+            out["best"]["speedup_vs_default"] = round(
+                default["ms"] / best["ms"], 3)
+    return out
+
+
 def summarize(results):
     by = {(r["L"], r["impl"]): r for r in results}
     summary = []
@@ -166,9 +218,16 @@ def main():
     ap.add_argument("--ls", default="2048,4096,8192")
     ap.add_argument("--bh", type=int, default=8)
     ap.add_argument("--impls", default="flash,scan,naive")
+    ap.add_argument("--block-sweep", action="store_true",
+                    help="autotune MXTPU_FLASH_BLOCK_Q/KV for the flash "
+                         "impl at the FIRST --ls length instead of the "
+                         "impl sweep")
     args = ap.parse_args()
-    results = sweep(tuple(int(x) for x in args.ls.split(",")),
-                    bh=args.bh, impls=tuple(args.impls.split(",")))
+    ls = tuple(int(x) for x in args.ls.split(","))
+    if args.block_sweep:
+        print(json.dumps(block_sweep(L=ls[0], bh=args.bh)))
+        return
+    results = sweep(ls, bh=args.bh, impls=tuple(args.impls.split(",")))
     print(json.dumps({"sweep": results, "summary": summarize(results)}))
 
 
