@@ -715,6 +715,31 @@ def _bench_decode() -> dict:
                     "tokens/s counts all scanned positions"}
 
 
+def _bench_serving() -> dict:
+    """Serving-engine loadgen (ISSUE 7): continuous-batching tokens/s,
+    p50/p99 request latency and batch occupancy through
+    ``mxnet_tpu.serving`` + ``tools/serve_loadgen.py``.  On CPU the
+    block ships the serving CONFIG with the measured fields null —
+    null-when-unmeasured (the PR 6 honesty rule; the CPU-scale policy
+    comparison lives in the tier-1-gated ``serve_loadgen --smoke``).
+    On TPU the ~0.5B-class mix measures for real."""
+    import jax
+    from mxnet_tpu.serving import serving_block
+    if jax.devices()[0].platform == "cpu":
+        blk = serving_block(max_batch=8, block_size=16,
+                            buckets=(16, 32, 64, 128, 256, 512),
+                            continuous=True)
+        blk["note"] = ("not measured on CPU; tools/serve_loadgen.py "
+                      "--smoke carries the CPU policy comparison")
+        return blk
+    from tools.serve_loadgen import run_loadgen
+    payload = run_loadgen(n_requests=32, max_batch=8, block_size=16,
+                          max_context=512, mode="both", smoke=False)
+    blk = payload["serving"]
+    blk["vs_static"] = payload.get("continuous_vs_static")
+    return blk
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -860,6 +885,11 @@ def _run_bench() -> dict:
         except Exception as e:  # noqa: BLE001
             result["extra"]["llama_decode"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["serving"] = _bench_serving()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["serving"] = {
+                "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)
         ml = _load_memlevers()
@@ -936,6 +966,9 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         ("rec_img_s_overlap", ("resnet_rec_pipeline", "input_pipeline",
                                "img_s_overlapped")),
         ("decode_tok_s", ("llama_decode", "tokens_per_sec")),
+        ("serve_tok_s", ("serving", "tokens_s_chip")),
+        ("serve_p99_ms", ("serving", "p99_ms")),
+        ("serve_occupancy", ("serving", "occupancy")),
         ("tpu_h2d_gb_s", ("tpu_bandwidth", "h2d_gb_s")),
         ("tpu_hbm_gb_s", ("tpu_bandwidth", "hbm_copy_gb_s")),
         ("kv_per_key_speedup", ("kvstore_bandwidth", "per_key_speedup")),
@@ -965,7 +998,7 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
     # generic sweep: future extras (memory-lever measurements, new
     # sweeps) surface automatically as long as they are scalars, one or
     # two levels deep, and the budget still allows them
-    handled = {"bert", "resnet_rec_pipeline", "llama_decode",
+    handled = {"bert", "resnet_rec_pipeline", "llama_decode", "serving",
                "tpu_bandwidth", "kvstore_bandwidth", "scaling_projection"}
     for k in sorted(extra):
         if k in handled:

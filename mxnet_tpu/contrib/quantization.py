@@ -130,6 +130,24 @@ class QuantizedDense(HybridBlock):
         self._act_type = getattr(dense, "_act_type", None)
         self._flatten = getattr(dense, "_flatten", True)
 
+    # public views for consumers that run the same int8 math outside
+    # the block forward (the serving engine extracts these so its
+    # compiled decode mirrors this layer op-for-op — docs/SERVING.md)
+    @property
+    def quantized_weight(self):
+        """(units, in) int8 weight."""
+        return self._qw
+
+    @property
+    def weight_scale(self):
+        """(units, 1) per-output-channel dequant scale."""
+        return self._w_scale
+
+    @property
+    def act_scale(self):
+        """Scalar activation quant scale (threshold / 127)."""
+        return self._act_scale
+
     def hybrid_forward(self, F, x):
         import jax.numpy as jnp
         from jax import lax

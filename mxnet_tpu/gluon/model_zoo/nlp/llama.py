@@ -71,6 +71,58 @@ def _rot_interleaved(u, cos, sin):
                       u2 * cos + u1 * sin], axis=-1).reshape(u.shape)
 
 
+# Query rows fed to the cache-attention einsums are padded to this many
+# rows: XLA CPU lowers an M=1 batched dot to a gemv whose accumulation
+# order differs from the gemm the full forward runs, while every M>=2
+# gemm is bitwise row-stable (verified empirically; tests/test_serving.py
+# decode-parity gate).  Padding one duplicate row buys bitwise equality
+# between single-token decode and the full-forward attention.
+_QPAD = 2
+
+
+def _cache_attention(q, ck, cv, valid, scale):
+    """Single-token attention against a KV cache, shared by
+    ``LlamaForCausalLM.generate`` and the serving engine
+    (``mxnet_tpu.serving``) — one source so decode parity can't drift.
+
+    Mirrors ``ops.flash_attention._scan_forward``'s single-block
+    online-softmax op-for-op (same einsum specs, same mask constant,
+    same normalization order) so that decode-with-cache logits are
+    BITWISE equal to the full forward's last-row logits in fp32.
+
+    q: (B, H, D) current-position queries (already rotated);
+    ck/cv: (B, KVH, L, D) cache (unrepeated GQA heads);
+    valid: (B, L) bool, True where the cache position participates;
+    scale: softmax scale (1/sqrt(D) — multiplied, like the flash path).
+    Returns (B, H*D).
+    """
+    import jax.numpy as jnp
+    from ....ops.flash_attention import _NEG_INF
+    b, h, d = q.shape
+    kvh, L = ck.shape[1], ck.shape[2]
+    rep = h // kvh
+    kr = jnp.repeat(ck, rep, axis=1).reshape(b * h, L, d)
+    vr = jnp.repeat(cv, rep, axis=1).reshape(b * h, L, d)
+    q2 = jnp.broadcast_to(q.reshape(b * h, 1, d), (b * h, _QPAD, d))
+    s = jnp.einsum("bqd,bkd->bqk", q2, kr,
+                   preferred_element_type=jnp.float32) * scale
+    vmask = jnp.repeat(valid[:, None, :], h, axis=1).reshape(b * h, 1, L)
+    s = jnp.where(vmask, s, _NEG_INF)
+    # single-block flash recurrence with the initial carry folded in,
+    # matching _scan_forward's first (only) step exactly
+    m0 = jnp.full((b * h, _QPAD, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b * h, _QPAD, 1), jnp.float32)
+    acc0 = jnp.zeros((b * h, _QPAD, d), jnp.float32)
+    m = jnp.maximum(m0, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m)
+    alpha = jnp.exp(m0 - m)
+    l = l0 * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc = acc0 * alpha + jnp.einsum("bqk,bkd->bqd", p.astype(cv.dtype), vr,
+                                    preferred_element_type=jnp.float32)
+    out = (acc / jnp.maximum(l, 1e-30)).astype(q.dtype)
+    return out[:, 0].reshape(b, h * d)
+
+
 class RMSNorm(HybridBlock):
     """Root-mean-square norm (no mean subtraction, no bias)."""
 
@@ -324,6 +376,14 @@ class LlamaForCausalLM(HybridBlock):
         return (m.embed.weight.data().data, m.norm.weight.data().data,
                 head, layers)
 
+    def decode_weights(self):
+        """Public decode-weight pytree: (embed, final_norm, lm_head|None,
+        [per-layer (in_norm, q, k, v, o, post_norm, gate, up, down)]) as
+        jax arrays.  The serving engine (``mxnet_tpu.serving``) and
+        ``generate()`` both consume this — weights are jit ARGUMENTS, never
+        baked into executables as constants."""
+        return self._decode_params()
+
     def generate(self, tokens, max_new_tokens, temperature=0.0, seed=0):
         """Autoregressive decode with per-layer KV caches: ONE jitted
         lax.scan over prefill+generation (static shapes — cache length is
@@ -352,7 +412,6 @@ class LlamaForCausalLM(HybridBlock):
             raise MXNetError("generate() needs at least one prefix token")
         total = t_prefix + int(max_new_tokens)
         h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
-        rep = h // kvh
         params = self._decode_params()   # pytree: passed as a jit ARGUMENT
         # (weights must not bake into the executable as constants), and the
         # compiled scan is cached per shape/temperature signature
@@ -388,16 +447,10 @@ class LlamaForCausalLM(HybridBlock):
                         caches_v[li], v[:, :, None, :], (0, 0, i, 0))
                     new_k.append(ck)
                     new_v.append(cv)
-                    # GQA attention against the cache: fold q heads as
-                    # (kvh, rep) so the cache is used unrepeated
-                    qg = q.reshape(b, kvh, rep, d)
-                    scores = jnp.einsum("bgrd,bgld->bgrl", qg, ck) \
-                        / (d ** 0.5)
-                    scores = jnp.where(pos_mask[None, None, None, :],
-                                       scores.astype(jnp.float32), -jnp.inf)
-                    p = jax.nn.softmax(scores, axis=-1)
-                    o = jnp.einsum("bgrl,bgld->bgrd", p.astype(ck.dtype), cv)
-                    x = x + o.reshape(b, h * d) @ ow.T
+                    valid = jnp.broadcast_to(pos_mask[None, :], (b, total))
+                    o = _cache_attention(q, ck, cv, valid,
+                                         1.0 / math.sqrt(d))
+                    x = x + o @ ow.T
                     y = _rms(x, po_w, eps)
                     x = x + (jax.nn.silu(y @ gw.T) * (y @ uw.T)) @ dw.T
                 logits = _rms(x, norm_w, eps) @ (emb.T if head_w is None

@@ -5,8 +5,13 @@ Reference capability: GluonNLP's BeamSearchSampler / SequenceSampler
 "Transformer MT ... beam search sampler".
 
 TPU-native: the per-step decoder call is jit-compiled by the caller
-(hybridized decoder); the beam bookkeeping (top-k over vocab*beam,
-backpointers) is device-side jnp so only the final sequences hit the host.
+(hybridized decoder OR a raw ``jax.jit`` step function — see
+``step_mode``); the beam bookkeeping (top-k over vocab*beam,
+backpointers) is device-side jnp so only the final sequences hit the
+host.  The per-token work never pulls logits to the host (mxlint HB11):
+token selection runs through the one device-side ``_topk`` path, and
+the early-exit all-done check is amortized to every ``sync_every``
+steps instead of one host sync per token.
 """
 from __future__ import annotations
 
@@ -38,6 +43,40 @@ class BeamSearchScorer:
         return (scores[:, None] * prev + log_probs) / self._lp(step)
 
 
+def _is_compiled_step(decoder):
+    """A raw compiled step function (``jax.jit`` output or any callable
+    flagged with ``expects_ndarray = False``) takes/returns jax arrays;
+    a Gluon decoder takes NDArrays.  jit-wrapped callables carry
+    ``.lower``/``.trace`` stage hooks — that is the auto-detection."""
+    flag = getattr(decoder, "expects_ndarray", None)
+    if flag is not None:
+        return not flag
+    return hasattr(decoder, "lower") and callable(
+        getattr(decoder, "lower"))
+
+
+class _StepCaller:
+    """Normalizes the decoder calling convention once at construction:
+    NDArray in/out (Gluon blocks) or jax arrays in/out (compiled step
+    functions), so the samplers themselves stay convention-free."""
+
+    def __init__(self, decoder, step_mode="auto"):
+        if step_mode not in ("auto", "ndarray", "jax"):
+            from ....base import MXNetError
+            raise MXNetError(f"step_mode={step_mode!r}: expected "
+                             "auto|ndarray|jax")
+        self._decoder = decoder
+        self._raw = (_is_compiled_step(decoder) if step_mode == "auto"
+                     else step_mode == "jax")
+
+    def __call__(self, step_input, states):
+        si = step_input if self._raw else NDArray(step_input)
+        log_probs, states = self._decoder(si, states)
+        lp = log_probs.data if isinstance(log_probs, NDArray) else \
+            jnp.asarray(log_probs)
+        return lp, states
+
+
 class BeamSearchSampler:
     """Beam search over a step decoder.
 
@@ -45,15 +84,22 @@ class BeamSearchSampler:
     step_input is (batch*beam,) int ids and log_probs is
     (batch*beam, vocab). States are pytrees of NDArrays/arrays with leading
     batch*beam axis.
+
+    ``step_mode``: "ndarray" (Gluon decoder, step_input arrives as an
+    NDArray), "jax" (compiled step function, raw jax arrays), or "auto"
+    (detect a ``jax.jit``-wrapped callable).  ``sync_every``: the
+    all-beams-done early-exit is checked on the host only every this
+    many steps (per-token host syncs serialize decode — mxlint HB11).
     """
 
     def __init__(self, beam_size, decoder, eos_id, scorer=None,
-                 max_length=100):
+                 max_length=100, step_mode="auto", sync_every=8):
         self._beam_size = beam_size
-        self._decoder = decoder
+        self._decoder = _StepCaller(decoder, step_mode)
         self._eos_id = int(eos_id)
         self._scorer = scorer or BeamSearchScorer()
         self._max_length = max_length
+        self._sync_every = max(1, int(sync_every))
 
     def _tile_states(self, states, beam):
         return _tile_states(states, beam)
@@ -81,10 +127,7 @@ class BeamSearchSampler:
         sequences = [step_input.reshape(batch, beam)]
 
         for step in range(1, self._max_length + 1):
-            log_probs, states = self._decoder(
-                NDArray(step_input), states)
-            lp = log_probs.data if isinstance(log_probs, NDArray) else \
-                jnp.asarray(log_probs)
+            lp, states = self._decoder(step_input, states)
             vocab = lp.shape[-1]
             lp = lp.reshape(batch, beam, vocab)
             cand = self._scorer(lp.reshape(batch * beam, vocab),
@@ -114,7 +157,9 @@ class BeamSearchSampler:
             sequences.append(word_idx)
             lengths = jnp.where(~done, lengths + 1, lengths)
             done = done | (word_idx == self._eos_id)
-            if bool(jnp.all(done)):
+            # amortized early exit: ONE host sync per sync_every tokens,
+            # not one per token (HB11 discipline)
+            if step % self._sync_every == 0 and bool(jnp.all(done)):
                 break
 
         samples = jnp.stack(sequences, axis=-1)              # (B, K, L)
@@ -127,6 +172,8 @@ class BeamSearchSampler:
 
 
 def _topk(x, k):
+    """THE device-side top-k: beam selection and top-k sampling both
+    route through this one ``lax.top_k`` — logits never hit the host."""
     import jax
     return jax.lax.top_k(x, k)
 
@@ -147,16 +194,26 @@ def _tree_map(fn, states):
 
 
 class SequenceSampler:
-    """Multinomial sequence sampler with temperature.
-    Reference: gluonnlp SequenceSampler."""
+    """Multinomial sequence sampler with temperature (and optional
+    device-side top-k truncation through the shared ``_topk`` path).
+    Reference: gluonnlp SequenceSampler.
+
+    Draws come from the global ``mx.random`` stream — snapshot/restore
+    via ``random.get_key_data``/``set_key_data`` (PR 4) reproduces a
+    sampling run exactly.  ``step_mode``/``sync_every``: see
+    BeamSearchSampler.
+    """
 
     def __init__(self, beam_size, decoder, eos_id, max_length=100,
-                 temperature=1.0):
+                 temperature=1.0, top_k=0, step_mode="auto",
+                 sync_every=8):
         self._beam_size = beam_size
-        self._decoder = decoder
+        self._decoder = _StepCaller(decoder, step_mode)
         self._eos_id = int(eos_id)
         self._max_length = max_length
         self._temperature = temperature
+        self._top_k = int(top_k)
+        self._sync_every = max(1, int(sync_every))
 
     def __call__(self, inputs, states):
         import jax
@@ -171,13 +228,19 @@ class SequenceSampler:
         lengths = jnp.ones((batch * beam,), dtype=jnp.int32)
         scores = jnp.zeros((batch * beam,))
         sequences = [step_input]
-        for _ in range(self._max_length):
-            log_probs, states = self._decoder(NDArray(step_input), states)
-            lp = log_probs.data if isinstance(log_probs, NDArray) else \
-                jnp.asarray(log_probs)
+        for step in range(1, self._max_length + 1):
+            lp, states = self._decoder(step_input, states)
             key = _rnd.next_key()
-            choice = jax.random.categorical(key, lp / self._temperature,
-                                            axis=-1)
+            scaled = lp / self._temperature
+            if self._top_k > 0:
+                # truncate to the k best ON DEVICE, sample among them,
+                # map back to vocab ids — same _topk as beam search
+                vals, idx = _topk(scaled, self._top_k)
+                pick = jax.random.categorical(key, vals, axis=-1)
+                choice = jnp.take_along_axis(
+                    idx, pick[:, None], axis=1)[:, 0]
+            else:
+                choice = jax.random.categorical(key, scaled, axis=-1)
             choice = jnp.where(done, self._eos_id, choice)
             taken = jnp.take_along_axis(lp, choice[:, None],
                                         axis=1).squeeze(1)
@@ -186,7 +249,7 @@ class SequenceSampler:
             sequences.append(choice)
             done = done | (choice == self._eos_id)
             step_input = choice
-            if bool(jnp.all(done)):
+            if step % self._sync_every == 0 and bool(jnp.all(done)):
                 break
         samples = jnp.stack(sequences, axis=-1).reshape(
             batch, beam, -1)

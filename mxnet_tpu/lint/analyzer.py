@@ -882,6 +882,79 @@ class _MultiStepPullScanner(ast.NodeVisitor):
     visit_For = visit_While = visit_AsyncFor = _scan_window_loop
 
 
+# -- HB11: per-token host sync in a decode/generation loop ---------------
+
+_HB11_SYNC_METHODS = _SYNC_METHODS | {"wait_to_read", "waitall"}
+# callee names that mark a loop as an autoregressive decode loop: the
+# per-token step call of samplers (self._decoder), serving engines
+# (engine.decode_step) and hand-rolled generation loops.  Bare "decode"
+# is deliberately absent — it collides with bytes.decode()
+_HB11_DECODE_CALLEES = {"decoder", "_decoder", "decode_step",
+                        "generate_step", "decode_token"}
+
+
+class _DecodeLoopPullScanner(ast.NodeVisitor):
+    """HB11: a loop that calls a decoder step runs ONE compiled step per
+    token; a host pull (``.item()``/``.asnumpy()``/``float()``/...)
+    in that loop pays a device->host round-trip PER TOKEN, serializing
+    the whole serving batch behind it — the serving twin of HB10.  The
+    compiled step should sample in-graph and hand back the token; reads
+    of accumulated sequences belong after the loop (or at amortized
+    chunk boundaries — a periodic ``bool(all(done))`` early-exit check
+    is not flagged)."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _calls_decoder(loop):
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                name = f.attr if isinstance(f, ast.Attribute) else \
+                    f.id if isinstance(f, ast.Name) else None
+                if name in _HB11_DECODE_CALLEES:
+                    return True
+        return False
+
+    def _flag(self, call, what):
+        self.c.add(Violation(
+            rule="HB11", path=self.path, line=call.lineno,
+            col=call.col_offset,
+            message=f"per-token host sync {what} inside a decode/"
+                    "generation loop: one device->host round-trip per "
+                    "token serializes the serving batch; sample in the "
+                    "compiled step and read sequences once after the "
+                    "loop", block="", func=self.func_stack[-1]))
+
+    def _scan_decode_loop(self, node):
+        if self._calls_decoder(node):
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                f = sub.func
+                if isinstance(f, ast.Attribute) and \
+                        f.attr in _HB11_SYNC_METHODS:
+                    self._flag(sub, f"`.{f.attr}()`")
+                elif isinstance(f, ast.Name) and f.id == "float" \
+                        and sub.args:
+                    self._flag(sub, "`float()`")
+        self.generic_visit(node)
+
+    visit_For = visit_While = visit_AsyncFor = _scan_decode_loop
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -1016,11 +1089,12 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
                 continue              # inherited: reported on the owner
             collector.analyze_entry(fn, cname)
     if only_classes is None:
-        # HB07/HB09/HB10 are module-wide (any function), not
+        # HB07/HB09/HB10/HB11 are module-wide (any function), not
         # forward-scoped
         _LoopCollectiveScanner(collector, path).visit(tree)
         _BackwardStepScanner(collector, path).visit(tree)
         _MultiStepPullScanner(collector, path).visit(tree)
+        _DecodeLoopPullScanner(collector, path).visit(tree)
     suppressed, _unknown = parse_suppressions(source)
     src_lines = source.splitlines()
     out = []

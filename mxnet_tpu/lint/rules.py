@@ -135,6 +135,23 @@ RULES = {
         "loss.backward()\n"
         "trainer.step(batch_size)       # step dispatches async\n"
         "print(loss.asnumpy())          # sync AFTER the dispatches"),
+    "HB11": Rule(
+        "HB11", "per-token-host-sync-in-decode-loop",
+        "A per-token host pull (`.item()`, `.asnumpy()`, `.asscalar()`, "
+        "`.tolist()`, `float()`) inside a decode/generation loop (a loop "
+        "driving a decoder step — `decoder(...)`/`.decode_step(...)`): "
+        "autoregressive decode runs ONE small compiled step per token, "
+        "so a host round-trip per token serializes the whole serving "
+        "batch behind the slowest pull — the serving twin of HB10. Keep "
+        "sampling/argmax in the compiled step (the engine returns the "
+        "sampled token), batch EOS checks at chunk boundaries, and pull "
+        "sequences once at the end.",
+        "for t in range(max_new):\n"
+        "    logits, st = decoder(tok, st)\n"
+        "    tok = int(logits.asnumpy().argmax())  # sync per token",
+        "for t in range(max_new):\n"
+        "    tok, st = decoder(tok, st)      # token sampled in-graph\n"
+        "out = seq.asnumpy()                 # ONE pull after the loop"),
     "HB10": Rule(
         "HB10", "per-step-host-pull-in-multi-step-loop",
         "A per-step host pull of loss/metrics (`float(loss)`, "

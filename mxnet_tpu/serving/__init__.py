@@ -1,0 +1,57 @@
+"""``mxnet_tpu.serving`` — compiled inference serving (ISSUE 7).
+
+The serving vertical the ROADMAP's "millions of users" north star needs:
+
+- :class:`InferenceEngine` — AOT-compiled prefill + single-token decode
+  per power-of-two shape bucket over a paged KV cache; compile cache
+  keyed and counted like the PR 1 retrace detector (zero compiles after
+  warmup under traffic); optional int8 weight serving via
+  ``contrib.quantization.quantize_net``.
+- :class:`PagedKVCache` — block-table indexed K/V pool, per-sequence
+  alloc/free, donated functional updates.
+- :class:`ContinuousBatcher` / :class:`StaticBatcher` — token-boundary
+  continuous batching vs the fixed-batch baseline, over the same engine.
+
+See docs/SERVING.md for the architecture and the bucket/compile-cache
+math; ``tools/serve_loadgen.py`` is the load-generator benchmark.
+"""
+from __future__ import annotations
+
+from .engine import InferenceEngine, next_bucket
+from .kv_cache import PagedKVCache
+from .scheduler import ContinuousBatcher, Request, StaticBatcher
+
+__all__ = ["InferenceEngine", "PagedKVCache", "ContinuousBatcher",
+           "StaticBatcher", "Request", "next_bucket", "serving_block"]
+
+
+def _r(x, nd=3):
+    return None if x is None else round(float(x), nd)
+
+
+def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
+                  continuous=True, requests=0, p50_ms=None, p99_ms=None,
+                  ttft_p50_ms=None, tokens_s=None, tokens_s_chip=None,
+                  occupancy=None, tokens_per_step=None,
+                  compiles_after_warmup=None, cache_utilization=None):
+    """The bench.py ``serving`` observability block (the `comm` block
+    discipline from PR 3/PR 5): static serving config is always real;
+    MEASURED fields default to ``None`` — null-when-unmeasured, so a CPU
+    run can never pass off an absent measurement as "latency is zero"
+    (the PR 6 honesty rule, tests/test_bench_line.py)."""
+    return {
+        "max_batch": int(max_batch),
+        "block_size": int(block_size),
+        "buckets": list(int(b) for b in buckets),
+        "quantized": bool(quantized),
+        "continuous": bool(continuous),
+        "requests": int(requests),
+        "p50_ms": _r(p50_ms), "p99_ms": _r(p99_ms),
+        "ttft_p50_ms": _r(ttft_p50_ms),
+        "tokens_s": _r(tokens_s, 1), "tokens_s_chip": _r(tokens_s_chip, 1),
+        "occupancy": _r(occupancy, 4),
+        "tokens_per_step": _r(tokens_per_step, 3),
+        "compiles_after_warmup": (None if compiles_after_warmup is None
+                                  else int(compiles_after_warmup)),
+        "cache_utilization": _r(cache_utilization, 4),
+    }

@@ -1,0 +1,483 @@
+"""AOT-compiled bucketed inference engine for Llama-family decoders.
+
+Serving can't afford a retrace mid-traffic (PR 1's retrace detector
+exists because one recompile stalls every request on the chip), so the
+engine AOT-compiles TWO graph families at warmup and only ever looks
+them up afterwards:
+
+- ``prefill[bucket]``: a full causal forward over a prompt padded to a
+  power-of-two sequence bucket, writing K/V (unrepeated GQA heads) into
+  the sequence's pool blocks and sampling the first generated token from
+  the last valid position's logits.
+- ``decode[n_blocks]``: ONE token for the whole fixed-size batch against
+  the paged KV cache — block-table gather, per-row position mask, the
+  shared ``llama._cache_attention`` math (bitwise the full forward, see
+  the decode-parity gate in tests/test_serving.py), current K/V
+  scattered into the pool before attending, next token sampled in-graph.
+
+Both families take the KV pools as DONATED arguments (the PR 6
+``step_multi`` carry discipline): the cache is updated functionally and
+swapped on the host, never copied.  Weights are jit arguments, never
+baked constants.  The compile cache is keyed like PR 1's retrace
+detector — every (kind, shape-signature) miss is counted, and
+``stats["compiles_after_warmup"]`` staying 0 under traffic is a tier-1
+assertion.
+
+int8 serving: pass ``quantize="int8"`` (+ calibration batches) and the
+engine routes the net through ``contrib.quantization.quantize_net`` —
+the projection weights become per-channel int8 with the calibrated
+activation scales, and the engine's matmuls mirror ``QuantizedDense``
+op-for-op (int32 accumulation is exact, so decode parity survives
+quantization bit-for-bit against the quantized net's own forward).
+"""
+from __future__ import annotations
+
+import math
+import os
+
+import numpy as _np
+
+from ..base import MXNetError
+from .kv_cache import PagedKVCache
+
+__all__ = ["InferenceEngine", "next_bucket"]
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def next_bucket(n, buckets):
+    """Smallest bucket >= n, or None when n exceeds every bucket."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return None
+
+
+class InferenceEngine:
+    """Compiled serving engine over one ``LlamaForCausalLM``.
+
+    Parameters
+    ----------
+    net : initialized LlamaForCausalLM (run one forward first so shapes
+        are materialized).  With ``quantize="int8"`` the net's Dense
+        projections are swapped for int8 twins IN PLACE via
+        ``contrib.quantization.quantize_net``.
+    max_batch : decode slots (>= 2; the compiled decode batch is fixed).
+    block_size : KV-cache block size in tokens (power of two).
+    max_context : longest supported sequence (rounded down to a multiple
+        of ``block_size``); prefill/decode buckets are the powers of two
+        in [block_size, max_context].
+    temperature / top_k / seed : in-graph sampling config (greedy at
+        temperature 0; otherwise top-k categorical when top_k > 0, full
+        categorical when 0).
+    """
+
+    def __init__(self, net, max_batch=None, block_size=None,
+                 num_blocks=None, max_context=None, temperature=0.0,
+                 top_k=0, seed=0, quantize=None, calib_data=None,
+                 num_calib_batches=10):
+        import jax
+        import jax.numpy as jnp
+        cfg = net.cfg
+        if cfg.tensor_parallel:
+            raise MXNetError("InferenceEngine drives the single-chip "
+                             "decode path; TP models serve via forward()")
+        if quantize not in (None, "int8"):
+            raise MXNetError(f"quantize={quantize!r}: only int8 weight "
+                             "quantization is supported")
+        self.net = net
+        self.cfg = cfg
+        self.max_batch = max(2, _env_int("MXTPU_SERVE_MAX_BATCH", 4)
+                             if max_batch is None else int(max_batch))
+        bs = _env_int("MXTPU_SERVE_BLOCK", 16) if block_size is None \
+            else int(block_size)
+        mc = max_context if max_context is not None else \
+            min(cfg.max_seq_len, _env_int("MXTPU_SERVE_MAX_CONTEXT", 1024))
+        mc = (mc // bs) * bs
+        if mc < bs:
+            raise MXNetError(f"max_context {mc} < block_size {bs}")
+        self.block_size = bs
+        self.max_context = mc
+        # shape buckets: powers of two in [block_size, max_context] —
+        # each bucket is one compiled graph, so traffic of ANY length
+        # mix runs on this fixed, warmup-compiled set
+        self.buckets = []
+        b = bs
+        while b <= mc:
+            self.buckets.append(b)
+            b *= 2
+        if num_blocks is None:
+            num_blocks = 1 + self.max_batch * (mc // bs)
+        self.quantized = False
+        if quantize == "int8":
+            self._quantize_in_place(net, calib_data, num_calib_batches)
+        self.params = self._extract_weights(net)
+        self.cache = PagedKVCache(
+            cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
+            num_blocks=num_blocks, block_size=bs,
+            max_batch=self.max_batch,
+            dtype=self.params["embed"].dtype)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self._base_key = jax.random.key(seed)
+        self._compiled = {}
+        self._warmed = False
+        self.stats = {"compiles": 0, "compiles_after_warmup": 0,
+                      "prefill_calls": 0, "decode_calls": 0}
+
+    # -- weights ---------------------------------------------------------
+
+    def _quantize_in_place(self, net, calib_data, num_calib_batches):
+        from ..contrib.quantization import QuantizedDense, quantize_net
+        has_q = any(isinstance(m, QuantizedDense) for m in
+                    self._walk(net))
+        if not has_q:
+            if calib_data is None:
+                raise MXNetError("quantize='int8' needs calib_data "
+                                 "(token batches for PTQ calibration)")
+            # calibration hooks pull activations host-side, which is
+            # illegal inside a jitted forward — run the calibration
+            # forwards eagerly, then restore hybridization
+            was_active = getattr(net, "_active", False)
+            if was_active:
+                net.hybridize(False)
+            try:
+                quantize_net(net, calib_data=calib_data,
+                             num_calib_batches=num_calib_batches)
+            finally:
+                if was_active:
+                    net.hybridize(True)
+        self.quantized = True
+
+    @staticmethod
+    def _walk(block):
+        yield block
+        for child in block._children.values():
+            yield from InferenceEngine._walk(child)
+
+    def _proj_params(self, layer):
+        """One projection as a tagged dict: {'w'} fp32 or
+        {'qw','ws','as'} int8 (QuantizedDense twins)."""
+        import jax.numpy as jnp
+        from ..contrib.quantization import QuantizedDense
+        if isinstance(layer, QuantizedDense):
+            return {"qw": layer.quantized_weight,
+                    "ws": layer.weight_scale.astype(jnp.float32),
+                    "as": jnp.float32(layer.act_scale)}
+        return {"w": layer.weight.data().data}
+
+    def _extract_weights(self, net):
+        m = net.model
+        layers = []
+        for layer in m.layers:
+            a, f = layer.attention, layer.mlp
+            layers.append({
+                "in_norm": layer.input_norm.weight.data().data,
+                "q": self._proj_params(a.q_proj),
+                "k": self._proj_params(a.k_proj),
+                "v": self._proj_params(a.v_proj),
+                "o": self._proj_params(a.o_proj),
+                "post_norm": layer.post_norm.weight.data().data,
+                "gate": self._proj_params(f.gate_proj),
+                "up": self._proj_params(f.up_proj),
+                "down": self._proj_params(f.down_proj),
+            })
+        params = {"embed": m.embed.weight.data().data,
+                  "norm": m.norm.weight.data().data,
+                  "layers": layers}
+        if net.lm_head is not None:
+            params["head"] = self._proj_params(net.lm_head)
+        return params
+
+    # -- graph building --------------------------------------------------
+
+    @staticmethod
+    def _proj(x, p):
+        """Dense matmul mirroring the block forwards op-for-op:
+        fp32 = FullyConnected's ``x @ w.T``; int8 = QuantizedDense's
+        round/clip -> int8 dot_general(int32 accum) -> rescale."""
+        import jax.numpy as jnp
+        from jax import lax
+        if "qw" in p:
+            lead = x.shape[:-1]
+            flat = x.reshape(-1, x.shape[-1])
+            qx = jnp.clip(jnp.round(flat / p["as"]), -127, 127) \
+                .astype(jnp.int8)
+            acc = lax.dot_general(qx, p["qw"], (((1,), (1,)), ((), ())),
+                                  preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (p["as"] *
+                                             p["ws"].reshape(1, -1))
+            return out.reshape(lead + (out.shape[-1],))
+        return jnp.matmul(x, p["w"].T)
+
+    def _head_logits(self, params, x):
+        import jax.numpy as jnp
+        if "head" in params:
+            return self._proj(x, params["head"])
+        return jnp.matmul(x, params["embed"].T)
+
+    def _build_prefill(self, bucket):
+        """Prefill graph for one prompt padded to ``bucket`` tokens:
+        causal forward (the same flash path the full forward runs),
+        K/V written into the sequence's blocks, first token sampled from
+        the last VALID position's logits."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from ..gluon.model_zoo.nlp.llama import (_QPAD, _rms,
+                                                 _rot_interleaved)
+        from ..ops.flash_attention import flash_attention
+        cfg = self.cfg
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        rep, eps, theta = h // kvh, cfg.rms_eps, cfg.rope_theta
+        bs = self.block_size
+        nb = bucket // bs
+        L = bucket
+
+        def run(params, kp, vp, toks, valid, bt, key):
+            x = jnp.take(params["embed"], toks, axis=0)      # (1, L, hid)
+            pos = jnp.arange(L)
+            freqs = theta ** (-jnp.arange(0, d, 2) / d)
+            ang = pos[:, None] * freqs[None, :]
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+            for li, lp in enumerate(params["layers"]):
+                hh = _rms(x, lp["in_norm"], eps)
+                q = self._proj(hh, lp["q"]).reshape(1, L, h, d) \
+                    .transpose(0, 2, 1, 3)
+                k = self._proj(hh, lp["k"]).reshape(1, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)
+                v = self._proj(hh, lp["v"]).reshape(1, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)
+                q = _rot_interleaved(q, cos, sin)
+                k = _rot_interleaved(k, cos, sin)
+                # unrepeated K/V into the pool blocks: (L, kvh, d) rows
+                kp = kp.at[li, bt].set(
+                    k[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d))
+                vp = vp.at[li, bt].set(
+                    v[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d))
+                kr = jnp.repeat(k, rep, axis=1)
+                vr = jnp.repeat(v, rep, axis=1)
+                o = flash_attention(q, kr, vr, causal=True)
+                o = o.transpose(0, 2, 1, 3).reshape(1, L, h * d)
+                x = x + self._proj(o, lp["o"])
+                y = _rms(x, lp["post_norm"], eps)
+                x = x + self._proj(
+                    jax.nn.silu(self._proj(y, lp["gate"])) *
+                    self._proj(y, lp["up"]), lp["down"])
+            x = _rms(x, params["norm"], eps)
+            # last-valid-row logits through an M=_QPAD slice (an M=1
+            # projection takes XLA's gemv path whose bits differ from
+            # the full forward's gemm — see llama._QPAD)
+            start = jnp.maximum(valid - _QPAD, 0)
+            xs = lax.dynamic_slice_in_dim(x, start, _QPAD, axis=1)
+            logits = self._head_logits(params, xs)[0]        # (_QPAD, V)
+            last = jnp.take(logits, valid - 1 - start, axis=0)
+            tok = self._sample(last[None, :], key)[0]
+            return last, tok, kp, vp
+
+        return run
+
+    def _build_decode(self, nbl):
+        """One-token decode for the fixed batch against ``nbl`` gathered
+        blocks per sequence (context bucket = nbl * block_size)."""
+        import jax
+        import jax.numpy as jnp
+        from ..gluon.model_zoo.nlp.llama import (_cache_attention, _rms,
+                                                 _rot_interleaved)
+        cfg = self.cfg
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        eps, theta = cfg.rms_eps, cfg.rope_theta
+        bs = self.block_size
+        B = self.max_batch
+        L = nbl * bs
+        scale = 1.0 / math.sqrt(d)
+
+        def run(params, kp, vp, toks, pos, bts, active, key):
+            x = jnp.take(params["embed"], toks, axis=0)      # (B, hid)
+            freqs = theta ** (-jnp.arange(0, d, 2) / d)
+            ang = pos[:, None] * freqs[None, :]              # (B, d/2)
+            cos, sin = jnp.cos(ang), jnp.sin(ang)
+            blk = jnp.take_along_axis(
+                bts, (pos // bs)[:, None], axis=1)[:, 0]     # (B,)
+            blk = jnp.where(active, blk, 0)                  # null block
+            off = pos % bs
+            valid = jnp.arange(L)[None, :] <= pos[:, None]   # (B, L)
+            for li, lp in enumerate(params["layers"]):
+                hh = _rms(x, lp["in_norm"], eps)
+                q = self._proj(hh, lp["q"]).reshape(B, h, d)
+                k = self._proj(hh, lp["k"]).reshape(B, kvh, d)
+                v = self._proj(hh, lp["v"]).reshape(B, kvh, d)
+                q = _rot_interleaved(q, cos[:, None, :], sin[:, None, :])
+                k = _rot_interleaved(k, cos[:, None, :], sin[:, None, :])
+                kp = kp.at[li, blk, off].set(k)
+                vp = vp.at[li, blk, off].set(v)
+                ck = kp[li][bts].reshape(B, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)                   # (B,kvh,L,d)
+                cv = vp[li][bts].reshape(B, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)
+                o = _cache_attention(q, ck, cv, valid, scale)
+                x = x + self._proj(o, lp["o"])
+                y = _rms(x, lp["post_norm"], eps)
+                x = x + self._proj(
+                    jax.nn.silu(self._proj(y, lp["gate"])) *
+                    self._proj(y, lp["up"]), lp["down"])
+            logits = self._head_logits(params, _rms(x, params["norm"],
+                                                    eps))    # (B, V)
+            return logits, self._sample(logits, key), kp, vp
+
+        return run
+
+    def _sample(self, logits, key):
+        """In-graph next-token sampling: greedy at temperature 0, else
+        (top-k) categorical — logits never leave the device per token."""
+        import jax
+        import jax.numpy as jnp
+        if self.temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        scaled = logits.astype(jnp.float32) / self.temperature
+        if self.top_k > 0:
+            vals, idx = jax.lax.top_k(scaled, self.top_k)
+            pick = jax.random.categorical(key, vals, axis=-1)
+            return jnp.take_along_axis(
+                idx, pick[:, None], axis=1)[:, 0].astype(jnp.int32)
+        return jax.random.categorical(key, scaled,
+                                      axis=-1).astype(jnp.int32)
+
+    # -- compile cache (the retrace-detector discipline) -----------------
+
+    def _get(self, kind, size, args):
+        """Compile-cache lookup keyed by (kind, shape-signature); every
+        miss is one AOT compile (``jit(...).lower(args).compile()``) and
+        is COUNTED — serving traffic after warmup() must never miss.
+        The cached object is a fixed executable, so an unexpected
+        shape/dtype drift raises loudly instead of retracing silently
+        (the PR 1 retrace-detector discipline, enforced not observed)."""
+        sig = (kind, size)
+        fn = self._compiled.get(sig)
+        if fn is None:
+            import jax
+            build = (self._build_prefill if kind == "prefill"
+                     else self._build_decode)(size)
+            fn = jax.jit(build, donate_argnums=(1, 2)) \
+                .lower(*args).compile()
+            self._compiled[sig] = fn
+            self.stats["compiles"] += 1
+            if self._warmed:
+                self.stats["compiles_after_warmup"] += 1
+        return fn
+
+    def warmup(self):
+        """AOT-compile every (prefill, decode) bucket graph by running
+        each once against the real pools (compile + execute warms the
+        jit cache; the pools round-trip through the donated call)."""
+        import jax
+        dummy_key = jax.random.key(0)
+        for bucket in self.buckets:
+            nb = bucket // self.block_size
+            ok = self.cache.alloc("__warmup__", bucket)
+            if not ok:
+                raise MXNetError("warmup: KV pool too small for bucket "
+                                 f"{bucket}; raise num_blocks")
+            bt = _np.asarray(self.cache.table("__warmup__"), _np.int32)
+            toks = _np.zeros((1, bucket), _np.int32)
+            args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                    toks, _np.int32(1), bt, dummy_key)
+            last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
+            self.cache.update_pools(kp, vp)
+            bts = self.cache.table_array(
+                ["__warmup__"] + [None] * (self.max_batch - 1), nb)
+            args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                    _np.zeros((self.max_batch,), _np.int32),
+                    _np.zeros((self.max_batch,), _np.int32), bts,
+                    _np.zeros((self.max_batch,), bool), dummy_key)
+            logits, nxt, kp, vp = self._get("decode", nb, args)(*args)
+            self.cache.update_pools(kp, vp)
+            self.cache.free("__warmup__")
+        self._warmed = True
+        return self
+
+    # -- serving calls ---------------------------------------------------
+
+    def prefill(self, slot, tokens):
+        """Prefill ``tokens`` (1D int sequence) into ``slot``: allocates
+        blocks, runs the bucketed prefill graph, samples the first
+        generated token.  Returns ``(first_token, last_logits)`` or None
+        when the prompt exceeds max_context or the pool is exhausted
+        (request stays queued)."""
+        import jax
+        toks = _np.asarray(tokens, _np.int32).reshape(-1)
+        t = toks.shape[0]
+        if t == 0:
+            raise MXNetError("prefill needs at least one token")
+        bucket = next_bucket(t, self.buckets)
+        if bucket is None:
+            return None
+        if not self.cache.alloc(slot, bucket):
+            return None
+        padded = _np.zeros((1, bucket), _np.int32)
+        padded[0, :t] = toks
+        bt = _np.asarray(self.cache.table(slot), _np.int32)
+        key = jax.random.fold_in(self._base_key,
+                                 (1 << 30) + self.stats["prefill_calls"])
+        args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                padded, _np.int32(t), bt, key)
+        last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
+        self.cache.update_pools(kp, vp)
+        self.cache.trim(slot, t)
+        self.cache.set_len(slot, t)
+        self.stats["prefill_calls"] += 1
+        return int(tok), last
+
+    def reserve(self, slot, pos):
+        """Grow ``slot``'s block table to cover ``pos`` before a decode
+        step; False when the pool is exhausted."""
+        return self.cache.ensure(slot, pos)
+
+    def decode(self, entries):
+        """One decode step for the joined batch.
+
+        entries: list of (slot, token, position) for the ACTIVE rows
+        (position = where this token goes, i.e. current sequence
+        length).  Pads to the fixed batch, picks the context bucket from
+        the max position, gathers block tables, runs the compiled step.
+        Returns (next_tokens (n_active,) np.int32, logits rows).
+        """
+        import jax
+        if not entries:
+            raise MXNetError("decode: empty batch")
+        n = len(entries)
+        if n > self.max_batch:
+            raise MXNetError(f"decode batch {n} > max_batch")
+        max_pos = max(p for _, _, p in entries)
+        bucket = next_bucket(max_pos + 1, self.buckets)
+        if bucket is None:
+            raise MXNetError(f"position {max_pos} exceeds max_context "
+                             f"{self.max_context}")
+        nbl = bucket // self.block_size
+        slots = [s for s, _, _ in entries] + \
+            [None] * (self.max_batch - n)
+        toks = _np.zeros((self.max_batch,), _np.int32)
+        pos = _np.zeros((self.max_batch,), _np.int32)
+        active = _np.zeros((self.max_batch,), bool)
+        for i, (slot, tok, p) in enumerate(entries):
+            toks[i], pos[i], active[i] = tok, p, True
+            self.cache.set_len(slot, p + 1)
+        bts = self.cache.table_array(slots, nbl)
+        key = jax.random.fold_in(self._base_key,
+                                 self.stats["decode_calls"])
+        args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                toks, pos, bts, active, key)
+        logits, nxt, kp, vp = self._get("decode", nbl, args)(*args)
+        self.cache.update_pools(kp, vp)
+        self.stats["decode_calls"] += 1
+        nxt = _np.asarray(nxt)[:n]
+        return nxt, _np.asarray(logits)[:n]
+
+    def release(self, slot):
+        """Finished sequence: return its blocks to the pool."""
+        self.cache.free(slot)

@@ -1,0 +1,172 @@
+"""Paged KV cache: block-table indexed, per-sequence alloc/free.
+
+The serving decode batch holds ``max_batch`` sequences of wildly
+different lengths; a dense (B, max_seq, ...) cache would reserve
+worst-case HBM for every slot.  Instead K/V live in a shared pool of
+fixed-size blocks (the vLLM PagedAttention layout, here sized for the
+TPU serving engine): each sequence owns an ordered list of physical
+block ids (its *block table*), blocks are handed out on demand as the
+sequence grows and returned to the free list the moment the sequence
+finishes — so cache memory tracks the LIVE token count, not
+max_batch x max_seq.
+
+Device side the pool is two jnp arrays of shape
+``(layers, num_blocks, block_size, kv_heads, head_dim)``; the compiled
+prefill/decode graphs take them as donated arguments and return the
+updated pool (functional update, carry donated like PR 6's
+``step_multi``), while this class keeps the HOST truth: the free list,
+per-slot block tables and lengths.  Physical block 0 is reserved as the
+null block — block-table padding and inactive batch rows point at it so
+every gather/scatter index stays in range; its contents are garbage by
+design and masked out of every attention (position mask).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["PagedKVCache"]
+
+
+class PagedKVCache:
+    """Block-pooled KV storage for one model.
+
+    Parameters
+    ----------
+    num_layers, num_kv_heads, head_dim : model geometry.
+    num_blocks : total physical blocks in the pool INCLUDING the
+        reserved null block 0.
+    block_size : tokens per block (power of two; decode context buckets
+        are multiples of it).
+    max_batch : decode slots (sequences resident at once).
+    """
+
+    def __init__(self, num_layers, num_kv_heads, head_dim, num_blocks=64,
+                 block_size=16, max_batch=4, dtype=None):
+        import jax.numpy as jnp
+        if block_size < 1 or (block_size & (block_size - 1)):
+            raise MXNetError("block_size must be a power of two, got "
+                             f"{block_size}")
+        if num_blocks < 2:
+            raise MXNetError("num_blocks must be >= 2 (block 0 is the "
+                             "reserved null block)")
+        self.num_layers = num_layers
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.dtype = dtype or jnp.float32
+        shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
+        self.k_pool = jnp.zeros(shape, self.dtype)
+        self.v_pool = jnp.zeros(shape, self.dtype)
+        # LIFO free list: freshly freed blocks are reused first (warm)
+        self._free = list(range(num_blocks - 1, 0, -1))
+        self._tables = {}        # slot -> [physical block ids]
+        self._lens = {}          # slot -> tokens stored
+        self.alloc_failures = 0  # pool-exhausted alloc attempts (stats)
+
+    # -- allocation ------------------------------------------------------
+
+    @property
+    def num_free_blocks(self):
+        return len(self._free)
+
+    @property
+    def blocks_in_use(self):
+        return (self.num_blocks - 1) - len(self._free)
+
+    def utilization(self):
+        """Fraction of allocatable blocks currently owned by sequences."""
+        total = self.num_blocks - 1
+        return self.blocks_in_use / total if total else 0.0
+
+    def blocks_for(self, n_tokens):
+        """Blocks needed to hold ``n_tokens`` positions."""
+        return -(-int(n_tokens) // self.block_size)
+
+    def alloc(self, slot, n_tokens):
+        """Give ``slot`` enough blocks for ``n_tokens`` positions.
+        Returns False (and allocates nothing) when the pool can't cover
+        the request — the scheduler then leaves the request queued."""
+        if slot in self._tables:
+            raise MXNetError(f"slot {slot} already allocated; free() first")
+        need = self.blocks_for(n_tokens)
+        if need > len(self._free):
+            self.alloc_failures += 1
+            return False
+        self._tables[slot] = [self._free.pop() for _ in range(need)]
+        self._lens[slot] = 0
+        return True
+
+    def ensure(self, slot, pos):
+        """Grow ``slot``'s table to cover position ``pos`` (0-based).
+        Returns False when the pool is exhausted (caller may evict or
+        stall the sequence)."""
+        table = self._tables[slot]
+        need = self.blocks_for(pos + 1) - len(table)
+        if need <= 0:
+            return True
+        if need > len(self._free):
+            self.alloc_failures += 1
+            return False
+        table.extend(self._free.pop() for _ in range(need))
+        return True
+
+    def trim(self, slot, n_tokens):
+        """Shrink ``slot``'s table to exactly cover ``n_tokens``
+        positions, returning the tail blocks to the pool (prefill
+        allocates for the padded BUCKET; the pad tail is garbage by
+        construction — decode overwrites a position before ever reading
+        it — so the blocks can be handed to other sequences now)."""
+        table = self._tables[slot]
+        keep = self.blocks_for(n_tokens)
+        while len(table) > keep:
+            self._free.append(table.pop())
+
+    def free(self, slot):
+        """Return all of ``slot``'s blocks to the pool."""
+        for blk in self._tables.pop(slot, ()):
+            self._free.append(blk)
+        self._lens.pop(slot, None)
+
+    def set_len(self, slot, n):
+        self._lens[slot] = int(n)
+
+    def seq_len(self, slot):
+        return self._lens.get(slot, 0)
+
+    def table(self, slot):
+        return list(self._tables.get(slot, ()))
+
+    # -- device-facing views --------------------------------------------
+
+    def table_array(self, slots, width):
+        """(len(slots), width) int32 block-table matrix for the compiled
+        decode step: row i is ``slots[i]``'s table, padded with the null
+        block; a ``None`` slot (inactive batch row) is all-null."""
+        out = _np.zeros((len(slots), width), _np.int32)
+        for i, slot in enumerate(slots):
+            if slot is None:
+                continue
+            t = self._tables.get(slot, ())
+            if len(t) > width:
+                raise MXNetError(
+                    f"slot {slot} holds {len(t)} blocks but the decode "
+                    f"bucket only gathers {width}; bucket too small")
+            out[i, :len(t)] = t[:width]
+        return out
+
+    def update_pools(self, k_pool, v_pool):
+        """Swap in the pools returned by a compiled (donated) step."""
+        self.k_pool = k_pool
+        self.v_pool = v_pool
+
+    def stats(self):
+        return {"num_blocks": self.num_blocks,
+                "block_size": self.block_size,
+                "blocks_in_use": self.blocks_in_use,
+                "utilization": round(self.utilization(), 4),
+                "alloc_failures": self.alloc_failures,
+                "sequences": len(self._tables)}

@@ -301,3 +301,74 @@ def test_require_tpu_fail_fast_refuses_cpu(monkeypatch, capsys):
     assert obj["platform_actual"] == "cpu"
     assert "REQUIRE_TPU" in obj["error"]
     _assert_headline(lines[-1])
+
+
+# ----------------------------------------------------------------------
+# the `serving` block schema (ISSUE 7): config always real, measured
+# fields null-when-unmeasured — a CPU run can't fake serving latency
+# ----------------------------------------------------------------------
+
+_SERVING_KEYS = {
+    "max_batch", "block_size", "buckets", "quantized", "continuous",
+    "requests", "p50_ms", "p99_ms", "ttft_p50_ms", "tokens_s",
+    "tokens_s_chip", "occupancy", "tokens_per_step",
+    "compiles_after_warmup", "cache_utilization",
+}
+
+
+def test_serving_block_schema_is_stable():
+    from mxnet_tpu.serving import serving_block
+    blk = serving_block()
+    assert set(blk) == _SERVING_KEYS
+    # MEASURED fields are null when nothing was measured
+    for k in ("p50_ms", "p99_ms", "ttft_p50_ms", "tokens_s",
+              "tokens_s_chip", "occupancy", "tokens_per_step",
+              "compiles_after_warmup", "cache_utilization"):
+        assert blk[k] is None, k
+    # measured values round-trip, rounded
+    blk2 = serving_block(p99_ms=12.3456, tokens_s_chip=901.239,
+                         occupancy=0.87654, compiles_after_warmup=0)
+    assert blk2["p99_ms"] == 12.346
+    assert blk2["tokens_s_chip"] == 901.2
+    assert blk2["occupancy"] == 0.8765
+    assert blk2["compiles_after_warmup"] == 0
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_bench_serving_on_cpu_is_nulls_not_zeros():
+    """bench.py's serving block on a CPU host: config real, every
+    latency/throughput field null (the CPU-scale evidence lives in the
+    tier-1 serve_loadgen smoke, not in fake bench zeros)."""
+    import jax
+    if jax.devices()[0].platform != "cpu":
+        return
+    blk = bench._bench_serving()
+    for k in ("p50_ms", "p99_ms", "tokens_s_chip", "occupancy"):
+        assert blk[k] is None, k
+    assert blk["max_batch"] > 0 and blk["block_size"] > 0
+    assert "note" in blk
+
+
+def test_serving_compact_keys_surface_when_measured():
+    from mxnet_tpu.serving import serving_block
+    p = _success_payload()
+    p["extra"]["serving"] = serving_block(
+        max_batch=8, block_size=16, buckets=(16, 32, 64),
+        requests=32, p50_ms=41.2, p99_ms=88.7, tokens_s=9120.4,
+        tokens_s_chip=9120.4, occupancy=0.91, tokens_per_step=7.3,
+        compiles_after_warmup=0)
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["serve_tok_s"] == 9120.4
+    assert obj["serve_p99_ms"] == 88.7
+    assert obj["serve_occupancy"] == 0.91
+
+
+def test_serving_nulls_stay_out_of_headline():
+    from mxnet_tpu.serving import serving_block
+    p = _success_payload()
+    p["extra"]["serving"] = serving_block(max_batch=8, block_size=16,
+                                          buckets=(16, 32))
+    obj = json.loads(bench._compact_line(p))
+    assert "serve_tok_s" not in obj
+    assert "serve_p99_ms" not in obj
+    assert "serve_occupancy" not in obj

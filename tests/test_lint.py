@@ -754,3 +754,74 @@ def test_hb10_package_is_clean():
     assert viol == []
     assert n_files > 50
     assert viol == [], [f"{v.path}:{v.line}" for v in viol]
+
+
+# ----------------------------------------------------------------------
+# HB11 — per-token host sync in a decode/generation loop (ISSUE 7)
+# ----------------------------------------------------------------------
+
+def test_hb11_per_token_pull_flagged():
+    out = lint_source(textwrap.dedent("""
+        def serve(decoder, tok, states, max_new):
+            for t in range(max_new):
+                logits, states = decoder(tok, states)
+                tok = int(logits.asnumpy().argmax())
+                score = float(logits)
+    """), path="<hb11>")
+    assert [v.rule for v in out] == ["HB11", "HB11"]
+    assert out[0].func == "serve"
+    assert "per-token host sync" in out[0].message
+
+
+def test_hb11_decode_step_and_item_flagged():
+    out = lint_source(textwrap.dedent("""
+        while pending:
+            toks, logits = engine.decode_step(batch)
+            best.append(logits.item())
+            toks.wait_to_read()
+    """), path="<hb11>")
+    assert [v.rule for v in out] == ["HB11", "HB11"]
+
+
+def test_hb11_pull_after_loop_is_clean():
+    # the SUPPORTED shape: sample in-graph, pull sequences once after
+    out = lint_source(textwrap.dedent("""
+        def serve(decoder, tok, states, max_new):
+            for t in range(max_new):
+                tok, states = decoder(tok, states)
+            return tok.asnumpy()
+    """), path="<hb11>")
+    assert out == []
+
+
+def test_hb11_loops_without_decoder_are_clean():
+    # an ordinary loop pulling values is not a decode loop
+    out = lint_source(textwrap.dedent("""
+        for batch in loader:
+            stats.append(batch.asnumpy())
+            s = raw.decode()          # bytes.decode: not a decoder step
+    """), path="<hb11>")
+    assert out == []
+
+
+def test_hb11_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB11" in RULES
+    assert RULES["HB11"].bad and RULES["HB11"].good
+    out = lint_source(textwrap.dedent("""
+        for t in range(max_new):
+            logits, st = decoder(tok, st)
+            dbg(logits.asnumpy())  # mxlint: disable=HB11
+    """), path="<hb11>")
+    assert out == []
+
+
+def test_hb11_package_is_clean():
+    """The framework's own decode loops (samplers, serving scheduler,
+    generate) must hold the bar the rule sets."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB11"})
+    assert viol == []
+    assert n_files > 50

@@ -129,3 +129,36 @@ def test_beam_search_prefers_high_prob_path():
     # best beam: start token, then 3, then EOS
     assert s[0, 0, 1] == 3
     assert 0 in s[0, 0, 2:]
+
+
+def test_sequence_sampler_determinism_via_key_data():
+    """SequenceSampler draws from the global mx.random stream:
+    snapshotting the key with random.get_key_data and restoring it with
+    set_key_data (the PR 4 checkpoint API) replays the exact sample —
+    and without the restore the stream moves on."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.ndarray import random as _rnd
+
+    vocab = 12
+
+    @jax.jit
+    def step(tok, states):
+        logits = jnp.tile(jnp.linspace(0.0, 1.0, vocab)[None, :],
+                          (tok.shape[0], 1))
+        return jax.nn.log_softmax(logits, axis=-1), states
+
+    sampler = nlp.SequenceSampler(beam_size=3, decoder=step, eos_id=0,
+                                  max_length=6, temperature=1.0, top_k=4)
+    snap = np.asarray(_rnd.get_key_data()).copy()
+    s1, sc1, l1 = sampler(mx.nd.array([1, 2]), {})
+    _rnd.set_key_data(snap)
+    s2, sc2, l2 = sampler(mx.nd.array([1, 2]), {})
+    np.testing.assert_array_equal(s1.asnumpy(), s2.asnumpy())
+    np.testing.assert_array_equal(l1.asnumpy(), l2.asnumpy())
+    # stream NOT restored -> (vanishingly likely) different draws
+    s3, _, _ = sampler(mx.nd.array([1, 2]), {})
+    assert not np.array_equal(s1.asnumpy(), s3.asnumpy())
+    # top_k=4 with an ascending logit ramp: only the 4 best ids appear
+    gen = s1.asnumpy()[..., 1:]
+    assert set(np.unique(gen)).issubset(set(range(vocab - 4, vocab)))

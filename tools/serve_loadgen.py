@@ -1,0 +1,198 @@
+#!/usr/bin/env python
+"""Serving load generator: continuous vs static batching on the compiled
+inference engine (mxnet_tpu.serving), with p50/p99 latency, tokens/s,
+and batch occupancy — the ISSUE 7 serving benchmark.
+
+The request mix is DETERMINISTIC (prompt lengths and generation budgets
+cycle through fixed lists), so the policy comparison — tokens-per-step
+and occupancy — is exact and CI-gateable; walltime-derived numbers
+(tokens/s, p50/p99) ride along as evidence, never as gates.
+
+Usage:
+  python tools/serve_loadgen.py --smoke           # CPU-sized, tier-1
+  python tools/serve_loadgen.py --requests 64 --max-batch 8
+  python tools/serve_loadgen.py --mode continuous|static|both
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# smoke mix: mixed prompt lengths + mixed generation budgets — the
+# shape of traffic where continuous batching wins (short requests vacate
+# slots that static batching would leave idle)
+_PROMPT_MIX = (5, 12, 24, 8, 17, 3)
+_NEW_MIX = (4, 12, 6, 16, 3, 9)
+
+
+def _build_net(smoke):
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+    if smoke:
+        cfg = LlamaConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                          num_heads=4, num_kv_heads=2,
+                          intermediate_size=128, max_seq_len=128,
+                          tie_embeddings=True)
+    else:
+        cfg = LlamaConfig(vocab_size=32000, hidden_size=1024,
+                          num_layers=8, num_heads=16, num_kv_heads=8,
+                          intermediate_size=2816, max_seq_len=1024)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))   # materialize shapes
+    net.hybridize()
+    return net, cfg
+
+
+def _requests(n, vocab, seed=0):
+    import numpy as np
+    from mxnet_tpu.serving import Request
+    rng = np.random.RandomState(seed)
+    out = []
+    for i in range(n):
+        t = _PROMPT_MIX[i % len(_PROMPT_MIX)]
+        new = _NEW_MIX[i % len(_NEW_MIX)]
+        out.append(Request(rng.randint(0, vocab, (t,)).tolist(), new))
+    return out
+
+
+def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
+                mode="both", smoke=True, quantize=None, seed=0):
+    """Run the mix through the chosen scheduling policy(ies); returns
+    the bench `serving` payload."""
+    from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
+                                   StaticBatcher, serving_block)
+    results = {}
+    for policy in (("continuous", "static") if mode == "both"
+                   else (mode,)):
+        net, cfg = _build_net(smoke)
+        kw = {}
+        if quantize:
+            import numpy as np
+            import mxnet_tpu as mx
+            rng = np.random.RandomState(seed)
+            kw = {"quantize": quantize,
+                  "calib_data": [mx.nd.array(
+                      rng.randint(0, cfg.vocab_size, (2, 16)),
+                      dtype="int32") for _ in range(2)]}
+        engine = InferenceEngine(net, max_batch=max_batch,
+                                 block_size=block_size,
+                                 max_context=max_context, **kw)
+        engine.warmup()
+        cls = (ContinuousBatcher if policy == "continuous"
+               else StaticBatcher)
+        # priming pass: the first requests through a process also pay
+        # one-time host-side jit warmups (key folding, conversions);
+        # keep those out of the measured window so the policy
+        # comparison is apples-to-apples
+        prime = cls(engine)
+        for req in _requests(2, cfg.vocab_size, seed + 1):
+            prime.submit(req)
+        prime.run()
+        batcher = cls(engine)
+        for req in _requests(n_requests, cfg.vocab_size, seed):
+            batcher.submit(req)
+        t0 = time.perf_counter()
+        stats = batcher.run()
+        wall = time.perf_counter() - t0
+        stats["wall_s"] = round(wall, 3)
+        stats["tokens_s"] = round(stats["tokens_generated"] / wall, 1) \
+            if wall > 0 else None
+        stats["tokens_per_step"] = round(
+            stats["tokens_generated"] / stats["decode_steps"], 3) \
+            if stats["decode_steps"] else None
+        stats["compiles_after_warmup"] = \
+            engine.stats["compiles_after_warmup"]
+        stats["ttfts"] = sorted(
+            round(r.ttft(), 4) for r in batcher.finished
+            if r.ttft() is not None)
+        results[policy] = stats
+    cont = results.get("continuous") or next(iter(results.values()))
+    blk = serving_block(
+        max_batch=max_batch, block_size=block_size,
+        buckets=_buckets(block_size, max_context),
+        quantized=bool(quantize), continuous="continuous" in results,
+        requests=cont["requests"],
+        p50_ms=_ms(cont.get("p50_latency_s")),
+        p99_ms=_ms(cont.get("p99_latency_s")),
+        ttft_p50_ms=_ms(cont["ttfts"][len(cont["ttfts"]) // 2]
+                        if cont.get("ttfts") else None),
+        tokens_s=cont.get("tokens_s"),
+        tokens_s_chip=cont.get("tokens_s"),   # single chip here
+        occupancy=cont.get("occupancy"),
+        tokens_per_step=cont.get("tokens_per_step"),
+        compiles_after_warmup=cont.get("compiles_after_warmup"),
+        cache_utilization=None)
+    payload = {"metric": "serve_loadgen", "mode": mode,
+               "smoke": bool(smoke), "serving": blk,
+               "policies": {k: {kk: vv for kk, vv in v.items()
+                                if kk != "ttfts"}
+                            for k, v in results.items()}}
+    if mode == "both":
+        c, s = results["continuous"], results["static"]
+        payload["continuous_vs_static"] = {
+            "tokens_per_step_ratio": round(
+                c["tokens_per_step"] / s["tokens_per_step"], 3)
+            if s.get("tokens_per_step") else None,
+            "occupancy_ratio": round(c["occupancy"] / s["occupancy"], 3)
+            if s.get("occupancy") else None,
+            "decode_steps": {"continuous": c["decode_steps"],
+                             "static": s["decode_steps"]},
+        }
+    return payload
+
+
+def _buckets(bs, mc):
+    out = []
+    b = bs
+    while b <= mc:
+        out.append(b)
+        b *= 2
+    return out
+
+
+def _ms(s):
+    return None if s is None else s * 1e3
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU-sized model + short mix (tier-1)")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--max-batch", type=int, default=4)
+    ap.add_argument("--block-size", type=int, default=None)
+    ap.add_argument("--max-context", type=int, default=None)
+    ap.add_argument("--mode", choices=("continuous", "static", "both"),
+                    default="both")
+    ap.add_argument("--int8", action="store_true",
+                    help="serve int8-quantized weights")
+    args = ap.parse_args(argv)
+    smoke = args.smoke
+    n = args.requests if args.requests is not None else (12 if smoke
+                                                         else 64)
+    payload = run_loadgen(
+        n_requests=n, max_batch=args.max_batch,
+        block_size=args.block_size or (8 if smoke else 16),
+        max_context=args.max_context or (64 if smoke else 512),
+        mode=args.mode, smoke=smoke,
+        quantize="int8" if args.int8 else None)
+    out = json.dumps(payload)
+    if len(out) > 1800:      # the driver tail-window contract
+        slim = dict(payload)
+        slim.pop("policies", None)
+        out = json.dumps(slim)
+    print(out, flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
