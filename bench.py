@@ -740,6 +740,50 @@ def _bench_serving() -> dict:
     return blk
 
 
+def _bench_elastic() -> dict:
+    """Elastic-membership evidence (ISSUE 8): reshard_ms / pause_ms /
+    membership_epoch for one measured kill -> reshard dp N -> N/2
+    transition through ``mx.elastic.ElasticController``.  On CPU the
+    block ships the elastic CONFIG with the measured fields null —
+    null-when-unmeasured (PR 6 honesty rule); the deterministic
+    correctness/parity evidence lives in tier-1's chaos elastic suite
+    (``tools/tpu_queue_runner.py --chaos elastic``).  On a multi-chip
+    TPU host the transition is measured for real."""
+    import jax
+    from mxnet_tpu import elastic
+    devices = jax.devices()
+    n = len(devices)
+    if devices[0].platform == "cpu" or n < 2 or n % 2:
+        blk = elastic.elastic_block(enabled=elastic.elastic_enabled(),
+                                    dp=1)
+        blk["note"] = ("not measured on CPU; correctness/parity "
+                       "evidence: tools/tpu_queue_runner.py --chaos "
+                       "elastic (tier-1, bitwise)")
+        return blk
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.parallel.mesh import make_mesh
+    mesh = make_mesh({"dp": n}, devices)
+    net = gluon.nn.Dense(64)
+    net.initialize()
+    trainer = parallel.DataParallelTrainer(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.01},
+        mesh=mesh, shard_updates=True)
+    membership = elastic.Membership([0, 1])
+    ctrl = elastic.ElasticController(
+        membership, devices=devices, devices_per_worker=n // 2,
+        net=net, backoff_s=0.0)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(2 * n, 32).astype(np.float32))
+    y = mx.nd.array(rng.randn(2 * n, 64).astype(np.float32))
+    trainer.step(x, y)                       # compile + warm at dp=n
+    membership.worker_dead(1)                # lose half the capacity
+    ctrl.check_step(1, trainer, params=net)  # pause -> reshard -> resume
+    trainer.step(x, y)                       # first post-reshard step
+    return elastic.elastic_block(**ctrl.stats())
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -890,6 +934,11 @@ def _run_bench() -> dict:
         except Exception as e:  # noqa: BLE001
             result["extra"]["serving"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["elastic"] = _bench_elastic()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["elastic"] = {
+                "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)
         ml = _load_memlevers()
@@ -969,6 +1018,9 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         ("serve_tok_s", ("serving", "tokens_s_chip")),
         ("serve_p99_ms", ("serving", "p99_ms")),
         ("serve_occupancy", ("serving", "occupancy")),
+        ("elastic_reshard_ms", ("elastic", "reshard_ms")),
+        ("elastic_pause_ms", ("elastic", "pause_ms")),
+        ("elastic_epoch", ("elastic", "membership_epoch")),
         ("tpu_h2d_gb_s", ("tpu_bandwidth", "h2d_gb_s")),
         ("tpu_hbm_gb_s", ("tpu_bandwidth", "hbm_copy_gb_s")),
         ("kv_per_key_speedup", ("kvstore_bandwidth", "per_key_speedup")),
@@ -999,7 +1051,8 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
     # sweeps) surface automatically as long as they are scalars, one or
     # two levels deep, and the budget still allows them
     handled = {"bert", "resnet_rec_pipeline", "llama_decode", "serving",
-               "tpu_bandwidth", "kvstore_bandwidth", "scaling_projection"}
+               "elastic", "tpu_bandwidth", "kvstore_bandwidth",
+               "scaling_projection"}
     for k in sorted(extra):
         if k in handled:
             continue

@@ -85,6 +85,7 @@ from . import parallel
 from . import lint
 from . import checkpoint
 from . import serving
+from . import elastic
 
 # mx.np / mx.npx numpy-compat front end (SURVEY.md §2.2 numpy-compat row):
 # jax.numpy already provides numpy semantics; expose it under the mx.np name.

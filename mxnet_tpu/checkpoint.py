@@ -63,7 +63,8 @@ from .ndarray import utils as nd_utils
 from .testing import faults as _faults
 
 __all__ = ["AsyncCheckpointer", "save_checkpoint_async", "CheckpointManager",
-           "CheckpointTimeout", "PreemptionHandler", "run_preemptible"]
+           "CheckpointTimeout", "PreemptionHandler", "run_preemptible",
+           "reshard_in_place", "reshard_from_checkpoint"]
 
 
 class CheckpointTimeout(MXNetError):
@@ -597,6 +598,84 @@ class CheckpointManager:
                 t._set_data(value.data)
             else:
                 params[name] = value
+
+
+# ---------------------------------------------------------------------------
+# Elastic reshard-in-place (ISSUE 8) — the state-movement half of a
+# membership transition.  The orchestration (when to pause, retries,
+# rendezvous, epoch bookkeeping) lives in elastic/controller.py; THIS is
+# the one place that knows how to move training state onto a new mesh.
+# ---------------------------------------------------------------------------
+
+def reshard_in_place(trainer, mesh, params=None, _attempt=0):
+    """Reshard a running trainer to a new ``mesh`` without a process
+    restart: capture optimizer state in per-parameter space
+    (``state_dict`` — the dp-independent form PR 4 built for cross-dp
+    restore) plus a host snapshot of the parameters, rebuild the
+    trainer for the new world size (``DataParallelTrainer.rebuild``:
+    new BucketPlan, fresh jit caches, re-placed device state), and
+    restore both — bitwise the state a fresh process would load from a
+    checkpoint of the same instant.
+
+    This is the **peer-to-peer** path: on a real pod the survivors'
+    live state is fresher than any checkpoint, so the transfer sources
+    from the trainer itself.  The ``elastic.reshard`` fault point fires
+    between capture and apply — chaos tests kill the reshard mid-flight
+    and the controller falls back to :func:`reshard_from_checkpoint`.
+
+    Returns ``{"source": "peer", "step": None}`` (no rewind: training
+    continues at the paused step).
+    """
+    if not hasattr(trainer, "rebuild"):
+        raise MXNetError(
+            f"reshard_in_place needs a trainer with rebuild(mesh) "
+            f"(parallel.DataParallelTrainer); got {type(trainer).__name__}")
+    state = trainer.state_dict()
+    psnap = None
+    if params is not None:
+        psnap = {name: _np.asarray(p.data().asnumpy())
+                 for name, p
+                 in params._collect_params_with_prefix().items()
+                 if p._data is not None}
+    # the kill-during-reshard fault point: armed chaos runs die HERE —
+    # after capture, before any mutation — modeling a peer that vanishes
+    # mid-transfer; the controller's fallback then restores from disk
+    _faults.fault_point("elastic.reshard", int(_attempt))
+    trainer.rebuild(mesh)
+    if psnap is not None:
+        target = params._collect_params_with_prefix()
+        for name, v in psnap.items():
+            target[name].set_data(v)
+    trainer.load_state_dict(state)
+    return {"source": "peer", "step": None}
+
+
+def reshard_from_checkpoint(trainer, mesh, params=None, manager=None):
+    """The fallback half of an elastic reshard: the peer transfer
+    failed (worker died mid-reshard), so rebuild for the new mesh and
+    restore the newest VALID checkpoint (torn/corrupt ones skipped —
+    the PR 4 ``latest()`` discipline).  Training must rewind to the
+    returned step; the RNG streams are restored with it, so the replay
+    is bitwise the original schedule.
+
+    Returns ``{"source": "checkpoint", "step": <restored step>}``.
+    """
+    if manager is None:
+        raise MXNetError(
+            "elastic reshard: peer transfer failed and no "
+            "CheckpointManager was provided to fall back to")
+    if not hasattr(trainer, "rebuild"):
+        raise MXNetError(
+            f"reshard_from_checkpoint needs a trainer with rebuild(mesh)"
+            f"; got {type(trainer).__name__}")
+    trainer.rebuild(mesh)
+    manifest = manager.restore(params=params, trainer=trainer)
+    if manifest is None:
+        raise MXNetError(
+            "elastic reshard: peer transfer failed and no valid "
+            "checkpoint exists — cannot recover without a restart")
+    return {"source": "checkpoint", "step": int(manifest["step"]),
+            "manifest": manifest}
 
 
 # ---------------------------------------------------------------------------

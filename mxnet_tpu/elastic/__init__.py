@@ -1,0 +1,62 @@
+"""``mxnet_tpu.elastic`` — scale data-parallel workers up/down mid-run
+without a restart (ISSUE 8, ROADMAP item 4).
+
+Three layers stitched through the existing stack:
+
+- :class:`Membership` — the epoch-numbered membership state machine
+  (``membership.py``), fed by the PS heartbeat death path
+  (``PSServer.attach_membership`` + the join/announce RPC) and fully
+  deterministic under ``testing.faults.FakeClock``;
+- :class:`ElasticController` — pause at a step boundary, reshard
+  params + ZeRO-1 optimizer state to the new dp (peer-to-peer via
+  ``checkpoint.reshard_in_place``, checkpoint fallback when the
+  transfer itself dies), rebuild the mesh/BucketPlan/compiled steps,
+  resume — with retry/backoff and a bounded rendezvous so a flapping
+  worker degrades to a smaller dp instead of hanging the job;
+- the **epoch fence** — ``kvstore.attach_membership`` rejects a stale
+  worker's collective with a clean error instead of letting it deadlock
+  a ring against departed peers.
+
+``estimator.fit(elastic_controller=...)`` wires the pause/resume hook
+into the high-level loop; ``testing/chaos.py`` (``tools/
+tpu_queue_runner.py --chaos elastic``) is the end-to-end kill-at-K /
+join-at-K' smoke with bitwise continuation parity.  docs/
+FAULT_TOLERANCE.md §Elastic membership has the state diagram.
+
+Env knobs: ``MXTPU_ELASTIC=0`` (kill switch),
+``MXTPU_ELASTIC_RENDEZVOUS_S`` (join window, default 30),
+``MXTPU_ELASTIC_MIN_DP`` (degradation floor, default 1).
+"""
+from __future__ import annotations
+
+from .membership import (Membership, MembershipEvent,
+                         StaleMembershipEpoch, STABLE, RENDEZVOUS,
+                         default_rendezvous_s)
+from .controller import ElasticController, elastic_enabled, min_dp
+
+__all__ = ["Membership", "MembershipEvent", "StaleMembershipEpoch",
+           "ElasticController", "elastic_enabled", "min_dp",
+           "default_rendezvous_s", "elastic_block", "STABLE",
+           "RENDEZVOUS"]
+
+
+def elastic_block(enabled=False, dp=1, membership_epoch=0, transitions=0,
+                  degraded=False, reshard_ms=None, pause_ms=None):
+    """The bench.py ``elastic`` observability block (the ``comm`` /
+    ``serving`` block discipline): static config/counters are always
+    real; MEASURED fields (``reshard_ms``, ``pause_ms``) default to
+    ``None`` — null-when-unmeasured, so a CPU run can never pass off an
+    absent measurement as "resharding is free" (the PR 6 honesty rule,
+    gated by tests/test_bench_line.py)."""
+    def _r(x, n=3):
+        return None if x is None else round(float(x), n)
+
+    return {
+        "enabled": bool(enabled),
+        "dp": int(dp),
+        "membership_epoch": int(membership_epoch),
+        "transitions": int(transitions),
+        "degraded": bool(degraded),
+        "reshard_ms": _r(reshard_ms),
+        "pause_ms": _r(pause_ms),
+    }

@@ -1,0 +1,237 @@
+"""Elastic membership controller (ISSUE 8 tentpole): pause -> reshard
+-> resume at a step boundary, without a process restart.
+
+The pieces existed separately — PR 4 reshards ZeRO-1 optimizer state
+bitwise across dp sizes and the PS layer detects dead workers by
+heartbeat — this closes the loop.  On a committed membership transition
+(a death fed from ``PSServer._scan_dead``, or a join announced through
+the ``_OP_JOIN`` RPC and admitted at the next boundary):
+
+1. **pause** — nothing interrupts a step mid-flight: the training loop
+   (``estimator.fit`` window boundary, or a custom loop calling
+   :meth:`ElasticController.check_step`) hands control over exactly
+   where the PR 4 ``PreemptionHandler`` stop seam sits, so the
+   in-flight step/scan-window always completes first;
+2. **reshard** — peer-to-peer from the live trainer's state
+   (``checkpoint.reshard_in_place``: per-parameter-space capture ->
+   ``DataParallelTrainer.rebuild(mesh)`` -> restore), because the live
+   state is newer than any checkpoint; retried with bounded backoff; a
+   reshard that dies mid-transfer (``elastic.reshard`` fault point)
+   falls back to ``checkpoint.reshard_from_checkpoint`` — the newest
+   valid checkpoint, with the resume step returned so the loop rewinds;
+3. **resume** — the new mesh / ``BucketPlan`` / compiled steps rebuild
+   lazily on the next step; an attached kvstore's membership epoch is
+   refreshed (collectives fenced by the old epoch are rejected, not
+   deadlocked) and an attached ``OverlapScheduler`` re-observes its
+   backward order.
+
+Degradation policy: a join that outlives its rendezvous window — or a
+joiner that dies mid-rendezvous — is dropped (``Membership.poll``) and
+the job **continues at the smaller dp**; shrinking below
+``MXTPU_ELASTIC_MIN_DP`` raises instead of limping.  All timeout logic
+reads the injectable ``now``/``sleep`` hooks, so every path is
+deterministic under ``testing.faults.FakeClock`` with zero sleeps.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..base import MXNetError
+from .membership import Membership  # noqa: F401  (re-exported surface)
+
+__all__ = ["ElasticController", "elastic_enabled", "min_dp"]
+
+
+def elastic_enabled():
+    """Kill switch: ``MXTPU_ELASTIC=0`` makes every controller inert
+    (``check_step`` returns None without touching the trainer) — the
+    same opt-out semantics as ``MXTPU_FUSED_STEP``/``MXTPU_OVERLAP_COMM``.
+    Default on: constructing a controller IS the opt-in."""
+    return os.environ.get("MXTPU_ELASTIC", "1") != "0"
+
+
+def min_dp():
+    """Degradation floor (``MXTPU_ELASTIC_MIN_DP``, default 1): the
+    smallest dp the controller will shrink to; a transition below it
+    raises instead of continuing with a crippled job."""
+    return int(os.environ.get("MXTPU_ELASTIC_MIN_DP", "1") or 1)
+
+
+class ElasticController:
+    """Drives elastic reshards from membership transitions.
+
+    ``membership``: the :class:`~mxnet_tpu.elastic.Membership` machine
+    (typically also attached to a ``PSServer`` so heartbeat deaths feed
+    it).  ``devices``: the device pool meshes are carved from (default
+    ``jax.devices()``).  ``devices_per_worker``: how many mesh devices
+    each membership rank contributes (default: pool size / initial rank
+    count — the v5e host granularity).  ``checkpoint_manager``: the
+    fallback source when the peer transfer dies.  ``net``: the gluon
+    block whose parameters ride along (required for the checkpoint
+    fallback; the peer path snapshots it too when given).
+
+    ``backoff_s``/``max_retries`` bound the peer-path retry loop;
+    ``now``/``sleep`` are injectable for deterministic tests (a
+    ``FakeClock`` and a no-op make every scenario sleep-free).
+    """
+
+    def __init__(self, membership, devices=None, devices_per_worker=None,
+                 checkpoint_manager=None, net=None, kvstore=None,
+                 scheduler=None, min_dp=None, max_retries=2,
+                 backoff_s=0.5, now=None, sleep=None):
+        import jax
+        self._membership = membership
+        self._devices = list(devices) if devices is not None \
+            else list(jax.devices())
+        n_ranks = max(1, len(membership.ranks))
+        self._dpw = int(devices_per_worker) if devices_per_worker \
+            is not None else max(1, len(self._devices) // n_ranks)
+        self._manager = checkpoint_manager
+        self._net = net
+        self._kvstore = kvstore
+        self._scheduler = scheduler
+        self._min_dp = int(min_dp) if min_dp is not None \
+            else globals()["min_dp"]()
+        self._max_retries = int(max_retries)
+        self._backoff_s = float(backoff_s)
+        self._now = now if now is not None else time.time
+        self._sleep = sleep if sleep is not None else time.sleep
+        self._enabled = elastic_enabled()   # read ONCE at construction
+        self._applied_epoch = membership.epoch
+        # observability (the bench `elastic` block + tests)
+        self.transitions = 0
+        self.degraded = False
+        self.last_pause_ms = None
+        self.last_reshard_ms = None
+        self.last_event = None
+
+    # -- wiring ---------------------------------------------------------
+    def attach_kvstore(self, kvstore):
+        """Fence an eager kvstore's collectives by the membership epoch
+        (``kvstore.attach_membership``) and keep it refreshed across
+        reshards."""
+        kvstore.attach_membership(self._membership)
+        self._kvstore = kvstore
+        return self
+
+    @property
+    def membership(self):
+        return self._membership
+
+    @property
+    def applied_epoch(self):
+        """The membership epoch the running trainer was last built for."""
+        return self._applied_epoch
+
+    def target_dp(self, include_pending=True):
+        """The dp size the current membership implies: ranks (plus an
+        in-rendezvous joiner about to be admitted) x devices-per-worker,
+        capped at the device pool."""
+        n = len(self._membership.ranks)
+        if include_pending and self._membership.pending_join is not None:
+            n += 1
+        return max(1, min(n * self._dpw, len(self._devices)))
+
+    # -- the step-boundary hook -----------------------------------------
+    def pending(self):
+        """True when a transition awaits the next step boundary (epoch
+        moved, or a joiner sits in rendezvous).  Also expires overdue
+        rendezvous — the degrade-to-smaller-dp policy needs no thread of
+        its own."""
+        if not self._enabled:
+            return False
+        if self._membership.poll() is not None:
+            self.degraded = True       # rendezvous expired: continue small
+        return (self._membership.epoch != self._applied_epoch
+                or self._membership.pending_join is not None)
+
+    def check_step(self, step, trainer, params=None):
+        """The pause seam (same contract as
+        ``PreemptionHandler.check_step``): call between steps / at scan
+        -window boundaries.  No transition -> None, O(1).  Otherwise the
+        boundary IS the pause: reshard + resume happen here, and the
+        returned dict tells the loop what happened —
+        ``{"source": "peer", "step": None}`` (continue at the same
+        step) or ``{"source": "checkpoint", "step": S}`` (rewind to S;
+        the RNG came back with the checkpoint, so the replay is
+        bitwise)."""
+        if not self.pending():
+            return None
+        return self.resync(step, trainer, params=params)
+
+    # -- the transition -------------------------------------------------
+    def resync(self, step, trainer, params=None):
+        """Apply the pending membership transition to ``trainer``."""
+        from .. import checkpoint as _ckpt
+        t_pause = time.perf_counter()
+        joiner = self._membership.pending_join
+        new_dp = self.target_dp()
+        if new_dp < self._min_dp:
+            raise MXNetError(
+                f"elastic: membership epoch {self._membership.epoch} "
+                f"implies dp={new_dp}, below the MXTPU_ELASTIC_MIN_DP="
+                f"{self._min_dp} floor — refusing to continue crippled; "
+                f"restore capacity or lower the floor")
+        mesh = self._make_mesh(new_dp)
+        t0 = time.perf_counter()
+        info = None
+        last_err = None
+        for attempt in range(1 + self._max_retries):
+            try:
+                info = _ckpt.reshard_in_place(trainer, mesh,
+                                              params=params or self._net,
+                                              _attempt=attempt)
+                break
+            except MXNetError as e:
+                last_err = e
+                if attempt < self._max_retries:
+                    # bounded exponential backoff before re-trying the
+                    # peer transfer (injectable: tests pass a no-op)
+                    self._sleep(self._backoff_s * (2 ** attempt))
+        if info is None:
+            # peer transfer kept dying (e.g. the source worker itself
+            # went down mid-reshard): recover from the newest valid
+            # checkpoint instead of hanging or crashing the job
+            try:
+                info = _ckpt.reshard_from_checkpoint(
+                    trainer, mesh, params=params or self._net,
+                    manager=self._manager)
+            except MXNetError as e:
+                raise MXNetError(
+                    f"elastic reshard failed on both paths — peer: "
+                    f"{last_err}; checkpoint: {e}") from e
+        if joiner is not None and \
+                self._membership.pending_join == joiner:
+            # state transfer done: commit the join (epoch bump)
+            self._membership.confirm_join(joiner)
+        self._applied_epoch = self._membership.epoch
+        if self._kvstore is not None:
+            self._kvstore.refresh_membership()
+        if self._scheduler is not None:
+            self._scheduler.reset_plan()
+        t1 = time.perf_counter()
+        self.transitions += 1
+        self.last_reshard_ms = round((t1 - t0) * 1e3, 3)
+        self.last_pause_ms = round((t1 - t_pause) * 1e3, 3)
+        info = dict(info, dp=new_dp, epoch=self._applied_epoch,
+                    reshard_ms=self.last_reshard_ms,
+                    pause_ms=self.last_pause_ms)
+        self.last_event = info
+        return info
+
+    def _make_mesh(self, dp):
+        from ..parallel.mesh import make_mesh
+        return make_mesh({"dp": dp}, self._devices[:dp])
+
+    # -- observability ---------------------------------------------------
+    def stats(self):
+        """The bench ``elastic`` block inputs (see
+        :func:`mxnet_tpu.elastic.elastic_block`)."""
+        return {"enabled": self._enabled,
+                "dp": self.target_dp(include_pending=False),
+                "membership_epoch": self._membership.epoch,
+                "transitions": self.transitions,
+                "degraded": self.degraded,
+                "reshard_ms": self.last_reshard_ms,
+                "pause_ms": self.last_pause_ms}

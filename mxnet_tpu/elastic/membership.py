@@ -1,0 +1,256 @@
+"""Epoch-numbered cluster membership state machine (ISSUE 8 tentpole).
+
+The PS layer already *detects* dead workers (heartbeat silence ->
+``PSServer._scan_dead``) and PR 4 made optimizer state dp-independent on
+disk — but nothing closed the loop: a preemption still meant a full job
+restart.  This module is the missing bookkeeping: a deterministic state
+machine over WHO is in the job, numbered by a monotonically increasing
+**membership epoch** that every committed transition (death, join)
+bumps.  The epoch is the fencing token for the whole elastic layer:
+
+- collectives are guarded by it (``kvstore.attach_membership``): a
+  worker still on epoch N when the cluster moved to N+1 gets a clean
+  ``MXNetError`` instead of deadlocking a ring against peers that no
+  longer exist;
+- a worker that rejoins carrying a stale epoch is **rejected** at the
+  announce RPC (``PSServer`` opcode ``_OP_JOIN``) — it must resync
+  state through the controller path, not slide back into the ring;
+- the controller (``elastic.controller``) reshards exactly when its
+  applied epoch falls behind.
+
+Joins are two-phase (announce -> confirm) with a **bounded rendezvous**:
+``announce_join`` parks the candidate as pending; the controller admits
+it at the next step boundary and calls :meth:`confirm_join` after the
+state transfer succeeds.  A candidate that goes silent past
+``rendezvous_s`` (or dies mid-rendezvous) is dropped by :meth:`poll` —
+the job **degrades to the smaller dp** instead of hanging on a flapping
+worker (TensorFlow's dynamic cluster membership treats this as table
+stakes, arXiv:1605.08695; at v5e-256 pod scale churn is the steady
+state, arXiv:2011.03641).
+
+Every timeout decision reads the injectable ``_now`` clock (the PR 4
+``PSServer._now`` discipline), so the whole machine is testable under
+``testing.faults.FakeClock`` with zero sleeps.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["Membership", "MembershipEvent", "StaleMembershipEpoch",
+           "STABLE", "RENDEZVOUS"]
+
+#: states of the machine.  STABLE: ranks are final for this epoch.
+#: RENDEZVOUS: a join was announced and waits for the controller to
+#: transfer state and confirm (bounded by ``rendezvous_s``).
+STABLE, RENDEZVOUS = "stable", "rendezvous"
+
+
+class StaleMembershipEpoch(MXNetError):
+    """A worker announced/acted with an epoch the cluster moved past."""
+
+
+class MembershipEvent:
+    """One committed (or rejected/expired) transition, for observability
+    and tests: ``kind`` in {"death", "join", "announce",
+    "rendezvous_expired", "rendezvous_cancelled"}."""
+
+    __slots__ = ("kind", "rank", "epoch", "time")
+
+    def __init__(self, kind, rank, epoch, time_):
+        self.kind = kind
+        self.rank = int(rank)
+        self.epoch = int(epoch)
+        self.time = float(time_)
+
+    def __repr__(self):
+        return (f"MembershipEvent({self.kind}, rank={self.rank}, "
+                f"epoch={self.epoch})")
+
+
+def default_rendezvous_s():
+    """Join rendezvous window in seconds (``MXTPU_ELASTIC_RENDEZVOUS_S``,
+    default 30): how long an announced joiner may take to finish state
+    transfer before the job stops waiting and continues at the smaller
+    dp."""
+    return float(os.environ.get("MXTPU_ELASTIC_RENDEZVOUS_S", "30") or 30)
+
+
+class Membership:
+    """The membership state machine.  Thread-safe (the PS serve threads
+    and the training thread both touch it); every method is a pure state
+    transition — no sleeps, no sockets — so the PS layer can drive it
+    from heartbeats and tests can drive it directly.
+
+    ``ranks``: the initial live worker ranks.  ``now``: injectable clock
+    (``testing.faults.FakeClock`` in tests).  ``rendezvous_s``: join
+    rendezvous bound (default ``MXTPU_ELASTIC_RENDEZVOUS_S``).
+    """
+
+    def __init__(self, ranks, epoch=0, now=None, rendezvous_s=None):
+        self._lock = threading.Lock()
+        self._ranks = sorted(int(r) for r in ranks)
+        if len(set(self._ranks)) != len(self._ranks):
+            raise MXNetError(f"duplicate ranks in {ranks!r}")
+        self._epoch = int(epoch)
+        self._now = now if now is not None else time.time
+        self._rendezvous_s = (float(rendezvous_s) if rendezvous_s
+                              is not None else default_rendezvous_s())
+        self._pending = None           # (rank, deadline) during RENDEZVOUS
+        self._events = []
+        self._subscribers = []
+
+    # -- views ----------------------------------------------------------
+    @property
+    def epoch(self):
+        with self._lock:
+            return self._epoch
+
+    @property
+    def ranks(self):
+        with self._lock:
+            return tuple(self._ranks)
+
+    @property
+    def state(self):
+        with self._lock:
+            return RENDEZVOUS if self._pending is not None else STABLE
+
+    @property
+    def pending_join(self):
+        """The announced-but-unconfirmed rank, or None."""
+        with self._lock:
+            return self._pending[0] if self._pending is not None else None
+
+    @property
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    def view(self):
+        """JSON-able snapshot (the ``_OP_MEMBERSHIP`` RPC payload)."""
+        with self._lock:
+            return {"epoch": self._epoch, "ranks": list(self._ranks),
+                    "state": (RENDEZVOUS if self._pending is not None
+                              else STABLE),
+                    "pending": (self._pending[0] if self._pending
+                                is not None else None)}
+
+    def subscribe(self, fn):
+        """Call ``fn(event)`` on every committed transition (death/join
+        commit and rendezvous expiry) — the controller's wake-up."""
+        with self._lock:
+            self._subscribers.append(fn)
+
+    # -- transitions ----------------------------------------------------
+    def _emit(self, kind, rank):
+        """Record + fan out one event.  Caller holds the lock; subscriber
+        callbacks run OUTSIDE it (a controller may call back into us)."""
+        ev = MembershipEvent(kind, rank, self._epoch, self._now())
+        self._events.append(ev)
+        subs = list(self._subscribers)
+        return ev, subs
+
+    @staticmethod
+    def _fan_out(ev, subs):
+        for fn in subs:
+            fn(ev)
+
+    def worker_dead(self, rank):
+        """Commit a death (heartbeat silence past the timeout — the
+        ``PSServer._scan_dead`` feed).  Bumps the epoch.  A death of the
+        pending joiner cancels the rendezvous instead (the flapping-
+        worker degrade: the job simply continues at the smaller dp)."""
+        rank = int(rank)
+        with self._lock:
+            if self._pending is not None and self._pending[0] == rank:
+                self._pending = None
+                ev, subs = self._emit("rendezvous_cancelled", rank)
+            elif rank in self._ranks:
+                self._ranks.remove(rank)
+                self._epoch += 1
+                ev, subs = self._emit("death", rank)
+            else:
+                return None            # unknown rank: nothing to commit
+        self._fan_out(ev, subs)
+        return ev
+
+    def announce_join(self, rank, seen_epoch):
+        """Phase 1 of a join: the candidate announces itself with the
+        newest epoch it knows.  A stale epoch is REJECTED (clean typed
+        error — the worker must resync, not resume); an accepted
+        announce parks the candidate as pending until
+        :meth:`confirm_join` (bounded by the rendezvous window).
+        Returns the rendezvous deadline."""
+        rank = int(rank)
+        with self._lock:
+            if int(seen_epoch) < self._epoch:
+                raise StaleMembershipEpoch(
+                    f"join announce from rank {rank} carries stale "
+                    f"membership epoch {int(seen_epoch)} (cluster is at "
+                    f"{self._epoch}): rejected — resync state through "
+                    f"the elastic controller and re-announce with the "
+                    f"current epoch")
+            if rank in self._ranks:
+                raise MXNetError(
+                    f"rank {rank} is already a live member "
+                    f"(epoch {self._epoch})")
+            if self._pending is not None and self._pending[0] != rank:
+                raise MXNetError(
+                    f"rank {self._pending[0]} is already in rendezvous; "
+                    f"one join at a time")
+            deadline = self._now() + self._rendezvous_s
+            self._pending = (rank, deadline)
+            ev, subs = self._emit("announce", rank)
+        self._fan_out(ev, subs)
+        return deadline
+
+    def confirm_join(self, rank):
+        """Phase 2: the controller finished the state transfer — commit
+        the join and bump the epoch."""
+        rank = int(rank)
+        with self._lock:
+            if self._pending is None or self._pending[0] != rank:
+                raise MXNetError(
+                    f"confirm_join({rank}): no matching announced join "
+                    f"(pending: {self._pending})")
+            self._pending = None
+            self._ranks.append(rank)
+            self._ranks.sort()
+            self._epoch += 1
+            ev, subs = self._emit("join", rank)
+        self._fan_out(ev, subs)
+        return ev
+
+    def poll(self):
+        """Expire an overdue rendezvous (no sleeps anywhere: whoever
+        calls — controller boundary check, PS scan — just reads the
+        clock).  Returns the expiry event, or None."""
+        with self._lock:
+            if self._pending is None:
+                return None
+            rank, deadline = self._pending
+            if self._now() <= deadline:
+                return None
+            self._pending = None
+            ev, subs = self._emit("rendezvous_expired", rank)
+        self._fan_out(ev, subs)
+        return ev
+
+    def check_epoch(self, epoch, what="collective"):
+        """Fencing-token check: raise :class:`StaleMembershipEpoch` when
+        ``epoch`` is behind the cluster (the pushpull guard — a stale
+        worker's collective must be *rejected*, never allowed to
+        deadlock a ring against departed peers)."""
+        with self._lock:
+            cur = self._epoch
+        if int(epoch) != cur:
+            raise StaleMembershipEpoch(
+                f"{what} carries membership epoch {int(epoch)} but the "
+                f"cluster is at {cur}: rejected instead of deadlocking "
+                f"— reshard via elastic.ElasticController and "
+                f"refresh_membership()")
+        return cur

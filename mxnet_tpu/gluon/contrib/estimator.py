@@ -255,7 +255,8 @@ class Estimator:
     def fit(self, train_data, val_data=None, epochs=None, event_handlers=None,
             batches=None, resume=None, checkpoint_manager=None,
             checkpoint_every=None, prefetch_to_device=False,
-            prefetch_depth=None, steps_per_call=None):
+            prefetch_depth=None, steps_per_call=None,
+            elastic_controller=None):
         """Train; with ``checkpoint_manager`` the loop is preemption-safe:
 
         - ``checkpoint_every=N`` saves the full training state (params,
@@ -287,6 +288,19 @@ class Estimator:
         in-graph (no ``pred``): use Loss metrics there.  Eager
         ``gluon.Trainer`` loops cannot compile multi-step windows; K>1
         falls back to 1 with a warning.
+
+        ``elastic_controller`` (``mx.elastic.ElasticController``, ISSUE
+        8): the pause/resume hook for elastic membership.  At every
+        step/window boundary — the exact seam the preemption check uses
+        — a pending membership transition (worker death or join)
+        pauses the loop, reshards params + optimizer state to the new
+        dp in place (peer path), and resumes on the next batch with no
+        cursor change.  When the reshard had to fall back to a
+        CHECKPOINT (the peer transfer itself died), the restored state
+        sits at an earlier step: the loop then stops cleanly with
+        ``.preempted`` set — exactly the PR 4 preemption contract — and
+        the caller re-enters ``fit(resume="auto")`` to replay from the
+        restored cursor (bitwise, RNG included).
         """
         import warnings
         from ... import checkpoint as ckpt_mod
@@ -367,11 +381,30 @@ class Estimator:
                                             loss=loss)
                     preempted = preempt is not None and \
                         preempt.check_step(self.global_step)
+                    rewound = False
+                    if elastic_controller is not None and not preempted:
+                        ev = elastic_controller.check_step(
+                            self.global_step, trainer=self.trainer,
+                            params=self.net)
+                        if ev is not None and \
+                                ev.get("source") == "checkpoint":
+                            # the reshard recovered from a checkpoint at
+                            # an EARLIER step: the in-memory cursor is
+                            # now ahead of the state — stop cleanly
+                            # (preemption semantics) so the caller
+                            # re-enters fit(resume="auto") and replays
+                            # from the restored cursor.  No save here:
+                            # the restored checkpoint IS the durable
+                            # state, and this loop's batch cursor no
+                            # longer describes it.
+                            self.global_step = ev["step"]
+                            preempted = True
+                            rewound = True
                     crossed = checkpoint_every and (
                         self.global_step // checkpoint_every
                         > gs_before // checkpoint_every)
-                    if checkpoint_manager is not None and (
-                            preempted or crossed):
+                    if checkpoint_manager is not None and not rewound \
+                            and (preempted or crossed):
                         # the in-flight window is DONE (scan boundary);
                         # a preemption save is synchronous — the process
                         # may be about to die and must not exit with a

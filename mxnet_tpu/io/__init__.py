@@ -126,6 +126,13 @@ class NDArrayIter(DataIter):
         self.last_batch_handle = last_batch_handle
         self.cursor = -batch_size
         self._cache_idx = None
+        # standalone shuffle-cursor restore (PR 4 known gap): keep the
+        # UNSHUFFLED arrays and the per-epoch reshuffle seeds, so
+        # set_state() can rebuild this exact epoch's order in a fresh
+        # process without replaying the global numpy RNG history
+        self._base_data = list(self.data)
+        self._base_label = list(self.label)
+        self._shuffle_seeds = []
         self.reset()
 
     @property
@@ -138,13 +145,25 @@ class NDArrayIter(DataIter):
         return [DataDesc(k, (self.batch_size,) + tuple(v.shape[1:]),
                          str(v.data.dtype)) for k, v in self.label]
 
+    def _apply_shuffle(self, seed):
+        """Apply ONE epoch's permutation, derived from ``seed`` alone —
+        composing with whatever order the arrays already carry (the
+        cumulative in-``reset()`` reshuffle semantics, now replayable)."""
+        idx = _np.random.RandomState(seed).permutation(self.num_data)
+        self.data = [(k, NDArray(v.data[idx])) for k, v in self.data]
+        self.label = [(k, NDArray(v.data[idx])) for k, v in self.label]
+
     def reset(self):
         if self.shuffle:
-            idx = _np.random.permutation(self.num_data)
-            self.data = [(k, NDArray(v.data[idx]))
-                         for k, v in self.data]
-            self.label = [(k, NDArray(v.data[idx]))
-                          for k, v in self.label]
+            # ONE draw from the global stream names this epoch's
+            # permutation; the permutation itself comes from a private
+            # RandomState(seed).  The estimator resume path still
+            # round-trips (checkpointed numpy RNG -> same seed drawn),
+            # and a STANDALONE set_state() can now rebuild the order
+            # from the saved seed list with no RNG replay at all.
+            seed = int(_np.random.randint(0, 2**31 - 1))
+            self._shuffle_seeds.append(seed)
+            self._apply_shuffle(seed)
         if self.last_batch_handle == "roll_over" and \
                 self.cursor > self.num_data:
             self.cursor = -self.batch_size + (self.cursor % self.num_data) % \
@@ -180,12 +199,28 @@ class NDArrayIter(DataIter):
         return 0
 
     def state_dict(self):
-        """Resume cursor: the batch cursor into this epoch's (already
-        shuffled) order.  Shuffle order itself reproduces from the
-        checkpointed numpy RNG state, not from here."""
-        return {"cursor": int(self.cursor)}
+        """Resume cursor: the batch cursor into this epoch's shuffled
+        order PLUS the per-epoch reshuffle seeds — together they make
+        the cursor restorable in a fresh process with any global RNG
+        state (the PR 4 gap: the order used to reproduce only by
+        replaying the checkpointed numpy stream through the estimator's
+        epoch re-entry)."""
+        return {"cursor": int(self.cursor),
+                "shuffle_seeds": list(self._shuffle_seeds)}
 
     def set_state(self, state):
+        seeds = state.get("shuffle_seeds")
+        if seeds is not None and [int(s) for s in seeds] != \
+                self._shuffle_seeds:
+            # rebuild the exact saved order from scratch: base arrays,
+            # then every epoch's permutation in sequence (deterministic
+            # standalone — no dependence on the global numpy stream)
+            self.data = list(self._base_data)
+            self.label = list(self._base_label)
+            self._shuffle_seeds = []
+            for s in seeds:
+                self._shuffle_seeds.append(int(s))
+                self._apply_shuffle(int(s))
         self.cursor = int(state.get("cursor", -self.batch_size))
 
 
@@ -393,6 +428,7 @@ class ImageRecordIter(DataIter):
         self._std = _np.array([std_r, std_g, std_b]).reshape(3, 1, 1)
         self._order = _np.arange(len(self._dataset))
         self._pos = 0
+        self._shuffle_seeds = []   # per-epoch reshuffle seeds (replayable)
         self._path_imgrec = path_imgrec
         self._n_threads = preprocess_threads
         # Native C++ decode+prefetch pipeline (src/prefetch.cc) when the
@@ -415,7 +451,12 @@ class ImageRecordIter(DataIter):
     def reset(self):
         self._pos = 0
         if self._shuffle:
-            _np.random.shuffle(self._order)
+            # same standalone-restorable scheme as NDArrayIter: ONE
+            # global-stream draw names the epoch's permutation, applied
+            # from a private RandomState so set_state can replay it
+            seed = int(_np.random.randint(0, 2**31 - 1))
+            self._shuffle_seeds.append(seed)
+            _np.random.RandomState(seed).shuffle(self._order)
         if self._use_native:
             from ..utils import native as _native
             if self._native_iter is None:
@@ -447,16 +488,26 @@ class ImageRecordIter(DataIter):
         return self._pos + self.batch_size <= len(self._dataset)
 
     def state_dict(self):
-        """Resume cursor: sample position within this epoch's order.
-        The order itself reproduces from the checkpointed numpy RNG
-        (shuffle draws come from ``np.random``)."""
-        return {"pos": int(self._pos)}
+        """Resume cursor: sample position within this epoch's order,
+        plus the per-epoch reshuffle seeds that make the order itself
+        restorable in a fresh process (standalone — no dependence on
+        the global numpy stream history)."""
+        return {"pos": int(self._pos),
+                "shuffle_seeds": list(self._shuffle_seeds)}
 
     def set_state(self, state):
         """Reposition to a :meth:`state_dict` cursor: the next batch
         decoded is the one the interrupted run would have decoded (the
         threaded decode fan-out is rebuilt from the cursor so already-
         consumed samples are not re-decoded)."""
+        seeds = state.get("shuffle_seeds")
+        if seeds is not None and [int(s) for s in seeds] != \
+                self._shuffle_seeds:
+            self._order = _np.arange(len(self._dataset))
+            self._shuffle_seeds = []
+            for s in seeds:
+                self._shuffle_seeds.append(int(s))
+                _np.random.RandomState(int(s)).shuffle(self._order)
         pos = int(state.get("pos", 0))
         if pos % self.batch_size:
             raise MXNetError(

@@ -56,6 +56,36 @@ class KVStore:
         self._updater = None
         self._optimizer = None
         self._compression = None
+        self._membership = None       # elastic.Membership once attached
+        self._member_epoch = None     # this worker's applied epoch
+
+    # -- elastic membership fencing (ISSUE 8) --------------------------
+    def attach_membership(self, membership):
+        """Fence this store's collectives by the cluster's membership
+        epoch (``mx.elastic.Membership``): the worker records the epoch
+        it was built for, and a collective attempted after the cluster
+        moved on raises a clean :class:`StaleMembershipEpoch` instead
+        of entering a ring whose peers died or changed — the classic
+        unrecoverable hang this turns into a recoverable error.  After
+        the controller reshards, :meth:`refresh_membership` re-arms the
+        fence at the new epoch."""
+        self._membership = membership
+        self._member_epoch = membership.epoch
+        return self
+
+    def refresh_membership(self):
+        """Adopt the current membership epoch (call after a controller-
+        led reshard completed on this worker)."""
+        if self._membership is not None:
+            self._member_epoch = self._membership.epoch
+        return self._member_epoch
+
+    def _guard_membership(self):
+        """The pushpull-entry fence: no-op without a membership."""
+        if self._membership is not None:
+            self._membership.check_epoch(
+                self._member_epoch,
+                what=f"{self.type} collective from this worker")
 
     # -- identity ------------------------------------------------------
     @property
@@ -362,6 +392,7 @@ class KVStoreTPUSync(KVStoreLocal):
 
         Returns the shard (traced) / full value (eager) NDArray, or the
         list of them for a key list."""
+        self._guard_membership()
         keys, values = self._canon(key, value)
         if not _contains_tracer(values):
             outs = [NDArray(jnp.zeros_like(_listify(v)[0].data))
@@ -421,6 +452,7 @@ class KVStoreTPUSync(KVStoreLocal):
             self._traced_store[str(k)] = self._ingraph_reduce(red.data)
 
     def push(self, key, value, priority=0):
+        self._guard_membership()
         keys, values = self._canon(key, value)
         if _contains_tracer(values):
             return self._push_traced(keys, values)
@@ -455,6 +487,7 @@ class KVStoreTPUSync(KVStoreLocal):
         dispatch cliff acknowledged in the module docstring).  Traced
         values (in-graph psum), updater-on-store, and sparse values
         keep the exact push/pull composition."""
+        self._guard_membership()
         keys, values = self._canon(key, value)
         if _contains_tracer(values) or self._updater is not None:
             return super().pushpull(key, value, out=out, priority=priority)
@@ -570,6 +603,7 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
                 self._store[str(k)]._set_data(r)
 
     def push(self, key, value, priority=0):
+        self._guard_membership()
         keys, values = self._canon(key, value)
         if _contains_tracer(values):
             # inside a jitted step: stay in-graph as a psum over the global
@@ -587,6 +621,7 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
         the store/out writes happen in a single pass — push-then-pull
         paid a second dispatch round just to copy the stored values out.
         Traced values and updater-on-kvstore keep the composition."""
+        self._guard_membership()
         keys, values = self._canon(key, value)
         if _contains_tracer(values) or self._updater is not None:
             return KVStore.pushpull(self, key, value, out=out,

@@ -58,6 +58,7 @@ _HDR = struct.Struct("<Q")
 _OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
     _OP_SHUTDOWN, _OP_CMD, _OP_CMDLOG = 1, 2, 3, 4, 5, 6, 7, 8, 9
 _OP_HEARTBEAT, _OP_HEALTH = 10, 11
+_OP_JOIN, _OP_MEMBERSHIP = 12, 13   # elastic membership (ISSUE 8)
 # opcodes (replies)
 _OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
 
@@ -246,6 +247,7 @@ class PSServer:
                                   # FakeClock instead of real sleeps
         self._last_seen = {}      # rank -> last heartbeat time
         self._dead = {}           # rank -> time declared dead
+        self._membership = None   # elastic.Membership once attached
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -288,6 +290,14 @@ class PSServer:
                   f"no heartbeat for {age:.1f}s "
                   f"(timeout {self._hb_timeout:.1f}s); dist_async "
                   f"continues with the remaining workers", flush=True)
+        if self._membership is not None:
+            # close the elastic loop: a detected death is a committed
+            # membership transition (epoch bump) the controller reshards
+            # on at its next step boundary.  Outside self._lock — the
+            # membership fans out to subscriber callbacks.
+            for rank, _ in newly_dead:
+                self._membership.worker_dead(rank)
+            self._membership.poll()     # expire an overdue rendezvous
         if newly_dead:
             with self._barrier_cv:
                 self._barrier_cv.notify_all()
@@ -296,6 +306,16 @@ class PSServer:
     def dead_workers(self):
         with self._lock:
             return sorted(self._dead)
+
+    def attach_membership(self, membership):
+        """Wire an :class:`~mxnet_tpu.elastic.Membership` into the
+        heartbeat path: deaths detected by :meth:`_scan_dead` commit
+        membership transitions, and the ``_OP_JOIN`` /
+        ``_OP_MEMBERSHIP`` RPCs become live (a join announce with a
+        stale epoch is rejected with a clean error instead of being
+        silently readmitted).  Returns the server for chaining."""
+        self._membership = membership
+        return self
 
     def _accept_loop(self):
         while True:
@@ -448,6 +468,37 @@ class PSServer:
                           "num_workers": self._num_workers}
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
                 json.dumps(health)))
+        elif op == _OP_JOIN:
+            # elastic join/announce (ISSUE 8): the worker presents the
+            # newest membership epoch it knows.  Stale epoch -> typed
+            # rejection (the _serve loop turns the raise into _OP_ERR);
+            # accepted -> the candidate parks in rendezvous until the
+            # controller transfers state and confirms.  Also counts as
+            # a heartbeat — an announced joiner is by definition alive.
+            (rank,) = struct.unpack_from("<i", frame, off)
+            (epoch,) = struct.unpack_from("<q", frame, off + 4)
+            if self._membership is None:
+                _send_frame(conn, bytes([_OP_ERR]) + _pack_text(
+                    "no membership attached: this server does not run "
+                    "elastic membership (attach_membership)"))
+                return False
+            deadline = self._membership.announce_join(rank, epoch)
+            with self._lock:
+                self._last_seen[rank] = self._now()
+                self._dead.pop(rank, None)
+            view = self._membership.view()
+            view["rendezvous_deadline"] = deadline
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
+                json.dumps(view)))
+        elif op == _OP_MEMBERSHIP:
+            if self._membership is None:
+                view = {"epoch": None, "ranks": [], "state": None,
+                        "pending": None}
+            else:
+                self._membership.poll()
+                view = self._membership.view()
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
+                json.dumps(view)))
         elif op == _OP_SHUTDOWN:
             _send_frame(conn, bytes([_OP_OK]))
             self._sock.close()
@@ -550,6 +601,21 @@ class PSClient:
 
     def barrier(self):
         return self._rpc(bytes([_OP_BARRIER]))
+
+    def join(self, rank, epoch):
+        """Announce this worker as a joiner carrying the newest
+        membership ``epoch`` it knows (elastic membership, ISSUE 8).
+        Returns the membership view (incl. the rendezvous deadline);
+        raises the server's typed rejection when the epoch is stale —
+        the worker must resync through the controller, not rejoin the
+        ring directly."""
+        return self._rpc(bytes([_OP_JOIN]) + struct.pack("<i", int(rank))
+                         + struct.pack("<q", int(epoch)))
+
+    def membership(self):
+        """The server's membership view: {epoch, ranks, state, pending}
+        (epoch None when the server runs without elastic membership)."""
+        return self._rpc(bytes([_OP_MEMBERSHIP]))
 
     def health(self):
         """Server's liveness view: {alive: {rank: age_s}, dead: [ranks],

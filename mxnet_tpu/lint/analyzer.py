@@ -52,6 +52,20 @@ _HOST_RNG_ROOTS = {"np", "numpy", "_np", "onp"}
 # host process-control calls that must never live in a forward (HB08)
 _SIGNAL_CALLS = {"signal.signal", "signal.raise_signal", "signal.alarm",
                  "os.kill", "os.killpg"}
+# world-size reads that bake the dp size into a trace (HB12): the call
+# forms; the mesh-attribute forms are matched structurally in ev()
+_WORLD_SIZE_CALLS = {"device_count", "local_device_count",
+                     "process_count"}
+_DEVICE_LIST_CALLS = {"jax.devices", "jax.local_devices"}
+
+
+def _mesh_receiver(node):
+    """True when an attribute chain's receiver names a mesh binding
+    (``mesh``, ``self.mesh``, ``self._mesh``, ``tp_mesh`` ...) — the
+    HB12 mesh-size-read heuristic."""
+    dotted = _dotted(node)
+    return bool(dotted) and any("mesh" in part.lower()
+                                for part in dotted.split("."))
 
 
 class _Taint:
@@ -226,8 +240,28 @@ class _FunctionAnalyzer(ast.NodeVisitor):
                 return _NONE           # static shape/dtype metadata
             if base.tensor:
                 return _TENSOR         # x.T and friends
+            if node.attr == "size" and _mesh_receiver(node.value):
+                self._report(
+                    "HB12", node,
+                    "mesh size read inside a traced forward: the world "
+                    "size is baked into the compiled program and goes "
+                    "silently stale after an elastic reshard "
+                    "(mx.elastic); capture it in __init__ and rebuild "
+                    "on reshard")
             return _Taint(host=base.host)
         if isinstance(node, ast.Subscript):
+            if isinstance(node.value, ast.Attribute) and \
+                    node.value.attr in ("shape", "axis_sizes") and \
+                    _mesh_receiver(node.value.value):
+                self._report(
+                    "HB12", node,
+                    "mesh axis size read (`mesh.shape[...]`) inside a "
+                    "traced forward: the world size is baked into the "
+                    "compiled program and goes silently stale after an "
+                    "elastic reshard (mx.elastic); capture it in "
+                    "__init__ and rebuild on reshard")
+                self.ev(node.slice)
+                return _NONE
             base = self.ev(node.value)
             idx = self.ev(node.slice)
             if base.tensor:
@@ -391,6 +425,15 @@ class _FunctionAnalyzer(ast.NodeVisitor):
                     "it in __init__")
                 self._arg_taints(node)
                 return _TENSOR
+            if fname in _WORLD_SIZE_CALLS:
+                self._report(
+                    "HB12", node,
+                    f"`{fname}()` inside a traced forward bakes the "
+                    "world size into the compiled program — silently "
+                    "stale after an elastic reshard (mx.elastic); "
+                    "capture it in __init__ and rebuild on reshard")
+                self._arg_taints(node)
+                return _NONE
             if fname in self.index.rng_names:
                 self._report(
                     "HB05", node,
@@ -448,6 +491,22 @@ class _FunctionAnalyzer(ast.NodeVisitor):
                     "pure")
                 self._arg_taints(node)
                 return _NONE
+            if parts[-1] in _WORLD_SIZE_CALLS or \
+                    dotted in _DEVICE_LIST_CALLS or \
+                    (parts[-1] == "devices" and _mesh_receiver(recv)):
+                self._report(
+                    "HB12", node,
+                    f"`{dotted}()` inside a traced forward bakes the "
+                    "world size into the compiled program — after an "
+                    "elastic reshard (mx.elastic, dp changed mid-run) "
+                    "every cached graph silently computes with the OLD "
+                    "size; capture it in __init__ and rebuild on "
+                    "reshard, or derive it in-graph (lax.psum over the "
+                    "axis)")
+                self._arg_taints(node)
+                return _CONTAINER if parts[-1] in ("devices",
+                                                   "local_devices") \
+                    else _NONE
 
         recv_taint = self.ev(recv)
 

@@ -170,6 +170,24 @@ RULES = {
         "for window in prefetcher.windows(k):\n"
         "    losses = trainer.step_multi(window)\n"
         "    total += losses.asnumpy().sum()  # ONE boundary sync"),
+    "HB12": Rule(
+        "HB12", "world-size-read-in-forward",
+        "`jax.device_count()` / `jax.devices()` / mesh-size reads "
+        "(`mesh.shape[...]`, `mesh.size`) inside a hybridized forward: "
+        "the world size is a trace-time Python int, so it is BAKED into "
+        "the compiled program — after an elastic reshard "
+        "(mx.elastic, dp changed mid-run) every cached graph silently "
+        "computes with the OLD world size (wrong loss scaling, wrong "
+        "shard math) instead of failing. Capture the size in __init__ "
+        "and let the controller rebuild the block on reshard, or "
+        "derive it in-graph (lax.psum of ones over the axis).",
+        "def hybrid_forward(self, F, x):\n"
+        "    return x / jax.device_count()   # baked in; stale after\n"
+        "                                    # an elastic reshard",
+        "# __init__: self._dp = dp   (trainer.rebuild() re-creates\n"
+        "#           the graph with the new size after a reshard)\n"
+        "def hybrid_forward(self, F, x):\n"
+        "    return x / self._dp"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

@@ -77,7 +77,10 @@ class DataParallelTrainer:
         # ZeRO-1 sharded gradient sync (see module docstring). Resolved
         # lazily in _zero1_active(): needs the optimizer rule (elementwise
         # kernels only) and the parameter shard specs (pure-dp only).
-        self._shard_updates = bool(shard_updates) and \
+        # The raw request survives separately so rebuild() can re-derive
+        # the effective flag for a different world size (dp may cross 1).
+        self._shard_requested = bool(shard_updates)
+        self._shard_updates = self._shard_requested and \
             self.mesh.shape.get("dp", 1) > 1
         self._zero1 = None              # tri-state; resolved lazily
         self._plan = None               # zero.BucketPlan once params known
@@ -880,6 +883,38 @@ class DataParallelTrainer:
         for p, v in zip(params, new_params):
             p._data._set_data(v)
         return NDArray(loss)
+
+    # -- elastic membership (mx.elastic, ISSUE 8) -----------------------
+    def rebuild(self, mesh):
+        """Adopt a new mesh **in place** — the trainer half of an
+        elastic reshard (``checkpoint.reshard_in_place`` drives the full
+        save-state / rebuild / restore-state sequence).
+
+        Everything derived from the old world size is dropped: the
+        ZeRO-1 resolution and :class:`~mxnet_tpu.parallel.zero.BucketPlan`
+        (bucket padding divides the dp size, so the plan cannot
+        survive), every compiled step (jit caches — the traced programs
+        bake the old mesh), and the device-resident params/optimizer
+        state (sharded over devices that may no longer be in the mesh).
+        Parameters stay in the block and are re-placed on first use;
+        optimizer state does NOT survive — reload it via
+        :meth:`load_state_dict` (its on-disk/per-parameter form is
+        dp-independent by PR 4 design, so any source dp reshards
+        bitwise).  The update-counter and lr schedule state are host
+        scalars and carry over untouched."""
+        self.mesh = mesh
+        self._shard_updates = self._shard_requested and \
+            mesh.shape.get("dp", 1) > 1
+        self._zero1 = None
+        self._plan = None
+        self._jitted = None
+        self._jitted_indexed = None
+        self._jit_accum_cache = {}
+        self._jit_multi_cache = {}
+        self._jit_zero1_cache = {}
+        self._param_vals = None
+        self._opt_state = None
+        return self
 
     # -- checkpoint protocol (mx.checkpoint.CheckpointManager) ----------
     def _require_params(self):

@@ -142,6 +142,21 @@ class OverlapScheduler:
             h.remove()
         self._handles = []
 
+    def reset_plan(self):
+        """Forget the observed backward order and the bucket plan (the
+        elastic-reshard hook: after a world-size change the kvstore ring
+        and the profitable bucket layout both changed).  The next cycle
+        re-observes and dispatches monolithically from ``finish()``,
+        exactly like the first cycle after ``install()``."""
+        self._plan = None
+        self._observed = []
+        self._observed_set = set()
+        self._param_bucket = {}
+        self._remaining = []
+        self._launched = set()
+        self._tail = None
+        self._fired = {i: 0 for i in self._idxs}
+
     def _make_hook(self, i):
         def hook(arr):
             self._on_ready(i)

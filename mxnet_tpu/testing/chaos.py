@@ -18,6 +18,28 @@ acceptance bar demands — in one process, deterministically:
 Runs the scenario twice: plain ``gluon.Trainer`` and
 ``DataParallelTrainer(shard_updates=True)``.  Prints one JSON verdict
 line; exit code 0 only if every check passed.
+
+``python -m mxnet_tpu.testing.chaos elastic`` (or ``tools/
+tpu_queue_runner.py --chaos elastic``) runs the ELASTIC MEMBERSHIP
+scenarios instead (ISSUE 8) — kill/join workers mid-run and demand
+bitwise continuation parity, all on the simulated 8-device CPU mesh
+with a ``FakeClock`` (zero sleeps):
+
+- ``shrink``  — PS heartbeats stop for worker 1 at step K; the server's
+  ``_scan_dead`` commits the death into the membership, the controller
+  pauses at the boundary, reshards dp 8 -> 4 peer-to-peer, resumes.
+  Final fp32 params + optimizer state must be BITWISE a fresh dp=4
+  process restored from the same boundary state.
+- ``grow``    — worker 1 announces a join at step K' (epoch-checked),
+  the controller admits it at the boundary: dp 4 -> 8, same parity bar
+  against a fresh dp=8 process.
+- ``reshard_fault`` — the death fires at K but the peer transfer
+  itself is killed (``elastic.reshard`` fault point, every retry): the
+  controller falls back to the newest valid checkpoint, training
+  rewinds to its step and replays at dp=4 — parity against a fresh
+  process restored from that same checkpoint.
+
+``python -m mxnet_tpu.testing.chaos all`` runs both suites.
 """
 from __future__ import annotations
 
@@ -193,6 +215,212 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     return result
 
 
+# ----------------------------------------------------------------------
+# Elastic membership scenarios (ISSUE 8): kill-at-K / join-at-K' with
+# bitwise continuation parity, deterministic on the CPU mesh (FakeClock,
+# no sleeps).
+# ----------------------------------------------------------------------
+
+def _build_elastic(mesh, seed=1234, dout=4):
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    mx.random.seed(seed)
+    _np.random.seed(seed)
+    net = gluon.nn.Dense(dout)
+    net.initialize()
+    trainer = parallel.DataParallelTrainer(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.05},
+        mesh=mesh, shard_updates=True)
+    return net, trainer
+
+
+def _capture_boundary(net, trainer):
+    """Host snapshot of EXACTLY what a fresh process would restore from
+    a checkpoint of this instant: params, per-parameter-space optimizer
+    state, and both RNG streams."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import _rng_state
+    sd = trainer.state_dict()
+    rng_arrays, rng_meta = _rng_state()
+    return {
+        "params": {n: p.data().asnumpy().copy() for n, p
+                   in net._collect_params_with_prefix().items()},
+        "sd": {"arrays": {k: mx.nd.array(v.asnumpy())
+                          for k, v in sd["arrays"].items()},
+               "meta": dict(sd["meta"])},
+        "rng": ({k: mx.nd.array(v.asnumpy())
+                 for k, v in rng_arrays.items()}, dict(rng_meta)),
+    }
+
+
+def _restore_boundary(net, trainer, snap):
+    import mxnet_tpu as mx
+    from mxnet_tpu.checkpoint import _restore_rng
+    net(mx.nd.array(_np.zeros((1, 8), _np.float32)))   # resolve shapes
+    target = net._collect_params_with_prefix()
+    for n, v in snap["params"].items():
+        target[n].set_data(v)
+    trainer.load_state_dict(snap["sd"])
+    _restore_rng(*snap["rng"])
+
+
+def _final_state(net, trainer):
+    return ({n: p.data().asnumpy() for n, p
+             in net._collect_params_with_prefix().items()},
+            {k: v.asnumpy() for k, v in trainer.state_dict()
+             ["arrays"].items()})
+
+
+def _deliver_ps_death(membership, clock, dead_rank=1, num_workers=2):
+    """Close the loop THROUGH the PS heartbeat path (not a direct state
+    poke): spin a PSServer on the FakeClock, beat both ranks, drop the
+    victim's beats, advance past the timeout, and let ``_scan_dead``
+    commit the death into the membership."""
+    import socket
+    from mxnet_tpu.kvstore.ps_server import PSServer, PSClient
+    from mxnet_tpu.testing import faults
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = PSServer("127.0.0.1", port, num_workers=num_workers,
+                   heartbeat_timeout=5.0)
+    srv._now = clock
+    srv.attach_membership(membership)
+    clients = [PSClient("127.0.0.1", port) for _ in range(num_workers)]
+    try:
+        for r, c in enumerate(clients):
+            c.beat_once(r)
+        clock.advance(3.0)
+        for r, c in enumerate(clients):
+            if r == dead_rank:
+                with faults.inject("ps.heartbeat.drop", action="drop"):
+                    assert not c.beat_once(r)
+            else:
+                c.beat_once(r)
+        clock.advance(3.0)      # victim silent past the 5 s timeout
+        return srv._scan_dead()
+    finally:
+        for c in clients:
+            c.close()
+        srv._sock.close()
+
+
+def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
+                         workdir=None):
+    """One elastic membership scenario; see the module docstring for
+    the three kinds.  Deterministic: FakeClock, no sleeps, bitwise
+    parity asserted against a fresh-process reference."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.testing import faults
+    import jax
+
+    devices = jax.devices()
+    dpw = 4
+    ranks = [0] if kind == "grow" else [0, 1]
+    dp0 = dpw * len(ranks)
+    dp1 = dp0 // 2 if kind != "grow" else dp0 * 2
+    clock = faults.FakeClock(1000.0)
+    membership = elastic.Membership(ranks, now=clock, rendezvous_s=30)
+    mgr = None
+    if workdir is not None:
+        mgr = CheckpointManager(
+            os.path.join(workdir, f"elastic-{kind}"), keep=5)
+    xs, ys = _make_data(77, n_batches=total_steps, batch=16)
+    net, trainer = _build_elastic(make_mesh({"dp": dp0},
+                                            devices[:dp0]))
+    controller = elastic.ElasticController(
+        membership, devices=devices, devices_per_worker=dpw,
+        checkpoint_manager=mgr, net=net, backoff_s=0.0,
+        now=clock, sleep=lambda s: None)
+    result = {"kind": kind, "dp_before": dp0, "dp_after": dp1,
+              "event_at": event_at, "total_steps": total_steps}
+
+    snap = None
+    ckpt_step = None
+    events = []
+    step = 0
+    fault_ctx = None
+    try:
+        while step < total_steps:
+            trainer.step(mx.nd.array(xs[step]), mx.nd.array(ys[step]))
+            step += 1
+            if kind == "reshard_fault" and mgr is not None and \
+                    step % 2 == 0 and snap is None:
+                # pre-event cadence: checkpoints land on EVEN steps, so
+                # the fallback genuinely rewinds (event_at is odd)
+                mgr.save(step, params=net, trainer=trainer,
+                         iterator={"batch": step}, sync=True)
+                ckpt_step = step
+            if step == event_at and snap is None:
+                if kind == "reshard_fault":
+                    # the fallback restores the newest checkpoint; the
+                    # reference must restore the SAME instant
+                    snap = {"from_checkpoint": True}
+                else:
+                    snap = _capture_boundary(net, trainer)
+                if kind == "grow":
+                    membership.announce_join(1, membership.epoch)
+                else:
+                    dead = _deliver_ps_death(membership, clock)
+                    result["ps_declared_dead"] = dead
+                if kind == "reshard_fault":
+                    # every peer attempt (incl. retries) dies mid-
+                    # transfer -> checkpoint fallback
+                    fault_ctx = faults.inject("elastic.reshard")
+                    fault_ctx.__enter__()
+            ev = controller.check_step(step, trainer, params=net)
+            if ev is not None:
+                events.append({k: ev[k] for k in
+                               ("source", "step", "dp", "epoch")})
+                if fault_ctx is not None:
+                    fault_ctx.__exit__(None, None, None)
+                    fault_ctx = None
+                if ev["source"] == "checkpoint":
+                    result["rewound_to"] = ev["step"]
+                    step = ev["step"]
+    finally:
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
+    params_a, state_a = _final_state(net, trainer)
+    result["events"] = events
+    result["membership_epoch"] = membership.epoch
+    result["final_dp"] = trainer.mesh.shape["dp"]
+
+    # reference: a FRESH process at the new dp restored from the same
+    # state the reshard moved (boundary snapshot or the fallback
+    # checkpoint), replaying the remaining steps
+    ref_net, ref_trainer = _build_elastic(
+        make_mesh({"dp": dp1}, devices[:dp1]), seed=4321)
+    if kind == "reshard_fault":
+        ref_net(mx.nd.array(xs[0]))
+        manifest = mgr.restore(step=ckpt_step, params=ref_net,
+                               trainer=ref_trainer)
+        start = int(manifest["step"])
+    else:
+        _restore_boundary(ref_net, ref_trainer, snap)
+        start = event_at
+    for i in range(start, total_steps):
+        ref_trainer.step(mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+    params_b, state_b = _final_state(ref_net, ref_trainer)
+
+    result["params_bitwise"] = _bitwise(params_a, params_b)
+    result["state_bitwise"] = _bitwise(state_a, state_b)
+    checks = [result["params_bitwise"], result["state_bitwise"],
+              result["final_dp"] == dp1,
+              membership.epoch >= 1, len(events) == 1]
+    if kind == "reshard_fault":
+        checks.append(events[0]["source"] == "checkpoint")
+        checks.append(result.get("rewound_to") == ckpt_step)
+    else:
+        checks.append(events[0]["source"] == "peer")
+    result["ok"] = bool(all(checks))
+    return result
+
+
 def main(argv=None):
     # the smoke must run anywhere — force the simulated CPU mesh exactly
     # like tests/conftest.py does
@@ -201,17 +429,24 @@ def main(argv=None):
     if "xla_force_host_platform_device_count" not in flags:
         os.environ["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
+    argv = list(sys.argv[1:] if argv is None else argv)
+    suite = argv[0] if argv else "preempt"
     workdir = tempfile.mkdtemp(prefix="mxtpu-chaos-")
+    results = []
     try:
-        results = [run_scenario(mode, workdir=workdir)
-                   for mode in ("plain", "sharded")]
-        # ISSUE 6: resume from the (non-K-aligned) surviving checkpoint
-        # with K=4 multi-step windows — must still match K=1 bitwise
-        results.append(run_scenario("sharded", workdir=workdir,
-                                    resume_steps_per_call=4))
+        if suite in ("preempt", "all"):
+            results += [run_scenario(mode, workdir=workdir)
+                        for mode in ("plain", "sharded")]
+            # ISSUE 6: resume from the (non-K-aligned) surviving
+            # checkpoint with K=4 multi-step windows — still bitwise K=1
+            results.append(run_scenario("sharded", workdir=workdir,
+                                        resume_steps_per_call=4))
+        if suite in ("elastic", "all"):
+            results += [run_elastic_scenario(kind, workdir=workdir)
+                        for kind in ("shrink", "grow", "reshard_fault")]
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
-    ok = all(r["ok"] for r in results)
+    ok = bool(results) and all(r["ok"] for r in results)
     print(json.dumps({"chaos": results, "ok": ok}))
     return 0 if ok else 1
 

@@ -37,6 +37,10 @@ site                        payload / effect
 ``ps.heartbeat.drop``       heartbeat send suppressed (silent worker)
 ``train.step``              global step index; ``mode=preempt`` delivers a
                             simulated preemption signal at step K
+``elastic.reshard``         attempt index during an elastic reshard's
+                            peer-to-peer state transfer; raise -> the
+                            transfer dies mid-flight and the controller
+                            falls back to the newest valid checkpoint
 ==========================  ===============================================
 """
 from __future__ import annotations

@@ -372,3 +372,64 @@ def test_serving_nulls_stay_out_of_headline():
     assert "serve_tok_s" not in obj
     assert "serve_p99_ms" not in obj
     assert "serve_occupancy" not in obj
+
+
+# ----------------------------------------------------------------------
+# the `elastic` block schema (ISSUE 8): config/counters always real,
+# measured transition timings null-when-unmeasured — a CPU run can't
+# pass off an absent measurement as "resharding is free"
+# ----------------------------------------------------------------------
+
+_ELASTIC_KEYS = {
+    "enabled", "dp", "membership_epoch", "transitions", "degraded",
+    "reshard_ms", "pause_ms",
+}
+
+
+def test_elastic_block_schema_is_stable():
+    from mxnet_tpu.elastic import elastic_block
+    blk = elastic_block()
+    assert set(blk) == _ELASTIC_KEYS
+    for k in ("reshard_ms", "pause_ms"):
+        assert blk[k] is None, k
+    assert blk["enabled"] is False and blk["transitions"] == 0
+    blk2 = elastic_block(enabled=True, dp=4, membership_epoch=2,
+                         transitions=1, reshard_ms=73.7777,
+                         pause_ms=74.1234)
+    assert blk2["reshard_ms"] == 73.778
+    assert blk2["pause_ms"] == 74.123
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_bench_elastic_on_cpu_is_nulls_not_zeros():
+    """bench.py's elastic block on a CPU host: the measured transition
+    timings stay null (the bitwise correctness evidence lives in the
+    tier-1 chaos elastic suite, not in fake bench numbers)."""
+    import jax
+    if jax.devices()[0].platform != "cpu":
+        return
+    blk = bench._bench_elastic()
+    assert blk["reshard_ms"] is None
+    assert blk["pause_ms"] is None
+    assert "note" in blk
+
+
+def test_elastic_compact_keys_surface_when_measured():
+    from mxnet_tpu.elastic import elastic_block
+    p = _success_payload()
+    p["extra"]["elastic"] = elastic_block(
+        enabled=True, dp=4, membership_epoch=2, transitions=1,
+        reshard_ms=73.8, pause_ms=74.1)
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["elastic_reshard_ms"] == 73.8
+    assert obj["elastic_pause_ms"] == 74.1
+    assert obj["elastic_epoch"] == 2
+
+
+def test_elastic_nulls_stay_out_of_headline():
+    from mxnet_tpu.elastic import elastic_block
+    p = _success_payload()
+    p["extra"]["elastic"] = elastic_block(enabled=True, dp=8)
+    obj = json.loads(bench._compact_line(p))
+    assert "elastic_reshard_ms" not in obj
+    assert "elastic_pause_ms" not in obj

@@ -825,3 +825,83 @@ def test_hb11_package_is_clean():
     viol, n_files = lint_paths([pkg], rules={"HB11"})
     assert viol == []
     assert n_files > 50
+
+
+# ----------------------------------------------------------------------
+# HB12 — world-size read captured inside a hybridized forward (ISSUE 8)
+# ----------------------------------------------------------------------
+
+def test_hb12_device_count_and_mesh_reads_flagged():
+    out = lint_source(textwrap.dedent("""
+        class Scaler(HybridBlock):
+            def hybrid_forward(self, F, x):
+                n = jax.device_count()
+                m = self.mesh.shape["dp"]
+                k = len(jax.devices())
+                s = self.mesh.size
+                return x / n
+    """), path="<hb12>")
+    assert [v.rule for v in out] == ["HB12"] * 4
+    assert "baked" in out[0].message or "bakes" in out[0].message
+    assert "elastic" in out[0].message
+
+
+def test_hb12_bare_import_and_local_device_count_flagged():
+    out = lint_source(textwrap.dedent("""
+        from jax import device_count
+        class Norm(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return x * device_count() + jax.local_device_count()
+    """), path="<hb12>")
+    assert [v.rule for v in out] == ["HB12", "HB12"]
+
+
+def test_hb12_init_capture_and_outside_forward_are_clean():
+    # the SUPPORTED shapes: capture in __init__ (the controller rebuilds
+    # the block on reshard), and world-size reads in plain setup code
+    out = lint_source(textwrap.dedent("""
+        class Scaler(HybridBlock):
+            def __init__(self, dp):
+                self._dp = dp
+            def hybrid_forward(self, F, x):
+                return x / self._dp
+
+        def make_trainer():
+            n = jax.device_count()          # setup code: fine
+            mesh = make_mesh({"dp": n})
+            return n, mesh.shape["dp"]      # outside a forward: fine
+    """), path="<hb12>")
+    assert out == []
+
+
+def test_hb12_tensor_shape_reads_stay_clean():
+    # x.shape / x.size are static per-trace metadata, not world size
+    out = lint_source(textwrap.dedent("""
+        class Meta(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return x.reshape(x.shape[0], -1) / x.size
+    """), path="<hb12>")
+    assert out == []
+
+
+def test_hb12_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB12" in RULES
+    assert RULES["HB12"].bad and RULES["HB12"].good
+    out = lint_source(textwrap.dedent("""
+        class Scaler(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return x / jax.device_count()  # mxlint: disable=HB12
+    """), path="<hb12>")
+    assert out == []
+
+
+def test_hb12_package_is_clean():
+    """No forward in the framework may bake the world size into its
+    trace — the elastic reshard path depends on it."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB12"})
+    assert viol == [], [f"{v.path}:{v.line}" for v in viol]
+    assert n_files > 50

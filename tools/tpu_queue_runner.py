@@ -482,21 +482,25 @@ def step_bert128(st: dict) -> None:
     _save_state(st)
 
 
-def run_chaos() -> int:
-    """``--chaos``: the fault-tolerance smoke (mxnet_tpu.testing.chaos)
-    in a child process on the simulated CPU mesh — kill the checkpoint
-    writer, preempt at step K, corrupt the newest checkpoint, auto-
-    resume, and demand bitwise parity with an uninterrupted run.  Needs
-    no TPU and takes no queue lock: safe to run any time, including
-    while the measurement queue owns the chip."""
+def run_chaos(suite: str = "preempt") -> int:
+    """``--chaos [elastic|all]``: the fault-tolerance smoke
+    (mxnet_tpu.testing.chaos) in a child process on the simulated CPU
+    mesh.  Default suite: kill the checkpoint writer, preempt at step
+    K, corrupt the newest checkpoint, auto-resume, bitwise parity.
+    ``elastic`` (ISSUE 8): kill worker 1 at step K via silent
+    heartbeats, join a replacement at K', kill a reshard mid-transfer —
+    each continuing WITHOUT a restart and bitwise-matching a fresh
+    process restored from the same state.  Needs no TPU and takes no
+    queue lock: safe to run any time, including while the measurement
+    queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
             flags + " --xla_force_host_platform_device_count=8").strip()
-    _log("chaos smoke: starting (CPU mesh, ~1 min)")
+    _log(f"chaos smoke [{suite}]: starting (CPU mesh, ~1 min)")
     r = subprocess.run(
-        [sys.executable, "-m", "mxnet_tpu.testing.chaos"],
+        [sys.executable, "-m", "mxnet_tpu.testing.chaos", suite],
         env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
     verdicts = _json_lines(r.stdout)
     if r.returncode == 0 and verdicts and verdicts[-1].get("ok"):
@@ -537,8 +541,12 @@ def _acquire_lock() -> bool:
 
 
 def main() -> int:
-    if "--chaos" in sys.argv[1:]:
-        return run_chaos()
+    args = sys.argv[1:]
+    if "--chaos" in args:
+        after = args[args.index("--chaos") + 1:]
+        suite = after[0] if after and not after[0].startswith("--") \
+            else "preempt"
+        return run_chaos(suite)
     os.makedirs(QDIR, exist_ok=True)
     if not _acquire_lock():
         return 1
