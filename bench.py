@@ -750,7 +750,7 @@ def _bench_elastic() -> dict:
     (``tools/tpu_queue_runner.py --chaos elastic``).  On a multi-chip
     TPU host the transition is measured for real."""
     import jax
-    from mxnet_tpu import elastic
+    from mxnet_tpu import elastic, telemetry
     devices = jax.devices()
     n = len(devices)
     if devices[0].platform == "cpu" or n < 2 or n % 2:
@@ -781,7 +781,18 @@ def _bench_elastic() -> dict:
     membership.worker_dead(1)                # lose half the capacity
     ctrl.check_step(1, trainer, params=net)  # pause -> reshard -> resume
     trainer.step(x, y)                       # first post-reshard step
-    return elastic.elastic_block(**ctrl.stats())
+    blk = elastic.elastic_block(**ctrl.stats())
+    if telemetry.enabled():
+        # thin-reader discipline (ISSUE 9): the measured transition
+        # fields come off the same registry a live scrape sees — the
+        # controller published them during resync
+        for field, metric in (("reshard_ms", "elastic.reshard_ms"),
+                              ("pause_ms", "elastic.pause_ms"),
+                              ("membership_epoch", "elastic.epoch")):
+            v = telemetry.value(metric)
+            if v is not None:
+                blk[field] = v
+    return blk
 
 
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
@@ -951,6 +962,19 @@ def _run_bench() -> dict:
             profiler.stop()
 
 
+def _stamp_telemetry(result: dict) -> dict:
+    """Stamp the payload with the telemetry schema version (ISSUE 9):
+    consumers of bench JSON / telemetry snapshots gate field parsing on
+    it.  None when mxnet_tpu is not importable (probe-failure paths) —
+    null-when-unmeasured, never a guessed constant."""
+    try:
+        from mxnet_tpu.telemetry import SCHEMA_VERSION
+        result["telemetry_schema_version"] = SCHEMA_VERSION
+    except Exception:  # noqa: BLE001 — stamping must not void the bench
+        result["telemetry_schema_version"] = None
+    return result
+
+
 _TPU_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                           ".bench_last_tpu.json")
 _BENCH_FULL = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -976,7 +1000,7 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
     for k in ("platform", "mfu", "tflops_delivered", "batch", "dtype",
               "data", "s2d_stem", "flops_source", "steps_per_call",
               "dispatch_ms_per_step", "platform_requested",
-              "platform_actual"):
+              "platform_actual", "telemetry_schema_version"):
         if k in result and result[k] is not None:
             cands.append((k, result[k]))
     if "error" in result:
@@ -1172,6 +1196,7 @@ def main() -> int:
                   "error": ("MXTPU_BENCH_REQUIRE_TPU=1: backend is "
                             f"{platform or 'unreachable'} after "
                             f"{attempts} probes; refusing CPU fallback")}
+        _stamp_telemetry(result)
         print(json.dumps(result), flush=True)
         if os.environ.get("MXTPU_BENCH_NO_COMPACT", "") != "1":
             print(_compact_line(result), flush=True)
@@ -1220,6 +1245,7 @@ def main() -> int:
         _save_tpu_cache(result)
     if error is not None:
         result["error"] = error
+    _stamp_telemetry(result)
     # Full payload: artifact file + an EARLIER stdout line (the driver's
     # ~2KB tail window must only ever contain the compact headline below)
     try:

@@ -43,6 +43,7 @@ debug._install()            # MXTPU_DEBUG_NANS / MXTPU_ENFORCE_DETERMINISM
                             # must configure jax before any computation
 
 from .base import MXNetError
+from . import telemetry   # first: every subsystem below publishes to it
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus
 from . import ndarray

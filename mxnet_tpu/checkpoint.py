@@ -61,6 +61,7 @@ from .base import MXNetError
 from .ndarray.ndarray import NDArray
 from .ndarray import utils as nd_utils
 from .testing import faults as _faults
+from . import telemetry as _telem
 
 __all__ = ["AsyncCheckpointer", "save_checkpoint_async", "CheckpointManager",
            "CheckpointTimeout", "PreemptionHandler", "run_preemptible",
@@ -469,6 +470,7 @@ class CheckpointManager:
         return self._writer._submit(write, desc=self._step_dir(step))
 
     def _write(self, step, groups, meta):
+        t0 = _telem.clock() if _telem.enabled() else None
         path = self._step_dir(step)
         if os.path.isdir(path):
             shutil.rmtree(path)      # overwrite a previous torn attempt
@@ -500,6 +502,15 @@ class CheckpointManager:
         _faults.fault_point("checkpoint.manifest", mpath)
         os.replace(tmp, mpath)
         self._retain(step)
+        if t0 is not None:
+            # writer-thread side, so the training loop never pays this;
+            # bytes = the committed payload files (manifest excluded)
+            _telem.observe("checkpoint.save_ms",
+                           (_telem.clock() - t0) * 1e3)
+            _telem.inc("checkpoint.saves")
+            _telem.inc("checkpoint.bytes",
+                       sum(f["nbytes"] for f in files.values()))
+            _telem.event("checkpoint.saved", step=step)
         return path
 
     def _retain(self, just_written):
@@ -551,6 +562,7 @@ class CheckpointManager:
         ``load_state_dict`` — optimizer state is saved dp-independent,
         so a trainer running at a different dp size reshards on load.
         """
+        t0 = _telem.clock() if _telem.enabled() else None
         if step is None:
             step = self.latest()
             if step is None:
@@ -571,6 +583,11 @@ class CheckpointManager:
         if restore_rng and "rng.ndz" in manifest.get("files", {}):
             arrays = self._load_group(path, manifest, "rng")
             _restore_rng(arrays, manifest["rng_meta"])
+        if t0 is not None:
+            _telem.observe("checkpoint.restore_ms",
+                           (_telem.clock() - t0) * 1e3)
+            _telem.inc("checkpoint.restores")
+            _telem.event("checkpoint.restored", step=int(step))
         return manifest
 
     @staticmethod
@@ -747,9 +764,14 @@ class PreemptionHandler:
 
     def request(self, reason="requested"):
         """Flip the preemption flag (signal handler, fault injector, or
-        orchestration code)."""
+        orchestration code).  Also dumps the telemetry flight recorder —
+        SIGTERM is exactly the moment the post-mortem must leave the
+        process (ISSUE 9); the dump is signal-handler-safe-enough here
+        because this runs in the Python-level handler, not the raw C
+        one."""
         self.reason = reason
         self._event.set()
+        _telem.on_preemption(reason)
 
     @property
     def requested(self):

@@ -38,6 +38,7 @@ import os
 import time
 
 from ..base import MXNetError
+from .. import telemetry as _telem
 from .membership import Membership  # noqa: F401  (re-exported surface)
 
 __all__ = ["ElasticController", "elastic_enabled", "min_dp"]
@@ -218,6 +219,20 @@ class ElasticController:
                     reshard_ms=self.last_reshard_ms,
                     pause_ms=self.last_pause_ms)
         self.last_event = info
+        if _telem.enabled():
+            # the bench `elastic` block and live scrapes read these off
+            # the registry — same numbers as stats(), one source
+            _telem.set_context(step=None if step is None else int(step),
+                               epoch=self._applied_epoch)
+            _telem.inc("elastic.transitions")
+            _telem.set_gauge("elastic.dp", new_dp)
+            _telem.set_gauge("elastic.reshard_ms", self.last_reshard_ms)
+            _telem.set_gauge("elastic.pause_ms", self.last_pause_ms)
+            _telem.observe("elastic.reshard_ms_hist",
+                           self.last_reshard_ms)
+            _telem.event("elastic.transition", source=info["source"],
+                         dp=new_dp, epoch=self._applied_epoch,
+                         rewind_step=info.get("step"))
         return info
 
     def _make_mesh(self, dp):

@@ -39,6 +39,7 @@ import threading
 import time
 
 from ..base import MXNetError
+from .. import telemetry as _telem
 
 __all__ = ["Membership", "MembershipEvent", "StaleMembershipEpoch",
            "STABLE", "RENDEZVOUS"]
@@ -148,9 +149,17 @@ class Membership:
     # -- transitions ----------------------------------------------------
     def _emit(self, kind, rank):
         """Record + fan out one event.  Caller holds the lock; subscriber
-        callbacks run OUTSIDE it (a controller may call back into us)."""
+        callbacks run OUTSIDE it (a controller may call back into us).
+        Every committed transition also lands in the telemetry event log
+        with the epoch as ambient context (ISSUE 9) — telemetry never
+        calls back into the membership, so emitting under the lock is
+        safe."""
         ev = MembershipEvent(kind, rank, self._epoch, self._now())
         self._events.append(ev)
+        _telem.set_context(epoch=self._epoch)
+        _telem.set_gauge("elastic.epoch", self._epoch)
+        _telem.event(f"membership.{kind}", rank=int(rank),
+                     epoch=self._epoch)
         subs = list(self._subscribers)
         return ev, subs
 

@@ -36,6 +36,7 @@ import numpy as _np
 
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
+from .. import telemetry as _telem
 
 __all__ = ["DevicePrefetcher", "AsyncDecodeIter", "PipelineStats",
            "default_prefetch_depth"]
@@ -81,6 +82,13 @@ class PipelineStats:
             if stage == "h2d":
                 self.h2d_bytes += nbytes
                 self.batches += 1
+        # mirror onto the process telemetry registry (ISSUE 9): the
+        # per-instance accumulator stays the bench `input_pipeline`
+        # source; the registry is what a live scrape sees
+        if _telem.enabled():
+            _telem.observe(f"io.{stage}_ms", dt * 1e3)
+            if stage == "h2d" and nbytes:
+                _telem.inc("io.h2d_bytes", nbytes)
 
     def summary(self):
         """Per-stage ms/batch plus ``overlap_efficiency`` — the fraction
@@ -329,6 +337,13 @@ class DevicePrefetcher:
         t_got = time.perf_counter()
         self.stats.add("stall", t_got - now)
         _profiler_span("pipeline:stall", now, t_got)
+        if _telem.enabled():
+            # read-ahead occupancy AFTER this get: depth batches queued
+            # = the worker is fully ahead; 0 = the consumer is about to
+            # stall on the next call
+            _telem.set_gauge("io.prefetch_queue_depth",
+                             self._queue.qsize())
+            _telem.set_gauge("io.prefetch_depth", self._depth)
         if got is _END:
             self._shutdown()
             raise StopIteration

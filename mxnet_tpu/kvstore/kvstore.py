@@ -87,6 +87,17 @@ class KVStore:
                 self._member_epoch,
                 what=f"{self.type} collective from this worker")
 
+    @staticmethod
+    def _telem_pushpull(n_keys):
+        """Registry twin of the store's data-plane activity (ISSUE 9):
+        one increment per eager pushpull dispatch + the key count, so a
+        live scrape sees collective pressure without a store-specific
+        stats call."""
+        from .. import telemetry as _telem
+        if _telem.enabled():
+            _telem.inc("kvstore.pushpull_calls")
+            _telem.inc("kvstore.pushpull_keys", n_keys)
+
     # -- identity ------------------------------------------------------
     @property
     def type(self):
@@ -111,6 +122,8 @@ class KVStore:
         raise NotImplementedError
 
     def pushpull(self, key, value, out=None, priority=0):
+        self._telem_pushpull(len(key) if isinstance(key, (list, tuple))
+                             else 1)
         self.push(key, value, priority)
         self.pull(key, out=out if out is not None else value,
                   priority=priority)
@@ -500,6 +513,7 @@ class KVStoreTPUSync(KVStoreLocal):
                 return super().pushpull(key, value, out=out,
                                         priority=priority)
             vss.append([x.data for x in vs])
+        self._telem_pushpull(len(keys))
         self._traced_store.clear()
         merged = _fused_reduce(vss)
         outs = out if out is not None else value

@@ -59,6 +59,7 @@ _OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
     _OP_SHUTDOWN, _OP_CMD, _OP_CMDLOG = 1, 2, 3, 4, 5, 6, 7, 8, 9
 _OP_HEARTBEAT, _OP_HEALTH = 10, 11
 _OP_JOIN, _OP_MEMBERSHIP = 12, 13   # elastic membership (ISSUE 8)
+_OP_TELEMETRY = 14                  # live telemetry scrape (ISSUE 9)
 # opcodes (replies)
 _OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
 
@@ -499,6 +500,23 @@ class PSServer:
                 view = self._membership.view()
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
                 json.dumps(view)))
+        elif op == _OP_TELEMETRY:
+            # live scrape of THIS process's telemetry (ISSUE 9): the PS
+            # RPC loop is the one long-lived listener every training/
+            # serving job already runs, so it doubles as the scrape
+            # endpoint — no extra port, no extra thread.  fmt byte:
+            # 0 = JSON snapshot, 1 = Prometheus text (wrapped in JSON so
+            # the typed reply framing stays uniform).
+            from .. import telemetry as _telemetry
+            fmt = frame[off] if len(frame) > off else 0
+            snap = _telemetry.snapshot()
+            if fmt == 1:
+                payload = {"format": "prom",
+                           "text": _telemetry.prom_text(snap)}
+            else:
+                payload = snap
+            _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
+                json.dumps(payload)))
         elif op == _OP_SHUTDOWN:
             _send_frame(conn, bytes([_OP_OK]))
             self._sock.close()
@@ -621,6 +639,15 @@ class PSClient:
         """Server's liveness view: {alive: {rank: age_s}, dead: [ranks],
         heartbeat_timeout, num_workers}."""
         return self._rpc(bytes([_OP_HEALTH]))
+
+    def telemetry(self, fmt="json"):
+        """Scrape the server process's ``mx.telemetry`` state (ISSUE 9):
+        ``fmt="json"`` returns the snapshot dict, ``fmt="prom"`` a
+        ``{"format": "prom", "text": ...}`` wrapper holding the
+        Prometheus text exposition — what ``tools/telemetry_dump.py``
+        prints for a scraper."""
+        return self._rpc(bytes([_OP_TELEMETRY,
+                                1 if fmt == "prom" else 0]))
 
     def beat_once(self, rank):
         """Send ONE heartbeat for ``rank`` synchronously over the RPC

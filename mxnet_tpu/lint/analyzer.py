@@ -1014,6 +1014,149 @@ class _DecodeLoopPullScanner(ast.NodeVisitor):
     visit_For = visit_While = visit_AsyncFor = _scan_decode_loop
 
 
+# -- HB13: wall-clock timing of device code without synchronization -----
+
+# clock reads whose subtraction forms a wall-clock delta
+_TIME_CALLS = {"time.time", "time.perf_counter", "time.monotonic",
+               "perf_counter", "monotonic"}
+# calls that drain the device inside the timed region (make the delta
+# measure compute, not dispatch)
+_HB13_SYNC_METHODS = {"block_until_ready", "wait_to_read", "waitall",
+                      "asnumpy", "asscalar", "item", "tolist"}
+# call forms that PRODUCE a compiled callable
+_JIT_FACTORIES = {"jax.jit", "jit", "jax.pmap", "pmap"}
+
+
+def _is_time_call(node):
+    return isinstance(node, ast.Call) and _dotted(node.func) in _TIME_CALLS
+
+
+def _is_jit_factory(node):
+    """``jax.jit(...)`` / ``jit(...)`` / ``...lower(args).compile()`` —
+    the value bound is a compiled callable whose invocation dispatches
+    async device work."""
+    if not isinstance(node, ast.Call):
+        return False
+    if _dotted(node.func) in _JIT_FACTORIES:
+        return True
+    return isinstance(node.func, ast.Attribute) and \
+        node.func.attr == "compile"
+
+
+class _UnsyncedTimingScanner(ast.NodeVisitor):
+    """HB13: ``t0 = time.perf_counter(); y = f(x); dt =
+    time.perf_counter() - t0`` where ``f`` is jitted/compiled and no
+    ``block_until_ready``/``wait_to_read``/host read happens inside the
+    timed region.  jax dispatch is ASYNC — the call returns the moment
+    the program is enqueued — so the delta measures host dispatch, not
+    device compute: the benchmark-lies-by-100x failure mode ISSUE 9's
+    telemetry timings must not reintroduce.  Scans every function (and
+    the module body); a jitted callable is one bound IN THAT SCOPE from
+    a jit factory (``jax.jit``/``jit``/``.compile()``), so eager helper
+    calls and host-only code never false-positive."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+
+    def visit_Module(self, node):
+        self._scan_scope(node)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self._scan_scope(node)
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _walk_scope(scope):
+        """Walk ``scope``'s body WITHOUT descending into nested
+        function definitions — each function is its own timed scope
+        (an outer clock variable must not pair with an inner
+        function's delta)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _scan_scope(self, scope):
+        # pass 1: names bound to compiled callables + clock variables
+        jitted, timevars = set(), {}
+        for sub in self._walk_scope(scope):
+            if isinstance(sub, ast.Assign):
+                if _is_jit_factory(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            jitted.add(t.id)
+                elif _is_time_call(sub.value):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Name):
+                            timevars.setdefault(t.id, []).append(
+                                sub.lineno)
+        if not timevars:
+            return
+        # pass 2: dispatches, syncs, and clock deltas (by line)
+        jcalls, syncs, deltas = [], [], []
+        for sub in self._walk_scope(scope):
+            if isinstance(sub, ast.Call):
+                f = sub.func
+                if (isinstance(f, ast.Attribute)
+                        and f.attr in _HB13_SYNC_METHODS) or \
+                        (isinstance(f, ast.Name)
+                         and f.id in _HB13_SYNC_METHODS):
+                    syncs.append(sub.lineno)
+                elif (isinstance(f, ast.Name) and f.id in jitted) or \
+                        _is_jit_factory(f):
+                    jcalls.append(sub.lineno)
+            elif isinstance(sub, ast.BinOp) and \
+                    isinstance(sub.op, ast.Sub) and \
+                    isinstance(sub.right, ast.Name) and \
+                    sub.right.id in timevars:
+                if _is_time_call(sub.left):
+                    deltas.append((sub.lineno, sub.right.id, sub.lineno))
+                elif isinstance(sub.left, ast.Name) and \
+                        sub.left.id in timevars:
+                    # t1 - t0: the region closes at t1's assignment
+                    ends = [l for l in timevars[sub.left.id]
+                            if l <= sub.lineno]
+                    if ends:
+                        deltas.append((sub.lineno, sub.right.id,
+                                       max(ends)))
+        if not jcalls:
+            return
+        for lineno, t0_name, end in deltas:
+            starts = [l for l in timevars[t0_name] if l <= lineno]
+            if not starts:
+                continue
+            start = max(s for s in starts if s <= end) \
+                if any(s <= end for s in starts) else None
+            if start is None or end <= start:
+                continue
+            if any(start <= l <= end for l in jcalls) and \
+                    not any(start <= l <= end for l in syncs):
+                self.c.add(Violation(
+                    rule="HB13", path=self.path, line=lineno, col=0,
+                    message="wall-clock delta around a jitted/compiled "
+                            "call with no block_until_ready/"
+                            "wait_to_read/host read in the timed "
+                            "region: jax dispatches asynchronously, so "
+                            "this measures DISPATCH, not device "
+                            "compute; sync on the result before "
+                            "reading the clock (or name the metric "
+                            "dispatch_ms)",
+                    block="", func=self.func_stack[-1]))
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -1148,12 +1291,13 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
                 continue              # inherited: reported on the owner
             collector.analyze_entry(fn, cname)
     if only_classes is None:
-        # HB07/HB09/HB10/HB11 are module-wide (any function), not
+        # HB07/HB09/HB10/HB11/HB13 are module-wide (any function), not
         # forward-scoped
         _LoopCollectiveScanner(collector, path).visit(tree)
         _BackwardStepScanner(collector, path).visit(tree)
         _MultiStepPullScanner(collector, path).visit(tree)
         _DecodeLoopPullScanner(collector, path).visit(tree)
+        _UnsyncedTimingScanner(collector, path).visit(tree)
     suppressed, _unknown = parse_suppressions(source)
     src_lines = source.splitlines()
     out = []

@@ -188,6 +188,25 @@ RULES = {
         "#           the graph with the new size after a reshard)\n"
         "def hybrid_forward(self, F, x):\n"
         "    return x / self._dp"),
+    "HB13": Rule(
+        "HB13", "unsynced-device-timing",
+        "A `time.time()`/`time.perf_counter()` delta wrapping a jitted/"
+        "compiled call with no `block_until_ready`/`wait_to_read`/host "
+        "read between the dispatch and the delta: jax dispatches "
+        "asynchronously, so the measured span is the HOST DISPATCH "
+        "time, not device compute — the classic way a benchmark (or a "
+        "telemetry gauge) reports a 100x-too-fast step. Sync on the "
+        "result inside the timed region, or name the metric dispatch_ms "
+        "and measure compute via the profiler.",
+        "f = jax.jit(step)\n"
+        "t0 = time.perf_counter()\n"
+        "y = f(x)                    # returns BEFORE the device runs\n"
+        "dt = time.perf_counter() - t0   # dispatch, not compute",
+        "f = jax.jit(step)\n"
+        "t0 = time.perf_counter()\n"
+        "y = f(x)\n"
+        "jax.block_until_ready(y)    # drain the device first\n"
+        "dt = time.perf_counter() - t0"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

@@ -34,6 +34,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from ..ndarray import random as _rnd
 from .. import _tape
+from .. import telemetry as _telem
 from ..gluon.parameter import _bind_params
 from ._compat import shard_map
 from .mesh import current_mesh, make_mesh
@@ -364,6 +365,7 @@ class DataParallelTrainer:
         :meth:`_build_accum`).  Returns the mean microbatch loss."""
         if n_micro < 1:
             raise MXNetError("step_accum: n_micro must be >= 1")
+        t_step = _telem.clock() if _telem.enabled() else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         bax = self._eff_bax(inputs[-1].ndim, is_label=True)
@@ -404,12 +406,13 @@ class DataParallelTrainer:
         inputs = self._put_batch(inputs)
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = jitted(
-            self._param_vals, self._opt_state, lr, key, *inputs)
+        new_params, self._opt_state, loss = self._dispatch(
+            jitted, self._param_vals, self._opt_state, lr, key, *inputs)
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
+        self._record_step(1, t_step)
         return NDArray(loss)
 
     def _build_indexed(self):
@@ -660,6 +663,36 @@ class DataParallelTrainer:
                     f"needs even shards; MXTPU_SHARDED_SYNC=0 restores "
                     f"the psum path)")
 
+    # -- telemetry (ISSUE 9) --------------------------------------------
+    def _dispatch(self, jitted, *args):
+        """Run one compiled step dispatch, timed into the telemetry
+        registry (``train.dispatch_ms`` — HOST dispatch time; jax
+        returns before the device finishes, so device time lives in the
+        profiler/XLA trace, not here).  An unhandled dispatch exception
+        dumps the flight recorder before re-raising."""
+        t0 = _telem.clock() if _telem.enabled() else None
+        try:
+            out = jitted(*args)
+        except Exception as e:  # noqa: BLE001 — record, then re-raise
+            _telem.on_step_error(self._num_update, e)
+            raise
+        if t0 is not None:
+            _telem.observe("train.dispatch_ms",
+                           (_telem.clock() - t0) * 1e3)
+        return out
+
+    def _record_step(self, k, t_step0):
+        """Publish per-step metrics after ``k`` steps committed; the
+        ambient telemetry step context feeds event records and profiler
+        span tags."""
+        if t_step0 is None:
+            return
+        _telem.set_context(step=self._num_update)
+        _telem.inc("train.steps", k)
+        _telem.observe("train.step_ms",
+                       (_telem.clock() - t_step0) * 1e3 / max(k, 1))
+        _telem.set_gauge("train.num_update", self._num_update)
+
     # -- public API -----------------------------------------------------
     @property
     def learning_rate(self):
@@ -673,6 +706,7 @@ class DataParallelTrainer:
     def step(self, *batch):
         """batch = (*inputs, label) NDArrays. Returns the scalar loss
         NDArray."""
+        t_step = _telem.clock() if _telem.enabled() else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
@@ -691,12 +725,13 @@ class DataParallelTrainer:
         inputs = self._put_batch(inputs)
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = jitted(
-            self._param_vals, self._opt_state, lr, key, *inputs)
+        new_params, self._opt_state, loss = self._dispatch(
+            jitted, self._param_vals, self._opt_state, lr, key, *inputs)
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
+        self._record_step(1, t_step)
         return NDArray(loss)
 
     def step_multi(self, batches, n_micro=1):
@@ -718,6 +753,7 @@ class DataParallelTrainer:
         default) keeps K-aware loops (estimator/bench) on the per-step
         entry points, restoring today's graphs exactly.
         """
+        t_step = _telem.clock() if _telem.enabled() else None
         batches = list(batches)
         k = len(batches)
         if k < 1:
@@ -770,12 +806,14 @@ class DataParallelTrainer:
         else:
             lrs = [self._lr] * k
         lrs = jnp.asarray(lrs, jnp.float32)
-        new_params, self._opt_state, losses = jitted(
-            self._param_vals, self._opt_state, lrs, keys, *stacked)
+        new_params, self._opt_state, losses = self._dispatch(
+            jitted, self._param_vals, self._opt_state, lrs, keys,
+            *stacked)
         self._num_update += k
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
+        self._record_step(k, t_step)
         return NDArray(losses)
 
     def put_epoch(self, superdata, superlabel):
@@ -858,6 +896,7 @@ class DataParallelTrainer:
     def step_indexed(self, epoch_handle, i):
         """One fused train step on batch ``i`` of a resident epoch
         (see :meth:`put_epoch`)."""
+        t_step = _telem.clock() if _telem.enabled() else None
         superdata, superlabel = epoch_handle[0], epoch_handle[1]
         if self._param_objs is None:
             # probe batch only for deferred-shape resolution on first call
@@ -875,13 +914,14 @@ class DataParallelTrainer:
             jitted = self._jitted_indexed
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
-        new_params, self._opt_state, loss = jitted(
-            self._param_vals, self._opt_state, lr, key, superdata,
-            superlabel, jnp.asarray(i, jnp.int32))
+        new_params, self._opt_state, loss = self._dispatch(
+            jitted, self._param_vals, self._opt_state, lr, key,
+            superdata, superlabel, jnp.asarray(i, jnp.int32))
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
+        self._record_step(1, t_step)
         return NDArray(loss)
 
     # -- elastic membership (mx.elastic, ISSUE 8) -----------------------
@@ -1134,6 +1174,13 @@ class DataParallelTrainer:
         elif serial > 0:
             out["overlap_frac"] = round(
                 max(0.0, min(1.0, 1.0 - exposed / serial)), 4)
+        # retire the probe's private numbers onto the registry: the
+        # bench `comm` block and live scrapers read ONE source (ISSUE 9)
+        for field, metric in (("exposed_comm_ms",
+                               "train.exposed_comm_ms"),
+                              ("overlap_frac", "train.overlap_frac")):
+            if out[field] is not None:
+                _telem.set_gauge(metric, out[field])
         return out
 
     def comm_stats(self, measure=False, iters=10, step_ms=None,
@@ -1173,6 +1220,7 @@ class DataParallelTrainer:
                 gbs = (bytes_rs + bytes_ag) / (coll_ms / 1e3) / 1e9
             if step_ms:
                 overlap = max(0.0, min(1.0, 1.0 - coll_ms / step_ms))
+            _telem.set_gauge("comm.collective_ms", coll_ms)
         ov = overlap_stats or {}
         return _zero.comm_block(
             dp=dp, wire_dtype=self._comm_dtype, buckets=plan.n_buckets,

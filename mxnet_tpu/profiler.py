@@ -14,8 +14,8 @@ import time
 import warnings
 
 __all__ = ["set_config", "set_state", "start", "stop", "pause", "resume",
-           "dump", "dumps", "Task", "Frame", "Counter", "Marker", "Domain",
-           "scope", "record_span"]
+           "dump", "dumps", "reset", "Task", "Frame", "Counter", "Marker",
+           "Domain", "scope", "record_span"]
 
 _CONFIG = {"filename": "profile.json", "profile_all": False,
            "aggregate_stats": False}
@@ -102,7 +102,28 @@ def dumps(reset=False):
     return "\n".join(lines)
 
 
+def reset():
+    """Drop every collected custom event (the ``dumps()`` aggregation
+    source).  The span store is process-global, so without this seam two
+    tests' B/E events could pair ACROSS tests and span assertions would
+    flake depending on test order — the exact failure mode the gluon
+    name-counter fixture fixed for auto-naming (PR 5).  A conftest
+    autouse hook calls this around every test."""
+    _STATE["events"] = []
+
+
+def _span_context():
+    """The ambient {step, epoch} tags (mx.telemetry context) attached to
+    every span while a profile runs, so perfetto/Chrome-trace rows
+    correlate with the telemetry event log (ISSUE 9)."""
+    from . import telemetry as _telem
+    ctx = _telem.context()
+    return {"args": ctx} if ctx else {}
+
+
 def _emit(name, ph, **extra):
+    if not extra:
+        extra = _span_context()
     _STATE["events"].append((name, ph, time.time(), extra))
 
 
@@ -113,11 +134,13 @@ def record_span(name, t0, t1):
     Used by the input-pipeline stages (``io.DevicePrefetcher`` /
     ``io.AsyncDecodeIter`` worker threads) so decode/H2D/stall show up
     in ``dumps()`` next to the step — list.append is atomic under the
-    GIL, so cross-thread emission needs no lock."""
+    GIL, so cross-thread emission needs no lock.  Spans are tagged with
+    the current telemetry step/epoch for trace correlation."""
     if not _STATE["running"]:
         return
-    _STATE["events"].append((name, "B", t0, {}))
-    _STATE["events"].append((name, "E", t1, {}))
+    extra = _span_context()
+    _STATE["events"].append((name, "B", t0, extra))
+    _STATE["events"].append((name, "E", t1, extra))
 
 
 class Domain:

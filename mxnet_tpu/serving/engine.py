@@ -38,6 +38,7 @@ import os
 import numpy as _np
 
 from ..base import MXNetError
+from .. import telemetry as _telem
 from .kv_cache import PagedKVCache
 
 __all__ = ["InferenceEngine", "next_bucket"]
@@ -367,8 +368,15 @@ class InferenceEngine:
                 .lower(*args).compile()
             self._compiled[sig] = fn
             self.stats["compiles"] += 1
+            _telem.inc("serving.compiles")
             if self._warmed:
+                # the tier-1 zero-retrace assertion reads the engine's
+                # own counter; the registry twin is what a live scrape
+                # sees (one source of truth for bench/loadgen, ISSUE 9)
                 self.stats["compiles_after_warmup"] += 1
+                _telem.inc("serving.compiles_after_warmup")
+                _telem.event("serving.compile_after_warmup",
+                             kind=kind, size=int(size))
         return fn
 
     def warmup(self):
@@ -426,11 +434,18 @@ class InferenceEngine:
                                  (1 << 30) + self.stats["prefill_calls"])
         args = (self.params, self.cache.k_pool, self.cache.v_pool,
                 padded, _np.int32(t), bt, key)
+        t0 = _telem.clock() if _telem.enabled() else None
         last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
         self.cache.update_pools(kp, vp)
         self.cache.trim(slot, t)
         self.cache.set_len(slot, t)
         self.stats["prefill_calls"] += 1
+        if t0 is not None:
+            _telem.inc("serving.prefill_calls")
+            _telem.observe("serving.prefill_ms",
+                           (_telem.clock() - t0) * 1e3)
+            _telem.set_gauge("serving.kv_block_utilization",
+                             round(self.cache.utilization(), 4))
         return int(tok), last
 
     def reserve(self, slot, pos):
@@ -472,9 +487,16 @@ class InferenceEngine:
                                  self.stats["decode_calls"])
         args = (self.params, self.cache.k_pool, self.cache.v_pool,
                 toks, pos, bts, active, key)
+        t0 = _telem.clock() if _telem.enabled() else None
         logits, nxt, kp, vp = self._get("decode", nbl, args)(*args)
         self.cache.update_pools(kp, vp)
         self.stats["decode_calls"] += 1
+        if t0 is not None:
+            _telem.inc("serving.decode_calls")
+            _telem.observe("serving.decode_ms",
+                           (_telem.clock() - t0) * 1e3)
+            _telem.set_gauge("serving.kv_block_utilization",
+                             round(self.cache.utilization(), 4))
         nxt = _np.asarray(nxt)[:n]
         return nxt, _np.asarray(logits)[:n]
 

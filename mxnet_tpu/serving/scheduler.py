@@ -27,6 +27,7 @@ import time
 from collections import deque
 
 from ..base import MXNetError
+from .. import telemetry as _telem
 
 __all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
 
@@ -92,12 +93,16 @@ class _BatcherBase:
             return False
         tok, _logits = out
         req.first_token_t = time.perf_counter()
+        if _telem.enabled() and req.submit_t is not None:
+            _telem.observe("serving.ttft_ms",
+                           (req.first_token_t - req.submit_t) * 1e3)
         self._append_token(req, slot, tok)
         return True
 
     def _append_token(self, req, slot, tok):
         req.generated.append(int(tok))
         self.tokens_generated += 1
+        _telem.inc("serving.tokens_generated")
         if req.eos_id is not None and int(tok) == int(req.eos_id):
             req.finish_reason = "eos"
         elif len(req.generated) >= req.max_new_tokens:
@@ -106,6 +111,12 @@ class _BatcherBase:
             req.finish_t = time.perf_counter()
             self.engine.release(slot)
             self.finished.append(req)
+            if _telem.enabled():
+                _telem.inc("serving.requests_finished")
+                lat = req.latency()
+                if lat is not None:
+                    _telem.observe("serving.request_latency_ms",
+                                   lat * 1e3)
 
     def _decode_active(self, active):
         """One joined decode step over ``active`` {slot: request}."""
@@ -121,6 +132,15 @@ class _BatcherBase:
         nxt, _logits = self.engine.decode(entries)
         self.decode_steps += 1
         self.occupancy_samples.append(len(entries) / self.engine.max_batch)
+        if _telem.enabled():
+            # per-boundary scheduler state: what a live scrape of a
+            # serving pod needs to spot admission stalls (ISSUE 9)
+            _telem.set_gauge("serving.queue_depth", len(self.queue))
+            _telem.observe("serving.batch_occupancy",
+                           len(entries) / self.engine.max_batch,
+                           edges=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                                  0.875, 1.0))
+            _telem.inc("serving.decode_steps")
         for (slot, _t, _p), tok in zip(entries, nxt):
             self._append_token(active[slot], slot, tok)
         for slot in [s for s, r in active.items() if r.done]:

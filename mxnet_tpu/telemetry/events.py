@@ -1,0 +1,115 @@
+"""Structured event log: schema-versioned records, bounded ring, JSONL.
+
+Every record carries::
+
+    {"v": 1,               # SCHEMA_VERSION — consumers gate on this
+     "seq": 42,            # monotonic per-process sequence number
+     "t": 1000.25,         # injectable-clock timestamp
+     "kind": "fault.trip", # dotted event kind
+     "step": 17,           # ambient training step (None before any)
+     "epoch": 2,           # ambient membership epoch (None outside
+                           # elastic jobs)
+     "data": {...}}        # kind-specific JSON-able payload
+
+The last ``ring_size`` records live in memory (the flight recorder's
+source); with ``MXTPU_EVENT_LOG=<path>`` every record is ALSO appended
+as one JSON line — the durable stream a trace collector tails.  Write
+failures are swallowed after the first warning: the event log must never
+take the training loop down.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import warnings
+from collections import deque
+
+__all__ = ["EventLog", "SCHEMA_VERSION"]
+
+#: bump on any BREAKING record/snapshot field change; additive fields
+#: keep the version (consumers must ignore unknown keys)
+SCHEMA_VERSION = 1
+
+
+class EventLog:
+    def __init__(self, ring_size=256, path=None, now=None):
+        self.ring_size = int(ring_size)
+        self.path = path or None
+        self._now = now if now is not None else time.time
+        self._lock = threading.Lock()
+        self._ring = deque(maxlen=self.ring_size)
+        self._seq = 0
+        self._ctx = {"step": None, "epoch": None}
+        self._file = None
+        self._write_warned = False
+
+    @property
+    def seq(self):
+        with self._lock:
+            return self._seq
+
+    # -- context --------------------------------------------------------
+    def set_context(self, step=None, epoch=None):
+        with self._lock:
+            if step is not None:
+                self._ctx["step"] = int(step)
+            if epoch is not None:
+                self._ctx["epoch"] = int(epoch)
+
+    def context(self):
+        with self._lock:
+            return {k: v for k, v in self._ctx.items() if v is not None}
+
+    # -- emission -------------------------------------------------------
+    def emit(self, kind, **data):
+        with self._lock:
+            self._seq += 1
+            rec = {"v": SCHEMA_VERSION, "seq": self._seq,
+                   "t": self._now(), "kind": str(kind),
+                   "step": self._ctx["step"], "epoch": self._ctx["epoch"],
+                   "data": data}
+            self._ring.append(rec)
+            line = None
+            if self.path:
+                try:
+                    line = json.dumps(rec)
+                except (TypeError, ValueError):
+                    line = json.dumps(dict(rec, data={"repr": repr(data)}))
+        if line is not None:
+            self._append_line(line)
+        return rec
+
+    def _append_line(self, line):
+        try:
+            if self._file is None:
+                self._file = open(self.path, "a", encoding="utf-8")
+            self._file.write(line + "\n")
+            self._file.flush()
+        except OSError as e:
+            if not self._write_warned:
+                self._write_warned = True
+                warnings.warn(f"telemetry event log {self.path!r} "
+                              f"unwritable ({e}); further records stay "
+                              f"in-memory only")
+            self._file = None
+
+    def events(self):
+        """Ring contents, oldest first (copies — the ring keeps moving)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def reset(self):
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+            self._ctx = {"step": None, "epoch": None}
+
+    def close(self):
+        with self._lock:
+            if self._file is not None:
+                try:
+                    self._file.close()
+                except OSError:
+                    pass
+                self._file = None

@@ -1,0 +1,72 @@
+"""Flight recorder: the last-N-events + metric snapshot post-mortem.
+
+A preempted or faulted pod job normally dies with nothing but a stack
+trace; the flight recorder writes ONE small JSON file the moment
+something goes wrong, so the operator (or the chaos harness) can read
+what the process was doing at the instant of death::
+
+    {"schema_version": 1,
+     "reason": "fault:train.step",
+     "time": 1000.25,
+     "pid": 4242,
+     "events": [...last N event records...],
+     "metrics": {...registry snapshot...}}
+
+Triggers (wired by the package front end):
+
+- **SIGTERM / preemption** — ``checkpoint.PreemptionHandler.request``
+  calls ``telemetry.on_preemption`` (the PR 4 stop seam);
+- **fault-point trips** — ``testing.faults.fault_point`` calls
+  ``telemetry.on_fault`` the moment an armed fault fires;
+- **unhandled step exceptions** — ``DataParallelTrainer`` wraps its
+  compiled dispatch and calls ``telemetry.on_step_error``.
+
+The dump path is resolved AT DUMP TIME from ``MXTPU_FLIGHT_DIR``
+(default: the system temp dir — never the working tree) as
+``mxtpu_flight.<pid>.json`` — re-dumps overwrite, so the file always
+holds the newest incident.  Write failures are swallowed: crash
+reporting must never mask the crash.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+
+__all__ = ["FlightRecorder"]
+
+
+class FlightRecorder:
+    def __init__(self, registry, eventlog):
+        self._registry = registry
+        self._events = eventlog
+        self.last_dump_path = None
+
+    @staticmethod
+    def default_path():
+        d = os.environ.get("MXTPU_FLIGHT_DIR") or tempfile.gettempdir()
+        return os.path.join(d, f"mxtpu_flight.{os.getpid()}.json")
+
+    def payload(self, reason):
+        from .events import SCHEMA_VERSION
+        return {"schema_version": SCHEMA_VERSION,
+                "reason": str(reason),
+                "time": self._events._now(),
+                "pid": os.getpid(),
+                "events": self._events.events(),
+                "metrics": self._registry.snapshot()}
+
+    def dump(self, reason, path=None):
+        """Write the dump; returns the path (None when the write
+        failed — never raises)."""
+        path = path or self.default_path()
+        try:
+            payload = self.payload(reason)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f, indent=1)
+            os.replace(tmp, path)   # readers never see a torn dump
+        except (OSError, TypeError, ValueError):
+            return None
+        self.last_dump_path = path
+        return path

@@ -1,0 +1,65 @@
+"""Prometheus text-format rendering of a telemetry snapshot.
+
+One pure function over the JSON snapshot (no registry access), so the
+same renderer serves the live path (``mx.telemetry.prom_text()``, the
+PS server's ``_OP_TELEMETRY`` RPC) and the offline path
+(``tools/telemetry_dump.py`` over a flight-recorder file).
+
+Metric names are sanitized to the Prometheus grammar: ``mxtpu_`` prefix,
+dots/dashes to underscores.  Histograms render as the conventional
+cumulative ``_bucket{le="..."}`` series plus ``_sum``/``_count``.
+"""
+from __future__ import annotations
+
+__all__ = ["prom_text", "sanitize_name"]
+
+
+def sanitize_name(name):
+    out = []
+    for ch in name:
+        out.append(ch if (ch.isalnum() or ch == "_") else "_")
+    s = "".join(out)
+    if not s.startswith("mxtpu_"):
+        s = "mxtpu_" + s
+    return s
+
+
+def _fmt(v):
+    if v is None:
+        return "NaN"
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(v)
+
+
+def prom_text(snap):
+    lines = []
+    if not snap.get("enabled", True):
+        return "# telemetry disabled (MXTPU_TELEMETRY=0)\n"
+    for name, v in (snap.get("counters") or {}).items():
+        n = sanitize_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n} {_fmt(v)}")
+    for name, v in (snap.get("gauges") or {}).items():
+        n = sanitize_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(v)}")
+    for name, h in (snap.get("histograms") or {}).items():
+        n = sanitize_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        for edge, c in zip(h["edges"], h["counts"]):
+            cum += c
+            lines.append(f'{n}_bucket{{le="{edge}"}} {cum}')
+        cum += h["counts"][-1]
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {_fmt(h['sum'])}")
+        lines.append(f"{n}_count {h['count']}")
+    ctx = snap.get("context") or {}
+    for k, v in sorted(ctx.items()):
+        n = sanitize_name(f"context.{k}")
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_fmt(v)}")
+    return "\n".join(lines) + "\n"

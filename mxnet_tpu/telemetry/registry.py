@@ -1,0 +1,213 @@
+"""Process-wide metrics registry: counters, gauges, fixed-edge histograms.
+
+Design constraints (ISSUE 9 tentpole):
+
+- **Deterministic aggregation**: histograms carry FIXED bucket edges
+  chosen at creation (default :data:`DEFAULT_MS_EDGES`), so merging
+  snapshots across workers — or comparing two runs — is exact bucket
+  arithmetic, never a re-binning estimate.
+- **Injectable clock**: the registry stamps snapshots through a ``now``
+  callable (``testing.faults.FakeClock`` in tests — the PR 4 PSServer
+  ``_now`` discipline).  Durations themselves are measured by callers
+  with ``time.perf_counter`` and *observed* into histograms.
+- **Zero overhead when disabled**: the package front end hands back
+  :data:`NULL_METRIC` (one shared instance whose methods are ``pass``)
+  instead of touching this module at all.
+- **Thread-safe**: the PS serve threads, prefetch workers, checkpoint
+  writer and the training thread all publish here.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+from ..base import MXNetError
+
+__all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
+           "NULL_METRIC", "DEFAULT_MS_EDGES"]
+
+#: default histogram edges, in milliseconds: spans sub-ms dispatch
+#: through multi-second reshard/checkpoint times.  FIXED so cross-worker
+#: aggregation is deterministic bucket-wise addition.
+DEFAULT_MS_EDGES = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0,
+                    100.0, 250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0)
+
+
+class _NullMetric:
+    """The disabled-mode metric: every mutator is a no-op; shared as ONE
+    module-level instance so the disabled path allocates nothing."""
+
+    __slots__ = ()
+
+    def inc(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+    @property
+    def value(self):
+        return None
+
+
+NULL_METRIC = _NullMetric()
+
+
+class Counter:
+    """Monotonically increasing count (events, bytes, calls)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._v = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n=1):
+        with self._lock:
+            self._v += n
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (queue depth, epoch, ms)."""
+
+    __slots__ = ("name", "_v", "_lock")
+
+    def __init__(self, name):
+        self.name = name
+        self._v = None
+        self._lock = threading.Lock()
+
+    def set(self, v):
+        with self._lock:
+            self._v = v
+
+    @property
+    def value(self):
+        with self._lock:
+            return self._v
+
+
+class Histogram:
+    """Fixed-edge histogram: ``counts[i]`` counts observations ``<=
+    edges[i]`` (last slot: overflow), plus running sum/count/min/max.
+    Edges are fixed at creation — deterministic aggregation is the
+    contract."""
+
+    __slots__ = ("name", "edges", "_counts", "_sum", "_count", "_min",
+                 "_max", "_lock")
+
+    def __init__(self, name, edges=None):
+        self.name = name
+        edges = tuple(float(e) for e in
+                      (DEFAULT_MS_EDGES if edges is None else edges))
+        if not edges or list(edges) != sorted(set(edges)):
+            raise MXNetError(
+                f"histogram {name!r}: edges must be a strictly "
+                f"increasing non-empty sequence, got {edges!r}")
+        self.edges = edges
+        self._counts = [0] * (len(edges) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._min = None
+        self._max = None
+        self._lock = threading.Lock()
+
+    def observe(self, v):
+        v = float(v)
+        i = bisect.bisect_left(self.edges, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+            if self._min is None or v < self._min:
+                self._min = v
+            if self._max is None or v > self._max:
+                self._max = v
+
+    @property
+    def value(self):
+        """Mean observation (the scalar thin-reader view); None before
+        the first observation."""
+        with self._lock:
+            return self._sum / self._count if self._count else None
+
+    def state(self):
+        with self._lock:
+            return {"edges": list(self.edges),
+                    "counts": list(self._counts),
+                    "sum": self._sum, "count": self._count,
+                    "min": self._min, "max": self._max}
+
+
+class MetricsRegistry:
+    """Name -> metric, with type checked on every lookup (a name can
+    never silently change kind mid-run)."""
+
+    def __init__(self, now=None):
+        self._now = now if now is not None else time.time
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _get(self, name, cls, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, **kw)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise MXNetError(
+                    f"telemetry metric {name!r} already registered as "
+                    f"{type(m).__name__}, requested {cls.__name__}")
+            return m
+
+    def counter(self, name):
+        return self._get(name, Counter)
+
+    def gauge(self, name):
+        return self._get(name, Gauge)
+
+    def histogram(self, name, edges=None):
+        m = self._get(name, Histogram, edges=edges)
+        if edges is not None and tuple(float(e) for e in edges) != m.edges:
+            raise MXNetError(
+                f"histogram {name!r} already registered with edges "
+                f"{m.edges}; re-registration with different edges would "
+                f"make aggregation non-deterministic")
+        return m
+
+    def value(self, name):
+        with self._lock:
+            m = self._metrics.get(name)
+        return None if m is None else m.value
+
+    def snapshot(self):
+        """JSON-able state of every metric, grouped by kind, with names
+        sorted so two snapshots of equal state serialize identically."""
+        from .events import SCHEMA_VERSION
+        with self._lock:
+            items = sorted(self._metrics.items())
+        counters, gauges, hists = {}, {}, {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                counters[name] = m.value
+            elif isinstance(m, Gauge):
+                gauges[name] = m.value
+            else:
+                hists[name] = m.state()
+        return {"schema_version": SCHEMA_VERSION, "time": self._now(),
+                "counters": counters, "gauges": gauges,
+                "histograms": hists}
+
+    def reset(self):
+        with self._lock:
+            self._metrics.clear()

@@ -52,6 +52,37 @@ import tempfile
 import numpy as _np
 
 
+def _flight_check(expect_kind=None):
+    """Assert the telemetry flight recorder left a parseable dump for
+    the kill this scenario just injected (ISSUE 9): the dump must exist,
+    parse, carry a metric snapshot, and its LAST event must be the
+    incident (``expect_kind`` prefix, e.g. ``"preemption"`` /
+    ``"fault.trip"``).  Returns None when telemetry is disabled (nothing
+    to assert — the kill switch is a supported mode)."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        return None
+    path = telemetry.last_flight_dump()
+    out = {"ok": False, "path": path}
+    if not path or not os.path.exists(path):
+        return out
+    try:
+        with open(path) as f:
+            dump = json.load(f)
+    except (OSError, ValueError) as e:
+        out["error"] = f"unparseable: {e}"
+        return out
+    events = dump.get("events") or []
+    last = events[-1] if events else {}
+    out["reason"] = dump.get("reason")
+    out["last_kind"] = last.get("kind")
+    out["last_step"] = last.get("step")
+    out["ok"] = bool(dump.get("metrics")) and bool(events) and (
+        expect_kind is None or str(last.get("kind", "")
+                                   ).startswith(expect_kind))
+    return out
+
+
 def _make_data(seed, n_batches=8, batch=16, din=8, dout=4):
     rng = _np.random.RandomState(seed)
     xs = rng.randn(n_batches, batch, din).astype(_np.float32)
@@ -175,6 +206,9 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     result["writer_kill_surfaced"] = writer_died
     result["preempted_at"] = stopped_at
     result["preempted"] = preempted
+    # the injected kill must have left a flight-recorder post-mortem
+    # whose last event IS the preemption (ISSUE 9)
+    result["flight_dump"] = _flight_check(expect_kind="preemption")
 
     # 3. corrupt the newest checkpoint: latest() must skip to an older one
     newest = mgr.latest()
@@ -208,10 +242,11 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
             step(xs[i], ys[i])
     result["params_bitwise"] = _bitwise(ref_params, _params_of(net))
     result["state_bitwise"] = _bitwise(ref_state, _state_of(trainer))
+    fd = result["flight_dump"]
     result["ok"] = bool(
         result["params_bitwise"] and result["state_bitwise"]
         and result["corrupt_skipped"]["ok"] and preempted
-        and writer_died)
+        and writer_died and (fd is None or fd["ok"]))
     return result
 
 
@@ -415,6 +450,11 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     if kind == "reshard_fault":
         checks.append(events[0]["source"] == "checkpoint")
         checks.append(result.get("rewound_to") == ckpt_step)
+        # the mid-transfer kill must have dumped the flight recorder,
+        # last event = the elastic.reshard fault trip (ISSUE 9)
+        result["flight_dump"] = _flight_check(expect_kind="fault.trip")
+        fd = result["flight_dump"]
+        checks.append(fd is None or fd["ok"])
     else:
         checks.append(events[0]["source"] == "peer")
     result["ok"] = bool(all(checks))
@@ -432,6 +472,9 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     suite = argv[0] if argv else "preempt"
     workdir = tempfile.mkdtemp(prefix="mxtpu-chaos-")
+    # flight-recorder dumps land in the scenario workdir (cleaned up
+    # with it) unless the caller pinned a directory
+    os.environ.setdefault("MXTPU_FLIGHT_DIR", workdir)
     results = []
     try:
         if suite in ("preempt", "all"):

@@ -188,6 +188,13 @@ def fault_point(name, payload=None):
         elif not f.should_fire():
             return None
         exc, action = f.exc, f.action
+    # the fault IS firing: record the trip + dump the flight recorder
+    # BEFORE the exception/action changes control flow (ISSUE 9) — the
+    # dump's last event is this trip, payload = the failing step/path.
+    # Outside the lock: telemetry has its own locks and never calls
+    # back into this module.
+    from .. import telemetry as _telem
+    _telem.on_fault(name, payload)
     if action is not None:
         return action(payload)
     raise exc if exc is not None else FaultInjected(

@@ -29,6 +29,7 @@ def _success_payload():
         "metric": "resnet50_train_images_per_sec", "value": 2068.4,
         "unit": "img/s", "vs_baseline": 1.59, "platform": "tpu",
         "platform_requested": "tpu", "platform_actual": "tpu",
+        "telemetry_schema_version": 1,
         "batch": 256, "dtype": "bf16", "data": "synthetic",
         "s2d_stem": True, "mfu": 0.235, "tflops_delivered": 46.3,
         "steps_per_call": 16, "dispatch_ms_per_step": 0.41,
@@ -433,3 +434,59 @@ def test_elastic_nulls_stay_out_of_headline():
     obj = json.loads(bench._compact_line(p))
     assert "elastic_reshard_ms" not in obj
     assert "elastic_pause_ms" not in obj
+
+
+# ----------------------------------------------------------------------
+# telemetry stamping (ISSUE 9): every bench JSON carries the telemetry
+# schema version, and telemetry-derived block fields keep the PR 6
+# null-when-unmeasured honesty rules
+# ----------------------------------------------------------------------
+
+def test_telemetry_schema_version_stamped():
+    from mxnet_tpu.telemetry import SCHEMA_VERSION
+    r = bench._stamp_telemetry({"metric": "x"})
+    assert r["telemetry_schema_version"] == SCHEMA_VERSION
+    # the stamp survives compaction into the driver headline
+    obj = _assert_headline(bench._compact_line(_success_payload()))
+    assert obj["telemetry_schema_version"] == 1
+
+
+def test_loadgen_compiles_counter_reads_through_telemetry():
+    """The loadgen's compiles_after_warmup is a before/after DELTA off
+    the process registry (one source of truth), so a second engine in
+    the same process cannot inherit the first one's count."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        return
+    telemetry.reset()
+    # simulate an earlier engine's post-warmup compile in this process
+    telemetry.inc("serving.compiles_after_warmup", 3)
+    import tools.serve_loadgen as slg
+    payload = slg.run_loadgen(n_requests=2, max_batch=2, block_size=8,
+                              max_context=64, mode="continuous",
+                              smoke=True)
+    blk = payload["serving"]
+    # the measured WINDOW saw zero compiles even though the process
+    # counter started at 3 — and the KV utilization gauge rode along
+    assert blk["compiles_after_warmup"] == 0
+    assert blk["cache_utilization"] is not None
+
+
+def test_serving_nulls_honesty_survives_telemetry(monkeypatch):
+    """With the telemetry kill switch on, serving_block fields fall
+    back to the engine's own counters — never fake zeros from an empty
+    registry."""
+    from mxnet_tpu import telemetry as telem
+    was = telem.enabled()
+    telem.configure(enabled=False)
+    try:
+        assert telem.snapshot() == {"schema_version": 1,
+                                    "enabled": False}
+        assert telem.value("serving.kv_block_utilization") is None
+        import jax
+        if jax.devices()[0].platform == "cpu":
+            blk = bench._bench_serving()
+            for k in ("p50_ms", "p99_ms", "tokens_s_chip", "occupancy"):
+                assert blk[k] is None, k
+    finally:
+        telem.configure(enabled=was)

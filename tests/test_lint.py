@@ -905,3 +905,125 @@ def test_hb12_package_is_clean():
     viol, n_files = lint_paths([pkg], rules={"HB12"})
     assert viol == [], [f"{v.path}:{v.line}" for v in viol]
     assert n_files > 50
+
+
+# ----------------------------------------------------------------------
+# HB13 — wall-clock timing of device code without sync (ISSUE 9)
+# ----------------------------------------------------------------------
+
+def test_hb13_unsynced_jit_timing_flagged():
+    out = lint_source(textwrap.dedent("""
+        import time, jax
+        def bench(step, x):
+            f = jax.jit(step)
+            t0 = time.perf_counter()
+            y = f(x)
+            dt = time.perf_counter() - t0
+            return dt
+    """), path="<hb13>")
+    assert [v.rule for v in out] == ["HB13"]
+    assert out[0].func == "bench"
+    assert "DISPATCH" in out[0].message
+
+
+def test_hb13_t1_minus_t0_loop_form_flagged():
+    # the t1-variable form with a warmup OUTSIDE the region: the warmup
+    # sync must not launder the unsynced measured loop
+    out = lint_source(textwrap.dedent("""
+        import time, jax
+        def bench(step, x, iters):
+            f = jax.jit(step)
+            f(x).block_until_ready()       # warmup, off the clock
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = f(x)
+            t1 = time.perf_counter()
+            return (t1 - t0) / iters
+    """), path="<hb13>")
+    assert [v.rule for v in out] == ["HB13"]
+
+
+def test_hb13_synced_timing_is_clean():
+    # the SUPPORTED shape: drain the device inside the timed region
+    out = lint_source(textwrap.dedent("""
+        import time, jax
+        def bench(step, x, iters):
+            f = jax.jit(step)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                y = f(x)
+            jax.block_until_ready(y)
+            dt = time.perf_counter() - t0
+            return dt
+    """), path="<hb13>")
+    assert out == []
+
+
+def test_hb13_eager_and_host_timing_are_clean():
+    # timing a plain python/host call is not device timing; nor is a
+    # perf_counter pair with no compiled call between them
+    out = lint_source(textwrap.dedent("""
+        import time
+        def bench(fn, x):
+            t0 = time.perf_counter()
+            y = fn(x)
+            host = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            parse(y)
+            return host + (time.perf_counter() - t1)
+    """), path="<hb13>")
+    assert out == []
+
+
+def test_hb13_compiled_executable_and_asnumpy_sync():
+    # .lower().compile() products count as compiled; an .asnumpy() host
+    # read inside the region IS a sync
+    out = lint_source(textwrap.dedent("""
+        import time, jax
+        def bench(step, x):
+            f = jax.jit(step).lower(x).compile()
+            t0 = time.perf_counter()
+            y = f(x)
+            dt = time.perf_counter() - t0
+            t1 = time.perf_counter()
+            z = f(x)
+            total = z.asnumpy().sum()
+            dt2 = time.perf_counter() - t1
+            return dt + dt2
+    """), path="<hb13>")
+    assert [v.rule for v in out] == ["HB13"]
+    assert out[0].line == 7          # only the UNSYNCED first region
+
+
+def test_hb13_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB13" in RULES
+    assert RULES["HB13"].bad and RULES["HB13"].good
+    out = lint_source(textwrap.dedent("""
+        import time, jax
+        def bench(step, x):
+            f = jax.jit(step)
+            t0 = time.perf_counter()
+            y = f(x)
+            dt = time.perf_counter() - t0  # mxlint: disable=HB13
+            return dt
+    """), path="<hb13>")
+    assert out == []
+
+
+def test_hb13_package_is_clean():
+    """Every wall-clock measurement of compiled dispatch in the
+    framework — including the new telemetry/ package that exists to
+    TAKE such measurements — must sync inside the region or time only
+    host work."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB13"})
+    assert viol == [], [f"{v.path}:{v.line}" for v in viol]
+    assert n_files > 50
+    # the telemetry package is part of the linted tree
+    import mxnet_tpu.telemetry as telem
+    tdir = os.path.dirname(os.path.abspath(telem.__file__))
+    tviol, tn = lint_paths([tdir], rules={"HB13"})
+    assert tviol == [] and tn >= 5

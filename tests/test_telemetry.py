@@ -1,0 +1,406 @@
+"""mx.telemetry (ISSUE 9): the unified observability spine.
+
+Covers the tentpole's contracts:
+
+- registry semantics under FakeClock — fixed-edge histogram
+  determinism, counter/gauge behavior, snapshot shape;
+- event ring eviction + monotonic ``seq`` + JSONL schema round-trip;
+- the disabled-mode (``MXTPU_TELEMETRY=0``) zero-allocation path, and
+  the acceptance gate that an instrumented train step is BITWISE
+  identical with telemetry on vs off;
+- flight-recorder dumps on an injected ``train.step`` fault and on a
+  real SIGTERM through the PR 4 ``PreemptionHandler``, with the dump's
+  last event matching the failing step;
+- ONE end-to-end smoke whose single ``telemetry.snapshot()`` contains
+  step, serving, checkpoint, and elastic metrics from the SAME
+  registry (the acceptance criterion);
+- Prometheus text rendering and the PS server's live scrape RPC.
+"""
+import json
+import os
+import signal
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.telemetry.registry import (MetricsRegistry, NULL_METRIC,
+                                          DEFAULT_MS_EDGES)
+from mxnet_tpu.telemetry.events import EventLog, SCHEMA_VERSION
+from mxnet_tpu.testing import faults
+from mxnet_tpu.testing.faults import FakeClock
+
+
+# ----------------------------------------------------------------------
+# registry semantics (FakeClock, determinism)
+# ----------------------------------------------------------------------
+
+def test_counter_gauge_histogram_basics():
+    clock = FakeClock(1000.0)
+    reg = MetricsRegistry(now=clock)
+    reg.counter("a").inc()
+    reg.counter("a").inc(4)
+    reg.gauge("g").set(2.5)
+    h = reg.histogram("h")
+    for v in (0.05, 0.3, 7.0, 99999.0):
+        h.observe(v)
+    snap = reg.snapshot()
+    assert snap["time"] == 1000.0            # injectable clock
+    assert snap["counters"] == {"a": 5}
+    assert snap["gauges"] == {"g": 2.5}
+    hs = snap["histograms"]["h"]
+    assert hs["edges"] == list(DEFAULT_MS_EDGES)
+    assert hs["count"] == 4 and hs["sum"] == pytest.approx(100006.35)
+    assert hs["min"] == 0.05 and hs["max"] == 99999.0
+    # 0.05 <= 0.1 (slot 0); 0.3 <= 0.5 (slot 2); 7 <= 10 (slot 6);
+    # 99999 overflows into the last slot
+    assert hs["counts"][0] == 1 and hs["counts"][2] == 1
+    assert hs["counts"][6] == 1 and hs["counts"][-1] == 1
+    # the registry refuses a silent kind change for a name
+    with pytest.raises(MXNetError):
+        reg.gauge("a")
+    assert reg.value("a") == 5 and reg.value("missing") is None
+
+
+def test_histogram_fixed_edges_are_deterministic():
+    """Same observations -> bit-identical snapshot state across two
+    registries: fixed edges are the cross-worker aggregation contract."""
+    obs = [0.2, 1.7, 1.7, 42.0, 9999.0, 0.0001]
+    snaps = []
+    for _ in range(2):
+        reg = MetricsRegistry(now=FakeClock(5.0))
+        for v in obs:
+            reg.histogram("x").observe(v)
+        snaps.append(json.dumps(reg.snapshot(), sort_keys=True))
+    assert snaps[0] == snaps[1]
+    # re-registration with different edges is an ERROR, not a re-bin
+    reg = MetricsRegistry()
+    reg.histogram("x", edges=(1.0, 2.0))
+    with pytest.raises(MXNetError):
+        reg.histogram("x", edges=(1.0, 3.0))
+
+
+def test_ring_eviction_and_monotonic_seq():
+    log = EventLog(ring_size=4, now=FakeClock(10.0))
+    for i in range(10):
+        log.emit("tick", i=i)
+    evs = log.events()
+    assert len(evs) == 4                      # bounded ring
+    assert [e["seq"] for e in evs] == [7, 8, 9, 10]   # monotonic, no gap
+    assert log.seq == 10                      # total seen, not ring len
+    assert evs[-1]["data"] == {"i": 9}
+
+
+def test_event_log_jsonl_schema_roundtrip(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    log = EventLog(ring_size=8, path=path, now=FakeClock(77.0))
+    log.set_context(step=3, epoch=1)
+    log.emit("membership.death", rank=1)
+    log.emit("checkpoint.saved", step=3)
+    log.close()
+    lines = [json.loads(l) for l in open(path)]
+    assert len(lines) == 2
+    for rec in lines:
+        assert set(rec) == {"v", "seq", "t", "kind", "step", "epoch",
+                            "data"}
+        assert rec["v"] == SCHEMA_VERSION
+        assert rec["t"] == 77.0
+        assert rec["step"] == 3 and rec["epoch"] == 1
+    assert [r["seq"] for r in lines] == [1, 2]
+    assert lines[0]["kind"] == "membership.death"
+    assert lines[0]["data"] == {"rank": 1}
+
+
+def test_module_event_log_env_wiring(tmp_path, monkeypatch):
+    """MXTPU_EVENT_LOG picked up by configure_from_env: the module-level
+    emit path appends JSONL while the ring keeps serving the flight
+    recorder."""
+    path = str(tmp_path / "stream.jsonl")
+    monkeypatch.setenv("MXTPU_EVENT_LOG", path)
+    monkeypatch.setenv("MXTPU_TELEMETRY_RING", "3")
+    telemetry.configure_from_env()
+    try:
+        for i in range(5):
+            telemetry.event("tick", i=i)
+        assert len(telemetry.events()) == 3          # ring honored
+        recs = [json.loads(l) for l in open(path)]
+        assert [r["data"]["i"] for r in recs] == list(range(5))
+    finally:
+        monkeypatch.delenv("MXTPU_EVENT_LOG")
+        monkeypatch.delenv("MXTPU_TELEMETRY_RING")
+        telemetry.configure_from_env()
+
+
+# ----------------------------------------------------------------------
+# disabled mode: zero allocation, no registry growth, helpers inert
+# ----------------------------------------------------------------------
+
+def test_disabled_mode_zero_allocation_path():
+    was = telemetry.enabled()
+    telemetry.configure(enabled=False)
+    try:
+        # every accessor hands back the ONE shared null metric
+        assert telemetry.counter("x") is NULL_METRIC
+        assert telemetry.gauge("y") is NULL_METRIC
+        assert telemetry.histogram("z") is NULL_METRIC
+        telemetry.inc("x", 5)
+        telemetry.observe("z", 1.0)
+        telemetry.set_gauge("y", 2)
+        telemetry.event("never", a=1)
+        telemetry.set_context(step=9)
+        assert telemetry.context() == {}
+        assert telemetry.events() == []
+        assert telemetry.value("x") is None
+        assert telemetry.snapshot() == {"schema_version": SCHEMA_VERSION,
+                                        "enabled": False}
+        # nothing leaked into the real registry behind the switch
+        assert telemetry.registry().snapshot()["counters"] == {}
+        assert telemetry.dump_flight("reason") is None
+        # the hot-path cost is one module-bool check; 20k no-op calls
+        # must be effectively free (very generous CI bound)
+        t0 = time.perf_counter()
+        for _ in range(20000):
+            telemetry.inc("x")
+        assert time.perf_counter() - t0 < 1.0
+    finally:
+        telemetry.configure(enabled=was)
+
+
+def _seeded_trainer():
+    mx.random.seed(1234)
+    np.random.seed(1234)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    return net, parallel.DataParallelTrainer(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.05},
+        shard_updates=True)
+
+
+def test_instrumented_step_bitwise_identical_with_telemetry_off():
+    """The acceptance gate: MXTPU_TELEMETRY=0 must not change a single
+    bit of the training math — instrumentation only ever reads clocks
+    and publishes host-side numbers."""
+    rng = np.random.RandomState(7)
+    xs = rng.randn(3, 16, 8).astype(np.float32)
+    ys = rng.randn(3, 16, 4).astype(np.float32)
+
+    results = {}
+    for mode in (True, False):
+        telemetry.configure(enabled=mode)
+        telemetry.reset()
+        try:
+            net, tr = _seeded_trainer()
+            for i in range(3):
+                tr.step(mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+            results[mode] = {
+                n: p.data().asnumpy()
+                for n, p in net._collect_params_with_prefix().items()}
+            if mode:
+                snap = telemetry.snapshot()
+                assert snap["counters"]["train.steps"] == 3
+                assert snap["histograms"]["train.step_ms"]["count"] == 3
+                assert snap["context"]["step"] == 3
+            else:
+                assert telemetry.registry().snapshot()["counters"] == {}
+        finally:
+            telemetry.configure(enabled=True)
+    assert set(results[True]) == set(results[False])
+    for k in results[True]:
+        assert np.array_equal(results[True][k], results[False][k]), k
+
+
+# ----------------------------------------------------------------------
+# flight recorder: injected train.step fault + real SIGTERM
+# ----------------------------------------------------------------------
+
+def test_flight_dump_on_injected_train_step_fault(tmp_path, monkeypatch):
+    from mxnet_tpu.checkpoint import PreemptionHandler
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    handler = PreemptionHandler().install()
+    try:
+        with faults.inject("train.step", at=3):
+            with pytest.raises(faults.FaultInjected):
+                for step in range(1, 6):
+                    telemetry.set_context(step=step)
+                    handler.check_step(step)
+    finally:
+        handler.uninstall()
+    path = telemetry.last_flight_dump()
+    assert path and path.startswith(str(tmp_path))
+    dump = json.load(open(path))
+    assert dump["reason"] == "fault:train.step"
+    last = dump["events"][-1]
+    # the dump's last event IS the failing step (acceptance criterion)
+    assert last["kind"] == "fault.trip"
+    assert last["step"] == 3
+    assert last["data"] == {"site": "train.step", "payload": 3}
+    assert dump["metrics"]["counters"]["faults.trips"] == 1
+
+
+def test_flight_dump_on_sigterm(tmp_path, monkeypatch):
+    """A REAL SIGTERM through the installed PreemptionHandler (the PR 4
+    stop seam) leaves a parseable post-mortem whose last event is the
+    preemption."""
+    from mxnet_tpu.checkpoint import PreemptionHandler
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.set_context(step=41)
+    telemetry.inc("train.steps", 41)
+    with PreemptionHandler() as handler:
+        signal.raise_signal(signal.SIGTERM)
+        assert handler.requested
+    path = telemetry.last_flight_dump()
+    assert path and os.path.exists(path)
+    dump = json.load(open(path))
+    assert dump["reason"].startswith("preemption:signal")
+    last = dump["events"][-1]
+    assert last["kind"] == "preemption" and last["step"] == 41
+    assert dump["metrics"]["counters"]["train.steps"] == 41
+    assert dump["metrics"]["counters"]["preemptions"] == 1
+
+
+# ----------------------------------------------------------------------
+# the end-to-end acceptance smoke: ONE snapshot, every subsystem
+# ----------------------------------------------------------------------
+
+def _tiny_llama():
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=1,
+                      num_heads=2, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=64, tie_embeddings=True)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    net(mx.nd.array(np.zeros((1, 4), np.int32)))
+    return net
+
+
+def test_unified_snapshot_across_subsystems(tmp_path):
+    """The ISSUE 9 acceptance criterion: after training steps, a
+    checkpoint save/restore, a serving run, and an elastic membership
+    transition, ONE ``telemetry.snapshot()`` carries step, serving,
+    checkpoint, and elastic metrics from the same registry."""
+    import jax
+    from mxnet_tpu import elastic
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.serving import ContinuousBatcher, InferenceEngine, \
+        Request
+    from mxnet_tpu.parallel.mesh import make_mesh
+
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device CPU mesh")
+
+    # -- train at dp=8, checkpoint, elastic shrink to dp=4 -------------
+    mx.random.seed(9)
+    np.random.seed(9)
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    trainer = parallel.DataParallelTrainer(
+        net, gluon.loss.L2Loss(), "adam", {"learning_rate": 0.05},
+        mesh=make_mesh({"dp": 8}, devices[:8]), shard_updates=True)
+    rng = np.random.RandomState(0)
+    x = mx.nd.array(rng.randn(16, 8).astype(np.float32))
+    y = mx.nd.array(rng.randn(16, 4).astype(np.float32))
+    trainer.step(x, y)
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=2)
+    mgr.save(1, params=net, trainer=trainer, sync=True)
+    mgr.restore(params=net, trainer=trainer)
+
+    clock = FakeClock(1000.0)
+    membership = elastic.Membership([0, 1], now=clock)
+    ctrl = elastic.ElasticController(
+        membership, devices=devices, devices_per_worker=4, net=net,
+        backoff_s=0.0, now=clock, sleep=lambda s: None)
+    membership.worker_dead(1)
+    ev = ctrl.check_step(1, trainer, params=net)
+    assert ev is not None and ev["source"] == "peer"
+    trainer.step(x, y)                    # first post-reshard step
+
+    # -- serve a couple of requests through the compiled engine --------
+    engine = InferenceEngine(_tiny_llama(), max_batch=2, block_size=8,
+                             max_context=32)
+    engine.warmup()
+    batcher = ContinuousBatcher(engine)
+    for toks, new in (([3, 5, 7], 2), ([11, 2], 3)):
+        batcher.submit(Request(toks, max_new_tokens=new))
+    batcher.run()
+
+    # -- ONE snapshot, every subsystem -------------------------------
+    snap = telemetry.snapshot()
+    c, g, h = snap["counters"], snap["gauges"], snap["histograms"]
+    assert c["train.steps"] == 2                        # step metrics
+    assert h["train.step_ms"]["count"] == 2
+    assert h["train.dispatch_ms"]["count"] == 2
+    assert c["checkpoint.saves"] == 1                   # checkpoint
+    assert c["checkpoint.restores"] == 1
+    assert c["checkpoint.bytes"] > 0
+    assert h["checkpoint.save_ms"]["count"] == 1
+    assert c["elastic.transitions"] == 1                # elastic
+    assert g["elastic.epoch"] == 1 and g["elastic.dp"] == 4
+    assert g["elastic.reshard_ms"] > 0
+    assert c["serving.decode_calls"] > 0                # serving
+    assert c["serving.prefill_calls"] >= 2
+    assert c["serving.tokens_generated"] == 5
+    assert h["serving.ttft_ms"]["count"] == 2
+    assert g["serving.kv_block_utilization"] is not None
+    # zero retraces after warmup: the counter never materialized
+    assert c.get("serving.compiles_after_warmup", 0) == 0
+    # ambient context: last committed step + membership epoch
+    assert snap["context"] == {"step": 2, "epoch": 1}
+    # the event ring saw the transition and the checkpoint lifecycle
+    kinds = [e["kind"] for e in telemetry.events()]
+    assert "membership.death" in kinds
+    assert "elastic.transition" in kinds
+    assert "checkpoint.saved" in kinds and "checkpoint.restored" in kinds
+    # the whole snapshot is JSON-able (the dump/scrape contract)
+    assert json.loads(json.dumps(snap)) == snap
+
+
+# ----------------------------------------------------------------------
+# rendering + live scrape
+# ----------------------------------------------------------------------
+
+def test_prom_text_rendering():
+    telemetry.inc("train.steps", 12)
+    telemetry.set_gauge("elastic.epoch", 3)
+    telemetry.observe("train.step_ms", 2.0, edges=(1.0, 4.0))
+    telemetry.set_context(step=12, epoch=3)
+    text = telemetry.prom_text()
+    assert "# TYPE mxtpu_train_steps counter" in text
+    assert "mxtpu_train_steps 12" in text
+    assert "mxtpu_elastic_epoch 3" in text
+    assert 'mxtpu_train_step_ms_bucket{le="4.0"} 1' in text
+    assert 'mxtpu_train_step_ms_bucket{le="+Inf"} 1' in text
+    assert "mxtpu_train_step_ms_count 1" in text
+    assert "mxtpu_context_step 12" in text
+    # disabled snapshot renders a comment, not fake zeros
+    assert "disabled" in telemetry.prom_text(
+        {"schema_version": 1, "enabled": False})
+
+
+def test_ps_server_telemetry_scrape_rpc():
+    """The PS server doubles as the live scrape endpoint: _OP_TELEMETRY
+    returns this process's snapshot (json) or prom text."""
+    import socket
+    from mxnet_tpu.kvstore.ps_server import PSClient, PSServer
+    telemetry.inc("train.steps", 5)
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    srv = PSServer("127.0.0.1", port, num_workers=1)
+    client = PSClient("127.0.0.1", port)
+    try:
+        snap = client.telemetry()
+        assert snap["counters"]["train.steps"] == 5
+        assert snap["schema_version"] == SCHEMA_VERSION
+        prom = client.telemetry(fmt="prom")
+        assert prom["format"] == "prom"
+        assert "mxtpu_train_steps 5" in prom["text"]
+    finally:
+        client.close()
+        srv._sock.close()

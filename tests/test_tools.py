@@ -420,3 +420,51 @@ def test_bench_projection_plumbs_measured_sweep():
     out2 = bench._scaling_projection(resnet, None)
     assert "error" not in out2
     assert "input_feed_cap" not in out2["inputs"]
+
+
+# ----------------------------------------------------------------------
+# tools/telemetry_dump.py (ISSUE 9): flight-dump/snapshot rendering +
+# the live PS-server scrape path — tier-1 smoke
+# ----------------------------------------------------------------------
+
+def test_telemetry_dump_renders_flight_file(tmp_path):
+    """End-to-end: take a real flight-recorder dump in-process, then
+    render it with the offline tool in both formats."""
+    import json as _json
+    from mxnet_tpu import telemetry
+    telemetry.inc("train.steps", 7)
+    telemetry.set_gauge("elastic.epoch", 2)
+    telemetry.observe("train.step_ms", 12.5)
+    telemetry.event("unit.test", detail="smoke")
+    path = telemetry.dump_flight("unit-test",
+                                 path=str(tmp_path / "flight.json"))
+    assert path is not None and os.path.exists(path)
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_dump.py"),
+         "--file", path, "--format=prom", "--events"],
+        capture_output=True, text=True, timeout=120, env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    assert "mxtpu_train_steps 7" in r.stdout
+    assert "# TYPE mxtpu_train_step_ms histogram" in r.stdout
+    assert 'reason=' in r.stdout          # flight header line
+    # --events appends the ring as JSONL; the last line is our event
+    ev = _json.loads(r.stdout.strip().splitlines()[-1])
+    assert ev["kind"] == "unit.test" and ev["v"] == 1
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_dump.py"),
+         "--file", path, "--format=json"],
+        capture_output=True, text=True, timeout=120, env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    payload = _json.loads(r.stdout)
+    assert payload["reason"] == "unit-test"
+    assert payload["metrics"]["counters"]["train.steps"] == 7
+
+
+def test_telemetry_dump_self_test_prom():
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "telemetry_dump.py"),
+         "--self-test", "--format=prom"],
+        capture_output=True, text=True, timeout=300, env=_cpu_env())
+    assert r.returncode == 0, r.stderr
+    assert "mxtpu_selftest_counter 3" in r.stdout
+    assert 'mxtpu_selftest_ms_bucket{le="+Inf"} 1' in r.stdout

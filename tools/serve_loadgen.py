@@ -68,6 +68,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                 mode="both", smoke=True, quantize=None, seed=0):
     """Run the mix through the chosen scheduling policy(ies); returns
     the bench `serving` payload."""
+    from mxnet_tpu import telemetry
     from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
                                    StaticBatcher, serving_block)
     results = {}
@@ -100,6 +101,13 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         batcher = cls(engine)
         for req in _requests(n_requests, cfg.vocab_size, seed):
             batcher.submit(req)
+        # ISSUE 9 thin-reader discipline: the measured window's compile
+        # count comes off the PROCESS telemetry registry (the same
+        # source a live scrape sees) as a before/after delta — the
+        # registry outlives the two per-policy engines this function
+        # builds.  Engine-local stats remain the fallback when the
+        # telemetry kill switch is on.
+        caw0 = telemetry.value("serving.compiles_after_warmup")
         t0 = time.perf_counter()
         stats = batcher.run()
         wall = time.perf_counter() - t0
@@ -109,8 +117,15 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         stats["tokens_per_step"] = round(
             stats["tokens_generated"] / stats["decode_steps"], 3) \
             if stats["decode_steps"] else None
-        stats["compiles_after_warmup"] = \
-            engine.stats["compiles_after_warmup"]
+        if telemetry.enabled():
+            caw1 = telemetry.value("serving.compiles_after_warmup")
+            stats["compiles_after_warmup"] = (caw1 or 0) - (caw0 or 0)
+            stats["cache_utilization"] = telemetry.value(
+                "serving.kv_block_utilization")
+        else:
+            stats["compiles_after_warmup"] = \
+                engine.stats["compiles_after_warmup"]
+            stats["cache_utilization"] = None
         stats["ttfts"] = sorted(
             round(r.ttft(), 4) for r in batcher.finished
             if r.ttft() is not None)
@@ -130,7 +145,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         occupancy=cont.get("occupancy"),
         tokens_per_step=cont.get("tokens_per_step"),
         compiles_after_warmup=cont.get("compiles_after_warmup"),
-        cache_utilization=None)
+        cache_utilization=cont.get("cache_utilization"))
     payload = {"metric": "serve_loadgen", "mode": mode,
                "smoke": bool(smoke), "serving": blk,
                "policies": {k: {kk: vv for kk, vv in v.items()

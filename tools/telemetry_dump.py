@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Read mx.telemetry state — live or post-mortem — and print it
+(ISSUE 9 tooling).
+
+Three sources, one renderer:
+
+  --file PATH        a flight-recorder dump (``mxtpu_flight.<pid>.json``)
+                     or a bare ``snapshot()`` JSON file
+  --host H --port P  live scrape over the PS server's ``_OP_TELEMETRY``
+                     RPC (any running job with a PSServer — dist_async
+                     training, the elastic membership server — doubles
+                     as a scrape endpoint, no extra port)
+  --self-test        emit a tiny in-process registry (smoke/demo)
+
+``--format=prom`` prints Prometheus text exposition (the scrape
+integration path); ``--format=json`` prints the snapshot/dump verbatim.
+For flight-recorder files, ``--events`` appends the event ring as JSONL
+after the metrics.
+
+Examples:
+  python tools/telemetry_dump.py --file /tmp/mxtpu_flight.4242.json
+  python tools/telemetry_dump.py --host 127.0.0.1 --port 9090 --format=prom
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _load_file(path):
+    with open(path, encoding="utf-8") as f:
+        payload = json.load(f)
+    # flight dump wraps the snapshot under "metrics"; a bare snapshot
+    # has "counters"/"gauges" at top level
+    if "metrics" in payload and "counters" not in payload:
+        return payload, payload["metrics"]
+    return payload, payload
+
+
+def _scrape(host, port, fmt):
+    from mxnet_tpu.kvstore.ps_server import PSClient
+    client = PSClient(host, port, retries=3)
+    try:
+        return client.telemetry(fmt=fmt)
+    finally:
+        client.close()
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--file", help="flight-recorder dump or snapshot JSON")
+    ap.add_argument("--host", help="PS server host for a live scrape")
+    ap.add_argument("--port", type=int, help="PS server port")
+    ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--events", action="store_true",
+                    help="also print the event ring (flight dumps) as "
+                         "JSONL")
+    ap.add_argument("--self-test", action="store_true",
+                    help="render a tiny in-process registry and exit")
+    args = ap.parse_args(argv)
+
+    from mxnet_tpu.telemetry.prom import prom_text
+
+    if args.self_test:
+        from mxnet_tpu import telemetry
+        telemetry.inc("selftest.counter", 3)
+        telemetry.set_gauge("selftest.gauge", 1.5)
+        telemetry.observe("selftest.ms", 2.0)
+        snap = telemetry.snapshot()
+        print(prom_text(snap) if args.format == "prom"
+              else json.dumps(snap, indent=1))
+        return 0
+
+    if args.file:
+        payload, snap = _load_file(args.file)
+        if args.format == "json":
+            print(json.dumps(payload, indent=1))
+        else:
+            if payload is not snap and "reason" in payload:
+                print(f"# flight dump: reason={payload['reason']!r} "
+                      f"pid={payload.get('pid')} "
+                      f"t={payload.get('time')}")
+            print(prom_text(snap), end="")
+        if args.events and payload is not snap:
+            for ev in payload.get("events", []):
+                print(json.dumps(ev))
+        return 0
+
+    if args.host and args.port:
+        out = _scrape(args.host, args.port, args.format)
+        if args.format == "prom":
+            print(out.get("text", ""), end="")
+        else:
+            print(json.dumps(out, indent=1))
+        return 0
+
+    ap.error("need --file, --host/--port, or --self-test")
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

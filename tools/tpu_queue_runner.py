@@ -504,6 +504,17 @@ def run_chaos(suite: str = "preempt") -> int:
         env=env, cwd=REPO, capture_output=True, text=True, timeout=600)
     verdicts = _json_lines(r.stdout)
     if r.returncode == 0 and verdicts and verdicts[-1].get("ok"):
+        # ISSUE 9: every scenario that injected a kill must have left a
+        # parseable flight-recorder dump (chaos records the check per
+        # scenario; None = telemetry kill switch, nothing to assert)
+        bad = [s.get("kind") or s.get("mode")
+               for s in verdicts[-1].get("chaos", [])
+               if "flight_dump" in s and s["flight_dump"] is not None
+               and not s["flight_dump"].get("ok")]
+        if bad:
+            _log(f"chaos smoke: FAILED — injected kill left no valid "
+                 f"flight-recorder dump in scenario(s) {bad}")
+            return 1
         _log("chaos smoke: OK " + json.dumps(verdicts[-1]))
         return 0
     _log(f"chaos smoke: FAILED rc={r.returncode}\n"
