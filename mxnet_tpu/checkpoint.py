@@ -58,6 +58,7 @@ import numpy as _np
 import jax
 
 from .base import MXNetError
+from .lint import racecheck as _racecheck
 from .ndarray.ndarray import NDArray
 from .ndarray import utils as nd_utils
 from .testing import faults as _faults
@@ -108,7 +109,7 @@ class AsyncCheckpointer:
 
     def __init__(self):
         self._current = None   # (thread, ticket)
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("AsyncCheckpointer._lock")
 
     def save(self, fname, arrays):
         """Snapshot ``arrays`` (name -> NDArray) and write them to

@@ -35,10 +35,10 @@ Every timeout decision reads the injectable ``_now`` clock (the PR 4
 from __future__ import annotations
 
 import os
-import threading
 import time
 
 from ..base import MXNetError
+from ..lint import racecheck as _racecheck
 from .. import telemetry as _telem
 
 __all__ = ["Membership", "MembershipEvent", "StaleMembershipEpoch",
@@ -92,7 +92,7 @@ class Membership:
     """
 
     def __init__(self, ranks, epoch=0, now=None, rendezvous_s=None):
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("Membership._lock")
         self._ranks = sorted(int(r) for r in ranks)
         if len(set(self._ranks)) != len(self._ranks):
             raise MXNetError(f"duplicate ranks in {ranks!r}")
@@ -147,7 +147,7 @@ class Membership:
             self._subscribers.append(fn)
 
     # -- transitions ----------------------------------------------------
-    def _emit(self, kind, rank):
+    def _emit(self, kind, rank):  # guarded-by: _lock
         """Record + fan out one event.  Caller holds the lock; subscriber
         callbacks run OUTSIDE it (a controller may call back into us).
         Every committed transition also lands in the telemetry event log

@@ -35,6 +35,7 @@ import queue as _queue
 import numpy as _np
 
 from ..base import MXNetError
+from ..lint import racecheck as _racecheck
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telem
 
@@ -68,7 +69,7 @@ class PipelineStats:
     """
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("PipelineStats._lock")
         self.decode_s = 0.0
         self.h2d_s = 0.0
         self.compute_s = 0.0
@@ -94,19 +95,26 @@ class PipelineStats:
         """Per-stage ms/batch plus ``overlap_efficiency`` — the fraction
         of consumer wall time spent computing rather than stalled
         waiting for input (1.0 = input pipeline fully hidden)."""
-        n = max(self.batches, 1)
-        busy = self.compute_s + self.stall_s
+        # snapshot under the lock (HB14: the producer thread's add() is
+        # mid-update otherwise — a torn batches/decode_s pair skews the
+        # per-batch figures); compute after release
+        with self._lock:
+            decode_s, h2d_s = self.decode_s, self.h2d_s
+            compute_s, stall_s = self.compute_s, self.stall_s
+            batches, h2d_bytes = self.batches, self.h2d_bytes
+        n = max(batches, 1)
+        busy = compute_s + stall_s
         out = {
-            "batches": self.batches,
-            "decode_ms_per_batch": round(self.decode_s / n * 1e3, 2),
-            "h2d_ms_per_batch": round(self.h2d_s / n * 1e3, 2),
-            "compute_ms_per_batch": round(self.compute_s / n * 1e3, 2),
-            "stall_ms_per_batch": round(self.stall_s / n * 1e3, 2),
-            "overlap_efficiency": round(self.compute_s / busy, 4)
+            "batches": batches,
+            "decode_ms_per_batch": round(decode_s / n * 1e3, 2),
+            "h2d_ms_per_batch": round(h2d_s / n * 1e3, 2),
+            "compute_ms_per_batch": round(compute_s / n * 1e3, 2),
+            "stall_ms_per_batch": round(stall_s / n * 1e3, 2),
+            "overlap_efficiency": round(compute_s / busy, 4)
             if busy > 0 else None,
         }
-        if self.h2d_bytes and self.h2d_s > 0:
-            out["h2d_gb_s"] = round(self.h2d_bytes / self.h2d_s / 1e9, 2)
+        if h2d_bytes and h2d_s > 0:
+            out["h2d_gb_s"] = round(h2d_bytes / h2d_s / 1e9, 2)
         return out
 
 

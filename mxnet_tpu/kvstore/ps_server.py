@@ -49,6 +49,8 @@ import zlib
 
 import numpy as _np
 
+from ..lint import racecheck as _racecheck
+
 __all__ = ["PSServer", "PSClient", "default_ps_addr", "ps_addrs",
            "key_to_server"]
 
@@ -226,18 +228,20 @@ class PSServer:
     (``python -m mxnet_tpu.kvstore.ps_server`` under launch.py -s N)."""
 
     def __init__(self, host, port, num_workers, heartbeat_timeout=None):
-        self._table = {}          # key -> np.ndarray (the live weights)
+        self._lock = _racecheck.make_lock("PSServer._lock")
+        # key -> np.ndarray (the live weights); racecheck-registered:
+        # under MXTPU_RACECHECK=1 any access off self._lock is a finding
+        self._table = _racecheck.guard({}, self._lock, "PSServer._table")
         self._updater = None      # server-side optimizer (set_optimizer;
                                   # per-key state lives in _ServerUpdater)
         self._push_count = {}     # key -> applied pushes (incl. stale)
         from collections import deque
         self._commands = deque(maxlen=64)   # recent controller messages,
                                             # readable via _OP_CMDLOG
-        self._lock = threading.Lock()
         self._num_workers = num_workers
         self._barrier_gen = 0
         self._barrier_count = 0
-        self._barrier_cv = threading.Condition()
+        self._barrier_cv = _racecheck.make_condition("PSServer._barrier_cv")
         # failure detection (reference ps-lite heartbeat: workers beat,
         # PS_HEARTBEAT_TIMEOUT seconds of silence marks a node dead).
         # 0 disables, like ps-lite's default.
@@ -448,15 +452,21 @@ class PSServer:
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(log))
         elif op == _OP_HEARTBEAT:
             (rank,) = struct.unpack_from("<i", frame, off)
+            rejoined = False
             with self._lock:
                 self._last_seen[rank] = self._now()
                 if rank in self._dead:
                     # a beat from a "dead" rank: it was only slow (or the
-                    # launcher restarted it) — log the rejoin, async mode
-                    # simply resumes applying its pushes
+                    # launcher restarted it) — async mode simply resumes
+                    # applying its pushes
                     del self._dead[rank]
-                    print(f"[ps_server] worker rank {rank} heartbeat "
-                          f"resumed; marking alive again", flush=True)
+                    rejoined = True
+            if rejoined:
+                # log OUTSIDE the table lock (HB16): console I/O can
+                # block on a slow/full pipe, and every serve thread's
+                # push/pull would stall behind it
+                print(f"[ps_server] worker rank {rank} heartbeat "
+                      f"resumed; marking alive again", flush=True)
             _send_frame(conn, bytes([_OP_OK]))
         elif op == _OP_HEALTH:
             now = self._now()
@@ -570,13 +580,17 @@ class PSClient:
         else:
             raise ConnectionError(f"cannot reach PS at {host}:{port}: "
                                   f"{last}")
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("PSClient._lock")
         self._addr = (host, port)
         self._hb_stop = None      # threading.Event while beating
 
     def _rpc(self, payload):
+        # the lock IS the RPC channel: one request/response pair in
+        # flight per socket, so the wire round necessarily happens with
+        # it held — callers that must not stall (heartbeats) use their
+        # own socket (start_heartbeat), exactly because of this
         with self._lock:
-            _send_frame(self._sock, payload)
+            _send_frame(self._sock, payload)  # mxlint: disable=HB16 -- the lock serializes this socket; see above
             resp = _recv_frame(self._sock)
         op = resp[0]
         if op == _OP_OK:

@@ -14,10 +14,24 @@ framework-level diagnostics with stable rule IDs:
     HB06  as_in_context / device transfers in a hot forward
     HB07  eager collectives (kvstore push/pull/pushpull, process_allgather)
           inside Python loops — module-wide, not just forwards
+    HB14  unguarded shared state (locked in one method, bare in another;
+          `# guarded-by:` annotations) — interprocedural, concurrency.py
+    HB15  lock-order inversion (cycle in the acquisition graph, merged
+          across every linted file)
+    HB16  blocking call (device sync / RPC / file IO / queue.get /
+          time.sleep / jitted dispatch) inside a `with lock:` body
 
 CLI: ``python tools/mxlint.py <paths>`` (non-zero exit on violations,
-``--format=json|text``, per-line ``# mxlint: disable=HB0x``). Rule
-catalog with bad/good snippets: ``docs/LINT.md`` or ``--list-rules``.
+``--format=json|text``, per-line ``# mxlint: disable=HB0x``,
+``--write-baseline``/``--baseline``/``--fail-on-new`` to gate CI on
+regressions only). Rule catalog with bad/good snippets:
+``docs/LINT.md`` or ``--list-rules``.
+
+Runtime side 2 (``racecheck``): with ``MXTPU_RACECHECK=1`` the threaded
+subsystems create their locks through ``lint.racecheck.make_lock``,
+which maintains a live lock-order graph (cycles flagged the moment an
+edge closes one) and checks registered guarded structures; findings
+dump through the telemetry flight recorder.  Zero overhead when off.
 
 Runtime side: every ``hybridize()``'d block counts its jax.jit cache
 misses (gluon/block.py CachedOp) and emits a :class:`RetraceWarning`
@@ -35,10 +49,12 @@ from .api import check, lint_paths
 from .report import Violation, render_json, render_text
 from .retrace import RetraceMonitor, RetraceWarning, default_threshold
 from .rules import ALL_RULE_IDS, RULES, Rule
+from . import racecheck
 
 __all__ = [
     "check", "lint_paths", "lint_source", "lint_file",
     "Violation", "render_text", "render_json",
     "RULES", "Rule", "ALL_RULE_IDS",
     "RetraceMonitor", "RetraceWarning", "default_threshold",
+    "racecheck",
 ]

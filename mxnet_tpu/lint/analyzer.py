@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import ast
 
+from .concurrency import run_concurrency_pass
 from .report import Violation
 from .suppressions import parse_suppressions, is_suppressed
 
@@ -1274,6 +1275,7 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
     applied). ``only_classes`` restricts reporting to those class names;
     ``rules`` restricts to a subset of rule IDs."""
     tree = ast.parse(source, filename=path)
+    src_lines = source.splitlines()
     index = _ModuleIndex(tree)
     collector = _Collector(index, path)
     for cname in index.classes:
@@ -1298,8 +1300,10 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
         _MultiStepPullScanner(collector, path).visit(tree)
         _DecodeLoopPullScanner(collector, path).visit(tree)
         _UnsyncedTimingScanner(collector, path).visit(tree)
+        # HB14/HB15/HB16: the interprocedural concurrency pass (per-class
+        # lock + field-access + call-graph model; concurrency.py)
+        run_concurrency_pass(collector, tree, path, src_lines)
     suppressed, _unknown = parse_suppressions(source)
-    src_lines = source.splitlines()
     out = []
     for v in sorted(collector.violations,
                     key=lambda v: (v.line, v.col, v.rule)):

@@ -98,7 +98,12 @@ def _dedupe(violations):
 
 def lint_paths(paths, rules=None):
     """Lint files and directories (recursing into ``*.py``). Returns
-    (violations, files_checked). Unreadable/unparsable files raise."""
+    (violations, files_checked). Unreadable/unparsable files raise.
+
+    HB15 runs twice: per file (intra-module cycles) and once over the
+    MERGED lock-order edges of every linted file, so an inversion whose
+    two orders live in different modules is still caught (the edges
+    share nodes through class-qualified lock tokens)."""
     files = []
     for p in paths:
         if os.path.isdir(p):
@@ -108,6 +113,18 @@ def lint_paths(paths, rules=None):
         else:
             files.append(p)
     out = []
+    merged_edges = []
+    want_hb15 = rules is None or "HB15" in rules
     for f in files:
         out.extend(lint_file(f, rules=rules))
+        if want_hb15:
+            from .concurrency import collect_lock_edges
+            try:
+                with open(f, encoding="utf-8") as fh:
+                    merged_edges.extend(collect_lock_edges(fh.read(), f))
+            except OSError:
+                pass
+    if want_hb15 and merged_edges:
+        from .concurrency import cross_module_cycles
+        out.extend(cross_module_cycles(merged_edges))
     return _dedupe(out), len(files)

@@ -1,20 +1,66 @@
 """``mxlint`` CLI entry point (see tools/mxlint.py).
 
     python tools/mxlint.py <paths...> [--format=text|json] [--rules=HB01,..]
+    python tools/mxlint.py <paths...> --write-baseline base.json
+    python tools/mxlint.py <paths...> --baseline base.json --fail-on-new
 
 Exit codes: 0 clean, 1 violations found, 2 usage/IO error. The tool is
 pure AST analysis — it never imports the linted code (and never imports
-jax), so it is safe on any tree and in minimal CI images.
+jax), so it is safe on any tree and in minimal CI images.  Baselines
+grandfather a tree's existing debt by (rule, file) violation COUNTS so
+new strict rules can land on ``mxnet_tpu/`` without blocking
+``examples/`` — only regressions beyond the snapshot gate CI.
 """
 from __future__ import annotations
 
 import argparse
+import json
 import sys
 
 from .api import lint_paths
 from .report import render_json, render_text
 from .rules import ALL_RULE_IDS, RULES
 from .suppressions import parse_suppressions
+
+_BASELINE_VERSION = 1
+
+
+def _group_key(v):
+    """Baseline grouping key: (rule, path).  Line numbers drift with
+    every edit, so the baseline stores violation COUNTS per group — a
+    group is \"new\" only when its count grows."""
+    return f"{v.rule}|{v.path}"
+
+
+def write_baseline(violations, path):
+    counts = {}
+    for v in violations:
+        k = _group_key(v)
+        counts[k] = counts.get(k, 0) + 1
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"version": _BASELINE_VERSION, "counts": counts}, f,
+                  indent=1, sort_keys=True)
+    return counts
+
+
+def filter_new(violations, baseline_path):
+    """Keep only violations beyond the baseline: within each
+    (rule, path) group, the first ``baseline_count`` hits (in line
+    order) are grandfathered; anything past that is a regression."""
+    with open(baseline_path, encoding="utf-8") as f:
+        base = json.load(f)
+    counts = dict(base.get("counts", {}))
+    grandfathered = 0
+    out = []
+    for v in sorted(violations, key=lambda v: (v.path, v.line, v.col,
+                                               v.rule)):
+        k = _group_key(v)
+        if counts.get(k, 0) > 0:
+            counts[k] -= 1
+            grandfathered += 1
+        else:
+            out.append(v)
+    return out, grandfathered
 
 
 def _parse_rules(spec):
@@ -34,8 +80,8 @@ def _parse_rules(spec):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxlint",
-        description="Trace-safety static analyzer for HybridBlocks "
-                    "(rules HB01-HB06; see docs/LINT.md)")
+        description="Trace-safety + concurrency static analyzer "
+                    "(rules HB01-HB16; see docs/LINT.md)")
     ap.add_argument("paths", nargs="+",
                     help="python files or directories to lint")
     ap.add_argument("--format", choices=("text", "json"), default="text",
@@ -44,7 +90,24 @@ def main(argv=None):
                     help="only check these rule IDs")
     ap.add_argument("--list-rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="snapshot the current violations (counts per "
+                         "rule+file) to FILE and exit 0 — the CI "
+                         "grandfather list new strict rules land "
+                         "against")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="compare against a --write-baseline snapshot: "
+                         "only violations BEYOND the baselined counts "
+                         "are reported and gate the exit code")
+    ap.add_argument("--fail-on-new", action="store_true",
+                    help="with --baseline: exit 1 only on regressions "
+                         "(implied by --baseline; kept for explicit CI "
+                         "invocations)")
     args = ap.parse_args(argv)
+    if args.fail_on_new and not args.baseline:
+        print("mxlint: --fail-on-new requires --baseline",
+              file=sys.stderr)
+        return 2
 
     if args.list_rules:
         for rid in ALL_RULE_IDS:
@@ -73,10 +136,30 @@ def main(argv=None):
             print(f"mxlint: warning: {p}:{line}: unknown rule {bad!r} in "
                   f"suppression comment", file=sys.stderr)
 
+    if args.write_baseline:
+        counts = write_baseline(violations, args.write_baseline)
+        print(f"mxlint: baseline written to {args.write_baseline}: "
+              f"{len(violations)} violation(s) across {len(counts)} "
+              f"group(s)")
+        return 0
+
+    grandfathered = 0
+    if args.baseline:
+        try:
+            violations, grandfathered = filter_new(violations,
+                                                   args.baseline)
+        except (OSError, ValueError) as e:
+            print(f"mxlint: cannot read baseline {args.baseline!r}: {e}",
+                  file=sys.stderr)
+            return 2
+
     if args.format == "json":
         print(render_json(violations, files_checked=n_files))
     else:
         print(render_text(violations))
+        if grandfathered:
+            print(f"({grandfathered} pre-existing violation(s) "
+                  f"grandfathered by {args.baseline})")
     return 1 if violations else 0
 
 

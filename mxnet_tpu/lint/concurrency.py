@@ -1,0 +1,690 @@
+"""Interprocedural concurrency pass — rules HB14/HB15/HB16.
+
+Unlike the per-function taint walk (analyzer.py), this pass builds a
+per-class model of the whole module before judging anything:
+
+1. **Lock inventory**: fields assigned from a lock factory
+   (``threading.Lock/RLock/Condition``, ``racecheck.make_lock/
+   make_rlock/make_condition``) become the class's lock set; module- and
+   function-level lock bindings are tracked by name.  A lock is
+   identified by a *token* (``ClassName.attr`` / bare name), so two
+   methods taking ``self._lock`` share one graph node.
+2. **Field-access model**: every ``self.<field>`` read/write in every
+   method is recorded together with the stack of locks lexically held
+   (``with <lock>:`` nesting) at the access.
+3. **Call graph**: ``self.m(...)`` and same-module ``fn(...)`` calls are
+   resolved one level, so a lock acquired (or a blocking call made)
+   inside a helper is charged to the call site that holds the lock.
+
+Annotations (see docs/LINT.md):
+
+- ``self._table = {}   # guarded-by: _lock`` — the field must ALWAYS be
+  accessed with ``self._lock`` held; any bare access is HB14 regardless
+  of thread reachability.
+- ``def _emit(self, ...):   # guarded-by: _lock`` — the method runs with
+  ``self._lock`` already held by its callers (the
+  ``Membership._emit`` shape); its body is analyzed under that lock.
+
+Rules:
+
+**HB14 unguarded-shared-state** — in a threading module, a mutable field
+(written outside ``__init__``) of a lock-owning class that is accessed
+under a lock in one method and with NO guard lock held in another.
+Construction-time methods (``__init__``/``__del__``/pickle hooks) are
+exempt: they happen-before/after the threads.
+
+**HB15 lock-order-inversion** — a cycle in the statically derived lock
+acquisition graph (edge A→B when B is acquired — directly or through a
+one-level call — while A is held).  ``api.lint_paths`` merges the edge
+lists of every linted file before cycle-checking, so an inversion split
+across modules is still caught.
+
+**HB16 blocking-call-under-lock** — a blocking operation lexically
+inside a ``with <lock>:`` body: ``time.sleep``, queue ``get/put``
+(queue-named receivers), socket sends/recvs (RPC), file I/O
+(``open``/``read``/``write``/``flush``/``os.replace``/``os.fsync``/
+``print``), device syncs (``block_until_ready``/``asnumpy``/
+``wait_to_read``/...), thread joins, and dispatch of a jit-compiled
+callable bound in the same scope.  ``cv.wait()`` on the HELD condition
+is exempt — releasing while waiting is the point of a condition
+variable.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from .report import Violation
+
+__all__ = ["run_concurrency_pass", "collect_lock_edges",
+           "cross_module_cycles"]
+
+# lock factory call forms
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+_RACECHECK_FACTORIES = {"make_lock", "make_rlock", "make_condition"}
+_LOCKISH_NAME = re.compile(r"(?:^|_)(?:lock|mutex|rlock|cv|cond)",
+                           re.IGNORECASE)
+
+_GUARDED_BY_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][A-Za-z0-9_.]*)")
+
+_INIT_METHODS = {"__init__", "__new__", "__del__", "__getstate__",
+                 "__setstate__", "__repr__", "__reduce__"}
+
+# container-mutator method names: `self.f.append(...)` counts as a WRITE
+# to field f's contents
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "popleft",
+             "appendleft", "clear", "update", "add", "discard",
+             "setdefault", "sort"}
+
+# -- HB16 blocking-call catalogs ----------------------------------------
+_SLEEP_CALLS = {"time.sleep"}
+_SOCKET_ATTRS = {"sendall", "recv", "recvfrom", "sendto", "accept",
+                 "connect", "makefile"}
+_SOCKET_CALLS = {"socket.create_connection"}
+_FILE_ATTRS = {"flush", "fsync", "readline", "readinto"}
+_OS_IO_CALLS = {"os.replace", "os.fsync", "os.rename"}
+_DEVICE_SYNC_ATTRS = {"block_until_ready", "wait_to_read", "waitall",
+                      "asnumpy", "asscalar", "item", "tolist"}
+_DEVICE_SYNC_CALLS = {"jax.block_until_ready"}
+_JIT_FACTORY_CALLS = {"jax.jit", "jit", "jax.pmap", "pmap"}
+
+
+def _dotted(node):
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_lock_factory(node):
+    """True for ``threading.Lock()`` / ``Lock()`` /
+    ``racecheck.make_lock(...)`` / ``_racecheck.make_condition(...)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else None
+    return name in _LOCK_FACTORIES or name in _RACECHECK_FACTORIES
+
+
+def _queueish(dotted):
+    if not dotted:
+        return False
+    last = dotted.split(".")[-1]
+    return "queue" in dotted.lower() or last == "q" or last.endswith("_q")
+
+
+class _Access:
+    __slots__ = ("field", "write", "locks", "node", "method")
+
+    def __init__(self, field, write, locks, node, method):
+        self.field = field
+        self.write = write
+        self.locks = locks           # frozenset of lock tokens held
+        self.node = node
+        self.method = method
+
+
+class _MethodInfo:
+    def __init__(self, name):
+        self.name = name
+        self.accesses = []           # [_Access]
+        self.acquired = set()        # every lock token this method takes
+        self.blocking = []           # [(node, what)] direct blocking ops
+        self.calls = []              # [(callee_name, kind, locks, node)]
+                                     # kind: "self" | "module"
+        self.edges = []              # [(held, taken, node)]
+
+
+class _ClassModel:
+    def __init__(self, name):
+        self.name = name
+        self.locks = set()           # lock field names (attr, no "self.")
+        self.guarded_by = {}         # field -> lock token (annotation)
+        self.methods = {}            # name -> _MethodInfo
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """One pass over a function body tracking the lexical lock stack."""
+
+    def __init__(self, model, cls, info, module, initial_locks=()):
+        self.model = model           # _ModuleModel
+        self.cls = cls               # _ClassModel or None
+        self.info = info             # _MethodInfo
+        self.module = module
+        self.stack = list(initial_locks)
+        self.local_locks = set()     # names bound to lock factories here
+        self.local_jitted = set()    # names bound to jit factories here
+
+    # -- token resolution ------------------------------------------------
+    def _token(self, expr):
+        """Lock token for a with-item / receiver, or None."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            attr = expr.attr
+            if attr in self.cls.locks or _LOCKISH_NAME.search(attr):
+                return f"{self.cls.name}.{attr}"
+            return None
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in self.local_locks:
+                return f"<local>.{n}"
+            if n in self.module.module_locks or _LOCKISH_NAME.search(n):
+                return n
+            return None
+        dotted = _dotted(expr)
+        if dotted and _LOCKISH_NAME.search(dotted.split(".")[-1]):
+            return dotted
+        return None
+
+    def _self_token(self, lockname):
+        """Normalize an annotation lock name to a token."""
+        lockname = lockname.split(".")[-1]
+        if self.cls is not None:
+            return f"{self.cls.name}.{lockname}"
+        return lockname
+
+    # -- statements ------------------------------------------------------
+    def visit_With(self, node):
+        tokens = []
+        for item in node.items:
+            self._scan_expr(item.context_expr)
+            tok = self._token(item.context_expr)
+            if tok is not None:
+                for held in self.stack:
+                    if held != tok:
+                        self.info.edges.append((held, tok,
+                                                item.context_expr))
+                self.info.acquired.add(tok)
+                tokens.append(tok)
+                self.stack.append(tok)
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in tokens:
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Assign(self, node):
+        if _is_lock_factory(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.local_locks.add(t.id)
+                elif isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self" and self.cls is not None:
+                    self.cls.locks.add(t.attr)
+            return
+        if self._is_jit_factory(node.value):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    self.local_jitted.add(t.id)
+        for t in node.targets:
+            self._record_target(t)
+        self._scan_expr(node.value)
+
+    def visit_AugAssign(self, node):
+        self._record_target(node.target)
+        self._scan_expr(node.value)
+
+    def visit_AnnAssign(self, node):
+        if node.value is not None and _is_lock_factory(node.value):
+            if isinstance(node.target, ast.Name):
+                self.local_locks.add(node.target.id)
+            return
+        self._record_target(node.target)
+        if node.value is not None:
+            self._scan_expr(node.value)
+
+    def visit_Delete(self, node):
+        for t in node.targets:
+            self._record_target(t)
+
+    def visit_FunctionDef(self, node):
+        # nested function (worker closures): analyzed in the same
+        # method's model — closures share the enclosing lock discipline
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Expr(self, node):
+        self._scan_expr(node.value)
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self.visit(child)
+            elif isinstance(child, ast.expr):
+                self._scan_expr(child)
+
+    # -- field access recording ------------------------------------------
+    def _field_of(self, expr):
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and expr.value.id == "self":
+            return expr.attr
+        return None
+
+    def _record_access(self, field, write, node):
+        if self.cls is None or field in self.cls.locks:
+            return
+        self.info.accesses.append(_Access(
+            field, write, frozenset(self.stack), node, self.info.name))
+
+    def _record_target(self, target):
+        f = self._field_of(target)
+        if f is not None:
+            self._record_access(f, True, target)
+            return
+        if isinstance(target, ast.Subscript):
+            f = self._field_of(target.value)
+            if f is not None:
+                self._record_access(f, True, target)
+                return
+            self._scan_expr(target.value)
+            self._scan_expr(target.slice)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._record_target(elt)
+        elif isinstance(target, ast.Starred):
+            self._record_target(target.value)
+        else:
+            self._scan_expr(target)
+
+    # -- expressions (calls, reads) --------------------------------------
+    def _scan_expr(self, node):
+        if node is None or isinstance(node, (ast.Constant, ast.Name)):
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                self._handle_call(sub)
+            elif isinstance(sub, ast.Attribute):
+                f = self._field_of(sub)
+                if f is not None:
+                    self._record_access(f, False, sub)
+
+    def _is_jit_factory(self, node):
+        if not isinstance(node, ast.Call):
+            return False
+        if _dotted(node.func) in _JIT_FACTORY_CALLS:
+            return True
+        return isinstance(node.func, ast.Attribute) and \
+            node.func.attr == "compile"
+
+    def _handle_call(self, node):
+        f = node.func
+        dotted = _dotted(f)
+        attr = f.attr if isinstance(f, ast.Attribute) else None
+        # mutator method on a self field: a WRITE to that field
+        if attr in _MUTATORS and isinstance(f, ast.Attribute):
+            fld = self._field_of(f.value)
+            if fld is not None:
+                self._record_access(fld, True, node)
+        # call-graph edges for one-level resolution
+        if self.stack:
+            if isinstance(f, ast.Attribute) and \
+                    isinstance(f.value, ast.Name) and f.value.id == "self":
+                self.info.calls.append((attr, "self",
+                                        tuple(self.stack), node))
+            elif isinstance(f, ast.Name):
+                self.info.calls.append((f.id, "module",
+                                        tuple(self.stack), node))
+            b = self._blocking_kind(node, dotted, attr, f)
+            if b is not None:
+                self.info.blocking.append((node, b, tuple(self.stack)))
+        else:
+            b = self._blocking_kind(node, dotted, attr, f,
+                                    under_lock=False)
+            if b is not None:
+                self.info.blocking.append((node, b, ()))
+
+    def _blocking_kind(self, node, dotted, attr, f, under_lock=True):
+        """Classify a call as blocking; returns a description or None.
+        ``under_lock=False`` records are used only for one-level call
+        resolution (a helper that blocks, called under a lock)."""
+        if dotted in _SLEEP_CALLS:
+            return f"`{dotted}()` (sleep)"
+        if dotted in _OS_IO_CALLS:
+            return f"`{dotted}()` (file I/O)"
+        if dotted in _SOCKET_CALLS:
+            return f"`{dotted}()` (RPC/socket)"
+        if dotted in _DEVICE_SYNC_CALLS:
+            return f"`{dotted}()` (device sync)"
+        if isinstance(f, ast.Name):
+            if f.id == "open":
+                return "`open()` (file I/O)"
+            if f.id == "print":
+                return "`print()` (console I/O)"
+            if f.id in self.local_jitted:
+                return f"`{f.id}()` (jit-compiled dispatch)"
+            return None
+        if attr is None:
+            return None
+        recv = f.value
+        recv_dotted = _dotted(recv)
+        if attr in _DEVICE_SYNC_ATTRS:
+            return f"`.{attr}()` (device sync)"
+        if attr in _SOCKET_ATTRS:
+            return f"`.{attr}()` (RPC/socket)"
+        if attr in _FILE_ATTRS:
+            return f"`.{attr}()` (file I/O)"
+        if attr in ("get", "put") and _queueish(recv_dotted):
+            return f"`.{attr}()` (queue wait)"
+        if attr == "join" and recv_dotted and \
+                "thread" in recv_dotted.lower():
+            return f"`.{attr}()` (thread join)"
+        if attr == "wait":
+            tok = self._token(recv) if under_lock else None
+            if under_lock and tok is not None and tok in self.stack:
+                return None       # cv.wait on the HELD condition: fine
+            if recv_dotted and not isinstance(recv, ast.Constant):
+                return f"`.{attr}()` (event/thread wait)"
+        return None
+
+
+class _ModuleModel:
+    def __init__(self, tree, path, src_lines):
+        self.path = path
+        self.src_lines = src_lines
+        self.classes = {}            # name -> _ClassModel
+        self.functions = {}          # name -> _MethodInfo (module funcs)
+        self.module_locks = set()
+        self.uses_threading = False
+        self.spawns_threads = False
+        self._scan_module(tree)
+
+    def _line(self, node):
+        i = getattr(node, "lineno", 0)
+        return self.src_lines[i - 1] if 0 < i <= len(self.src_lines) \
+            else ""
+
+    def _guarded_by_on(self, node):
+        m = _GUARDED_BY_RE.search(self._line(node))
+        return m.group(1) if m else None
+
+    def _scan_module(self, tree):
+        src = "\n".join(self.src_lines)
+        if re.search(r"\b(?:import\s+threading|from\s+threading\s+import"
+                     r"|concurrent\.futures|ThreadPoolExecutor"
+                     r"|make_lock|make_rlock|make_condition)", src):
+            self.uses_threading = True
+        if re.search(r"\bThread\s*\(|ThreadPoolExecutor\s*\(", src):
+            self.spawns_threads = True
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and _is_lock_factory(node.value):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.module_locks.add(t.id)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = self._walk_function(
+                    node, None)
+            elif isinstance(node, ast.ClassDef):
+                self._scan_class(node)
+
+    def _scan_class(self, cd):
+        cls = _ClassModel(cd.name)
+        self.classes[cd.name] = cls
+        methods = [i for i in cd.body
+                   if isinstance(i, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        # pass 1: lock fields + guarded-by field annotations (any method)
+        for m in methods:
+            for sub in ast.walk(m):
+                if isinstance(sub, ast.Assign):
+                    for t in sub.targets:
+                        if isinstance(t, ast.Attribute) and \
+                                isinstance(t.value, ast.Name) and \
+                                t.value.id == "self":
+                            if _is_lock_factory(sub.value):
+                                cls.locks.add(t.attr)
+                            else:
+                                g = self._guarded_by_on(sub)
+                                if g:
+                                    cls.guarded_by[t.attr] = \
+                                        f"{cls.name}.{g.split('.')[-1]}"
+        # pass 2: per-method access/edge/blocking model
+        for m in methods:
+            initial = ()
+            g = self._guarded_by_on(m)
+            if g:
+                initial = (f"{cls.name}.{g.split('.')[-1]}",)
+            cls.methods[m.name] = self._walk_function(m, cls, initial)
+
+    def _walk_function(self, fn, cls, initial_locks=()):
+        info = _MethodInfo(fn.name)
+        w = _MethodWalker(self, cls, info, self, initial_locks)
+        for stmt in fn.body:
+            w.visit(stmt)
+        return info
+
+
+# ----------------------------------------------------------------------
+# rule evaluation
+# ----------------------------------------------------------------------
+
+def _check_hb14(model, collector):
+    if not model.uses_threading:
+        return
+    for cls in model.classes.values():
+        if not cls.locks and not cls.guarded_by:
+            continue
+        # field -> guard lock set (locks it is EVER accessed under,
+        # outside construction)
+        guards = {}
+        mutable = set(cls.guarded_by)     # annotated fields: always live
+        for info in cls.methods.values():
+            construction = info.name in _INIT_METHODS
+            for a in info.accesses:
+                if a.write and not construction:
+                    mutable.add(a.field)
+                if construction:
+                    continue
+                if a.locks:
+                    guards.setdefault(a.field, set()).update(a.locks)
+        for field, tok in cls.guarded_by.items():
+            guards.setdefault(field, set()).add(tok)
+        for info in cls.methods.values():
+            if info.name in _INIT_METHODS:
+                continue
+            for a in info.accesses:
+                g = guards.get(a.field)
+                if not g or a.field not in mutable:
+                    continue
+                if a.locks & g:
+                    continue
+                annotated = a.field in cls.guarded_by
+                lock_desc = " / ".join(sorted(g))
+                collector.add(Violation(
+                    rule="HB14", path=model.path, line=a.node.lineno,
+                    col=a.node.col_offset,
+                    message=(
+                        f"shared field `self.{a.field}` accessed without "
+                        f"{lock_desc} held"
+                        + (" (declared `# guarded-by`)" if annotated
+                           else f", but other methods access it under "
+                                f"{lock_desc}")
+                        + ": a concurrent locked writer races this "
+                        "access (torn reads, lost updates); take the "
+                        "lock here, or document the invariant with "
+                        "`# guarded-by:` / a justified "
+                        "`# mxlint: disable=HB14`"),
+                    block=cls.name, func=info.name))
+
+
+def _one_level_edges(model, cls, info):
+    """Edges through a single call hop: a call made while holding locks
+    to a method/function that itself acquires locks."""
+    out = []
+    for callee, kind, held, node in info.calls:
+        target = None
+        if kind == "self" and cls is not None:
+            target = cls.methods.get(callee)
+        elif kind == "module":
+            target = model.functions.get(callee)
+        if target is None:
+            continue
+        for tok in target.acquired:
+            for h in held:
+                if h != tok:
+                    out.append((h, tok, node))
+    return out
+
+
+def _all_edges(model):
+    """Every lock-order edge in the module, with the site node and
+    owning (class, method) for reporting."""
+    edges = []
+    for cls in model.classes.values():
+        for info in cls.methods.values():
+            for h, t, node in info.edges:
+                edges.append((h, t, node, cls.name, info.name))
+            for h, t, node in _one_level_edges(model, cls, info):
+                edges.append((h, t, node, cls.name, info.name))
+    for info in model.functions.values():
+        for h, t, node in info.edges:
+            edges.append((h, t, node, "", info.name))
+        for h, t, node in _one_level_edges(model, None, info):
+            edges.append((h, t, node, "", info.name))
+    return edges
+
+
+def _cycle_violations(edges, path_of=None):
+    """Report each edge that participates in a cycle, once per (A, B).
+    ``edges``: [(held, taken, node, block, func)] or the cross-module
+    form [(held, taken, path, line, col, block, func)]."""
+    graph = {}
+    for e in edges:
+        graph.setdefault(e[0], set()).add(e[1])
+
+    def reachable(src, dst):
+        stack, seen = [src], set()
+        while stack:
+            n = stack.pop()
+            if n == dst:
+                return True
+            if n in seen:
+                continue
+            seen.add(n)
+            stack.extend(graph.get(n, ()))
+        return False
+
+    out = []
+    reported = set()
+    for e in edges:
+        h, t = e[0], e[1]
+        if (h, t) in reported:
+            continue
+        if not reachable(t, h):
+            continue
+        reported.add((h, t))
+        if len(e) == 5:
+            _h, _t, node, block, func = e
+            path, line, col = path_of, node.lineno, node.col_offset
+        else:
+            _h, _t, path, line, col, block, func = e
+        out.append(Violation(
+            rule="HB15", path=path, line=line, col=col,
+            message=(
+                f"lock-order inversion: {t} is acquired here while "
+                f"{h} is held, but elsewhere {h} is (transitively) "
+                f"acquired while {t} is held — two threads interleaving "
+                f"these orders deadlock; pick ONE global order (document "
+                f"it) or release {h} first"),
+            block=block, func=func))
+    return out
+
+
+def _check_hb16(model, collector):
+    for cls in model.classes.values():
+        for info in cls.methods.values():
+            _hb16_for(model, cls, info, collector)
+    for info in model.functions.values():
+        _hb16_for(model, None, info, collector)
+
+
+def _hb16_for(model, cls, info, collector):
+    cname = cls.name if cls is not None else ""
+    for node, what, held in info.blocking:
+        if not held:
+            continue
+        collector.add(Violation(
+            rule="HB16", path=model.path, line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"blocking call {what} while holding {held[-1]}: every "
+                f"other thread needing the lock stalls behind this "
+                f"wait — on the step path that is a host-side stall "
+                f"that caps throughput (arXiv:2011.03641); move the "
+                f"blocking work outside the critical section (snapshot "
+                f"under the lock, act after release)"),
+            block=cname, func=info.name))
+    # one-level: call under lock to a helper that blocks
+    for callee, kind, held, node in info.calls:
+        if not held:
+            continue
+        target = None
+        if kind == "self" and cls is not None:
+            target = cls.methods.get(callee)
+        elif kind == "module":
+            target = model.functions.get(callee)
+        if target is None or target is info:
+            continue
+        blocked = [b for b in target.blocking]
+        if not blocked:
+            continue
+        _n, what, _h = blocked[0]
+        collector.add(Violation(
+            rule="HB16", path=model.path, line=node.lineno,
+            col=node.col_offset,
+            message=(
+                f"blocking call reached while holding {held[-1]}: "
+                f"`{callee}()` performs {what} — every other thread "
+                f"needing the lock stalls behind it; move the call "
+                f"outside the critical section or shrink the helper"),
+            block=cname, func=info.name))
+
+
+def run_concurrency_pass(collector, tree, path, src_lines):
+    """Run HB14/HB15/HB16 over one module; violations go into the
+    shared collector (suppressions applied downstream)."""
+    model = _ModuleModel(tree, path, src_lines)
+    _check_hb14(model, collector)
+    for v in _cycle_violations(_all_edges(model), path_of=path):
+        collector.add(v)
+    _check_hb16(model, collector)
+
+
+# ----------------------------------------------------------------------
+# cross-module HB15 (api.lint_paths merges every file's edges)
+# ----------------------------------------------------------------------
+
+def collect_lock_edges(source, path):
+    """The module's lock-order edges as JSON-able tuples
+    ``(held, taken, path, line, col, block, func)``, with HB15
+    suppressions already applied (a suppressed edge never feeds the
+    cross-module cycle check)."""
+    from .suppressions import parse_suppressions, is_suppressed
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError:
+        return []
+    src_lines = source.splitlines()
+    model = _ModuleModel(tree, path, src_lines)
+    suppressed, _ = parse_suppressions(source)
+    out = []
+    for h, t, node, block, func in _all_edges(model):
+        if is_suppressed(suppressed, node.lineno, "HB15"):
+            continue
+        out.append((h, t, path, node.lineno, node.col_offset, block,
+                    func))
+    return out
+
+
+def cross_module_cycles(edges):
+    """Cycle-check a merged multi-file edge list; returns Violations."""
+    return _cycle_violations(edges)

@@ -207,6 +207,68 @@ RULES = {
         "y = f(x)\n"
         "jax.block_until_ready(y)    # drain the device first\n"
         "dt = time.perf_counter() - t0"),
+    "HB14": Rule(
+        "HB14", "unguarded-shared-state",
+        "A mutable field of a lock-owning class accessed under the lock "
+        "in one method but with NO lock held in another (in a module "
+        "that runs threads): a locked writer races the bare access — "
+        "torn reads, lost updates, the silent corruption chaos kills "
+        "only catch by luck. Take the lock at every access, declare the "
+        "invariant with `# guarded-by: _lock` (on the field assignment: "
+        "every access must hold it; on a `def` line: the method runs "
+        "with it already held), or justify a lock-free design with "
+        "`# mxlint: disable=HB14`.",
+        "class Stats:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.n = 0\n"
+        "    def add(self):           # worker thread\n"
+        "        with self._lock:\n"
+        "            self.n += 1\n"
+        "    def summary(self):\n"
+        "        return self.n        # bare read races add()",
+        "    def summary(self):\n"
+        "        with self._lock:     # snapshot under the lock,\n"
+        "            n = self.n       # compute after release\n"
+        "        return n"),
+    "HB15": Rule(
+        "HB15", "lock-order-inversion",
+        "A cycle in the statically derived lock acquisition graph: one "
+        "code path takes lock A then B (directly or through a called "
+        "method), another takes B then A — two threads interleaving "
+        "those orders deadlock, and only under load. The edges are "
+        "merged across every linted file, so an inversion split across "
+        "modules is still caught. Pick ONE global order and document "
+        "it, or restructure so the inner lock is released first.",
+        "def transfer(src, dst):\n"
+        "    with src.lock:\n"
+        "        with dst.lock:       # order depends on caller:\n"
+        "            ...              # transfer(a,b) || transfer(b,a)\n"
+        "                             # deadlocks",
+        "def transfer(src, dst):\n"
+        "    first, second = sorted((src, dst), key=id)\n"
+        "    with first.lock:         # ONE global order, any caller\n"
+        "        with second.lock:\n"
+        "            ..."),
+    "HB16": Rule(
+        "HB16", "blocking-call-under-lock",
+        "A blocking operation inside a `with lock:` body — device sync "
+        "(`.asnumpy()`/`block_until_ready`), RPC/socket I/O, file I/O "
+        "(`open`/`.write`/`.flush`/`print`), `queue.get/put`, "
+        "`time.sleep`, a thread join, or dispatch of a jit-compiled "
+        "callable: every other thread needing the lock stalls behind "
+        "the wait, and on the step path that host-side stall directly "
+        "caps throughput (arXiv:2011.03641). Snapshot state under the "
+        "lock, do the blocking work after release. (`cv.wait()` on the "
+        "held condition is exempt — releasing while waiting is the "
+        "point.)",
+        "with self._lock:\n"
+        "    arr = self._table[key]\n"
+        "    sock.sendall(pack(arr))   # wire round under the lock:\n"
+        "                              # every push/pull stalls",
+        "with self._lock:\n"
+        "    arr = self._table[key].copy()   # snapshot under the lock\n"
+        "sock.sendall(pack(arr))             # blocking work outside"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

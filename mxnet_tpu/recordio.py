@@ -17,6 +17,7 @@ import threading
 import numpy as _np
 
 from .base import MXNetError
+from .lint import racecheck as _racecheck
 
 __all__ = ["MXRecordIO", "MXIndexedRecordIO", "IRHeader", "pack", "unpack",
            "pack_img", "unpack_img"]
@@ -45,6 +46,15 @@ class MXRecordIO:
         self.open()
 
     def open(self):
+        # per-thread read handles: seek+read pairs from concurrent decode
+        # workers (io.AsyncDecodeIter) must not race on one descriptor.
+        # (Re)created FIRST so close()/__del__ always find the lock even
+        # when the file open below raises; open() itself runs before any
+        # reader thread exists (construction / unpickle / reset), which
+        # is the happens-before that makes the bare re-init safe:
+        self._tl = threading.local()
+        self._tl_handles = []  # mxlint: disable=HB14 -- re-created before reader threads start (happens-before via thread start)
+        self._tl_lock = _racecheck.make_lock("MXRecordIO._tl_lock")
         if self.flag == "w":
             self.fid = open(self.uri, "wb")
             self.writable = True
@@ -54,11 +64,6 @@ class MXRecordIO:
         else:
             raise MXNetError(f"Invalid flag {self.flag}")
         self.pid = os.getpid()
-        # per-thread read handles: seek+read pairs from concurrent decode
-        # workers (io.AsyncDecodeIter) must not race on one descriptor
-        self._tl = threading.local()
-        self._tl_handles = []
-        self._tl_lock = threading.Lock()
 
     def _read_fid(self):
         """File handle private to the calling thread (read mode only).
@@ -84,8 +89,10 @@ class MXRecordIO:
         if self.fid is not None and not self.fid.closed:
             self.fid.close()
         self.fid = None
-        with getattr(self, "_tl_lock", threading.Lock()):
-            for fid in getattr(self, "_tl_handles", []):
+        if getattr(self, "_tl_lock", None) is None:
+            return      # open() never completed: no reader handles exist
+        with self._tl_lock:
+            for fid in self._tl_handles:
                 if not fid.closed:
                     fid.close()
             self._tl_handles = []

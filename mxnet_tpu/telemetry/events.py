@@ -20,10 +20,11 @@ take the training loop down.
 from __future__ import annotations
 
 import json
-import threading
 import time
 import warnings
 from collections import deque
+
+from ..lint import racecheck as _racecheck
 
 __all__ = ["EventLog", "SCHEMA_VERSION"]
 
@@ -37,10 +38,14 @@ class EventLog:
         self.ring_size = int(ring_size)
         self.path = path or None
         self._now = now if now is not None else time.time
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("EventLog._lock")
         self._ring = deque(maxlen=self.ring_size)
         self._seq = 0
         self._ctx = {"step": None, "epoch": None}
+        # the JSONL appender has its OWN lock (never nested with _lock:
+        # emit() releases _lock before touching the file) so a slow disk
+        # stalls only other appenders, never the in-memory ring
+        self._io_lock = _racecheck.make_lock("EventLog._io_lock")
         self._file = None
         self._write_warned = False
 
@@ -81,18 +86,24 @@ class EventLog:
         return rec
 
     def _append_line(self, line):
-        try:
-            if self._file is None:
-                self._file = open(self.path, "a", encoding="utf-8")
-            self._file.write(line + "\n")
-            self._file.flush()
-        except OSError as e:
-            if not self._write_warned:
-                self._write_warned = True
-                warnings.warn(f"telemetry event log {self.path!r} "
-                              f"unwritable ({e}); further records stay "
-                              f"in-memory only")
-            self._file = None
+        # two concurrent emitters previously raced on self._file (HB14):
+        # both could open the path, one handle leaked, and interleaved
+        # write/flush pairs could tear lines.  The file I/O lives under
+        # its own lock by design — serializing the append IS this lock's
+        # job, so the blocking write is the invariant, not a bug:
+        with self._io_lock:
+            try:
+                if self._file is None:
+                    self._file = open(self.path, "a", encoding="utf-8")  # mxlint: disable=HB16 -- _io_lock exists to serialize this append path
+                self._file.write(line + "\n")
+                self._file.flush()  # mxlint: disable=HB16 -- _io_lock exists to serialize this append path
+            except OSError as e:
+                if not self._write_warned:
+                    self._write_warned = True
+                    warnings.warn(f"telemetry event log {self.path!r} "
+                                  f"unwritable ({e}); further records "
+                                  f"stay in-memory only")
+                self._file = None
 
     def events(self):
         """Ring contents, oldest first (copies — the ring keeps moving)."""
@@ -106,7 +117,7 @@ class EventLog:
             self._ctx = {"step": None, "epoch": None}
 
     def close(self):
-        with self._lock:
+        with self._io_lock:
             if self._file is not None:
                 try:
                     self._file.close()

@@ -19,10 +19,10 @@ Design constraints (ISSUE 9 tentpole):
 from __future__ import annotations
 
 import bisect
-import threading
 import time
 
 from ..base import MXNetError
+from ..lint import racecheck as _racecheck
 
 __all__ = ["MetricsRegistry", "Counter", "Gauge", "Histogram",
            "NULL_METRIC", "DEFAULT_MS_EDGES"]
@@ -65,7 +65,7 @@ class Counter:
     def __init__(self, name):
         self.name = name
         self._v = 0
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("telemetry.Counter._lock")
 
     def inc(self, n=1):
         with self._lock:
@@ -85,7 +85,7 @@ class Gauge:
     def __init__(self, name):
         self.name = name
         self._v = None
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("telemetry.Gauge._lock")
 
     def set(self, v):
         with self._lock:
@@ -120,7 +120,7 @@ class Histogram:
         self._count = 0
         self._min = None
         self._max = None
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("telemetry.Histogram._lock")
 
     def observe(self, v):
         v = float(v)
@@ -155,7 +155,7 @@ class MetricsRegistry:
 
     def __init__(self, now=None):
         self._now = now if now is not None else time.time
-        self._lock = threading.Lock()
+        self._lock = _racecheck.make_lock("MetricsRegistry._lock")
         self._metrics = {}
 
     def _get(self, name, cls, **kw):

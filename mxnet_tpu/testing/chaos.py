@@ -52,6 +52,30 @@ import tempfile
 import numpy as _np
 
 
+def _racecheck_arm():
+    """Run the scenario under the runtime race/lock-order detector
+    (ISSUE 10): every chaos interleaving doubles as a concurrency test.
+    ``MXTPU_RACECHECK=0`` is the explicit opt-out; otherwise the
+    detector is enabled for the scenario regardless of ambient env, so
+    the tier-1 chaos tests always exercise it."""
+    from mxnet_tpu.lint import racecheck
+    if os.environ.get("MXTPU_RACECHECK", "") == "0":
+        return None
+    racecheck.reset()               # this scenario's findings only
+    racecheck.configure(enabled=True)
+    return racecheck
+
+
+def _racecheck_verdict(rc):
+    """Post-scenario gate: zero findings, or the scenario fails."""
+    if rc is None:
+        return None
+    found = rc.findings()
+    return {"enabled": True, "findings": len(found),
+            "kinds": sorted({f["kind"] for f in found}),
+            "ok": not found}
+
+
 def _flight_check(expect_kind=None):
     """Assert the telemetry flight recorder left a parseable dump for
     the kill this scenario just injected (ISSUE 9): the dump must exist,
@@ -148,6 +172,7 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     from mxnet_tpu.checkpoint import CheckpointManager, run_preemptible
     from mxnet_tpu.testing import faults
 
+    rc = _racecheck_arm()
     k_resume = int(resume_steps_per_call)
     if k_resume > 1 and mode != "sharded":
         raise MXNetError(
@@ -243,10 +268,13 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     result["params_bitwise"] = _bitwise(ref_params, _params_of(net))
     result["state_bitwise"] = _bitwise(ref_state, _state_of(trainer))
     fd = result["flight_dump"]
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
     result["ok"] = bool(
         result["params_bitwise"] and result["state_bitwise"]
         and result["corrupt_skipped"]["ok"] and preempted
-        and writer_died and (fd is None or fd["ok"]))
+        and writer_died and (fd is None or fd["ok"])
+        and (rcv is None or rcv["ok"]))
     return result
 
 
@@ -353,6 +381,7 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     from mxnet_tpu.testing import faults
     import jax
 
+    rc = _racecheck_arm()
     devices = jax.devices()
     dpw = 4
     ranks = [0] if kind == "grow" else [0, 1]
@@ -457,6 +486,9 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
         checks.append(fd is None or fd["ok"])
     else:
         checks.append(events[0]["source"] == "peer")
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    checks.append(rcv is None or rcv["ok"])
     result["ok"] = bool(all(checks))
     return result
 

@@ -57,18 +57,22 @@ def deterministic_gluon_naming():
 
 @pytest.fixture(autouse=True)
 def reset_profiler_and_telemetry():
-    """Reset the PROCESS-GLOBAL profiler span store and telemetry
-    registry/event-ring before every test (same pattern as the gluon
-    name-counter fixture above).
+    """Reset the PROCESS-GLOBAL profiler span store, telemetry
+    registry/event-ring, and racecheck state before every test (same
+    pattern as the gluon name-counter fixture above).
 
     ``profiler._STATE['events']`` had no reset seam: a test that opened
     a span without closing it (or vice versa) leaked B/E events that
     PAIRED with a later test's spans in ``dumps()``, so span-count
     assertions depended on test order.  Telemetry metrics have the same
     process-global shape — a counter assertion must count only its own
-    test's increments.  Lazy ``sys.modules`` lookup: tests that never
+    test's increments.  Racecheck (ISSUE 10) likewise: its lock-order
+    graph and findings are process-global, and a chaos test that
+    enabled it must not leave the detector armed (reset() re-reads
+    MXTPU_RACECHECK).  Lazy ``sys.modules`` lookup: tests that never
     import mxnet_tpu must not pay the import."""
-    for mod in ("mxnet_tpu.profiler", "mxnet_tpu.telemetry"):
+    for mod in ("mxnet_tpu.profiler", "mxnet_tpu.telemetry",
+                "mxnet_tpu.lint.racecheck"):
         m = sys.modules.get(mod)
         if m is not None:
             m.reset()

@@ -1,0 +1,29 @@
+"""HB14 clean near-misses: every shared-field access holds the lock;
+init-only config fields read bare are immutable (exempt); a method
+declared `# guarded-by:` is analyzed as running under the lock."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.processed = 0
+        self.batch_size = 32        # written ONLY here: immutable config
+
+    def add(self):
+        with self._lock:
+            self.processed += 1
+            self._note()
+
+    def _note(self):  # guarded-by: _lock
+        self.processed += 0         # caller holds the lock: clean
+
+    def summary(self):
+        with self._lock:            # snapshot under the lock
+            n = self.processed
+        return {"processed": n, "batch": self.batch_size}
+
+    def start(self, work):
+        t = threading.Thread(target=lambda: [self.add() for _ in work])
+        t.start()
+        return t

@@ -1,0 +1,38 @@
+"""HB14 seeded violation: a stats class whose worker thread writes a
+counter under the lock while the reporter reads it bare — the planted
+bug the unguarded-shared-state pass must catch."""
+import threading
+
+
+class Stats:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.processed = 0
+        self.errors = 0
+
+    def add(self, failed=False):
+        with self._lock:
+            self.processed += 1
+            if failed:
+                self.errors += 1
+
+    def summary(self):
+        # SEEDED HB14: bare read races the worker's locked writes
+        return {"processed": self.processed, "errors": self.errors}
+
+    def start(self, work):
+        t = threading.Thread(target=lambda: [self.add(w) for w in work])
+        t.start()
+        return t
+
+
+class Annotated:
+    """Guarded-by annotation path: no lock usage anywhere, the
+    declaration alone makes the bare write a violation."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}   # guarded-by: _lock
+
+    def poke(self, k, v):
+        self._table[k] = v          # SEEDED HB14: declared guarded

@@ -1,0 +1,32 @@
+"""HB15 clean near-misses: the same two locks taken SEQUENTIALLY (no
+nesting) and nested in ONE consistent global order everywhere."""
+import threading
+
+table_lock = threading.Lock()
+index_lock = threading.Lock()
+
+_table = {}
+_index = {}
+
+
+def update(key, value):
+    with table_lock:                 # consistent order: table -> index
+        _table[key] = value
+        with index_lock:
+            _index[key] = len(_table)
+
+
+def reindex():
+    with table_lock:                 # SAME order: table -> index
+        keys = list(_table)
+        with index_lock:
+            for k in keys:
+                _index[k] = 0
+
+
+def snapshot():
+    with table_lock:                 # sequential, never nested: no edge
+        t = dict(_table)
+    with index_lock:
+        i = dict(_index)
+    return t, i

@@ -1,0 +1,29 @@
+"""HB15 seeded violation: two code paths nest the same two module locks
+in OPPOSITE orders — the classic AB/BA deadlock, visible statically as
+a cycle in the acquisition graph (one edge goes through a helper call,
+exercising the one-level interprocedural resolution)."""
+import threading
+
+table_lock = threading.Lock()
+index_lock = threading.Lock()
+
+_table = {}
+_index = {}
+
+
+def update(key, value):
+    with table_lock:                 # order: table -> index
+        _table[key] = value
+        with index_lock:
+            _index[key] = len(_table)
+
+
+def _drop(key):
+    with table_lock:                 # acquired by reindex UNDER index
+        _table.pop(key, None)
+
+
+def reindex():
+    with index_lock:                 # order: index -> table (SEEDED)
+        for key in list(_index):
+            _drop(key)

@@ -1,0 +1,37 @@
+"""HB16 clean near-misses: the blocking work happens OUTSIDE the
+critical section (snapshot-then-act); `cv.wait()` on the HELD condition
+is the supported idiom; dict `.get` under a lock is not a queue wait."""
+import time
+import threading
+
+state_lock = threading.Lock()
+_cache = {}
+
+
+class Worker:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._cv = threading.Condition()
+        self._sock = sock
+        self._pending = []
+
+    def flush(self, payload):
+        with self._lock:
+            out = list(self._pending)   # snapshot under the lock
+            self._pending.clear()
+        for p in out:
+            self._sock.sendall(p)       # blocking work after release
+
+    def wait_for_work(self):
+        with self._cv:
+            while not self._pending:
+                self._cv.wait(timeout=1)   # held condition: the idiom
+            return self._pending.pop()
+
+    def lookup(self, key):
+        with self._lock:
+            return _cache.get(key)      # dict.get: not a queue wait
+
+
+def backoff():
+    time.sleep(0.01)                    # sleep with no lock held: fine

@@ -1,0 +1,45 @@
+"""HB16 seeded violations: blocking operations inside `with lock:`
+bodies — a sleep, a queue wait, file I/O, a jitted dispatch, and an RPC
+reached through a module helper (one-level resolution)."""
+import queue
+import time
+import threading
+
+import jax
+
+state_lock = threading.Lock()
+work_queue = queue.Queue()
+
+
+def _send(sock, payload):
+    sock.sendall(payload)            # the blocking body of the helper
+
+
+class Worker:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._log = None
+
+    def poll(self):
+        with self._lock:
+            item = work_queue.get(timeout=1)   # SEEDED: queue wait
+            time.sleep(0.01)                   # SEEDED: sleep
+        return item
+
+    def flush(self, payload):
+        with self._lock:
+            _send(self._sock, payload)         # SEEDED: RPC via helper
+
+    def record(self, line):
+        with self._lock:
+            self._log = open("log.txt", "a")   # SEEDED: file I/O
+            self._log.flush()                  # SEEDED: file I/O
+
+
+def dispatch(step, x):
+    f = jax.jit(step)
+    with state_lock:
+        y = f(x)                               # SEEDED: jitted dispatch
+        y.block_until_ready()                  # SEEDED: device sync
+    return y

@@ -1011,6 +1011,277 @@ def test_hb13_suppression_and_catalog():
     assert out == []
 
 
+# ----------------------------------------------------------------------
+# HB14/HB15/HB16 — interprocedural concurrency pass (ISSUE 10)
+# ----------------------------------------------------------------------
+
+_FIXDIR = os.path.join(REPO, "tests", "fixtures", "concurrency")
+
+
+def _lint_fixture(name):
+    from mxnet_tpu.lint import lint_file
+    return lint_file(os.path.join(_FIXDIR, name))
+
+
+def test_hb14_fixture_planted_bug_caught():
+    """Seeded regression: the bare summary() reads and the annotated
+    guarded-by write must BOTH be flagged."""
+    out = _lint_fixture("hb14_violation.py")
+    assert [v.rule for v in out] == ["HB14"] * 3
+    assert {v.func for v in out} == {"summary", "poke"}
+    assert any("guarded-by" in v.message for v in out)
+
+
+def test_hb14_fixture_clean_near_misses():
+    # locked snapshot, init-only config read, guarded-by method body
+    assert _lint_fixture("hb14_clean.py") == []
+
+
+def test_hb14_inline_locked_write_bare_read():
+    out = lint_source(textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def worker(self):
+                with self._lock:
+                    self.n += 1
+            def read(self):
+                return self.n
+    """), path="<hb14>")
+    assert _rules(out) == ["HB14"]
+    assert out[0].func == "read" and out[0].block == "S"
+
+
+def test_hb14_init_only_fields_and_lockless_classes_clean():
+    # immutable config read bare: exempt; a class with no locks at all
+    # (deliberately lock-free, like DevicePrefetcher) never fires
+    out = lint_source(textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.depth = 2
+                self.n = 0
+            def worker(self):
+                with self._lock:
+                    self.n += 1
+            def read(self):
+                return self.depth
+        class LockFree:
+            def __init__(self):
+                self.cursor = 0
+            def bump(self):
+                self.cursor += 1
+    """), path="<hb14>")
+    assert out == []
+
+
+def test_hb14_guarded_by_method_annotation():
+    # a `# guarded-by: _lock` def-line annotation = caller holds the
+    # lock (the Membership._emit shape): body accesses are NOT bare
+    out = lint_source(textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def bump(self):
+                with self._lock:
+                    self._apply()
+            def _apply(self):  # guarded-by: _lock
+                self.n += 1
+    """), path="<hb14>")
+    assert out == []
+
+
+def test_hb14_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB14" in RULES
+    assert RULES["HB14"].bad and RULES["HB14"].good
+    out = lint_source(textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def worker(self):
+                with self._lock:
+                    self.n += 1
+            def read(self):
+                return self.n  # mxlint: disable=HB14
+    """), path="<hb14>")
+    assert out == []
+
+
+def test_hb15_fixture_inversion_caught():
+    """Seeded regression: the AB/BA cycle — one edge through a helper
+    call (interprocedural) — is reported on both edges."""
+    out = _lint_fixture("hb15_violation.py")
+    assert [v.rule for v in out] == ["HB15", "HB15"]
+    assert all("inversion" in v.message for v in out)
+
+
+def test_hb15_fixture_clean_orders():
+    assert _lint_fixture("hb15_clean.py") == []
+
+
+def test_hb15_self_attr_locks_and_method_hop():
+    # ClassName.attr tokens: two methods of one class nesting
+    # self._a/self._b in opposite orders, one side through self.helper()
+    out = lint_source(textwrap.dedent("""
+        import threading
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+            def _take_a(self):
+                with self._a_lock:
+                    pass
+            def two(self):
+                with self._b_lock:
+                    self._take_a()
+    """), path="<hb15>")
+    assert _rules(out) == ["HB15"]
+
+
+def test_hb15_cross_module_cycle_via_lint_paths(tmp_path):
+    """The tentpole's cross-module half: each file alone is clean (one
+    edge each), the MERGED acquisition graph has the cycle."""
+    from mxnet_tpu.lint.api import lint_paths
+    a = tmp_path / "mod_a.py"
+    a.write_text(textwrap.dedent("""
+        import threading
+        class Table:
+            def __init__(self):
+                self._table_lock = threading.Lock()
+                self._index_lock = threading.Lock()
+            def update(self):
+                with self._table_lock:
+                    with self._index_lock:
+                        pass
+    """))
+    b = tmp_path / "mod_b.py"
+    b.write_text(textwrap.dedent("""
+        import threading
+        class Table:
+            def __init__(self):
+                self._table_lock = threading.Lock()
+                self._index_lock = threading.Lock()
+            def reindex(self):
+                with self._index_lock:
+                    with self._table_lock:
+                        pass
+    """))
+    from mxnet_tpu.lint import lint_file
+    assert lint_file(str(a)) == [] and lint_file(str(b)) == []
+    viol, n = lint_paths([str(tmp_path)])
+    assert n == 2
+    assert sorted(v.rule for v in viol) == ["HB15", "HB15"]
+    assert {os.path.basename(v.path) for v in viol} == \
+        {"mod_a.py", "mod_b.py"}
+
+
+def test_hb16_fixture_planted_bugs_caught():
+    """Seeded regression: sleep, queue wait, file I/O, jitted dispatch,
+    device sync, and an RPC through a module helper — all under locks."""
+    out = _lint_fixture("hb16_violation.py")
+    assert [v.rule for v in out] == ["HB16"] * 7
+    msgs = " | ".join(v.message for v in out)
+    for needle in ("sleep", "queue wait", "file I/O", "RPC",
+                   "jit-compiled dispatch", "device sync"):
+        assert needle in msgs, needle
+
+
+def test_hb16_fixture_clean_near_misses():
+    # snapshot-then-act, cv.wait on the held condition, dict .get
+    assert _lint_fixture("hb16_clean.py") == []
+
+
+def test_hb16_inline_sleep_and_queue_under_lock():
+    out = lint_source(textwrap.dedent("""
+        import time, threading
+        lock = threading.Lock()
+        def drain(q, opts):
+            with lock:
+                mode = opts.get("mode")   # non-queue receiver: clean
+                item = q.get()
+                work_queue.get()
+                time.sleep(1)
+    """), path="<hb16>")
+    assert [v.rule for v in out] == ["HB16", "HB16", "HB16"]
+
+
+def test_hb16_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB16" in RULES
+    assert RULES["HB16"].bad and RULES["HB16"].good
+    out = lint_source(textwrap.dedent("""
+        import time, threading
+        lock = threading.Lock()
+        def tick():
+            with lock:
+                time.sleep(1)  # mxlint: disable=HB16
+    """), path="<hb16>")
+    assert out == []
+
+
+def test_hb14_hb15_hb16_package_is_clean():
+    """The acceptance bar: the whole framework package holds the new
+    concurrency rules (every true positive fixed or justified)."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB14", "HB15", "HB16"})
+    assert viol == [], [f"{v.rule} {v.path}:{v.line}" for v in viol]
+    assert n_files > 50
+
+
+# ----------------------------------------------------------------------
+# --baseline / --fail-on-new: gate CI on regressions only (ISSUE 10)
+# ----------------------------------------------------------------------
+
+_BASELINE_DIRTY = textwrap.dedent("""
+    class Net(HybridBlock):
+        def hybrid_forward(self, F, x):
+            return float(F.max(x))
+""")
+
+
+def test_baseline_roundtrip_gates_only_regressions(tmp_path):
+    f = tmp_path / "legacy.py"
+    f.write_text(_BASELINE_DIRTY)
+    base = tmp_path / "baseline.json"
+    # snapshot: exit 0 even though violations exist
+    r = _run_cli(str(f), "--write-baseline", str(base))
+    assert r.returncode == 0 and base.exists()
+    # unchanged tree vs baseline: grandfathered, exit 0
+    r = _run_cli(str(f), "--baseline", str(base), "--fail-on-new")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "grandfathered" in r.stdout
+    # a NEW violation appears: only it gates (and is reported)
+    f.write_text(_BASELINE_DIRTY + textwrap.dedent("""
+        class Net2(HybridBlock):
+            def hybrid_forward(self, F, x):
+                return x.asnumpy()
+    """))
+    r = _run_cli(str(f), "--baseline", str(base), "--fail-on-new")
+    assert r.returncode == 1
+    assert "asnumpy" in r.stdout and "float" not in r.stdout
+
+
+def test_baseline_fail_on_new_requires_baseline(tmp_path):
+    f = tmp_path / "x.py"
+    f.write_text(_CLI_CLEAN)
+    r = _run_cli(str(f), "--fail-on-new")
+    assert r.returncode == 2
+
+
 def test_hb13_package_is_clean():
     """Every wall-clock measurement of compiled dispatch in the
     framework — including the new telemetry/ package that exists to

@@ -1,0 +1,263 @@
+"""mx.lint.racecheck: the runtime race / lock-order detector (ISSUE 10).
+
+Deterministic — threads are sequenced with start()/join(), never
+sleeps: the detector works on acquisition ORDER HISTORY, so the two
+inverted orders need never actually interleave to be caught (that is
+the point: the chaos runs flag the deadlock without having to lose the
+scheduling lottery first).
+"""
+import json
+import os
+import threading
+
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.lint import racecheck
+
+
+@pytest.fixture
+def armed():
+    """Detector on for the test; conftest's autouse reset (which
+    re-reads MXTPU_RACECHECK) restores the ambient state afterwards."""
+    racecheck.reset()
+    racecheck.configure(enabled=True)
+    yield racecheck
+    racecheck.reset()
+
+
+# ----------------------------------------------------------------------
+# lock-order cycle detection
+# ----------------------------------------------------------------------
+
+def test_ab_ba_from_two_threads_trips_cycle_detector(armed):
+    a = racecheck.make_lock("test.a")
+    b = racecheck.make_lock("test.b")
+
+    def ab():
+        with a:
+            with b:
+                pass
+
+    def ba():
+        with b:
+            with a:
+                pass
+
+    t1 = threading.Thread(target=ab)
+    t1.start()
+    t1.join()
+    assert racecheck.findings() == []     # one order alone: no cycle
+    t2 = threading.Thread(target=ba)
+    t2.start()
+    t2.join()
+    found = racecheck.findings()
+    assert len(found) == 1
+    f = found[0]
+    assert f["kind"] == "lock-order"
+    assert set(f["locks"]) == {"test.a", "test.b"}
+    assert "deadlock" in f["detail"]
+    assert f["stack"]                      # acquisition stack captured
+
+
+def test_consistent_order_and_reentrant_rlock_are_clean(armed):
+    a = racecheck.make_lock("test.a")
+    b = racecheck.make_lock("test.b")
+    r = racecheck.make_rlock("test.r")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    with r:
+        with r:                            # re-entrant: no self-edge
+            pass
+    with a:
+        pass
+    with b:                                # sequential: no edge at all
+        pass
+    assert racecheck.findings() == []
+
+
+def test_cycle_reported_once_per_pair(armed):
+    a = racecheck.make_lock("test.a")
+    b = racecheck.make_lock("test.b")
+    for _ in range(4):
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+    assert len(racecheck.findings()) == 1
+
+
+def test_same_role_instances_share_a_graph_node(armed):
+    # two Membership-style instances created from the same make_lock
+    # role string are ONE node (the lockdep lock-class idea)
+    a1 = racecheck.make_lock("role.a")
+    a2 = racecheck.make_lock("role.a")
+    with a1:
+        with a2:                           # same name: no self-edge
+            pass
+    assert racecheck.findings() == []
+
+
+# ----------------------------------------------------------------------
+# guarded structures
+# ----------------------------------------------------------------------
+
+def test_guarded_dict_bare_mutation_flagged(armed):
+    lock = racecheck.make_lock("test.guard_lock")
+    table = racecheck.guard({}, lock, "test.table")
+    with lock:
+        table["k"] = 1                     # locked: clean
+        assert table["k"] == 1
+    assert racecheck.findings() == []
+    table["k"] = 2                         # SEEDED: bare mutation
+    found = racecheck.findings()
+    assert len(found) == 1
+    assert found[0]["kind"] == "unguarded-access"
+    assert "test.table" in found[0]["detail"]
+
+
+def test_guarded_dict_bare_read_from_thread_flagged(armed):
+    lock = racecheck.make_lock("test.guard_lock")
+    table = racecheck.guard({"k": 1}, lock, "test.table")
+    out = []
+
+    def reader():
+        out.append(table.get("k"))         # SEEDED: bare read, worker
+
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join()
+    assert out == [1]
+    found = racecheck.findings()
+    assert len(found) == 1 and found[0]["kind"] == "unguarded-access"
+
+
+def test_lock_held_by_other_thread_does_not_count(armed):
+    """held_by_current_thread is per-thread: another thread holding the
+    lock must not launder this thread's bare access."""
+    lock = racecheck.make_lock("test.guard_lock")
+    table = racecheck.guard({}, lock, "test.table")
+    acquired = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        with lock:
+            acquired.set()
+            release.wait(5)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    acquired.wait(5)
+    table.update({"k": 1})                 # bare HERE despite holder
+    release.set()
+    t.join()
+    assert [f["kind"] for f in racecheck.findings()] == \
+        ["unguarded-access"]
+
+
+# ----------------------------------------------------------------------
+# zero overhead when disabled
+# ----------------------------------------------------------------------
+
+def test_disabled_mode_allocates_no_wrappers(monkeypatch):
+    monkeypatch.setenv("MXTPU_RACECHECK", "0")
+    racecheck.reset()                      # re-reads the env
+    assert not racecheck.enabled()
+    lk = racecheck.make_lock("x")
+    assert isinstance(lk, type(threading.Lock()))   # plain primitive
+    assert not isinstance(lk, racecheck.TrackedLock)
+    rl = racecheck.make_rlock("x")
+    assert isinstance(rl, type(threading.RLock()))
+    cv = racecheck.make_condition("x")
+    assert isinstance(cv, threading.Condition)
+    assert isinstance(cv._lock, type(threading.RLock()))  # stock inner
+    d = {}
+    assert racecheck.guard(d, lk, "t") is d          # same object back
+    with lk:                               # and nothing is recorded
+        pass
+    assert racecheck.findings() == []
+
+
+# ----------------------------------------------------------------------
+# condition-variable wrapping (the PSServer._barrier_cv shape)
+# ----------------------------------------------------------------------
+
+def test_tracked_condition_wait_notify_roundtrip(armed):
+    cv = racecheck.make_condition("test.cv")
+    state = {"go": False, "seen": False}
+
+    def waiter():
+        with cv:
+            while not state["go"]:
+                cv.wait(timeout=5)
+            state["seen"] = True
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    with cv:
+        state["go"] = True
+        cv.notify_all()
+    t.join(5)
+    assert state["seen"] and not t.is_alive()
+    assert racecheck.findings() == []      # wait/reacquire: no cycle
+
+
+# ----------------------------------------------------------------------
+# flight-recorder integration + reset + chaos gate
+# ----------------------------------------------------------------------
+
+def test_finding_dumps_through_flight_recorder(armed, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    lock = racecheck.make_lock("test.guard_lock")
+    table = racecheck.guard({}, lock, "test.table")
+    table["bare"] = 1                      # SEEDED finding
+    path = mx.telemetry.last_flight_dump()
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "racecheck:unguarded-access"
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "racecheck.unguarded-access" in kinds
+    assert mx.telemetry.value("racecheck.findings") == 1
+
+
+def test_assert_clean_raises_with_context(armed):
+    racecheck.assert_clean("nothing yet")  # no findings: no raise
+    lock = racecheck.make_lock("test.guard_lock")
+    table = racecheck.guard({}, lock, "t")
+    table["k"] = 1
+    with pytest.raises(racecheck.RaceCheckError, match="after shrink"):
+        racecheck.assert_clean("shrink")
+
+
+def test_reset_clears_state_and_rereads_env(armed):
+    a = racecheck.make_lock("test.a")
+    b = racecheck.make_lock("test.b")
+    with a:
+        with b:
+            pass
+    with b:
+        with a:
+            pass
+    assert racecheck.findings()
+    racecheck.reset()
+    assert racecheck.findings() == []
+    assert racecheck.enabled() == \
+        (os.environ.get("MXTPU_RACECHECK", "0") not in ("", "0"))
+
+
+def test_chaos_scenario_runs_under_racecheck(tmp_path):
+    """The tier-1 chaos gate (ISSUE 10 satellite): a preempt scenario
+    arms the detector and its verdict — zero findings — is folded into
+    the scenario's ok."""
+    from mxnet_tpu.testing.chaos import run_scenario
+    r = run_scenario("plain", workdir=str(tmp_path))
+    assert r["racecheck"] is not None
+    assert r["racecheck"]["enabled"] and r["racecheck"]["ok"]
+    assert r["racecheck"]["findings"] == 0
+    assert r["ok"], r

@@ -1,9 +1,11 @@
 #!/usr/bin/env python
-"""mxlint — trace-safety static analyzer for HybridBlocks.
+"""mxlint — trace-safety + concurrency static analyzer.
 
     python tools/mxlint.py mxnet_tpu/gluon/model_zoo
     python tools/mxlint.py my_model.py --format=json
     python tools/mxlint.py --list-rules
+    python tools/mxlint.py examples/ --write-baseline base.json
+    python tools/mxlint.py examples/ --baseline base.json --fail-on-new
 
 Exit codes: 0 clean, 1 violations, 2 usage/IO error. Loads
 ``mxnet_tpu/lint`` as a standalone package so linting never imports the

@@ -494,6 +494,10 @@ def run_chaos(suite: str = "preempt") -> int:
     queue lock: safe to run any time, including while the measurement
     queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # ISSUE 10: every chaos interleaving runs under the runtime race /
+    # lock-order detector (mxnet_tpu.lint.racecheck); a finding fails
+    # the scenario exactly like a parity miss
+    env.setdefault("MXTPU_RACECHECK", "1")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
@@ -514,6 +518,15 @@ def run_chaos(suite: str = "preempt") -> int:
         if bad:
             _log(f"chaos smoke: FAILED — injected kill left no valid "
                  f"flight-recorder dump in scenario(s) {bad}")
+            return 1
+        # ISSUE 10: zero racecheck findings after every scenario
+        raced = [s.get("kind") or s.get("mode")
+                 for s in verdicts[-1].get("chaos", [])
+                 if s.get("racecheck") is not None
+                 and not s["racecheck"].get("ok")]
+        if raced:
+            _log(f"chaos smoke: FAILED — racecheck findings in "
+                 f"scenario(s) {raced}")
             return 1
         _log("chaos smoke: OK " + json.dumps(verdicts[-1]))
         return 0
