@@ -401,6 +401,7 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
                                             overlap_stats=overlap_stats)
     except Exception as e:  # noqa: BLE001 — observability never voids the bench
         result["comm"] = {"error": f"{type(e).__name__}: {e}"}
+    _stamp_parallelism(result, trainer)
     import jax.numpy as jnp
     from mxnet_tpu.ndarray import random as _rnd
     jitted = jit_args = None
@@ -541,6 +542,7 @@ def _bench_bert() -> dict:
     # analytic FLOPs: cross-checked against XLA cost analysis on TPU v5e
     # (77.9 vs 78.2 TFLOP/s delivered) — skips a costly AOT recompile
     _attach_mfu(result, _bert_train_flops_per_sample(seq), samples_s)
+    _stamp_parallelism(result, trainer)
     try:
         result["flash_attention"] = _flash_evidence(batch, seq)
     except Exception as e:  # noqa: BLE001 — evidence must not void the
@@ -962,6 +964,27 @@ def _run_bench() -> dict:
             profiler.stop()
 
 
+def _stamp_parallelism(result: dict, trainer) -> dict:
+    """Stamp the mesh shape + `parallelism` block (ISSUE 11) onto a
+    bench payload: the mesh is configuration (always stamped);
+    pp_bubble_frac is the analytic 1F1B fraction (present only when a
+    pipeline axis exists); tp_collective_ms is MEASURED-only and stays
+    null until a tp>1 TPU round fills it (PR 6 honesty rule)."""
+    try:
+        from mxnet_tpu.parallel.mesh import parallelism_block
+        from mxnet_tpu.parallel.pipeline_parallel import bubble_fraction
+        cfg = trainer.mesh_config
+        pp_m = trainer._pp_microbatches if cfg.pp > 1 else None
+        pb = bubble_fraction(cfg.pp, pp_m) if cfg.pp > 1 else None
+        result["mesh"] = cfg.as_dict()
+        result["parallelism"] = parallelism_block(
+            cfg, pp_microbatches=pp_m, pp_bubble_frac=pb,
+            tp_collective_ms=None)
+    except Exception as e:  # noqa: BLE001 — observability never voids
+        result["parallelism"] = {"error": f"{type(e).__name__}: {e}"}
+    return result
+
+
 def _stamp_telemetry(result: dict) -> dict:
     """Stamp the payload with the telemetry schema version (ISSUE 9):
     consumers of bench JSON / telemetry snapshots gate field parsing on
@@ -1003,6 +1026,9 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
               "platform_actual", "telemetry_schema_version"):
         if k in result and result[k] is not None:
             cands.append((k, result[k]))
+    par = result.get("parallelism") or {}
+    if par.get("mesh_spec"):
+        cands.append(("mesh", par["mesh_spec"]))
     if "error" in result:
         err = str(result["error"])
         cands.append(("error",

@@ -297,11 +297,24 @@ _FORMAT_VERSION = 1
 def _dp_size():
     """Ambient dp mesh size at save time (recorded in the manifest so a
     resumed run can reshard optimizer state when its dp differs)."""
-    from .parallel.mesh import current_mesh
+    from .parallel.mesh import current_mesh, AXIS_DP
     mesh = current_mesh()
-    if mesh is not None and "dp" in mesh.axis_names:
-        return int(mesh.shape["dp"])
+    if mesh is not None and AXIS_DP in mesh.axis_names:
+        return int(mesh.shape[AXIS_DP])
     return 1
+
+
+def _mesh_desc():
+    """Ambient 3D mesh spec at save time (``"dp8"``, ``"dp2tp2pp2"``,
+    ... — ISSUE 11): the manifest records the FULL topology, so a
+    restore into any other dp x tp x pp shape knows what it reshards
+    from.  The state itself is mesh-independent (per-parameter space);
+    this field is provenance, not a restore requirement."""
+    from .parallel.mesh import current_mesh, MeshConfig
+    mesh = current_mesh()
+    if mesh is None:
+        return None
+    return MeshConfig.for_mesh(mesh).describe()
 
 
 class CheckpointManager:
@@ -438,6 +451,7 @@ class CheckpointManager:
         from . import runtime as _runtime
         meta = {"format": _FORMAT_VERSION, "step": step,
                 "time": time.time(), "dp": _dp_size(),
+                "mesh": _mesh_desc(),
                 # K-step compiled training (ISSUE 6): record the save
                 # cadence so a resumed run knows the cursor can only sit
                 # on this grid — the cursor itself stays in STEPS, so a
@@ -643,6 +657,12 @@ def reshard_in_place(trainer, mesh, params=None, _attempt=0):
 
     Returns ``{"source": "peer", "step": None}`` (no rewind: training
     continues at the paused step).
+
+    ``mesh`` may be a ``jax.sharding.Mesh`` or a
+    ``parallel.MeshConfig`` (ISSUE 11): an elastic transition re-fences
+    all three axes (dp, tp, pp) through ``trainer.rebuild`` — the
+    per-parameter state capture below is mesh-shape-independent, so a
+    ``2x2x2`` trainer reshards onto ``dp8`` (and back) bitwise.
     """
     if not hasattr(trainer, "rebuild"):
         raise MXNetError(

@@ -174,7 +174,7 @@ class ElasticController:
                 f"implies dp={new_dp}, below the MXTPU_ELASTIC_MIN_DP="
                 f"{self._min_dp} floor — refusing to continue crippled; "
                 f"restore capacity or lower the floor")
-        mesh = self._make_mesh(new_dp)
+        mesh = self._make_mesh(new_dp, trainer)
         t0 = time.perf_counter()
         info = None
         last_err = None
@@ -235,9 +235,19 @@ class ElasticController:
                          rewind_step=info.get("step"))
         return info
 
-    def _make_mesh(self, dp):
-        from ..parallel.mesh import make_mesh
-        return make_mesh({"dp": dp}, self._devices[:dp])
+    def _make_mesh(self, dp, trainer=None):
+        """The post-transition mesh: the dp axis follows membership, the
+        tp/pp axes follow the TRAINER's MeshConfig (ISSUE 11: an elastic
+        transition epoch-fences all three axes — tp/pp shape is a model
+        property and survives the reshard, dp is the elastic one)."""
+        from ..parallel.mesh import MeshConfig
+        cfg = getattr(trainer, "mesh_config", None)
+        tp = cfg.tp if cfg is not None else 1
+        pp = cfg.pp if cfg is not None else 1
+        if tp > 1 or pp > 1:
+            dp = max(1, min(dp, len(self._devices) // (tp * pp)))
+        new = MeshConfig(dp=dp, tp=tp, pp=pp)
+        return new.build(self._devices[:new.size])
 
     # -- observability ---------------------------------------------------
     def stats(self):

@@ -215,11 +215,11 @@ class Trainer:
         eligible parameter's new value on a 1/N shard per chip (with the
         optimizer state living sharded) and all-gathers the result.
         ``MXTPU_SHARDED_SYNC=0`` kills it; no mesh -> exact old path."""
-        from ..parallel.mesh import current_mesh
+        from ..parallel.mesh import current_mesh, AXIS_DP
         from ..parallel import zero as _zero
         mesh = current_mesh()
-        if mesh is None or "dp" not in mesh.axis_names or \
-                mesh.shape["dp"] <= 1 or not _zero.sharded_sync_enabled():
+        if mesh is None or AXIS_DP not in mesh.axis_names or \
+                mesh.shape[AXIS_DP] <= 1 or not _zero.sharded_sync_enabled():
             return None
         return mesh
 
@@ -237,11 +237,12 @@ class Trainer:
         if jitted is None:
             if mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec as P
-                dp = mesh.shape["dp"]
+                from ..parallel.mesh import AXIS_DP
+                dp = mesh.shape[AXIS_DP]
 
                 def ws_spec(ndim):
                     return NamedSharding(
-                        mesh, P(*(["dp"] + [None] * (ndim - 1))))
+                        mesh, P(*([AXIS_DP] + [None] * (ndim - 1))))
 
                 def shardable(x):
                     return getattr(x, "ndim", 0) >= 1 and \

@@ -368,7 +368,8 @@ class KVStoreTPUSync(KVStoreLocal):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        self._data_axis = "dp"
+        from ..parallel.mesh import AXIS_DP
+        self._data_axis = AXIS_DP
         self._traced_store = {}   # key -> reduced tracer, within one trace
 
     @property

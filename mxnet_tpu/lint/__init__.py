@@ -20,6 +20,8 @@ framework-level diagnostics with stable rule IDs:
           across every linted file)
     HB16  blocking call (device sync / RPC / file IO / queue.get /
           time.sleep / jitted dispatch) inside a `with lock:` body
+    HB17  hardcoded mesh-axis literal ("dp"/"tp"/"pp" in P()/collective
+          calls, mesh.shape["dp"]/[0]) outside parallel/mesh.py
 
 CLI: ``python tools/mxlint.py <paths>`` (non-zero exit on violations,
 ``--format=json|text``, per-line ``# mxlint: disable=HB0x``,

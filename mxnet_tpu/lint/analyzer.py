@@ -1158,6 +1158,88 @@ class _UnsyncedTimingScanner(ast.NodeVisitor):
                     block="", func=self.func_stack[-1]))
 
 
+# -- HB17: hardcoded mesh-axis literal outside parallel/mesh.py ----------
+
+_HB17_AXIS_NAMES = {"dp", "tp", "pp"}
+_HB17_SPEC_CALLEES = {"P", "PartitionSpec"}
+_HB17_COLLECTIVE_CALLEES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle", "axis_index", "pcast",
+    "reduce_scatter_bucket"}
+
+
+class _MeshAxisLiteralScanner(ast.NodeVisitor):
+    """HB17: a hardcoded ``"dp"``/``"tp"``/``"pp"`` string inside a
+    PartitionSpec or collective call, or a literal index into a mesh's
+    ``.shape``/``.axis_names`` (``mesh.shape["dp"]`` / ``mesh.shape[0]``)
+    anywhere outside ``parallel/mesh.py``.  The axis names are
+    MeshConfig's contract (ISSUE 11): literal copies silently break when
+    the mesh layout changes (an elastic reshard, a 2x2x2 config, a
+    renamed axis) — import ``AXIS_DP``/``AXIS_TP``/``AXIS_PP`` from
+    ``parallel.mesh`` or go through the MeshConfig accessors instead."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+        norm = path.replace("\\", "/")
+        self.exempt = norm.endswith("parallel/mesh.py")
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _add(self, node, what):
+        self.c.add(Violation(
+            rule="HB17", path=self.path, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            message=f"hardcoded mesh-axis {what}: the dp/tp/pp axis "
+                    "names are MeshConfig's contract (parallel/mesh.py)"
+                    " — import AXIS_DP/AXIS_TP/AXIS_PP or use the "
+                    "MeshConfig accessors so a changed mesh layout "
+                    "cannot silently strand this call site",
+            block="", func=self.func_stack[-1]))
+
+    def visit_Call(self, node):
+        if not self.exempt:
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) \
+                else getattr(f, "id", None)
+            if name in _HB17_SPEC_CALLEES or \
+                    name in _HB17_COLLECTIVE_CALLEES:
+                for sub in list(node.args) + \
+                        [kw.value for kw in node.keywords]:
+                    for n in ast.walk(sub):
+                        if isinstance(n, ast.Constant) and \
+                                n.value in _HB17_AXIS_NAMES:
+                            self._add(n, f'literal "{n.value}" in '
+                                         f"`{name}(...)`")
+        self.generic_visit(node)
+
+    def visit_Subscript(self, node):
+        if not self.exempt:
+            v = node.value
+            if isinstance(v, ast.Attribute) and \
+                    v.attr in ("shape", "axis_names", "axis_sizes"):
+                base = v.value
+                base_name = base.attr if isinstance(base, ast.Attribute) \
+                    else getattr(base, "id", "")
+                if "mesh" in str(base_name).lower():
+                    sl = node.slice
+                    if isinstance(sl, ast.Constant) and (
+                            sl.value in _HB17_AXIS_NAMES or
+                            isinstance(sl.value, int)):
+                        self._add(
+                            sl, f"index `{base_name}.{v.attr}"
+                                f"[{sl.value!r}]`")
+        self.generic_visit(node)
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -1300,6 +1382,7 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
         _MultiStepPullScanner(collector, path).visit(tree)
         _DecodeLoopPullScanner(collector, path).visit(tree)
         _UnsyncedTimingScanner(collector, path).visit(tree)
+        _MeshAxisLiteralScanner(collector, path).visit(tree)
         # HB14/HB15/HB16: the interprocedural concurrency pass (per-class
         # lock + field-access + call-graph model; concurrency.py)
         run_concurrency_pass(collector, tree, path, src_lines)

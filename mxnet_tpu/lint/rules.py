@@ -269,6 +269,23 @@ RULES = {
         "with self._lock:\n"
         "    arr = self._table[key].copy()   # snapshot under the lock\n"
         "sock.sendall(pack(arr))             # blocking work outside"),
+    "HB17": Rule(
+        "HB17", "hardcoded-mesh-axis",
+        "A literal \"dp\"/\"tp\"/\"pp\" string inside a PartitionSpec "
+        "or collective call, or a literal index into a mesh's "
+        "`.shape`/`.axis_names` (`mesh.shape[\"dp\"]`, `mesh.shape[0]`)"
+        " outside parallel/mesh.py.  The axis names are MeshConfig's "
+        "single-source contract (ISSUE 11): a hardcoded copy keeps "
+        "compiling after the mesh layout changes — a 2x2x2 config, an "
+        "elastic reshard, a reordered axis — and then shards or "
+        "reduces over the WRONG axis silently.  Import "
+        "AXIS_DP/AXIS_TP/AXIS_PP from parallel.mesh (or read sizes "
+        "through MeshConfig) so the name has one owner.",
+        "spec = P(\"dp\", None)          # literal axis name\n"
+        "dp = self.mesh.shape[\"dp\"]    # literal shape index",
+        "from mxnet_tpu.parallel.mesh import AXIS_DP\n"
+        "spec = P(AXIS_DP, None)\n"
+        "dp = self.mesh.shape[AXIS_DP]   # one owner for the name"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

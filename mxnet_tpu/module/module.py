@@ -276,7 +276,8 @@ class Module(BaseModule):
             raise MXNetError(
                 f"batch size {self._batch_size} must be divisible by the "
                 f"number of contexts {len(devs)}")
-        self._mesh = Mesh(_np2.array(devs), ("dp",))
+        from ..parallel.mesh import AXIS_DP
+        self._mesh = Mesh(_np2.array(devs), (AXIS_DP,))
 
     def _replicate_params(self):
         """Pin parameters replicated on the dp mesh. Runs AFTER they hold
@@ -296,7 +297,9 @@ class Module(BaseModule):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
         data = arr.data if hasattr(arr, "data") else arr
-        return jax.device_put(data, NamedSharding(self._mesh, P("dp")))
+        from ..parallel.mesh import AXIS_DP
+        return jax.device_put(data,
+                              NamedSharding(self._mesh, P(AXIS_DP)))
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False,

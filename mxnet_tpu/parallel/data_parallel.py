@@ -37,7 +37,8 @@ from .. import _tape
 from .. import telemetry as _telem
 from ..gluon.parameter import _bind_params
 from ._compat import shard_map
-from .mesh import current_mesh, make_mesh
+from .mesh import (current_mesh, make_mesh, MeshConfig,
+                   AXIS_DP, AXIS_TP, AXIS_PP)
 from . import zero as _zero
 
 __all__ = ["DataParallelTrainer", "all_reduce_gradients"]
@@ -68,10 +69,34 @@ class DataParallelTrainer:
 
     def __init__(self, block, loss_fn, optimizer="sgd", optimizer_params=None,
                  mesh=None, batch_axis=0, dtype=None, donate=True,
-                 shard_updates=False, label_batch_axis=None):
+                 shard_updates=False, label_batch_axis=None,
+                 mesh_config=None, pp_microbatches=None):
         self.block = block
         self.loss_fn = loss_fn
-        self.mesh = mesh or current_mesh() or make_mesh({"dp": -1})
+        # ONE source of mesh truth (ISSUE 11): an explicit MeshConfig
+        # wins, then an explicit/ambient Mesh (config derived from its
+        # axis names), then the MXTPU_MESH env spec, then flat dp over
+        # all devices — the unset-env default builds exactly the
+        # Mesh(('dp',), N) of the flat trainer, bitwise.
+        if mesh_config is None and mesh is None:
+            mesh = current_mesh()
+        if mesh is not None:
+            self.mesh = mesh
+            self.mesh_config = MeshConfig.for_mesh(mesh)
+        else:
+            cfg = mesh_config or MeshConfig.from_env() \
+                or MeshConfig(dp=-1)
+            self.mesh_config = cfg = cfg.resolve(len(jax.devices()))
+            self.mesh = cfg.build()
+        # pipeline microbatch knob: arg > MXTPU_PP_MICROBATCH env >
+        # 2 ticks of work per stage (the smallest schedule with a
+        # steady-state 1F1B phase)
+        if pp_microbatches is None:
+            import os as _os
+            pp_microbatches = int(_os.environ.get(
+                "MXTPU_PP_MICROBATCH", 2 * self.mesh_config.pp))
+        self._pp_microbatches = max(1, int(pp_microbatches))
+        self._pp_exec = None          # built on first pp step
         self.batch_axis = batch_axis
         self._label_bax = (batch_axis if label_batch_axis is None
                            else label_batch_axis)
@@ -81,8 +106,11 @@ class DataParallelTrainer:
         # The raw request survives separately so rebuild() can re-derive
         # the effective flag for a different world size (dp may cross 1).
         self._shard_requested = bool(shard_updates)
+        # ZeRO-1 runs on the pure-dp composition only: tp-sharded
+        # params and pp-staged params have their own state layouts
         self._shard_updates = self._shard_requested and \
-            self.mesh.shape.get("dp", 1) > 1
+            self.mesh.shape.get(AXIS_DP, 1) > 1 and \
+            self.mesh_config.tp == 1 and self.mesh_config.pp == 1
         self._zero1 = None              # tri-state; resolved lazily
         self._plan = None               # zero.BucketPlan once params known
         self._comm_dtype = _zero.comm_dtype()   # read ONCE at construction
@@ -162,7 +190,7 @@ class DataParallelTrainer:
             return NamedSharding(self.mesh, P())
         ax = self._eff_bax(b.ndim, is_label)
         spec = [None] * b.ndim
-        spec[ax] = "dp"
+        spec[ax] = AXIS_DP
         return NamedSharding(self.mesh, P(*spec))
 
     def _batch_spec(self, ndim, is_label=False):
@@ -171,7 +199,7 @@ class DataParallelTrainer:
         if not ndim:
             return P()
         spec = [None] * ndim
-        spec[self._eff_bax(ndim, is_label)] = "dp"
+        spec[self._eff_bax(ndim, is_label)] = AXIS_DP
         return P(*spec)
 
     def _put_batch(self, inputs):
@@ -187,7 +215,7 @@ class DataParallelTrainer:
         same ``_eff_bax`` rule as :meth:`_batch_spec`."""
         inner = [None] * (ndim - 1)
         if ndim - 1 >= 1:
-            inner[self._eff_bax(ndim - 1, is_label)] = "dp"
+            inner[self._eff_bax(ndim - 1, is_label)] = AXIS_DP
         return P(*([None] + inner))
 
     def _put_stacked(self, steps):
@@ -365,6 +393,8 @@ class DataParallelTrainer:
         :meth:`_build_accum`).  Returns the mean microbatch loss."""
         if n_micro < 1:
             raise MXNetError("step_accum: n_micro must be >= 1")
+        if self._pp_active():
+            return self._pp_step(batch, n_micro=n_micro)
         t_step = _telem.clock() if _telem.enabled() else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
@@ -389,7 +419,7 @@ class DataParallelTrainer:
             self._zero1_ensure_plan(inputs)
         self._ensure_device_state(params)
         if self._zero1_active():
-            dp = self.mesh.shape["dp"]
+            dp = self.mesh.shape[AXIS_DP]
             b = inputs[-1].shape[bax]
             if b % dp or (b // dp) % n_micro:
                 raise MXNetError(
@@ -461,7 +491,7 @@ class DataParallelTrainer:
                 order = self._probe_backward_order(probe_inputs)
             self._plan = _zero.BucketPlan(
                 [tuple(p.shape) for p in self._param_objs],
-                self.mesh.shape["dp"], fill_order=order)
+                self.mesh.shape[AXIS_DP], fill_order=order)
         return self._plan
 
     def _probe_backward_order(self, inputs):
@@ -509,7 +539,7 @@ class DataParallelTrainer:
         (per-element state) shard over 'dp', scalar leaves (step
         counters) replicate."""
         return jax.tree.map(
-            lambda x: P("dp") if getattr(x, "ndim", 0) >= 1 else P(),
+            lambda x: P(AXIS_DP) if getattr(x, "ndim", 0) >= 1 else P(),
             self._opt_state)
 
     def _zero1_sync_update(self, param_vals, grads, opt_local, lr, key,
@@ -534,9 +564,9 @@ class DataParallelTrainer:
           (slice / tile) — the pure-compute baseline the probe subtracts.
         """
         plan = self._plan
-        dp = self.mesh.shape["dp"]
+        dp = self.mesh.shape[AXIS_DP]
         mode = self._comm_dtype
-        idx = lax.axis_index("dp")
+        idx = lax.axis_index(AXIS_DP)
         gflats = plan.flatten(grads)
         pflats = plan.flatten(param_vals)
         if comm_mode == "mono":
@@ -563,7 +593,7 @@ class DataParallelTrainer:
             if comm_mode == "none":
                 new_pflats.append(jnp.tile(np_, dp))
             else:
-                new_pflats.append(lax.all_gather(np_, "dp", tiled=True))
+                new_pflats.append(lax.all_gather(np_, AXIS_DP, tiled=True))
             new_state.append(ns)
         return plan.unflatten(new_pflats, param_vals), new_state
 
@@ -590,9 +620,9 @@ class DataParallelTrainer:
             bucketed RS -> 1/N update -> AG pipeline.  Shared by every
             kind; the multi-step scan body IS this function."""
             # per-chip PRNG stream (dropout etc. draws fresh per chip)
-            key = jax.random.fold_in(key, lax.axis_index("dp"))
+            key = jax.random.fold_in(key, lax.axis_index(AXIS_DP))
             grads, loss = grad_fn(param_vals, key, ins, label)
-            loss = lax.pmean(loss, "dp")
+            loss = lax.pmean(loss, AXIS_DP)
             new_params, new_state = self._zero1_sync_update(
                 param_vals, grads, opt_local, lr,
                 jax.random.fold_in(key, 0x5eed), comm_mode=comm_mode)
@@ -653,7 +683,7 @@ class DataParallelTrainer:
         return jitted
 
     def _zero1_check_batch(self, inputs):
-        dp = self.mesh.shape["dp"]
+        dp = self.mesh.shape[AXIS_DP]
         for i, b in enumerate(inputs):
             ax = self._eff_bax(b.ndim, is_label=(i == len(inputs) - 1))
             if b.shape[ax] % dp:
@@ -662,6 +692,51 @@ class DataParallelTrainer:
                     f"not divisible by dp={dp} (the sharded pipeline "
                     f"needs even shards; MXTPU_SHARDED_SYNC=0 restores "
                     f"the psum path)")
+
+    # -- pipeline parallelism (ISSUE 11: pp axis of the MeshConfig) -----
+    def _pp_active(self):
+        return self.mesh_config.pp > 1
+
+    def _pp_ensure(self):
+        """Build the 1F1B stage executor once: split the block into
+        ``pp`` contiguous stages and give each its ``dp [x tp]``
+        submesh (``MeshConfig.stage_mesh``) — stage params/optimizer
+        state live ONLY there."""
+        if self._pp_exec is None:
+            from .pipeline_parallel import (PipelineStageExecutor,
+                                            split_into_stages)
+            stages = split_into_stages(self.block, self.mesh_config.pp)
+            devices = list(_np.asarray(self.mesh.devices).reshape(-1))
+            self._pp_exec = PipelineStageExecutor(
+                stages, self.loss_fn, self.mesh_config, devices,
+                self._rule_init, self._rule_apply,
+                self._pp_microbatches)
+        return self._pp_exec
+
+    def _pp_step(self, batch, n_micro=1):
+        """One pp training step (step/step_accum/step_multi all land
+        here): the executor runs M = pp_microbatches * n_micro
+        microbatches through the 1F1B schedule.  Loss semantics match
+        the flat step: the mean of equal-size microbatch means IS the
+        full-batch mean."""
+        t_step = _telem.clock() if _telem.enabled() else None
+        if self.batch_axis != 0 or self._label_bax != 0:
+            raise MXNetError(
+                "pipeline parallelism supports batch_axis=0 only")
+        inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
+                  for b in batch]
+        if len(inputs) != 2:
+            raise MXNetError(
+                "pipeline parallelism expects (data, label) batches — "
+                "a Sequential stage chain has one activation stream")
+        self._collect(NDArray(inputs[0]))
+        ex = self._pp_ensure()
+        key = _rnd.next_key()
+        lr = self.learning_rate
+        loss = ex.step(inputs[0], inputs[1], key, lr, n_micro=n_micro)
+        self._num_update += 1
+        self._record_step(1, t_step)
+        return NDArray(loss)
 
     # -- telemetry (ISSUE 9) --------------------------------------------
     def _dispatch(self, jitted, *args):
@@ -706,6 +781,8 @@ class DataParallelTrainer:
     def step(self, *batch):
         """batch = (*inputs, label) NDArrays. Returns the scalar loss
         NDArray."""
+        if self._pp_active():
+            return self._pp_step(batch)
         t_step = _telem.clock() if _telem.enabled() else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
@@ -760,6 +837,13 @@ class DataParallelTrainer:
             raise MXNetError("step_multi: need at least one batch")
         if n_micro < 1:
             raise MXNetError("step_multi: n_micro must be >= 1")
+        if self._pp_active():
+            # the pp schedule is host-driven — K steps run as K
+            # consecutive 1F1B windows (identical math to K=1 by
+            # construction; the scan fusion is a flat-mesh feature)
+            losses = [self._pp_step(bt, n_micro=n_micro).data
+                      for bt in batches]
+            return NDArray(jnp.stack(losses))
         steps = [[b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in bt] for bt in batches]
         first = steps[0]
@@ -783,7 +867,7 @@ class DataParallelTrainer:
         self._ensure_device_state(params)
         if self._zero1_active():
             self._zero1_check_batch(first)
-            dp = self.mesh.shape["dp"]
+            dp = self.mesh.shape[AXIS_DP]
             if n_micro > 1 and (first[-1].shape[bax] // dp) % n_micro:
                 raise MXNetError(
                     f"step_multi under shard_updates: batch "
@@ -828,6 +912,10 @@ class DataParallelTrainer:
         (src/io/iter_prefetcher.h) — and on remote-tunneled hosts it
         avoids the per-step H2D dispatch stall entirely.
         """
+        if self._pp_active():
+            raise MXNetError(
+                "put_epoch/step_indexed are flat-mesh entry points; "
+                "with a pp axis use step()/step_accum()/step_multi()")
         mesh = self.mesh
         sd = jnp.asarray(superdata.data if isinstance(superdata, NDArray)
                          else superdata)
@@ -843,7 +931,7 @@ class DataParallelTrainer:
                     f"got shape {tuple(a.shape)}. Stack per-step batches "
                     f"along a new axis 0 before calling put_epoch.")
             inner = [None] * (a.ndim - 1)
-            inner[self._eff_bax(a.ndim - 1, is_label)] = "dp"
+            inner[self._eff_bax(a.ndim - 1, is_label)] = AXIS_DP
             return P(*([None] + inner))
 
         spec_d = epoch_spec(sd)
@@ -858,6 +946,11 @@ class DataParallelTrainer:
         #6: re-device_put per step put a host round on the timed path).
         Only a parameter externally mutated since our last write (identity
         check against the cached array) is re-transferred."""
+        if self._pp_active():
+            # pp-staged state lives with the stage executor (each
+            # stage's submesh), not in the flat-mesh caches
+            self._pp_ensure().ensure_ready()
+            return
         if self._param_vals is None:
             self._param_vals = [
                 jax.device_put(p.data().data, self._param_sharding(p))
@@ -876,7 +969,7 @@ class DataParallelTrainer:
                 # replicate.  This is where the (N-1)/N optimizer-HBM
                 # saving comes from.
                 plan = self._zero1_ensure_plan()
-                shard = NamedSharding(self.mesh, P("dp"))
+                shard = NamedSharding(self.mesh, P(AXIS_DP))
                 rep = NamedSharding(self.mesh, P())
                 self._opt_state = [
                     jax.tree.map(
@@ -941,10 +1034,24 @@ class DataParallelTrainer:
         :meth:`load_state_dict` (its on-disk/per-parameter form is
         dp-independent by PR 4 design, so any source dp reshards
         bitwise).  The update-counter and lr schedule state are host
-        scalars and carry over untouched."""
+        scalars and carry over untouched.
+
+        ``mesh`` may be a ``jax.sharding.Mesh`` or a
+        :class:`~mxnet_tpu.parallel.mesh.MeshConfig` — an elastic
+        transition re-fences ALL THREE axes through here, not just dp
+        (ISSUE 11): the pp stage executor, tp shard placements and the
+        ZeRO resolution are all re-derived from the new config."""
+        if isinstance(mesh, MeshConfig):
+            cfg = mesh.resolve(len(jax.devices()))
+            mesh = cfg.build()
+        else:
+            cfg = MeshConfig.for_mesh(mesh)
         self.mesh = mesh
+        self.mesh_config = cfg
+        self._pp_exec = None
         self._shard_updates = self._shard_requested and \
-            mesh.shape.get("dp", 1) > 1
+            mesh.shape.get(AXIS_DP, 1) > 1 and \
+            cfg.tp == 1 and cfg.pp == 1
         self._zero1 = None
         self._plan = None
         self._jitted = None
@@ -980,7 +1087,26 @@ class DataParallelTrainer:
         saved once."""
         from ..ndarray.ndarray import NDArray as _ND
         arrays, leaves = {}, {}
-        if self._opt_state is not None:
+        if self._pp_active():
+            # pp-staged state: the executor's per-stage trees map back
+            # to the global (sorted) parameter index — the on-disk form
+            # is identical to the replicated save, so a checkpoint
+            # written at dp x tp x pp restores into ANY mesh shape
+            ex = self._pp_exec
+            if ex is not None and ex._opt_state is not None and \
+                    self._param_objs is not None:
+                pos = {id(p): i for i, p in enumerate(self._param_objs)}
+                for _s, _li, p, _val, state in ex.iter_params():
+                    gi = pos[id(p)]
+                    for name, leaf in state.items():
+                        if getattr(leaf, "ndim", 0) >= 1:
+                            arrays[f"opt/{gi}/{name}"] = _ND(leaf)
+                            leaves[name] = "vec"
+                        else:
+                            arrays[f"opt/{gi}/{name}"] = _ND(
+                                jnp.asarray(leaf))
+                            leaves.setdefault(name, "per_param_scalar")
+        elif self._opt_state is not None:
             params = self._param_objs
             if self._zero1_active():
                 plan = self._zero1_ensure_plan()
@@ -1014,7 +1140,8 @@ class DataParallelTrainer:
         meta = {"kind": "parallel.DataParallelTrainer",
                 "rule": self._rule_name,
                 "num_update": int(self._num_update),
-                "saved_dp": int(self.mesh.shape.get("dp", 1)),
+                "saved_dp": int(self.mesh.shape.get(AXIS_DP, 1)),
+                "saved_mesh": self.mesh_config.describe(),
                 "zero1": bool(self._opt_state is not None
                               and self._zero1_active()),
                 "leaves": leaves}
@@ -1037,9 +1164,36 @@ class DataParallelTrainer:
         def host(a):
             return _np.asarray(a.asnumpy())
 
+        if self._pp_active():
+            # re-stage the per-parameter state onto each stage's submesh
+            # (the pp inverse of the branches below; a checkpoint saved
+            # at ANY mesh shape — flat dp8, zero1, 2x2x2 — lands here
+            # when THIS trainer has a pipeline axis)
+            ex = self._pp_ensure()
+            ex.ensure_ready()
+            pos = {id(p): i for i, p in enumerate(params)}
+            for s, li, p, val, _state in list(ex.iter_params()):
+                gi = pos[id(p)]
+                tmpl = self._rule_init(val)
+                new_state = {}
+                for name, tleaf in tmpl.items():
+                    if tleaf.ndim >= 1:
+                        src = host(arrays[f"opt/{gi}/{name}"])
+                        new_state[name] = jnp.asarray(
+                            src, tleaf.dtype).reshape(tleaf.shape)
+                    else:
+                        key = f"opt/{gi}/{name}" \
+                            if f"opt/{gi}/{name}" in arrays \
+                            else f"opt_scalar/{name}"
+                        new_state[name] = jnp.asarray(
+                            host(arrays[key]).reshape(()), tleaf.dtype)
+                ex.set_state(s, li, new_state)
+            ex.ensure_ready()       # re-place the restored params
+            return
+
         if self._zero1_active():
             plan = self._zero1_ensure_plan()
-            shard = NamedSharding(self.mesh, P("dp"))
+            shard = NamedSharding(self.mesh, P(AXIS_DP))
             rep = NamedSharding(self.mesh, P())
             # template fixes the leaf set + dtypes for this rule
             template = self._rule_init(jnp.zeros((1,), jnp.float32))
@@ -1129,7 +1283,7 @@ class DataParallelTrainer:
         if self._zero1_active():
             self._zero1_ensure_plan(inputs)
         self._ensure_device_state(params)
-        if not self._zero1_active() or self.mesh.shape.get("dp", 1) <= 1:
+        if not self._zero1_active() or self.mesh.shape.get(AXIS_DP, 1) <= 1:
             return out
         self._zero1_check_batch(inputs)
         dev_inputs = self._put_batch(inputs)
@@ -1193,7 +1347,16 @@ class DataParallelTrainer:
         much of it a ``step_ms``-long step could hide.  All fields are
         zeros when the sharded pipeline is off — the schema survives so
         CPU CI regression-tests it (tests/test_bench_line.py)."""
-        dp = self.mesh.shape.get("dp", 1)
+        dp = self.mesh.shape.get(AXIS_DP, 1)
+        if self._pp_active():
+            # pipeline-staged state: each chip holds only its stage's
+            # optimizer state (the pp analog of the ZeRO row below)
+            ex = self._pp_exec
+            total = ex.state_bytes() if ex is not None else 0
+            return _zero.comm_block(
+                dp=dp, wire_dtype=self._comm_dtype,
+                state_bytes_per_chip=total // self.mesh_config.pp,
+                state_bytes_replicated=total)
         state_rep = 0
         if self._opt_state is not None:
             for leaf in jax.tree.leaves(self._opt_state):
@@ -1241,7 +1404,7 @@ class DataParallelTrainer:
         import time
         from .. import profiler
         plan = self._plan
-        dp = self.mesh.shape["dp"]
+        dp = self.mesh.shape[AXIS_DP]
         mode = self._comm_dtype
 
         def comm_only(flats, key):
@@ -1249,7 +1412,7 @@ class DataParallelTrainer:
             for b, f in enumerate(flats):
                 sh = _zero.reduce_scatter_bucket(
                     f, jax.random.fold_in(key, b), dp, mode)
-                outs.append(lax.all_gather(sh, "dp", tiled=True))
+                outs.append(lax.all_gather(sh, AXIS_DP, tiled=True))
             return outs
 
         specs = [P()] * plan.n_buckets
@@ -1268,7 +1431,7 @@ class DataParallelTrainer:
         return (t1 - t0) / iters * 1e3
 
 
-def all_reduce_gradients(params, mesh=None, axis="dp", kvstore=None,
+def all_reduce_gradients(params, mesh=None, axis=AXIS_DP, kvstore=None,
                          keys=None):
     """Sum parameter gradients across data-parallel workers — the ONE
     implementation behind ``gluon.Trainer.allreduce_grads`` and

@@ -17,9 +17,11 @@ from jax.sharding import PartitionSpec as P
 from ..base import MXNetError
 from ..gluon import nn
 from ..gluon.block import HybridBlock
+from .mesh import AXIS_TP
 
 __all__ = ["tp_spec_for_param", "shard_params_tp", "ParallelDense",
-           "ParallelEmbedding"]
+           "ParallelEmbedding", "llama_tp_rules", "bert_tp_rules",
+           "shard_model_tp"]
 
 
 def tp_spec_for_param(name, shape, kind="auto"):
@@ -29,12 +31,12 @@ def tp_spec_for_param(name, shape, kind="auto"):
     if len(shape) < 2:
         return P()
     if kind == "column":
-        return P("tp", None)
+        return P(AXIS_TP, None)
     if kind == "row":
-        return P(None, "tp")
+        return P(None, AXIS_TP)
     if "embed" in name:
-        return P(None, "tp")
-    return P("tp", None)
+        return P(None, AXIS_TP)
+    return P(AXIS_TP, None)
 
 
 def shard_params_tp(block, rules=None):
@@ -62,10 +64,11 @@ class ParallelDense(nn.Dense):
         super().__init__(units, **kwargs)
         if parallel_mode not in ("column", "row"):
             raise MXNetError("parallel_mode must be 'column' or 'row'")
-        self.weight.shard(P("tp", None) if parallel_mode == "column"
-                          else P(None, "tp"))
+        self.weight.shard(P(AXIS_TP, None) if parallel_mode == "column"
+                          else P(None, AXIS_TP))
         if self.bias is not None:
-            self.bias.shard(P("tp") if parallel_mode == "column" else P())
+            self.bias.shard(P(AXIS_TP) if parallel_mode == "column"
+                            else P())
 
 
 class ParallelEmbedding(nn.Embedding):
@@ -73,4 +76,66 @@ class ParallelEmbedding(nn.Embedding):
 
     def __init__(self, input_dim, output_dim, **kwargs):
         super().__init__(input_dim, output_dim, **kwargs)
-        self.weight.shard(P(None, "tp"))
+        self.weight.shard(P(None, AXIS_TP))
+
+
+# ---------------------------------------------------------------------------
+# Model-zoo wiring (ISSUE 11): the megatron rule tables for the llama and
+# BERT blocks, keyed on the zoo's parameter names.  Column-parallel
+# projections write into the head/hidden axis that the paired
+# row-parallel projection immediately consumes, so activations stay
+# 'tp'-sharded between them and XLA's sharding algebra inserts exactly
+# one reduce per pair (the megatron layout).
+# ---------------------------------------------------------------------------
+
+def llama_tp_rules():
+    """child-attribute-name -> spec for the llama decoder blocks:
+    q/k/v + SwiGLU gate/up column-parallel, o_proj/down_proj
+    row-parallel, norms/embeddings replicated (the megatron pairing:
+    exactly one reduce per attention/MLP block)."""
+    col, row = P(AXIS_TP, None), P(None, AXIS_TP)
+    return {"q_proj": col, "k_proj": col, "v_proj": col,
+            "gate_proj": col, "up_proj": col,
+            "o_proj": row, "down_proj": row}
+
+
+def bert_tp_rules():
+    """child-attribute-name -> spec for the BERT encoder blocks:
+    attention query/key/value + ffn_1 column-parallel, attention out +
+    ffn_2 row-parallel."""
+    col, row = P(AXIS_TP, None), P(None, AXIS_TP)
+    return {"proj_query": col, "proj_key": col, "proj_value": col,
+            "ffn_1": col, "proj_out": row, "ffn_2": row}
+
+
+def shard_model_tp(block, arch):
+    """Annotate a model-zoo block for tensor parallelism over the
+    MeshConfig 'tp' axis: ``arch`` is ``"llama"`` or ``"bert"``.
+
+    The walk keys on child-block ATTRIBUTE names (``_children`` keys:
+    ``q_proj``, ``proj_query``, ``ffn_1``, ...) rather than parameter
+    name substrings — the zoo's Dense layers are auto-named
+    (``dense0_weight``), so structure, not names, identifies the
+    megatron roles.  Column-parallel biases shard with their output
+    features; row-parallel biases replicate (added once after the
+    reduce).  Returns the block; training through
+    ``DataParallelTrainer`` on a mesh with a tp axis then partitions
+    every annotated matmul (the trainer honors
+    ``Parameter.shard_spec``)."""
+    table = {"llama": llama_tp_rules, "bert": bert_tp_rules}.get(arch)
+    if table is None:
+        raise MXNetError(f"shard_model_tp: unknown arch {arch!r} "
+                         f"(llama|bert)")
+    rules = table()
+    col = P(AXIS_TP, None)
+
+    def walk(b):
+        for name, child in getattr(b, "_children", {}).items():
+            spec = rules.get(name)
+            if spec is not None and hasattr(child, "weight"):
+                child.weight.shard(spec)
+                if getattr(child, "bias", None) is not None:
+                    child.bias.shard(P(AXIS_TP) if spec == col else P())
+            walk(child)
+    walk(block)
+    return block

@@ -36,6 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..base import MXNetError
+from .mesh import AXIS_DP
 
 __all__ = ["BucketPlan", "bucket_bound_bytes", "comm_dtype",
            "sharded_sync_enabled", "overlap_comm_enabled",
@@ -233,7 +234,8 @@ def int8_roundtrip_error(flat, key):
     return err / jnp.maximum(jnp.max(jnp.abs(flat)), 1e-30)
 
 
-def reduce_scatter_bucket(flat, key, dp, mode="fp32", axis="dp"):
+def reduce_scatter_bucket(flat, key, dp, mode="fp32",
+                          axis=AXIS_DP):
     """Mean-reduce one bucket across ``dp`` chips, returning this chip's
     1/dp shard.  Must run inside ``shard_map`` with ``axis`` bound;
     ``flat`` is the chip's LOCAL gradient bucket (f32, length % dp == 0).

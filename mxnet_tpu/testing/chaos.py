@@ -377,7 +377,8 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     import mxnet_tpu as mx
     from mxnet_tpu import elastic
     from mxnet_tpu.checkpoint import CheckpointManager
-    from mxnet_tpu.parallel.mesh import make_mesh
+    from mxnet_tpu.parallel.mesh import make_mesh, \
+        AXIS_DP as _AXIS_DP
     from mxnet_tpu.testing import faults
     import jax
 
@@ -394,7 +395,7 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
         mgr = CheckpointManager(
             os.path.join(workdir, f"elastic-{kind}"), keep=5)
     xs, ys = _make_data(77, n_batches=total_steps, batch=16)
-    net, trainer = _build_elastic(make_mesh({"dp": dp0},
+    net, trainer = _build_elastic(make_mesh({_AXIS_DP: dp0},
                                             devices[:dp0]))
     controller = elastic.ElasticController(
         membership, devices=devices, devices_per_worker=dpw,
@@ -452,13 +453,13 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     params_a, state_a = _final_state(net, trainer)
     result["events"] = events
     result["membership_epoch"] = membership.epoch
-    result["final_dp"] = trainer.mesh.shape["dp"]
+    result["final_dp"] = trainer.mesh.shape[_AXIS_DP]
 
     # reference: a FRESH process at the new dp restored from the same
     # state the reshard moved (boundary snapshot or the fallback
     # checkpoint), replaying the remaining steps
     ref_net, ref_trainer = _build_elastic(
-        make_mesh({"dp": dp1}, devices[:dp1]), seed=4321)
+        make_mesh({_AXIS_DP: dp1}, devices[:dp1]), seed=4321)
     if kind == "reshard_fault":
         ref_net(mx.nd.array(xs[0]))
         manifest = mgr.restore(step=ckpt_step, params=ref_net,

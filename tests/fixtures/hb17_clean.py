@@ -1,0 +1,19 @@
+"""HB17 clean fixture: the same call sites routed through the
+MeshConfig axis-name contract."""
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from mxnet_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, MeshConfig
+
+
+def batch_spec(ndim):
+    spec = [None] * ndim
+    spec[0] = AXIS_DP
+    return P(*spec)
+
+
+def collective(x, mesh):
+    i = lax.axis_index(AXIS_TP)
+    dp = mesh.shape[AXIS_DP]
+    cfg = MeshConfig.for_mesh(mesh)
+    return lax.psum(x, AXIS_PP) + i + dp + cfg.dp

@@ -1,0 +1,15 @@
+"""HB17 fixture: hardcoded mesh-axis literals (each marked line is a
+seeded planted bug the lint regression test must keep catching)."""
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def batch_spec():
+    return P("dp", None)                 # HB17: literal axis in P(...)
+
+
+def collective(x, mesh):
+    i = lax.axis_index("tp")             # HB17: literal axis name
+    dp = mesh.shape["dp"]                # HB17: literal shape key
+    first = mesh.shape[0]                # HB17: positional axis index
+    return lax.psum(x, "pp") + i + dp + first   # HB17: literal axis

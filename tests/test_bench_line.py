@@ -490,3 +490,76 @@ def test_serving_nulls_honesty_survives_telemetry(monkeypatch):
                 assert blk[k] is None, k
     finally:
         telem.configure(enabled=was)
+
+
+# ---------------------------------------------------------------------------
+# parallelism block (ISSUE 11): mesh shape stamped, pp/tp fields honest
+# ---------------------------------------------------------------------------
+
+_PAR_KEYS = {"mesh", "mesh_spec", "pp_microbatches", "pp_bubble_frac",
+             "tp_collective_ms"}
+
+
+def test_parallelism_block_schema_is_stable():
+    from mxnet_tpu.parallel.mesh import MeshConfig, parallelism_block
+    blk = parallelism_block()
+    assert set(blk) == _PAR_KEYS
+    assert blk["mesh"] == {"dp": 1, "tp": 1, "pp": 1}
+    assert blk["mesh_spec"] == "dp1"
+    # measured/conditional fields are null-when-absent, never fake zeros
+    for k in ("pp_microbatches", "pp_bubble_frac", "tp_collective_ms"):
+        assert blk[k] is None, k
+    blk3 = parallelism_block(MeshConfig.from_spec("2x2x2"),
+                             pp_microbatches=8,
+                             pp_bubble_frac=1 / 9)
+    assert blk3["mesh"] == {"dp": 2, "tp": 2, "pp": 2}
+    assert blk3["mesh_spec"] == "dp2tp2pp2"
+    assert blk3["pp_bubble_frac"] == 0.1111
+    assert json.loads(json.dumps(blk3)) == blk3
+
+
+def test_bench_stamps_mesh_and_parallelism():
+    """bench.py stamps the trainer's mesh shape into every payload; on
+    a flat-dp CPU run the pp/tp fields are nulls (nothing measured, no
+    pipeline axis), never zeros."""
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices")
+    from mxnet_tpu import gluon
+    from mxnet_tpu.parallel import MeshConfig, DataParallelTrainer
+    net = gluon.nn.Dense(4)
+    tr = DataParallelTrainer(net, gluon.loss.L2Loss(), "sgd",
+                             {"learning_rate": 0.1},
+                             mesh_config=MeshConfig.from_spec("dp8"))
+    result = {}
+    bench._stamp_parallelism(result, tr)
+    assert result["mesh"] == {"dp": 8, "tp": 1, "pp": 1}
+    par = result["parallelism"]
+    assert set(par) == _PAR_KEYS
+    assert par["mesh_spec"] == "dp8"
+    assert par["pp_bubble_frac"] is None
+    assert par["tp_collective_ms"] is None
+    # with a pipeline axis the analytic bubble fraction is stamped
+    net2 = gluon.nn.HybridSequential()
+    net2.add(gluon.nn.Dense(4), gluon.nn.Dense(4))
+    tr3 = DataParallelTrainer(net2, gluon.loss.L2Loss(), "sgd",
+                              {"learning_rate": 0.1},
+                              mesh_config=MeshConfig.from_spec("4x1x2"),
+                              pp_microbatches=8)
+    result3 = {}
+    bench._stamp_parallelism(result3, tr3)
+    par3 = result3["parallelism"]
+    assert par3["mesh_spec"] == "dp4pp2"
+    assert par3["pp_microbatches"] == 8
+    assert par3["pp_bubble_frac"] == round(1 / 9, 4)
+
+
+def test_mesh_spec_surfaces_in_headline():
+    payload = _success_payload()
+    from mxnet_tpu.parallel.mesh import MeshConfig, parallelism_block
+    payload["parallelism"] = parallelism_block(
+        MeshConfig.from_spec("dp64tp4"))
+    line = bench._compact_line(payload)
+    obj = _assert_headline(line)
+    assert obj.get("mesh") == "dp64tp4"

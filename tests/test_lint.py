@@ -840,7 +840,9 @@ def test_hb12_device_count_and_mesh_reads_flagged():
                 k = len(jax.devices())
                 s = self.mesh.size
                 return x / n
-    """), path="<hb12>")
+    """), path="<hb12>", rules={"HB12"})
+    # (the mesh.shape["dp"] literal additionally trips HB17 — scoped
+    # out here; test_hb17_* owns that rule)
     assert [v.rule for v in out] == ["HB12"] * 4
     assert "baked" in out[0].message or "bakes" in out[0].message
     assert "elastic" in out[0].message
@@ -870,7 +872,9 @@ def test_hb12_init_capture_and_outside_forward_are_clean():
             n = jax.device_count()          # setup code: fine
             mesh = make_mesh({"dp": n})
             return n, mesh.shape["dp"]      # outside a forward: fine
-    """), path="<hb12>")
+    """), path="<hb12>", rules={"HB12"})
+    # (the literal mesh reads are HB12-clean in setup code but DO trip
+    # HB17 — that is the point of the new rule; scoped out here)
     assert out == []
 
 
@@ -1298,3 +1302,67 @@ def test_hb13_package_is_clean():
     tdir = os.path.dirname(os.path.abspath(telem.__file__))
     tviol, tn = lint_paths([tdir], rules={"HB13"})
     assert tviol == [] and tn >= 5
+
+
+# ---------------------------------------------------------------------------
+# HB17 — hardcoded mesh-axis literal outside parallel/mesh.py (ISSUE 11)
+# ---------------------------------------------------------------------------
+
+def test_hb17_fixture_pack():
+    """The seeded violation fixture keeps tripping every planted bug;
+    the clean twin (same call sites through the MeshConfig axis names)
+    stays silent."""
+    from mxnet_tpu.lint.analyzer import lint_file
+    fdir = os.path.join(os.path.dirname(__file__), "fixtures")
+    viol = lint_file(os.path.join(fdir, "hb17_violation.py"),
+                     rules={"HB17"})
+    assert [v.rule for v in viol] == ["HB17"] * 5, \
+        [(v.line, v.message) for v in viol]
+    clean = lint_file(os.path.join(fdir, "hb17_clean.py"),
+                      rules={"HB17"})
+    assert clean == [], [(v.line, v.message) for v in clean]
+
+
+def test_hb17_mesh_py_is_exempt_and_suppression_works():
+    from mxnet_tpu.lint.analyzer import lint_source
+    src = 'from jax.sharding import PartitionSpec as P\n' \
+          'spec = P("dp", None)\n'
+    # the axis names are DEFINED in parallel/mesh.py — it is the one
+    # file allowed to spell them
+    assert lint_source(src, path="mxnet_tpu/parallel/mesh.py") == []
+    out = lint_source(src, path="elsewhere.py", rules={"HB17"})
+    assert [v.rule for v in out] == ["HB17"]
+    sup = 'from jax.sharding import PartitionSpec as P\n' \
+          'spec = P("dp")  # mxlint: disable=HB17 -- doc example\n'
+    assert lint_source(sup, path="elsewhere.py", rules={"HB17"}) == []
+
+
+def test_hb17_ignores_non_axis_strings_and_dict_keys():
+    """"dp" as a stats dict key / unrelated axis names ('sp', 'ep') are
+    not mesh-axis literals in collective calls — no false positives."""
+    from mxnet_tpu.lint.analyzer import lint_source
+    src = (
+        'from jax import lax\n'
+        'def stats(dp):\n'
+        '    return {"dp": dp, "tp": 1}\n'
+        'def ring(x):\n'
+        '    return lax.psum(x, "sp")\n'
+    )
+    assert lint_source(src, path="x.py", rules={"HB17"}) == []
+
+
+def test_hb17_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    assert "HB17" in RULES
+    assert RULES["HB17"].bad and RULES["HB17"].good
+
+
+def test_hb17_package_is_clean():
+    """The whole framework routes mesh-axis names through MeshConfig
+    (parallel/mesh.py) — the ISSUE 11 single-source-of-truth gate."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg], rules={"HB17"})
+    assert viol == [], [f"{v.path}:{v.line}" for v in viol]
+    assert n_files > 50

@@ -164,3 +164,37 @@ def test_memory_levers_ce_smoke_and_summary():
     assert s["ce_naive_32ktok_oom"] is True
     assert s["zero1_dp256_state_mb"] == 0.8
     assert set(MATRIX) >= {"accum_base", "ce_fused_128k", "zero1"}
+
+
+def test_bench_regression_gate_wiring(tmp_path, monkeypatch):
+    """The ISSUE 11 perf gate: after a TPU bench the runner diffs the
+    newest BENCH_r*.json round against this run's .bench_full.json via
+    tools/bench_diff.py --fail-on-regression; the non-zero exit lands
+    in state and trips main()'s completion exit code."""
+    real_repo = qr.REPO
+    monkeypatch.setattr(qr, "REPO", str(tmp_path))
+    # keep bench_diff.py reachable from the fake repo root
+    os.makedirs(tmp_path / "tools")
+    import shutil
+    shutil.copy(os.path.join(real_repo, "tools", "bench_diff.py"),
+                tmp_path / "tools" / "bench_diff.py")
+    payload = {"metric": "resnet50_train_images_per_sec",
+               "value": 2000.0, "unit": "img/s", "vs_baseline": 5.2,
+               "platform": "tpu", "telemetry_schema_version": 1}
+    with open(tmp_path / "BENCH_r01.json", "w") as f:
+        json.dump({"n": 1, "cmd": "python bench.py", "rc": 0,
+                   "parsed": payload}, f)
+    slow = dict(payload, value=1200.0)
+    with open(tmp_path / ".bench_full.json", "w") as f:
+        json.dump(slow, f)
+    monkeypatch.setenv("MXTPU_BENCH_REGRESSION_PCT", "10")
+    st = {}
+    qr._bench_regression_gate(st)
+    assert st["bench_regression"]["rc"] == 1
+    assert st["bench_regression"]["verdict"]["status"] == "regression"
+    # within threshold: clean
+    with open(tmp_path / ".bench_full.json", "w") as f:
+        json.dump(dict(payload, value=1950.0), f)
+    qr._bench_regression_gate(st)
+    assert st["bench_regression"]["rc"] == 0
+    assert st["bench_regression"]["verdict"]["status"] == "ok"

@@ -468,3 +468,131 @@ def test_telemetry_dump_self_test_prom():
     assert r.returncode == 0, r.stderr
     assert "mxtpu_selftest_counter 3" in r.stdout
     assert 'mxtpu_selftest_ms_bucket{le="+Inf"} 1' in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# tools/bench_diff.py — the cross-round perf gate (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+def _bench_payload(value=2000.0, step_ms=None, schema=1, platform="tpu"):
+    d = {"metric": "resnet50_train_images_per_sec", "value": value,
+         "unit": "img/s", "vs_baseline": round(value / 380.0, 3),
+         "platform": platform, "telemetry_schema_version": schema,
+         "batch": 128, "mfu": round(value / 8600.0, 4),
+         "comm": {"collective_ms": step_ms, "est_ici_gb_s": None},
+         "extra": {"serving": {"tokens_s_chip": 900.0, "p99_ms": 41.0}}}
+    return d
+
+
+def _write(tmp_path, name, payload):
+    import json
+    p = tmp_path / name
+    p.write_text(json.dumps(payload))
+    return str(p)
+
+
+def test_bench_diff_detects_planted_regression(tmp_path):
+    """The acceptance fixture pair: a planted 20% throughput regression
+    must exit non-zero under --fail-on-regression 10."""
+    from tools import bench_diff
+    old = _write(tmp_path, "old.json", _bench_payload(value=2000.0))
+    new = _write(tmp_path, "new.json", _bench_payload(value=1600.0))
+    rc = bench_diff.main([old, new, "--fail-on-regression", "10",
+                          "--quiet"])
+    assert rc == 1
+    # within threshold: clean exit
+    ok = _write(tmp_path, "ok.json", _bench_payload(value=1950.0))
+    assert bench_diff.main([old, ok, "--fail-on-regression", "10",
+                            "--quiet"]) == 0
+    # without the gate flag the same pair only reports
+    assert bench_diff.main([old, new, "--quiet"]) == 0
+
+
+def test_bench_diff_direction_awareness(tmp_path):
+    """Latency going UP is a regression; latency going DOWN is not —
+    and an improved throughput never gates."""
+    from tools import bench_diff
+    old = _bench_payload(); old["extra"]["serving"]["p99_ms"] = 40.0
+    new = _bench_payload(); new["extra"]["serving"]["p99_ms"] = 60.0
+    o = _write(tmp_path, "o.json", old)
+    n = _write(tmp_path, "n.json", new)
+    assert bench_diff.main([o, n, "--fail-on-regression", "10",
+                            "--quiet"]) == 1
+    faster = _bench_payload(value=2400.0)
+    faster["extra"]["serving"]["p99_ms"] = 20.0
+    f = _write(tmp_path, "f.json", faster)
+    assert bench_diff.main([o, f, "--fail-on-regression", "10",
+                            "--quiet"]) == 0
+
+
+def test_bench_diff_skips_nulls_and_checks_schema(tmp_path):
+    from tools import bench_diff
+    # null-when-unmeasured on one side: the metric never compares, so a
+    # CPU round with nulls cannot fake a regression
+    old = _bench_payload(step_ms=3.2)
+    new = _bench_payload(step_ms=None)
+    o = _write(tmp_path, "o.json", old)
+    n = _write(tmp_path, "n.json", new)
+    assert bench_diff.main([o, n, "--fail-on-regression", "10",
+                            "--quiet"]) == 0
+    # schema drift: refuse to compare (exit 2) unless allowed
+    drift = _write(tmp_path, "d.json", _bench_payload(schema=2))
+    assert bench_diff.main([o, drift, "--quiet"]) == 2
+    assert bench_diff.main([o, drift, "--allow-schema-drift",
+                            "--quiet"]) == 0
+
+
+def test_bench_diff_platform_mismatch_never_gates(tmp_path):
+    """A CPU-fallback round vs a TPU round is apples-to-oranges: the
+    rounds 4/5 tunnel outage must not read as a 90% regression."""
+    from tools import bench_diff
+    o = _write(tmp_path, "o.json", _bench_payload(value=2000.0))
+    n = _write(tmp_path, "n.json",
+               _bench_payload(value=150.0, platform="cpu"))
+    assert bench_diff.main([o, n, "--fail-on-regression", "10",
+                            "--quiet"]) == 0
+
+
+def test_bench_diff_reads_driver_round_wrappers(tmp_path):
+    """BENCH_r*.json trajectory files ({"cmd", "parsed": ...}) unwrap;
+    an unparsed round (parsed: null) compares as nothing, exit 0."""
+    import json
+    from tools import bench_diff
+    w_old = _write(tmp_path, "BENCH_r01.json",
+                   {"n": 1, "cmd": "python bench.py", "rc": 0,
+                    "parsed": _bench_payload(value=2000.0)})
+    w_new = _write(tmp_path, "BENCH_r02.json",
+                   {"n": 2, "cmd": "python bench.py", "rc": 0,
+                    "parsed": _bench_payload(value=1000.0)})
+    assert bench_diff.main([w_old, w_new, "--fail-on-regression", "10",
+                            "--quiet"]) == 1
+    w_null = _write(tmp_path, "BENCH_r03.json",
+                    {"n": 3, "cmd": "python bench.py", "rc": 1,
+                     "parsed": None})
+    assert bench_diff.main([w_old, w_null, "--fail-on-regression",
+                            "10", "--quiet"]) == 0
+
+
+def test_scaling_efficiency_3d_projection():
+    """tools/scaling_efficiency.py 3D model: more chips on tp/pp axes
+    cost comm/bubble efficiency; every input is surfaced; the tp term
+    discloses itself when unmodeled."""
+    from tools.scaling_efficiency import project_3d_scaling
+    out = project_3d_scaling(
+        60.0, 1.02e8,
+        mesh_shapes=[(256, 1, 1), (64, 4, 1), (32, 4, 2)],
+        act_bytes_per_layer=2.6e6, n_layers=50, base_mfu=0.24)
+    rows = out["projection"]
+    assert [r["chips"] for r in rows] == [256, 256, 256]
+    assert all(0 < r["projected_efficiency"] <= 1 for r in rows)
+    # pure dp pays only the (well-overlapped) grad ring
+    assert rows[0]["projected_efficiency"] > rows[1]["projected_efficiency"]
+    # adding a pipeline axis pays the 1F1B bubble on top
+    assert rows[1]["projected_efficiency"] > rows[2]["projected_efficiency"]
+    assert rows[2]["pp_bubble_frac"] > 0
+    assert rows[0]["pp_bubble_frac"] == 0
+    assert all("projected_mfu" in r for r in rows)
+    # unmodeled tp term must say so rather than read as free
+    out2 = project_3d_scaling(60.0, 1.02e8, mesh_shapes=[(64, 4, 1)])
+    assert "UNMODELED" in out2["projection"][0]["tp_term"]
+    assert out["inputs"]["param_bytes"] == 1.02e8

@@ -74,7 +74,39 @@ def main():
     ap.add_argument("--per-device-batch", type=int, default=64)
     ap.add_argument("--iters", type=int, default=10)
     ap.add_argument("--warmup", type=int, default=3)
+    ap.add_argument("--project-3d", metavar="SPECS", default=None,
+                    help='comma-separated mesh specs ("dp64tp4,'
+                         'dp32tp4pp2" or "64x4x1"): print the analytic '
+                         "3D projection instead of timing meshes")
+    ap.add_argument("--step-ms", type=float, default=None,
+                    help="measured single-chip step ms (3D projection "
+                         "input)")
+    ap.add_argument("--param-bytes", type=float, default=None)
+    ap.add_argument("--act-bytes-per-layer", type=float, default=None)
+    ap.add_argument("--n-layers", type=int, default=None)
+    ap.add_argument("--pp-microbatches", type=int, default=None)
+    ap.add_argument("--base-mfu", type=float, default=None,
+                    help="measured single-chip MFU -> projected_mfu "
+                         "rows")
     args = ap.parse_args()
+
+    if args.project_3d is not None:
+        if args.step_ms is None or args.param_bytes is None:
+            raise SystemExit("--project-3d needs --step-ms and "
+                             "--param-bytes (measured inputs; a "
+                             "projection without them would be a guess)")
+        from mxnet_tpu.parallel.mesh import MeshConfig
+        shapes = [(c.dp, c.tp, c.pp) for c in
+                  (MeshConfig.from_spec(s)
+                   for s in args.project_3d.split(","))]
+        out = project_3d_scaling(
+            args.step_ms, args.param_bytes, mesh_shapes=shapes,
+            act_bytes_per_layer=args.act_bytes_per_layer,
+            n_layers=args.n_layers,
+            pp_microbatches=args.pp_microbatches,
+            base_mfu=args.base_mfu)
+        print("SCALE3DJSON " + json.dumps(out), flush=True)
+        return
 
     import jax
     n_total = len(jax.devices())
@@ -106,8 +138,6 @@ def main():
     print("SCALEJSON " + json.dumps(summary), flush=True)
 
 
-if __name__ == "__main__":
-    main()
 
 
 # ---------------------------------------------------------------------------
@@ -240,3 +270,101 @@ def project_ici_scaling(step_ms_1chip, param_bytes, chips=(8, 64, 256),
                  "host_fed_efficiency shows the rec-pipeline cap; the "
                  "device-resident put_epoch path sidesteps it."),
     }
+
+
+# ---------------------------------------------------------------------------
+# 3D (dp x tp x pp) projection (ISSUE 11): the flat-dp roofline above
+# models one axis; pod-scale training composes three, each with its own
+# comm volume and its own place on the step's critical path.
+# ---------------------------------------------------------------------------
+
+def project_3d_scaling(step_ms_1chip, param_bytes, mesh_shapes=None,
+                       act_bytes_per_layer=None, n_layers=None,
+                       pp_microbatches=None, base_mfu=None,
+                       ici_gbps_per_link=100.0, links=4, overlap=0.7):
+    """Per-mesh-shape efficiency/MFU projection for a v5e-256 pod.
+
+    Three axis terms, charged per step (every input is surfaced in the
+    output — PROJECTION, not measurement):
+
+    - **dp** — ring allreduce of this chip's gradient shard: with tp*pp
+      model sharding each chip owns ``param_bytes/(tp*pp)``, so the dp
+      ring moves ``2*(dp-1)/dp`` of that; a fraction ``overlap`` hides
+      under backward (the PR 5 bucket overlap / LHS machinery).
+    - **tp** — megatron activation collectives: ~4 allreduce-equivalents
+      per layer per step (2 forward, 2 backward) of
+      ``act_bytes_per_layer``, each moving ``2*(tp-1)/tp`` of its
+      payload; only half the dp overlap fraction is credited — tp
+      collectives sit BETWEEN matmuls on the critical path, where the
+      scheduler has far less slack.  Zero when tp=1 or the activation
+      inputs are not given (disclosed as unmodeled).
+    - **pp** — the 1F1B bubble: compute efficiency is multiplied by
+      ``1 - (pp-1)/(M+pp-1)`` (``M = pp_microbatches``, default
+      ``4*pp``).  Activation hop bytes are negligible next to the grad
+      ring and are not charged.
+
+    ``projected_mfu`` rows appear when ``base_mfu`` (the measured
+    single-chip MFU) is given: mfu = base_mfu * efficiency.
+    """
+    if mesh_shapes is None:
+        # the v5e-256 cookbook shapes (docs/PARALLELISM.md)
+        mesh_shapes = [(256, 1, 1), (64, 4, 1), (32, 8, 1),
+                       (32, 4, 2), (16, 4, 4)]
+    ici_bw = ici_gbps_per_link * links * 1e9 / 8
+    rows = []
+    for shape in mesh_shapes:
+        dp, tp, pp = (int(x) for x in shape)
+        chips = dp * tp * pp
+        shard = param_bytes / (tp * pp)
+        ring = 2 * (dp - 1) / dp * shard if dp > 1 else 0.0
+        t_dp_ms = ring / ici_bw * 1e3
+        exposed = t_dp_ms * (1 - overlap)
+        t_tp_ms = tp_modeled = None
+        if tp > 1 and act_bytes_per_layer and n_layers:
+            tp_bytes = 4 * n_layers * act_bytes_per_layer \
+                * 2 * (tp - 1) / tp
+            t_tp_ms = tp_bytes / ici_bw * 1e3
+            exposed += t_tp_ms * (1 - overlap / 2)
+            tp_modeled = True
+        elif tp > 1:
+            tp_modeled = False          # disclosed: term missing
+        m = pp_microbatches if pp_microbatches else 4 * pp
+        bubble = (pp - 1) / (m + pp - 1) if pp > 1 else 0.0
+        comm_eff = step_ms_1chip / (step_ms_1chip + exposed)
+        eff = comm_eff * (1 - bubble)
+        row = {"mesh": {"dp": dp, "tp": tp, "pp": pp}, "chips": chips,
+               "dp_ring_bytes": int(ring),
+               "t_dp_ms": round(t_dp_ms, 3),
+               "t_tp_ms": None if t_tp_ms is None else round(t_tp_ms, 3),
+               "pp_bubble_frac": round(bubble, 4),
+               "exposed_ms": round(exposed, 3),
+               "projected_efficiency": round(eff, 4)}
+        if tp_modeled is False:
+            row["tp_term"] = ("UNMODELED: pass act_bytes_per_layer + "
+                              "n_layers to charge tp collectives")
+        if base_mfu is not None:
+            row["projected_mfu"] = round(base_mfu * eff, 4)
+        rows.append(row)
+    return {
+        "model": ("per-axis ICI comm volume (dp grad ring + megatron tp "
+                  "activation collectives) x 1F1B bubble fraction, weak "
+                  "scaling"),
+        "inputs": {"step_ms_1chip": step_ms_1chip,
+                   "param_bytes": param_bytes,
+                   "act_bytes_per_layer": act_bytes_per_layer,
+                   "n_layers": n_layers,
+                   "pp_microbatches": pp_microbatches,
+                   "base_mfu": base_mfu,
+                   "ici_gbps_per_link": ici_gbps_per_link,
+                   "links_per_chip": links, "overlap_fraction": overlap},
+        "projection": rows,
+        "note": ("PROJECTION, not a measurement (single-chip "
+                 "environment); correctness of the composed 3D step is "
+                 "gated separately (tests/test_mesh3d.py parity suite). "
+                 "tp charged at half the dp overlap credit: its "
+                 "collectives sit between matmuls on the critical "
+                 "path."),
+    }
+
+if __name__ == "__main__":
+    main()

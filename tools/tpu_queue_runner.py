@@ -324,7 +324,39 @@ def step_bench(st: dict) -> None:
              f"value={lines[-1].get('value')}")
         if plat == "tpu":
             st["done"]["bench"] = True
+            _bench_regression_gate(st)
     _save_state(st)
+
+
+def _bench_regression_gate(st: dict) -> None:
+    """ISSUE 11 satellite: the perf GATE.  Diff this run's full payload
+    (.bench_full.json) against the newest prior-round trajectory file
+    (BENCH_r*.json) with tools/bench_diff.py --fail-on-regression
+    (threshold MXTPU_BENCH_REGRESSION_PCT, default 10).  bench_diff
+    skips null-when-unmeasured fields, checks telemetry_schema_version,
+    and refuses to gate cross-platform pairs — a CPU-fallback round
+    cannot fake a TPU regression.  A non-zero exit is recorded in
+    state.json and propagates out of main() when the queue drains."""
+    import glob
+    import subprocess
+    rounds = sorted(glob.glob(os.path.join(REPO, "BENCH_r*.json")))
+    current = os.path.join(REPO, ".bench_full.json")
+    if not rounds or not os.path.exists(current):
+        return
+    pct = os.environ.get("MXTPU_BENCH_REGRESSION_PCT", "10")
+    cmd = [sys.executable, os.path.join(REPO, "tools", "bench_diff.py"),
+           rounds[-1], current, "--fail-on-regression", pct, "--quiet"]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    last = (r.stdout.strip().splitlines() or [""])[-1]
+    _log(f"bench_diff vs {os.path.basename(rounds[-1])}: rc="
+         f"{r.returncode} {last[:400]}")
+    verdict = None
+    if last.startswith("BENCHDIFF "):
+        try:
+            verdict = json.loads(last[len("BENCHDIFF "):])
+        except ValueError:
+            pass
+    st["bench_regression"] = {"rc": r.returncode, "verdict": verdict}
 
 
 FLASH_LS = (2048, 4096, 8192, 16384, 32768)
@@ -597,6 +629,12 @@ def main() -> int:
         pending = [n for n in wanted if not st["done"].get(n)]
         if not pending:
             _log("queue complete: " + json.dumps(st.get("done", {})))
+            if st.get("bench_regression", {}).get("rc"):
+                # the bench_diff gate tripped: everything ran, but the
+                # queue's exit code says this round got SLOWER
+                _log("bench regression gate tripped (exit 3): "
+                     + json.dumps(st["bench_regression"].get("verdict")))
+                return 3
             return 0
         _log(f"pass finished with pending steps {pending}; "
              f"sleeping 600s before the next pass")
