@@ -1068,6 +1068,8 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         ("serve_tok_s", ("serving", "tokens_s_chip")),
         ("serve_p99_ms", ("serving", "p99_ms")),
         ("serve_occupancy", ("serving", "occupancy")),
+        ("serve_prefix_hit", ("serving", "prefix_hit_rate")),
+        ("router_p99_ms", ("serving", "router_p99_ms")),
         ("elastic_reshard_ms", ("elastic", "reshard_ms")),
         ("elastic_pause_ms", ("elastic", "pause_ms")),
         ("elastic_epoch", ("elastic", "membership_epoch")),

@@ -42,7 +42,7 @@ from . import debug
 debug._install()            # MXTPU_DEBUG_NANS / MXTPU_ENFORCE_DETERMINISM
                             # must configure jax before any computation
 
-from .base import MXNetError
+from .base import MXNetError, NotSupportedError
 from . import telemetry   # first: every subsystem below publishes to it
 from .context import Context, cpu, gpu, tpu, cpu_pinned, current_context, \
     num_gpus, num_tpus

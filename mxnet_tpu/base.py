@@ -10,8 +10,8 @@ from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["MXNetError", "string_types", "numeric_types", "integer_types",
-           "registry_create", "DTYPE_MAP"]
+__all__ = ["MXNetError", "NotSupportedError", "string_types",
+           "numeric_types", "integer_types", "registry_create", "DTYPE_MAP"]
 
 
 class MXNetError(RuntimeError):
@@ -22,6 +22,13 @@ class MXNetError(RuntimeError):
     natively, so this is a plain Python exception with the same name so user
     ``except mx.MXNetError`` code keeps working.
     """
+
+
+class NotSupportedError(MXNetError):
+    """A coherent request the current build deliberately does not serve
+    yet.  Distinct from a misuse error: the message names the tracked
+    follow-up that lifts the limit, so callers can feature-gate on the
+    TYPE instead of pattern-matching message strings."""
 
 
 string_types = (str,)

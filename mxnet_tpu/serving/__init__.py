@@ -8,9 +8,18 @@ The serving vertical the ROADMAP's "millions of users" north star needs:
   warmup under traffic); optional int8 weight serving via
   ``contrib.quantization.quantize_net``.
 - :class:`PagedKVCache` — block-table indexed K/V pool, per-sequence
-  alloc/free, donated functional updates.
+  alloc/free, donated functional updates, per-block refcounts for
+  copy-on-write prefix sharing (typed :class:`DoubleFreeError` on
+  accounting violations).
 - :class:`ContinuousBatcher` / :class:`StaticBatcher` — token-boundary
-  continuous batching vs the fixed-batch baseline, over the same engine.
+  continuous batching vs the fixed-batch baseline, over the same
+  engine; with ``prefill_chunk`` set, admission packs chunks from
+  several prompts into one dispatch (ISSUE 12).
+- :mod:`frontend` — the multi-replica layer: :class:`PrefixCache`
+  (system prompt prefilled once, blocks forked CoW per request) and
+  :class:`Router` (least-loaded admission over N replicas, epoch-fenced
+  membership, drain-and-requeue on death, one shared warmup compile
+  cache).
 
 See docs/SERVING.md for the architecture and the bucket/compile-cache
 math; ``tools/serve_loadgen.py`` is the load-generator benchmark.
@@ -18,11 +27,13 @@ math; ``tools/serve_loadgen.py`` is the load-generator benchmark.
 from __future__ import annotations
 
 from .engine import InferenceEngine, next_bucket
-from .kv_cache import PagedKVCache
+from .kv_cache import PagedKVCache, DoubleFreeError
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
+from .frontend import PrefixCache, Router
 
-__all__ = ["InferenceEngine", "PagedKVCache", "ContinuousBatcher",
-           "StaticBatcher", "Request", "next_bucket", "serving_block"]
+__all__ = ["InferenceEngine", "PagedKVCache", "DoubleFreeError",
+           "ContinuousBatcher", "StaticBatcher", "Request", "next_bucket",
+           "serving_block", "PrefixCache", "Router"]
 
 
 def _r(x, nd=3):
@@ -33,12 +44,17 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
                   continuous=True, requests=0, p50_ms=None, p99_ms=None,
                   ttft_p50_ms=None, tokens_s=None, tokens_s_chip=None,
                   occupancy=None, tokens_per_step=None,
-                  compiles_after_warmup=None, cache_utilization=None):
+                  compiles_after_warmup=None, cache_utilization=None,
+                  chunked_prefill=False, router_replicas=0,
+                  prefix_hit_rate=None, router_p99_ms=None):
     """The bench.py ``serving`` observability block (the `comm` block
     discipline from PR 3/PR 5): static serving config is always real;
     MEASURED fields default to ``None`` — null-when-unmeasured, so a CPU
     run can never pass off an absent measurement as "latency is zero"
-    (the PR 6 honesty rule, tests/test_bench_line.py)."""
+    (the PR 6 honesty rule, tests/test_bench_line.py).  ISSUE 12 grows
+    the front-end fields: ``chunked_prefill``/``router_replicas`` are
+    config (always real), ``prefix_hit_rate``/``router_p99_ms`` are
+    measured (null until a run actually measured them)."""
     return {
         "max_batch": int(max_batch),
         "block_size": int(block_size),
@@ -54,4 +70,8 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
         "compiles_after_warmup": (None if compiles_after_warmup is None
                                   else int(compiles_after_warmup)),
         "cache_utilization": _r(cache_utilization, 4),
+        "chunked_prefill": bool(chunked_prefill),
+        "router_replicas": int(router_replicas),
+        "prefix_hit_rate": _r(prefix_hit_rate, 4),
+        "router_p99_ms": _r(router_p99_ms),
     }

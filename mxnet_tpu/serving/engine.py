@@ -29,6 +29,29 @@ the projection weights become per-channel int8 with the calibrated
 activation scales, and the engine's matmuls mirror ``QuantizedDense``
 op-for-op (int32 accumulation is exact, so decode parity survives
 quantization bit-for-bit against the quantized net's own forward).
+
+ISSUE 12 adds a third graph family for the serving FRONT-END
+(``mxnet_tpu.serving.frontend``):
+
+- ``chunk[n_blocks]``: a PACKED continuation prefill — up to
+  ``max_batch`` rows, each a chunk of up to ``MXTPU_PREFILL_CHUNK``
+  prompt tokens starting at an arbitrary position, attending to that
+  row's already-cached K/V through its block table (offset-causal
+  mask).  One dispatch admits several queued prompts of a boundary
+  (chunked/batched prefill) AND computes only the un-cached suffix of
+  a prompt whose prefix the :class:`~.frontend.PrefixCache` already
+  holds.  The chunk math mirrors the cold prefill's flash path
+  op-for-op (same blockwise online-softmax, same mask constant), so
+  the K/V it writes — and therefore every later decode logit — is
+  BITWISE the cold path's (tests/test_serving_frontend.py).
+- ``cow``: a one-block pool copy, the device half of the kv-cache's
+  copy-on-write fork (a shared block is copied before its first
+  write; every other holder keeps the original bits).
+
+Both are compiled at warmup like the rest; ``compiles_after_warmup``
+still gates zero retraces.  Replicas behind one
+:class:`~.frontend.Router` pass a shared ``compile_cache`` so the
+fleet pays each graph compile once.
 """
 from __future__ import annotations
 
@@ -37,7 +60,7 @@ import os
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, NotSupportedError
 from .. import telemetry as _telem
 from .kv_cache import PagedKVCache
 
@@ -76,21 +99,48 @@ class InferenceEngine:
     temperature / top_k / seed : in-graph sampling config (greedy at
         temperature 0; otherwise top-k categorical when top_k > 0, full
         categorical when 0).
+    mesh : a ``parallel.MeshConfig`` (or spec string) RECORDED on the
+        engine and carried by the router manifest; tp/pp > 1 raise the
+        typed ``NotSupportedError`` until ROADMAP item 2 lands.
+    prefill_chunk : chunk bucket in tokens (multiple of block_size) for
+        the packed continuation-prefill family; 0/None reads
+        ``MXTPU_PREFILL_CHUNK`` (default off).
+    prefix_cache : True builds a ``frontend.PrefixCache`` over this
+        engine's KV pool; None reads ``MXTPU_PREFIX_CACHE``.
+    compile_cache : dict shared across replicas of a ``frontend.Router``
+        so the fleet pays each graph compile once (signatures carry the
+        pool geometry, so mismatched engines never collide).
     """
 
     def __init__(self, net, max_batch=None, block_size=None,
                  num_blocks=None, max_context=None, temperature=0.0,
                  top_k=0, seed=0, quantize=None, calib_data=None,
-                 num_calib_batches=10):
+                 num_calib_batches=10, mesh=None, prefill_chunk=None,
+                 prefix_cache=None, compile_cache=None):
         import jax
         import jax.numpy as jnp
+        from ..parallel.mesh import MeshConfig
         cfg = net.cfg
         if cfg.tensor_parallel:
-            raise MXNetError("InferenceEngine drives the single-chip "
-                             "decode path; TP models serve via forward()")
+            raise NotSupportedError(
+                "InferenceEngine drives the single-chip decode path; "
+                "TP-sharded serving over the named-axis mesh is the "
+                "ROADMAP item-2 follow-up — until it lands, serve "
+                "tensor_parallel nets via forward()")
         if quantize not in (None, "int8"):
             raise MXNetError(f"quantize={quantize!r}: only int8 weight "
                              "quantization is supported")
+        # the mesh this engine serves on is RECORDED (the router
+        # manifest carries it so a fleet's placement is inspectable)
+        # even though only dp=1 is runnable today
+        if isinstance(mesh, str):
+            mesh = MeshConfig.from_spec(mesh)
+        self.mesh_config = mesh if mesh is not None else MeshConfig()
+        if self.mesh_config.tp > 1 or self.mesh_config.pp > 1:
+            raise NotSupportedError(
+                f"mesh {self.mesh_config.describe()!r}: serving over "
+                "tp/pp axes is the ROADMAP item-2 follow-up; only "
+                "dp-replicated engines (frontend.Router) run today")
         self.net = net
         self.cfg = cfg
         self.max_batch = max(2, _env_int("MXTPU_SERVE_MAX_BATCH", 4)
@@ -126,10 +176,35 @@ class InferenceEngine:
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self._base_key = jax.random.key(seed)
-        self._compiled = {}
+        # compile cache: pass one dict to every replica of a Router and
+        # the whole fleet pays each (kind, size) compile exactly once —
+        # executables close over shapes only (weights/pools are jit
+        # ARGUMENTS), so replicas with identical config share freely
+        self._compiled = {} if compile_cache is None else compile_cache
         self._warmed = False
+        # chunked/batched prefill (ISSUE 12): chunk bucket in tokens;
+        # 0 disables the family (no extra warmup compiles)
+        pc = _env_int("MXTPU_PREFILL_CHUNK", 0) if prefill_chunk is None \
+            else int(prefill_chunk)
+        if pc < 0 or (pc and pc % bs):
+            raise MXNetError(f"prefill_chunk {pc} must be a positive "
+                             f"multiple of block_size {bs} (or 0=off)")
+        self.prefill_chunk = min(pc, mc)
+        # copy-on-write prefix cache: True builds one, an instance is
+        # adopted, None reads MXTPU_PREFIX_CACHE (default off so the
+        # cold engine's block accounting is exactly PR 7's)
+        if prefix_cache is None:
+            prefix_cache = os.environ.get(
+                "MXTPU_PREFIX_CACHE", "0") not in ("", "0")
+        if prefix_cache is True:
+            from .frontend.prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(self.cache)
+        else:
+            self.prefix_cache = prefix_cache or None
         self.stats = {"compiles": 0, "compiles_after_warmup": 0,
-                      "prefill_calls": 0, "decode_calls": 0}
+                      "prefill_calls": 0, "decode_calls": 0,
+                      "chunk_prefill_calls": 0,
+                      "prompt_tokens_computed": 0}
 
     # -- weights ---------------------------------------------------------
 
@@ -333,6 +408,118 @@ class InferenceEngine:
 
         return run
 
+    def _build_chunk_prefill(self, nbl):
+        """Packed continuation prefill: up to ``max_batch`` rows, each a
+        chunk of up to ``prefill_chunk`` prompt tokens starting at an
+        arbitrary position, attending to ``nbl`` gathered blocks of that
+        row's cache (offset-causal: key position <= query position).
+
+        The attention is ``ops.flash_attention._scan_forward`` with the
+        row index replaced by the ABSOLUTE position — same block
+        decomposition, same einsum specs, same ``-1e30`` mask constant,
+        same normalization order — so a chunk row's output (and the K/V
+        it scatters) is bitwise the cold full-prefill's row for the
+        same tokens (the prefix-cache parity gate,
+        tests/test_serving_frontend.py)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from ..gluon.model_zoo.nlp.llama import _rms, _rot_interleaved
+        from ..ops.flash_attention import _NEG_INF, _pick_block
+        cfg = self.cfg
+        h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        rep, eps, theta = h // kvh, cfg.rms_eps, cfg.rope_theta
+        bs = self.block_size
+        R, C = self.max_batch, self.prefill_chunk
+        L = nbl * bs
+        bk = _pick_block(L, 256) or L
+        nk = L // bk
+        scale = 1.0 / math.sqrt(d)
+
+        def attend(q, kr, vr, qpos):
+            # q (R*h, C, d); kr/vr (R*h, L, d); qpos (R*h, C) absolute
+            kb = kr.reshape(R * h, nk, bk, d).transpose(1, 0, 2, 3)
+            vb = vr.reshape(R * h, nk, bk, d).transpose(1, 0, 2, 3)
+
+            def step(carry, blk):
+                acc, m_i, l_i, j = carry
+                kj, vj = blk
+                s = jnp.einsum("bqd,bkd->bqk", q, kj,
+                               preferred_element_type=jnp.float32) * scale
+                kpos = j * bk + lax.broadcasted_iota(jnp.int32, (C, bk), 1)
+                s = jnp.where(qpos[:, :, None] >= kpos[None], s, _NEG_INF)
+                m_new = jnp.maximum(m_i, jnp.max(s, axis=-1,
+                                                 keepdims=True))
+                p = jnp.exp(s - m_new)
+                alpha = jnp.exp(m_i - m_new)
+                l_new = l_i * alpha + jnp.sum(p, axis=-1, keepdims=True)
+                acc = acc * alpha + jnp.einsum(
+                    "bqk,bkd->bqd", p.astype(vr.dtype), vj,
+                    preferred_element_type=jnp.float32)
+                return (acc, m_new, l_new, j + 1), None
+
+            init = (jnp.zeros((R * h, C, d), jnp.float32),
+                    jnp.full((R * h, C, 1), _NEG_INF, jnp.float32),
+                    jnp.zeros((R * h, C, 1), jnp.float32),
+                    jnp.int32(0))
+            (acc, m_i, l_i, _), _ = lax.scan(step, init, (kb, vb))
+            return (acc / jnp.maximum(l_i, 1e-30)).astype(q.dtype)
+
+        def run(params, kp, vp, toks, starts, valids, bts, active, key):
+            x = jnp.take(params["embed"], toks, axis=0)      # (R, C, hid)
+            cidx = jnp.arange(C)
+            abs_pos = starts[:, None] + cidx[None, :]        # (R, C)
+            freqs = theta ** (-jnp.arange(0, d, 2) / d)
+            ang = abs_pos[..., None] * freqs
+            cos, sin = jnp.cos(ang), jnp.sin(ang)            # (R, C, d/2)
+            write = active[:, None] & (cidx[None, :] < valids[:, None])
+            blk = jnp.take_along_axis(
+                bts, jnp.clip(abs_pos // bs, 0, nbl - 1), axis=1)
+            blk = jnp.where(write, blk, 0)                   # null block
+            off = abs_pos % bs
+            qpos = jnp.repeat(abs_pos, h, axis=0)            # (R*h, C)
+            for li, lp in enumerate(params["layers"]):
+                hh = _rms(x, lp["in_norm"], eps)
+                q = self._proj(hh, lp["q"]).reshape(R, C, h, d) \
+                    .transpose(0, 2, 1, 3)
+                k = self._proj(hh, lp["k"]).reshape(R, C, kvh, d) \
+                    .transpose(0, 2, 1, 3)
+                v = self._proj(hh, lp["v"]).reshape(R, C, kvh, d)
+                q = _rot_interleaved(q, cos[:, None], sin[:, None])
+                k = _rot_interleaved(k, cos[:, None], sin[:, None])
+                kp = kp.at[li, blk, off].set(k.transpose(0, 2, 1, 3))
+                vp = vp.at[li, blk, off].set(v)
+                ck = kp[li][bts].reshape(R, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)                   # (R,kvh,L,d)
+                cv = vp[li][bts].reshape(R, L, kvh, d) \
+                    .transpose(0, 2, 1, 3)
+                kr = jnp.repeat(ck, rep, axis=1).reshape(R * h, L, d)
+                vr = jnp.repeat(cv, rep, axis=1).reshape(R * h, L, d)
+                o = attend(q.reshape(R * h, C, d), kr, vr, qpos)
+                o = o.reshape(R, h, C, d).transpose(0, 2, 1, 3) \
+                    .reshape(R, C, h * d)
+                x = x + self._proj(o, lp["o"])
+                y = _rms(x, lp["post_norm"], eps)
+                x = x + self._proj(
+                    jax.nn.silu(self._proj(y, lp["gate"])) *
+                    self._proj(y, lp["up"]), lp["down"])
+            x = _rms(x, params["norm"], eps)
+            logits = self._head_logits(params, x)            # (R, C, V)
+            last = jnp.take_along_axis(
+                logits, jnp.clip(valids - 1, 0, C - 1)[:, None, None],
+                axis=1)[:, 0]                                # (R, V)
+            return last, self._sample(last, key), kp, vp
+
+        return run
+
+    def _build_cow(self, _size):
+        """Copy-on-write block fork: duplicate one physical block's K/V
+        (all layers) into a freshly allocated block, pools donated."""
+        def run(kp, vp, src, dst):
+            return (kp.at[:, dst].set(kp[:, src]),
+                    vp.at[:, dst].set(vp[:, src]))
+        return run
+
     def _sample(self, logits, key):
         """In-graph next-token sampling: greedy at temperature 0, else
         (top-k) categorical — logits never leave the device per token."""
@@ -351,20 +538,30 @@ class InferenceEngine:
 
     # -- compile cache (the retrace-detector discipline) -----------------
 
+    def _sig(self, kind, size):
+        return (kind, size, self.cache.num_blocks, self.max_batch,
+                self.block_size)
+
     def _get(self, kind, size, args):
         """Compile-cache lookup keyed by (kind, shape-signature); every
         miss is one AOT compile (``jit(...).lower(args).compile()``) and
         is COUNTED — serving traffic after warmup() must never miss.
         The cached object is a fixed executable, so an unexpected
         shape/dtype drift raises loudly instead of retracing silently
-        (the PR 1 retrace-detector discipline, enforced not observed)."""
-        sig = (kind, size)
+        (the PR 1 retrace-detector discipline, enforced not observed).
+        The signature carries the pool geometry so a SHARED cache
+        (Router fleets) only ever serves executables whose donated pool
+        shapes match this engine's."""
+        sig = self._sig(kind, size)
         fn = self._compiled.get(sig)
         if fn is None:
             import jax
-            build = (self._build_prefill if kind == "prefill"
-                     else self._build_decode)(size)
-            fn = jax.jit(build, donate_argnums=(1, 2)) \
+            build = {"prefill": self._build_prefill,
+                     "decode": self._build_decode,
+                     "chunk": self._build_chunk_prefill,
+                     "cow": self._build_cow}[kind](size)
+            donate = (0, 1) if kind == "cow" else (1, 2)
+            fn = jax.jit(build, donate_argnums=donate) \
                 .lower(*args).compile()
             self._compiled[sig] = fn
             self.stats["compiles"] += 1
@@ -380,13 +577,19 @@ class InferenceEngine:
         return fn
 
     def warmup(self):
-        """AOT-compile every (prefill, decode) bucket graph by running
-        each once against the real pools (compile + execute warms the
-        jit cache; the pools round-trip through the donated call)."""
+        """AOT-compile every (prefill, decode[, chunk, cow]) bucket
+        graph by running each once against the real pools (compile +
+        execute warms the jit cache; the pools round-trip through the
+        donated call).  Graphs already present in a SHARED compile
+        cache (Router replicas) are skipped outright — the fleet
+        compiles each signature once."""
         import jax
         dummy_key = jax.random.key(0)
         for bucket in self.buckets:
             nb = bucket // self.block_size
+            if self._sig("prefill", bucket) in self._compiled and \
+                    self._sig("decode", nb) in self._compiled:
+                continue
             ok = self.cache.alloc("__warmup__", bucket)
             if not ok:
                 raise MXNetError("warmup: KV pool too small for bucket "
@@ -406,6 +609,31 @@ class InferenceEngine:
             logits, nxt, kp, vp = self._get("decode", nb, args)(*args)
             self.cache.update_pools(kp, vp)
             self.cache.free("__warmup__")
+        if self.prefill_chunk:
+            # the packed-chunk family: one graph per context bucket,
+            # warmed with every row inactive (all writes land in the
+            # null block, so no pool allocation is needed)
+            R, C = self.max_batch, self.prefill_chunk
+            for bucket in self.buckets:
+                nb = bucket // self.block_size
+                if self._sig("chunk", nb) in self._compiled:
+                    continue
+                args = (self.params, self.cache.k_pool,
+                        self.cache.v_pool, _np.zeros((R, C), _np.int32),
+                        _np.zeros((R,), _np.int32),
+                        _np.zeros((R,), _np.int32),
+                        _np.zeros((R, nb), _np.int32),
+                        _np.zeros((R,), bool), dummy_key)
+                _l, _t, kp, vp = self._get("chunk", nb, args)(*args)
+                self.cache.update_pools(kp, vp)
+        if self.prefill_chunk or self.prefix_cache is not None:
+            if self._sig("cow", 0) not in self._compiled:
+                # the copy-on-write block copy (src=dst=0 copies the
+                # null block onto itself — garbage by design)
+                args = (self.cache.k_pool, self.cache.v_pool,
+                        _np.int32(0), _np.int32(0))
+                kp, vp = self._get("cow", 0, args)(*args)
+                self.cache.update_pools(kp, vp)
         self._warmed = True
         return self
 
@@ -440,18 +668,159 @@ class InferenceEngine:
         self.cache.trim(slot, t)
         self.cache.set_len(slot, t)
         self.stats["prefill_calls"] += 1
+        self.stats["prompt_tokens_computed"] += t
         if t0 is not None:
             _telem.inc("serving.prefill_calls")
             _telem.observe("serving.prefill_ms",
                            (_telem.clock() - t0) * 1e3)
-            _telem.set_gauge("serving.kv_block_utilization",
-                             round(self.cache.utilization(), 4))
+            self._publish_cache_gauges()
         return int(tok), last
+
+    def attach_prefix(self, slot, tokens):
+        """Prefix-cache admission: adopt the longest cached block chain
+        that prefixes ``tokens`` into ``slot`` (refcounts bumped, zero
+        compute) and return the number of cached positions (0 = miss or
+        no prefix cache; the caller prefills from there)."""
+        if self.prefix_cache is None:
+            return 0
+        return self.prefix_cache.attach(slot, tokens)
+
+    def insert_prefix(self, slot, tokens):
+        """Register ``slot``'s freshly prefilled prompt in the prefix
+        cache so later requests sharing the prefix skip its compute."""
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(slot, tokens)
+
+    def pin_prefix(self, tokens):
+        """Prefill ``tokens`` ONCE into a temporary slot and pin the
+        chain — including the partial tail block — in the prefix cache.
+        The deliberate system-prompt seam: every later request starting
+        with ``tokens`` adopts the blocks (CoW on its first write past
+        them) instead of recomputing.  Returns False when the pool
+        cannot hold the prefix right now."""
+        if self.prefix_cache is None:
+            raise MXNetError("pin_prefix needs prefix_cache=True")
+        slot = ("__prefix_pin__", self.stats["prefill_calls"])
+        if self.prefill(slot, tokens) is None:
+            return False
+        self.prefix_cache.insert(slot, tokens)
+        self.release(slot)
+        return True
+
+    def chunk_prefill(self, entries):
+        """One PACKED continuation-prefill dispatch (the ISSUE 12
+        chunked/batched prefill): ``entries`` is a list of
+        ``(slot, tokens, start)`` rows — ``tokens`` (<= prefill_chunk of
+        them) are the prompt positions ``[start, start+n)`` of ``slot``,
+        whose table already caches everything before ``start``.
+
+        Allocates/CoW-forks the written blocks, runs the compiled chunk
+        graph once for ALL rows, and returns ``(next_tokens, logits)``
+        aligned with ``entries`` (row meaningful only for rows whose
+        chunk ends the prompt).  Returns None when the pool cannot
+        cover the chunk (callers may evict prefix chains and retry)."""
+        import jax
+        if not self.prefill_chunk:
+            raise MXNetError("chunk_prefill needs prefill_chunk > 0 "
+                             "(MXTPU_PREFILL_CHUNK)")
+        n = len(entries)
+        if not 1 <= n <= self.max_batch:
+            raise MXNetError(f"chunk_prefill: {n} rows vs max_batch "
+                             f"{self.max_batch}")
+        C = self.prefill_chunk
+        end_max = 0
+        for slot, toks, start in entries:
+            t = len(toks)
+            if not 1 <= t <= C:
+                raise MXNetError(f"chunk of {t} tokens vs chunk bucket "
+                                 f"{C}")
+            if not self.cache.ensure(slot, start + t - 1):
+                return None
+            copies = self.cache.prepare_write(slot, start, start + t)
+            if copies is None:
+                return None
+            self._apply_cow(copies)
+            end_max = max(end_max, start + t)
+        bucket = next_bucket(end_max, self.buckets)
+        if bucket is None:
+            raise MXNetError(f"chunk end {end_max} exceeds max_context "
+                             f"{self.max_context}")
+        nbl = bucket // self.block_size
+        R = self.max_batch
+        toks = _np.zeros((R, C), _np.int32)
+        starts = _np.zeros((R,), _np.int32)
+        valids = _np.zeros((R,), _np.int32)
+        active = _np.zeros((R,), bool)
+        slots = [None] * R
+        for i, (slot, chunk, start) in enumerate(entries):
+            toks[i, :len(chunk)] = _np.asarray(chunk, _np.int32)
+            starts[i], valids[i], active[i] = start, len(chunk), True
+            slots[i] = slot
+        bts = self.cache.table_array(slots, nbl)
+        key = jax.random.fold_in(self._base_key,
+                                 (1 << 29) +
+                                 self.stats["chunk_prefill_calls"])
+        args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                toks, starts, valids, bts, active, key)
+        t0 = _telem.clock() if _telem.enabled() else None
+        last, nxt, kp, vp = self._get("chunk", nbl, args)(*args)
+        self.cache.update_pools(kp, vp)
+        for slot, chunk, start in entries:
+            self.cache.set_len(slot, start + len(chunk))
+        self.stats["chunk_prefill_calls"] += 1
+        self.stats["prompt_tokens_computed"] += \
+            int(sum(len(c) for _s, c, _p in entries))
+        if t0 is not None:
+            _telem.inc("serving.chunk_prefill_calls")
+            _telem.observe("serving.chunk_prefill_ms",
+                           (_telem.clock() - t0) * 1e3)
+            self._publish_cache_gauges()
+        return _np.asarray(nxt)[:n], _np.asarray(last)[:n]
+
+    def _apply_cow(self, copies):
+        """Run the device half of each (src -> dst) copy-on-write fork
+        the cache planned: the new block must carry the shared block's
+        bits before the caller's write lands."""
+        for src, dst in copies:
+            args = (self.cache.k_pool, self.cache.v_pool,
+                    _np.int32(src), _np.int32(dst))
+            kp, vp = self._get("cow", 0, args)(*args)
+            self.cache.update_pools(kp, vp)
+
+    def _publish_cache_gauges(self):
+        _telem.set_gauge("serving.kv_block_utilization",
+                         round(self.cache.utilization(), 4))
+        _telem.set_gauge("serving.kv_blocks_in_use",
+                         self.cache.blocks_in_use)
+        if self.prefix_cache is not None:
+            hr = self.prefix_cache.hit_rate()
+            if hr is not None:
+                _telem.set_gauge("serving.prefix_hit_rate",
+                                 round(hr, 4))
 
     def reserve(self, slot, pos):
         """Grow ``slot``'s block table to cover ``pos`` before a decode
-        step; False when the pool is exhausted."""
-        return self.cache.ensure(slot, pos)
+        step, copy-on-write-forking the written block if a prefix chain
+        still shares it.  Under pool pressure, LRU prefix chains are
+        evicted first (only chains — never a block a live sequence
+        holds); False when the pool is exhausted even then."""
+        pc = self.prefix_cache
+        if not self.cache.ensure(slot, pos):
+            need = self.cache.blocks_for(pos + 1) - \
+                len(self.cache.table(slot))
+            if pc is None or not pc.evict(blocks_needed=need):
+                return False
+            if not self.cache.ensure(slot, pos):
+                return False
+        copies = self.cache.prepare_write(slot, pos, pos + 1)
+        if copies is None:
+            if pc is None or not pc.evict(blocks_needed=1):
+                return False
+            copies = self.cache.prepare_write(slot, pos, pos + 1)
+            if copies is None:
+                return False
+        self._apply_cow(copies)
+        return True
 
     def decode(self, entries):
         """One decode step for the joined batch.
@@ -495,11 +864,13 @@ class InferenceEngine:
             _telem.inc("serving.decode_calls")
             _telem.observe("serving.decode_ms",
                            (_telem.clock() - t0) * 1e3)
-            _telem.set_gauge("serving.kv_block_utilization",
-                             round(self.cache.utilization(), 4))
+            self._publish_cache_gauges()
         nxt = _np.asarray(nxt)[:n]
         return nxt, _np.asarray(logits)[:n]
 
     def release(self, slot):
-        """Finished sequence: return its blocks to the pool."""
+        """Finished sequence: drop its hold on its blocks (a block a
+        prefix chain still references survives in the pool)."""
         self.cache.free(slot)
+        if _telem.enabled():
+            self._publish_cache_gauges()

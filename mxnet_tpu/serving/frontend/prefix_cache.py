@@ -1,0 +1,237 @@
+"""Copy-on-write prefix cache: hash token prefixes to KV block chains.
+
+The serving north star is millions of requests that all start with the
+same system prompt.  PR 7's engine recomputes that prompt per request;
+this cache remembers, per PHYSICAL BLOCK, which token chain produced
+it, so a request whose prompt starts with a cached chain adopts the
+blocks (refcount bump, zero compute) and only the un-cached suffix is
+prefilled (through the engine's packed chunk graph).
+
+Structure: a trie of nodes keyed by ``(parent_key, block_tokens)`` —
+the dict key IS the hash of the whole token prefix up to that block
+(each key embeds its parent's key, the rolling-hash construction at
+block granularity).  Full-block nodes chain; one PARTIAL tail node per
+insertion remembers a block whose last positions are still unwritten
+(a 12-token system prompt at block_size 8 caches one full block plus a
+4-token partial).  Adopting a partial block is exactly where
+copy-on-write earns its keep: the adopter's next write lands in that
+block, ``PagedKVCache.prepare_write`` sees refcount > 1 and forks it,
+and the cached original keeps serving other requests bit-identically.
+
+Every node holds ONE reference on its block.  Eviction (LRU, leaf
+first) only drops that reference — a block a live sequence still reads
+has refcount > 1 and stays in the pool untouched, so eviction under
+block pressure can never corrupt an in-flight request (the ISSUE 12
+acceptance gate).
+
+Single-owner discipline: a PrefixCache belongs to ONE engine replica
+and is only touched from that replica's driver (thread or the Router's
+deterministic drive) — no lock, by design; the Router never shares one
+across replicas.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ... import telemetry as _telem
+
+__all__ = ["PrefixCache"]
+
+
+class _Node:
+    __slots__ = ("key", "parent", "block", "n_tokens", "partial",
+                 "children", "tick")
+
+    def __init__(self, key, parent, block, n_tokens, partial, tick):
+        self.key = key
+        self.parent = parent          # parent _Node or None (root child)
+        self.block = block            # physical block id (one ref held)
+        self.n_tokens = n_tokens      # tokens cached in this block
+        self.partial = partial        # True: block tail still unwritten
+        self.children = 0             # live child-node count
+        self.tick = tick              # LRU stamp (deterministic counter)
+
+
+class PrefixCache:
+    """Block-chain prefix cache over one :class:`PagedKVCache`.
+
+    Parameters
+    ----------
+    cache : the engine's PagedKVCache (chains hold refs on its blocks).
+    max_nodes : soft cap on cached nodes; inserting past it evicts LRU
+        leaves first (0 = unbounded, eviction only under pool pressure).
+    """
+
+    def __init__(self, cache, max_nodes=0):
+        self.cache = cache
+        self.max_nodes = int(max_nodes)
+        self._nodes = {}      # key -> _Node
+        self._tick = 0        # deterministic LRU clock (no wall time)
+        self.lookups = 0
+        self.hits = 0
+        self.hit_tokens = 0   # positions served from cache, cumulative
+        self.evictions = 0
+
+    # -- key construction ------------------------------------------------
+
+    @staticmethod
+    def _key(parent_key, tokens):
+        return (parent_key, tuple(int(t) for t in tokens))
+
+    def _bump(self, node):
+        self._tick += 1
+        # refresh the whole chain: a leaf hit keeps its ancestors warm
+        # (an ancestor must never be evicted before its children anyway,
+        # but LRU order should reflect reachability)
+        while node is not None:
+            node.tick = self._tick
+            node = node.parent
+
+    # -- the read path ---------------------------------------------------
+
+    def lookup(self, tokens):
+        """Longest cached chain prefixing ``tokens``, capped at
+        ``len(tokens) - 1`` positions (at least one token must be
+        computed to produce logits).  Returns ``(n_tokens, blocks)``
+        with refcounts UNTOUCHED — :meth:`attach` takes the references.
+        """
+        bs = self.cache.block_size
+        toks = [int(t) for t in tokens]
+        limit = len(toks) - 1
+        parent = None
+        parent_key = None
+        blocks = []
+        n = 0
+        while n + bs <= limit:
+            key = self._key(parent_key, toks[n:n + bs])
+            node = self._nodes.get(key)
+            if node is None or node.partial:
+                break
+            blocks.append(node.block)
+            n += bs
+            parent, parent_key = node, key
+        # partial tail: the longest cached sub-block continuation
+        for ln in range(min(bs - 1, limit - n), 0, -1):
+            key = self._key(parent_key, toks[n:n + ln])
+            node = self._nodes.get(key)
+            if node is not None and node.partial:
+                blocks.append(node.block)
+                n += ln
+                parent = node
+                break
+        self.lookups += 1
+        if n:
+            self.hits += 1
+            self.hit_tokens += n
+            self._bump(parent)
+        self._publish()
+        return n, blocks
+
+    def attach(self, slot, tokens):
+        """Adopt the longest cached chain into ``slot`` (one ref per
+        block) and return the cached position count (0 = miss; the
+        caller allocates from scratch)."""
+        n, blocks = self.lookup(tokens)
+        if n:
+            self.cache.adopt(slot, blocks, n)
+        return n
+
+    # -- the write path --------------------------------------------------
+
+    def insert(self, slot, tokens):
+        """Register ``slot``'s prefilled prompt: one node per full
+        block, plus a partial node for the tail sub-block (if any and
+        if at least one token long).  Blocks already chained are
+        skipped; new nodes take one reference each so the chain
+        survives the sequence's release."""
+        bs = self.cache.block_size
+        toks = [int(t) for t in tokens]
+        table = self.cache.table(slot)
+        parent = None
+        parent_key = None
+        n = 0
+        idx = 0
+        while n + bs <= len(toks):
+            key = self._key(parent_key, toks[n:n + bs])
+            node = self._nodes.get(key)
+            if node is None:
+                node = self._new_node(key, parent, table[idx],
+                                      bs, partial=False)
+                if node is None:    # cap reached, nothing evictable
+                    return
+            parent, parent_key = node, key
+            n += bs
+            idx += 1
+        rem = len(toks) - n
+        if rem > 0 and idx < len(table):
+            key = self._key(parent_key, toks[n:])
+            if key not in self._nodes:
+                self._new_node(key, parent, table[idx], rem, partial=True)
+
+    def _new_node(self, key, parent, block, n_tokens, partial):
+        if self.max_nodes and len(self._nodes) >= self.max_nodes:
+            if not self.evict(blocks_needed=0, nodes_needed=1):
+                return None
+        self.cache.ref(block)
+        self._tick += 1
+        node = _Node(key, parent, block, n_tokens, partial, self._tick)
+        self._nodes[key] = node
+        if parent is not None:
+            parent.children += 1
+        return node
+
+    # -- eviction --------------------------------------------------------
+
+    def evict(self, blocks_needed=1, nodes_needed=0):
+        """Drop LRU LEAF nodes until the pool has ``blocks_needed``
+        free blocks (and/or ``nodes_needed`` node slots).  Only the
+        cache's own reference is dropped — a block a live sequence
+        shares keeps its other refcounts and is NOT returned to the
+        free list (``PagedKVCache.unref`` recycles at zero only).
+        Returns the number of nodes evicted."""
+        dropped = 0
+        while (self.cache.num_free_blocks < blocks_needed or
+               dropped < nodes_needed):
+            leaves = [nd for nd in self._nodes.values()
+                      if nd.children == 0]
+            if not leaves:
+                break
+            victim = min(leaves, key=lambda nd: nd.tick)
+            del self._nodes[victim.key]
+            if victim.parent is not None:
+                victim.parent.children -= 1
+            self.cache.unref(victim.block)
+            self.evictions += 1
+            dropped += 1
+        self._publish()
+        return dropped
+
+    def clear(self):
+        """Drop every chain (shutdown / tests)."""
+        for node in self._nodes.values():
+            self.cache.unref(node.block)
+        self._nodes.clear()
+
+    # -- stats -----------------------------------------------------------
+
+    def hit_rate(self):
+        return self.hits / self.lookups if self.lookups else None
+
+    def held_blocks(self):
+        """References this cache holds (the ``holders`` argument for
+        ``PagedKVCache.check_leaks``)."""
+        return len(self._nodes)
+
+    def _publish(self):
+        if _telem.enabled():
+            hr = self.hit_rate()
+            if hr is not None:
+                _telem.set_gauge("serving.prefix_hit_rate",
+                                 round(hr, 4))
+
+    def stats(self):
+        return {"nodes": len(self._nodes),
+                "lookups": self.lookups, "hits": self.hits,
+                "hit_rate": (round(self.hit_rate(), 4)
+                             if self.lookups else None),
+                "hit_tokens": self.hit_tokens,
+                "evictions": self.evictions}

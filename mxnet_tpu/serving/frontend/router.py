@@ -1,0 +1,429 @@
+"""Multi-replica serving router: least-loaded admission, epoch-fenced
+replica membership, drain-and-requeue on replica death.
+
+One :class:`~mxnet_tpu.serving.InferenceEngine` is one chip's decode
+loop; planet-scale traffic needs a FLEET of them behind one front end
+(the GluonCV/GluonNLP deployment story, arXiv:1907.04433).  The Router
+owns N engine replicas, each with its own
+:class:`~mxnet_tpu.serving.ContinuousBatcher`, KV pool and prefix
+cache, and:
+
+- **admits** each request to the replica with the lowest load score,
+  computed from the PR 9 telemetry registry signals
+  (``serving.replica<i>.queue_depth`` / ``.ttft_ms`` /
+  ``.kv_block_utilization`` — the same gauges a live scrape sees;
+  direct engine reads are the fallback when the registry is off);
+- **numbers the replica set with an epoch** (the PR 8 membership
+  discipline): every death or join bumps it, and stats/manifest carry
+  it so two observations of the fleet are comparable;
+- **drains and requeues** when a replica dies mid-traffic: its queued,
+  prefilling and mid-decode requests are reset to their prompts and
+  re-admitted to the survivors — greedy decode is deterministic, so a
+  re-run request produces the same tokens it would have (the chaos
+  gate: zero lost, zero duplicated, outputs bitwise the solo run);
+- **shares one warmup compile cache** across replicas: executables
+  close over shapes only, so the fleet pays each (kind, size) graph
+  compile once (replica 2's warmup skips straight through).
+
+Two drive modes.  ``start()`` spawns one worker THREAD per replica
+(each replica's engine/batcher/prefix cache is touched only by its
+worker — single-owner, no data sharing; the router's own bookkeeping
+is the only locked state).  ``drive()`` steps every live replica once
+on the caller's thread, round-robin — fully deterministic, zero
+sleeps, what the chaos scenario and the loadgen's reproducible numbers
+use.  Both modes run the same admission/death/requeue code.
+"""
+from __future__ import annotations
+
+import threading
+
+from ...base import MXNetError
+from ... import telemetry as _telem
+from ...lint import racecheck as _racecheck
+from ..scheduler import ContinuousBatcher
+
+__all__ = ["Router", "Replica"]
+
+
+class Replica:
+    """One engine + batcher + (optional) worker thread.  Everything in
+    here is owned by the replica's driver; the Router only reads/writes
+    it while holding the router lock in ways the drivers tolerate
+    (inbox hand-off, death flag)."""
+
+    __slots__ = ("rid", "engine", "batcher", "alive", "inbox",
+                 "boundaries", "thread", "ttfts")
+
+    def __init__(self, rid, engine, batcher):
+        self.rid = rid
+        self.engine = engine
+        self.batcher = batcher
+        self.alive = True
+        self.inbox = []          # guarded-by: Router._lock
+        self.boundaries = 0      # scheduling boundaries stepped
+        self.thread = None
+        self.ttfts = []          # recent TTFTs (seconds) for scoring
+
+    def load_signals(self, inbox_len=0):
+        """The raw admission signals, read directly off the replica —
+        the fallback (and the source the Router publishes to the
+        telemetry registry after every boundary).  ``inbox_len`` is
+        snapshotted by the caller under the router lock (the inbox is
+        the one cross-thread structure here)."""
+        b = self.batcher
+        depth = len(b.queue) + int(inbox_len) + len(b.active) + \
+            len(getattr(b, "prefilling", ()))
+        recent = self.ttfts[-8:]
+        ttft_ms = (sorted(recent)[len(recent) // 2] * 1e3
+                   if recent else 0.0)
+        return {"queue_depth": depth,
+                "ttft_ms": ttft_ms,
+                "kv_block_utilization": self.engine.cache.utilization()}
+
+
+class Router:
+    """Front-end over ``replicas`` engine replicas.
+
+    Parameters
+    ----------
+    engine_factory : callable(compile_cache_dict) -> InferenceEngine
+        (unwarmed).  Called once per replica with the SHARED compile
+        cache; the router warms each engine (replica 0 pays the
+        compiles, the rest reuse them).
+    replicas : fleet size (>= 1); default ``MXTPU_SERVE_REPLICAS`` or 2.
+    prefills_per_step : forwarded to each ContinuousBatcher.
+    now : timestamp source for router events (FakeClock-injectable;
+        never used for waiting — the router has no timeouts).
+    """
+
+    def __init__(self, engine_factory, replicas=None,
+                 prefills_per_step=1, now=None):
+        import os
+        import time
+        if replicas is None:
+            try:
+                replicas = int(os.environ.get("MXTPU_SERVE_REPLICAS", 2))
+            except ValueError:
+                replicas = 2
+        if replicas < 1:
+            raise MXNetError(f"Router needs >= 1 replica, got {replicas}")
+        self._now = now if now is not None else time.time
+        self._lock = _racecheck.make_lock("Router._lock")
+        self._cond = threading.Condition(self._lock)
+        self.epoch = 0             # guarded-by: _lock (replica-set epoch)
+        self.requeues = 0          # guarded-by: _lock
+        self._assigned = {}        # guarded-by: _lock — req.id -> rid
+        self._submitted = {}       # guarded-by: _lock — req.id -> Request
+        self._stopping = False     # guarded-by: _lock
+        self.events = []           # guarded-by: _lock — membership log
+        self.compile_cache = {}
+        self.replicas = []
+        warm0 = None
+        for rid in range(replicas):
+            eng = engine_factory(self.compile_cache)
+            before = eng.stats["compiles"]
+            eng.warmup()
+            if rid == 0:
+                warm0 = eng.stats["compiles"] - before
+            self.replicas.append(
+                Replica(rid, eng,
+                        ContinuousBatcher(eng, prefills_per_step)))
+        self.warmup_compiles = warm0 or 0
+        self.warmup_compiles_shared = (replicas - 1) * (warm0 or 0)
+
+    # -- membership ------------------------------------------------------
+
+    def live_replicas(self):
+        return [r for r in self.replicas if r.alive]
+
+    def kill_replica(self, rid):
+        """Administrative kill (chaos / tests): same path a crashed
+        worker takes — epoch bump, drain, requeue."""
+        self._on_death(self.replicas[rid],
+                       MXNetError(f"replica {rid} killed"))
+
+    def _on_death(self, rep, exc):
+        if not rep.alive:
+            return
+        with self._lock:
+            rep.alive = False
+            self.epoch += 1
+            epoch = self.epoch
+            lost = list(rep.inbox)
+            rep.inbox.clear()
+            self.events.append({"kind": "replica_dead", "rid": rep.rid,
+                                "epoch": epoch,
+                                "error": f"{type(exc).__name__}: {exc}",
+                                "t": self._now()})
+        b = rep.batcher
+        # everything the dead replica still owed: queued, mid-prefill,
+        # mid-decode.  Finished requests already left the building.
+        lost += list(b.queue)
+        b.queue.clear()
+        lost += [st.req for st in getattr(b, "prefilling", {}).values()]
+        getattr(b, "prefilling", {}).clear()
+        lost += list(b.active.values())
+        b.active.clear()
+        if not self.live_replicas():
+            raise MXNetError(
+                f"router: last replica died ({exc}); "
+                f"{len(lost)} request(s) unservable")
+        _telem.event("serving.replica_dead", rid=rep.rid,
+                     epoch=epoch, requeued=len(lost))
+        _telem.inc("serving.replica_deaths")
+        for req in lost:
+            # reset to the prompt: greedy decode reproduces the exact
+            # stream on the new replica, so nothing is lost or doubled
+            req.generated = []
+            req.finish_reason = None
+            req.first_token_t = None
+            req.finish_t = None
+            with self._lock:
+                self.requeues += 1
+            self.submit(req, _requeue=True)
+
+    # -- admission -------------------------------------------------------
+
+    def _signals(self, rep):
+        """Per-replica load signals THROUGH the telemetry registry when
+        it's live (the published gauges are the fleet's source of
+        truth), falling back to direct reads."""
+        if _telem.enabled():
+            pre = f"serving.replica{rep.rid}."
+            depth = _telem.value(pre + "queue_depth")
+            if depth is not None:
+                return {"queue_depth": depth,
+                        "ttft_ms": _telem.value(pre + "ttft_ms") or 0.0,
+                        "kv_block_utilization":
+                            _telem.value(pre + "kv_block_utilization")
+                            or 0.0}
+        with self._lock:
+            inbox_len = len(rep.inbox)
+        return rep.load_signals(inbox_len)
+
+    def _score(self, sig):
+        # queue depth dominates (each queued request is a whole
+        # generation of latency); KV pressure breaks ties between
+        # equally-deep queues; TTFT drift demotes a replica that has
+        # been serving slowly even when its queue momentarily clears
+        return (2.0 * sig["queue_depth"]
+                + 1.0 * sig["kv_block_utilization"]
+                + 0.001 * sig["ttft_ms"])
+
+    def submit(self, request, _requeue=False):
+        """Admit ``request`` to the least-loaded live replica."""
+        live = self.live_replicas()
+        if not live:
+            raise MXNetError("router: no live replicas")
+        scored = [(self._score(self._signals(r)), r.rid, r) for r in live]
+        scored.sort(key=lambda t: (t[0], t[1]))
+        rep = scored[0][2]
+        with self._lock:
+            if not _requeue:
+                self._submitted[request.id] = request
+            self._assigned[request.id] = rep.rid
+            rep.inbox.append(request)
+            self._cond.notify_all()
+        return request
+
+    def _drain_inbox(self, rep):
+        with self._lock:
+            pending, rep.inbox = rep.inbox, []
+        for req in pending:
+            rep.batcher.submit(req)
+
+    # -- driving ---------------------------------------------------------
+
+    def _step_replica(self, rep):
+        """One scheduling boundary on one replica (runs on the
+        replica's owner thread — worker or deterministic driver)."""
+        from ...testing import faults
+        rep.boundaries += 1
+        faults.fault_point(f"serving.replica{rep.rid}.step",
+                           payload=rep.boundaries)
+        self._drain_inbox(rep)
+        n_fin = len(rep.batcher.finished)
+        moved = rep.batcher.step()
+        for req in rep.batcher.finished[n_fin:]:
+            t = req.ttft()
+            if t is not None:
+                rep.ttfts.append(t)
+        if _telem.enabled():
+            with self._lock:
+                inbox_len = len(rep.inbox)
+            sig = rep.load_signals(inbox_len)
+            pre = f"serving.replica{rep.rid}."
+            _telem.set_gauge(pre + "queue_depth", sig["queue_depth"])
+            _telem.set_gauge(pre + "ttft_ms",
+                             round(sig["ttft_ms"], 3))
+            _telem.set_gauge(pre + "kv_block_utilization",
+                             round(sig["kv_block_utilization"], 4))
+        return moved
+
+    def _replica_idle(self, rep):
+        b = rep.batcher
+        return not (rep.inbox or b.queue or b.active
+                    or getattr(b, "prefilling", None))
+
+    def drive(self, max_boundaries=100000):
+        """Deterministic mode: round-robin every live replica until all
+        submitted requests finish.  Zero sleeps, zero threads — the
+        chaos scenario's and the loadgen's reproducible path."""
+        boundaries = 0
+        while not self.all_done():
+            progressed = False
+            for rep in list(self.replicas):
+                if not rep.alive or self._replica_idle(rep):
+                    continue
+                try:
+                    self._step_replica(rep)
+                except Exception as e:  # noqa: BLE001 — death path
+                    self._on_death(rep, e)
+                progressed = True
+                boundaries += 1
+                if boundaries > max_boundaries:
+                    raise MXNetError("router drive exceeded "
+                                     "max_boundaries — fleet wedged")
+            if not progressed and not self.all_done():
+                raise MXNetError(
+                    "router: no replica can make progress but "
+                    "requests remain (pool too small for the mix?)")
+        return boundaries
+
+    # -- threaded mode ---------------------------------------------------
+
+    def start(self):
+        """Spawn one worker thread per replica (production shape).
+        Each worker owns its replica exclusively; it sleeps on the
+        router condition variable when idle (no polling)."""
+        for rep in self.replicas:
+            if rep.thread is not None:
+                continue
+            t = threading.Thread(target=self._worker, args=(rep,),
+                                 name=f"router-replica{rep.rid}",
+                                 daemon=True)
+            rep.thread = t
+            t.start()
+        return self
+
+    def _worker(self, rep):
+        while True:
+            with self._lock:
+                while (rep.alive and not self._stopping
+                       and self._replica_idle(rep)):
+                    self._cond.wait()  # mxlint: disable=HB16 -- Condition.wait RELEASES the router lock while sleeping
+                if self._stopping or not rep.alive:
+                    return
+            try:
+                self._step_replica(rep)
+            except Exception as e:  # noqa: BLE001 — death path
+                self._on_death(rep, e)
+                return
+            finally:
+                with self._lock:
+                    self._cond.notify_all()
+
+    def stop(self):
+        """Stop workers after they finish the current boundary."""
+        with self._lock:
+            self._stopping = True
+            self._cond.notify_all()
+        for rep in self.replicas:
+            if rep.thread is not None:
+                rep.thread.join(timeout=60)
+                rep.thread = None
+        with self._lock:
+            self._stopping = False
+        return self
+
+    def wait_all_done(self, timeout=60.0):
+        """Threaded mode: block until every submitted request finished.
+        Event-driven, not polled — workers notify the router condition
+        after every boundary."""
+        import time
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while True:
+                reqs = list(self._submitted.values())
+                if all(r.done for r in reqs):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise MXNetError(
+                        "router: requests still unfinished at timeout")
+                self._cond.wait(remaining)  # mxlint: disable=HB16 -- Condition.wait RELEASES the router lock while sleeping
+
+    # -- introspection ---------------------------------------------------
+
+    def all_done(self):
+        with self._lock:
+            reqs = list(self._submitted.values())
+        return all(r.done for r in reqs)
+
+    def finished(self):
+        """Every finished request across live AND dead replicas (a
+        request that completed before its replica died stays
+        completed)."""
+        out = []
+        for rep in self.replicas:
+            out.extend(rep.batcher.finished)
+        return out
+
+    def manifest(self):
+        """The fleet's inspectable shape: epoch, per-replica liveness +
+        engine config + mesh spec (the ISSUE 12 small-fix: the recorded
+        MeshConfig rides along so item-2 TP serving slots in here)."""
+        with self._lock:
+            epoch = self.epoch
+        return {
+            "epoch": epoch,
+            "replicas": [{
+                "rid": r.rid,
+                "alive": r.alive,
+                "mesh": r.engine.mesh_config.describe(),
+                "max_batch": r.engine.max_batch,
+                "block_size": r.engine.block_size,
+                "max_context": r.engine.max_context,
+                "buckets": list(r.engine.buckets),
+                "quantized": r.engine.quantized,
+                "prefill_chunk": r.engine.prefill_chunk,
+                "prefix_cache": r.engine.prefix_cache is not None,
+            } for r in self.replicas],
+            "shared_compile_cache": len(self.compile_cache),
+            "warmup_compiles": self.warmup_compiles,
+            "warmup_compiles_shared": self.warmup_compiles_shared,
+        }
+
+    def stats(self):
+        fin = self.finished()
+        lat = sorted(r.latency() for r in fin
+                     if r.latency() is not None)
+
+        def pct(p):
+            if not lat:
+                return None
+            return lat[min(len(lat) - 1, int(round(p * (len(lat) - 1))))]
+
+        per_replica = []
+        total_caw = 0
+        for r in self.replicas:
+            occ = r.batcher.occupancy()
+            total_caw += r.engine.stats["compiles_after_warmup"]
+            per_replica.append({
+                "rid": r.rid, "alive": r.alive,
+                "requests": len(r.batcher.finished),
+                "boundaries": r.boundaries,
+                "occupancy": round(occ, 4) if occ is not None else None,
+                "prefix": (r.engine.prefix_cache.stats()
+                           if r.engine.prefix_cache else None),
+            })
+        with self._lock:
+            epoch, requeues = self.epoch, self.requeues
+        return {"replicas": len(self.replicas),
+                "live": len(self.live_replicas()),
+                "epoch": epoch,
+                "requests": len(fin),
+                "requeues": requeues,
+                "p50_latency_s": pct(0.50), "p99_latency_s": pct(0.99),
+                "compiles_after_warmup": total_caw,
+                "per_replica": per_replica}

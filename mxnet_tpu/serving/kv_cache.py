@@ -1,4 +1,5 @@
-"""Paged KV cache: block-table indexed, per-sequence alloc/free.
+"""Paged KV cache: block-table indexed, per-sequence alloc/free, with
+per-block refcounts for copy-on-write prefix sharing.
 
 The serving decode batch holds ``max_batch`` sequences of wildly
 different lengths; a dense (B, max_seq, ...) cache would reserve
@@ -15,10 +16,25 @@ Device side the pool is two jnp arrays of shape
 prefill/decode graphs take them as donated arguments and return the
 updated pool (functional update, carry donated like PR 6's
 ``step_multi``), while this class keeps the HOST truth: the free list,
-per-slot block tables and lengths.  Physical block 0 is reserved as the
-null block — block-table padding and inactive batch rows point at it so
-every gather/scatter index stays in range; its contents are garbage by
-design and masked out of every attention (position mask).
+per-slot block tables, lengths, and per-block REFCOUNTS.  Physical
+block 0 is reserved as the null block — block-table padding and
+inactive batch rows point at it so every gather/scatter index stays in
+range; its contents are garbage by design and masked out of every
+attention (position mask).
+
+Refcounts (ISSUE 12): a block freshly popped from the free list has
+refcount 1 (its owning slot).  ``fork``/``adopt`` hand the SAME
+physical blocks to another holder and bump the count — this is how the
+prefix cache shares one prefilled system prompt across every request
+that starts with it.  A shared block is immutable: before the engine
+scatters K/V into a position whose block has refcount > 1,
+``prepare_write`` allocates a fresh block and the engine copies the
+old contents device-side (copy-on-write; the writer pays, every other
+holder keeps the original bits).  ``free``/``trim`` only DECREMENT; a
+block returns to the free list exactly when its count hits 0, so
+eviction can never reclaim memory another sequence still reads.
+Refcount violations (double free, underflow) raise the typed
+:class:`DoubleFreeError` instead of corrupting the free list.
 """
 from __future__ import annotations
 
@@ -26,7 +42,13 @@ import numpy as _np
 
 from ..base import MXNetError
 
-__all__ = ["PagedKVCache"]
+__all__ = ["PagedKVCache", "DoubleFreeError"]
+
+
+class DoubleFreeError(MXNetError):
+    """A block refcount went below zero or a slot was freed twice —
+    the host-side block accounting is corrupt and continuing would
+    hand one sequence's KV memory to another."""
 
 
 class PagedKVCache:
@@ -65,7 +87,38 @@ class PagedKVCache:
         self._free = list(range(num_blocks - 1, 0, -1))
         self._tables = {}        # slot -> [physical block ids]
         self._lens = {}          # slot -> tokens stored
+        self._refs = {}          # block id -> holders (never block 0)
         self.alloc_failures = 0  # pool-exhausted alloc attempts (stats)
+        self.cow_copies = 0      # copy-on-write forks performed
+
+    # -- refcount plumbing ----------------------------------------------
+
+    def _pop_free(self):
+        blk = self._free.pop()
+        self._refs[blk] = 1
+        return blk
+
+    def ref(self, blk):
+        """One more holder for an allocated block (prefix-cache chains,
+        forked tables)."""
+        if self._refs.get(blk, 0) < 1:
+            raise DoubleFreeError(f"ref() on unallocated block {blk}")
+        self._refs[blk] += 1
+
+    def unref(self, blk):
+        """Drop one holder; the block rejoins the free list at 0."""
+        r = self._refs.get(blk, 0)
+        if r < 1:
+            raise DoubleFreeError(
+                f"refcount underflow on block {blk} (double free)")
+        if r == 1:
+            del self._refs[blk]
+            self._free.append(blk)
+        else:
+            self._refs[blk] = r - 1
+
+    def refcount(self, blk):
+        return self._refs.get(blk, 0)
 
     # -- allocation ------------------------------------------------------
 
@@ -96,8 +149,25 @@ class PagedKVCache:
         if need > len(self._free):
             self.alloc_failures += 1
             return False
-        self._tables[slot] = [self._free.pop() for _ in range(need)]
+        self._tables[slot] = [self._pop_free() for _ in range(need)]
         self._lens[slot] = 0
+        return True
+
+    def adopt(self, slot, blocks, n_tokens):
+        """Create ``slot`` sharing ``blocks`` (a prefix-cache chain
+        covering ``n_tokens`` positions): each block gains a holder, the
+        slot's length starts at ``n_tokens``.  The slot grows past the
+        shared prefix with ``ensure`` and CoW-forks on write."""
+        if slot in self._tables:
+            raise MXNetError(f"slot {slot} already allocated; free() first")
+        if self.blocks_for(n_tokens) != len(blocks):
+            raise MXNetError(
+                f"adopt: {len(blocks)} blocks cannot cover {n_tokens} "
+                f"tokens at block_size {self.block_size}")
+        for blk in blocks:
+            self.ref(blk)
+        self._tables[slot] = list(blocks)
+        self._lens[slot] = int(n_tokens)
         return True
 
     def ensure(self, slot, pos):
@@ -111,24 +181,65 @@ class PagedKVCache:
         if need > len(self._free):
             self.alloc_failures += 1
             return False
-        table.extend(self._free.pop() for _ in range(need))
+        table.extend(self._pop_free() for _ in range(need))
         return True
+
+    def prepare_write(self, slot, start, end):
+        """Copy-on-write plan for scattering K/V into positions
+        ``[start, end)`` of ``slot``: every covering block with
+        refcount > 1 is swapped for a fresh block in the table, and the
+        (old, new) pairs are returned so the ENGINE can copy the block
+        contents device-side before the write lands.  Returns None when
+        the pool can't supply the fresh blocks (caller may evict and
+        retry); [] when nothing is shared (the common path)."""
+        if end <= start:
+            return []
+        table = self._tables[slot]
+        copies = []
+        first = int(start) // self.block_size
+        last = (int(end) - 1) // self.block_size
+        for idx in range(first, last + 1):
+            old = table[idx]
+            if self._refs.get(old, 0) > 1:
+                if not self._free:
+                    # undo the partial plan: nothing is copied until the
+                    # whole range has fresh blocks
+                    self.alloc_failures += 1
+                    for o, n, i in copies:
+                        del self._refs[n]
+                        self._free.append(n)
+                        table[i] = o
+                        self._refs[o] = self._refs.get(o, 0) + 1
+                        self.cow_copies -= 1
+                    return None
+                new = self._pop_free()
+                table[idx] = new
+                self.unref(old)
+                copies.append((old, new, idx))
+                self.cow_copies += 1
+        return [(o, n) for o, n, _ in copies]
 
     def trim(self, slot, n_tokens):
         """Shrink ``slot``'s table to exactly cover ``n_tokens``
-        positions, returning the tail blocks to the pool (prefill
-        allocates for the padded BUCKET; the pad tail is garbage by
-        construction — decode overwrites a position before ever reading
-        it — so the blocks can be handed to other sequences now)."""
+        positions, dropping this slot's hold on the tail blocks
+        (prefill allocates for the padded BUCKET; the pad tail is
+        garbage by construction — decode overwrites a position before
+        ever reading it).  A tail block another holder still references
+        survives in the pool; only refcount-0 blocks are recycled."""
         table = self._tables[slot]
         keep = self.blocks_for(n_tokens)
         while len(table) > keep:
-            self._free.append(table.pop())
+            self.unref(table.pop())
 
     def free(self, slot):
-        """Return all of ``slot``'s blocks to the pool."""
-        for blk in self._tables.pop(slot, ()):
-            self._free.append(blk)
+        """Drop ``slot``'s hold on all of its blocks.  Freeing a slot
+        that does not exist is a double free (typed error): the caller's
+        lifecycle accounting is broken."""
+        if slot not in self._tables:
+            raise DoubleFreeError(f"free() on unknown slot {slot!r} "
+                                  "(double free or never allocated)")
+        for blk in self._tables.pop(slot):
+            self.unref(blk)
         self._lens.pop(slot, None)
 
     def set_len(self, slot, n):
@@ -139,6 +250,35 @@ class PagedKVCache:
 
     def table(self, slot):
         return list(self._tables.get(slot, ()))
+
+    def check_leaks(self, holders=0):
+        """Invariant sweep for lifecycle tests: with all sequences
+        released, every block must be back on the free list except the
+        ``holders`` references held externally (e.g. a prefix cache's
+        chains), and the refcount map must exactly cover the live
+        tables + holders.  Raises MXNetError naming the discrepancy."""
+        table_refs = {}
+        for slot, table in self._tables.items():
+            for blk in table:
+                table_refs[blk] = table_refs.get(blk, 0) + 1
+        extra = sum(self._refs.values()) - sum(table_refs.values())
+        if extra != holders:
+            raise MXNetError(
+                f"KV block leak: {extra} dangling reference(s) beyond "
+                f"the {holders} declared external holder(s); refs="
+                f"{dict(self._refs)} tables={dict(self._tables)}")
+        for blk, n in table_refs.items():
+            if self._refs.get(blk, 0) < n:
+                raise MXNetError(
+                    f"block {blk} held by {n} table(s) but refcount is "
+                    f"{self._refs.get(blk, 0)}")
+        accounted = len(self._free) + len(self._refs)
+        if accounted != self.num_blocks - 1:
+            raise MXNetError(
+                f"block accounting off: {len(self._free)} free + "
+                f"{len(self._refs)} referenced != {self.num_blocks - 1} "
+                "allocatable")
+        return True
 
     # -- device-facing views --------------------------------------------
 
@@ -164,9 +304,12 @@ class PagedKVCache:
         self.v_pool = v_pool
 
     def stats(self):
+        shared = sum(1 for r in self._refs.values() if r > 1)
         return {"num_blocks": self.num_blocks,
                 "block_size": self.block_size,
                 "blocks_in_use": self.blocks_in_use,
                 "utilization": round(self.utilization(), 4),
                 "alloc_failures": self.alloc_failures,
-                "sequences": len(self._tables)}
+                "sequences": len(self._tables),
+                "shared_blocks": shared,
+                "cow_copies": self.cow_copies}

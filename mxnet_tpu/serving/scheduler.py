@@ -15,6 +15,16 @@ generation).  ``StaticBatcher`` implements the fixed-batch baseline over
 the SAME engine so the load generator's continuous-vs-static comparison
 measures the scheduling policy, not two different compiled paths.
 
+Chunked prefill (ISSUE 12): when the engine's ``prefill_chunk`` is set,
+admission switches from one-prompt-per-dispatch to PACKED chunks — every
+boundary gathers up to ``max_batch`` rows (tail chunks of in-flight long
+prompts first, then new admissions, each first consulting the prefix
+cache so a cached system prompt costs zero compute) into ONE
+``chunk_prefill`` dispatch.  Same work, strictly fewer dispatches than
+the one-per-boundary policy on any mixed queue — the deterministic gate
+in tests/test_serving_frontend.py.  A long prompt still delays the
+running batch by at most one chunk per boundary.
+
 Everything here is host-side policy: per-token device work is exactly
 one compiled decode step; the only host pull per boundary is the sampled
 token vector (needed to detect EOS and admit/evict — the serving
@@ -168,22 +178,51 @@ class _BatcherBase:
                 "cache": self.engine.cache.stats()}
 
 
+class _PrefillState:
+    """A prompt part-way through chunked prefill: ``done`` positions of
+    ``req.tokens`` are cached in ``slot`` (prefix-cache hits count)."""
+
+    __slots__ = ("req", "slot", "done")
+
+    def __init__(self, req, slot, done):
+        self.req = req
+        self.slot = slot
+        self.done = int(done)
+
+
 class ContinuousBatcher(_BatcherBase):
     """Token-boundary continuous batching: admit into free slots before
     every decode step, evict finished sequences the moment EOS/length
-    hits, never drain the batch to take new work."""
+    hits, never drain the batch to take new work.  With the engine's
+    ``prefill_chunk`` set, admission packs chunks from several prompts
+    into one dispatch per boundary (ISSUE 12 chunked prefill)."""
 
     def __init__(self, engine, prefills_per_step=1):
         super().__init__(engine)
         self.prefills_per_step = int(prefills_per_step)
         self.active = {}          # slot -> Request
+        self.prefilling = {}      # slot -> _PrefillState (chunked only)
         self._free_slots = list(range(engine.max_batch - 1, -1, -1))
 
     def step(self):
-        """One scheduling boundary: admit up to ``prefills_per_step``
-        queued requests, then run one joined decode step.  Returns the
-        amount of work done — admissions + sequences decoded (0 means
-        the boundary was a no-op: nothing admissible, nothing active)."""
+        """One scheduling boundary: admit queued requests (one packed
+        chunk dispatch when chunked, else up to ``prefills_per_step``
+        single-prompt prefills), then run one joined decode step.
+        Returns the amount of work done — admissions + prefill rows +
+        sequences decoded (0 means the boundary was a no-op)."""
+        if self.engine.prefill_chunk:
+            admitted = self._admit_chunked()
+        else:
+            admitted = self._admit_serial()
+        if not self.active:
+            return admitted
+        before = set(self.active)
+        self._decode_active(self.active)
+        for slot in before - set(self.active):
+            self._free_slots.append(slot)
+        return admitted + len(before)
+
+    def _admit_serial(self):
         admitted = 0
         while (self.queue and self._free_slots
                and admitted < self.prefills_per_step):
@@ -198,25 +237,91 @@ class ContinuousBatcher(_BatcherBase):
                 self._free_slots.append(slot)
             else:
                 self.active[slot] = req
-        if not self.active:
+        return admitted
+
+    def _admit_chunked(self):
+        """Pack one ``chunk_prefill`` dispatch: tail chunks of in-flight
+        prompts first (they hold slots and blocks — finish them), then
+        new admissions through the prefix cache.  Returns admissions +
+        dispatched rows."""
+        eng = self.engine
+        C = eng.prefill_chunk
+        entries, rows = [], {}
+        for slot, st in list(self.prefilling.items()):
+            if len(entries) >= eng.max_batch:
+                break
+            chunk = st.req.tokens[st.done:st.done + C]
+            entries.append((slot, chunk, st.done))
+            rows[slot] = st
+        admitted = 0
+        while (self.queue and self._free_slots
+               and len(entries) < eng.max_batch):
+            req = self.queue[0]
+            if len(req.tokens) - 1 >= eng.max_context:
+                raise MXNetError(
+                    "request cannot be admitted (prompt exceeds "
+                    "max_context)")
+            slot = self._free_slots[-1]
+            start = eng.attach_prefix(slot, req.tokens)
+            if start == 0 and not eng.cache.alloc(slot, 0):
+                break                       # cannot even open a table
+            self.queue.popleft()
+            self._free_slots.pop()
+            st = _PrefillState(req, slot, start)
+            self.prefilling[slot] = st
+            entries.append((slot, req.tokens[start:start + C], start))
+            rows[slot] = st
+            admitted += 1
+        if not entries:
             return admitted
-        before = set(self.active)
-        self._decode_active(self.active)
-        for slot in before - set(self.active):
-            self._free_slots.append(slot)
-        return admitted + len(before)
+        out = eng.chunk_prefill(entries)
+        if out is None and eng.prefix_cache is not None:
+            # pool pressure: evict LRU chains no request still shares
+            # (refcount > 1 blocks survive untouched), then retry once
+            need = sum(
+                max(0, eng.cache.blocks_for(start + len(chunk))
+                    - len(eng.cache.table(slot)))
+                for slot, chunk, start in entries)
+            if eng.prefix_cache.evict(blocks_needed=need):
+                out = eng.chunk_prefill(entries)
+        if out is None:
+            # still starved: in-flight prompts keep their state and
+            # retry next boundary (decode frees blocks as requests end)
+            return admitted
+        nxt, _logits = out
+        for i, (slot, chunk, start) in enumerate(entries):
+            st = rows[slot]
+            st.done = start + len(chunk)
+            if st.done < len(st.req.tokens):
+                continue                    # more chunks to come
+            del self.prefilling[slot]
+            req = st.req
+            req.first_token_t = time.perf_counter()
+            if _telem.enabled() and req.submit_t is not None:
+                _telem.observe("serving.ttft_ms",
+                               (req.first_token_t - req.submit_t) * 1e3)
+            # register the finished prompt BEFORE decode writes past it
+            # (the partial tail block CoW-forks on the first write)
+            eng.insert_prefix(slot, req.tokens)
+            self._append_token(req, slot, int(nxt[i]))
+            if req.done:
+                self._free_slots.append(slot)
+            else:
+                self.active[slot] = req
+        return admitted + len(entries)
 
     def run(self, max_steps=100000):
         """Drive until queue and batch are empty."""
         steps = 0
-        while self.queue or self.active:
+        while self.queue or self.active or self.prefilling:
             moved = self.step()
             steps += 1
             if steps > max_steps:
                 raise MXNetError("run() exceeded max_steps — scheduler "
                                  "wedged (pool too small for any "
                                  "queued request?)")
-            if moved == 0 and self.queue and not self.active:
+            if moved == 0 and not self.active and \
+                    (self.queue or self.prefilling):
                 # a no-op boundary with work still queued: the head
                 # request can never be admitted
                 raise MXNetError(

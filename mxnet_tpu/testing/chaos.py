@@ -39,7 +39,22 @@ with a ``FakeClock`` (zero sleeps):
   rewinds to its step and replays at dp=4 — parity against a fresh
   process restored from that same checkpoint.
 
-``python -m mxnet_tpu.testing.chaos all`` runs both suites.
+``python -m mxnet_tpu.testing.chaos serving`` (or ``tools/
+tpu_queue_runner.py --chaos serving``) runs the SERVING FRONT-END
+scenario instead (ISSUE 12), deterministic on CPU with a FakeClock and
+zero sleeps: a 2-replica ``serving.frontend.Router`` (prefix cache +
+chunked prefill on, shared warmup compile cache) serves a
+shared-system-prompt mix; replica 1 is killed mid-traffic via the
+``serving.replica1.step`` fault point; the router must bump the
+replica-set epoch, drain and REQUEUE the dead replica's in-flight
+requests, and finish every request exactly once with the exact token
+stream a solo cold-path engine produces (greedy decode is
+deterministic and the prefix path is bitwise the cold path).  The kill
+must leave a parseable flight-recorder dump, racecheck must report
+zero findings, and the surviving replica's KV pool must pass the leak
+sweep (prefix-chain holds accounted).
+
+``python -m mxnet_tpu.testing.chaos all`` runs all three suites.
 """
 from __future__ import annotations
 
@@ -494,6 +509,121 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     return result
 
 
+# ----------------------------------------------------------------------
+# Serving front-end scenario (ISSUE 12): kill a router replica
+# mid-traffic; zero lost/duplicated requests, outputs exactly the solo
+# cold-path streams, flight dump + racecheck + KV leak sweep.
+# ----------------------------------------------------------------------
+
+def _serving_net():
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                     LlamaForCausalLM)
+    cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=64, tie_embeddings=True)
+    net = LlamaForCausalLM(cfg)
+    net.initialize()
+    net(mx.nd.array([[1, 2, 3]], dtype="int32"))
+    net.hybridize()
+    return net
+
+
+def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
+                         kill_at_boundary=2, workdir=None):
+    """Kill replica ``kill_rid`` at its ``kill_at_boundary``-th
+    scheduling boundary while ``n_requests`` shared-system-prompt
+    requests are in flight; the router requeues and every request must
+    complete exactly once with the solo cold-path token stream.
+    Deterministic: the router's drive() mode (no threads), FakeClock
+    timestamps, zero sleeps."""
+    from mxnet_tpu.serving import InferenceEngine, Request, Router
+    from mxnet_tpu.testing import faults
+
+    rc = _racecheck_arm()
+    clock = faults.FakeClock(5000.0)
+    net = _serving_net()
+    rng = _np.random.RandomState(12)
+    sys_prompt = rng.randint(0, 64, (12,)).tolist()
+    prompts = [sys_prompt + rng.randint(0, 64, (3 + i % 4,)).tolist()
+               for i in range(n_requests)]
+    result = {"kind": "serving", "replicas": replicas,
+              "requests": n_requests, "kill_rid": kill_rid,
+              "kill_at_boundary": kill_at_boundary}
+
+    # solo cold-path references: one fresh single-replica engine per
+    # prompt, full-prompt prefill, greedy decode — the stream every
+    # routed request must reproduce bit-for-bit
+    ref_eng = InferenceEngine(net, max_batch=2, block_size=8,
+                              max_context=32)
+    ref_eng.warmup()
+    refs = []
+    for p in prompts:
+        tok, _ = ref_eng.prefill(0, p)
+        cur = list(p) + [int(tok)]
+        for _ in range(3):
+            pos = len(cur) - 1
+            assert ref_eng.reserve(0, pos)
+            nxt, _lg = ref_eng.decode([(0, cur[-1], pos)])
+            cur.append(int(nxt[0]))
+        ref_eng.release(0)
+        refs.append(cur[len(p):])
+
+    def factory(compile_cache):
+        return InferenceEngine(net, max_batch=2, block_size=8,
+                               max_context=32, num_blocks=24,
+                               prefill_chunk=8, prefix_cache=True,
+                               compile_cache=compile_cache)
+
+    router = Router(factory, replicas=replicas, now=clock)
+    for rep in router.replicas:
+        rep.engine.pin_prefix(sys_prompt)
+    reqs = [router.submit(Request(p, max_new_tokens=4))
+            for p in prompts]
+    with faults.inject(f"serving.replica{kill_rid}.step",
+                       at=kill_at_boundary):
+        router.drive()
+    fin = router.finished()
+    result["finished"] = len(fin)
+    result["epoch"] = router.epoch
+    result["requeues"] = router.requeues
+    result["no_lost_or_dup"] = (
+        sorted(r.id for r in fin) == sorted(r.id for r in reqs)
+        and len(fin) == len(reqs))
+    result["outputs_match_solo"] = all(
+        r.generated == ref for r, ref in zip(reqs, refs))
+    st = router.stats()
+    result["compiles_after_warmup"] = st["compiles_after_warmup"]
+    result["prefix_hits"] = sum(
+        (pr["prefix"] or {}).get("hits", 0)
+        for pr in st["per_replica"])
+    # the injected kill must have left a parseable flight dump whose
+    # last event is the fault trip (ISSUE 9 discipline)
+    result["flight_dump"] = _flight_check(expect_kind="fault.trip")
+    # KV leak sweep on the survivors: with every request released, only
+    # the prefix-cache chains may still hold blocks
+    leaks_ok = True
+    for rep in router.replicas:
+        if not rep.alive:
+            continue
+        try:
+            rep.engine.cache.check_leaks(
+                holders=rep.engine.prefix_cache.held_blocks())
+        except Exception as e:  # noqa: BLE001 — verdict, not crash
+            leaks_ok = False
+            result["leak_error"] = f"{type(e).__name__}: {e}"
+    result["kv_leaks_clean"] = leaks_ok
+    fd = result["flight_dump"]
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    result["ok"] = bool(
+        result["no_lost_or_dup"] and result["outputs_match_solo"]
+        and result["epoch"] >= 1 and result["requeues"] >= 1
+        and result["compiles_after_warmup"] == 0 and leaks_ok
+        and (fd is None or fd["ok"]) and (rcv is None or rcv["ok"]))
+    return result
+
+
 def main(argv=None):
     # the smoke must run anywhere — force the simulated CPU mesh exactly
     # like tests/conftest.py does
@@ -520,6 +650,8 @@ def main(argv=None):
         if suite in ("elastic", "all"):
             results += [run_elastic_scenario(kind, workdir=workdir)
                         for kind in ("shrink", "grow", "reshard_fault")]
+        if suite in ("serving", "all"):
+            results.append(run_serving_scenario(workdir=workdir))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     ok = bool(results) and all(r["ok"] for r in results)

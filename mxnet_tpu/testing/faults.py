@@ -41,6 +41,10 @@ site                        payload / effect
                             peer-to-peer state transfer; raise -> the
                             transfer dies mid-flight and the controller
                             falls back to the newest valid checkpoint
+``serving.replica<i>.step`` boundary counter of serving-router replica
+                            ``i``; raise -> the replica dies mid-traffic
+                            and the router drains + requeues its
+                            requests (``--chaos serving``)
 ==========================  ===============================================
 """
 from __future__ import annotations

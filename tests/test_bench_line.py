@@ -314,6 +314,9 @@ _SERVING_KEYS = {
     "requests", "p50_ms", "p99_ms", "ttft_p50_ms", "tokens_s",
     "tokens_s_chip", "occupancy", "tokens_per_step",
     "compiles_after_warmup", "cache_utilization",
+    # ISSUE 12 front-end fields
+    "chunked_prefill", "router_replicas", "prefix_hit_rate",
+    "router_p99_ms",
 }
 
 
@@ -324,15 +327,25 @@ def test_serving_block_schema_is_stable():
     # MEASURED fields are null when nothing was measured
     for k in ("p50_ms", "p99_ms", "ttft_p50_ms", "tokens_s",
               "tokens_s_chip", "occupancy", "tokens_per_step",
-              "compiles_after_warmup", "cache_utilization"):
+              "compiles_after_warmup", "cache_utilization",
+              "prefix_hit_rate", "router_p99_ms"):
         assert blk[k] is None, k
+    # CONFIG fields are always real (front-end off by default)
+    assert blk["chunked_prefill"] is False
+    assert blk["router_replicas"] == 0
     # measured values round-trip, rounded
     blk2 = serving_block(p99_ms=12.3456, tokens_s_chip=901.239,
-                         occupancy=0.87654, compiles_after_warmup=0)
+                         occupancy=0.87654, compiles_after_warmup=0,
+                         chunked_prefill=True, router_replicas=4,
+                         prefix_hit_rate=0.98765, router_p99_ms=77.7777)
     assert blk2["p99_ms"] == 12.346
     assert blk2["tokens_s_chip"] == 901.2
     assert blk2["occupancy"] == 0.8765
     assert blk2["compiles_after_warmup"] == 0
+    assert blk2["chunked_prefill"] is True
+    assert blk2["router_replicas"] == 4
+    assert blk2["prefix_hit_rate"] == 0.9877
+    assert blk2["router_p99_ms"] == 77.778
     assert json.loads(json.dumps(blk)) == blk
 
 
@@ -357,11 +370,14 @@ def test_serving_compact_keys_surface_when_measured():
         max_batch=8, block_size=16, buckets=(16, 32, 64),
         requests=32, p50_ms=41.2, p99_ms=88.7, tokens_s=9120.4,
         tokens_s_chip=9120.4, occupancy=0.91, tokens_per_step=7.3,
-        compiles_after_warmup=0)
+        compiles_after_warmup=0, chunked_prefill=True,
+        router_replicas=4, prefix_hit_rate=0.97, router_p99_ms=92.3)
     obj = _assert_headline(bench._compact_line(p))
     assert obj["serve_tok_s"] == 9120.4
     assert obj["serve_p99_ms"] == 88.7
     assert obj["serve_occupancy"] == 0.91
+    assert obj["serve_prefix_hit"] == 0.97
+    assert obj["router_p99_ms"] == 92.3
 
 
 def test_serving_nulls_stay_out_of_headline():
@@ -373,6 +389,8 @@ def test_serving_nulls_stay_out_of_headline():
     assert "serve_tok_s" not in obj
     assert "serve_p99_ms" not in obj
     assert "serve_occupancy" not in obj
+    assert "serve_prefix_hit" not in obj
+    assert "router_p99_ms" not in obj
 
 
 # ----------------------------------------------------------------------

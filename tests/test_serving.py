@@ -317,6 +317,16 @@ def test_full_request_lifecycle_slot_reuse_zero_retraces():
     assert eng.cache.blocks_in_use == 0
     assert eng.stats["compiles_after_warmup"] == 0
     assert stats["occupancy"] > 0
+    # ISSUE 12 hygiene: the refcount sweep balances (no dangling holds),
+    # the in-use gauge went back to zero, and a second release of an
+    # already-freed slot is the typed double-free
+    assert eng.cache.check_leaks()
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.serving import DoubleFreeError
+    if telemetry.enabled():
+        assert telemetry.value("serving.kv_blocks_in_use") == 0
+    with pytest.raises(DoubleFreeError):
+        eng.release(0)
 
 
 def test_continuous_beats_static_on_mixed_lengths():

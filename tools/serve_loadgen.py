@@ -12,6 +12,10 @@ Usage:
   python tools/serve_loadgen.py --smoke           # CPU-sized, tier-1
   python tools/serve_loadgen.py --requests 64 --max-batch 8
   python tools/serve_loadgen.py --mode continuous|static|both
+  python tools/serve_loadgen.py --smoke --replicas 2   # router fleet:
+      shared-system-prompt mix through N replicas (prefix cache +
+      chunked prefill on), reporting prefix hit rate and per-replica
+      occupancy (ISSUE 12)
 """
 from __future__ import annotations
 
@@ -29,6 +33,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 # slots that static batching would leave idle)
 _PROMPT_MIX = (5, 12, 24, 8, 17, 3)
 _NEW_MIX = (4, 12, 6, 16, 3, 9)
+# router mix: every request opens with the SAME system prompt (the
+# millions-of-users shape) — deterministic, so the prefix hit rate and
+# the computed-token savings are exact, CI-gateable quantities
+_SYS_PROMPT_LEN = 12
+_USER_MIX = (5, 9, 3, 7, 4, 11)
 
 
 def _build_net(smoke):
@@ -64,13 +73,100 @@ def _requests(n, vocab, seed=0):
     return out
 
 
+def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
+                       max_context=64, smoke=True, replicas=2, seed=0):
+    """The ISSUE 12 fleet benchmark: a deterministic shared-system-
+    prompt mix through ``replicas`` engine replicas behind one Router
+    (prefix cache + chunked prefill on, shared warmup compile cache,
+    deterministic drive).  Returns the bench `serving` payload with the
+    front-end fields measured: prefix hit rate, per-replica occupancy,
+    router p50/p99."""
+    import numpy as np
+    from mxnet_tpu.serving import InferenceEngine, Request, Router, \
+        serving_block
+    net, cfg = _build_net(smoke)
+    rng = np.random.RandomState(seed)
+    sys_prompt = rng.randint(0, cfg.vocab_size,
+                             (_SYS_PROMPT_LEN,)).tolist()
+
+    def factory(compile_cache):
+        return InferenceEngine(net, max_batch=max_batch,
+                               block_size=block_size,
+                               max_context=max_context,
+                               prefill_chunk=2 * block_size,
+                               prefix_cache=True,
+                               compile_cache=compile_cache)
+
+    router = Router(factory, replicas=replicas)
+    for rep in router.replicas:
+        rep.engine.pin_prefix(sys_prompt)
+    reqs = []
+    for i in range(n_requests):
+        user = rng.randint(0, cfg.vocab_size,
+                           (_USER_MIX[i % len(_USER_MIX)],)).tolist()
+        reqs.append(Request(sys_prompt + user,
+                            _NEW_MIX[i % len(_NEW_MIX)]))
+    t0 = time.perf_counter()
+    for req in reqs:
+        router.submit(req)
+    router.drive()
+    wall = time.perf_counter() - t0
+    st = router.stats()
+    tokens = sum(len(r.generated) for r in router.finished())
+    prefix_hits = 0
+    prefix_lookups = 0
+    hit_tokens = 0
+    computed = 0
+    for rep in router.replicas:
+        pc = rep.engine.prefix_cache
+        prefix_hits += pc.hits
+        prefix_lookups += pc.lookups
+        hit_tokens += pc.hit_tokens
+        computed += rep.engine.stats["prompt_tokens_computed"]
+    hit_rate = prefix_hits / prefix_lookups if prefix_lookups else None
+    blk = serving_block(
+        max_batch=max_batch, block_size=block_size,
+        buckets=_buckets(block_size, max_context),
+        continuous=True, requests=st["requests"],
+        p50_ms=_ms(st["p50_latency_s"]), p99_ms=_ms(st["p99_latency_s"]),
+        tokens_s=(round(tokens / wall, 1) if wall > 0 else None),
+        tokens_s_chip=(round(tokens / wall / replicas, 1)
+                       if wall > 0 else None),
+        occupancy=(sum(o) / len(o) if (o := [
+            r["occupancy"] for r in st["per_replica"]
+            if r["occupancy"] is not None]) else None),
+        compiles_after_warmup=st["compiles_after_warmup"],
+        chunked_prefill=True, router_replicas=replicas,
+        prefix_hit_rate=hit_rate, router_p99_ms=_ms(st["p99_latency_s"]))
+    return {"metric": "serve_loadgen", "mode": "router",
+            "smoke": bool(smoke), "serving": blk,
+            "router": {
+                "epoch": st["epoch"], "requeues": st["requeues"],
+                "prompt_tokens_computed": computed,
+                "prefix_hit_tokens": hit_tokens,
+                "warmup_compiles_shared":
+                    router.warmup_compiles_shared,
+                "per_replica": [
+                    {"rid": r["rid"], "requests": r["requests"],
+                     "occupancy": r["occupancy"]}
+                    for r in st["per_replica"]],
+            }}
+
+
 def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
-                mode="both", smoke=True, quantize=None, seed=0):
+                mode="both", smoke=True, quantize=None, seed=0,
+                replicas=0):
     """Run the mix through the chosen scheduling policy(ies); returns
-    the bench `serving` payload."""
+    the bench `serving` payload.  ``replicas >= 1`` switches to the
+    router fleet benchmark (:func:`run_router_loadgen`)."""
     from mxnet_tpu import telemetry
     from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
                                    StaticBatcher, serving_block)
+    if replicas:
+        return run_router_loadgen(
+            n_requests=n_requests, max_batch=max_batch,
+            block_size=block_size, max_context=max_context,
+            smoke=smoke, replicas=replicas, seed=seed)
     results = {}
     for policy in (("continuous", "static") if mode == "both"
                    else (mode,)):
@@ -190,6 +286,10 @@ def main(argv=None):
                     default="both")
     ap.add_argument("--int8", action="store_true",
                     help="serve int8-quantized weights")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="N>=1: router fleet benchmark with a shared-"
+                         "system-prompt mix (prefix cache + chunked "
+                         "prefill); 0 = single-engine policy comparison")
     args = ap.parse_args(argv)
     smoke = args.smoke
     n = args.requests if args.requests is not None else (12 if smoke
@@ -199,7 +299,8 @@ def main(argv=None):
         block_size=args.block_size or (8 if smoke else 16),
         max_context=args.max_context or (64 if smoke else 512),
         mode=args.mode, smoke=smoke,
-        quantize="int8" if args.int8 else None)
+        quantize="int8" if args.int8 else None,
+        replicas=args.replicas)
     out = json.dumps(payload)
     if len(out) > 1800:      # the driver tail-window contract
         slim = dict(payload)
