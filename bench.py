@@ -787,10 +787,15 @@ def _bench_elastic() -> dict:
     if telemetry.enabled():
         # thin-reader discipline (ISSUE 9): the measured transition
         # fields come off the same registry a live scrape sees — the
-        # controller published them during resync
+        # controller published them during resync; the ISSUE 13 fields
+        # (drain_ms, autoscale_decisions) stay null unless a notice
+        # drain / autoscale loop actually ran
         for field, metric in (("reshard_ms", "elastic.reshard_ms"),
                               ("pause_ms", "elastic.pause_ms"),
-                              ("membership_epoch", "elastic.epoch")):
+                              ("membership_epoch", "elastic.epoch"),
+                              ("drain_ms", "elastic.drain_ms"),
+                              ("autoscale_decisions",
+                               "autoscale.decisions")):
             v = telemetry.value(metric)
             if v is not None:
                 blk[field] = v

@@ -1,0 +1,387 @@
+"""Load-based autoscaling over the telemetry spine (ISSUE 13 tentpole,
+second half).
+
+PR 8 scales on FAILURE (a death shrinks dp); this module scales on
+LOAD: a control loop watches the PR 9 registry signals —
+``train.step_ms``, io prefetch-queue occupancy, the serving router's
+``serving.replica<i>.queue_depth`` / ``ttft_ms`` /
+``kv_block_utilization`` gauges — through **hysteresis windows** and
+issues deliberate grow/shrink decisions:
+
+- **training dp** rescales through the existing epoch-fenced
+  ``ElasticController.resync`` (``request_dp``: same pause-at-boundary
+  → ``reshard_in_place`` → resume machinery as a membership change, so
+  the transition stays bitwise and tp/pp are preserved per ISSUE 11);
+- **serving replicas** are added/removed through the Router's
+  epoch-fenced replica set (``add_replica`` / ``drain_replica`` — a
+  removed replica's requests requeue to the survivors, zero lost).
+
+Control discipline (the 2011.03641 lesson: oscillating capacity is
+worse than fixed capacity):
+
+- a rule fires only after its signal stays past the threshold for the
+  whole ``window_s`` (hysteresis — one hot step never triggers);
+- a **cooldown** (``MXTPU_AUTOSCALE_COOLDOWN_S``) separates decisions
+  per domain, so a reshard's own cost cannot trigger the next reshard;
+- hard min/max bounds clamp every target;
+- ``MXTPU_AUTOSCALE=0`` is a kill switch: ``tick()`` returns None
+  without reading a signal — behavior is bitwise today's.
+
+Everything reads the injectable ``now`` clock (FakeClock in tests,
+zero sleeps) and the loop itself is pull-based: callers tick it at
+step/scheduling boundaries (``estimator.fit(autoscaler=...)`` does).
+
+:class:`DegradationLadder` is the declared what-when-capacity-is-lost
+policy: shed serving admissions → run shrunken toward
+``MXTPU_ELASTIC_MIN_DP`` → checkpoint-and-stop with ``.preempted``
+(the PR 4 contract).  Every rung transition is a telemetry event.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..base import MXNetError
+from .. import telemetry as _telem
+
+__all__ = ["ScalingRule", "ScalingPolicy", "Autoscaler",
+           "DegradationLadder", "autoscale_enabled",
+           "default_cooldown_s"]
+
+
+def autoscale_enabled():
+    """Kill switch: ``MXTPU_AUTOSCALE=0`` makes every ``tick()`` a
+    no-op — no signal reads, no decisions, bitwise today's behavior.
+    Default on: constructing an Autoscaler IS the opt-in (the
+    ``MXTPU_ELASTIC`` discipline)."""
+    return os.environ.get("MXTPU_AUTOSCALE", "1") != "0"
+
+
+def default_cooldown_s():
+    """Seconds between decisions per domain
+    (``MXTPU_AUTOSCALE_COOLDOWN_S``, default 60): a reshard/warmup must
+    never be able to trigger the next one."""
+    return float(os.environ.get("MXTPU_AUTOSCALE_COOLDOWN_S", "60") or 60)
+
+
+class ScalingRule:
+    """One watched signal with a hysteresis window.
+
+    ``signal``: registry metric name (for serving signals the
+    Autoscaler aggregates ``serving.replica<i>.<suffix>`` with max —
+    pass e.g. ``"serving.queue_depth"``).  ``high``/``low``: breach
+    thresholds (either may be None for one-sided rules).  ``domain``:
+    ``"train"`` (dp) or ``"serving"`` (replicas).  The verdict is
+    ``"grow"`` only after the value stays ``> high`` for ``window_s``
+    continuous seconds, ``"shrink"`` after ``< low`` for the same —
+    one spike never moves capacity."""
+
+    def __init__(self, signal, high=None, low=None, domain="train",
+                 window_s=30.0):
+        if domain not in ("train", "serving"):
+            raise MXNetError(f"ScalingRule domain {domain!r}: expected "
+                             f"'train' or 'serving'")
+        if high is None and low is None:
+            raise MXNetError(f"ScalingRule {signal!r}: need high and/or "
+                             f"low threshold")
+        self.signal = str(signal)
+        self.high = None if high is None else float(high)
+        self.low = None if low is None else float(low)
+        self.domain = domain
+        self.window_s = float(window_s)
+        self._high_since = None
+        self._low_since = None
+
+    def update(self, value, now):
+        """Feed one observation; returns "grow"/"shrink" when the
+        hysteresis window completes, else None."""
+        if value is None:
+            return None
+        v = float(value)
+        if self.high is not None and v > self.high:
+            if self._high_since is None:
+                self._high_since = now
+        else:
+            self._high_since = None
+        if self.low is not None and v < self.low:
+            if self._low_since is None:
+                self._low_since = now
+        else:
+            self._low_since = None
+        if self._high_since is not None and \
+                now - self._high_since >= self.window_s:
+            return "grow"
+        if self._low_since is not None and \
+                now - self._low_since >= self.window_s:
+            return "shrink"
+        return None
+
+    def reset(self):
+        self._high_since = None
+        self._low_since = None
+
+
+class ScalingPolicy:
+    """A rule set plus the bounds every decision is clamped to."""
+
+    def __init__(self, rules, cooldown_s=None, min_dp=None, max_dp=None,
+                 min_replicas=1, max_replicas=None):
+        self.rules = list(rules)
+        self.cooldown_s = (default_cooldown_s() if cooldown_s is None
+                           else float(cooldown_s))
+        from .controller import min_dp as _env_min_dp
+        self.min_dp = _env_min_dp() if min_dp is None else int(min_dp)
+        self.max_dp = None if max_dp is None else int(max_dp)
+        self.min_replicas = int(min_replicas)
+        self.max_replicas = None if max_replicas is None \
+            else int(max_replicas)
+
+    def evaluate(self, signals, now):
+        """Feed the rules; returns {domain: "grow"|"shrink"} — grow
+        wins over shrink within a domain (capacity pressure trumps
+        idleness when both signals somehow coexist)."""
+        verdicts = {}
+        for rule in self.rules:
+            v = rule.update(signals.get(rule.signal), now)
+            if v is None:
+                continue
+            prev = verdicts.get(rule.domain)
+            if prev != "grow":
+                verdicts[rule.domain] = v if prev is None else (
+                    "grow" if "grow" in (prev, v) else v)
+        return verdicts
+
+
+class Autoscaler:
+    """The control loop: tick at boundaries, read signals, decide,
+    apply through the epoch-fenced seams.
+
+    ``controller``: an :class:`~mxnet_tpu.elastic.ElasticController`
+    (training dp domain); ``router``: a
+    :class:`~mxnet_tpu.serving.frontend.Router` (serving domain).
+    Either may be None.  ``now`` is the injectable clock; ``signals``
+    may be passed to :meth:`tick` explicitly (tests/chaos) or are read
+    off the telemetry registry.
+    """
+
+    def __init__(self, policy, controller=None, router=None, now=None):
+        self._policy = policy
+        self._controller = controller
+        self._router = router
+        self._now = now if now is not None else time.time
+        self._enabled = autoscale_enabled()   # read ONCE at construction
+        self._last_decision_t = {}            # domain -> time
+        self.decisions = []
+        self.skipped = {"cooldown": 0, "bounds": 0, "capacity": 0}
+
+    @property
+    def enabled(self):
+        return self._enabled
+
+    # -- signal plumbing -------------------------------------------------
+    def _serving_signal(self, suffix):
+        """Max over the live replicas' published per-replica gauges
+        (the fleet is as loaded as its hottest replica), falling back
+        to direct reads when the registry is off."""
+        if self._router is None:
+            return None
+        vals = []
+        for rep in self._router.live_replicas():
+            v = _telem.value(f"serving.replica{rep.rid}.{suffix}")
+            if v is None:
+                v = rep.load_signals().get(suffix)
+            if v is not None:
+                vals.append(float(v))
+        return max(vals) if vals else None
+
+    def read_signals(self):
+        """The registry view of every rule's signal (None when a
+        signal has not been published — rules skip None)."""
+        out = {}
+        for rule in self._policy.rules:
+            if rule.signal in out:
+                continue
+            if rule.signal.startswith("serving.") and \
+                    _telem.value(rule.signal) is None:
+                out[rule.signal] = self._serving_signal(
+                    rule.signal[len("serving."):])
+            else:
+                out[rule.signal] = _telem.value(rule.signal)
+        return out
+
+    # -- current sizes ---------------------------------------------------
+    def _current_dp(self):
+        c = self._controller
+        if c is None:
+            return None
+        return c.applied_dp if c.applied_dp is not None \
+            else c.target_dp(include_pending=False)
+
+    def _dp_capacity(self):
+        return self._controller.target_dp(include_pending=True)
+
+    # -- the loop body ---------------------------------------------------
+    def tick(self, signals=None, step=None):
+        """One control-loop pass (call at a step/scheduling boundary).
+        Returns the list of decisions issued this tick (possibly
+        empty), or None when the kill switch is on."""
+        if not self._enabled:
+            return None
+        now = self._now()
+        if signals is None:
+            signals = self.read_signals()
+        verdicts = self._policy.evaluate(signals, now)
+        issued = []
+        for domain, verdict in sorted(verdicts.items()):
+            last = self._last_decision_t.get(domain)
+            if last is not None and now - last < self._policy.cooldown_s:
+                self.skipped["cooldown"] += 1
+                continue
+            d = (self._apply_train(verdict, signals, now, step)
+                 if domain == "train"
+                 else self._apply_serving(verdict, signals, now, step))
+            if d is not None:
+                self._last_decision_t[domain] = now
+                issued.append(d)
+        return issued
+
+    def _record(self, decision):
+        self.decisions.append(decision)
+        _telem.inc("autoscale.decisions")
+        _telem.event("autoscale.decision", **{
+            k: v for k, v in decision.items() if k != "signals"})
+        return decision
+
+    def _apply_train(self, verdict, signals, now, step):
+        if self._controller is None:
+            return None
+        cur = self._current_dp()
+        if cur is None:
+            return None
+        if verdict == "grow":
+            target = min(cur * 2, self._dp_capacity())
+            if self._policy.max_dp is not None:
+                target = min(target, self._policy.max_dp)
+            if target <= cur:
+                self.skipped["capacity" if self._dp_capacity() <= cur
+                             else "bounds"] += 1
+                return None
+        else:
+            target = max(cur // 2, self._policy.min_dp)
+            if target >= cur:
+                self.skipped["bounds"] += 1
+                return None
+        self._controller.request_dp(target)
+        _telem.set_gauge("autoscale.dp_target", target)
+        return self._record({"t": now, "domain": "train",
+                             "verdict": verdict, "from": cur,
+                             "to": target, "step": step,
+                             "signals": dict(signals)})
+
+    def _apply_serving(self, verdict, signals, now, step):
+        if self._router is None:
+            return None
+        live = self._router.live_replicas()
+        cur = len(live)
+        if verdict == "grow":
+            if self._policy.max_replicas is not None and \
+                    cur + 1 > self._policy.max_replicas:
+                self.skipped["bounds"] += 1
+                return None
+            rep = self._router.add_replica()
+            to = rep.rid
+        else:
+            if cur - 1 < self._policy.min_replicas:
+                self.skipped["bounds"] += 1
+                return None
+            # drain the highest-rid live replica: the newest capacity
+            # leaves first (LIFO keeps replica 0's warm caches longest)
+            victim = max(live, key=lambda r: r.rid)
+            self._router.drain_replica(victim.rid, reason="autoscale")
+            to = victim.rid
+        return self._record({"t": now, "domain": "serving",
+                             "verdict": verdict, "from": cur,
+                             "to": cur + (1 if verdict == "grow" else -1),
+                             "rid": to, "step": step,
+                             "signals": dict(signals)})
+
+    def stats(self):
+        return {"enabled": self._enabled,
+                "decisions": len(self.decisions),
+                "skipped": dict(self.skipped),
+                "last": self.decisions[-1] if self.decisions else None}
+
+
+class DegradationLadder:
+    """The declared graceful-degradation policy for capacity loss,
+    walked rung by rung as notices/deaths eat the fleet:
+
+    ======  ==========================  =================================
+    rung    trigger                     action
+    ======  ==========================  =================================
+    1       capacity < healthy target   **shed serving admissions**
+                                        (``Router.set_shedding(True)`` —
+                                        new submits get a typed
+                                        ``AdmissionShed``; in-flight and
+                                        requeued work is untouched)
+    2       (implicit)                  **run shrunken**: the controller
+                                        reshards dp toward
+                                        ``MXTPU_ELASTIC_MIN_DP`` and
+                                        training continues
+    3       capacity < min_dp floor     **checkpoint-and-stop**: request
+                                        a preemption (PR 4 contract —
+                                        sync checkpoint, ``.preempted``)
+                                        instead of limping or raising
+    ======  ==========================  =================================
+
+    Capacity recovering to the healthy target un-sheds (rung 0).  Every
+    transition is a telemetry event (``degrade.*``)."""
+
+    def __init__(self, router=None, stop=None, now=None):
+        self._router = router
+        self._stop = stop
+        self._now = now if now is not None else time.time
+        self.level = 0
+        self.transitions = []
+
+    def _log(self, kind, **data):
+        rec = dict(data, kind=kind, t=self._now(), level=self.level)
+        self.transitions.append(rec)
+        _telem.event(f"degrade.{kind}", **data)
+        _telem.set_gauge("degrade.level", self.level)
+        return rec
+
+    def assess(self, capacity_dp, healthy_dp, floor_dp):
+        """Called by the controller on every capacity change.  Returns
+        "ok" | "shed" | "stop"."""
+        capacity_dp = int(capacity_dp)
+        if capacity_dp < int(floor_dp):
+            self.level = 3
+            self._log("stop", capacity_dp=capacity_dp, floor=floor_dp)
+            if self._stop is not None:
+                self._stop(f"elastic capacity dp={capacity_dp} below "
+                           f"floor {floor_dp}")
+                return "stop"
+            from ..checkpoint import PreemptionHandler
+            handler = PreemptionHandler.installed()
+            if handler is not None:
+                handler.request(
+                    reason=f"degradation ladder: capacity dp="
+                           f"{capacity_dp} below MXTPU_ELASTIC_MIN_DP="
+                           f"{floor_dp} — checkpoint and stop")
+                return "stop"
+            return "stop-unhandled"
+        if capacity_dp < int(healthy_dp):
+            if self.level < 1:
+                self.level = max(self.level, 1)
+                self._log("shed", capacity_dp=capacity_dp,
+                          healthy=healthy_dp)
+            if self._router is not None:
+                self._router.set_shedding(True, reason="degraded")
+            return "shed"
+        if self.level != 0:
+            self.level = 0
+            self._log("recovered", capacity_dp=capacity_dp)
+            if self._router is not None:
+                self._router.set_shedding(False, reason="recovered")
+        return "ok"

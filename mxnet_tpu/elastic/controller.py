@@ -40,6 +40,7 @@ import time
 from ..base import MXNetError
 from .. import telemetry as _telem
 from .membership import Membership  # noqa: F401  (re-exported surface)
+from .notices import DrainDeadline
 
 __all__ = ["ElasticController", "elastic_enabled", "min_dp"]
 
@@ -80,7 +81,8 @@ class ElasticController:
     def __init__(self, membership, devices=None, devices_per_worker=None,
                  checkpoint_manager=None, net=None, kvstore=None,
                  scheduler=None, min_dp=None, max_retries=2,
-                 backoff_s=0.5, now=None, sleep=None):
+                 backoff_s=0.5, now=None, sleep=None, notices=None,
+                 ladder=None, drain_checkpoint=None):
         import jax
         self._membership = membership
         self._devices = list(devices) if devices is not None \
@@ -100,11 +102,23 @@ class ElasticController:
         self._sleep = sleep if sleep is not None else time.sleep
         self._enabled = elastic_enabled()   # read ONCE at construction
         self._applied_epoch = membership.epoch
+        # ISSUE 13: preemption notices, load-based rescale requests,
+        # and the graceful-degradation ladder
+        self._notices = notices
+        self._ladder = ladder
+        self._requested_dp = None     # autoscaler target (one-shot)
+        self._applied_dp = None       # dp the trainer was last built for
+        self._healthy_dp = self.target_dp(include_pending=False)
+        #: callable(step) run sync BEFORE a notice-driven drain commits
+        #: (checkpoint-then-reshard; estimator.fit wires its own saver)
+        self.drain_checkpoint = drain_checkpoint
         # observability (the bench `elastic` block + tests)
         self.transitions = 0
+        self.drains = 0
         self.degraded = False
         self.last_pause_ms = None
         self.last_reshard_ms = None
+        self.last_drain_ms = None
         self.last_event = None
 
     # -- wiring ---------------------------------------------------------
@@ -116,14 +130,53 @@ class ElasticController:
         self._kvstore = kvstore
         return self
 
+    def attach_notices(self, board):
+        """Wire a :class:`~mxnet_tpu.elastic.NoticeBoard`: pending
+        notices are drained at step boundaries AHEAD of the heartbeat
+        timeout (``check_step`` commits ``worker_dead`` the moment a
+        noticed rank is seen at a boundary, instead of waiting for
+        ``PSServer._scan_dead``)."""
+        self._notices = board
+        return self
+
+    def attach_ladder(self, ladder):
+        """Wire a :class:`~mxnet_tpu.elastic.DegradationLadder`: on
+        every capacity change the ladder sheds/recovers serving
+        admissions, and a drop below the ``MXTPU_ELASTIC_MIN_DP`` floor
+        walks rung 3 (checkpoint-and-stop via the PR 4 preemption
+        contract) instead of raising."""
+        self._ladder = ladder
+        return self
+
     @property
     def membership(self):
         return self._membership
 
     @property
+    def notices(self):
+        return self._notices
+
+    @property
     def applied_epoch(self):
         """The membership epoch the running trainer was last built for."""
         return self._applied_epoch
+
+    @property
+    def applied_dp(self):
+        """The dp the trainer was last rebuilt for (None before the
+        first transition — the construction-time mesh is the trainer's
+        business)."""
+        return self._applied_dp
+
+    def request_dp(self, n):
+        """ISSUE 13: a deliberate, load-based dp target (the
+        autoscaler's seam).  Applied at the next step boundary through
+        the SAME epoch-fenced ``resync`` as a membership change —
+        bitwise reshard, tp/pp preserved.  The target is clamped to
+        [min_dp, membership capacity]; returns the clamped value."""
+        cap = self.target_dp(include_pending=True)
+        self._requested_dp = max(self._min_dp, min(int(n), cap))
+        return self._requested_dp
 
     def target_dp(self, include_pending=True):
         """The dp size the current membership implies: ranks (plus an
@@ -145,7 +198,56 @@ class ElasticController:
         if self._membership.poll() is not None:
             self.degraded = True       # rendezvous expired: continue small
         return (self._membership.epoch != self._applied_epoch
-                or self._membership.pending_join is not None)
+                or self._membership.pending_join is not None
+                or self._requested_dp is not None)
+
+    def _check_notices(self, step):
+        """ISSUE 13: drain every pending preemption notice at this
+        boundary — commit ``worker_dead`` for the doomed rank NOW,
+        ahead of the heartbeat timeout, optionally checkpointing first
+        (``drain_checkpoint``).  A notice whose grace window already
+        lapsed raises the typed :class:`DrainDeadline` instead of
+        silently degrading to the heartbeat path.  Returns the number
+        of drains committed."""
+        board = self._notices
+        if board is None:
+            return 0
+        board.poll()
+        pending = board.pending()
+        _telem.set_gauge("elastic.pending_notices", len(pending))
+        drained = 0
+        for notice in pending:
+            if notice.rank not in self._membership.ranks:
+                # unknown or already-departed rank: nothing to drain
+                board.mark_drained(notice)
+                continue
+            now = board.now()
+            if notice.deadline is not None and now > notice.deadline:
+                board.mark_expired(notice)
+                raise DrainDeadline(
+                    f"preemption notice for rank {notice.rank} "
+                    f"({notice.kind}) expired {now - notice.deadline:.1f}s "
+                    f"before this step boundary could drain it — the "
+                    f"worker may already be gone and the heartbeat path "
+                    f"will commit the death late; take the emergency "
+                    f"exit (sync checkpoint + stop) now", notice=notice)
+            t0 = time.perf_counter()
+            if self.drain_checkpoint is not None and step is not None:
+                # checkpoint-THEN-reshard: the drain leaves a durable
+                # boundary before the membership moves
+                self.drain_checkpoint(int(step))
+            self._membership.worker_dead(notice.rank)
+            board.mark_drained(notice)
+            self.drains += 1
+            self.last_drain_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            drained += 1
+            if _telem.enabled():
+                _telem.inc("elastic.drains")
+                _telem.set_gauge("elastic.drain_ms", self.last_drain_ms)
+                _telem.event("elastic.drain", rank=notice.rank,
+                             notice=notice.kind,
+                             step=None if step is None else int(step))
+        return drained
 
     def check_step(self, step, trainer, params=None):
         """The pause seam (same contract as
@@ -156,18 +258,67 @@ class ElasticController:
         ``{"source": "peer", "step": None}`` (continue at the same
         step) or ``{"source": "checkpoint", "step": S}`` (rewind to S;
         the RNG came back with the checkpoint, so the replay is
-        bitwise)."""
+        bitwise).  With a :class:`NoticeBoard` attached the boundary
+        first drains noticed ranks (death committed AHEAD of the
+        heartbeat timeout; ``elastic.pending_notices`` gauge published;
+        lapsed grace raises :class:`DrainDeadline`)."""
+        if not self._enabled:
+            return None
+        self._check_notices(step)
         if not self.pending():
             return None
         return self.resync(step, trainer, params=params)
 
     # -- the transition -------------------------------------------------
     def resync(self, step, trainer, params=None):
-        """Apply the pending membership transition to ``trainer``."""
+        """Apply the pending membership transition (or a load-based
+        ``request_dp`` target) to ``trainer``."""
         from .. import checkpoint as _ckpt
+        from ..parallel.mesh import AXIS_DP as _AXIS_DP
         t_pause = time.perf_counter()
         joiner = self._membership.pending_join
-        new_dp = self.target_dp()
+        capacity = self.target_dp()
+        new_dp = capacity if self._requested_dp is None \
+            else max(1, min(self._requested_dp, capacity))
+        same_membership = (self._membership.epoch == self._applied_epoch
+                           and joiner is None)
+        if same_membership and self._requested_dp is not None:
+            # load-based rescale only: skip the reshard when the trainer
+            # already runs at the requested dp (no-op transition)
+            try:
+                cur = int(dict(trainer.mesh.shape).get(_AXIS_DP, 0))
+            except (AttributeError, TypeError):
+                cur = 0
+            if cur == new_dp:
+                self._requested_dp = None
+                return None
+        if self._ladder is not None:
+            outcome = self._ladder.assess(capacity, self._healthy_dp,
+                                          self._min_dp)
+            if outcome in ("stop", "stop-unhandled"):
+                # rung 3: capacity below the floor.  The ladder already
+                # requested the PR 4 preemption exit (sync checkpoint +
+                # clean stop at the caller's boundary); do NOT reshard
+                # below the floor, and do not raise when someone is
+                # handling the stop.
+                self.degraded = True
+                self._requested_dp = None
+                self._applied_epoch = self._membership.epoch
+                info = {"source": "stop", "step": None, "dp": capacity,
+                        "epoch": self._applied_epoch}
+                self.last_event = info
+                _telem.event("elastic.capacity_stop", dp=capacity,
+                             floor=self._min_dp)
+                if outcome == "stop-unhandled":
+                    raise MXNetError(
+                        f"elastic: membership epoch "
+                        f"{self._membership.epoch} implies dp="
+                        f"{capacity}, below the MXTPU_ELASTIC_MIN_DP="
+                        f"{self._min_dp} floor, and no PreemptionHandler"
+                        f"/stop hook is installed to take the "
+                        f"checkpoint-and-stop exit — restore capacity "
+                        f"or lower the floor")
+                return info
         if new_dp < self._min_dp:
             raise MXNetError(
                 f"elastic: membership epoch {self._membership.epoch} "
@@ -207,6 +358,13 @@ class ElasticController:
             # state transfer done: commit the join (epoch bump)
             self._membership.confirm_join(joiner)
         self._applied_epoch = self._membership.epoch
+        self._applied_dp = new_dp
+        self._requested_dp = None
+        if self._ladder is not None:
+            # post-transition reassessment: capacity back at the healthy
+            # target un-sheds serving admissions (rung 0)
+            self._ladder.assess(self.target_dp(include_pending=False),
+                                self._healthy_dp, self._min_dp)
         if self._kvstore is not None:
             self._kvstore.refresh_membership()
         if self._scheduler is not None:
@@ -259,4 +417,8 @@ class ElasticController:
                 "transitions": self.transitions,
                 "degraded": self.degraded,
                 "reshard_ms": self.last_reshard_ms,
-                "pause_ms": self.last_pause_ms}
+                "pause_ms": self.last_pause_ms,
+                "drain_ms": self.last_drain_ms,
+                "drains": self.drains,
+                "pending_notices": (len(self._notices.pending())
+                                    if self._notices is not None else 0)}

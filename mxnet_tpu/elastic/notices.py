@@ -1,0 +1,401 @@
+"""Preemption-notice plumbing (ISSUE 13 tentpole, first half).
+
+PR 8 reacts to worker deaths *after the fact*: heartbeat silence past
+``MXTPU_PS_HEARTBEAT_TIMEOUT`` is the first signal, and by then the
+victim may already have died mid-collective.  Real platforms announce
+most deaths IN ADVANCE — GCE publishes maintenance events on the
+instance metadata server, and a preemption delivers SIGTERM with a
+grace window before SIGKILL.  This module turns those advance signals
+into first-class membership input:
+
+- a :class:`Notice` names a doomed rank, why, and the absolute deadline
+  its grace window expires at;
+- the :class:`NoticeBoard` is the process-wide ledger the elastic
+  controller and the serving router read at their boundaries: a posted
+  notice triggers an orderly **drain** (checkpoint-then-reshard for
+  training, requeue-to-survivors for serving) *ahead of* the heartbeat
+  timeout; a revoked notice (maintenance cancelled) cancels a pending
+  drain before it commits;
+- pluggable :class:`NoticeSource`\\ s feed the board:
+  :class:`GCENoticeSource` polls the metadata server,
+  :class:`SignalNoticeSource` converts SIGTERM into a graced notice,
+  and :class:`FakeNoticeSource` scripts notices deterministically for
+  tests and chaos scenarios (zero sleeps, FakeClock timestamps).
+
+Every posted notice is an *incident*: it lands in the telemetry event
+log and triggers a flight-recorder dump (``reason="notice:..."``) so a
+preempted job always leaves a post-mortem, even when the drain itself
+then succeeds.  :class:`DrainDeadline` is the typed failure for the
+case PR 8 silently degraded: a notice whose grace window lapsed before
+the next step boundary could drain it (the heartbeat path will still
+catch the death — but late, and the caller deserves to know NOW).
+
+TensorFlow's dynamic cluster membership (arXiv:1605.08695) treats
+exactly this — deliberate, signal-driven membership change — as what
+separates a production system from a demo.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from ..base import MXNetError
+from ..lint import racecheck as _racecheck
+from .. import telemetry as _telem
+
+__all__ = ["Notice", "NoticeBoard", "NoticeSource", "FakeNoticeSource",
+           "SignalNoticeSource", "GCENoticeSource", "DrainDeadline",
+           "make_notice_source", "default_notice_grace_s"]
+
+
+class DrainDeadline(MXNetError):
+    """A preemption notice's grace window expired before a step boundary
+    could drain it.  The heartbeat path will still commit the death —
+    late, mid-collective — but the caller is told NOW so it can take
+    the emergency exit (sync checkpoint + clean stop) instead of
+    limping into the timeout."""
+
+    def __init__(self, msg, notice=None):
+        super().__init__(msg)
+        self.notice = notice
+
+
+def default_notice_grace_s():
+    """Grace window assumed for sources that do not carry one
+    (``MXTPU_NOTICE_GRACE_S``, default 30 — the GCE preemption grace)."""
+    return float(os.environ.get("MXTPU_NOTICE_GRACE_S", "30") or 30)
+
+
+class Notice:
+    """One advance warning: ``rank`` is doomed, ``deadline`` (absolute,
+    board clock) is when the grace window runs out.  ``kind`` in
+    {"preempt", "maintenance", "sigterm"} by convention — free-form."""
+
+    __slots__ = ("rank", "kind", "grace_s", "posted_at", "deadline",
+                 "source")
+
+    def __init__(self, rank, kind, grace_s, posted_at, source="api"):
+        self.rank = int(rank)
+        self.kind = str(kind)
+        self.grace_s = None if grace_s is None else float(grace_s)
+        self.posted_at = float(posted_at)
+        self.deadline = (None if self.grace_s is None
+                         else self.posted_at + self.grace_s)
+        self.source = str(source)
+
+    def view(self):
+        return {"rank": self.rank, "kind": self.kind,
+                "grace_s": self.grace_s, "posted_at": self.posted_at,
+                "deadline": self.deadline, "source": self.source}
+
+    def __repr__(self):
+        return (f"Notice(rank={self.rank}, kind={self.kind!r}, "
+                f"deadline={self.deadline})")
+
+
+class NoticeBoard:
+    """The process-wide notice ledger.  Thread-safe: signal handlers,
+    metadata pollers, the PS serve threads and the training thread may
+    all touch it.  ``now`` is the injectable clock deadlines are
+    measured against (``testing.faults.FakeClock`` in tests — the PR 4
+    discipline; zero sleeps anywhere).
+    """
+
+    def __init__(self, now=None):
+        self._lock = _racecheck.make_lock("NoticeBoard._lock")
+        self._now = now if now is not None else time.time
+        self._pending = {}        # guarded-by: _lock — rank -> Notice
+        self._sources = []        # guarded-by: _lock
+        self.posted = 0           # guarded-by: _lock — lifetime counters
+        self.revoked = 0          # guarded-by: _lock
+        self.expired = 0          # guarded-by: _lock
+        self.drained = 0          # guarded-by: _lock
+
+    def now(self):
+        return self._now()
+
+    # -- sources --------------------------------------------------------
+    def attach_source(self, source):
+        """Register a :class:`NoticeSource`; :meth:`poll` pulls it."""
+        with self._lock:
+            self._sources.append(source)
+        attach = getattr(source, "attach", None)
+        if callable(attach):
+            attach(self)
+        return self
+
+    def poll(self):
+        """Pull every attached source once (the controller/router call
+        this at their boundaries — no polling thread of its own)."""
+        with self._lock:
+            sources = list(self._sources)
+        for s in sources:
+            s.poll(self)
+        return self.pending()
+
+    # -- the ledger -----------------------------------------------------
+    def post(self, rank, grace_s=None, kind="preempt", source="api"):
+        """Record an advance warning for ``rank``.  Re-posting for a
+        rank already noticed keeps the EARLIER deadline (a second signal
+        never extends a grace window).  The posting is an incident:
+        event + flight-recorder dump."""
+        if grace_s is None:
+            grace_s = default_notice_grace_s()
+        n = Notice(rank, kind, grace_s, self._now(), source=source)
+        with self._lock:
+            prev = self._pending.get(n.rank)
+            if prev is not None and prev.deadline is not None and \
+                    (n.deadline is None or prev.deadline <= n.deadline):
+                return prev
+            self._pending[n.rank] = n
+            self.posted += 1
+            pending = len(self._pending)
+        _telem.event("notice.posted", rank=n.rank, notice=n.kind,
+                     grace_s=n.grace_s, source=n.source)
+        _telem.inc("notices.posted")
+        _telem.set_gauge("elastic.pending_notices", pending)
+        # a notice IS an incident: leave the post-mortem now, while the
+        # process is still healthy enough to write it
+        _telem.dump_flight(f"notice:{n.kind}:rank{n.rank}")
+        return n
+
+    def revoke(self, rank, source="api"):
+        """Cancel the pending notice for ``rank`` (maintenance window
+        cancelled / preemption withdrawn).  A drain that has not yet
+        committed at a boundary is thereby cancelled.  Returns the
+        revoked notice, or None."""
+        rank = int(rank)
+        with self._lock:
+            n = self._pending.pop(rank, None)
+            if n is not None:
+                self.revoked += 1
+            pending = len(self._pending)
+        if n is not None:
+            _telem.event("notice.revoked", rank=rank, notice=n.kind,
+                         source=source)
+            _telem.inc("notices.revoked")
+            _telem.set_gauge("elastic.pending_notices", pending)
+        return n
+
+    def pending(self):
+        """Pending notices, oldest-posted first."""
+        with self._lock:
+            return sorted(self._pending.values(),
+                          key=lambda n: (n.posted_at, n.rank))
+
+    def pending_for(self, rank):
+        with self._lock:
+            return self._pending.get(int(rank))
+
+    def mark_drained(self, notice):
+        """The consumer (controller/router) committed the drain this
+        notice asked for — retire it."""
+        with self._lock:
+            cur = self._pending.get(notice.rank)
+            if cur is notice or (cur is not None
+                                 and cur.posted_at == notice.posted_at):
+                del self._pending[notice.rank]
+                self.drained += 1
+            pending = len(self._pending)
+        _telem.event("notice.drained", rank=notice.rank,
+                     notice=notice.kind)
+        _telem.set_gauge("elastic.pending_notices", pending)
+
+    def mark_expired(self, notice):
+        """The grace window lapsed before a boundary could drain it —
+        retire the notice (the heartbeat path owns the death now) and
+        record the miss."""
+        with self._lock:
+            cur = self._pending.get(notice.rank)
+            if cur is notice or (cur is not None
+                                 and cur.posted_at == notice.posted_at):
+                del self._pending[notice.rank]
+                self.expired += 1
+            pending = len(self._pending)
+        _telem.event("notice.expired", rank=notice.rank,
+                     notice=notice.kind, deadline=notice.deadline)
+        _telem.inc("notices.expired")
+        _telem.set_gauge("elastic.pending_notices", pending)
+
+    def stats(self):
+        with self._lock:
+            return {"pending": len(self._pending),
+                    "posted": self.posted, "revoked": self.revoked,
+                    "expired": self.expired, "drained": self.drained}
+
+
+class NoticeSource:
+    """Base class: a producer of notices.  ``poll(board)`` is called by
+    :meth:`NoticeBoard.poll` at consumer boundaries — sources never need
+    their own thread (they may run one if their transport demands it,
+    but every built-in source is pull-based)."""
+
+    def poll(self, board):  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class FakeNoticeSource(NoticeSource):
+    """Deterministic scripted source for tests/chaos: queue preempt/
+    revoke actions, optionally deferred by ``after_polls`` poll calls,
+    and :meth:`poll` applies the due ones.  Zero wall-clock anywhere —
+    deadlines come from the board's (Fake)clock."""
+
+    def __init__(self):
+        self._lock = _racecheck.make_lock("FakeNoticeSource._lock")
+        self._script = []        # guarded-by: _lock
+        self.polls = 0           # guarded-by: _lock
+
+    def preempt(self, rank, grace_s=None, kind="preempt", after_polls=0):
+        with self._lock:
+            self._script.append(
+                ["post", int(rank), grace_s, kind, int(after_polls)])
+        return self
+
+    def revoke(self, rank, after_polls=0):
+        with self._lock:
+            self._script.append(
+                ["revoke", int(rank), None, None, int(after_polls)])
+        return self
+
+    def poll(self, board):
+        due = []
+        with self._lock:
+            self.polls += 1
+            keep = []
+            for item in self._script:
+                if item[4] <= 0:
+                    due.append(item)
+                else:
+                    item[4] -= 1
+                    keep.append(item)
+            self._script = keep
+        for op, rank, grace_s, kind, _ in due:
+            if op == "post":
+                board.post(rank, grace_s=grace_s, kind=kind,
+                           source="fake")
+            else:
+                board.revoke(rank, source="fake")
+
+
+class SignalNoticeSource(NoticeSource):
+    """SIGTERM-grace source: converts the platform's kill signal into a
+    graced notice for THIS worker's rank, so the controller drains at
+    the next boundary instead of dying mid-step.
+
+    Complementary to ``checkpoint.PreemptionHandler`` (which
+    checkpoint-stops): use this one when the job should *reshard and
+    continue on the survivors* rather than stop.  ``install()`` hooks
+    ``signal.SIGTERM`` (chaining any previous handler); tests call
+    :meth:`deliver` directly — no real signal needed."""
+
+    def __init__(self, rank, grace_s=None):
+        self.rank = int(rank)
+        self.grace_s = (default_notice_grace_s() if grace_s is None
+                        else float(grace_s))
+        self._board = None
+        self._fired = False
+        self._prev = None
+        self._installed = False
+
+    def attach(self, board):
+        self._board = board
+
+    def deliver(self):
+        """The signal body (callable directly from tests): post the
+        notice for our rank.  Idempotent until the notice is consumed."""
+        if self._board is not None:
+            self._fired = True
+            self._board.post(self.rank, grace_s=self.grace_s,
+                             kind="sigterm", source="signal")
+
+    def install(self):
+        import signal as _signal
+        if self._installed:
+            return self
+
+        def _handler(signum, frame):
+            self.deliver()
+            if callable(self._prev):
+                self._prev(signum, frame)
+
+        self._prev = _signal.signal(_signal.SIGTERM, _handler)
+        self._installed = True
+        return self
+
+    def remove(self):
+        import signal as _signal
+        if self._installed:
+            _signal.signal(_signal.SIGTERM,
+                           self._prev if self._prev is not None
+                           else _signal.SIG_DFL)
+            self._installed = False
+        return self
+
+    def poll(self, board):
+        # push-based (the signal posts directly); nothing to pull
+        return None
+
+
+class GCENoticeSource(NoticeSource):
+    """GCE maintenance-event poller: reads the instance metadata server
+    (``maintenance-event``) and posts/revokes a notice for THIS
+    worker's rank.  Any transport failure (not on GCE, no network,
+    timeout) counts as "no event" — the source degrades to the
+    heartbeat path, it never takes the job down.
+
+    ``fetch`` is injectable for tests (a callable returning the
+    metadata string, e.g. ``"NONE"`` / ``"TERMINATE_ON_HOST_MAINTENANCE"``).
+    """
+
+    METADATA_URL = ("http://metadata.google.internal/computeMetadata/v1/"
+                    "instance/maintenance-event")
+    _DOOM = ("TERMINATE_ON_HOST_MAINTENANCE", "MIGRATE_ON_HOST_MAINTENANCE",
+             "TERMINATE", "PREEMPTED")
+
+    def __init__(self, rank, grace_s=None, fetch=None, timeout_s=0.5):
+        self.rank = int(rank)
+        self.grace_s = (default_notice_grace_s() if grace_s is None
+                        else float(grace_s))
+        self._timeout = float(timeout_s)
+        self._fetch = fetch if fetch is not None else self._fetch_http
+        self.errors = 0
+
+    def _fetch_http(self):
+        from urllib.request import Request, urlopen
+        req = Request(self.METADATA_URL,
+                      headers={"Metadata-Flavor": "Google"})
+        with urlopen(req, timeout=self._timeout) as resp:  # pragma: no cover
+            return resp.read().decode("utf-8", "replace").strip()
+
+    def poll(self, board):
+        try:
+            state = (self._fetch() or "").strip().upper()
+        except Exception:  # noqa: BLE001 — off-GCE/no-network is normal
+            self.errors += 1
+            return None
+        if any(state.startswith(d) for d in self._DOOM):
+            kind = "preempt" if "PREEMPT" in state else "maintenance"
+            return board.post(self.rank, grace_s=self.grace_s,
+                              kind=kind, source="gce")
+        if state == "NONE" and board.pending_for(self.rank) is not None \
+                and board.pending_for(self.rank).source == "gce":
+            return board.revoke(self.rank, source="gce")
+        return None
+
+
+def make_notice_source(rank=0, spec=None):
+    """Build the production notice source named by ``MXTPU_NOTICE_SOURCE``
+    (``gce`` | ``sigterm`` | ``none``/unset).  Returns None when no
+    source is configured — constructing a board/source explicitly is
+    always the test/API path."""
+    spec = (os.environ.get("MXTPU_NOTICE_SOURCE", "")
+            if spec is None else spec).strip().lower()
+    if spec in ("", "none", "0"):
+        return None
+    if spec == "gce":
+        return GCENoticeSource(rank)
+    if spec == "sigterm":
+        return SignalNoticeSource(rank).install()
+    raise MXNetError(
+        f"MXTPU_NOTICE_SOURCE={spec!r}: expected 'gce', 'sigterm' or "
+        f"'none'")

@@ -256,7 +256,7 @@ class Estimator:
             batches=None, resume=None, checkpoint_manager=None,
             checkpoint_every=None, prefetch_to_device=False,
             prefetch_depth=None, steps_per_call=None,
-            elastic_controller=None):
+            elastic_controller=None, autoscaler=None):
         """Train; with ``checkpoint_manager`` the loop is preemption-safe:
 
         - ``checkpoint_every=N`` saves the full training state (params,
@@ -301,6 +301,18 @@ class Estimator:
         ``.preempted`` set — exactly the PR 4 preemption contract — and
         the caller re-enters ``fit(resume="auto")`` to replay from the
         restored cursor (bitwise, RNG included).
+
+        ISSUE 13 extends the same seam: with a ``NoticeBoard`` attached
+        to the controller, advance preemption notices drain doomed
+        workers at the boundary (checkpoint-then-reshard — with a
+        ``checkpoint_manager`` the loop wires the controller's
+        ``drain_checkpoint`` to a sync save with the real cursor); a
+        notice whose grace window already lapsed (typed
+        ``DrainDeadline``) takes the emergency exit — sync checkpoint,
+        stop with ``.preempted``.  ``autoscaler``
+        (``mx.elastic.Autoscaler``): ticked once per boundary so
+        load-based grow/shrink decisions land through the controller's
+        epoch-fenced resync; inert under ``MXTPU_AUTOSCALE=0``.
         """
         import warnings
         from ... import checkpoint as ckpt_mod
@@ -351,6 +363,21 @@ class Estimator:
                 epoch_done = True
                 epoch_src, epoch_close = self._epoch_source(
                     train_data, prefetch_to_device, prefetch_depth)
+                if elastic_controller is not None and \
+                        checkpoint_manager is not None:
+                    # checkpoint-THEN-reshard on notice-driven drains:
+                    # the controller's drain saves through the SAME
+                    # manager with the loop's real cursor (batch_idx is
+                    # read at call time — the drain happens at a
+                    # boundary inside run_window)
+                    def _drain_save(step):
+                        checkpoint_manager.save(
+                            int(step), params=self.net,
+                            trainer=self.trainer,
+                            iterator={"epoch": self.current_epoch,
+                                      "batch": batch_idx},
+                            sync=True)
+                    elastic_controller.drain_checkpoint = _drain_save
 
                 def run_window(window):
                     """Execute a window of batches (ONE dispatch on the
@@ -383,9 +410,23 @@ class Estimator:
                         preempt.check_step(self.global_step)
                     rewound = False
                     if elastic_controller is not None and not preempted:
-                        ev = elastic_controller.check_step(
-                            self.global_step, trainer=self.trainer,
-                            params=self.net)
+                        from ...elastic.notices import DrainDeadline
+                        try:
+                            ev = elastic_controller.check_step(
+                                self.global_step, trainer=self.trainer,
+                                params=self.net)
+                        except DrainDeadline:
+                            # a notice's grace window lapsed before this
+                            # boundary could drain it: emergency exit —
+                            # the shared preemption save below is sync,
+                            # then stop with .preempted (PR 4 contract)
+                            ev = None
+                            preempted = True
+                        if ev is not None and \
+                                ev.get("source") == "stop":
+                            # degradation-ladder rung 3: capacity below
+                            # the floor — checkpoint-and-stop now
+                            preempted = True
                         if ev is not None and \
                                 ev.get("source") == "checkpoint":
                             # the reshard recovered from a checkpoint at
@@ -400,6 +441,12 @@ class Estimator:
                             self.global_step = ev["step"]
                             preempted = True
                             rewound = True
+                    if autoscaler is not None and not preempted:
+                        # the load-based control loop ticks at the same
+                        # boundary; decisions apply through the
+                        # controller's epoch-fenced resync at the NEXT
+                        # boundary (no mid-window capacity change)
+                        autoscaler.tick(step=self.global_step)
                     crossed = checkpoint_every and (
                         self.global_step // checkpoint_every
                         > gs_before // checkpoint_every)

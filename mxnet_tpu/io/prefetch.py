@@ -565,7 +565,13 @@ class AsyncDecodeIter:
             for f in futs:
                 f.cancel()
         self._pending = []
-        self._pool.shutdown(wait=False, cancel_futures=True)
+        # JOIN the pool threads (wait=True), don't just signal them:
+        # with wait=False the non-daemon workers were still winding down
+        # when the conftest thread-leak guard (2 s grace) sampled
+        # threading.enumerate() — the known test_real_data teardown
+        # flake on a loaded host.  Pending work was cancelled above, so
+        # the join is bounded by one in-flight sample decode.
+        self._pool.shutdown(wait=True, cancel_futures=True)
 
     def __enter__(self):
         return self

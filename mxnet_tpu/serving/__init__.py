@@ -29,11 +29,11 @@ from __future__ import annotations
 from .engine import InferenceEngine, next_bucket
 from .kv_cache import PagedKVCache, DoubleFreeError
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
-from .frontend import PrefixCache, Router
+from .frontend import PrefixCache, Router, AdmissionShed
 
 __all__ = ["InferenceEngine", "PagedKVCache", "DoubleFreeError",
            "ContinuousBatcher", "StaticBatcher", "Request", "next_bucket",
-           "serving_block", "PrefixCache", "Router"]
+           "serving_block", "PrefixCache", "Router", "AdmissionShed"]
 
 
 def _r(x, nd=3):

@@ -21,6 +21,6 @@ See docs/SERVING.md §Front-end; the chaos gate is
 from __future__ import annotations
 
 from .prefix_cache import PrefixCache
-from .router import Router, Replica
+from .router import Router, Replica, AdmissionShed
 
-__all__ = ["PrefixCache", "Router", "Replica"]
+__all__ = ["PrefixCache", "Router", "Replica", "AdmissionShed"]

@@ -42,7 +42,14 @@ from ... import telemetry as _telem
 from ...lint import racecheck as _racecheck
 from ..scheduler import ContinuousBatcher
 
-__all__ = ["Router", "Replica"]
+__all__ = ["Router", "Replica", "AdmissionShed"]
+
+
+class AdmissionShed(MXNetError):
+    """The router is shedding new admissions (degradation-ladder rung 1
+    — capacity dropped below the healthy target).  In-flight and
+    requeued requests are unaffected; the caller should back off or
+    route elsewhere."""
 
 
 class Replica:
@@ -115,7 +122,11 @@ class Router:
         self._assigned = {}        # guarded-by: _lock — req.id -> rid
         self._submitted = {}       # guarded-by: _lock — req.id -> Request
         self._stopping = False     # guarded-by: _lock
+        self._shedding = False     # guarded-by: _lock (ladder rung 1)
         self.events = []           # guarded-by: _lock — membership log
+        self._factory = engine_factory
+        self._prefills_per_step = prefills_per_step
+        self._notices = None       # elastic.NoticeBoard (ISSUE 13)
         self.compile_cache = {}
         self.replicas = []
         warm0 = None
@@ -142,35 +153,32 @@ class Router:
         self._on_death(self.replicas[rid],
                        MXNetError(f"replica {rid} killed"))
 
-    def _on_death(self, rep, exc):
-        if not rep.alive:
-            return
+    def _evacuate(self, rep, event_kind, detail):
+        """Take ``rep`` out of the replica set (epoch bump) and collect
+        everything it still owed: inbox, queued, mid-prefill,
+        mid-decode.  Finished requests already left the building.
+        Returns the requests to requeue — shared by the crash path
+        (``_on_death``) and the graceful drain (``drain_replica``)."""
         with self._lock:
             rep.alive = False
             self.epoch += 1
             epoch = self.epoch
             lost = list(rep.inbox)
             rep.inbox.clear()
-            self.events.append({"kind": "replica_dead", "rid": rep.rid,
-                                "epoch": epoch,
-                                "error": f"{type(exc).__name__}: {exc}",
-                                "t": self._now()})
+            self.events.append(dict(detail, kind=event_kind,
+                                    rid=rep.rid, epoch=epoch,
+                                    t=self._now()))
+            self._cond.notify_all()   # its worker thread must exit
         b = rep.batcher
-        # everything the dead replica still owed: queued, mid-prefill,
-        # mid-decode.  Finished requests already left the building.
         lost += list(b.queue)
         b.queue.clear()
         lost += [st.req for st in getattr(b, "prefilling", {}).values()]
         getattr(b, "prefilling", {}).clear()
         lost += list(b.active.values())
         b.active.clear()
-        if not self.live_replicas():
-            raise MXNetError(
-                f"router: last replica died ({exc}); "
-                f"{len(lost)} request(s) unservable")
-        _telem.event("serving.replica_dead", rid=rep.rid,
-                     epoch=epoch, requeued=len(lost))
-        _telem.inc("serving.replica_deaths")
+        return lost, epoch
+
+    def _requeue_all(self, lost):
         for req in lost:
             # reset to the prompt: greedy decode reproduces the exact
             # stream on the new replica, so nothing is lost or doubled
@@ -181,6 +189,120 @@ class Router:
             with self._lock:
                 self.requeues += 1
             self.submit(req, _requeue=True)
+
+    def _on_death(self, rep, exc):
+        if not rep.alive:
+            return
+        lost, epoch = self._evacuate(
+            rep, "replica_dead",
+            {"error": f"{type(exc).__name__}: {exc}"})
+        if not self.live_replicas():
+            raise MXNetError(
+                f"router: last replica died ({exc}); "
+                f"{len(lost)} request(s) unservable")
+        _telem.event("serving.replica_dead", rid=rep.rid,
+                     epoch=epoch, requeued=len(lost))
+        _telem.inc("serving.replica_deaths")
+        self._requeue_all(lost)
+
+    def drain_replica(self, rid, reason="admin"):
+        """Graceful exit for a DOOMED (preemption-noticed) or
+        autoscaled-away replica: epoch bump, everything it still owed
+        requeued to the survivors — zero lost, zero duplicated — and
+        the replica leaves the set before its machine disappears.  Same
+        evacuation as the crash path, minus the surprise."""
+        rep = self.replicas[rid]
+        if not rep.alive:
+            return 0
+        if len(self.live_replicas()) <= 1:
+            raise MXNetError(
+                f"router: refusing to drain replica {rid} — it is the "
+                f"last live replica (scale up or stop shedding first)")
+        lost, epoch = self._evacuate(rep, "replica_drained",
+                                     {"reason": str(reason)})
+        _telem.event("serving.replica_drained", rid=rep.rid,
+                     epoch=epoch, requeued=len(lost),
+                     reason=str(reason))
+        _telem.inc("serving.replica_drains")
+        self._requeue_all(lost)
+        return len(lost)
+
+    def add_replica(self):
+        """Grow the fleet by one replica (the autoscaler's grow path):
+        built from the stored factory against the SHARED warmup compile
+        cache (pool-geometry-keyed executables — the newcomer compiles
+        nothing new for known shapes), epoch bump, worker thread
+        spawned when the fleet runs threaded."""
+        eng = self._factory(self.compile_cache)
+        eng.warmup()
+        # self.replicas stays SINGLE-WRITER (the control loop that calls
+        # add_replica) and append is atomic under the GIL; every
+        # concurrent reader snapshots with list(self.replicas) — so the
+        # list itself needs no lock, only the epoch/event bookkeeping
+        rid = len(self.replicas)
+        rep = Replica(rid, eng,
+                      ContinuousBatcher(eng, self._prefills_per_step))
+        threaded = any(r.thread is not None for r in self.replicas)
+        self.replicas.append(rep)
+        with self._lock:
+            self.epoch += 1
+            epoch = self.epoch
+            self.events.append({"kind": "replica_added", "rid": rid,
+                                "epoch": epoch, "t": self._now()})
+        _telem.event("serving.replica_added", rid=rid, epoch=epoch)
+        _telem.inc("serving.replica_adds")
+        if threaded:
+            t = threading.Thread(target=self._worker, args=(rep,),
+                                 name=f"router-replica{rid}",
+                                 daemon=True)
+            rep.thread = t
+            t.start()
+        return rep
+
+    # -- degradation / notices (ISSUE 13) --------------------------------
+
+    def set_shedding(self, on, reason=None):
+        """Degradation-ladder rung 1: while shedding, NEW submissions
+        raise :class:`AdmissionShed`; requeues (drain/death evacuation)
+        are exempt so nothing in flight is ever lost.  Idempotent; the
+        transition (only) is logged."""
+        on = bool(on)
+        with self._lock:
+            changed = on != self._shedding
+            self._shedding = on
+        if changed:
+            _telem.event("serving.shedding", on=on,
+                         reason=str(reason) if reason else None)
+            _telem.set_gauge("serving.shedding", int(on))
+        return on
+
+    @property
+    def shedding(self):
+        with self._lock:
+            return self._shedding
+
+    def attach_notices(self, board):
+        """Wire an :class:`~mxnet_tpu.elastic.NoticeBoard` whose ranks
+        name replica ids: a pending notice drains the doomed replica at
+        the next scheduling boundary (requeue to survivors, zero lost);
+        a revoked notice cancels the drain before it commits."""
+        self._notices = board
+        return self
+
+    def _check_notices(self):
+        if self._notices is None:
+            return 0
+        self._notices.poll()
+        drained = 0
+        for notice in self._notices.pending():
+            rid = notice.rank
+            if rid >= len(self.replicas) or not self.replicas[rid].alive:
+                self._notices.mark_drained(notice)   # already gone
+                continue
+            self._notices.mark_drained(notice)
+            self.drain_replica(rid, reason=f"notice:{notice.kind}")
+            drained += 1
+        return drained
 
     # -- admission -------------------------------------------------------
 
@@ -211,7 +333,19 @@ class Router:
                 + 0.001 * sig["ttft_ms"])
 
     def submit(self, request, _requeue=False):
-        """Admit ``request`` to the least-loaded live replica."""
+        """Admit ``request`` to the least-loaded live replica.  While
+        the degradation ladder has admissions SHED, new requests are
+        rejected with a typed :class:`AdmissionShed` (requeues of
+        in-flight work are exempt — a drain never loses a request)."""
+        if not _requeue:
+            with self._lock:
+                shedding = self._shedding
+            if shedding:
+                _telem.inc("serving.admissions_shed")
+                raise AdmissionShed(
+                    "router is shedding new admissions (capacity below "
+                    "the healthy target — degradation-ladder rung 1); "
+                    "retry after capacity recovers")
         live = self.live_replicas()
         if not live:
             raise MXNetError("router: no live replicas")
@@ -271,6 +405,7 @@ class Router:
         chaos scenario's and the loadgen's reproducible path."""
         boundaries = 0
         while not self.all_done():
+            self._check_notices()   # drain doomed replicas first
             progressed = False
             for rep in list(self.replicas):
                 if not rep.alive or self._replica_idle(rep):
@@ -313,6 +448,17 @@ class Router:
                        and self._replica_idle(rep)):
                     self._cond.wait()  # mxlint: disable=HB16 -- Condition.wait RELEASES the router lock while sleeping
                 if self._stopping or not rep.alive:
+                    return
+            board = self._notices
+            if board is not None:
+                # single-owner discipline: the DOOMED replica's own
+                # worker performs its drain (it owns the batcher state)
+                board.poll()
+                notice = board.pending_for(rep.rid)
+                if notice is not None:
+                    board.mark_drained(notice)
+                    self.drain_replica(rep.rid,
+                                       reason=f"notice:{notice.kind}")
                     return
             try:
                 self._step_replica(rep)
@@ -419,11 +565,13 @@ class Router:
             })
         with self._lock:
             epoch, requeues = self.epoch, self.requeues
+            shedding = self._shedding
         return {"replicas": len(self.replicas),
                 "live": len(self.live_replicas()),
                 "epoch": epoch,
                 "requests": len(fin),
                 "requeues": requeues,
+                "shedding": shedding,
                 "p50_latency_s": pct(0.50), "p99_latency_s": pct(0.99),
                 "compiles_after_warmup": total_caw,
                 "per_replica": per_replica}

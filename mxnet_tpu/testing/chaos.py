@@ -54,7 +54,23 @@ must leave a parseable flight-recorder dump, racecheck must report
 zero findings, and the surviving replica's KV pool must pass the leak
 sweep (prefix-chain holds accounted).
 
-``python -m mxnet_tpu.testing.chaos all`` runs all three suites.
+``python -m mxnet_tpu.testing.chaos autoscale`` (or ``tools/
+tpu_queue_runner.py --chaos autoscale``) runs the PRODUCTION-ELASTICITY
+scenario (ISSUE 13), deterministic on the CPU mesh with a FakeClock and
+zero sleeps: a preemption NOTICE for training worker 1 drains it at a
+step boundary AHEAD of the heartbeat timeout (checkpoint-then-reshard
+dp 8 -> 4), the degradation ladder sheds serving admissions while
+capacity is below target, the notice is then REVOKED (maintenance
+cancelled) and the load-based autoscaler grows dp back 4 -> 8 through
+the same epoch-fenced resync — with params + optimizer state BITWISE a
+fresh restore at EACH intermediate dp.  On the serving side a notice
+drains a router replica mid-traffic (zero lost/duplicated requests,
+identical-prompt streams bitwise-equal) and the serving autoscaler
+adds a replacement replica from the shared compile cache (zero new
+compiles).  Every injected notice leaves a parseable flight dump;
+racecheck is armed; the KV pools pass the leak sweep.
+
+``python -m mxnet_tpu.testing.chaos all`` runs all four suites.
 """
 from __future__ import annotations
 
@@ -624,6 +640,240 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     return result
 
 
+# ----------------------------------------------------------------------
+# Production-elasticity scenario (ISSUE 13): preemption notice -> drain
+# -> shrink under load -> notice revoked -> load-driven grow back, with
+# bitwise parity at each dp; serving replica drained by notice with
+# zero lost requests and an autoscaled replacement replica.
+# ----------------------------------------------------------------------
+
+def run_autoscale_scenario(total_steps=6, notice_at=2, revoke_at=4,
+                           workdir=None):
+    """The ISSUE 13 acceptance scenario; see the module docstring.
+    Deterministic: FakeClock, zero sleeps, drive()-mode router."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import elastic
+    from mxnet_tpu.checkpoint import CheckpointManager
+    from mxnet_tpu.parallel.mesh import make_mesh, AXIS_DP as _AXIS_DP
+    from mxnet_tpu.serving import (AdmissionShed, InferenceEngine,
+                                   Request, Router)
+    from mxnet_tpu.testing import faults
+    import jax
+
+    rc = _racecheck_arm()
+    clock = faults.FakeClock(2000.0)
+    devices = jax.devices()
+    dpw, ranks = 4, [0, 1]
+    dp0 = dpw * len(ranks)               # 8
+    dp_small = dp0 // 2                  # 4 after the drain
+    result = {"kind": "autoscale", "dp_before": dp0,
+              "dp_small": dp_small, "notice_at": notice_at,
+              "revoke_at": revoke_at, "total_steps": total_steps}
+
+    # -- serving fleet: 2 replicas, shared-system-prompt mix ------------
+    net_s = _serving_net()
+    rng = _np.random.RandomState(21)
+    sys_prompt = rng.randint(0, 64, (12,)).tolist()
+    # 3 unique prompts, each submitted twice: greedy decode is
+    # deterministic, so the twin of a drained-and-requeued request is
+    # the bitwise oracle for its stream — no second warmup needed
+    uniq = [sys_prompt + rng.randint(0, 64, (3 + i,)).tolist()
+            for i in range(3)]
+    prompts = [p for p in uniq for _ in range(2)]
+
+    def factory(compile_cache):
+        return InferenceEngine(net_s, max_batch=2, block_size=8,
+                               max_context=32, num_blocks=24,
+                               prefill_chunk=8, prefix_cache=True,
+                               compile_cache=compile_cache)
+
+    router = Router(factory, replicas=2, now=clock)
+    for rep in router.replicas:
+        rep.engine.pin_prefix(sys_prompt)
+    sboard = elastic.NoticeBoard(now=clock)
+    ssrc = elastic.FakeNoticeSource()
+    sboard.attach_source(ssrc)
+    router.attach_notices(sboard)
+    serve_scaler = elastic.Autoscaler(
+        elastic.ScalingPolicy(
+            [elastic.ScalingRule("serving.queue_depth", high=10,
+                                 domain="serving", window_s=0.0)],
+            cooldown_s=0.0, max_replicas=3),
+        router=router, now=clock)
+
+    reqs = [router.submit(Request(p, max_new_tokens=4)) for p in prompts]
+    # the doomed replica steps twice, THEN the notice lands mid-traffic
+    ssrc.preempt(1, grace_s=60, after_polls=2)
+    router.drive()
+    result["serving_flight_dump"] = _flight_check(expect_kind="notice")
+    fin = router.finished()
+    result["serving_no_lost_or_dup"] = (
+        sorted(r.id for r in fin) == sorted(r.id for r in reqs)
+        and len(fin) == len(reqs))
+    by_prompt = {}
+    for r in reqs:
+        by_prompt.setdefault(tuple(r.tokens), []).append(r.generated)
+    result["serving_twin_streams_bitwise"] = all(
+        all(len(g) > 0 for g in gs) and all(g == gs[0] for g in gs)
+        for gs in by_prompt.values())
+    result["serving_drained"] = any(
+        e["kind"] == "replica_drained" for e in router.events)
+    # load-driven replacement: the serving autoscaler adds replica 2
+    # from the SHARED warmup compile cache — zero new compiles
+    serve_scaler.tick(signals={"serving.queue_depth": 99.0})
+    result["serving_replicas_live"] = len(router.live_replicas())
+    router.replicas[-1].engine.pin_prefix(sys_prompt)
+
+    # -- training: notice -> drain -> shrink -> revoke -> grow back -----
+    xs, ys = _make_data(77, n_batches=total_steps, batch=16)
+    net, trainer = _build_elastic(make_mesh({_AXIS_DP: dp0},
+                                            devices[:dp0]))
+    membership = elastic.Membership(ranks, now=clock, rendezvous_s=60)
+    board = elastic.NoticeBoard(now=clock)
+    src = elastic.FakeNoticeSource()
+    board.attach_source(src)
+    mgr = None
+    if workdir is not None:
+        mgr = CheckpointManager(
+            os.path.join(workdir, "autoscale"), keep=5, async_save=False)
+    ladder = elastic.DegradationLadder(router=router, now=clock)
+    controller = elastic.ElasticController(
+        membership, devices=devices, devices_per_worker=dpw,
+        checkpoint_manager=mgr, net=net, backoff_s=0.0,
+        now=clock, sleep=lambda s: None, notices=board, ladder=ladder)
+    if mgr is not None:
+        # checkpoint-THEN-reshard on every notice-driven drain
+        controller.drain_checkpoint = lambda s: mgr.save(
+            s, params=net, trainer=trainer, iterator={"batch": s},
+            sync=True)
+    scaler = elastic.Autoscaler(
+        elastic.ScalingPolicy(
+            [elastic.ScalingRule("train.step_ms", high=100.0,
+                                 domain="train", window_s=5.0)],
+            cooldown_s=5.0, max_dp=dp0),
+        controller=controller, now=clock)
+
+    snap_a = snap_b = None
+    shed_blocked = False
+    events = []
+    for step in range(1, total_steps + 1):
+        clock.advance(2.0)
+        trainer.step(mx.nd.array(xs[step - 1]), mx.nd.array(ys[step - 1]))
+        if step == notice_at:
+            # GCE-style advance warning for worker 1, 30 s grace: the
+            # boundary below drains it AHEAD of any heartbeat timeout
+            src.preempt(1, grace_s=30)
+            snap_a = _capture_boundary(net, trainer)
+        if step == revoke_at:
+            # maintenance cancelled: notice revoked, the worker lives
+            # and re-announces; the grow itself is LOAD-driven (below)
+            src.revoke(1)
+            board.poll()
+            membership.announce_join(1, membership.epoch)
+        # the load-based control loop ticks at every boundary (the
+        # synthetic step_ms signal stays hot, so the autoscaler wants
+        # capacity the moment membership can back it)
+        scaler.tick(signals={"train.step_ms": 500.0}, step=step)
+        if step == revoke_at:
+            snap_b = _capture_boundary(net, trainer)
+        ev = controller.check_step(step, trainer, params=net)
+        if ev is not None:
+            events.append({k: ev.get(k) for k in
+                           ("source", "step", "dp", "epoch")})
+        if step == notice_at:
+            result["training_flight_dump"] = _flight_check(
+                expect_kind="notice")
+            result["shed_after_drain"] = router.shedding
+            try:
+                router.submit(Request(prompts[0], max_new_tokens=2))
+            except AdmissionShed:
+                shed_blocked = True
+    result["events"] = events
+    result["shed_blocked"] = shed_blocked
+    result["unshed_after_grow"] = not router.shedding
+    result["drain_checkpoint_at"] = None if mgr is None else mgr.latest()
+    result["membership_epoch"] = membership.epoch
+    result["final_dp"] = trainer.mesh.shape[_AXIS_DP]
+    result["drains"] = controller.drains
+    result["autoscale"] = scaler.stats()
+    grow = [d for d in scaler.decisions
+            if d["domain"] == "train" and d["verdict"] == "grow"]
+    result["load_driven_grow"] = bool(grow) and grow[0]["to"] == dp0
+    params_final, state_final = _final_state(net, trainer)
+
+    # parity 1: the dp=4 segment must be BITWISE a fresh dp=4 process
+    # restored from the drain-boundary state
+    ref_net, ref_trainer = _build_elastic(
+        make_mesh({_AXIS_DP: dp_small}, devices[:dp_small]), seed=4321)
+    _restore_boundary(ref_net, ref_trainer, snap_a)
+    for i in range(notice_at, revoke_at):
+        ref_trainer.step(mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+    pa, sa = _final_state(ref_net, ref_trainer)
+    result["params_bitwise_dp4"] = _bitwise(
+        {n: v for n, v in snap_b["params"].items()}, pa)
+    result["state_bitwise_dp4"] = _bitwise(
+        {k: v.asnumpy() for k, v in snap_b["sd"]["arrays"].items()}, sa)
+
+    # parity 2: the grown dp=8 tail must be BITWISE a fresh dp=8
+    # process restored from the grow-boundary state
+    ref_net8, ref_trainer8 = _build_elastic(
+        make_mesh({_AXIS_DP: dp0}, devices[:dp0]), seed=9876)
+    _restore_boundary(ref_net8, ref_trainer8, snap_b)
+    for i in range(revoke_at, total_steps):
+        ref_trainer8.step(mx.nd.array(xs[i]), mx.nd.array(ys[i]))
+    pb, sb = _final_state(ref_net8, ref_trainer8)
+    result["params_bitwise"] = _bitwise(params_final, pb)
+    result["state_bitwise"] = _bitwise(state_final, sb)
+
+    # serving epilogue: admissions recovered — two more requests ride
+    # the grown fleet (incl. the autoscaled replica) to completion
+    extra = [router.submit(Request(p, max_new_tokens=4))
+             for p in uniq[:2]]
+    router.drive()
+    result["serving_post_recovery_ok"] = all(r.done for r in extra)
+    st = router.stats()
+    result["compiles_after_warmup"] = st["compiles_after_warmup"]
+    leaks_ok = True
+    for rep in router.replicas:
+        if not rep.alive:
+            continue
+        try:
+            rep.engine.cache.check_leaks(
+                holders=rep.engine.prefix_cache.held_blocks())
+        except Exception as e:  # noqa: BLE001 — verdict, not crash
+            leaks_ok = False
+            result["leak_error"] = f"{type(e).__name__}: {e}"
+    result["kv_leaks_clean"] = leaks_ok
+
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    fds = [result.get("serving_flight_dump"),
+           result.get("training_flight_dump")]
+    checks = [
+        result["serving_no_lost_or_dup"],
+        result["serving_twin_streams_bitwise"],
+        result["serving_drained"],
+        result["serving_replicas_live"] == 2,
+        result["serving_post_recovery_ok"],
+        result["compiles_after_warmup"] == 0,
+        leaks_ok,
+        result["shed_after_drain"], shed_blocked,
+        result["unshed_after_grow"],
+        mgr is None or result["drain_checkpoint_at"] == notice_at,
+        result["drains"] == 1,
+        result["membership_epoch"] == 2,       # death + join
+        result["final_dp"] == dp0,
+        result["load_driven_grow"],
+        len(events) == 2,
+        result["params_bitwise_dp4"], result["state_bitwise_dp4"],
+        result["params_bitwise"], result["state_bitwise"],
+        all(fd is None or fd["ok"] for fd in fds),
+        rcv is None or rcv["ok"],
+    ]
+    result["ok"] = bool(all(checks))
+    return result
+
+
 def main(argv=None):
     # the smoke must run anywhere — force the simulated CPU mesh exactly
     # like tests/conftest.py does
@@ -652,6 +902,8 @@ def main(argv=None):
                         for kind in ("shrink", "grow", "reshard_fault")]
         if suite in ("serving", "all"):
             results.append(run_serving_scenario(workdir=workdir))
+        if suite in ("autoscale", "all"):
+            results.append(run_autoscale_scenario(workdir=workdir))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     ok = bool(results) and all(r["ok"] for r in results)

@@ -401,7 +401,8 @@ def test_serving_nulls_stay_out_of_headline():
 
 _ELASTIC_KEYS = {
     "enabled", "dp", "membership_epoch", "transitions", "degraded",
-    "reshard_ms", "pause_ms",
+    "reshard_ms", "pause_ms", "drain_ms", "drains", "pending_notices",
+    "autoscale_decisions",
 }
 
 
@@ -409,27 +410,36 @@ def test_elastic_block_schema_is_stable():
     from mxnet_tpu.elastic import elastic_block
     blk = elastic_block()
     assert set(blk) == _ELASTIC_KEYS
-    for k in ("reshard_ms", "pause_ms"):
+    for k in ("reshard_ms", "pause_ms", "drain_ms",
+              "autoscale_decisions"):
         assert blk[k] is None, k
     assert blk["enabled"] is False and blk["transitions"] == 0
+    assert blk["drains"] == 0 and blk["pending_notices"] == 0
     blk2 = elastic_block(enabled=True, dp=4, membership_epoch=2,
                          transitions=1, reshard_ms=73.7777,
-                         pause_ms=74.1234)
+                         pause_ms=74.1234, drain_ms=5.5555,
+                         drains=1, autoscale_decisions=3)
     assert blk2["reshard_ms"] == 73.778
     assert blk2["pause_ms"] == 74.123
+    assert blk2["drain_ms"] == 5.556
+    assert blk2["autoscale_decisions"] == 3
     assert json.loads(json.dumps(blk)) == blk
 
 
 def test_bench_elastic_on_cpu_is_nulls_not_zeros():
     """bench.py's elastic block on a CPU host: the measured transition
     timings stay null (the bitwise correctness evidence lives in the
-    tier-1 chaos elastic suite, not in fake bench numbers)."""
+    tier-1 chaos elastic suite, not in fake bench numbers).  The ISSUE
+    13 fields keep the same honesty: no notice drain / autoscale loop
+    ran, so drain_ms and autoscale_decisions are null, not zero."""
     import jax
     if jax.devices()[0].platform != "cpu":
         return
     blk = bench._bench_elastic()
     assert blk["reshard_ms"] is None
     assert blk["pause_ms"] is None
+    assert blk["drain_ms"] is None
+    assert blk["autoscale_decisions"] is None
     assert "note" in blk
 
 

@@ -199,6 +199,27 @@ def test_async_decode_iter_close_cancels_pending():
     assert len(started) <= n_started + 2
 
 
+def test_async_decode_iter_close_joins_pool_threads():
+    """ISSUE 13 satellite: close() must JOIN the decode workers, not
+    just signal them — with wait=False the non-daemon pool threads were
+    still winding down when the conftest 2 s thread-leak grace sampled
+    them on a loaded host (the known test_real_data teardown flake)."""
+    import threading
+
+    it = AsyncDecodeIter(lambda i: i, range(32), batch_size=4,
+                         n_workers=4, lookahead=2)
+    next(it)
+    pool_threads = list(it._pool._threads)
+    assert any(t.is_alive() for t in pool_threads)
+    it.close()
+    # joined INSIDE close — zero grace needed, nothing for the conftest
+    # leak guard to race against
+    assert all(not t.is_alive() for t in pool_threads)
+    assert not [t for t in threading.enumerate()
+                if t.name.startswith("mxtpu-decode")]
+    it.close()                                    # idempotent
+
+
 # ----------------------------------------------------------------------
 # ImageRecordIter preprocess_threads plumbing (pure-Python decode path)
 # ----------------------------------------------------------------------

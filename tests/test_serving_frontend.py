@@ -340,6 +340,78 @@ def test_router_death_requeues_zero_lost_or_dup(net):
         holders=survivor.engine.prefix_cache.held_blocks())
 
 
+def test_router_drain_replica_requeues_and_add_replica_grows(net):
+    """ISSUE 13: a graceful drain (preemption notice / autoscale-away)
+    evacuates the doomed replica with zero lost or duplicated requests,
+    and add_replica grows the fleet from the SHARED warmup compile
+    cache — the newcomer compiles nothing."""
+    rng = np.random.RandomState(17)
+    prompts = [rng.randint(0, 64, (4 + i,)).tolist() for i in range(4)]
+    router = _router(net, replicas=2)
+    reqs = [router.submit(Request(p, max_new_tokens=3))
+            for p in prompts]
+    moved = router.drain_replica(1, reason="notice:test")
+    assert moved >= 1                        # its inbox was evacuated
+    assert router.epoch == 1
+    assert [e["kind"] for e in router.events] == ["replica_drained"]
+    assert not router.replicas[1].alive
+    rep = router.add_replica()
+    assert rep.rid == 2 and rep.alive and router.epoch == 2
+    router.drive()
+    fin = router.finished()
+    assert sorted(r.id for r in fin) == sorted(r.id for r in reqs)
+    assert len(fin) == len(reqs)             # zero lost, zero dup
+    assert router.stats()["compiles_after_warmup"] == 0
+    # the last live replica refuses to drain (typed, not a wedge)
+    router.drain_replica(2, reason="autoscale")
+    with pytest.raises(mx.base.MXNetError, match="last live replica"):
+        router.drain_replica(0)
+
+
+def test_router_shedding_rejects_new_admissions_only(net):
+    """Degradation-ladder rung 1: shedding rejects NEW submits with the
+    typed AdmissionShed; requeues (a drain) are exempt, so in-flight
+    work still completes exactly once."""
+    from mxnet_tpu.serving import AdmissionShed
+    rng = np.random.RandomState(19)
+    router = _router(net, replicas=2)
+    reqs = [router.submit(Request(rng.randint(0, 64, (5,)).tolist(),
+                                  max_new_tokens=2)) for _ in range(2)]
+    assert router.set_shedding(True, reason="test") is True
+    with pytest.raises(AdmissionShed):
+        router.submit(Request([1, 2, 3], max_new_tokens=1))
+    router.drain_replica(1, reason="notice:test")   # requeues pass
+    router.drive()
+    assert all(r.done for r in reqs)
+    router.set_shedding(False)
+    r3 = router.submit(Request([1, 2, 3], max_new_tokens=1))
+    router.drive()
+    assert r3.done
+
+
+def test_router_notice_board_drains_doomed_replica(net):
+    """A NoticeBoard wired into the router drains the noticed replica
+    at the next drive boundary; a revoked notice cancels the drain."""
+    from mxnet_tpu import elastic
+    from mxnet_tpu.testing import faults
+    clock = faults.FakeClock(100.0)
+    board = elastic.NoticeBoard(now=clock)
+    router = _router(net, replicas=2)
+    router.attach_notices(board)
+    rng = np.random.RandomState(23)
+    # revoked before any boundary: no drain
+    board.post(0, grace_s=60, kind="maintenance")
+    board.revoke(0)
+    reqs = [router.submit(Request(rng.randint(0, 64, (4,)).tolist(),
+                                  max_new_tokens=2)) for _ in range(2)]
+    board.post(1, grace_s=60, kind="preempt")
+    router.drive()
+    assert router.replicas[0].alive          # revocation cancelled it
+    assert not router.replicas[1].alive      # the noticed one drained
+    assert all(r.done for r in reqs)
+    assert board.stats()["pending"] == 0
+
+
 def test_router_threaded_mode_racecheck_clean(net):
     from mxnet_tpu.lint import racecheck
     racecheck.reset()

@@ -515,19 +515,26 @@ def step_bert128(st: dict) -> None:
 
 
 def run_chaos(suite: str = "preempt") -> int:
-    """``--chaos [elastic|serving|all]``: the fault-tolerance smoke
-    (mxnet_tpu.testing.chaos) in a child process on the simulated CPU
-    mesh.  Default suite: kill the checkpoint writer, preempt at step
-    K, corrupt the newest checkpoint, auto-resume, bitwise parity.
+    """``--chaos [elastic|serving|autoscale|all]``: the fault-tolerance
+    smoke (mxnet_tpu.testing.chaos) in a child process on the simulated
+    CPU mesh.  Default suite: kill the checkpoint writer, preempt at
+    step K, corrupt the newest checkpoint, auto-resume, bitwise parity.
     ``elastic`` (ISSUE 8): kill worker 1 at step K via silent
     heartbeats, join a replacement at K', kill a reshard mid-transfer —
     each continuing WITHOUT a restart and bitwise-matching a fresh
     process restored from the same state.  ``serving`` (ISSUE 12): kill
     a serving-router replica mid-traffic — the router must requeue with
     zero lost/duplicated requests and every output must match the solo
-    cold-path stream exactly.  Needs no TPU and takes no queue lock:
-    safe to run any time, including while the measurement queue owns
-    the chip."""
+    cold-path stream exactly.  ``autoscale`` (ISSUE 13): a preemption
+    NOTICE drains worker 1 at a boundary ahead of the heartbeat
+    timeout (checkpoint-then-reshard 8->4, serving admissions shed),
+    the notice is revoked and the load-based autoscaler grows back
+    4->8 — bitwise vs a fresh restore at EACH dp, a noticed serving
+    replica drained with zero lost requests, a replacement replica
+    autoscaled in with zero new compiles, flight-dump + racecheck +
+    KV-leak gates folded into the verdict.  Needs no TPU and takes no
+    queue lock: safe to run any time, including while the measurement
+    queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # ISSUE 10: every chaos interleaving runs under the runtime race /
     # lock-order detector (mxnet_tpu.lint.racecheck); a finding fails
