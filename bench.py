@@ -108,74 +108,51 @@ def _cpu_fallback_subprocess(timeout: float = 900.0) -> dict | None:
 
 
 # ---------------------------------------------------------------------------
-# MFU helpers
+# MFU helpers — lifted into mxnet_tpu/telemetry/costmodel.py (ISSUE 14)
+# so the trainer's live `train.mfu` gauge and bench's offline numbers
+# share ONE cost model.  The bench-local names stay as lazy wrappers
+# (mxnet_tpu must not import before the backend probe decides the
+# platform); output for the same inputs is byte-identical
+# (test_bench_line.py).
 # ---------------------------------------------------------------------------
 
-# Advertised per-chip bf16 peak FLOP/s by device_kind substring (google
-# cloud TPU docs); lowercase match, first hit wins.
-_PEAK_BF16 = [
-    ("v6", 918e12), ("trillium", 918e12),
-    ("v5p", 459e12),
-    ("v5 lite", 197e12), ("v5e", 197e12), ("v5litepod", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 46e12),
-]
+def _costmodel():
+    from mxnet_tpu.telemetry import costmodel
+    return costmodel
 
 
 def _chip_peak_flops(dev) -> float | None:
-    kind = getattr(dev, "device_kind", "").lower()
-    for sub, peak in _PEAK_BF16:
-        if sub in kind:
-            return peak
-    return None
+    return _costmodel().chip_peak_flops(dev)
 
 
 def _compiled_flops(jitted, *args) -> float | None:
-    """XLA's own FLOP estimate for the compiled step (AOT cost analysis)."""
-    try:
-        cost = jitted.lower(*args).compile().cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        f = float(cost.get("flops", -1.0))
-        return f if f > 0 else None
-    except Exception:  # noqa: BLE001 — cost analysis is best-effort
-        return None
+    return _costmodel().compiled_flops(jitted, *args)
 
 
 def _resnet_train_flops_per_img() -> float:
-    # 4.1 GFLOP fwd at 224^2 (2*MAC convention) * 3 for fwd+bwd
-    return 3 * 4.1e9
+    return _costmodel().resnet_train_flops_per_img()
 
 
-def _bert_train_flops_per_sample(seq, layers=12, d=768, ffn=3072) -> float:
-    # matmul MACs/token/layer: QKVO 4d^2, FFN 2*d*ffn, attention 2*L*d
-    per_tok = layers * (4 * d * d + 2 * d * ffn + 2 * seq * d)
-    return 3 * 2 * per_tok * seq  # fwd+bwd ~ 3x fwd; FLOPs = 2*MACs
+def _bert_train_flops_per_sample(seq, layers=12, d=768,
+                                 ffn=3072) -> float:
+    return _costmodel().bert_train_flops_per_sample(seq, layers=layers,
+                                                    d=d, ffn=ffn)
 
 
 def _attach_mfu(result, flops_per_sample, samples_per_sec, jitted=None,
                 jit_args=None):
-    import jax
-    analytic = flops_per_sample
-    compiled = None
-    if jitted is not None and jit_args is not None and \
-            os.environ.get("MXTPU_BENCH_COST_ANALYSIS", "1") == "1":
-        per_step = _compiled_flops(jitted, *jit_args)
-        if per_step is not None:
-            compiled = per_step
-    batch = result.get("batch", 1)
-    flops_per_step = compiled if compiled is not None \
-        else analytic * batch
-    result["tflops_delivered"] = round(
-        flops_per_step / batch * samples_per_sec / 1e12, 2)
-    result["flops_source"] = "xla_cost_analysis" if compiled is not None \
-        else "analytic_2mac"
-    peak = _chip_peak_flops(jax.devices()[0])
-    if peak is not None:
-        result["mfu"] = round(
-            flops_per_step / batch * samples_per_sec / peak, 4)
-        result["chip_peak_tflops_bf16"] = peak / 1e12
+    return _costmodel().attach_mfu(result, flops_per_sample,
+                                   samples_per_sec, jitted=jitted,
+                                   jit_args=jit_args)
+
+
+def _stamp_live_mfu(result: dict) -> dict:
+    """Attach the trainer-published live gauge (`train.mfu` as
+    ``mfu_live``): measured during the timed loop itself, null when the
+    chip peak is unknown (CPU) or telemetry is off — never a fake
+    zero (the PR 6 honesty rule)."""
+    from mxnet_tpu import telemetry as _telem
+    result["mfu_live"] = _telem.value("train.mfu")
     return result
 
 
@@ -417,6 +394,7 @@ def _bench_resnet(data_mode=None, iters=None, cost_analysis=True) -> dict:
                     data.data, label.data)
     _attach_mfu(result, _resnet_train_flops_per_img(), img_s, jitted,
                 jit_args)
+    _stamp_live_mfu(result)
     return result
 
 
@@ -542,6 +520,7 @@ def _bench_bert() -> dict:
     # analytic FLOPs: cross-checked against XLA cost analysis on TPU v5e
     # (77.9 vs 78.2 TFLOP/s delivered) — skips a costly AOT recompile
     _attach_mfu(result, _bert_train_flops_per_sample(seq), samples_s)
+    _stamp_live_mfu(result)
     _stamp_parallelism(result, trainer)
     try:
         result["flash_attention"] = _flash_evidence(batch, seq)
@@ -1025,10 +1004,11 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
                ("metric", "value", "unit", "vs_baseline") if k in result}
     extra = result.get("extra") or {}
     cands = []
-    for k in ("platform", "mfu", "tflops_delivered", "batch", "dtype",
-              "data", "s2d_stem", "flops_source", "steps_per_call",
-              "dispatch_ms_per_step", "platform_requested",
-              "platform_actual", "telemetry_schema_version"):
+    for k in ("platform", "mfu", "mfu_live", "tflops_delivered", "batch",
+              "dtype", "data", "s2d_stem", "flops_source",
+              "steps_per_call", "dispatch_ms_per_step",
+              "platform_requested", "platform_actual",
+              "telemetry_schema_version"):
         if k in result and result[k] is not None:
             cands.append((k, result[k]))
     par = result.get("parallelism") or {}

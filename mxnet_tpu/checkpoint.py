@@ -161,10 +161,19 @@ class AsyncCheckpointer:
             # one must not be — start it, then surface the old error
             prev_error = e
         ticket = _Ticket(desc)
+        # ISSUE 14: the writer thread's span parents under the trace
+        # that requested the save (capture here, activate on the thread)
+        from .telemetry import tracing as _tracing
+        trace_ctx = _tracing.capture()
 
         def run():
             try:
-                ticket.path = job()
+                with _tracing.activate(trace_ctx):
+                    t0 = _tracing.clock() if _tracing.enabled() else None
+                    ticket.path = job()
+                    if t0 is not None:
+                        _tracing.record("checkpoint.async_write", t0,
+                                        _tracing.clock(), path=desc)
             except BaseException as e:  # noqa: BLE001 — surfaced on wait()
                 ticket._error = MXNetError(
                     f"async checkpoint to {desc} failed: "

@@ -39,6 +39,7 @@ import time
 
 from ..base import MXNetError
 from .. import telemetry as _telem
+from ..telemetry import tracing as _trace
 from .membership import Membership  # noqa: F401  (re-exported surface)
 from .notices import DrainDeadline
 
@@ -391,6 +392,14 @@ class ElasticController:
             _telem.event("elastic.transition", source=info["source"],
                          dp=new_dp, epoch=self._applied_epoch,
                          rewind_step=info.get("step"))
+        if _trace.enabled():
+            # the transition on the causal timeline (ISSUE 14): the
+            # pause window with the reshard inside it — a training trace
+            # shows exactly which step boundary paid the resync
+            root = _trace.record("elastic.pause", t_pause, t1,
+                                 dp=new_dp, epoch=self._applied_epoch,
+                                 source=info["source"])
+            _trace.record("elastic.reshard", t0, t1, parent=root)
         return info
 
     def _make_mesh(self, dp, trainer=None):

@@ -10,6 +10,7 @@ import time
 from ...base import MXNetError
 from ... import metric as metric_mod
 from ... import autograd
+from ...telemetry import watchdog as _watchdog
 from ..trainer import Trainer
 
 __all__ = ["Estimator", "TrainBegin", "TrainEnd", "EpochBegin", "EpochEnd",
@@ -406,6 +407,14 @@ class Estimator:
                             if isinstance(h, BatchEnd):
                                 h.batch_end(self, pred=pred, label=b[1],
                                             loss=loss)
+                        if _watchdog.enabled() and loss is not None:
+                            # the health watchdog's loss rules tick
+                            # where the loss is ALREADY host-side
+                            # (MetricHandler's update just pulled this
+                            # same array) — no new device sync
+                            _watchdog.on_step(
+                                self.global_step,
+                                loss=float(loss.asnumpy().mean()))  # mxlint: disable=HB10 -- MetricHandler.batch_end already synced this loss; re-reading the host buffer adds no dispatch
                     preempted = preempt is not None and \
                         preempt.check_step(self.global_step)
                     rewound = False

@@ -38,6 +38,7 @@ from ..base import MXNetError
 from ..lint import racecheck as _racecheck
 from ..ndarray.ndarray import NDArray
 from .. import telemetry as _telem
+from ..telemetry import tracing as _tracing
 
 __all__ = ["DevicePrefetcher", "AsyncDecodeIter", "PipelineStats",
            "default_prefetch_depth"]
@@ -194,6 +195,8 @@ class DevicePrefetcher:
                                 # ahead of it by up to `depth` batches)
         self._skip = 0          # set_state replay-skip, applied by the
                                 # worker on ITS source iterator
+        self._trace_ctx = None  # ambient span captured at worker start
+                                # (ISSUE 14 cross-thread propagation)
 
     # -- sharding -------------------------------------------------------
     def _leaf_sharding(self, x):
@@ -279,6 +282,14 @@ class DevicePrefetcher:
         return False
 
     def _worker(self):
+        # spans the worker opens parent under the trace that was
+        # ambient when the consumer started it (tracing.capture in
+        # _ensure_started) — the prefetcher's decode/h2d stage spans
+        # land inside the training trace, not as orphan roots
+        with _tracing.activate(self._trace_ctx):
+            self._worker_body()
+
+    def _worker_body(self):
         try:
             it = iter(self._source)
             while self._skip > 0:   # set_state replay-skip (sources
@@ -312,11 +323,15 @@ class DevicePrefetcher:
             self.stats.add("h2d", t2 - t1, nbytes)
             _profiler_span("pipeline:decode", t0, t1)
             _profiler_span("pipeline:h2d", t1, t2)
+            if _tracing.enabled():
+                _tracing.record("io.decode", t0, t1)
+                _tracing.record("io.h2d", t1, t2, bytes=nbytes)
             if not self._enqueue((dev_item,)):
                 return
 
     def _ensure_started(self):
         if self._thread is None and not self._finished:
+            self._trace_ctx = _tracing.capture()
             self._queue = _queue.Queue(maxsize=self._depth)
             self._stop.clear()
             self._thread = threading.Thread(
@@ -345,6 +360,8 @@ class DevicePrefetcher:
         t_got = time.perf_counter()
         self.stats.add("stall", t_got - now)
         _profiler_span("pipeline:stall", now, t_got)
+        if _tracing.enabled():
+            _tracing.record("io.wait", now, t_got)
         if _telem.enabled():
             # read-ahead occupancy AFTER this get: depth batches queued
             # = the worker is fully ahead; 0 = the consumer is about to

@@ -35,6 +35,9 @@ from ..ndarray.ndarray import NDArray
 from ..ndarray import random as _rnd
 from .. import _tape
 from .. import telemetry as _telem
+from ..telemetry import tracing as _trace
+from ..telemetry import watchdog as _watchdog
+from ..telemetry import costmodel as _costmodel
 from ..gluon.parameter import _bind_params
 from ._compat import shard_map
 from .mesh import (current_mesh, make_mesh, MeshConfig,
@@ -149,6 +152,12 @@ class DataParallelTrainer:
         self._jit_zero1_cache = {}
         self._num_update = 0
         self._donate = donate
+        # live MFU accounting (ISSUE 14): per-compiled-step XLA FLOP
+        # cost, computed at most once per jitted object and only when
+        # the chip peak is known (costmodel.live_cost_enabled)
+        self._live_cost = {}         # id(jitted) -> (jitted, flops)
+        self._last_step_flops = None
+        self._live_peak = ()         # () = not yet resolved
 
     # -- parameter plumbing --------------------------------------------
     def _collect(self, *args):
@@ -396,6 +405,8 @@ class DataParallelTrainer:
         if self._pp_active():
             return self._pp_step(batch, n_micro=n_micro)
         t_step = _telem.clock() if _telem.enabled() else None
+        trc = _trace.enabled()
+        tt0 = _trace.clock() if trc else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         bax = self._eff_bax(inputs[-1].ndim, is_label=True)
@@ -433,16 +444,21 @@ class DataParallelTrainer:
             if jitted is None:
                 jitted = self._build_accum(n_micro)
                 self._jit_accum_cache[n_micro] = jitted
+        tt1 = _trace.clock() if trc else None
         inputs = self._put_batch(inputs)
+        tt2 = _trace.clock() if trc else None
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
         new_params, self._opt_state, loss = self._dispatch(
             jitted, self._param_vals, self._opt_state, lr, key, *inputs)
+        tt3 = _trace.clock() if trc else None
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
         self._record_step(1, t_step)
+        if trc:
+            self._trace_step_phases(tt0, tt1, tt2, tt3)
         return NDArray(loss)
 
     def _build_indexed(self):
@@ -733,9 +749,18 @@ class DataParallelTrainer:
         ex = self._pp_ensure()
         key = _rnd.next_key()
         lr = self.learning_rate
+        trc = _trace.enabled()
+        tt0 = _trace.clock() if trc else None
         loss = ex.step(inputs[0], inputs[1], key, lr, n_micro=n_micro)
         self._num_update += 1
         self._record_step(1, t_step)
+        if trc:
+            # host-driven 1F1B: the stage executor owns the inner
+            # schedule, so the step is one dispatch-phase span
+            root = _trace.record("train.step", tt0, _trace.clock(),
+                                 step=self._num_update, pp=True)
+            _trace.record("train.phase.dispatch", tt0, root.t1,
+                          parent=root)
         return NDArray(loss)
 
     # -- telemetry (ISSUE 9) --------------------------------------------
@@ -746,6 +771,12 @@ class DataParallelTrainer:
         profiler/XLA trace, not here).  An unhandled dispatch exception
         dumps the flight recorder before re-raising."""
         t0 = _telem.clock() if _telem.enabled() else None
+        if t0 is not None:
+            # live MFU (ISSUE 14): resolve this compiled step's XLA FLOP
+            # cost BEFORE dispatch (the args are donated by the call) —
+            # at most once per jitted object, and only when the chip
+            # peak is known (never on a plain CPU host)
+            self._maybe_live_cost(jitted, args)
         try:
             out = jitted(*args)
         except Exception as e:  # noqa: BLE001 — record, then re-raise
@@ -756,17 +787,57 @@ class DataParallelTrainer:
                            (_telem.clock() - t0) * 1e3)
         return out
 
+    def _maybe_live_cost(self, jitted, args):
+        """Cache the compiled step's XLA FLOP estimate (once per jitted
+        — the dict keeps the jitted alive so ids can't be reused) and
+        remember it as the cost of the step being dispatched."""
+        key = id(jitted)
+        hit = self._live_cost.get(key)
+        if hit is None:
+            flops = (_costmodel.compiled_flops(jitted, *args)
+                     if _costmodel.live_cost_enabled() else None)
+            hit = (jitted, flops)
+            self._live_cost[key] = hit
+        self._last_step_flops = hit[1]
+
     def _record_step(self, k, t_step0):
         """Publish per-step metrics after ``k`` steps committed; the
         ambient telemetry step context feeds event records and profiler
-        span tags."""
+        span tags.  When the compiled step's FLOP cost is known, the
+        live ``train.mfu`` / ``train.tflops_delivered`` gauges are O(1)
+        arithmetic on top; the health watchdog ticks at the same seam."""
         if t_step0 is None:
             return
+        dt_s = _telem.clock() - t_step0
         _telem.set_context(step=self._num_update)
         _telem.inc("train.steps", k)
-        _telem.observe("train.step_ms",
-                       (_telem.clock() - t_step0) * 1e3 / max(k, 1))
+        _telem.observe("train.step_ms", dt_s * 1e3 / max(k, 1))
         _telem.set_gauge("train.num_update", self._num_update)
+        flops = self._last_step_flops
+        if flops and dt_s > 0:
+            if self._live_peak == ():
+                self._live_peak = _costmodel.chip_peak_flops()
+            _telem.set_gauge("train.step_flops", flops / max(k, 1))
+            _telem.set_gauge("train.tflops_delivered",
+                             round(flops / dt_s / 1e12, 4))
+            if self._live_peak:
+                _telem.set_gauge("train.mfu",
+                                 round(flops / dt_s / self._live_peak, 4))
+        _watchdog.on_step(self._num_update,
+                          step_ms=dt_s * 1e3 / max(k, 1))
+
+    def _trace_step_phases(self, t0, t1, t2, t3):
+        """Commit the per-step phase span tree (ISSUE 14): one
+        ``train.step`` root whose children tile it exactly —
+        prepare (param collect / plan / device state), h2d (batch
+        placement), dispatch (the compiled call), commit (host-side
+        param bookkeeping + metric publication)."""
+        t4 = _trace.clock()
+        root = _trace.record("train.step", t0, t4, step=self._num_update)
+        _trace.record("train.phase.prepare", t0, t1, parent=root)
+        _trace.record("train.phase.h2d", t1, t2, parent=root)
+        _trace.record("train.phase.dispatch", t2, t3, parent=root)
+        _trace.record("train.phase.commit", t3, t4, parent=root)
 
     # -- public API -----------------------------------------------------
     @property
@@ -784,6 +855,8 @@ class DataParallelTrainer:
         if self._pp_active():
             return self._pp_step(batch)
         t_step = _telem.clock() if _telem.enabled() else None
+        trc = _trace.enabled()
+        tt0 = _trace.clock() if trc else None
         inputs = [b.data if isinstance(b, NDArray) else jnp.asarray(b)
                   for b in batch]
         params = self._collect(*[NDArray(b) for b in inputs[:-1]])
@@ -799,16 +872,21 @@ class DataParallelTrainer:
             if self._jitted is None:
                 self._build()
             jitted = self._jitted
+        tt1 = _trace.clock() if trc else None
         inputs = self._put_batch(inputs)
+        tt2 = _trace.clock() if trc else None
         key = _rnd.next_key()
         lr = jnp.asarray(self.learning_rate, jnp.float32)
         new_params, self._opt_state, loss = self._dispatch(
             jitted, self._param_vals, self._opt_state, lr, key, *inputs)
+        tt3 = _trace.clock() if trc else None
         self._num_update += 1
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
         self._record_step(1, t_step)
+        if trc:
+            self._trace_step_phases(tt0, tt1, tt2, tt3)
         return NDArray(loss)
 
     def step_multi(self, batches, n_micro=1):
@@ -831,6 +909,8 @@ class DataParallelTrainer:
         entry points, restoring today's graphs exactly.
         """
         t_step = _telem.clock() if _telem.enabled() else None
+        trc = _trace.enabled()
+        tt0 = _trace.clock() if trc else None
         batches = list(batches)
         k = len(batches)
         if k < 1:
@@ -880,7 +960,9 @@ class DataParallelTrainer:
             if jitted is None:
                 jitted = self._build_multi(k, n_micro)
                 self._jit_multi_cache[(k, n_micro)] = jitted
+        tt1 = _trace.clock() if trc else None
         stacked = self._put_stacked(steps)
+        tt2 = _trace.clock() if trc else None
         # per-step keys/lrs drawn from the SAME host streams the K=1
         # path uses — this is what makes K>1 bitwise-match K=1
         keys = jnp.stack([_rnd.next_key() for _ in range(k)])
@@ -893,11 +975,14 @@ class DataParallelTrainer:
         new_params, self._opt_state, losses = self._dispatch(
             jitted, self._param_vals, self._opt_state, lrs, keys,
             *stacked)
+        tt3 = _trace.clock() if trc else None
         self._num_update += k
         self._param_vals = list(new_params)
         for p, v in zip(params, new_params):
             p._data._set_data(v)
         self._record_step(k, t_step)
+        if trc:
+            self._trace_step_phases(tt0, tt1, tt2, tt3)
         return NDArray(losses)
 
     def put_epoch(self, superdata, superlabel):

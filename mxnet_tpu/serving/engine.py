@@ -62,6 +62,7 @@ import numpy as _np
 
 from ..base import MXNetError, NotSupportedError
 from .. import telemetry as _telem
+from ..telemetry import tracing as _trace
 from .kv_cache import PagedKVCache
 
 __all__ = ["InferenceEngine", "next_bucket"]
@@ -556,6 +557,7 @@ class InferenceEngine:
         fn = self._compiled.get(sig)
         if fn is None:
             import jax
+            tc0 = _trace.clock() if _trace.enabled() else None
             build = {"prefill": self._build_prefill,
                      "decode": self._build_decode,
                      "chunk": self._build_chunk_prefill,
@@ -566,6 +568,11 @@ class InferenceEngine:
             self._compiled[sig] = fn
             self.stats["compiles"] += 1
             _telem.inc("serving.compiles")
+            if tc0 is not None:
+                # compiles on the request timeline: a warmup-miss that
+                # stalls traffic is visible exactly where it hurt
+                _trace.record("engine.compile", tc0, _trace.clock(),
+                              kind=kind, size=int(size))
             if self._warmed:
                 # the tier-1 zero-retrace assertion reads the engine's
                 # own counter; the registry twin is what a live scrape

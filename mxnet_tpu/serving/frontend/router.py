@@ -39,6 +39,7 @@ import threading
 
 from ...base import MXNetError
 from ... import telemetry as _telem
+from ...telemetry import tracing as _trace
 from ...lint import racecheck as _racecheck
 from ..scheduler import ContinuousBatcher
 
@@ -81,8 +82,11 @@ class Replica:
         depth = len(b.queue) + int(inbox_len) + len(b.active) + \
             len(getattr(b, "prefilling", ()))
         recent = self.ttfts[-8:]
+        # None, not 0.0, before the first measured TTFT: an unmeasured
+        # replica must read as "no signal", never as "perfect" (the
+        # r04/r05 null-when-unmeasured convention — ISSUE 14 fix)
         ttft_ms = (sorted(recent)[len(recent) // 2] * 1e3
-                   if recent else 0.0)
+                   if recent else None)
         return {"queue_depth": depth,
                 "ttft_ms": ttft_ms,
                 "kv_block_utilization": self.engine.cache.utilization()}
@@ -127,6 +131,7 @@ class Router:
         self._factory = engine_factory
         self._prefills_per_step = prefills_per_step
         self._notices = None       # elastic.NoticeBoard (ISSUE 13)
+        self._trace_ctx = None     # ambient span captured at start()
         self.compile_cache = {}
         self.replicas = []
         warm0 = None
@@ -178,7 +183,7 @@ class Router:
         b.active.clear()
         return lost, epoch
 
-    def _requeue_all(self, lost):
+    def _requeue_all(self, lost, from_rid=None):
         for req in lost:
             # reset to the prompt: greedy decode reproduces the exact
             # stream on the new replica, so nothing is lost or doubled
@@ -186,6 +191,15 @@ class Router:
             req.finish_reason = None
             req.first_token_t = None
             req.finish_t = None
+            req._queue_t0 = None
+            if _trace.enabled() and req.trace is not None:
+                # the requeue hop is an instant marker in the SAME
+                # trace: the re-admission chain parents under the
+                # original root, so a drained request's timeline stays
+                # one causally-linked tree across replicas
+                t = _trace.clock()
+                _trace.record("requeue", t, t, parent=req.trace,
+                              from_rid=from_rid)
             with self._lock:
                 self.requeues += 1
             self.submit(req, _requeue=True)
@@ -203,7 +217,7 @@ class Router:
         _telem.event("serving.replica_dead", rid=rep.rid,
                      epoch=epoch, requeued=len(lost))
         _telem.inc("serving.replica_deaths")
-        self._requeue_all(lost)
+        self._requeue_all(lost, from_rid=rep.rid)
 
     def drain_replica(self, rid, reason="admin"):
         """Graceful exit for a DOOMED (preemption-noticed) or
@@ -224,7 +238,7 @@ class Router:
                      epoch=epoch, requeued=len(lost),
                      reason=str(reason))
         _telem.inc("serving.replica_drains")
-        self._requeue_all(lost)
+        self._requeue_all(lost, from_rid=rep.rid)
         return len(lost)
 
     def add_replica(self):
@@ -309,28 +323,38 @@ class Router:
     def _signals(self, rep):
         """Per-replica load signals THROUGH the telemetry registry when
         it's live (the published gauges are the fleet's source of
-        truth), falling back to direct reads."""
+        truth), falling back to direct reads.  Unmeasured signals are
+        ``None`` — "no signal", NEVER a fake-perfect 0.0 (the r04/r05
+        null-when-unmeasured convention): scoring drops any signal not
+        measured on every candidate rather than letting an unmeasured
+        replica win admission on numbers nobody observed."""
         if _telem.enabled():
             pre = f"serving.replica{rep.rid}."
             depth = _telem.value(pre + "queue_depth")
             if depth is not None:
                 return {"queue_depth": depth,
-                        "ttft_ms": _telem.value(pre + "ttft_ms") or 0.0,
+                        "ttft_ms": _telem.value(pre + "ttft_ms"),
                         "kv_block_utilization":
-                            _telem.value(pre + "kv_block_utilization")
-                            or 0.0}
+                            _telem.value(pre + "kv_block_utilization")}
         with self._lock:
             inbox_len = len(rep.inbox)
         return rep.load_signals(inbox_len)
 
-    def _score(self, sig):
+    def _score(self, sig, use_ttft=True, use_kv=True):
         # queue depth dominates (each queued request is a whole
         # generation of latency); KV pressure breaks ties between
         # equally-deep queues; TTFT drift demotes a replica that has
-        # been serving slowly even when its queue momentarily clears
-        return (2.0 * sig["queue_depth"]
-                + 1.0 * sig["kv_block_utilization"]
-                + 0.001 * sig["ttft_ms"])
+        # been serving slowly even when its queue momentarily clears.
+        # A signal class unmeasured on ANY candidate is excluded for
+        # ALL (the caller passes use_*) — scores stay comparable and
+        # admission falls back to queue depth alone when that is the
+        # only signal every replica actually has.
+        s = 2.0 * sig["queue_depth"]
+        if use_kv:
+            s += 1.0 * sig["kv_block_utilization"]
+        if use_ttft:
+            s += 0.001 * sig["ttft_ms"]
+        return s
 
     def submit(self, request, _requeue=False):
         """Admit ``request`` to the least-loaded live replica.  While
@@ -349,9 +373,22 @@ class Router:
         live = self.live_replicas()
         if not live:
             raise MXNetError("router: no live replicas")
-        scored = [(self._score(self._signals(r)), r.rid, r) for r in live]
+        ta0 = _trace.clock() if _trace.enabled() else None
+        sigs = [self._signals(r) for r in live]
+        # null-honesty: only score on signal classes EVERY candidate
+        # has measured; otherwise fall back to queue depth alone
+        use_ttft = all(s["ttft_ms"] is not None for s in sigs)
+        use_kv = all(s["kv_block_utilization"] is not None for s in sigs)
+        scored = [(self._score(s, use_ttft, use_kv), r.rid, r)
+                  for s, r in zip(sigs, live)]
         scored.sort(key=lambda t: (t[0], t[1]))
         rep = scored[0][2]
+        if ta0 is not None:
+            if request.trace is None:
+                request.trace = _trace.start("request", id=request.id)
+            _trace.record("admission", ta0, _trace.clock(),
+                          parent=request.trace, rid=rep.rid,
+                          requeue=bool(_requeue))
         with self._lock:
             if not _requeue:
                 self._submitted[request.id] = request
@@ -375,6 +412,7 @@ class Router:
         rep.boundaries += 1
         faults.fault_point(f"serving.replica{rep.rid}.step",
                            payload=rep.boundaries)
+        tb0 = _trace.clock() if _trace.enabled() else None
         self._drain_inbox(rep)
         n_fin = len(rep.batcher.finished)
         moved = rep.batcher.step()
@@ -382,14 +420,24 @@ class Router:
             t = req.ttft()
             if t is not None:
                 rep.ttfts.append(t)
+        if tb0 is not None:
+            # boundary span parents under the driver's ambient trace
+            # (the worker thread activates the context captured at
+            # start(); drive() runs on the caller's own ambient)
+            _trace.record("serving.boundary", tb0, _trace.clock(),
+                          rid=rep.rid)
         if _telem.enabled():
             with self._lock:
                 inbox_len = len(rep.inbox)
             sig = rep.load_signals(inbox_len)
             pre = f"serving.replica{rep.rid}."
             _telem.set_gauge(pre + "queue_depth", sig["queue_depth"])
-            _telem.set_gauge(pre + "ttft_ms",
-                             round(sig["ttft_ms"], 3))
+            if sig["ttft_ms"] is not None:
+                # never publish a fake-perfect 0.0 before the first
+                # measured TTFT: the gauge stays absent => value() is
+                # None => admission scoring treats it as "no signal"
+                _telem.set_gauge(pre + "ttft_ms",
+                                 round(sig["ttft_ms"], 3))
             _telem.set_gauge(pre + "kv_block_utilization",
                              round(sig["kv_block_utilization"], 4))
         return moved
@@ -431,6 +479,7 @@ class Router:
         """Spawn one worker thread per replica (production shape).
         Each worker owns its replica exclusively; it sleeps on the
         router condition variable when idle (no polling)."""
+        self._trace_ctx = _trace.capture()
         for rep in self.replicas:
             if rep.thread is not None:
                 continue
@@ -442,6 +491,12 @@ class Router:
         return self
 
     def _worker(self, rep):
+        # worker spans parent under the trace ambient at start()
+        # (ISSUE 14 cross-thread propagation)
+        with _trace.activate(getattr(self, "_trace_ctx", None)):
+            self._worker_loop(rep)
+
+    def _worker_loop(self, rep):
         while True:
             with self._lock:
                 while (rep.alive and not self._stopping

@@ -38,6 +38,8 @@ from collections import deque
 
 from ..base import MXNetError
 from .. import telemetry as _telem
+from ..telemetry import tracing as _trace
+from ..telemetry import watchdog as _watchdog
 
 __all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
 
@@ -61,6 +63,11 @@ class Request:
         self.finish_t = None
         self.generated = []
         self.finish_reason = None     # "eos" | "length"
+        # causal tracing (ISSUE 14): the root span of this request's
+        # life — created at first admission, SURVIVES a drain/requeue
+        # hop (the requeued chain parents under the same root)
+        self.trace = None
+        self._queue_t0 = None         # current queue-residency start
 
     @property
     def done(self):
@@ -90,6 +97,10 @@ class _BatcherBase:
 
     def submit(self, request):
         request.submit_t = time.perf_counter()
+        if _trace.enabled():
+            if request.trace is None:
+                request.trace = _trace.start("request", id=request.id)
+            request._queue_t0 = _trace.clock()
         self.queue.append(request)
         return request
 
@@ -98,9 +109,20 @@ class _BatcherBase:
     def _admit_one(self, slot, req):
         """Prefill ``req`` into ``slot``; returns True on admission.
         The first generated token comes from the prefill itself."""
+        tp0 = _trace.clock() if _trace.enabled() else None
         out = self.engine.prefill(slot, req.tokens)
         if out is None:
             return False
+        if tp0 is not None:
+            # admission succeeded: queue residency ends where the
+            # prefill begins; both parent under the request root
+            if req._queue_t0 is not None:
+                _trace.record("queue", req._queue_t0, tp0,
+                              parent=req.trace)
+                req._queue_t0 = None
+            _trace.record("prefill", tp0, _trace.clock(),
+                          parent=req.trace, slot=slot,
+                          tokens=len(req.tokens))
         tok, _logits = out
         req.first_token_t = time.perf_counter()
         if _telem.enabled() and req.submit_t is not None:
@@ -121,6 +143,8 @@ class _BatcherBase:
             req.finish_t = time.perf_counter()
             self.engine.release(slot)
             self.finished.append(req)
+            _trace.finish(req.trace, reason=req.finish_reason,
+                          tokens=len(req.generated))
             if _telem.enabled():
                 _telem.inc("serving.requests_finished")
                 lat = req.latency()
@@ -130,6 +154,7 @@ class _BatcherBase:
 
     def _decode_active(self, active):
         """One joined decode step over ``active`` {slot: request}."""
+        td0 = _trace.clock() if _trace.enabled() else None
         entries = []
         for slot, req in active.items():
             pos = len(req.tokens) + len(req.generated) - 1
@@ -142,6 +167,14 @@ class _BatcherBase:
         nxt, _logits = self.engine.decode(entries)
         self.decode_steps += 1
         self.occupancy_samples.append(len(entries) / self.engine.max_batch)
+        if td0 is not None:
+            # one joined dispatch, one span PER REQUEST (same [t0,t1],
+            # each parented under its own request root): every request's
+            # chain carries all N of its decode boundaries
+            td1 = _trace.clock()
+            for slot, _t, pos in entries:
+                _trace.record("decode", td0, td1,
+                              parent=active[slot].trace, pos=pos)
         if _telem.enabled():
             # per-boundary scheduler state: what a live scrape of a
             # serving pod needs to spot admission stalls (ISSUE 9)
@@ -151,6 +184,12 @@ class _BatcherBase:
                            edges=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
                                   0.875, 1.0))
             _telem.inc("serving.decode_steps")
+        if _watchdog.enabled():
+            # the serving health rules tick at the same boundary seam
+            # (host ints only — queue saturation + KV-leak trend)
+            _watchdog.on_serving_boundary(
+                queue_depth=len(self.queue),
+                kv_blocks_in_use=self.engine.cache.blocks_in_use)
         for (slot, _t, _p), tok in zip(entries, nxt):
             self._append_token(active[slot], slot, tok)
         for slot in [s for s, r in active.items() if r.done]:
@@ -267,6 +306,10 @@ class ContinuousBatcher(_BatcherBase):
                 break                       # cannot even open a table
             self.queue.popleft()
             self._free_slots.pop()
+            if _trace.enabled() and req._queue_t0 is not None:
+                _trace.record("queue", req._queue_t0, _trace.clock(),
+                              parent=req.trace, prefix_hit=start)
+                req._queue_t0 = None
             st = _PrefillState(req, slot, start)
             self.prefilling[slot] = st
             entries.append((slot, req.tokens[start:start + C], start))
@@ -274,6 +317,7 @@ class ContinuousBatcher(_BatcherBase):
             admitted += 1
         if not entries:
             return admitted
+        tc0 = _trace.clock() if _trace.enabled() else None
         out = eng.chunk_prefill(entries)
         if out is None and eng.prefix_cache is not None:
             # pool pressure: evict LRU chains no request still shares
@@ -289,6 +333,14 @@ class ContinuousBatcher(_BatcherBase):
             # retry next boundary (decode frees blocks as requests end)
             return admitted
         nxt, _logits = out
+        if tc0 is not None:
+            # one packed dispatch, one span per packed ROW — each
+            # chunk parents under its own request's root
+            tc1 = _trace.clock()
+            for slot, chunk, start in entries:
+                _trace.record("prefill_chunk", tc0, tc1,
+                              parent=rows[slot].req.trace, slot=slot,
+                              start=start, tokens=len(chunk))
         for i, (slot, chunk, start) in enumerate(entries):
             st = rows[slot]
             st.done = start + len(chunk)

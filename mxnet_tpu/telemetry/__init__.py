@@ -42,6 +42,9 @@ from .registry import (MetricsRegistry, Counter, Gauge, Histogram,
 from .events import EventLog, SCHEMA_VERSION
 from .flight import FlightRecorder
 from .prom import prom_text as _render_prom
+from . import tracing
+from . import watchdog
+from . import costmodel
 
 __all__ = ["SCHEMA_VERSION", "enabled", "registry", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "value", "event",
@@ -49,7 +52,8 @@ __all__ = ["SCHEMA_VERSION", "enabled", "registry", "counter", "gauge",
            "flight", "dump_flight", "last_flight_dump", "on_fault",
            "on_preemption", "on_step_error", "reset", "configure",
            "clock", "MetricsRegistry", "EventLog", "FlightRecorder",
-           "Counter", "Gauge", "Histogram", "DEFAULT_MS_EDGES"]
+           "Counter", "Gauge", "Histogram", "DEFAULT_MS_EDGES",
+           "tracing", "watchdog", "costmodel"]
 
 
 def _env_enabled():
@@ -275,7 +279,11 @@ def reset():
     """Clear metrics, events, context and the last-dump marker IN PLACE
     (module references held by instrumented sites stay valid).  The
     conftest autouse hook calls this between tests so metric assertions
-    can't pair-flake — the profiler.reset() discipline."""
+    can't pair-flake — the profiler.reset() discipline.  The tracing
+    ring and the watchdog rule state are process-global in the same
+    way and reset alongside (both re-read their env kill switches)."""
     _REGISTRY.reset()
     _EVENTS.reset()
     _FLIGHT.last_dump_path = None
+    tracing.reset()
+    watchdog.reset()

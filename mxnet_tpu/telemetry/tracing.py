@@ -1,0 +1,371 @@
+"""End-to-end causal tracing: trace/span trees over the telemetry spine.
+
+PR 9's registry records *aggregate* metrics (a TTFT histogram, a
+step_ms gauge) but nothing causally links one request's life (router
+admission -> queue -> prefill chunk(s) -> decode boundaries -> finish)
+or one training step's phases (prepare -> h2d -> dispatch -> commit).
+The MLPerf TPU-pod analysis (arXiv:1909.09756) and the
+concurrency-limits study (arXiv:2011.03641) attribute their wins to
+exactly this per-phase timeline attribution — you cannot close an MFU
+gap or a p99 tail you cannot decompose.  This module is that timeline:
+
+- **spans** with deterministic per-process ids (monotonic counters —
+  two identical runs produce identical trees, the twin-request gate in
+  tests/test_tracing.py), a ``trace`` id (the root span's id), a
+  ``parent`` id, ``[t0, t1]`` stamps from an injectable clock, and
+  JSON-able ``args``;
+- **ambient context** per thread (:func:`span` nests automatically)
+  with EXPLICIT cross-thread propagation — :func:`capture` on the
+  owning thread, :func:`activate` on the worker (``DevicePrefetcher``,
+  router replica workers, the async checkpoint writer all do this), so
+  a span started on a worker thread parents under the trace that
+  spawned the work;
+- **manual spans** (:func:`start` / :func:`finish` / :func:`record`)
+  for lifecycles that cross call boundaries — a serving request's root
+  span lives on the ``Request`` object from admission to finish,
+  surviving a drain-and-requeue hop across replicas;
+- **Chrome-trace/perfetto export** (:func:`chrome_trace`): finished
+  spans as complete ``"X"`` events merged with the existing
+  ``profiler.record_span`` B/E stream — one timeline for both
+  (``tools/telemetry_dump.py --trace out.json``).
+
+``MXTPU_TRACE=0`` is a bitwise-inert kill switch in the PR 9 style:
+every helper is one module-bool check, :func:`span` hands back one
+shared no-op context manager, and nothing allocates.  The ring is
+bounded by ``MXTPU_TRACE_RING`` (default 4096 finished spans).  Span
+taxonomy and the export workflow: docs/OBSERVABILITY.md §Tracing.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import deque
+
+from ..lint import racecheck as _racecheck
+
+__all__ = ["Span", "enabled", "configure", "configure_from_env",
+           "reset", "clock", "span", "start", "finish", "record",
+           "current", "capture", "activate", "spans", "chrome_trace"]
+
+
+def _env_enabled():
+    return os.environ.get("MXTPU_TRACE", "1") != "0"
+
+
+def _env_ring():
+    try:
+        return max(1, int(os.environ.get("MXTPU_TRACE_RING", "4096")))
+    except ValueError:
+        return 4096
+
+
+class Span:
+    """One timed, named node of a trace tree.  ``trace`` is the root
+    span's id; ``parent`` is None on roots.  ``t1`` is None while the
+    span is open (open spans never export)."""
+
+    __slots__ = ("name", "trace", "span", "parent", "t0", "t1",
+                 "thread", "args")
+
+    def __init__(self, name, trace, span_id, parent, t0, args):
+        self.name = name
+        self.trace = trace
+        self.span = span_id
+        self.parent = parent
+        self.t0 = t0
+        self.t1 = None
+        self.thread = threading.current_thread().name
+        self.args = args
+
+    def to_record(self):
+        return {"name": self.name, "trace": self.trace,
+                "span": self.span, "parent": self.parent,
+                "t0": self.t0, "t1": self.t1, "thread": self.thread,
+                "args": dict(self.args)}
+
+
+class _NullSpan:
+    """The disabled-mode span: one shared instance, every method a
+    no-op, usable as a context manager and as a ``parent=``."""
+
+    __slots__ = ()
+    name = trace = span = parent = t0 = t1 = None
+    args = {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class Tracer:
+    """The process-wide span store: deterministic id counter, bounded
+    finished-span ring, per-thread ambient span stack."""
+
+    def __init__(self, ring_size=4096, now=None):
+        self.ring_size = int(ring_size)
+        self._now = now if now is not None else time.perf_counter
+        self._lock = _racecheck.make_lock("telemetry.Tracer._lock")
+        self._ring = deque(maxlen=self.ring_size)   # guarded-by: _lock
+        self._next_id = 0                           # guarded-by: _lock
+        self._tls = threading.local()               # per-thread ambient
+
+    # -- ids / ambient ---------------------------------------------------
+    def _new_id(self):
+        with self._lock:
+            self._next_id += 1
+            return self._next_id
+
+    def _stack(self):
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def current(self):
+        st = self._stack()
+        return st[-1] if st else None
+
+    # -- span lifecycle --------------------------------------------------
+    def start(self, name, parent=None, **args):
+        """Open a span (NOT pushed as ambient — the manual API for
+        lifecycles that cross call boundaries).  ``parent`` defaults to
+        the ambient span; a root span's ``trace`` is its own id."""
+        if parent is None:
+            parent = self.current()
+        sid = self._new_id()
+        if parent is None or parent is NULL_SPAN:
+            return Span(name, sid, sid, None, self._now(), args)
+        return Span(name, parent.trace, sid, parent.span, self._now(),
+                    args)
+
+    def finish(self, sp, **args):
+        """Stamp ``t1`` and commit ``sp`` to the ring.  Idempotent on
+        the null span and on already-finished spans."""
+        if sp is None or sp is NULL_SPAN or sp.t1 is not None:
+            return sp
+        sp.t1 = self._now()
+        if args:
+            sp.args.update(args)
+        with self._lock:
+            self._ring.append(sp.to_record())
+        return sp
+
+    def record(self, name, t0, t1, parent=None, **args):
+        """Commit an already-timed ``[t0, t1]`` span in one call (the
+        pre-timed form: decode boundaries, prefetcher stage times)."""
+        if parent is None:
+            parent = self.current()
+        sid = self._new_id()
+        if parent is None or parent is NULL_SPAN:
+            sp = Span(name, sid, sid, None, t0, args)
+        else:
+            sp = Span(name, parent.trace, sid, parent.span, t0, args)
+        sp.t1 = t1
+        with self._lock:
+            self._ring.append(sp.to_record())
+        return sp
+
+    def push(self, sp):
+        self._stack().append(sp)
+
+    def pop(self, sp):
+        st = self._stack()
+        if st and st[-1] is sp:
+            st.pop()
+
+    def spans(self):
+        """Finished spans, oldest first (copies — the ring moves on)."""
+        with self._lock:
+            return [dict(r) for r in self._ring]
+
+    def reset(self):
+        with self._lock:
+            self._ring.clear()
+            self._next_id = 0
+        # the calling thread's ambient stack; other threads' stacks die
+        # with their work
+        self._tls = threading.local()
+
+
+_ENABLED = _env_enabled()
+_TRACER = Tracer(ring_size=_env_ring())
+
+
+def configure(enabled=None, ring_size=None, now=None):
+    """Reconfigure tracing (tests; production configures via env).
+    ``now`` injects the span clock — the FakeClock seam the
+    twin-request determinism gate uses."""
+    global _ENABLED, _TRACER
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if ring_size is not None or now is not None:
+        _TRACER = Tracer(
+            ring_size=ring_size if ring_size is not None
+            else _TRACER.ring_size,
+            now=now if now is not None else _TRACER._now)
+    return _ENABLED
+
+
+def configure_from_env():
+    return configure(enabled=_env_enabled(), ring_size=_env_ring())
+
+
+def enabled():
+    """Whether tracing is live (``MXTPU_TRACE`` != 0).  Hot paths check
+    this ONCE and skip their clock reads entirely when off — the
+    zero-overhead contract."""
+    return _ENABLED
+
+
+def clock():
+    """The tracer's span clock (perf_counter unless injected)."""
+    return _TRACER._now()
+
+
+class _Scope:
+    """The ambient context-manager span: child of the current ambient
+    span, itself ambient for the scope's duration."""
+
+    __slots__ = ("_sp",)
+
+    def __init__(self, name, args):
+        self._sp = _TRACER.start(name, **args)
+
+    def __enter__(self):
+        _TRACER.push(self._sp)
+        return self._sp
+
+    def __exit__(self, *exc):
+        _TRACER.pop(self._sp)
+        _TRACER.finish(self._sp)
+        return False
+
+
+def span(name, **args):
+    """Scoped span: ``with tracing.span("train.step", step=i): ...`` —
+    nests under the ambient span and is ambient inside the scope."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return _Scope(name, args)
+
+
+def start(name, parent=None, **args):
+    """Open a manual span (see :meth:`Tracer.start`); finish it with
+    :func:`finish`.  Returns the shared null span when disabled."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return _TRACER.start(name, parent=parent, **args)
+
+
+def finish(sp, **args):
+    if not _ENABLED:
+        return sp
+    return _TRACER.finish(sp, **args)
+
+
+def record(name, t0, t1, parent=None, **args):
+    """Commit a pre-timed span (no-op when disabled)."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return _TRACER.record(name, t0, t1, parent=parent, **args)
+
+
+def current():
+    """The ambient span on THIS thread (None when none or disabled)."""
+    if not _ENABLED:
+        return None
+    return _TRACER.current()
+
+
+def capture():
+    """Snapshot the ambient span for hand-off to a worker thread:
+    ``ctx = tracing.capture()`` on the owner, ``with
+    tracing.activate(ctx):`` on the worker — spans the worker opens
+    then parent under the owner's trace."""
+    if not _ENABLED:
+        return None
+    return _TRACER.current()
+
+
+class _Activation:
+    __slots__ = ("_ctx", "_pushed")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._pushed = False
+
+    def __enter__(self):
+        if _ENABLED and self._ctx is not None \
+                and self._ctx is not NULL_SPAN:
+            _TRACER.push(self._ctx)
+            self._pushed = True
+        return self._ctx
+
+    def __exit__(self, *exc):
+        if self._pushed:
+            _TRACER.pop(self._ctx)
+        return False
+
+
+def activate(ctx):
+    """Install a :func:`capture`\\ d span as this thread's ambient
+    context for the scope's duration (worker-thread half of the
+    propagation hand-shake).  Safe with ``ctx=None`` (no-op)."""
+    return _Activation(ctx)
+
+
+def spans():
+    """Finished span records, oldest first ([] when disabled)."""
+    if not _ENABLED:
+        return []
+    return _TRACER.spans()
+
+
+def reset():
+    """Fresh tracer: empty ring, id counter at zero, DEFAULT clock, env
+    kill switch re-read (the conftest between-tests seam) — a test that
+    injected a FakeClock or disabled tracing can't leak either."""
+    global _ENABLED, _TRACER
+    _ENABLED = _env_enabled()
+    _TRACER = Tracer(ring_size=_env_ring())
+
+
+# -- export -------------------------------------------------------------
+
+def chrome_trace(include_profiler=True):
+    """The merged Chrome-trace JSON object: every finished tracing span
+    as a complete ``"X"`` event (ts/dur in microseconds, ``args``
+    carrying trace/span/parent ids for perfetto correlation) plus —
+    when ``include_profiler`` — the ``profiler.record_span`` B/E event
+    stream, so XLA-adjacent pipeline spans and causal request/step
+    spans land on ONE timeline.  Valid input for chrome://tracing and
+    https://ui.perfetto.dev."""
+    pid = os.getpid()
+    events = []
+    tids = {}
+    for r in spans():
+        tid = tids.setdefault(r["thread"], len(tids))
+        events.append({
+            "name": r["name"], "ph": "X", "pid": pid, "tid": tid,
+            "ts": r["t0"] * 1e6,
+            "dur": max(0.0, (r["t1"] - r["t0"]) * 1e6),
+            "args": dict(r["args"], trace=r["trace"], span=r["span"],
+                         parent=r["parent"]),
+        })
+    if include_profiler:
+        from .. import profiler
+        ptid = len(tids)
+        for name, ph, ts, extra in profiler._STATE["events"]:
+            ev = {"name": name, "ph": ph, "ts": ts * 1e6, "pid": pid,
+                  "tid": ptid}
+            ev.update(extra)
+            events.append(ev)
+    meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+             "args": {"name": thread}} for thread, tid in tids.items()]
+    return {"traceEvents": meta + events}

@@ -1,0 +1,279 @@
+"""Health watchdog: declarative run-health rules over the telemetry
+spine (ISSUE 14).
+
+PR 13's degradation ladder reacts to *capacity* signals; run *health*
+— a NaN loss, a silent step-time stall, a KV-block leak — went
+unwatched: the job limps until a human reads a dashboard.  The
+watchdog is a set of cheap declarative rules ticked at the seams the
+code already crosses (the trainer's per-step bookkeeping, the
+estimator's loss pull, every serving scheduling boundary); each
+firing emits a typed ``watchdog.<rule>`` event, bumps the
+``watchdog.trips`` counter, and dumps the PR 9 flight recorder with
+``reason="watchdog:<rule>"`` — the post-mortem exists the moment the
+run goes bad, not when it finally dies.
+
+Rule catalog (docs/OBSERVABILITY.md §Watchdog):
+
+``nonfinite_loss``     loss is NaN/Inf at a step boundary
+``nonfinite_grad``     gradient norm is NaN/Inf
+``loss_spike``         loss > spike_factor x the trailing-window mean
+``step_stall``         no step committed for ``stall_s`` seconds
+                       (injectable clock — FakeClock in tests/chaos),
+                       or one step alone took ``stall_s``
+``queue_saturation``   serving queue depth >= ``queue_depth`` for
+                       ``queue_boundaries`` consecutive boundaries
+``kv_leak``            the per-window MINIMUM of ``kv_blocks_in_use``
+                       strictly rose ``kv_windows`` windows in a row —
+                       blocks never return to the pool even at the
+                       emptiest boundary of each window (a refcount
+                       leak trend, not normal load growth)
+
+Each rule re-arms only after its condition clears (one incident, one
+event — not one per step of a long NaN plateau).  ``MXTPU_WATCHDOG=0``
+is a bitwise-inert kill switch in the PR 9 style: every hook is one
+module-bool check and nothing allocates.  The NaN-loss chaos scenario
+injects through the ``watchdog.loss`` fault point
+(``testing/faults.py``) so the detection path is exactly the
+production one.
+"""
+from __future__ import annotations
+
+import math
+import os
+from collections import deque
+
+from ..lint import racecheck as _racecheck
+
+__all__ = ["Watchdog", "enabled", "watchdog", "configure", "reset",
+           "on_step", "on_serving_boundary", "check"]
+
+
+def _env_enabled():
+    return os.environ.get("MXTPU_WATCHDOG", "1") != "0"
+
+
+def _env_float(name, default):
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return float(default)
+
+
+class Watchdog:
+    """The rule engine.  ``now`` is the stall clock (injectable —
+    ``testing.faults.FakeClock`` in tests and chaos; defaults to
+    ``time.monotonic``).  Thresholds default from the env so a
+    production job tunes them without code."""
+
+    def __init__(self, now=None, stall_s=None, spike_factor=None,
+                 spike_window=16, queue_depth=None, queue_boundaries=8,
+                 kv_window=16, kv_windows=3):
+        import time
+        self._now = now if now is not None else time.monotonic
+        self.stall_s = float(stall_s) if stall_s is not None \
+            else _env_float("MXTPU_WATCHDOG_STALL_S", 120.0)
+        self.spike_factor = float(spike_factor) if spike_factor \
+            is not None else _env_float("MXTPU_WATCHDOG_SPIKE", 10.0)
+        self.queue_depth = int(queue_depth) if queue_depth is not None \
+            else int(_env_float("MXTPU_WATCHDOG_QUEUE", 64))
+        self.queue_boundaries = int(queue_boundaries)
+        self.kv_window = int(kv_window)
+        self.kv_windows = int(kv_windows)
+        self._lock = _racecheck.make_lock("telemetry.Watchdog._lock")
+        # everything below: guarded-by: _lock
+        self._losses = deque(maxlen=int(spike_window))
+        self._last_step_t = None
+        self._saturated = 0
+        self._kv_samples = []
+        self._kv_min_run = 0
+        self._kv_last_min = None
+        self._tripped = set()        # rules currently in-incident
+        self._pending = []           # incidents to fire OUTSIDE _lock
+        self.trips = []              # (rule, detail) history
+
+    # -- firing ----------------------------------------------------------
+    def _fire(self, rule, detail):
+        """One incident: typed event + counter + flight dump.  The
+        event is emitted BEFORE the dump so the dump's last event IS
+        the incident (the chaos-harness contract).  Runs OUTSIDE the
+        watchdog lock — the flight dump is file I/O (HB16)."""
+        from . import event, inc, dump_flight
+        event(f"watchdog.{rule}", **detail)
+        inc("watchdog.trips")
+        inc(f"watchdog.{rule}.trips")
+        dump_flight(f"watchdog:{rule}")
+
+    def _drain(self):
+        """Fire every incident queued under the lock (caller must NOT
+        hold it)."""
+        while True:
+            with self._lock:
+                if not self._pending:
+                    return
+                rule, detail = self._pending.pop(0)
+            self._fire(rule, detail)
+
+    def _edge(self, rule, firing, **detail):  # guarded-by: _lock
+        """Edge-trigger ``rule``: queue a firing on False->True, re-arm
+        on the first healthy observation.  Called under ``_lock``; the
+        actual event/dump happens in :meth:`_drain` after release."""
+        if firing:
+            if rule not in self._tripped:
+                self._tripped.add(rule)
+                self._pending.append((rule, detail))
+                self.trips.append((rule, detail))
+        else:
+            self._tripped.discard(rule)
+
+    # -- training seams --------------------------------------------------
+    def on_step(self, step, loss=None, grad_norm=None, step_ms=None):
+        """Tick the training rules at a committed step boundary.
+        ``loss``/``grad_norm`` are host floats (callers that already
+        synced pass them; the trainer's own tick passes only
+        ``step_ms`` — it never pulls the loss, HB10).  The
+        ``watchdog.loss`` fault point lets chaos inject a NaN loss
+        through the exact production path."""
+        from ..testing import faults
+        inj = faults.fault_point("watchdog.loss", payload=int(step))
+        if isinstance(inj, (int, float)):
+            loss = float(inj)
+        with self._lock:
+            now = self._now()
+            gap = (now - self._last_step_t
+                   if self._last_step_t is not None else None)
+            self._last_step_t = now
+            if loss is not None:
+                loss = float(loss)
+                self._edge("nonfinite_loss", not math.isfinite(loss),
+                           step=int(step), loss=repr(loss))
+                if math.isfinite(loss):
+                    window = [v for v in self._losses]
+                    if len(window) >= 4:
+                        mean = sum(window) / len(window)
+                        self._edge(
+                            "loss_spike",
+                            abs(loss) > self.spike_factor
+                            * (abs(mean) + 1e-12) and abs(loss) > 1e-6,
+                            step=int(step), loss=loss,
+                            trailing_mean=mean)
+                    self._losses.append(loss)
+            if grad_norm is not None:
+                self._edge("nonfinite_grad",
+                           not math.isfinite(float(grad_norm)),
+                           step=int(step), grad_norm=repr(grad_norm))
+            stalled = (gap is not None and gap > self.stall_s) or \
+                (step_ms is not None and step_ms > self.stall_s * 1e3)
+            self._edge("step_stall", stalled, step=int(step),
+                       gap_s=round(gap, 3) if gap is not None else None,
+                       stall_s=self.stall_s)
+        self._drain()
+
+    def check(self, step=None):
+        """Explicit stall probe for seams where no step arrives (a
+        monitoring thread, a serving boundary, the chaos clock): fires
+        ``step_stall`` when the last committed step is older than
+        ``stall_s``."""
+        with self._lock:
+            if self._last_step_t is None:
+                return False
+            gap = self._now() - self._last_step_t
+            self._edge("step_stall", gap > self.stall_s,
+                       step=step, gap_s=round(gap, 3),
+                       stall_s=self.stall_s)
+            stalled = gap > self.stall_s
+        self._drain()
+        return stalled
+
+    # -- serving seams ---------------------------------------------------
+    def on_serving_boundary(self, queue_depth=None, kv_blocks_in_use=None):
+        """Tick the serving rules at a scheduling boundary (host ints
+        the batcher already holds — zero device traffic)."""
+        with self._lock:
+            if queue_depth is not None:
+                if queue_depth >= self.queue_depth:
+                    self._saturated += 1
+                else:
+                    self._saturated = 0
+                self._edge("queue_saturation",
+                           self._saturated >= self.queue_boundaries,
+                           queue_depth=int(queue_depth),
+                           boundaries=self._saturated)
+            if kv_blocks_in_use is not None:
+                self._kv_samples.append(int(kv_blocks_in_use))
+                if len(self._kv_samples) >= self.kv_window:
+                    wmin = min(self._kv_samples)
+                    self._kv_samples = []
+                    if self._kv_last_min is not None and \
+                            wmin > self._kv_last_min:
+                        self._kv_min_run += 1
+                    else:
+                        self._kv_min_run = 0
+                    self._kv_last_min = wmin
+                    self._edge("kv_leak",
+                               self._kv_min_run >= self.kv_windows,
+                               window_min=wmin,
+                               rising_windows=self._kv_min_run)
+        self._drain()
+
+    def state(self):
+        with self._lock:
+            return {"trips": [r for r, _ in self.trips],
+                    "tripped": sorted(self._tripped),
+                    "losses": list(self._losses)}
+
+
+_ENABLED = _env_enabled()
+_WD = Watchdog()
+
+
+def enabled():
+    return _ENABLED
+
+
+def watchdog():
+    """The process-global instance the instrumented seams tick."""
+    return _WD
+
+
+def configure(enabled=None, instance=None, **kw):
+    """Swap config (tests / chaos: ``configure(instance=Watchdog(
+    now=fake_clock, stall_s=30))`` points the global seams at a
+    deterministic engine)."""
+    global _ENABLED, _WD
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if instance is not None:
+        _WD = instance
+    elif kw:
+        _WD = Watchdog(**kw)
+    return _WD
+
+
+def reset():
+    """Fresh rule state, default clock, re-read env kill switch (the
+    conftest between-tests seam, via ``telemetry.reset()``) — an
+    injected FakeClock must never leak into the next test."""
+    global _ENABLED, _WD
+    _ENABLED = _env_enabled()
+    _WD = Watchdog()
+
+
+# -- module-level hooks: one bool check when disabled -------------------
+
+def on_step(step, loss=None, grad_norm=None, step_ms=None):
+    if not _ENABLED:
+        return
+    _WD.on_step(step, loss=loss, grad_norm=grad_norm, step_ms=step_ms)
+
+
+def on_serving_boundary(queue_depth=None, kv_blocks_in_use=None):
+    if not _ENABLED:
+        return
+    _WD.on_serving_boundary(queue_depth=queue_depth,
+                            kv_blocks_in_use=kv_blocks_in_use)
+
+
+def check(step=None):
+    if not _ENABLED:
+        return False
+    return _WD.check(step=step)

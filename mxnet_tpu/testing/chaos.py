@@ -70,7 +70,13 @@ adds a replacement replica from the shared compile cache (zero new
 compiles).  Every injected notice leaves a parseable flight dump;
 racecheck is armed; the KV pools pass the leak sweep.
 
-``python -m mxnet_tpu.testing.chaos all`` runs all four suites.
+``python -m mxnet_tpu.testing.chaos watchdog`` (or ``tools/
+tpu_queue_runner.py --chaos watchdog``) runs the RUN-HEALTH scenario
+(ISSUE 14): a NaN loss injected through the ``watchdog.loss`` fault
+point and a FakeClock step stall must each emit a typed ``watchdog.*``
+event and dump the flight recorder with ``reason="watchdog:<rule>"``.
+
+``python -m mxnet_tpu.testing.chaos all`` runs all five suites.
 """
 from __future__ import annotations
 
@@ -874,6 +880,75 @@ def run_autoscale_scenario(total_steps=6, notice_at=2, revoke_at=4,
     return result
 
 
+# ----------------------------------------------------------------------
+# Watchdog scenario (ISSUE 14): injected NaN loss + FakeClock step
+# stall, each leaving a typed watchdog.* event and a flight dump whose
+# reason names the rule.
+# ----------------------------------------------------------------------
+
+def run_watchdog_scenario(total_steps=6, nan_at=3, workdir=None):
+    """Run-health watchdog end to end: train a tiny sharded model,
+    inject a NaN loss through the ``watchdog.loss`` fault point
+    (testing/faults.py — the detection path is exactly production's),
+    then starve the step clock (FakeClock, zero sleeps) past
+    ``stall_s``.  Each incident must emit its typed ``watchdog.*``
+    event and dump the flight recorder with ``reason="watchdog:<rule>"``
+    — the same gates ``tools/tpu_queue_runner.py --chaos watchdog``
+    applies in a child process."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import watchdog as wd_mod
+    from mxnet_tpu.testing import faults
+
+    rc = _racecheck_arm()
+    result = {"mode": "watchdog", "nan_at": nan_at,
+              "total_steps": total_steps}
+    clock = faults.FakeClock(1000.0)
+    wd = wd_mod.Watchdog(now=clock, stall_s=30.0)
+    wd_mod.configure(enabled=True, instance=wd)
+    try:
+        xs, ys = _make_data(7)
+        net, trainer, step = _build("sharded")
+        with faults.inject("watchdog.loss", at=nan_at, times=1,
+                           action=lambda p: float("nan")):
+            for i in range(total_steps):
+                loss = step(xs[i], ys[i])
+                # the estimator's seam: tick with the host loss the
+                # metric path already pulled (the fault point swaps in
+                # the NaN at step nan_at)
+                wd_mod.on_step(i + 1,
+                               loss=float(loss.asnumpy().mean()))
+                clock.advance(1.0)
+        kinds = [e["kind"] for e in telemetry.events()]
+        result["nan_event"] = "watchdog.nonfinite_loss" in kinds
+        result["nan_flight"] = _flight_check(expect_kind="watchdog")
+        nan_reason = (result["nan_flight"] or {}).get("reason")
+        result["nan_reason_ok"] = nan_reason == "watchdog:nonfinite_loss"
+
+        # training went quiet: no step for > stall_s (FakeClock)
+        clock.advance(31.0)
+        stalled = wd_mod.check(step=total_steps)
+        kinds = [e["kind"] for e in telemetry.events()]
+        result["stall_detected"] = bool(stalled)
+        result["stall_event"] = "watchdog.step_stall" in kinds
+        result["stall_flight"] = _flight_check(expect_kind="watchdog")
+        stall_reason = (result["stall_flight"] or {}).get("reason")
+        result["stall_reason_ok"] = stall_reason == "watchdog:step_stall"
+        result["trips"] = [r for r, _ in wd.trips]
+    finally:
+        wd_mod.reset()           # never leak the FakeClock instance
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    nf, sf = result["nan_flight"], result["stall_flight"]
+    result["ok"] = bool(
+        result["nan_event"] and result["stall_event"]
+        and result["stall_detected"]
+        and (nf is None or (nf["ok"] and result["nan_reason_ok"]))
+        and (sf is None or (sf["ok"] and result["stall_reason_ok"]))
+        and (rcv is None or rcv["ok"]))
+    return result
+
+
 def main(argv=None):
     # the smoke must run anywhere — force the simulated CPU mesh exactly
     # like tests/conftest.py does
@@ -904,6 +979,8 @@ def main(argv=None):
             results.append(run_serving_scenario(workdir=workdir))
         if suite in ("autoscale", "all"):
             results.append(run_autoscale_scenario(workdir=workdir))
+        if suite in ("watchdog", "all"):
+            results.append(run_watchdog_scenario(workdir=workdir))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     ok = bool(results) and all(r["ok"] for r in results)

@@ -470,6 +470,48 @@ def test_elastic_nulls_stay_out_of_headline():
 # null-when-unmeasured honesty rules
 # ----------------------------------------------------------------------
 
+def test_mfu_helpers_delegate_to_shared_costmodel():
+    """ISSUE 14: flops_source/mfu come from telemetry/costmodel.py —
+    the bench-local helpers are thin wrappers over the ONE cost model
+    the trainer's live gauges use, and the payload they produce for
+    the same inputs is byte-identical to before the lift."""
+    from mxnet_tpu.telemetry import costmodel
+    assert bench._resnet_train_flops_per_img() == \
+        costmodel.resnet_train_flops_per_img()
+    assert bench._bert_train_flops_per_sample(128, layers=2) == \
+        costmodel.bert_train_flops_per_sample(128, layers=2)
+    assert bench._chip_peak_flops(None) is None or True
+    a = bench._attach_mfu({"batch": 16}, 2e9, 321.5)
+    b = costmodel.attach_mfu({"batch": 16}, 2e9, 321.5)
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    # the exact pre-lift shape on a CPU host: analytic source, no mfu
+    assert a["flops_source"] == "analytic_2mac"
+    assert a["tflops_delivered"] == round(2e9 * 321.5 / 1e12, 2)
+
+
+def test_mfu_live_null_when_unmeasured_on_cpu():
+    """The compact line's ``mfu_live`` keeps the PR 6 honesty rule: on
+    a CPU host the trainer never stamps `train.mfu`, so the stamped
+    field is null and stays OUT of the headline."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        return
+    telemetry.reset()
+    r = bench._stamp_live_mfu({"metric": "x"})
+    assert r["mfu_live"] is None
+    p = _success_payload()
+    p["mfu_live"] = None
+    assert "mfu_live" not in json.loads(bench._compact_line(p))
+    # measured (TPU round / env-pinned peak): the key surfaces
+    p["mfu_live"] = 0.233
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["mfu_live"] == 0.233
+    # and the live gauge rides through the stamp when present
+    telemetry.set_gauge("train.mfu", 0.41)
+    assert bench._stamp_live_mfu({})["mfu_live"] == 0.41
+    telemetry.reset()
+
+
 def test_telemetry_schema_version_stamped():
     from mxnet_tpu.telemetry import SCHEMA_VERSION
     r = bench._stamp_telemetry({"metric": "x"})

@@ -446,6 +446,61 @@ def test_serving_chaos_scenario(tmp_path):
 
 
 # ----------------------------------------------------------------------
+# the ISSUE 14 null-honesty fix: an UNMEASURED replica must not win
+# admission on a fake-perfect TTFT (r04/r05 null-when-unmeasured)
+# ----------------------------------------------------------------------
+
+def test_admission_unmeasured_ttft_is_no_signal_not_perfect(net):
+    """Regression: replica 1 has the deeper queue but NO measured
+    ttft/kv gauges.  The old ``value(...) or 0.0`` scored it as if it
+    had perfect TTFT (6.0 < 7.5) and admitted onto the deeper queue;
+    with None treated as "no signal" the scoring falls back to queue
+    depth only and the shallower, fully-measured replica 0 wins."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        pytest.skip("telemetry off")
+    telemetry.reset()
+    router = _router(net, replicas=2)
+    telemetry.set_gauge("serving.replica0.queue_depth", 2)
+    telemetry.set_gauge("serving.replica0.ttft_ms", 3000.0)
+    telemetry.set_gauge("serving.replica0.kv_block_utilization", 0.5)
+    telemetry.set_gauge("serving.replica1.queue_depth", 3)
+    # replica 1: ttft/kv gauges never published (no traffic measured)
+    assert telemetry.value("serving.replica1.ttft_ms") is None
+    req = router.submit(Request([1, 2, 3], max_new_tokens=1))
+    assert router._assigned[req.id] == 0
+    # the signals layer itself reports None, not 0.0
+    sig = router._signals(router.replicas[1])
+    assert sig["ttft_ms"] is None
+    assert sig["kv_block_utilization"] is None
+    telemetry.reset()
+
+
+def test_replica_ttft_gauge_absent_until_measured(net):
+    """Direct-read fallback + gauge publication keep the convention:
+    before any finished request, load_signals reports ttft_ms=None and
+    _step_replica publishes NO ttft gauge (value() stays None); the
+    gauge appears only once a real TTFT was measured."""
+    from mxnet_tpu import telemetry
+    if not telemetry.enabled():
+        pytest.skip("telemetry off")
+    telemetry.reset()
+    router = _router(net, replicas=2)
+    rep = router.replicas[0]
+    assert rep.load_signals()["ttft_ms"] is None
+    router._step_replica(rep)              # idle boundary publishes...
+    assert telemetry.value("serving.replica0.queue_depth") == 0
+    assert telemetry.value("serving.replica0.ttft_ms") is None  # ...no ttft
+    rng = np.random.RandomState(23)
+    req = router.submit(Request(rng.randint(0, 64, (4,)).tolist(),
+                                max_new_tokens=2))
+    router.drive()
+    rid = router._assigned[req.id]
+    assert telemetry.value(f"serving.replica{rid}.ttft_ms") is not None
+    telemetry.reset()
+
+
+# ----------------------------------------------------------------------
 # the ISSUE 12 small fix: typed TP rejection + recorded MeshConfig
 # ----------------------------------------------------------------------
 

@@ -11,6 +11,10 @@ Three sources, one renderer:
                      training, the elastic membership server — doubles
                      as a scrape endpoint, no extra port)
   --self-test        emit a tiny in-process registry (smoke/demo)
+  --trace OUT.json   export THIS process's merged causal-tracing +
+                     profiler span stream as Chrome-trace JSON
+                     (ISSUE 14; open in chrome://tracing or perfetto —
+                     combine with --self-test for a demo trace)
 
 ``--format=prom`` prints Prometheus text exposition (the scrape
 integration path); ``--format=json`` prints the snapshot/dump verbatim.
@@ -62,18 +66,36 @@ def main(argv=None):
                          "JSONL")
     ap.add_argument("--self-test", action="store_true",
                     help="render a tiny in-process registry and exit")
+    ap.add_argument("--trace", metavar="OUT",
+                    help="write the merged tracing + profiler span "
+                         "stream as Chrome-trace JSON to OUT")
     args = ap.parse_args(argv)
 
     from mxnet_tpu.telemetry.prom import prom_text
 
     if args.self_test:
         from mxnet_tpu import telemetry
+        from mxnet_tpu.telemetry import tracing
         telemetry.inc("selftest.counter", 3)
         telemetry.set_gauge("selftest.gauge", 1.5)
         telemetry.observe("selftest.ms", 2.0)
+        with tracing.span("selftest.root", demo=True):
+            with tracing.span("selftest.child"):
+                pass
         snap = telemetry.snapshot()
         print(prom_text(snap) if args.format == "prom"
               else json.dumps(snap, indent=1))
+        if not args.trace:
+            return 0
+
+    if args.trace:
+        from mxnet_tpu.telemetry import tracing
+        payload = tracing.chrome_trace()
+        with open(args.trace, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        n = sum(1 for ev in payload["traceEvents"]
+                if ev.get("ph") != "M")
+        print(f"# wrote {n} trace event(s) to {args.trace}")
         return 0
 
     if args.file:

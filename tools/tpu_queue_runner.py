@@ -515,8 +515,9 @@ def step_bert128(st: dict) -> None:
 
 
 def run_chaos(suite: str = "preempt") -> int:
-    """``--chaos [elastic|serving|autoscale|all]``: the fault-tolerance
-    smoke (mxnet_tpu.testing.chaos) in a child process on the simulated
+    """``--chaos [elastic|serving|autoscale|watchdog|all]``: the
+    fault-tolerance smoke (mxnet_tpu.testing.chaos) in a child process
+    on the simulated
     CPU mesh.  Default suite: kill the checkpoint writer, preempt at
     step K, corrupt the newest checkpoint, auto-resume, bitwise parity.
     ``elastic`` (ISSUE 8): kill worker 1 at step K via silent
@@ -532,9 +533,13 @@ def run_chaos(suite: str = "preempt") -> int:
     4->8 — bitwise vs a fresh restore at EACH dp, a noticed serving
     replica drained with zero lost requests, a replacement replica
     autoscaled in with zero new compiles, flight-dump + racecheck +
-    KV-leak gates folded into the verdict.  Needs no TPU and takes no
-    queue lock: safe to run any time, including while the measurement
-    queue owns the chip."""
+    KV-leak gates folded into the verdict.  ``watchdog`` (ISSUE 14): a
+    NaN loss injected through the ``watchdog.loss`` fault point and a
+    FakeClock step stall must each leave a typed ``watchdog.*`` event
+    and a flight dump whose reason names the rule
+    (``watchdog:nonfinite_loss`` / ``watchdog:step_stall``).  Needs no
+    TPU and takes no queue lock: safe to run any time, including while
+    the measurement queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # ISSUE 10: every chaos interleaving runs under the runtime race /
     # lock-order detector (mxnet_tpu.lint.racecheck); a finding fails
