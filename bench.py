@@ -781,6 +781,39 @@ def _bench_elastic() -> dict:
     return blk
 
 
+def _bench_fleet() -> dict:
+    """Fleet-observability evidence (ISSUE 15): slowest_rank /
+    step_ms_skew / scrape_ms from one ``FleetCollector.collect()`` over
+    the workers named by ``MXTPU_FLEET_ADDRS`` ("h0:p0,h1:p1,...").
+    A single process has no fleet to scrape — the block ships config
+    with every measured field null (null-when-unmeasured, the PR 6
+    honesty rule); the deterministic correctness evidence lives in the
+    tier-1 chaos fleet suite (``tools/tpu_queue_runner.py --chaos
+    fleet``)."""
+    from mxnet_tpu.telemetry import fleet as _fleet
+    addrs = os.environ.get("MXTPU_FLEET_ADDRS", "").strip()
+    if not addrs:
+        blk = _fleet.fleet_block(enabled=_fleet.enabled(), ranks=1)
+        blk["note"] = ("single process: no fleet to scrape (set "
+                       "MXTPU_FLEET_ADDRS=h0:p0,... on a pod); "
+                       "correctness evidence: tools/tpu_queue_runner.py "
+                       "--chaos fleet (tier-1)")
+        return blk
+    coll = _fleet.FleetCollector(_fleet.transports_from_addrs(addrs))
+    snap = coll.collect()
+    skew = snap.get("skew") or {}
+    return _fleet.fleet_block(
+        enabled=True, ranks=len(snap.get("ranks") or []),
+        slowest_rank=skew.get("slowest_rank"),
+        step_ms_skew=skew.get("skew_ratio"),
+        scrape_ms=snap.get("scrape_ms"),
+        stragglers=sum(1 for s in (skew.get("straggler_scores")
+                                   or {}).values()
+                       if s >= coll.skew),
+        epoch_desync=snap.get("epoch_desync") is not None,
+        scrape_dead=len(snap.get("dead") or []))
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -936,6 +969,11 @@ def _run_bench() -> dict:
         except Exception as e:  # noqa: BLE001
             result["extra"]["elastic"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["fleet"] = _bench_fleet()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["fleet"] = {
+                "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)
         ml = _load_memlevers()
@@ -1058,6 +1096,9 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         ("elastic_reshard_ms", ("elastic", "reshard_ms")),
         ("elastic_pause_ms", ("elastic", "pause_ms")),
         ("elastic_epoch", ("elastic", "membership_epoch")),
+        ("fleet_slowest_rank", ("fleet", "slowest_rank")),
+        ("fleet_skew", ("fleet", "step_ms_skew")),
+        ("fleet_scrape_ms", ("fleet", "scrape_ms")),
         ("tpu_h2d_gb_s", ("tpu_bandwidth", "h2d_gb_s")),
         ("tpu_hbm_gb_s", ("tpu_bandwidth", "hbm_copy_gb_s")),
         ("kv_per_key_speedup", ("kvstore_bandwidth", "per_key_speedup")),
@@ -1088,7 +1129,7 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
     # sweeps) surface automatically as long as they are scalars, one or
     # two levels deep, and the budget still allows them
     handled = {"bert", "resnet_rec_pipeline", "llama_decode", "serving",
-               "elastic", "tpu_bandwidth", "kvstore_bandwidth",
+               "elastic", "fleet", "tpu_bandwidth", "kvstore_bandwidth",
                "scaling_projection"}
     for k in sorted(extra):
         if k in handled:

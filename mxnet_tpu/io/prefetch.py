@@ -140,6 +140,21 @@ class _WorkerFailure:
 _END = _EndOfStream()
 
 
+def _batch_nbytes(batch):
+    """Exact bytes of one delivered batch (tuple/list of array leaves);
+    0 when nothing measurable — the gauge then stays unset, never a
+    fabricated zero (ISSUE 15 memory honesty)."""
+    leaves = batch if isinstance(batch, (tuple, list)) else (batch,)
+    total = 0
+    for leaf in leaves:
+        n = getattr(leaf, "nbytes", None)
+        if n is None:
+            n = getattr(getattr(leaf, "_data", None), "nbytes", None)
+        if isinstance(n, int):
+            total += n
+    return total
+
+
 class DevicePrefetcher:
     """Iterator wrapper that stages batches onto the device ahead of use.
 
@@ -197,6 +212,8 @@ class DevicePrefetcher:
                                 # worker on ITS source iterator
         self._trace_ctx = None  # ambient span captured at worker start
                                 # (ISSUE 14 cross-thread propagation)
+        self._batch_nbytes = None   # first delivered batch's exact
+                                    # bytes (ISSUE 15 memory honesty)
 
     # -- sharding -------------------------------------------------------
     def _leaf_sharding(self, x):
@@ -375,6 +392,17 @@ class DevicePrefetcher:
         if isinstance(got, _WorkerFailure):
             self._shutdown()
             raise got.exc
+        if _telem.enabled():
+            # memory honesty (ISSUE 15): exact read-ahead buffer bytes
+            # (queued batches + the one being handed out), so an OOM
+            # post-mortem can name the prefetch pipeline.  Batch size
+            # is measured once — the feed is fixed-shape by design.
+            if self._batch_nbytes is None:
+                self._batch_nbytes = _batch_nbytes(got[0])
+            if self._batch_nbytes:
+                _telem.set_gauge(
+                    "io.prefetch_buffer_bytes",
+                    self._batch_nbytes * (self._queue.qsize() + 1))
         self._last_yield = t_got
         self._consumed += 1
         return got[0]

@@ -62,8 +62,19 @@ _OP_INIT, _OP_PUSH, _OP_PULL, _OP_SET_OPT, _OP_STATS, _OP_BARRIER, \
 _OP_HEARTBEAT, _OP_HEALTH = 10, 11
 _OP_JOIN, _OP_MEMBERSHIP = 12, 13   # elastic membership (ISSUE 8)
 _OP_TELEMETRY = 14                  # live telemetry scrape (ISSUE 9)
+_OP_CTX = 15                        # span-context wrapper (ISSUE 15):
+                                    # i64 trace + i64 span + inner frame
 # opcodes (replies)
 _OP_OK, _OP_OK_TENSOR, _OP_OK_TEXT, _OP_ERR = 100, 101, 102, 200
+
+#: opcode -> rpc name for the server-side stitching span
+_OP_NAMES = {_OP_INIT: "init", _OP_PUSH: "push", _OP_PULL: "pull",
+             _OP_SET_OPT: "set_optimizer", _OP_STATS: "stats",
+             _OP_BARRIER: "barrier", _OP_SHUTDOWN: "shutdown",
+             _OP_CMD: "cmd", _OP_CMDLOG: "cmdlog",
+             _OP_HEARTBEAT: "heartbeat", _OP_HEALTH: "health",
+             _OP_JOIN: "join", _OP_MEMBERSHIP: "membership",
+             _OP_TELEMETRY: "telemetry"}
 
 _DTYPE_FLAGS = {"float32": 0, "float64": 1, "float16": 2, "uint8": 3,
                 "int32": 4, "int8": 5, "int64": 6, "bool": 7,
@@ -357,6 +368,22 @@ class PSServer:
         """Serve one frame; returns True when the server should stop."""
         op = frame[0]
         off = 1
+        if op == _OP_CTX:
+            # cross-worker trace stitching (ISSUE 15): the client
+            # prefixed its ambient span ids, so this RPC's server-side
+            # handling gets a span that DISCLOSES the remote parent
+            # (span ids are per-process; the fleet chrome_trace
+            # correlates via these args — it never renames ids).
+            rtrace, rspan = struct.unpack_from("<qq", frame, off)
+            inner = frame[off + 16:]
+            from ..telemetry import tracing as _tracing
+            sp = _tracing.start(
+                f"ps.rpc.{_OP_NAMES.get(inner[0], inner[0])}",
+                remote_trace=int(rtrace), remote_span=int(rspan))
+            try:
+                return self._handle(conn, inner)
+            finally:
+                _tracing.finish(sp)
         if op == _OP_INIT:
             key, off = _unpack_key(frame, off)
             value, _ = _unpack_tensor(frame, off)
@@ -516,13 +543,20 @@ class PSServer:
             # serving job already runs, so it doubles as the scrape
             # endpoint — no extra port, no extra thread.  fmt byte:
             # 0 = JSON snapshot, 1 = Prometheus text (wrapped in JSON so
-            # the typed reply framing stays uniform).
+            # the typed reply framing stays uniform).  fmt 2 = the
+            # fleet scrape payload (ISSUE 15): snapshot + this rank's
+            # finished-span ring, what FleetCollector stitches.
             from .. import telemetry as _telemetry
             fmt = frame[off] if len(frame) > off else 0
             snap = _telemetry.snapshot()
             if fmt == 1:
                 payload = {"format": "prom",
                            "text": _telemetry.prom_text(snap)}
+            elif fmt == 2:
+                from ..telemetry import tracing as _tracing
+                payload = {"snapshot": snap,
+                           "spans": _tracing.spans(),
+                           "dropped_spans": _tracing.dropped()}
             else:
                 payload = snap
             _send_frame(conn, bytes([_OP_OK_TEXT]) + _pack_text(
@@ -585,6 +619,16 @@ class PSClient:
         self._hb_stop = None      # threading.Event while beating
 
     def _rpc(self, payload):
+        # cross-worker trace stitching (ISSUE 15): when this thread has
+        # an ambient span, prefix its (trace, span) ids so the server's
+        # handling span discloses the remote parent — a push/pushpull/
+        # join then correlates with the issuing side in the stitched
+        # fleet timeline
+        from ..telemetry import tracing as _tracing
+        sp = _tracing.current()
+        if sp is not None and sp.span is not None:
+            payload = bytes([_OP_CTX]) + struct.pack(
+                "<qq", int(sp.trace), int(sp.span)) + payload
         # the lock IS the RPC channel: one request/response pair in
         # flight per socket, so the wire round necessarily happens with
         # it held — callers that must not stall (heartbeats) use their
@@ -659,9 +703,11 @@ class PSClient:
         ``fmt="json"`` returns the snapshot dict, ``fmt="prom"`` a
         ``{"format": "prom", "text": ...}`` wrapper holding the
         Prometheus text exposition — what ``tools/telemetry_dump.py``
-        prints for a scraper."""
-        return self._rpc(bytes([_OP_TELEMETRY,
-                                1 if fmt == "prom" else 0]))
+        prints for a scraper.  ``fmt="fleet"`` (ISSUE 15) returns
+        ``{"snapshot", "spans", "dropped_spans"}`` — the payload
+        ``telemetry.fleet.FleetCollector`` merges and stitches."""
+        code = {"prom": 1, "fleet": 2}.get(fmt, 0)
+        return self._rpc(bytes([_OP_TELEMETRY, code]))
 
     def beat_once(self, rank):
         """Send ONE heartbeat for ``rank`` synchronously over the RPC

@@ -158,6 +158,9 @@ class DataParallelTrainer:
         self._live_cost = {}         # id(jitted) -> (jitted, flops)
         self._last_step_flops = None
         self._live_peak = ()         # () = not yet resolved
+        # memory honesty (ISSUE 15): exact byte gauges for the flight
+        # recorder's memory block, published once per build
+        self._mem_gauges_stale = True
 
     # -- parameter plumbing --------------------------------------------
     def _collect(self, *args):
@@ -825,6 +828,37 @@ class DataParallelTrainer:
                                  round(flops / dt_s / self._live_peak, 4))
         _watchdog.on_step(self._num_update,
                           step_ms=dt_s * 1e3 / max(k, 1))
+        if self._mem_gauges_stale:
+            self._publish_memory_gauges()
+
+    def _publish_memory_gauges(self):
+        """One-time (per build) exact byte gauges for the flight
+        recorder's ``memory`` block (ISSUE 15): the device-resident
+        param bytes this trainer owns and its per-chip optimizer-state
+        bytes (``train.zero1_shard_bytes`` when ZeRO-1 shards it, the
+        replicated ``train.opt_state_bytes`` otherwise).  Exact
+        arithmetic on shapes already in hand — no device traffic."""
+        self._mem_gauges_stale = False
+        try:
+            if self._param_vals is not None:
+                pbytes = sum(leaf.size * leaf.dtype.itemsize
+                             for leaf in jax.tree.leaves(self._param_vals))
+                _telem.set_gauge("train.param_bytes", int(pbytes))
+            if self._opt_state is not None:
+                dp = self.mesh.shape.get(AXIS_DP, 1)
+                zero1 = bool(self._zero1 and self._plan is not None)
+                sbytes = 0
+                for leaf in jax.tree.leaves(self._opt_state):
+                    nbytes = leaf.size * leaf.dtype.itemsize
+                    # ZeRO-1: vector leaves are dp-sharded, scalars
+                    # replicate (the comm_stats accounting)
+                    sbytes += nbytes // dp if zero1 and leaf.ndim >= 1 \
+                        else nbytes
+                _telem.set_gauge("train.zero1_shard_bytes" if zero1
+                                 else "train.opt_state_bytes",
+                                 int(sbytes))
+        except Exception:  # noqa: BLE001 — observability never takes
+            pass           # a training step down
 
     def _trace_step_phases(self, t0, t1, t2, t3):
         """Commit the per-step phase span tree (ISSUE 14): one
@@ -1146,6 +1180,7 @@ class DataParallelTrainer:
         self._jit_zero1_cache = {}
         self._param_vals = None
         self._opt_state = None
+        self._mem_gauges_stale = True
         return self
 
     # -- checkpoint protocol (mx.checkpoint.CheckpointManager) ----------

@@ -799,6 +799,11 @@ class InferenceEngine:
                          round(self.cache.utilization(), 4))
         _telem.set_gauge("serving.kv_blocks_in_use",
                          self.cache.blocks_in_use)
+        # memory honesty (ISSUE 15): exact bytes the live block-table
+        # entries pin, so an OOM post-mortem names the KV pool by size
+        _telem.set_gauge("serving.kv_bytes_in_use",
+                         self.cache.blocks_in_use
+                         * self.cache.block_nbytes)
         if self.prefix_cache is not None:
             hr = self.prefix_cache.hit_rate()
             if hr is not None:

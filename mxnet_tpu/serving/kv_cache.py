@@ -135,6 +135,14 @@ class PagedKVCache:
         total = self.num_blocks - 1
         return self.blocks_in_use / total if total else 0.0
 
+    @property
+    def block_nbytes(self):
+        """Exact bytes ONE block pins across both pools and all layers
+        — the flight recorder's memory block multiplies this by
+        ``blocks_in_use`` (ISSUE 15 memory honesty)."""
+        layers, _, bs, kvh, hd = self.k_pool.shape
+        return 2 * layers * bs * kvh * hd * self.k_pool.dtype.itemsize
+
     def blocks_for(self, n_tokens):
         """Blocks needed to hold ``n_tokens`` positions."""
         return -(-int(n_tokens) // self.block_size)

@@ -40,20 +40,23 @@ import time
 from .registry import (MetricsRegistry, Counter, Gauge, Histogram,
                        NULL_METRIC, DEFAULT_MS_EDGES)
 from .events import EventLog, SCHEMA_VERSION
-from .flight import FlightRecorder
+from .flight import FlightRecorder, memory_block
 from .prom import prom_text as _render_prom
 from . import tracing
 from . import watchdog
 from . import costmodel
+from . import fleet
 
 __all__ = ["SCHEMA_VERSION", "enabled", "registry", "counter", "gauge",
            "histogram", "inc", "set_gauge", "observe", "value", "event",
-           "events", "set_context", "context", "snapshot", "prom_text",
-           "flight", "dump_flight", "last_flight_dump", "on_fault",
-           "on_preemption", "on_step_error", "reset", "configure",
-           "clock", "MetricsRegistry", "EventLog", "FlightRecorder",
-           "Counter", "Gauge", "Histogram", "DEFAULT_MS_EDGES",
-           "tracing", "watchdog", "costmodel"]
+           "events", "events_dropped", "set_context", "context",
+           "snapshot", "prom_text", "flight", "dump_flight",
+           "last_flight_dump", "on_fault", "on_preemption",
+           "on_step_error", "reset", "configure", "clock",
+           "MetricsRegistry", "EventLog", "FlightRecorder",
+           "memory_block", "Counter", "Gauge", "Histogram",
+           "DEFAULT_MS_EDGES", "tracing", "watchdog", "costmodel",
+           "fleet"]
 
 
 def _env_enabled():
@@ -197,6 +200,14 @@ def events():
     if not _ENABLED:
         return []
     return _EVENTS.events()
+
+
+def events_dropped():
+    """Event records the bounded ring evicted since the last reset
+    (0 when disabled) — visible truncation (ISSUE 15)."""
+    if not _ENABLED:
+        return 0
+    return _EVENTS.dropped
 
 
 # -- snapshot / rendering -----------------------------------------------

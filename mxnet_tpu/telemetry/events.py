@@ -41,6 +41,7 @@ class EventLog:
         self._lock = _racecheck.make_lock("EventLog._lock")
         self._ring = deque(maxlen=self.ring_size)
         self._seq = 0
+        self._dropped = 0       # ring evictions since reset; guarded-by: _lock
         self._ctx = {"step": None, "epoch": None}
         # the JSONL appender has its OWN lock (never nested with _lock:
         # emit() releases _lock before touching the file) so a slow disk
@@ -53,6 +54,14 @@ class EventLog:
     def seq(self):
         with self._lock:
             return self._seq
+
+    @property
+    def dropped(self):
+        """Records the bounded ring has evicted since the last reset —
+        a truncated event history must be visibly truncated (ISSUE 15;
+        mirrored as the ``telemetry.events.dropped`` counter)."""
+        with self._lock:
+            return self._dropped
 
     # -- context --------------------------------------------------------
     def set_context(self, step=None, epoch=None):
@@ -74,13 +83,23 @@ class EventLog:
                    "t": self._now(), "kind": str(kind),
                    "step": self._ctx["step"], "epoch": self._ctx["epoch"],
                    "data": data}
+            evicting = len(self._ring) == self.ring_size
             self._ring.append(rec)
+            if evicting:
+                self._dropped += 1
             line = None
             if self.path:
                 try:
                     line = json.dumps(rec)
                 except (TypeError, ValueError):
                     line = json.dumps(dict(rec, data={"repr": repr(data)}))
+        if evicting:
+            # count the silent eviction where every reader looks (the
+            # registry counter; chrome_trace stamps it too).  Outside
+            # _lock — the counter has its own, and metric updates never
+            # emit events, so this cannot recurse.
+            from . import inc
+            inc("telemetry.events.dropped")
         if line is not None:
             self._append_line(line)
         return rec
@@ -114,6 +133,7 @@ class EventLog:
         with self._lock:
             self._ring.clear()
             self._seq = 0
+            self._dropped = 0
             self._ctx = {"step": None, "epoch": None}
 
     def close(self):

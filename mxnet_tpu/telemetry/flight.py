@@ -10,7 +10,9 @@ what the process was doing at the instant of death::
      "time": 1000.25,
      "pid": 4242,
      "events": [...last N event records...],
-     "metrics": {...registry snapshot...}}
+     "metrics": {...registry snapshot...},
+     "memory": {"devices": [...jax memory_stats or None...],
+                "gauges": {...exact byte gauges, None when unset...}}}
 
 Triggers (wired by the package front end):
 
@@ -33,7 +35,50 @@ import json
 import os
 import tempfile
 
-__all__ = ["FlightRecorder"]
+__all__ = ["FlightRecorder", "memory_block"]
+
+#: exact byte gauges the subsystems publish (ISSUE 15 memory honesty):
+#: an OOM post-mortem names the consumer.  Absent gauges report None —
+#: never zero.
+_BYTE_GAUGES = ("train.param_bytes", "train.zero1_shard_bytes",
+                "train.opt_state_bytes", "serving.kv_bytes_in_use",
+                "io.prefetch_buffer_bytes")
+
+
+def memory_block(registry=None):
+    """The flight dump's ``memory`` block: per-device backend memory
+    stats when jax exposes them (``device.memory_stats()`` — ``None``
+    otherwise, NEVER a fabricated zero: CPU backends report no stats),
+    plus the exact byte gauges we already own (:data:`_BYTE_GAUGES`),
+    so an OOM post-mortem names the consumer instead of just the
+    corpse."""
+    devices = None
+    try:
+        import jax
+        rows = []
+        for d in jax.devices():
+            stats = None
+            ms = getattr(d, "memory_stats", None)
+            if callable(ms):
+                try:
+                    stats = ms() or None
+                except Exception:  # noqa: BLE001 — honesty over crash
+                    stats = None
+            rows.append({
+                "id": int(d.id), "platform": str(d.platform),
+                "bytes_in_use": (stats or {}).get("bytes_in_use"),
+                "peak_bytes_in_use": (stats or {}).get(
+                    "peak_bytes_in_use"),
+                "bytes_limit": (stats or {}).get("bytes_limit"),
+            })
+        devices = rows
+    except Exception:  # noqa: BLE001 — the dump must never raise
+        devices = None
+    gauges = {}
+    if registry is not None:
+        for name in _BYTE_GAUGES:
+            gauges[name] = registry.value(name)
+    return {"devices": devices, "gauges": gauges}
 
 
 class FlightRecorder:
@@ -54,7 +99,8 @@ class FlightRecorder:
                 "time": self._events._now(),
                 "pid": os.getpid(),
                 "events": self._events.events(),
-                "metrics": self._registry.snapshot()}
+                "metrics": self._registry.snapshot(),
+                "memory": memory_block(self._registry)}
 
     def dump(self, reason, path=None):
         """Write the dump; returns the path (None when the write

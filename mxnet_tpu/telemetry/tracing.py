@@ -46,7 +46,8 @@ from ..lint import racecheck as _racecheck
 
 __all__ = ["Span", "enabled", "configure", "configure_from_env",
            "reset", "clock", "span", "start", "finish", "record",
-           "current", "capture", "activate", "spans", "chrome_trace"]
+           "current", "capture", "activate", "spans", "dropped",
+           "chrome_trace"]
 
 
 def _env_enabled():
@@ -113,6 +114,7 @@ class Tracer:
         self._lock = _racecheck.make_lock("telemetry.Tracer._lock")
         self._ring = deque(maxlen=self.ring_size)   # guarded-by: _lock
         self._next_id = 0                           # guarded-by: _lock
+        self._dropped = 0                           # guarded-by: _lock
         self._tls = threading.local()               # per-thread ambient
 
     # -- ids / ambient ---------------------------------------------------
@@ -152,9 +154,22 @@ class Tracer:
         sp.t1 = self._now()
         if args:
             sp.args.update(args)
-        with self._lock:
-            self._ring.append(sp.to_record())
+        self._commit(sp.to_record())
         return sp
+
+    def _commit(self, rec):
+        """Append a finished record, counting the oldest entry a full
+        ring silently evicts — a truncated timeline must be VISIBLY
+        truncated (``telemetry.trace.dropped_spans``, and
+        :func:`chrome_trace` stamps the count into its output)."""
+        with self._lock:
+            evicting = len(self._ring) == self.ring_size
+            self._ring.append(rec)
+            if evicting:
+                self._dropped += 1
+        if evicting:
+            from . import inc       # outside _lock; one counter bump
+            inc("telemetry.trace.dropped_spans")
 
     def record(self, name, t0, t1, parent=None, **args):
         """Commit an already-timed ``[t0, t1]`` span in one call (the
@@ -167,8 +182,7 @@ class Tracer:
         else:
             sp = Span(name, parent.trace, sid, parent.span, t0, args)
         sp.t1 = t1
-        with self._lock:
-            self._ring.append(sp.to_record())
+        self._commit(sp.to_record())
         return sp
 
     def push(self, sp):
@@ -184,10 +198,16 @@ class Tracer:
         with self._lock:
             return [dict(r) for r in self._ring]
 
+    def dropped(self):
+        """Spans the bounded ring has evicted since the last reset."""
+        with self._lock:
+            return self._dropped
+
     def reset(self):
         with self._lock:
             self._ring.clear()
             self._next_id = 0
+            self._dropped = 0
         # the calling thread's ambient stack; other threads' stacks die
         # with their work
         self._tls = threading.local()
@@ -327,6 +347,14 @@ def spans():
     return _TRACER.spans()
 
 
+def dropped():
+    """Finished spans the bounded ring evicted since the last reset
+    (0 when disabled) — the visible-truncation counter (ISSUE 15)."""
+    if not _ENABLED:
+        return 0
+    return _TRACER.dropped()
+
+
 def reset():
     """Fresh tracer: empty ring, id counter at zero, DEFAULT clock, env
     kill switch re-read (the conftest between-tests seam) — a test that
@@ -338,26 +366,77 @@ def reset():
 
 # -- export -------------------------------------------------------------
 
-def chrome_trace(include_profiler=True):
+def _span_event(r, pid, tid):
+    return {
+        "name": r["name"], "ph": "X", "pid": pid, "tid": tid,
+        "ts": r["t0"] * 1e6,
+        "dur": max(0.0, (r["t1"] - r["t0"]) * 1e6),
+        "args": dict(r["args"], trace=r["trace"], span=r["span"],
+                     parent=r["parent"]),
+    }
+
+
+def _fleet_chrome_trace(fleet):
+    """Per-rank process lanes over a fleet snapshot's stitched span
+    rings (ISSUE 15): ``pid`` = rank, threads keep their lanes inside
+    each rank.  Span ids are per-process — cross-worker linkage rides
+    the ``remote_trace``/``remote_span`` args the PS RPC context
+    wrapper stamped server-side.  The estimated per-rank clock offset
+    is DISCLOSED as a lane label and in ``otherData`` — timestamps are
+    never shifted (the scrape round-trip bounds the estimate; shifting
+    would fake a precision the estimate does not have)."""
+    events, meta = [], []
+    dropped = {}
+    offsets = {}
+    for rank_s, row in sorted((fleet.get("per_rank") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+        pid = int(rank_s)
+        off = row.get("clock_offset_est_s")
+        offsets[rank_s] = off
+        if row.get("dropped_spans"):
+            dropped[rank_s] = row["dropped_spans"]
+        meta.append({"name": "process_name", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"name": f"rank {pid}"}})
+        meta.append({"name": "process_labels", "ph": "M", "pid": pid,
+                     "tid": 0, "args": {"labels":
+                     ("scrape failed: " + str(row.get("error"))
+                      if not row.get("ok") else
+                      f"clock_offset_est_s={off} "
+                      f"(disclosed estimate; NOT applied)")}})
+        tids = {}
+        for r in row.get("spans") or []:
+            tid = tids.setdefault(r["thread"], len(tids))
+            events.append(_span_event(r, pid, tid))
+        meta.extend({"name": "thread_name", "ph": "M", "pid": pid,
+                     "tid": tid, "args": {"name": thread}}
+                    for thread, tid in tids.items())
+    return {"traceEvents": meta + events,
+            "otherData": {"fleet_schema_version":
+                          fleet.get("fleet_schema_version"),
+                          "clock_offset_est_s": offsets,
+                          "dropped_spans": dropped}}
+
+
+def chrome_trace(include_profiler=True, fleet=None):
     """The merged Chrome-trace JSON object: every finished tracing span
     as a complete ``"X"`` event (ts/dur in microseconds, ``args``
     carrying trace/span/parent ids for perfetto correlation) plus —
     when ``include_profiler`` — the ``profiler.record_span`` B/E event
     stream, so XLA-adjacent pipeline spans and causal request/step
-    spans land on ONE timeline.  Valid input for chrome://tracing and
-    https://ui.perfetto.dev."""
+    spans land on ONE timeline.  With ``fleet`` (a
+    :meth:`~.fleet.FleetCollector.collect` snapshot) the export is the
+    STITCHED multi-worker timeline instead: one process lane per rank,
+    clock offsets disclosed, never applied.  ``otherData`` stamps the
+    ring's drop count so a truncated timeline is visibly truncated.
+    Valid input for chrome://tracing and https://ui.perfetto.dev."""
+    if fleet is not None:
+        return _fleet_chrome_trace(fleet)
     pid = os.getpid()
     events = []
     tids = {}
     for r in spans():
         tid = tids.setdefault(r["thread"], len(tids))
-        events.append({
-            "name": r["name"], "ph": "X", "pid": pid, "tid": tid,
-            "ts": r["t0"] * 1e6,
-            "dur": max(0.0, (r["t1"] - r["t0"]) * 1e6),
-            "args": dict(r["args"], trace=r["trace"], span=r["span"],
-                         parent=r["parent"]),
-        })
+        events.append(_span_event(r, pid, tid))
     if include_profiler:
         from .. import profiler
         ptid = len(tids)
@@ -368,4 +447,7 @@ def chrome_trace(include_profiler=True):
             events.append(ev)
     meta = [{"name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
              "args": {"name": thread}} for thread, tid in tids.items()]
-    return {"traceEvents": meta + events}
+    from . import events_dropped
+    return {"traceEvents": meta + events,
+            "otherData": {"dropped_spans": dropped(),
+                          "dropped_events": events_dropped()}}

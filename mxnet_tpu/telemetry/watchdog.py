@@ -44,8 +44,9 @@ from collections import deque
 
 from ..lint import racecheck as _racecheck
 
-__all__ = ["Watchdog", "enabled", "watchdog", "configure", "reset",
-           "on_step", "on_serving_boundary", "check"]
+__all__ = ["Watchdog", "EdgeRuleEngine", "enabled", "watchdog",
+           "configure", "reset", "on_step", "on_serving_boundary",
+           "check"]
 
 
 def _env_enabled():
@@ -59,34 +60,22 @@ def _env_float(name, default):
         return float(default)
 
 
-class Watchdog:
-    """The rule engine.  ``now`` is the stall clock (injectable —
-    ``testing.faults.FakeClock`` in tests and chaos; defaults to
-    ``time.monotonic``).  Thresholds default from the env so a
-    production job tunes them without code."""
+class EdgeRuleEngine:
+    """The edge-trigger incident machinery, factored out of the process
+    watchdog so the fleet collector (ISSUE 15) fires through the exact
+    same discipline: rules queue an incident on a False->True transition
+    under ``_lock`` and re-arm on the first healthy observation; the
+    actual typed event + counter + flight dump run in :meth:`_drain`
+    OUTSIDE the lock (the dump is file I/O — HB16).  ``_PREFIX`` names
+    the incident family (``watchdog.<rule>`` / ``fleet.<rule>``, dump
+    reason ``"<prefix>:<rule>"``)."""
 
-    def __init__(self, now=None, stall_s=None, spike_factor=None,
-                 spike_window=16, queue_depth=None, queue_boundaries=8,
-                 kv_window=16, kv_windows=3):
-        import time
-        self._now = now if now is not None else time.monotonic
-        self.stall_s = float(stall_s) if stall_s is not None \
-            else _env_float("MXTPU_WATCHDOG_STALL_S", 120.0)
-        self.spike_factor = float(spike_factor) if spike_factor \
-            is not None else _env_float("MXTPU_WATCHDOG_SPIKE", 10.0)
-        self.queue_depth = int(queue_depth) if queue_depth is not None \
-            else int(_env_float("MXTPU_WATCHDOG_QUEUE", 64))
-        self.queue_boundaries = int(queue_boundaries)
-        self.kv_window = int(kv_window)
-        self.kv_windows = int(kv_windows)
-        self._lock = _racecheck.make_lock("telemetry.Watchdog._lock")
+    _PREFIX = "watchdog"
+
+    def __init__(self):
+        self._lock = _racecheck.make_lock(
+            f"telemetry.{type(self).__name__}._lock")
         # everything below: guarded-by: _lock
-        self._losses = deque(maxlen=int(spike_window))
-        self._last_step_t = None
-        self._saturated = 0
-        self._kv_samples = []
-        self._kv_min_run = 0
-        self._kv_last_min = None
         self._tripped = set()        # rules currently in-incident
         self._pending = []           # incidents to fire OUTSIDE _lock
         self.trips = []              # (rule, detail) history
@@ -96,12 +85,12 @@ class Watchdog:
         """One incident: typed event + counter + flight dump.  The
         event is emitted BEFORE the dump so the dump's last event IS
         the incident (the chaos-harness contract).  Runs OUTSIDE the
-        watchdog lock — the flight dump is file I/O (HB16)."""
+        engine lock — the flight dump is file I/O (HB16)."""
         from . import event, inc, dump_flight
-        event(f"watchdog.{rule}", **detail)
-        inc("watchdog.trips")
-        inc(f"watchdog.{rule}.trips")
-        dump_flight(f"watchdog:{rule}")
+        event(f"{self._PREFIX}.{rule}", **detail)
+        inc(f"{self._PREFIX}.trips")
+        inc(f"{self._PREFIX}.{rule}.trips")
+        dump_flight(f"{self._PREFIX}:{rule}")
 
     def _drain(self):
         """Fire every incident queued under the lock (caller must NOT
@@ -113,17 +102,52 @@ class Watchdog:
                 rule, detail = self._pending.pop(0)
             self._fire(rule, detail)
 
-    def _edge(self, rule, firing, **detail):  # guarded-by: _lock
-        """Edge-trigger ``rule``: queue a firing on False->True, re-arm
+    def _edge(self, key, firing, rule=None, **detail):  # guarded-by: _lock
+        """Edge-trigger ``key``: queue a firing on False->True, re-arm
         on the first healthy observation.  Called under ``_lock``; the
-        actual event/dump happens in :meth:`_drain` after release."""
+        actual event/dump happens in :meth:`_drain` after release.
+        ``rule`` names the fired incident when several edges share one
+        rule (the fleet's per-rank straggler edges); defaults to
+        ``key``."""
+        if rule is None:
+            rule = key
         if firing:
-            if rule not in self._tripped:
-                self._tripped.add(rule)
+            if key not in self._tripped:
+                self._tripped.add(key)
                 self._pending.append((rule, detail))
                 self.trips.append((rule, detail))
         else:
-            self._tripped.discard(rule)
+            self._tripped.discard(key)
+
+
+class Watchdog(EdgeRuleEngine):
+    """The rule engine.  ``now`` is the stall clock (injectable —
+    ``testing.faults.FakeClock`` in tests and chaos; defaults to
+    ``time.monotonic``).  Thresholds default from the env so a
+    production job tunes them without code."""
+
+    def __init__(self, now=None, stall_s=None, spike_factor=None,
+                 spike_window=16, queue_depth=None, queue_boundaries=8,
+                 kv_window=16, kv_windows=3):
+        import time
+        super().__init__()
+        self._now = now if now is not None else time.monotonic
+        self.stall_s = float(stall_s) if stall_s is not None \
+            else _env_float("MXTPU_WATCHDOG_STALL_S", 120.0)
+        self.spike_factor = float(spike_factor) if spike_factor \
+            is not None else _env_float("MXTPU_WATCHDOG_SPIKE", 10.0)
+        self.queue_depth = int(queue_depth) if queue_depth is not None \
+            else int(_env_float("MXTPU_WATCHDOG_QUEUE", 64))
+        self.queue_boundaries = int(queue_boundaries)
+        self.kv_window = int(kv_window)
+        self.kv_windows = int(kv_windows)
+        # everything below: guarded-by: _lock
+        self._losses = deque(maxlen=int(spike_window))
+        self._last_step_t = None
+        self._saturated = 0
+        self._kv_samples = []
+        self._kv_min_run = 0
+        self._kv_last_min = None
 
     # -- training seams --------------------------------------------------
     def on_step(self, step, loss=None, grad_norm=None, step_ms=None):

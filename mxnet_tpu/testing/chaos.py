@@ -76,7 +76,20 @@ tpu_queue_runner.py --chaos watchdog``) runs the RUN-HEALTH scenario
 point and a FakeClock step stall must each emit a typed ``watchdog.*``
 event and dump the flight recorder with ``reason="watchdog:<rule>"``.
 
-``python -m mxnet_tpu.testing.chaos all`` runs all five suites.
+``python -m mxnet_tpu.testing.chaos fleet`` (or ``tools/
+tpu_queue_runner.py --chaos fleet``) runs the FLEET-OBSERVABILITY
+scenario (ISSUE 15): N simulated workers (per-rank metric registries —
+exactly what a remote ``PSClient.telemetry()`` scrape returns) stepped
+under ONE FakeClock with zero sleeps, one injected straggler (its
+steps run long via the ``fleet.straggle`` fault-point clock advance)
+and one scrape-dead rank (its transport raises).  The
+``FleetCollector`` must name BOTH ranks in typed ``fleet.straggler`` /
+``fleet.scrape_dead`` events with flight dumps whose reason carries
+the rule, the merged histograms must equal the element-wise per-rank
+bucket sums bitwise, and racecheck must report zero findings on the
+collector locks.
+
+``python -m mxnet_tpu.testing.chaos all`` runs all six suites.
 """
 from __future__ import annotations
 
@@ -949,6 +962,118 @@ def run_watchdog_scenario(total_steps=6, nan_at=3, workdir=None):
     return result
 
 
+# ----------------------------------------------------------------------
+# Fleet observability scenario (ISSUE 15): N simulated workers, one
+# straggler + one scrape-dead rank — the fleet collector must name both
+# by rank, merge histograms exactly, and stay racecheck-clean.
+# ----------------------------------------------------------------------
+
+def run_fleet_scenario(n_workers=4, straggler_rank=2, dead_rank=3,
+                       steps=4, workdir=None):
+    """The ISSUE 15 acceptance scenario; see the module docstring.
+    Deterministic: per-rank registries on ONE FakeClock, zero sleeps,
+    the straggler's extra step time injected through the
+    ``fleet.straggle`` fault point (the detection path is exactly what
+    a real pod scrape sees)."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.telemetry import fleet as fleet_mod
+    from mxnet_tpu.telemetry.registry import MetricsRegistry
+    from mxnet_tpu.testing import faults
+
+    rc = _racecheck_arm()
+    clock = faults.FakeClock(3000.0)
+    result = {"kind": "fleet", "workers": n_workers,
+              "straggler_rank": straggler_rank, "dead_rank": dead_rank,
+              "steps": steps}
+
+    # N simulated workers: each rank is its own registry — exactly the
+    # snapshot a remote PSClient.telemetry() scrape returns — stepped
+    # under the same FakeClock.  Every rank also carries the same
+    # membership epoch (no desync in this scenario) and its own step
+    # counter.
+    regs = {r: MetricsRegistry(now=clock) for r in range(n_workers)}
+    with faults.inject("fleet.straggle",
+                       action=lambda rank: clock.advance(0.45)):
+        for _ in range(steps):
+            for r in range(n_workers):
+                t0 = clock()
+                clock.advance(0.05)          # the nominal 50 ms step
+                if r == straggler_rank:
+                    # the injected straggler: the armed fault point
+                    # advances the clock mid-"step", so THIS rank's
+                    # step_ms histogram runs ~10x long
+                    faults.fault_point("fleet.straggle", payload=r)
+                regs[r].histogram("train.step_ms").observe(
+                    (clock() - t0) * 1e3)
+                regs[r].counter("train.steps").inc()
+                regs[r].gauge("elastic.epoch").set(3)
+
+    def transport(rank):
+        def scrape():
+            if rank == dead_rank:
+                raise ConnectionError("simulated dead scrape endpoint")
+            return {"snapshot": regs[rank].snapshot()}
+        return scrape
+
+    coll = fleet_mod.FleetCollector(
+        {r: transport(r) for r in range(n_workers)},
+        now=clock, skew=3.0, scrape_s=0.0)
+    snap = coll.collect()
+
+    kinds = {}
+    for ev in telemetry.events():
+        kinds.setdefault(ev["kind"], []).append(ev["data"])
+    stragglers = kinds.get("fleet.straggler", [])
+    deads = kinds.get("fleet.scrape_dead", [])
+    result["straggler_named"] = any(
+        d.get("rank") == straggler_rank for d in stragglers)
+    result["scrape_dead_named"] = any(
+        d.get("rank") == dead_rank for d in deads)
+    result["slowest_rank"] = snap["skew"]["slowest_rank"]
+    result["skew_ratio"] = snap["skew"]["skew_ratio"]
+    result["dead_error_typed"] = bool(
+        snap["per_rank"][str(dead_rank)].get("error"))
+
+    # the rule firings must have left a flight dump whose reason names
+    # a fleet rule and whose last event is the incident (ISSUE 9/14
+    # contract, reused verbatim)
+    result["flight_dump"] = _flight_check(expect_kind="fleet")
+    fd = result["flight_dump"]
+    reason_ok = fd is None or str(fd.get("reason", "")
+                                  ).startswith("fleet:")
+
+    # merge exactness: every merged histogram equals the element-wise
+    # sum of the per-rank buckets, computed here in the same ascending
+    # rank order the collector uses — bitwise, not approximately
+    alive = [r for r in range(n_workers) if r != dead_rank]
+    merged = snap["histograms"]["train.step_ms"]
+    expect_counts = [0] * (len(merged["edges"]) + 1)
+    expect_sum, expect_count = 0.0, 0
+    for r in alive:
+        st = regs[r].snapshot()["histograms"]["train.step_ms"]
+        for i, c in enumerate(st["counts"]):
+            expect_counts[i] += c
+        expect_sum += st["sum"]
+        expect_count += st["count"]
+    result["hist_merge_bitwise"] = (
+        merged["counts"] == expect_counts
+        and merged["sum"] == expect_sum
+        and merged["count"] == expect_count)
+    result["counters_summed"] = (
+        snap["counters"]["train.steps"] == steps * len(alive))
+
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    result["ok"] = bool(
+        result["straggler_named"] and result["scrape_dead_named"]
+        and result["slowest_rank"] == straggler_rank
+        and result["dead_error_typed"]
+        and result["hist_merge_bitwise"] and result["counters_summed"]
+        and (fd is None or (fd["ok"] and reason_ok))
+        and (rcv is None or rcv["ok"]))
+    return result
+
+
 def main(argv=None):
     # the smoke must run anywhere — force the simulated CPU mesh exactly
     # like tests/conftest.py does
@@ -981,6 +1106,8 @@ def main(argv=None):
             results.append(run_autoscale_scenario(workdir=workdir))
         if suite in ("watchdog", "all"):
             results.append(run_watchdog_scenario(workdir=workdir))
+        if suite in ("fleet", "all"):
+            results.append(run_fleet_scenario(workdir=workdir))
     finally:
         shutil.rmtree(workdir, ignore_errors=True)
     ok = bool(results) and all(r["ok"] for r in results)

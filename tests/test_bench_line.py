@@ -465,6 +465,96 @@ def test_elastic_nulls_stay_out_of_headline():
 
 
 # ----------------------------------------------------------------------
+# the `fleet` block schema (ISSUE 15): config always real, measured
+# skew/scrape fields null-when-unmeasured — a single-process run can't
+# pass off "no fleet to scrape" as "zero skew measured"
+# ----------------------------------------------------------------------
+
+_FLEET_KEYS = {
+    "fleet_schema_version", "enabled", "ranks", "slowest_rank",
+    "step_ms_skew", "scrape_ms", "stragglers", "epoch_desync",
+    "scrape_dead",
+}
+
+
+def test_fleet_block_schema_is_stable():
+    from mxnet_tpu.telemetry.fleet import (fleet_block,
+                                           FLEET_SCHEMA_VERSION)
+    blk = fleet_block()
+    assert set(blk) == _FLEET_KEYS
+    assert blk["fleet_schema_version"] == FLEET_SCHEMA_VERSION
+    for k in ("slowest_rank", "step_ms_skew", "scrape_ms",
+              "stragglers", "epoch_desync", "scrape_dead"):
+        assert blk[k] is None, k
+    assert blk["enabled"] is False and blk["ranks"] == 0
+    blk2 = fleet_block(enabled=True, ranks=4, slowest_rank=2,
+                       step_ms_skew=3.14159, scrape_ms=12.5555,
+                       stragglers=1, epoch_desync=False, scrape_dead=1)
+    assert blk2["step_ms_skew"] == 3.1416
+    assert blk2["scrape_ms"] == 12.556
+    assert blk2["slowest_rank"] == 2 and blk2["scrape_dead"] == 1
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_bench_fleet_single_process_is_nulls_not_zeros(monkeypatch):
+    """bench.py's fleet block without MXTPU_FLEET_ADDRS: there is no
+    fleet to scrape, so every measured field is null — the correctness
+    evidence lives in the tier-1 chaos fleet suite."""
+    monkeypatch.delenv("MXTPU_FLEET_ADDRS", raising=False)
+    blk = bench._bench_fleet()
+    assert blk["slowest_rank"] is None
+    assert blk["step_ms_skew"] is None
+    assert blk["scrape_ms"] is None
+    assert blk["stragglers"] is None
+    assert "note" in blk
+
+
+def test_fleet_compact_keys_surface_when_measured():
+    from mxnet_tpu.telemetry.fleet import fleet_block
+    p = _success_payload()
+    p["extra"]["fleet"] = fleet_block(
+        enabled=True, ranks=4, slowest_rank=2, step_ms_skew=3.1,
+        scrape_ms=12.5, stragglers=1)
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["fleet_slowest_rank"] == 2
+    assert obj["fleet_skew"] == 3.1
+    assert obj["fleet_scrape_ms"] == 12.5
+
+
+def test_fleet_nulls_stay_out_of_headline():
+    from mxnet_tpu.telemetry.fleet import fleet_block
+    p = _success_payload()
+    p["extra"]["fleet"] = fleet_block(enabled=True, ranks=1)
+    obj = json.loads(bench._compact_line(p))
+    assert "fleet_slowest_rank" not in obj
+    assert "fleet_skew" not in obj
+    assert "fleet_scrape_ms" not in obj
+
+
+def test_bench_diff_gates_fleet_schema_drift(tmp_path, capsys):
+    """tools/bench_diff.py refuses (exit 2) to compare payloads whose
+    fleet blocks carry different fleet_schema_versions — the ISSUE 11
+    telemetry-schema discipline extended to the fleet snapshot."""
+    from tools import bench_diff
+    from mxnet_tpu.telemetry.fleet import fleet_block
+    base = {"metric": "m", "value": 1.0, "platform": "cpu",
+            "telemetry_schema_version": 1,
+            "extra": {"fleet": fleet_block(enabled=True, ranks=2)}}
+    drift = json.loads(json.dumps(base))
+    drift["extra"]["fleet"]["fleet_schema_version"] += 1
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(drift))
+    rc = bench_diff.main([str(a), str(b), "--quiet"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "fleet_schema_drift" in out
+    # same fleet schema compares fine
+    b.write_text(json.dumps(base))
+    assert bench_diff.main([str(a), str(b), "--quiet"]) == 0
+
+
+# ----------------------------------------------------------------------
 # telemetry stamping (ISSUE 9): every bench JSON carries the telemetry
 # schema version, and telemetry-derived block fields keep the PR 6
 # null-when-unmeasured honesty rules

@@ -17,7 +17,8 @@ Rules of the diff (the PR 6 honesty discipline applies):
   TPU-only metric;
 - ``telemetry_schema_version`` is checked first: payloads from
   different schemas do not compare (exit 2) unless
-  ``--allow-schema-drift``;
+  ``--allow-schema-drift``; the bench ``fleet`` block's
+  ``fleet_schema_version`` (ISSUE 15) is checked the same way;
 - direction comes from the metric name (``*_ms``/latency: lower is
   better; throughput/efficiency/MFU: higher is better); metrics with
   unknown direction are reported informationally and never gate;
@@ -48,10 +49,12 @@ _DOWN_SUFFIXES = ("_ms", "p99", "p50", "ttft", "bubble_frac",
                   "pp_bubble_frac", "exposed_ms")
 # config/provenance keys: never compared (a changed knob is not a perf
 # regression; the human reads those out of the payload directly)
-_SKIP_KEYS = {"telemetry_schema_version", "batch", "dtype", "data",
+_SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
+              "batch", "dtype", "data",
               "steps_per_call", "s2d_stem", "n", "rc", "cmd", "tail",
               "time", "cached_at", "dp", "buckets", "epoch",
-              "membership_epoch", "transitions"}
+              "membership_epoch", "transitions", "ranks",
+              "slowest_rank"}
 
 
 def direction(key):
@@ -159,6 +162,20 @@ def main(argv=None):
             and not args.allow_schema_drift:
         verdict.update(status="schema_drift", old_schema=vo,
                        new_schema=vn)
+        print("BENCHDIFF " + json.dumps(verdict))
+        return 2
+
+    # the fleet snapshot schema is versioned the same way (ISSUE 15):
+    # payloads whose `fleet` blocks come from different schemas do not
+    # compare
+    fvo = ((old.get("extra") or {}).get("fleet")
+           or {}).get("fleet_schema_version")
+    fvn = ((new.get("extra") or {}).get("fleet")
+           or {}).get("fleet_schema_version")
+    if fvo is not None and fvn is not None and fvo != fvn \
+            and not args.allow_schema_drift:
+        verdict.update(status="fleet_schema_drift", old_schema=fvo,
+                       new_schema=fvn)
         print("BENCHDIFF " + json.dumps(verdict))
         return 2
 

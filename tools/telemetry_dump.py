@@ -1,20 +1,28 @@
 #!/usr/bin/env python
 """Read mx.telemetry state — live or post-mortem — and print it
-(ISSUE 9 tooling).
+(ISSUE 9 tooling; fleet aggregation since ISSUE 15).
 
-Three sources, one renderer:
+Sources, one renderer:
 
   --file PATH        a flight-recorder dump (``mxtpu_flight.<pid>.json``)
                      or a bare ``snapshot()`` JSON file
-  --host H --port P  live scrape over the PS server's ``_OP_TELEMETRY``
-                     RPC (any running job with a PSServer — dist_async
-                     training, the elastic membership server — doubles
-                     as a scrape endpoint, no extra port)
+  --host SPEC        live scrape over the PS server's ``_OP_TELEMETRY``
+                     RPC.  SPEC is one or more comma-separated hosts
+                     (``h``, ``h:p``, or ``h0:p0,h1:p1,...`` — bare
+                     hosts take --port).  A dead host prints ONE typed
+                     ``SCRAPE_FAILED {...}`` line and the dump
+                     continues with the survivors instead of aborting.
+  --fleet            merge the multi-host scrape into ONE fleet
+                     snapshot (``telemetry.fleet.FleetCollector``):
+                     counters summed, per-rank gauges, histograms
+                     merged EXACTLY, skew analysis naming the slowest
+                     rank.  With --trace the stitched per-rank span
+                     rings export as one perfetto timeline (clock
+                     offsets disclosed per lane, never applied).
   --self-test        emit a tiny in-process registry (smoke/demo)
-  --trace OUT.json   export THIS process's merged causal-tracing +
-                     profiler span stream as Chrome-trace JSON
-                     (ISSUE 14; open in chrome://tracing or perfetto —
-                     combine with --self-test for a demo trace)
+  --trace OUT.json   export the merged causal-tracing + profiler span
+                     stream as Chrome-trace JSON (ISSUE 14; with
+                     --fleet: the stitched multi-worker timeline)
 
 ``--format=prom`` prints Prometheus text exposition (the scrape
 integration path); ``--format=json`` prints the snapshot/dump verbatim.
@@ -24,6 +32,7 @@ after the metrics.
 Examples:
   python tools/telemetry_dump.py --file /tmp/mxtpu_flight.4242.json
   python tools/telemetry_dump.py --host 127.0.0.1 --port 9090 --format=prom
+  python tools/telemetry_dump.py --fleet --host h0:9090,h1:9090 --trace pod.json
 """
 from __future__ import annotations
 
@@ -46,6 +55,22 @@ def _load_file(path):
     return payload, payload
 
 
+def _parse_hosts(spec, port):
+    """``h``, ``h:p``, or a comma-separated list of either -> ordered
+    [(host, port), ...]; bare hosts need --port."""
+    out = []
+    for part in (p.strip() for p in str(spec).split(",") if p.strip()):
+        host, _, p = part.rpartition(":")
+        if host and p.isdigit():
+            out.append((host, int(p)))
+        elif port:
+            out.append((part, int(port)))
+        else:
+            raise SystemExit(f"host {part!r} carries no port and no "
+                             f"--port was given")
+    return out
+
+
 def _scrape(host, port, fmt):
     from mxnet_tpu.kvstore.ps_server import PSClient
     client = PSClient(host, port, retries=3)
@@ -55,12 +80,79 @@ def _scrape(host, port, fmt):
         client.close()
 
 
+def _dump_hosts(hosts, fmt):
+    """Per-host scrape, one section each; a dead host is a typed line,
+    not an abort (ISSUE 15 satellite).  Exit 0 when at least one host
+    answered."""
+    ok = 0
+    for host, port in hosts:
+        try:
+            out = _scrape(host, port, fmt)
+        except Exception as e:  # noqa: BLE001 — typed line, keep going
+            print("SCRAPE_FAILED " + json.dumps(
+                {"host": host, "port": port,
+                 "error": f"{type(e).__name__}: {e}"}))
+            continue
+        ok += 1
+        if len(hosts) > 1:
+            print(f"# host {host}:{port}")
+        if fmt == "prom":
+            print(out.get("text", ""), end="")
+        else:
+            print(json.dumps(out, indent=1))
+    return 0 if ok else 1
+
+
+def _dump_fleet(hosts, fmt, trace_out):
+    """Multi-host scrape merged into ONE fleet snapshot; per-host
+    failures stay typed lines AND land in the snapshot's per_rank
+    rows."""
+    from mxnet_tpu.telemetry import fleet as fleet_mod
+    transports = {rank: fleet_mod.ps_transport(host, port)
+                  for rank, (host, port) in enumerate(hosts)}
+    coll = fleet_mod.FleetCollector(transports)
+    snap = coll.collect()
+    for rank_s, row in sorted((snap.get("per_rank") or {}).items(),
+                              key=lambda kv: int(kv[0])):
+        if not row.get("ok"):
+            host, port = hosts[int(rank_s)]
+            print("SCRAPE_FAILED " + json.dumps(
+                {"rank": int(rank_s), "host": host, "port": port,
+                 "error": row.get("error")}))
+    if trace_out:
+        from mxnet_tpu.telemetry import tracing
+        payload = tracing.chrome_trace(fleet=snap)
+        with open(trace_out, "w", encoding="utf-8") as f:
+            json.dump(payload, f)
+        n = sum(1 for ev in payload["traceEvents"]
+                if ev.get("ph") != "M")
+        print(f"# wrote {n} fleet trace event(s) to {trace_out}")
+        return 0 if snap.get("alive") else 1
+    if fmt == "prom":
+        from mxnet_tpu.telemetry.prom import prom_text
+        print(prom_text(fleet_mod.fleet_prom_snapshot(snap)), end="")
+    else:
+        # the span rings are trace payload, not a metrics dump — keep
+        # the JSON view readable
+        slim = dict(snap)
+        slim["per_rank"] = {r: {k: v for k, v in row.items()
+                                if k != "spans"}
+                            for r, row in snap["per_rank"].items()}
+        print(json.dumps(slim, indent=1))
+    return 0 if snap.get("alive") else 1
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--file", help="flight-recorder dump or snapshot JSON")
-    ap.add_argument("--host", help="PS server host for a live scrape")
-    ap.add_argument("--port", type=int, help="PS server port")
+    ap.add_argument("--host", help="PS host(s): h, h:p, or a "
+                                   "comma-separated list")
+    ap.add_argument("--port", type=int, help="default port for bare "
+                                             "--host entries")
     ap.add_argument("--format", choices=("prom", "json"), default="prom")
+    ap.add_argument("--fleet", action="store_true",
+                    help="merge the multi-host scrape into ONE fleet "
+                         "snapshot (ISSUE 15)")
     ap.add_argument("--events", action="store_true",
                     help="also print the event ring (flight dumps) as "
                          "JSONL")
@@ -68,7 +160,8 @@ def main(argv=None):
                     help="render a tiny in-process registry and exit")
     ap.add_argument("--trace", metavar="OUT",
                     help="write the merged tracing + profiler span "
-                         "stream as Chrome-trace JSON to OUT")
+                         "stream as Chrome-trace JSON to OUT (with "
+                         "--fleet: the stitched per-rank timeline)")
     args = ap.parse_args(argv)
 
     from mxnet_tpu.telemetry.prom import prom_text
@@ -87,6 +180,12 @@ def main(argv=None):
               else json.dumps(snap, indent=1))
         if not args.trace:
             return 0
+
+    if args.host:
+        hosts = _parse_hosts(args.host, args.port)
+        if args.fleet:
+            return _dump_fleet(hosts, args.format, args.trace)
+        return _dump_hosts(hosts, args.format)
 
     if args.trace:
         from mxnet_tpu.telemetry import tracing
@@ -113,15 +212,7 @@ def main(argv=None):
                 print(json.dumps(ev))
         return 0
 
-    if args.host and args.port:
-        out = _scrape(args.host, args.port, args.format)
-        if args.format == "prom":
-            print(out.get("text", ""), end="")
-        else:
-            print(json.dumps(out, indent=1))
-        return 0
-
-    ap.error("need --file, --host/--port, or --self-test")
+    ap.error("need --file, --host, or --self-test")
     return 2
 
 

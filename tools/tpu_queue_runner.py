@@ -515,7 +515,7 @@ def step_bert128(st: dict) -> None:
 
 
 def run_chaos(suite: str = "preempt") -> int:
-    """``--chaos [elastic|serving|autoscale|watchdog|all]``: the
+    """``--chaos [elastic|serving|autoscale|watchdog|fleet|all]``: the
     fault-tolerance smoke (mxnet_tpu.testing.chaos) in a child process
     on the simulated
     CPU mesh.  Default suite: kill the checkpoint writer, preempt at
@@ -537,7 +537,12 @@ def run_chaos(suite: str = "preempt") -> int:
     NaN loss injected through the ``watchdog.loss`` fault point and a
     FakeClock step stall must each leave a typed ``watchdog.*`` event
     and a flight dump whose reason names the rule
-    (``watchdog:nonfinite_loss`` / ``watchdog:step_stall``).  Needs no
+    (``watchdog:nonfinite_loss`` / ``watchdog:step_stall``).  ``fleet``
+    (ISSUE 15): N simulated workers under FakeClock with one injected
+    straggler and one scrape-dead rank — the FleetCollector must name
+    both BY RANK in typed ``fleet.*`` events with matching flight
+    dumps, merged histograms must equal per-rank bucket sums bitwise,
+    racecheck zero on the collector locks.  Needs no
     TPU and takes no queue lock: safe to run any time, including while
     the measurement queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
