@@ -974,6 +974,11 @@ def _run_bench() -> dict:
         except Exception as e:  # noqa: BLE001
             result["extra"]["fleet"] = {
                 "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["lint"] = _bench_lint()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["lint"] = {
+                "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)
         ml = _load_memlevers()
@@ -984,6 +989,52 @@ def _run_bench() -> dict:
         if profile:
             from mxnet_tpu import profiler
             profiler.stop()
+
+
+LINT_SCHEMA_VERSION = 1
+
+
+def _bench_lint() -> dict:
+    """Static-correctness evidence (ISSUE 16): the full mxlint sweep
+    (HB01-HB20, including the use-after-donate dataflow pass) over the
+    in-tree ``mxnet_tpu`` package, shipped with the bench line so every
+    round records that the measured code was donation-clean.
+    ``findings`` is a GATE — the tree is kept at zero and a regression
+    shows up in the next bench diff; ``suppressions`` counts the
+    per-line ``# mxlint: disable=`` opt-outs so silently growing the
+    grandfather list is visible too."""
+    from mxnet_tpu.lint.api import lint_paths
+    from mxnet_tpu.lint.rules import ALL_RULE_IDS
+    from mxnet_tpu.lint.suppressions import parse_suppressions
+    import mxnet_tpu.lint as _lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(
+        _lint.__file__)))
+    viol, n_files = lint_paths([pkg])
+    n_supp = 0
+    for root, _dirs, names in os.walk(pkg):
+        for n in names:
+            if not n.endswith(".py"):
+                continue
+            try:
+                with open(os.path.join(root, n), encoding="utf-8") as f:
+                    supp, _unknown = parse_suppressions(f.read())
+            except OSError:
+                continue
+            n_supp += len(supp)
+    by_rule = {}
+    for v in viol:
+        by_rule[v.rule] = by_rule.get(v.rule, 0) + 1
+    blk = {
+        "lint_schema_version": LINT_SCHEMA_VERSION,
+        "rules_enabled": len(ALL_RULE_IDS),
+        "files_checked": n_files,
+        "suppressions": n_supp,
+        "findings": len(viol),
+        "ok": not viol,
+    }
+    if by_rule:
+        blk["by_rule"] = by_rule
+    return blk
 
 
 def _stamp_parallelism(result: dict, trainer) -> dict:

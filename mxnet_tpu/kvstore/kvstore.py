@@ -761,7 +761,10 @@ class KVStoreDistTPUSync(KVStoreTPUSync):
             self._wire_mode = "allgather"
             return None
         try:
-            spec = NamedSharding(mesh, P("w"))
+            # "w" is the PRIVATE single-axis wire mesh _global_mesh()
+            # builds for the bucket all-reduce — never a MeshConfig
+            # mesh, so the AXIS_* contract does not own the name
+            spec = NamedSharding(mesh, P("w"))  # mxlint: disable=HB19
             ndev = len(mesh.devices.ravel())
             local_devs = jax.local_devices()
             bound = self._bound()

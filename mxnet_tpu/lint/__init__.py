@@ -22,11 +22,21 @@ framework-level diagnostics with stable rule IDs:
           time.sleep / jitted dispatch) inside a `with lock:` body
     HB17  hardcoded mesh-axis literal ("dp"/"tp"/"pp" in P()/collective
           calls, mesh.shape["dp"]/[0]) outside parallel/mesh.py
+    HB18  use-after-donate: a name passed in a donated position of a
+          jitted/AOT call is read/returned/stored afterwards without
+          rebinding — intraprocedural dataflow, dataflow.py
+    HB19  mesh-axis consistency: axis names reaching P(...)/shard_map
+          specs/collective axis_name= must be the canonical AXIS_*
+          constants and constructible on the enclosing MeshConfig
+    HB20  donation aliasing: the same array (or an alias of it) passed
+          twice into one donated call, or a donated buffer captured by
+          a closure/self-field that outlives the call
 
 CLI: ``python tools/mxlint.py <paths>`` (non-zero exit on violations,
-``--format=json|text``, per-line ``# mxlint: disable=HB0x``,
+``--format=json|text|sarif``, per-line ``# mxlint: disable=HB0x``,
 ``--write-baseline``/``--baseline``/``--fail-on-new`` to gate CI on
-regressions only). Rule catalog with bad/good snippets:
+regressions only; the baseline reader accepts both its native JSON and
+SARIF files). Rule catalog with bad/good snippets:
 ``docs/LINT.md`` or ``--list-rules``.
 
 Runtime side 2 (``racecheck``): with ``MXTPU_RACECHECK=1`` the threaded
@@ -34,6 +44,15 @@ subsystems create their locks through ``lint.racecheck.make_lock``,
 which maintains a live lock-order graph (cycles flagged the moment an
 edge closes one) and checks registered guarded structures; findings
 dump through the telemetry flight recorder.  Zero overhead when off.
+
+Runtime side 3 (``donation``): with ``MXTPU_DONATION_CHECK=1`` the
+donating dispatch seams (trainer step, serving pool swap) poison their
+donor buffers after dispatch, and any later NDArray host touch
+(``.asnumpy()``/``__getitem__``/``.shape``) of a poisoned buffer raises
+a typed :class:`donation.UseAfterDonateError` naming the dispatch site
+— reproducing on CPU the crash TPU donation would cause.  Findings
+emit ``donation.*`` telemetry and a flight dump.  Zero overhead when
+off.
 
 Runtime side: every ``hybridize()``'d block counts its jax.jit cache
 misses (gluon/block.py CachedOp) and emits a :class:`RetraceWarning`
@@ -48,15 +67,15 @@ from __future__ import annotations
 
 from .analyzer import lint_file, lint_source
 from .api import check, lint_paths
-from .report import Violation, render_json, render_text
+from .report import Violation, render_json, render_sarif, render_text
 from .retrace import RetraceMonitor, RetraceWarning, default_threshold
 from .rules import ALL_RULE_IDS, RULES, Rule
-from . import racecheck
+from . import donation, racecheck
 
 __all__ = [
     "check", "lint_paths", "lint_source", "lint_file",
-    "Violation", "render_text", "render_json",
+    "Violation", "render_text", "render_json", "render_sarif",
     "RULES", "Rule", "ALL_RULE_IDS",
     "RetraceMonitor", "RetraceWarning", "default_threshold",
-    "racecheck",
+    "racecheck", "donation",
 ]

@@ -32,6 +32,7 @@ from __future__ import annotations
 import ast
 
 from .concurrency import run_concurrency_pass
+from .dataflow import run_dataflow_pass
 from .report import Violation
 from .suppressions import parse_suppressions, is_suppressed
 
@@ -1386,6 +1387,9 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
         # HB14/HB15/HB16: the interprocedural concurrency pass (per-class
         # lock + field-access + call-graph model; concurrency.py)
         run_concurrency_pass(collector, tree, path, src_lines)
+        # HB18/HB19/HB20: the intraprocedural dataflow pass (per-function
+        # def-use chains over names + self.* paths; dataflow.py)
+        run_dataflow_pass(collector, tree, path)
     suppressed, _unknown = parse_suppressions(source)
     out = []
     for v in sorted(collector.violations,

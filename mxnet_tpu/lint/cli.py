@@ -1,6 +1,6 @@
 """``mxlint`` CLI entry point (see tools/mxlint.py).
 
-    python tools/mxlint.py <paths...> [--format=text|json] [--rules=HB01,..]
+    python tools/mxlint.py <paths...> [--format=text|json|sarif] [--rules=..]
     python tools/mxlint.py <paths...> --write-baseline base.json
     python tools/mxlint.py <paths...> --baseline base.json --fail-on-new
 
@@ -10,6 +10,9 @@ jax), so it is safe on any tree and in minimal CI images.  Baselines
 grandfather a tree's existing debt by (rule, file) violation COUNTS so
 new strict rules can land on ``mxnet_tpu/`` without blocking
 ``examples/`` — only regressions beyond the snapshot gate CI.
+``--baseline`` accepts either the native counts snapshot or a SARIF
+log (``--format=sarif`` output, or one produced by another tool): a
+SARIF baseline is folded down to the same (rule, file) counts.
 """
 from __future__ import annotations
 
@@ -18,7 +21,7 @@ import json
 import sys
 
 from .api import lint_paths
-from .report import render_json, render_text
+from .report import render_json, render_sarif, render_text
 from .rules import ALL_RULE_IDS, RULES
 from .suppressions import parse_suppressions
 
@@ -43,13 +46,40 @@ def write_baseline(violations, path):
     return counts
 
 
+def _load_baseline_counts(baseline_path):
+    """Read a baseline into (rule, path) counts.  Two formats:
+
+    - native ``--write-baseline`` snapshot: ``{"version", "counts"}``
+    - a SARIF 2.1.0 log (``--format=sarif`` output): each result's
+      ``ruleId`` + first physical location URI is folded into the same
+      count keys, so a stored CI scan doubles as the grandfather list
+    """
+    with open(baseline_path, encoding="utf-8") as f:
+        base = json.load(f)
+    if not isinstance(base, dict):
+        raise ValueError("baseline is not a JSON object")
+    if "runs" in base:  # SARIF log
+        counts = {}
+        for run in base.get("runs") or []:
+            for result in run.get("results") or []:
+                rule = result.get("ruleId", "")
+                uri = ""
+                locs = result.get("locations") or []
+                if locs:
+                    uri = (locs[0].get("physicalLocation", {})
+                           .get("artifactLocation", {}).get("uri", ""))
+                if rule and uri:
+                    k = f"{rule}|{uri}"
+                    counts[k] = counts.get(k, 0) + 1
+        return counts
+    return dict(base.get("counts", {}))
+
+
 def filter_new(violations, baseline_path):
     """Keep only violations beyond the baseline: within each
     (rule, path) group, the first ``baseline_count`` hits (in line
     order) are grandfathered; anything past that is a regression."""
-    with open(baseline_path, encoding="utf-8") as f:
-        base = json.load(f)
-    counts = dict(base.get("counts", {}))
+    counts = _load_baseline_counts(baseline_path)
     grandfathered = 0
     out = []
     for v in sorted(violations, key=lambda v: (v.path, v.line, v.col,
@@ -80,11 +110,13 @@ def _parse_rules(spec):
 def main(argv=None):
     ap = argparse.ArgumentParser(
         prog="mxlint",
-        description="Trace-safety + concurrency static analyzer "
-                    "(rules HB01-HB16; see docs/LINT.md)")
+        description="Trace-safety + concurrency + donation-dataflow "
+                    "static analyzer (rules HB01-HB20; see "
+                    "docs/LINT.md)")
     ap.add_argument("paths", nargs="+",
                     help="python files or directories to lint")
-    ap.add_argument("--format", choices=("text", "json"), default="text",
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
                     help="diagnostic output format (default: text)")
     ap.add_argument("--rules", default=None, metavar="HB0x,HB0y",
                     help="only check these rule IDs")
@@ -155,6 +187,8 @@ def main(argv=None):
 
     if args.format == "json":
         print(render_json(violations, files_checked=n_files))
+    elif args.format == "sarif":
+        print(render_sarif(violations, files_checked=n_files))
     else:
         print(render_text(violations))
         if grandfathered:

@@ -1,0 +1,816 @@
+"""Intraprocedural dataflow pass — HB18/HB19/HB20 (ISSUE 16).
+
+Grows the linter from per-statement pattern matching into per-function
+**def-use chains** over local names and ``self.*`` attribute paths, then
+ships three rule families on top:
+
+HB18  use-after-donate: a name passed in a donated position of a
+      jitted/AOT call (``donate_argnums``, including executables built
+      in another method of the same class and dispatch-through helpers
+      like the trainer's ``self._dispatch(jitted, *args)``) and then
+      read / returned / stored afterwards without rebinding.  Rebinding
+      the name from the call's result — ``p, s = f(p, s)`` — is the
+      clean pattern: the RHS is evaluated before the targets are
+      stored, so same-statement rebinds never poison.
+HB19  mesh-axis consistency: axis names reaching ``P(...)``,
+      ``shard_map(..., in_specs/out_specs)`` or a collective
+      (``psum``/``all_gather``/... ``axis_name=``) must be drawn from
+      the ``parallel/mesh.py`` AXIS_* constants AND be constructible on
+      the declared ``MeshConfig`` of the enclosing scope — catching an
+      ``"sp"``/``"ep"`` axis before it exists on any mesh, and an
+      ``AXIS_TP`` collective inside a function whose only declared mesh
+      is dp-only.
+HB20  donation-aliasing: the same array object passed twice into one
+      donated call, or a donated buffer that was first stored into a
+      ``self.*`` field / captured by a closure — an alias that outlives
+      the call and dangles the moment the donor buffer is reused.
+
+Why a dedicated pass: CPU XLA silently ignores ``donate_argnums``, so
+tier-1 (CPU parity) structurally cannot catch a use-after-donate — it
+is a latent crash that fires only on the first real TPU round
+(arXiv:1909.09756's device-resident-step discipline makes donation the
+default on every hot path here).  The dataflow pass makes the bug class
+visible at lint time; ``lint/donation.py`` is the runtime half.
+
+Analysis model (deliberately simple, documented so the limits are
+contractual):
+
+- **Linear walk with branch forking.**  Statements are processed in
+  order; ``if``/``try`` branches are analyzed on forked copies of the
+  poison state and merged as a UNION (poisoned on any path counts —
+  a "may" analysis).  Loop bodies are processed twice so a donation at
+  the bottom of iteration N is seen by a read at the top of iteration
+  N+1 (the wraparound case); the collector dedups repeat reports.
+- **Donating callables** are names or ``self.X`` attributes bound from
+  ``jax.jit(..., donate_argnums=...)`` (``.lower(...).compile()`` AOT
+  chains included), resolved across the methods of the enclosing class.
+  A call whose FIRST argument is itself a known donating callable is a
+  dispatch-through (the trainer's ``self._dispatch(jitted, p, s, ...)``
+  seam): donated positions shift right by one.
+- **Kill set.**  Poison dies on rebind (assign / for-target / with-as),
+  and on a method call THROUGH the owner prefix of a poisoned dotted
+  path (``self.cache.update_pools(...)`` may rebind
+  ``self.cache.k_pool`` — the engine's clean pattern), because an
+  intraprocedural pass cannot see the callee's stores.
+
+Stdlib-only (the ``mx.lint`` contract): pure ``ast``, no jax import.
+"""
+from __future__ import annotations
+
+import ast
+
+from .report import Violation
+
+__all__ = ["run_dataflow_pass"]
+
+# The canonical mesh axes — parallel/mesh.py's MeshConfig contract.
+# Deliberately duplicated here as data (the linter never imports the
+# framework): adding an axis (the ROADMAP's "sp"/"ep" items) means
+# touching mesh.py AND this contract in the same PR, which is exactly
+# the single-source ceremony HB19 exists to enforce.
+_CANONICAL_AXES = ("dp", "tp", "pp")
+_CANONICAL_AXIS_CONSTS = ("AXIS_DP", "AXIS_TP", "AXIS_PP")
+_CONST_TO_AXIS = dict(zip(_CANONICAL_AXIS_CONSTS, _CANONICAL_AXES))
+
+_SPEC_CALLEES = {"P", "PartitionSpec"}
+_COLLECTIVE_CALLEES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "psum_scatter",
+    "all_to_all", "ppermute", "pshuffle", "pcast",
+    "reduce_scatter_bucket"}
+_SHARD_MAP_CALLEES = {"shard_map"}
+
+
+def _path_of(node):
+    """A hashable dotted path for a Name/Attribute chain:
+    ``("self", "cache", "k_pool")`` — or None for anything else."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return tuple(reversed(parts))
+    return None
+
+
+def _fmt_path(path):
+    return ".".join(path)
+
+
+def _positions_value(node, env=None):
+    """Resolve a ``donate_argnums`` expression to a position tuple:
+    constant ints/tuples, a local name bound to one (``env``), or an
+    ``(0, 1) if self._donate else ()`` conditional — conditionals
+    resolve to the UNION of their branches, because a position donated
+    on any configuration is a "may" bug on that configuration."""
+    if isinstance(node, ast.IfExp):
+        merged = set()
+        for branch in (node.body, node.orelse):
+            merged |= set(_positions_value(branch, env) or ())
+        return tuple(sorted(merged)) or None
+    if isinstance(node, ast.Name) and env:
+        return env.get(node.id)
+    return _const_positions(node)
+
+
+def _donate_positions(call, env=None):
+    """The statically-known donated positions of a ``jax.jit`` call, or
+    None when the call does not donate / cannot be resolved."""
+    for kw in call.keywords:
+        if kw.arg not in ("donate_argnums", "donate_argnames"):
+            continue
+        return _positions_value(kw.value, env)
+    return None
+
+
+def _const_positions(node):
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for e in node.elts:
+            if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                out.append(e.value)
+            else:
+                return None
+        return tuple(out) or None
+    return None
+
+
+def _unwrap_aot(node):
+    """Peel ``.lower(...).compile()`` / ``.compile()`` AOT chains off a
+    call expression, returning the innermost Call."""
+    while isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("lower", "compile"):
+        node = node.func.value
+    return node if isinstance(node, ast.Call) else None
+
+
+def _donating_expr(node, env=None):
+    """Donated positions when ``node`` is a donating ``jax.jit(...)``
+    expression (AOT chains included), else None."""
+    call = _unwrap_aot(node) if isinstance(node, ast.Call) else None
+    if call is None:
+        return None
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        getattr(f, "id", None)
+    if name != "jit":
+        return None
+    return _donate_positions(call, env)
+
+
+def _local_pos_env(fn):
+    """Local names bound to constant position tuples within ``fn`` —
+    the ``donate = (0, 1) if self._donate else ()`` idiom that then
+    feeds ``donate_argnums=donate``."""
+    env = {}
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node is not fn:
+            continue
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            pos = _positions_value(node.value)
+            if pos:
+                env[node.targets[0].id] = pos
+    return env
+
+
+class _ClassDonations:
+    """Pre-pass over a ClassDef: resolve every donating executable the
+    class builds, across methods —
+
+    - ``self.X = jax.jit(..., donate_argnums=...)`` (AOT chains and the
+      ``donate = (0, 1) if ... else ()`` local-name idiom included), so
+      a step executable built in ``_build`` is recognized when
+      dispatched from ``step``;
+    - methods that RETURN a donating executable (the engine's
+      ``_get``-style factory), recorded in ``method_returns`` so
+      ``fn = self._get(...)`` call sites inherit the positions;
+    - ``self.X = self._build_accum(...)`` resolved through
+      ``method_returns``."""
+
+    def __init__(self, classdef):
+        self.attrs = {}            # attr name -> donated positions
+        self.method_returns = {}   # method name -> donated positions
+        methods = [n for n in classdef.body
+                   if isinstance(n, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef))]
+        pending = []               # (attr, factory method name)
+        for m in methods:
+            env = _local_pos_env(m)
+            local_don = {}         # local name -> positions (this method)
+            # assigns first, returns second: ast.walk is breadth-first,
+            # so `return fn` sits shallower than the `fn = jax.jit(...)`
+            # it refers to (the compile-cache-miss nesting)
+            for node in ast.walk(m):
+                if not isinstance(node, ast.Assign):
+                    continue
+                pos = _donating_expr(node.value, env)
+                for t in node.targets:
+                    if pos and isinstance(t, ast.Name):
+                        local_don[t.id] = pos
+                    if not isinstance(t, ast.Attribute) or \
+                            not isinstance(t.value, ast.Name) or \
+                            t.value.id != "self":
+                        continue
+                    if pos:
+                        self.attrs[t.attr] = pos
+                    elif isinstance(node.value, ast.Call):
+                        vf = _path_of(node.value.func)
+                        if vf and len(vf) == 2 and vf[0] == "self":
+                            pending.append((t.attr, vf[1]))
+            for node in ast.walk(m):
+                if isinstance(node, ast.Return) and \
+                        node.value is not None:
+                    pos = _donating_expr(node.value, env)
+                    if pos is None and isinstance(node.value, ast.Name):
+                        pos = local_don.get(node.value.id)
+                    if pos:
+                        self.method_returns[m.name] = pos
+        for attr, meth in pending:
+            if meth in self.method_returns:
+                self.attrs[attr] = self.method_returns[meth]
+
+
+class _FunctionDataflow:
+    """One function's linear def-use walk (HB18 + HB20)."""
+
+    def __init__(self, pass_, fn, class_name, class_don, method_returns):
+        self.p = pass_
+        self.fn = fn
+        self.cls = class_name or ""
+        self.cls_don = class_don             # self attr -> positions
+        self.cls_returns = method_returns    # factory method -> positions
+        self.env = _local_pos_env(fn)        # donate-tuple local names
+        self.donating = {}     # local path -> positions
+        self.poisoned = {}     # path -> site string
+        self.aliases = {}      # name -> list of alias descriptions
+
+    # -- driving ---------------------------------------------------------
+
+    def run(self):
+        for stmt in self.fn.body:
+            self._stmt(stmt)
+
+    def _stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            self._note_closure(stmt)
+            return
+        if isinstance(stmt, ast.ClassDef):
+            return
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            self._assign(stmt)
+            return
+        if isinstance(stmt, ast.If):
+            self._check_expr(stmt.test)
+            self._fork_branches([stmt.body, stmt.orelse])
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            self._check_expr(stmt.iter)
+            self._kill_target(stmt.target)
+            # two passes: catch donation-at-bottom / read-at-top
+            for _ in range(2):
+                for s in stmt.body:
+                    self._stmt(s)
+                self._kill_target(stmt.target)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.While):
+            self._check_expr(stmt.test)
+            for _ in range(2):
+                for s in stmt.body:
+                    self._stmt(s)
+                self._check_expr(stmt.test)
+            for s in stmt.orelse:
+                self._stmt(s)
+            return
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                self._check_expr(item.context_expr)
+                if item.optional_vars is not None:
+                    self._kill_target(item.optional_vars)
+            for s in stmt.body:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Try):
+            self._fork_branches(
+                [stmt.body] + [h.body for h in stmt.handlers]
+                + ([stmt.orelse] if stmt.orelse else []))
+            for s in stmt.finalbody:
+                self._stmt(s)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                for call in self._calls_in(stmt.value):
+                    self._handle_call(call)   # HB20 still applies; the
+                    # pending poison is moot — nothing runs after return
+                self._check_expr(stmt.value, reading="returned")
+            return
+        if isinstance(stmt, ast.Expr):
+            self._expr_stmt(stmt.value)
+            return
+        if isinstance(stmt, (ast.Delete,)):
+            for t in stmt.targets:
+                self._kill_target(t)
+            return
+        # raise/assert/global/pass/...: check embedded expressions
+        for child in ast.iter_child_nodes(stmt):
+            if isinstance(child, ast.expr):
+                self._check_expr(child)
+
+    def _fork_branches(self, bodies):
+        base_poison = dict(self.poisoned)
+        base_don = dict(self.donating)
+        merged = dict(base_poison)
+        merged_don = dict(base_don)
+        for body in bodies:
+            self.poisoned = dict(base_poison)
+            self.donating = dict(base_don)
+            for s in body:
+                self._stmt(s)
+            merged.update(self.poisoned)      # union: "may" analysis
+            merged_don.update(self.donating)  # `jitted = ...` chosen in
+            # a branch (the step-variant selection idiom) stays known
+        self.poisoned = merged
+        self.donating = merged_don
+
+    # -- assignments -----------------------------------------------------
+
+    def _assign(self, stmt):
+        if isinstance(stmt, ast.AugAssign):
+            self._check_expr(stmt.value)
+            self._check_expr(stmt.target)   # aug target is read first
+            self._kill_target(stmt.target)
+            return
+        value = stmt.value
+        targets = stmt.targets if isinstance(stmt, ast.Assign) \
+            else ([stmt.target] if stmt.value is not None else [])
+        if value is None:
+            return
+        # donating-callable binding? (f = jax.jit(...); AOT chains)
+        pos = _donating_expr(value, self.env)
+        if pos:
+            for t in targets:
+                tp = _path_of(t)
+                if tp:
+                    self.donating[tp] = pos
+            # still check the jit args themselves for poisoned reads
+            self._check_expr(value)
+            return
+        # factory binding: fn = self._get(...) where _get returns a
+        # donating executable (resolved by the class pre-pass); a
+        # literal donate=False / donate_argnums=() at the call site is
+        # an explicit opt-out (the overlap-probe idiom)
+        if isinstance(value, ast.Call):
+            vf = _path_of(value.func)
+            opted_out = any(
+                kw.arg in ("donate", "donate_argnums") and
+                ((isinstance(kw.value, ast.Constant) and
+                  not kw.value.value) or
+                 (isinstance(kw.value, (ast.Tuple, ast.List)) and
+                  not kw.value.elts))
+                for kw in value.keywords)
+            if vf and len(vf) == 2 and vf[0] == "self" and \
+                    vf[1] in self.cls_returns and not opted_out:
+                for t in targets:
+                    tp = _path_of(t)
+                    if tp:
+                        self.donating[tp] = self.cls_returns[vf[1]]
+        # plain alias of a donating callable: g = self._step
+        vp = _path_of(value)
+        if vp is not None:
+            dpos = self._donation_of(vp)
+            if dpos:
+                for t in targets:
+                    tp = _path_of(t)
+                    if tp:
+                        self.donating[tp] = dpos
+        # a lambda on the RHS (metrics = lambda: params.sum()) captures
+        # its free names just like a nested def — record the aliases
+        for n in ast.walk(value):
+            if isinstance(n, ast.Lambda):
+                self._note_closure(n)
+        # self.X = name  — record the alias BEFORE any later donation
+        for t in targets:
+            if isinstance(t, ast.Attribute) and \
+                    isinstance(t.value, ast.Name) and \
+                    t.value.id == "self" and isinstance(value, ast.Name):
+                self.aliases.setdefault(value.id, []).append(
+                    f"stored into self.{t.attr} at line {stmt.lineno}")
+        # RHS first (a donating call poisons its donated args, and
+        # poisoned reads inside the RHS are violations) ...
+        to_poison = self._expr_stmt(value, collect=True)
+        # ... then the targets rebind: same-statement rebinding from the
+        # result is the CLEAN pattern, so targets cancel pending poison
+        killed = set()
+        for t in targets:
+            killed |= self._kill_target(t)
+        for path, site in to_poison:
+            if path not in killed:
+                self.poisoned[path] = site
+
+    def _kill_target(self, target):
+        """Rebinding kills poison; returns the set of killed paths."""
+        killed = set()
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                killed |= self._kill_target(e)
+            return killed
+        if isinstance(target, ast.Starred):
+            return self._kill_target(target.value)
+        tp = _path_of(target)
+        if tp is not None:
+            killed.add(tp)
+            self.poisoned.pop(tp, None)
+            # rebinding a prefix (self.cache = ...) kills everything
+            # under it
+            for p in [p for p in self.poisoned
+                      if p[:len(tp)] == tp and len(p) > len(tp)]:
+                self.poisoned.pop(p, None)
+                killed.add(p)
+        elif isinstance(target, ast.Subscript):
+            self._check_expr(target.value)
+            self._check_expr(target.slice)
+        return killed
+
+    # -- expressions -----------------------------------------------------
+
+    def _expr_stmt(self, expr, collect=False):
+        """Process an expression statement / assignment RHS.  Donating
+        calls poison their donated args AFTER the statement; with
+        ``collect=True`` the pending poisons are returned instead of
+        applied (assignment targets get a chance to cancel them)."""
+        pending = []
+        for call in self._calls_in(expr):
+            pending.extend(self._handle_call(call))
+        self._check_expr(expr, skip_calls=True)
+        if collect:
+            return pending
+        for path, site in pending:
+            self.poisoned[path] = site
+        return []
+
+    def _calls_in(self, expr):
+        return [n for n in ast.walk(expr) if isinstance(n, ast.Call)]
+
+    def _donation_of(self, path):
+        if path in self.donating:
+            return self.donating[path]
+        if len(path) == 2 and path[0] == "self" and \
+                path[1] in self.cls_don:
+            return self.cls_don[path[1]]
+        return None
+
+    def _handle_call(self, call):
+        """HB18 poison + HB20 aliasing for one call; returns pending
+        ``(path, site)`` poisons."""
+        callee_path = _path_of(call.func)
+        pos = self._donation_of(callee_path) if callee_path else None
+        args = list(call.args)
+        shift = 0
+        if pos is None and args:
+            # dispatch-through: self._dispatch(jitted, *args) where the
+            # first argument is itself a known donating callable
+            a0 = _path_of(args[0])
+            if a0 is not None:
+                inner = self._donation_of(a0)
+                if inner is not None:
+                    pos = inner
+                    shift = 1
+        # inline jax.jit(step, donate_argnums=..)(a, b) immediate call
+        if pos is None:
+            inner = _donating_expr(call.func, self.env)
+            if inner:
+                pos = inner
+        # immediate factory dispatch: self._get(kind, size, args)(*args)
+        if pos is None and isinstance(call.func, ast.Call):
+            ff = _path_of(call.func.func)
+            if ff and len(ff) == 2 and ff[0] == "self" and \
+                    ff[1] in self.cls_returns:
+                pos = self.cls_returns[ff[1]]
+                callee_path = ff
+        if not pos:
+            # a method call through the owner prefix of a poisoned path
+            # may rebind fields the pass cannot see: kill under the
+            # receiver (the cache.update_pools(...) clean pattern).
+            # len > 2 so bare `self.helper()` does NOT launder self.*
+            # poison — only calls on the owning sub-object do
+            if callee_path is not None and len(callee_path) > 2:
+                owner = callee_path[:-1]
+                for p in [p for p in self.poisoned
+                          if p[:len(owner)] == owner and p != owner]:
+                    self.poisoned.pop(p, None)
+            return []
+        site = (f"`{_fmt_path(callee_path) if callee_path else '<call>'}"
+                f"(...)` at line {call.lineno}")
+        donated_paths = []
+        pending = []
+        for i in pos:
+            j = i + shift
+            if j >= len(args):
+                # `f(*args)`: a donated position folded into a starred
+                # tuple poisons the tuple name itself — reading any
+                # element after the call is the same bug
+                if args and isinstance(args[-1], ast.Starred):
+                    sp = _path_of(args[-1].value)
+                    if sp is not None and (sp, site) not in pending:
+                        donated_paths.append((len(args) - 1, sp))
+                        pending.append((sp, site))
+                continue
+            a = args[j]
+            if isinstance(a, ast.Starred):
+                a = a.value
+            ap = _path_of(a)
+            if ap is None:
+                continue
+            donated_paths.append((j, ap))
+            pending.append((ap, site))
+        # HB20(a): same object in two positions, at least one donated
+        all_paths = {}
+        for j, a in enumerate(args):
+            ap = _path_of(a)
+            if ap is not None:
+                all_paths.setdefault(ap, []).append(j)
+        for j, ap in donated_paths:
+            if len(all_paths.get(ap, ())) > 1:
+                self._violation(
+                    "HB20", call,
+                    f"`{_fmt_path(ap)}` is passed twice into donated "
+                    f"call {site} — XLA donates the buffer once, the "
+                    f"second reference dangles the moment the donor "
+                    f"memory is reused")
+        # HB20(b): donated arg has a live alias (self.* store / closure)
+        for j, ap in donated_paths:
+            if len(ap) == 1 and ap[0] in self.aliases:
+                where = "; ".join(self.aliases[ap[0]])
+                self._violation(
+                    "HB20", call,
+                    f"`{_fmt_path(ap)}` is donated by {site} but an "
+                    f"alias outlives the call ({where}) — the aliased "
+                    f"reference dangles after donation")
+        return pending
+
+    def _note_closure(self, fndef):
+        """A nested def/lambda capturing a local by name: every
+        captured name gains a closure alias (HB20(b))."""
+        bound = set()
+        if hasattr(fndef, "args"):
+            a = fndef.args
+            bound = {x.arg for x in
+                     a.posonlyargs + a.args + a.kwonlyargs}
+            if a.vararg:
+                bound.add(a.vararg.arg)
+            if a.kwarg:
+                bound.add(a.kwarg.arg)
+        body = fndef.body if isinstance(fndef.body, list) else [fndef.body]
+        for node in body:
+            for n in ast.walk(node):
+                if isinstance(n, ast.Name) and \
+                        isinstance(n.ctx, ast.Load) and \
+                        n.id not in bound:
+                    name = getattr(fndef, "name", "<lambda>")
+                    self.aliases.setdefault(n.id, []).append(
+                        f"captured by closure `{name}` at line "
+                        f"{fndef.lineno}")
+
+    def _check_expr(self, expr, reading="read", skip_calls=False):
+        """Flag loads of poisoned paths inside ``expr`` (HB18)."""
+        if expr is None:
+            return
+        for node in ast.walk(expr):
+            if skip_calls and isinstance(node, ast.Call):
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            path = None
+            if isinstance(node, ast.Attribute) and \
+                    isinstance(node.ctx, ast.Load):
+                path = _path_of(node)
+            elif isinstance(node, ast.Name) and \
+                    isinstance(node.ctx, ast.Load):
+                path = (node.id,)
+            if path is None:
+                continue
+            # a load of a poisoned path OR of anything under it
+            hit_key, hit = None, None
+            if path in self.poisoned:
+                hit_key, hit = path, self.poisoned[path]
+            else:
+                for p, s in self.poisoned.items():
+                    if path[:len(p)] == p:
+                        hit_key, hit = p, s
+                        break
+            if hit is not None:
+                self._violation(
+                    "HB18", node,
+                    f"`{_fmt_path(path)}` was donated to {hit} and is "
+                    f"{reading} afterwards without rebinding — on TPU "
+                    f"the buffer is gone (CPU XLA ignores donation, so "
+                    f"tier-1 can't see this); rebind it from the "
+                    f"call's result or drop the donation")
+                # one report per poisoning: further reads of the same
+                # path repeat the same bug
+                self.poisoned.pop(hit_key, None)
+
+    def _violation(self, rule, node, message):
+        self.p.collector.add(Violation(
+            rule=rule, path=self.p.path, line=node.lineno,
+            col=getattr(node, "col_offset", 0), message=message,
+            block=self.cls, func=self.fn.name))
+
+
+# ----------------------------------------------------------------------
+# HB19 — mesh-axis consistency
+# ----------------------------------------------------------------------
+
+class _MeshAxisConsistency(ast.NodeVisitor):
+    """Axis names reaching a PartitionSpec / shard_map spec / collective
+    must be canonical (AXIS_DP/AXIS_TP/AXIS_PP, or their literals inside
+    the exempt parallel/mesh.py) AND constructible on the MeshConfig
+    declared in the enclosing function — ``MeshConfig(dp=8)`` followed
+    by an ``AXIS_TP`` collective is flagged before it ever reaches a
+    mesh."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+        # axes declared by a MeshConfig(...) ctor per function scope;
+        # None = no (or ambiguous) declaration -> scope check off
+        self.declared_stack = [None]
+        norm = path.replace("\\", "/")
+        self.exempt_literals = norm.endswith("parallel/mesh.py")
+
+    # -- scope tracking --------------------------------------------------
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        self.declared_stack.append(self._declared_axes(node))
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+            self.declared_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def _declared_axes(self, fn):
+        """The axis set of the single ``MeshConfig(...)``/``from_spec``
+        declaration in ``fn``'s own body, or None when there is none or
+        more than one (ambiguous scopes don't gate)."""
+        decls = []
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not fn:
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = f.attr if isinstance(f, ast.Attribute) else \
+                getattr(f, "id", None)
+            if name != "MeshConfig":
+                continue
+            if not node.keywords or any(kw.arg is None
+                                        for kw in node.keywords):
+                return None          # positional / **kw: can't resolve
+            axes = set()
+            for kw in node.keywords:
+                if kw.arg in _CANONICAL_AXES:
+                    v = kw.value
+                    if isinstance(v, ast.Constant) and v.value == 1:
+                        continue     # size-1 axis: not collective-able
+                    axes.add(kw.arg)
+            decls.append(axes)
+        if len(decls) != 1:
+            return None
+        return decls[0]
+
+    # -- reporting -------------------------------------------------------
+
+    def _add(self, node, message):
+        self.c.add(Violation(
+            rule="HB19", path=self.path, line=node.lineno,
+            col=getattr(node, "col_offset", 0), message=message,
+            block="", func=self.func_stack[-1]))
+
+    # -- axis extraction -------------------------------------------------
+
+    def _axis_nodes(self, callee, call):
+        """(node, axis_token_or_None) pairs for every axis-position
+        argument of ``call``.  axis_token is the resolved axis string
+        for canonical names/constants, None for unknown."""
+        out = []
+        if callee in _SPEC_CALLEES:
+            subs = list(call.args) + [kw.value for kw in call.keywords]
+            for sub in subs:
+                for n in ast.walk(sub):
+                    out.extend(self._classify(n))
+        elif callee in _COLLECTIVE_CALLEES:
+            cand = []
+            if len(call.args) > 1:
+                cand.append(call.args[1])   # psum(x, axis_name) slot
+            cand += [kw.value for kw in call.keywords
+                     if kw.arg == "axis_name"]
+            for sub in cand:
+                targets = sub.elts if isinstance(sub, (ast.Tuple,
+                                                       ast.List)) \
+                    else [sub]
+                for n in targets:
+                    out.extend(self._classify(n))
+        elif callee in _SHARD_MAP_CALLEES:
+            for kw in call.keywords:
+                if kw.arg in ("in_specs", "out_specs", "axis_names"):
+                    for n in ast.walk(kw.value):
+                        out.extend(self._classify(n))
+        return out
+
+    def _classify(self, n):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            return [(n, n.value if n.value in _CANONICAL_AXES else None)]
+        name = None
+        if isinstance(n, ast.Name):
+            name = n.id
+        elif isinstance(n, ast.Attribute):
+            name = n.attr
+        if name is not None and name.startswith("AXIS_"):
+            return [(n, _CONST_TO_AXIS.get(name))]
+        return []
+
+    # -- the check -------------------------------------------------------
+
+    def visit_Call(self, node):
+        f = node.func
+        callee = f.attr if isinstance(f, ast.Attribute) else \
+            getattr(f, "id", None)
+        if callee in _SPEC_CALLEES or callee in _COLLECTIVE_CALLEES or \
+                callee in _SHARD_MAP_CALLEES:
+            declared = self.declared_stack[-1]
+            for n, axis in self._axis_nodes(callee, node):
+                if axis is None:
+                    what = (f'"{n.value}"'
+                            if isinstance(n, ast.Constant)
+                            else f"`{getattr(n, 'attr', None) or getattr(n, 'id', '?')}`")
+                    self._add(n, (
+                        f"axis {what} in `{callee}(...)` is not a "
+                        f"canonical mesh axis "
+                        f"({'/'.join(_CANONICAL_AXES)}): no MeshConfig "
+                        f"can construct it — add it to "
+                        f"parallel/mesh.py (AXIS_* + this catalog) "
+                        f"before sharding over it"))
+                elif isinstance(n, ast.Constant) and \
+                        not self.exempt_literals:
+                    # canonical literal outside mesh.py: HB17 territory
+                    continue
+                elif declared is not None and axis not in declared and \
+                        callee in _COLLECTIVE_CALLEES:
+                    self._add(n, (
+                        f"collective `{callee}(...)` reduces over "
+                        f"'{axis}' but the MeshConfig declared in this "
+                        f"scope has no '{axis}' axis (missing or "
+                        f"size 1) — the axis name will not resolve on "
+                        f"the built mesh"))
+        self.generic_visit(node)
+
+
+# ----------------------------------------------------------------------
+# the pass driver
+# ----------------------------------------------------------------------
+
+class _DataflowPass:
+    def __init__(self, collector, path):
+        self.collector = collector
+        self.path = path
+
+    def run(self, tree):
+        # HB19 is a straight scan
+        _MeshAxisConsistency(self.collector, self.path).visit(tree)
+        # HB18/HB20: every function, with class-level donation context
+        self._walk(tree, class_name=None, class_don=None)
+
+    def _walk(self, node, class_name, class_don):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                cd = _ClassDonations(child)
+                self._walk(child, child.name, cd)
+            elif isinstance(child, (ast.FunctionDef,
+                                    ast.AsyncFunctionDef)):
+                _FunctionDataflow(
+                    self, child, class_name,
+                    class_don.attrs if class_don else {},
+                    class_don.method_returns if class_don else {}).run()
+                # nested defs get their own (closure-free) analysis
+                self._walk(child, class_name, class_don)
+            else:
+                self._walk(child, class_name, class_don)
+
+
+def run_dataflow_pass(collector, tree, path):
+    """Run HB18/HB19/HB20 over one module; violations land in the
+    shared collector (suppressions applied downstream)."""
+    _DataflowPass(collector, path).run(tree)

@@ -1,0 +1,220 @@
+"""Runtime use-after-donate sentinel — the dynamic half of HB18/HB20.
+
+The static dataflow pass (``dataflow.py``) reasons about donation it
+can SEE in one function; this module watches the buffers a live process
+actually donates.  CPU XLA silently ignores ``donate_argnums``, so a
+use-after-donate is invisible to tier-1 — the read returns perfectly
+good data on CPU and crashes (or silently corrupts, if the buffer was
+reused) on the first real TPU round.  With ``MXTPU_DONATION_CHECK=1``
+the dispatch seams that donate — ``DataParallelTrainer._dispatch``
+(params + optimizer state), the serving engine's pool swap
+(``KVCache.update_pools`` after every prefill/decode/chunk/cow
+executable) — call :func:`poison` on the donor buffers AFTER dispatch,
+and the NDArray host-access points (``.asnumpy()``, ``__getitem__``,
+``.shape``) call :func:`touch`: any touch of a poisoned buffer raises
+a typed :class:`UseAfterDonateError` naming the dispatch site — the
+TPU crash, reproduced on CPU, with a source-level culprit.
+
+Findings are recorded in-process (:func:`findings`), emitted as
+``donation.*`` telemetry events, and dumped through the flight recorder
+(``reason="donation:<site>"``) so a chaos run that trips leaves the
+same post-mortem a kill does.  The chaos suites arm the sentinel and
+assert an empty findings list after every scenario
+(:func:`assert_clean`).
+
+Zero overhead when off (the default): the instrumented seams gate on
+the module-level ``_ENABLED`` bool — one attribute read, no wrapper, no
+registry — so ``MXTPU_DONATION_CHECK=0`` is bitwise-inert.  Poisoned
+entries hold a STRONG reference to the donor buffer: on CPU the buffer
+outlives donation anyway, and pinning it prevents ``id()`` reuse from
+mis-attributing a fresh allocation to an old dispatch.  The registry is
+FIFO-capped so a long run cannot grow it unboundedly.
+
+Stdlib-only at import (the ``mx.lint`` contract): telemetry is imported
+lazily and only when a finding fires.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+
+__all__ = ["enabled", "configure", "configure_from_env", "reset",
+           "poison", "touch", "findings", "assert_clean",
+           "UseAfterDonateError", "DonationCheckError"]
+
+
+class UseAfterDonateError(RuntimeError):
+    """A host access touched a buffer that was donated to a compiled
+    call — ``site`` names the dispatch that consumed it."""
+
+    def __init__(self, message, site=""):
+        super().__init__(message)
+        self.site = site
+
+
+class DonationCheckError(AssertionError):
+    """:func:`assert_clean` failed — the run produced findings."""
+
+
+def _env_enabled():
+    return os.environ.get("MXTPU_DONATION_CHECK", "0") not in ("", "0")
+
+
+_ENABLED = _env_enabled()
+
+# internal bookkeeping lock — the sentinel must not race itself when
+# trainer threads and serving pools poison concurrently
+_STATE_LOCK = threading.Lock()
+_MAX_POISONED = 512
+_POISONED = {}     # id(buffer) -> {"site", "obj", "line"}
+_ORDER = []        # FIFO of ids for the cap
+_FINDINGS = []
+
+
+def enabled():
+    """Whether the sentinel is live (``MXTPU_DONATION_CHECK=1``)."""
+    return _ENABLED
+
+
+def configure(enabled=None):
+    """Flip the sentinel (tests / chaos harness)."""
+    global _ENABLED
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    return _ENABLED
+
+
+def configure_from_env():
+    """Re-read ``MXTPU_DONATION_CHECK`` (subprocess harnesses that
+    mutate the env after import)."""
+    return configure(enabled=_env_enabled())
+
+
+def reset():
+    """Drop the poison registry and findings, and re-read the env (the
+    conftest per-test hook, alongside telemetry/racecheck reset)."""
+    global _ENABLED
+    with _STATE_LOCK:
+        _POISONED.clear()
+        del _ORDER[:]
+        del _FINDINGS[:]
+    _ENABLED = _env_enabled()
+
+
+def findings():
+    """All findings so far, oldest first (list of dicts:
+    ``{"kind", "site", "op", "detail", "thread", "stack"}``)."""
+    with _STATE_LOCK:
+        return [dict(f) for f in _FINDINGS]
+
+
+def assert_clean(context=""):
+    """Raise :class:`DonationCheckError` when any finding was recorded
+    — the chaos suites' post-scenario gate."""
+    found = findings()
+    if found:
+        lines = [f"  [{f['kind']}] {f['detail']}" for f in found]
+        raise DonationCheckError(
+            f"donation: {len(found)} finding(s)"
+            + (f" after {context}" if context else "") + ":\n"
+            + "\n".join(lines))
+
+
+def _short_stack(skip=3, limit=6):
+    frames = traceback.extract_stack()[:-skip]
+    return [f"{os.path.basename(f.filename)}:{f.lineno}:{f.name}"
+            for f in frames[-limit:]]
+
+
+def _leaves(value):
+    """Flatten one poison argument into buffer leaves: lists/tuples/
+    dicts one level at a time, NDArray-likes unwrapped to their backing
+    array (``._data``).  ``None`` and python scalars are skipped."""
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if v is None or isinstance(v, (bool, int, float, complex, str)):
+            continue
+        if isinstance(v, (list, tuple)):
+            stack.extend(v)
+            continue
+        if isinstance(v, dict):
+            stack.extend(v.values())
+            continue
+        inner = getattr(v, "_data", None)
+        if inner is not None and not isinstance(
+                inner, (list, tuple, dict)):
+            yield inner
+        yield v
+
+
+def poison(values, site):
+    """Mark every buffer leaf in ``values`` as donated by ``site``.
+    Called by the dispatch seams AFTER a donating call returns — from
+    that point the donor buffers are dead on TPU, so any later host
+    touch is a latent crash.  No-op when the sentinel is off."""
+    if not _ENABLED:
+        return
+    with _STATE_LOCK:
+        for leaf in _leaves(values):
+            key = id(leaf)
+            if key in _POISONED:
+                continue
+            # strong ref on purpose: prevents id() reuse (see module
+            # docstring); FIFO cap bounds the pin
+            _POISONED[key] = {"site": str(site), "obj": leaf}
+            _ORDER.append(key)
+        while len(_ORDER) > _MAX_POISONED:
+            _POISONED.pop(_ORDER.pop(0), None)
+
+
+def touch(buffer, op):
+    """Check a host access (``op`` names it: "asnumpy", "getitem",
+    "shape") against the poison registry; a hit records a finding,
+    emits telemetry + a flight dump, and raises
+    :class:`UseAfterDonateError` naming the dispatch site.  The
+    instrumented access points gate on ``_ENABLED`` before calling, so
+    this body only ever runs with the sentinel armed."""
+    if not _ENABLED:
+        return
+    with _STATE_LOCK:
+        rec = _POISONED.get(id(buffer))
+    if rec is None:
+        return
+    site = rec["site"]
+    detail = (f"use-after-donate: .{op} touched a buffer donated to "
+              f"{site} — on TPU this buffer no longer exists (CPU XLA "
+              f"ignores donation); rebind from the dispatch result "
+              f"instead of holding the donor")
+    finding = {"kind": "use-after-donate", "site": site, "op": op,
+               "detail": detail,
+               "thread": threading.current_thread().name,
+               "stack": _short_stack()}
+    with _STATE_LOCK:
+        _FINDINGS.append(finding)
+    _dump(site, finding)
+    raise UseAfterDonateError(detail, site=site)
+
+
+def _dump(site, rec):
+    """Emit the finding as a telemetry event and dump the flight
+    recorder.  Lazy lookup through ``sys.modules`` — this module must
+    stay stdlib-importable (tools/mxlint.py loads lint/ standalone),
+    and a finding in a process without mxnet_tpu just stays
+    in-process."""
+    try:
+        import sys
+        mx = sys.modules.get("mxnet_tpu")
+        if mx is None:
+            return
+        telemetry = mx.telemetry
+    except (ImportError, AttributeError):
+        return
+    try:
+        telemetry.event("donation.use_after_donate", site=site,
+                        op=rec["op"], thread=rec["thread"])
+        telemetry.inc("donation.findings")
+        telemetry.dump_flight(f"donation:{site}")
+    except Exception:  # noqa: BLE001 — reporting must never take the run down
+        pass

@@ -42,6 +42,69 @@ def render_text(violations):
     return "\n".join(lines)
 
 
+#: SARIF 2.1.0 — the minimal profile GitHub code scanning and most CI
+#: viewers accept: tool.driver with a rule index, one result per
+#: violation with ruleId/ruleIndex/level/message/locations.
+_SARIF_VERSION = "2.1.0"
+_SARIF_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/"
+                 "sarif-spec/master/Schemas/sarif-schema-2.1.0.json")
+
+
+def render_sarif(violations, files_checked=None):
+    """Render violations as a SARIF 2.1.0 log (single run).
+
+    The driver carries the full rule catalog (not just the rules that
+    fired) so viewers can resolve ``ruleIndex`` and show the help text;
+    ``fullDescription`` is the catalog summary from ``rules.py``.
+    """
+    rule_ids = sorted(RULES)
+    rule_index = {rid: i for i, rid in enumerate(rule_ids)}
+    rules = [
+        {
+            "id": rid,
+            "name": RULES[rid].title,
+            "shortDescription": {"text": RULES[rid].title},
+            "fullDescription": {"text": RULES[rid].summary},
+            "defaultConfiguration": {"level": "error"},
+        }
+        for rid in rule_ids
+    ]
+    results = []
+    for v in violations:
+        result = {
+            "ruleId": v.rule,
+            "level": "error",
+            "message": {"text": v.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": v.path},
+                    "region": {"startLine": v.line,
+                               "startColumn": v.col + 1},
+                },
+            }],
+        }
+        if v.rule in rule_index:
+            result["ruleIndex"] = rule_index[v.rule]
+        if v.block:
+            result["locations"][0]["logicalLocations"] = [{
+                "fullyQualifiedName": f"{v.block}.{v.func}",
+                "kind": "function",
+            }]
+        results.append(result)
+    run = {
+        "tool": {"driver": {"name": "mxlint",
+                            "informationUri":
+                                "https://example.invalid/mxnet_tpu",
+                            "rules": rules}},
+        "results": results,
+    }
+    if files_checked is not None:
+        run["properties"] = {"filesChecked": files_checked}
+    return json.dumps({"$schema": _SARIF_SCHEMA,
+                       "version": _SARIF_VERSION,
+                       "runs": [run]}, indent=2)
+
+
 def render_json(violations, files_checked=None):
     by_rule = {}
     for v in violations:

@@ -286,6 +286,55 @@ RULES = {
         "from mxnet_tpu.parallel.mesh import AXIS_DP\n"
         "spec = P(AXIS_DP, None)\n"
         "dp = self.mesh.shape[AXIS_DP]   # one owner for the name"),
+    "HB18": Rule(
+        "HB18", "use-after-donate",
+        "A name passed in a donated position of a jitted/AOT call "
+        "(`donate_argnums`, including executables built in another "
+        "method and dispatch-through helpers) is read, returned, or "
+        "stored afterwards without rebinding. CPU XLA silently ignores "
+        "donation, so tier-1 cannot see this — it is a latent "
+        "deleted-buffer crash that fires on the first real TPU round. "
+        "Rebind the name from the call's result (the clean pattern) or "
+        "drop the donation.",
+        "step = jax.jit(f, donate_argnums=(0,))\n"
+        "new = step(params)\n"
+        "norm = params[0].sum()        # params was donated: gone on TPU",
+        "step = jax.jit(f, donate_argnums=(0,))\n"
+        "params = step(params)         # rebound from the result\n"
+        "norm = params[0].sum()        # reads the NEW buffer"),
+    "HB19": Rule(
+        "HB19", "unknown-mesh-axis",
+        "An axis name reaching `P(...)`, `shard_map(in_specs/"
+        "out_specs)`, or a collective (`psum`/`all_gather`/... "
+        "`axis_name=`) that is not a canonical mesh axis (dp/tp/pp "
+        "via the parallel/mesh.py AXIS_* constants), or a collective "
+        "over an axis the MeshConfig declared in the enclosing scope "
+        "cannot construct (missing or size 1). The call compiles on "
+        "CPU and then fails — or silently reduces over the wrong "
+        "group — when the mesh is built. Add the axis to "
+        "parallel/mesh.py first, and size it >1 on the config that "
+        "reaches this call.",
+        'g = lax.psum(x, "sp")            # no mesh has an "sp" axis\n'
+        "cfg = MeshConfig(dp=8)\n"
+        "y = lax.psum(x, AXIS_TP)         # dp-only mesh: tp won't "
+        "resolve",
+        "from mxnet_tpu.parallel.mesh import AXIS_DP\n"
+        "cfg = MeshConfig(dp=4, tp=2)\n"
+        "y = lax.psum(x, AXIS_DP)         # canonical axis, on this "
+        "mesh"),
+    "HB20": Rule(
+        "HB20", "donation-aliasing",
+        "The same array object passed twice into one donated call, or "
+        "a donated buffer that was first stored into a `self.*` field "
+        "or captured by a closure. XLA donates the buffer once; every "
+        "other reference silently dangles the moment the donor memory "
+        "is reused — corruption, not a crash, and only on TPU.",
+        "self._snapshot = params          # alias created...\n"
+        "new = step(params)               # ...then params donated:\n"
+        "                                 # self._snapshot dangles",
+        "new = step(params)\n"
+        "self._snapshot = new             # alias the RESULT, which\n"
+        "                                 # nobody donates"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

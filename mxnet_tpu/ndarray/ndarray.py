@@ -42,6 +42,10 @@ _LIVE_ARRAYS = weakref.WeakSet()
 from ..base import MXNetError, numeric_types, integer_types
 from ..context import Context, current_context, cpu
 from .. import _tape
+# use-after-donate sentinel (ISSUE 16): stdlib-only import; the host
+# access points below gate on its module bool, so MXTPU_DONATION_CHECK=0
+# costs one attribute read per access and changes nothing else
+from ..lint import donation as _donation
 
 __all__ = ["NDArray", "array", "zeros", "ones", "full", "empty", "arange",
            "concat", "concatenate", "stack", "from_jax", "waitall",
@@ -144,6 +148,8 @@ class NDArray:
     # ------------------------------------------------------------------
     @property
     def shape(self):
+        if _donation._ENABLED:
+            _donation.touch(self._data, "shape")
         return tuple(self._data.shape)
 
     @property
@@ -224,6 +230,8 @@ class NDArray:
     # ------------------------------------------------------------------
     def asnumpy(self):
         """Sync point: reference MXNDArraySyncCopyToCPU → WaitForVar."""
+        if _donation._ENABLED:
+            _donation.touch(self._data, "asnumpy")
         from ..testing import faults as _faults
         _faults.fault_point("ndarray.d2h")
         return _np.asarray(jax.device_get(self._data))
@@ -440,6 +448,8 @@ class NDArray:
     # indexing
     # ------------------------------------------------------------------
     def __getitem__(self, key):
+        if _donation._ENABLED:
+            _donation.touch(self._data, "getitem")
         key = _convert_index(key)
         return _apply1(self, lambda d: d[key], name="getitem")
 

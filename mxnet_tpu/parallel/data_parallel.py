@@ -31,6 +31,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..base import MXNetError
+from ..lint import donation as _donation
 from ..ndarray.ndarray import NDArray
 from ..ndarray import random as _rnd
 from .. import _tape
@@ -785,6 +786,12 @@ class DataParallelTrainer:
         except Exception as e:  # noqa: BLE001 — record, then re-raise
             _telem.on_step_error(self._num_update, e)
             raise
+        if _donation._ENABLED and self._donate:
+            # every step variant donates positions (0, 1) — the param
+            # and optimizer-state buffers are dead past this point; any
+            # later host touch of them is the TPU crash, caught on CPU
+            _donation.poison(args[:2],
+                             site="DataParallelTrainer._dispatch")
         if t0 is not None:
             _telem.observe("train.dispatch_ms",
                            (_telem.clock() - t0) * 1e3)

@@ -606,7 +606,8 @@ class InferenceEngine:
             args = (self.params, self.cache.k_pool, self.cache.v_pool,
                     toks, _np.int32(1), bt, dummy_key)
             last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
-            self.cache.update_pools(kp, vp)
+            self.cache.update_pools(kp, vp,
+                                    site="InferenceEngine.warmup(prefill)")
             bts = self.cache.table_array(
                 ["__warmup__"] + [None] * (self.max_batch - 1), nb)
             args = (self.params, self.cache.k_pool, self.cache.v_pool,
@@ -614,7 +615,8 @@ class InferenceEngine:
                     _np.zeros((self.max_batch,), _np.int32), bts,
                     _np.zeros((self.max_batch,), bool), dummy_key)
             logits, nxt, kp, vp = self._get("decode", nb, args)(*args)
-            self.cache.update_pools(kp, vp)
+            self.cache.update_pools(kp, vp,
+                                    site="InferenceEngine.warmup(decode)")
             self.cache.free("__warmup__")
         if self.prefill_chunk:
             # the packed-chunk family: one graph per context bucket,
@@ -632,7 +634,8 @@ class InferenceEngine:
                         _np.zeros((R, nb), _np.int32),
                         _np.zeros((R,), bool), dummy_key)
                 _l, _t, kp, vp = self._get("chunk", nb, args)(*args)
-                self.cache.update_pools(kp, vp)
+                self.cache.update_pools(kp, vp,
+                                        site="InferenceEngine.warmup(chunk)")
         if self.prefill_chunk or self.prefix_cache is not None:
             if self._sig("cow", 0) not in self._compiled:
                 # the copy-on-write block copy (src=dst=0 copies the
@@ -640,7 +643,8 @@ class InferenceEngine:
                 args = (self.cache.k_pool, self.cache.v_pool,
                         _np.int32(0), _np.int32(0))
                 kp, vp = self._get("cow", 0, args)(*args)
-                self.cache.update_pools(kp, vp)
+                self.cache.update_pools(kp, vp,
+                                        site="InferenceEngine.warmup(cow)")
         self._warmed = True
         return self
 
@@ -671,7 +675,7 @@ class InferenceEngine:
                 padded, _np.int32(t), bt, key)
         t0 = _telem.clock() if _telem.enabled() else None
         last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
-        self.cache.update_pools(kp, vp)
+        self.cache.update_pools(kp, vp, site="InferenceEngine.prefill")
         self.cache.trim(slot, t)
         self.cache.set_len(slot, t)
         self.stats["prefill_calls"] += 1
@@ -771,7 +775,8 @@ class InferenceEngine:
                 toks, starts, valids, bts, active, key)
         t0 = _telem.clock() if _telem.enabled() else None
         last, nxt, kp, vp = self._get("chunk", nbl, args)(*args)
-        self.cache.update_pools(kp, vp)
+        self.cache.update_pools(kp, vp,
+                                site="InferenceEngine.chunk_prefill")
         for slot, chunk, start in entries:
             self.cache.set_len(slot, start + len(chunk))
         self.stats["chunk_prefill_calls"] += 1
@@ -792,7 +797,8 @@ class InferenceEngine:
             args = (self.cache.k_pool, self.cache.v_pool,
                     _np.int32(src), _np.int32(dst))
             kp, vp = self._get("cow", 0, args)(*args)
-            self.cache.update_pools(kp, vp)
+            self.cache.update_pools(kp, vp,
+                                    site="InferenceEngine._apply_cow")
 
     def _publish_cache_gauges(self):
         _telem.set_gauge("serving.kv_block_utilization",
@@ -870,7 +876,7 @@ class InferenceEngine:
                 toks, pos, bts, active, key)
         t0 = _telem.clock() if _telem.enabled() else None
         logits, nxt, kp, vp = self._get("decode", nbl, args)(*args)
-        self.cache.update_pools(kp, vp)
+        self.cache.update_pools(kp, vp, site="InferenceEngine.decode")
         self.stats["decode_calls"] += 1
         if t0 is not None:
             _telem.inc("serving.decode_calls")

@@ -41,6 +41,7 @@ from __future__ import annotations
 import numpy as _np
 
 from ..base import MXNetError
+from ..lint import donation as _donation
 
 __all__ = ["PagedKVCache", "DoubleFreeError"]
 
@@ -306,8 +307,14 @@ class PagedKVCache:
             out[i, :len(t)] = t[:width]
         return out
 
-    def update_pools(self, k_pool, v_pool):
-        """Swap in the pools returned by a compiled (donated) step."""
+    def update_pools(self, k_pool, v_pool, site="InferenceEngine.dispatch"):
+        """Swap in the pools returned by a compiled (donated) step.
+        With the use-after-donate sentinel armed (MXTPU_DONATION_CHECK,
+        ISSUE 16) the OLD pools are poisoned at the swap: the donated
+        executables consumed them, so any host touch of a stale pool
+        reference after this point raises naming ``site``."""
+        if _donation._ENABLED and self.k_pool is not k_pool:
+            _donation.poison((self.k_pool, self.v_pool), site=site)
         self.k_pool = k_pool
         self.v_pool = v_pool
 

@@ -126,6 +126,31 @@ def _racecheck_verdict(rc):
             "ok": not found}
 
 
+def _donation_arm():
+    """Run the scenario under the use-after-donate sentinel (ISSUE 16):
+    every chaos interleaving doubles as a donation-correctness test —
+    the trainer/engine seams poison their donated buffers and any stale
+    host touch fails the scenario the way a TPU run would crash.
+    ``MXTPU_DONATION_CHECK=0`` is the explicit opt-out."""
+    from mxnet_tpu.lint import donation
+    if os.environ.get("MXTPU_DONATION_CHECK", "") == "0":
+        return None
+    donation.reset()                # this scenario's findings only
+    donation.configure(enabled=True)
+    return donation
+
+
+def _donation_verdict(dc):
+    """Post-scenario gate: zero use-after-donate findings, or the
+    scenario fails."""
+    if dc is None:
+        return None
+    found = dc.findings()
+    return {"enabled": True, "findings": len(found),
+            "sites": sorted({f["site"] for f in found}),
+            "ok": not found}
+
+
 def _flight_check(expect_kind=None):
     """Assert the telemetry flight recorder left a parseable dump for
     the kill this scenario just injected (ISSUE 9): the dump must exist,
@@ -223,6 +248,7 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     from mxnet_tpu.testing import faults
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     k_resume = int(resume_steps_per_call)
     if k_resume > 1 and mode != "sharded":
         raise MXNetError(
@@ -320,11 +346,14 @@ def run_scenario(mode, total_steps=6, preempt_at=3, workdir=None,
     fd = result["flight_dump"]
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     result["ok"] = bool(
         result["params_bitwise"] and result["state_bitwise"]
         and result["corrupt_skipped"]["ok"] and preempted
         and writer_died and (fd is None or fd["ok"])
-        and (rcv is None or rcv["ok"]))
+        and (rcv is None or rcv["ok"])
+        and (dcv is None or dcv["ok"]))
     return result
 
 
@@ -433,6 +462,7 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
     import jax
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     devices = jax.devices()
     dpw = 4
     ranks = [0] if kind == "grow" else [0, 1]
@@ -539,7 +569,10 @@ def run_elastic_scenario(kind="shrink", total_steps=6, event_at=3,
         checks.append(events[0]["source"] == "peer")
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     checks.append(rcv is None or rcv["ok"])
+    checks.append(dcv is None or dcv["ok"])
     result["ok"] = bool(all(checks))
     return result
 
@@ -576,6 +609,7 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     from mxnet_tpu.testing import faults
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     clock = faults.FakeClock(5000.0)
     net = _serving_net()
     rng = _np.random.RandomState(12)
@@ -651,11 +685,14 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     fd = result["flight_dump"]
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     result["ok"] = bool(
         result["no_lost_or_dup"] and result["outputs_match_solo"]
         and result["epoch"] >= 1 and result["requeues"] >= 1
         and result["compiles_after_warmup"] == 0 and leaks_ok
-        and (fd is None or fd["ok"]) and (rcv is None or rcv["ok"]))
+        and (fd is None or fd["ok"]) and (rcv is None or rcv["ok"])
+        and (dcv is None or dcv["ok"]))
     return result
 
 
@@ -680,6 +717,7 @@ def run_autoscale_scenario(total_steps=6, notice_at=2, revoke_at=4,
     import jax
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     clock = faults.FakeClock(2000.0)
     devices = jax.devices()
     dpw, ranks = 4, [0, 1]
@@ -866,6 +904,8 @@ def run_autoscale_scenario(total_steps=6, notice_at=2, revoke_at=4,
 
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     fds = [result.get("serving_flight_dump"),
            result.get("training_flight_dump")]
     checks = [
@@ -888,6 +928,7 @@ def run_autoscale_scenario(total_steps=6, notice_at=2, revoke_at=4,
         result["params_bitwise"], result["state_bitwise"],
         all(fd is None or fd["ok"] for fd in fds),
         rcv is None or rcv["ok"],
+        dcv is None or dcv["ok"],
     ]
     result["ok"] = bool(all(checks))
     return result
@@ -914,6 +955,7 @@ def run_watchdog_scenario(total_steps=6, nan_at=3, workdir=None):
     from mxnet_tpu.testing import faults
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     result = {"mode": "watchdog", "nan_at": nan_at,
               "total_steps": total_steps}
     clock = faults.FakeClock(1000.0)
@@ -952,13 +994,16 @@ def run_watchdog_scenario(total_steps=6, nan_at=3, workdir=None):
         wd_mod.reset()           # never leak the FakeClock instance
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     nf, sf = result["nan_flight"], result["stall_flight"]
     result["ok"] = bool(
         result["nan_event"] and result["stall_event"]
         and result["stall_detected"]
         and (nf is None or (nf["ok"] and result["nan_reason_ok"]))
         and (sf is None or (sf["ok"] and result["stall_reason_ok"]))
-        and (rcv is None or rcv["ok"]))
+        and (rcv is None or rcv["ok"])
+        and (dcv is None or dcv["ok"]))
     return result
 
 
@@ -981,6 +1026,7 @@ def run_fleet_scenario(n_workers=4, straggler_rank=2, dead_rank=3,
     from mxnet_tpu.testing import faults
 
     rc = _racecheck_arm()
+    dc = _donation_arm()
     clock = faults.FakeClock(3000.0)
     result = {"kind": "fleet", "workers": n_workers,
               "straggler_rank": straggler_rank, "dead_rank": dead_rank,
@@ -1064,13 +1110,16 @@ def run_fleet_scenario(n_workers=4, straggler_rank=2, dead_rank=3,
 
     result["racecheck"] = _racecheck_verdict(rc)
     rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
     result["ok"] = bool(
         result["straggler_named"] and result["scrape_dead_named"]
         and result["slowest_rank"] == straggler_rank
         and result["dead_error_typed"]
         and result["hist_merge_bitwise"] and result["counters_summed"]
         and (fd is None or (fd["ok"] and reason_ok))
-        and (rcv is None or rcv["ok"]))
+        and (rcv is None or rcv["ok"])
+        and (dcv is None or dcv["ok"]))
     return result
 
 

@@ -69,10 +69,14 @@ def reset_profiler_and_telemetry():
     test's increments.  Racecheck (ISSUE 10) likewise: its lock-order
     graph and findings are process-global, and a chaos test that
     enabled it must not leave the detector armed (reset() re-reads
-    MXTPU_RACECHECK).  Lazy ``sys.modules`` lookup: tests that never
-    import mxnet_tpu must not pay the import."""
+    MXTPU_RACECHECK).  The donation sentinel (ISSUE 16) has the same
+    shape: its poison registry and findings are process-global and
+    reset() re-reads MXTPU_DONATION_CHECK.  Lazy ``sys.modules``
+    lookup: tests that never import mxnet_tpu must not pay the
+    import."""
     for mod in ("mxnet_tpu.profiler", "mxnet_tpu.telemetry",
-                "mxnet_tpu.lint.racecheck"):
+                "mxnet_tpu.lint.racecheck",
+                "mxnet_tpu.lint.donation"):
         m = sys.modules.get(mod)
         if m is not None:
             m.reset()
@@ -107,3 +111,28 @@ def no_leaked_nondaemon_threads():
         "test leaked live non-daemon thread(s): "
         + ", ".join(repr(t.name) for t in leaked)
         + " — close() your iterators/pools or mark the thread daemon")
+
+
+# ----------------------------------------------------------------------
+# tier-1 duration guard (ISSUE 16): anything creeping past the budget
+# without a `slow` marker fails the run via test_zz_duration_guard.py
+# ----------------------------------------------------------------------
+
+#: per-test wall budget (call phase) for NON-slow tests.  The tier-1
+#: suite runs under a hard driver timeout; one unmarked 40 s test eats
+#: the headroom of twenty 2 s tests.  Tests legitimately past this go
+#: behind `@pytest.mark.slow` (still tier-1, but visibly budgeted).
+DURATION_BUDGET_S = 20.0
+
+#: (nodeid, seconds) for every non-slow test whose call phase crossed
+#: the budget this session; read by tests/test_zz_duration_guard.py,
+#: which sorts last alphabetically so the sweep has already run.
+DURATION_OFFENDERS = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call" or report.duration <= DURATION_BUDGET_S:
+        return
+    if "slow" in getattr(report, "keywords", {}):
+        return
+    DURATION_OFFENDERS.append((report.nodeid, round(report.duration, 2)))

@@ -1,0 +1,30 @@
+# HB18 near-misses — every function here is CLEAN:
+#   rebinding from the dispatch result, explicit donation opt-out,
+#   non-donated positions read freely, and the loop that rebinds its
+#   carry each iteration (the healthy trainer shape).
+import jax
+
+
+def rebinds(params, opt_state, batch):
+    step = jax.jit(lambda p, s, b: (p, s), donate_argnums=(0, 1))
+    params, opt_state = step(params, opt_state, batch)
+    return params  # fresh binding from the result: fine
+
+
+def opted_out(params, batch):
+    step = jax.jit(lambda p, b: p, donate_argnums=())
+    out = step(params, batch)
+    return params  # nothing was donated
+
+
+def non_donated_position(params, batch):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    out = step(params, batch)
+    return batch  # position 1 is not donated
+
+
+def carry_loop(params, batches):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    for b in batches:
+        params = step(params, b)  # rebound every iteration
+    return params

@@ -1,0 +1,32 @@
+# HB18 fixture — use-after-donate, three planted bugs (line order):
+#   1. read of a name after it was donated to a locally-jitted call
+#   2. dispatch-through: helper(jitted, params, ...) donates position 0
+#      of the *inner* callable; the stale name is returned
+#   3. loop wraparound: donation in iteration N poisons the read at the
+#      top of iteration N+1 even though the read precedes it textually
+import jax
+
+
+def plain_step(params, opt_state, batch):
+    step = jax.jit(lambda p, s, b: (p, s), donate_argnums=(0, 1))
+    new_p, new_s = step(params, opt_state, batch)
+    return params  # BUG: donated at the call above; use new_p
+
+
+def _dispatch(fn, *args):
+    return fn(*args)
+
+
+def dispatched_step(params, batch):
+    jitted = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    out = _dispatch(jitted, params, batch)
+    stale = params  # BUG: donated through the dispatch helper
+    return out, stale
+
+
+def wraparound(params, batches):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    for b in batches:
+        norm = params.sum()  # BUG on iteration 2: donated last round
+        step(params, b)
+    return norm

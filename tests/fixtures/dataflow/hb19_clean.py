@@ -1,0 +1,45 @@
+# HB19 near-misses — every function here is CLEAN:
+#   canonical AXIS_* constants inside the declared mesh, spec usage
+#   (no scope gating on P(...)), scopes with no/ambiguous MeshConfig
+#   declarations, and size-1 axes deliberately excluded from the
+#   declaration set only when a collective never touches them.
+import jax
+from jax.sharding import PartitionSpec as P
+from jax import lax
+from jax.experimental.shard_map import shard_map
+
+from mxnet_tpu.parallel.mesh import AXIS_DP, AXIS_PP, AXIS_TP, MeshConfig
+
+
+def declared_and_used(x):
+    cfg = MeshConfig(dp=8, tp=2)
+    y = lax.psum(x, AXIS_DP)
+    return lax.pmean(y, axis_name=AXIS_TP)
+
+
+def spec_only_no_gating(x):
+    # P(...) placements are legal for axes the mesh merely *has*; only
+    # collectives are gated against the declared scope
+    cfg = MeshConfig(dp=8)
+    return P(AXIS_TP, None)
+
+
+def no_declaration_scope(x):
+    # nothing declared here: scope gating is off, canonical is enough
+    return lax.psum(x, AXIS_PP)
+
+
+def ambiguous_declarations(x, big):
+    # two MeshConfigs in one scope: ambiguous, gating is off
+    a = MeshConfig(dp=8)
+    b = MeshConfig(dp=4, tp=2)
+    cfg = b if big else a
+    return lax.psum(x, AXIS_TP)
+
+
+def shard_mapped(fn, mesh, x):
+    cfg = MeshConfig(dp=8, tp=2)
+    mapped = shard_map(fn, mesh=mesh,
+                       in_specs=P(AXIS_DP, AXIS_TP),
+                       out_specs=P(AXIS_DP))
+    return mapped(x)

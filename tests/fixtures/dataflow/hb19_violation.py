@@ -1,0 +1,27 @@
+# HB19 fixture — mesh-axis consistency, three planted bugs (line order):
+#   1. non-canonical string axis in a PartitionSpec
+#   2. unknown AXIS_* constant in a collective (no MeshConfig can
+#      construct it)
+#   3. canonical axis used by a collective OUTSIDE the axes the
+#      enclosing scope's MeshConfig declares
+import jax
+from jax.sharding import PartitionSpec as P
+from jax import lax
+
+from mxnet_tpu.parallel.mesh import AXIS_DP, AXIS_TP, MeshConfig
+
+AXIS_SP = "sp"  # a local invention — NOT in the canonical catalog
+
+
+def bad_spec_string(x):
+    return P("sp", None)  # BUG: "sp" is not a canonical axis
+
+
+def bad_collective_const(x):
+    return lax.psum(x, AXIS_SP)  # BUG: AXIS_SP is not canonical
+
+
+def collective_off_mesh(x):
+    cfg = MeshConfig(dp=8)
+    y = lax.psum(x, AXIS_DP)  # fine: dp is declared
+    return lax.pmean(y, axis_name=AXIS_TP)  # BUG: no tp axis on cfg

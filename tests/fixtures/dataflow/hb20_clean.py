@@ -1,0 +1,31 @@
+# HB20 near-misses — every function here is CLEAN:
+#   distinct arrays in distinct donated positions, duplicates into a
+#   NON-donating call, aliases of non-donated arguments, and a closure
+#   over the REBOUND result rather than the donor.
+import jax
+
+
+def distinct_args(params, opt_state, batch):
+    step = jax.jit(lambda p, s, b: (p, s), donate_argnums=(0, 1))
+    params, opt_state = step(params, opt_state, batch)
+    return params
+
+
+def duplicate_into_plain_call(params, batch):
+    plain = jax.jit(lambda p, q, b: p)  # no donation: aliasing is fine
+    return plain(params, params, batch)
+
+
+def alias_of_non_donated(params, batch):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    keep = lambda: batch.sum()  # noqa: E731 — batch is not donated
+    params = step(params, batch)
+    return params, keep
+
+
+class Holder:
+    def stash_result(self, params, batch):
+        step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+        params = step(params, batch)
+        self._snapshot = params  # alias of the FRESH buffer: fine
+        return params

@@ -1,0 +1,26 @@
+# HB20 fixture — donation aliasing, three planted bugs (line order):
+#   1. the same array passed twice into one donated call (XLA donates
+#      the buffer once; the second reference dangles)
+#   2. donated arg previously stored into a self-field that outlives
+#      the call
+#   3. donated arg captured by a closure defined before the call
+import jax
+
+
+def duplicate_positions(params, batch):
+    step = jax.jit(lambda p, q, b: p, donate_argnums=(0,))
+    return step(params, params, batch)  # BUG: params donated AND read
+
+
+class Holder:
+    def stash_then_donate(self, params, batch):
+        step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+        self._snapshot = params  # alias outlives the donating call
+        return step(params, batch)  # BUG: self._snapshot dangles
+
+
+def closure_capture(params, batch):
+    step = jax.jit(lambda p, b: p, donate_argnums=(0,))
+    metrics = lambda: params.sum()  # noqa: E731 — captures params
+    out = step(params, batch)  # BUG: metrics() reads a dead buffer
+    return out, metrics

@@ -11,6 +11,8 @@ SURVEY.md §6.)
 import json
 import os
 
+import pytest
+
 import bench
 
 
@@ -723,3 +725,69 @@ def test_mesh_spec_surfaces_in_headline():
     line = bench._compact_line(payload)
     obj = _assert_headline(line)
     assert obj.get("mesh") == "dp64tp4"
+
+
+# ----------------------------------------------------------------------
+# the `lint` block schema (ISSUE 16): the full HB01-HB20 sweep runs
+# inside the bench and ships a zero-findings verdict with the line
+# ----------------------------------------------------------------------
+
+_LINT_KEYS = {
+    "lint_schema_version", "rules_enabled", "files_checked",
+    "suppressions", "findings", "ok",
+}
+
+
+@pytest.mark.slow
+def test_bench_lint_block_schema_and_zero_findings_gate():
+    """The block's schema is stable, the sweep really runs (file and
+    rule counts are live), and findings==0 — the measured tree is
+    donation-clean.  A finding would flip `ok` and surface in the next
+    bench diff."""
+    blk = bench._bench_lint()
+    assert set(blk) == _LINT_KEYS, set(blk) ^ _LINT_KEYS
+    assert blk["lint_schema_version"] == bench.LINT_SCHEMA_VERSION
+    assert blk["rules_enabled"] >= 20          # HB01..HB20 shipped
+    assert blk["files_checked"] > 50
+    assert blk["suppressions"] >= 1            # justified opt-outs exist
+    assert blk["findings"] == 0
+    assert blk["ok"] is True
+    assert "by_rule" not in blk                # only present on findings
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_bench_lint_block_rides_the_headline_budget():
+    """lint counters are scalars one level deep: the generic headline
+    sweep may surface them, and the line stays under the cap."""
+    p = _success_payload()
+    p["extra"]["lint"] = {
+        "lint_schema_version": 1, "rules_enabled": 20,
+        "files_checked": 180, "suppressions": 7, "findings": 0,
+        "ok": True,
+    }
+    _assert_headline(bench._compact_line(p))
+
+
+def test_bench_diff_gates_lint_schema_drift(tmp_path, capsys):
+    """tools/bench_diff.py refuses (exit 2) to compare payloads whose
+    lint blocks carry different lint_schema_versions — same discipline
+    as the telemetry and fleet schema gates."""
+    from tools import bench_diff
+    base = {"metric": "m", "value": 1.0, "platform": "cpu",
+            "telemetry_schema_version": 1,
+            "extra": {"lint": {"lint_schema_version": 1,
+                               "rules_enabled": 20, "files_checked": 180,
+                               "suppressions": 7, "findings": 0,
+                               "ok": True}}}
+    drift = json.loads(json.dumps(base))
+    drift["extra"]["lint"]["lint_schema_version"] += 1
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(drift))
+    rc = bench_diff.main([str(a), str(b), "--quiet"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "lint_schema_drift" in out
+    # same lint schema compares fine
+    b.write_text(json.dumps(base))
+    assert bench_diff.main([str(a), str(b), "--quiet"]) == 0

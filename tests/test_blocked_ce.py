@@ -80,6 +80,7 @@ def test_blocked_ce_ndarray_contrib_and_autograd():
                                rtol=1e-5, atol=1e-6)
 
 
+@pytest.mark.slow
 def test_llama_fused_ce_loss_matches_logits_path():
     from mxnet_tpu.gluon.model_zoo.nlp.llama import llama_tiny
     from mxnet_tpu.gluon.loss import SoftmaxCrossEntropyLoss
@@ -199,6 +200,7 @@ def test_blocked_ce_backward_never_materializes_logits():
     assert not bad, f"full logits in backward: {bad}"
 
 
+@pytest.mark.slow
 def test_llama_fused_ce_loss_tied_embeddings():
     """Tied head: the embedding weight takes grads from BOTH the lookup
     and the fused CE head; training must still descend."""

@@ -1,0 +1,253 @@
+"""mx.lint.donation: the runtime use-after-donate sentinel (ISSUE 16).
+
+CPU XLA ignores ``donate_argnums``, so a use-after-donate runs clean on
+every CPU tier-1 pass and corrupts (or crashes) on the first TPU round.
+The sentinel reproduces the TPU failure on CPU: the donating dispatch
+seams poison their donor buffers, and any later NDArray host touch of
+one raises a typed :class:`UseAfterDonateError` naming the dispatch
+site.  These tests plant that bug in a real scripted trainer step and
+assert the catch — plus the zero-overhead/off-by-default contract the
+production paths rely on.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.lint import donation
+from mxnet_tpu.ndarray.ndarray import NDArray
+from mxnet_tpu.parallel import make_mesh, mesh_scope
+
+nd = mx.nd
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+@pytest.fixture
+def armed():
+    """Sentinel on for the test; conftest's autouse reset (which
+    re-reads MXTPU_DONATION_CHECK) restores the ambient state."""
+    donation.reset()
+    donation.configure(enabled=True)
+    yield donation
+    donation.reset()
+
+
+def _make_trainer():
+    from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    net(nd.zeros((2, 8)))
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    mesh = make_mesh({"dp": 8})
+    return net, mesh, DataParallelTrainer(
+        net, loss_fn, "sgd", {"learning_rate": 0.1}, mesh=mesh)
+
+
+def _batch():
+    rs = np.random.RandomState(7)
+    x = nd.array(rs.randn(8, 8).astype(np.float32))
+    y = nd.array(rs.randint(0, 4, (8,)))
+    return x, y
+
+
+# ----------------------------------------------------------------------
+# off-by-default / zero-overhead contract
+# ----------------------------------------------------------------------
+
+def test_disabled_by_default_and_inert():
+    """With MXTPU_DONATION_CHECK unset the sentinel registers nothing:
+    poison() and touch() return immediately and the registry stays
+    empty — the instrumented seams are a single bool read."""
+    donation.reset()
+    if os.environ.get("MXTPU_DONATION_CHECK", "0") in ("", "0"):
+        assert not donation.enabled()
+    donation.configure(enabled=False)
+    buf = np.arange(4.0)
+    donation.poison((buf,), site="nowhere")
+    assert donation._POISONED == {}
+    donation.touch(buf, "asnumpy")       # no registry, no raise
+    assert donation.findings() == []
+    donation.assert_clean("inert")       # vacuously clean
+
+
+@needs8
+def test_sentinel_off_vs_on_is_bitwise_inert(armed):
+    """Arming the sentinel must not change training numerics: two
+    fresh trainers, one stepped with the check off and one with it on,
+    land on bitwise-identical parameters."""
+    x, y = _batch()
+    results = {}
+    for mode in (False, True):
+        donation.reset()
+        donation.configure(enabled=mode)
+        net, mesh, dpt = _make_trainer()
+        for p in net.collect_params().values():
+            p.set_data(nd.array(np.random.RandomState(1)
+                                .randn(*p.shape).astype(np.float32)))
+        with mesh_scope(mesh):
+            dpt.step(x, y)
+            dpt.step(x, y)
+        results[mode] = [p.data().asnumpy().copy()
+                         for _, p in sorted(net.collect_params().items())]
+        assert donation.findings() == []   # healthy path: clean
+    for off, on in zip(results[False], results[True]):
+        np.testing.assert_array_equal(off, on)
+
+
+# ----------------------------------------------------------------------
+# the planted bug: stale buffer across a donating trainer step
+# ----------------------------------------------------------------------
+
+@needs8
+def test_planted_use_after_donate_caught_in_trainer_step(armed):
+    """The TPU crash, reproduced on CPU: hold a raw param buffer across
+    a donating step (the classic 'metrics snapshot' bug), touch it, and
+    the sentinel raises naming the dispatch seam."""
+    x, y = _batch()
+    net, mesh, dpt = _make_trainer()
+    with mesh_scope(mesh):
+        dpt.step(x, y)   # materialize device params (written back
+                         # aliased into the gluon params)
+        p = next(iter(net.collect_params().values()))
+        stale = NDArray(p.data()._data)   # snapshot of the live buffer
+        dpt.step(x, y)   # donates it
+        with pytest.raises(donation.UseAfterDonateError) as ei:
+            stale.asnumpy()
+        assert ei.value.site == "DataParallelTrainer._dispatch"
+        assert "DataParallelTrainer._dispatch" in str(ei.value)
+        (finding,) = donation.findings()
+        assert finding["kind"] == "use-after-donate"
+        assert finding["op"] == "asnumpy"
+        # getitem and shape are guarded the same way
+        with pytest.raises(donation.UseAfterDonateError):
+            stale[0]
+        with pytest.raises(donation.UseAfterDonateError):
+            stale.shape
+
+
+@needs8
+def test_healthy_param_reads_stay_clean_after_steps(armed):
+    """The clean pattern — reading params THROUGH the gluon handle,
+    which the trainer rebinds from the dispatch result every step —
+    must never trip the sentinel."""
+    x, y = _batch()
+    net, mesh, dpt = _make_trainer()
+    with mesh_scope(mesh):
+        for _ in range(3):
+            dpt.step(x, y)
+            for p in net.collect_params().values():
+                p.data().asnumpy()
+                p.data().shape
+    assert donation.findings() == []
+    donation.assert_clean("healthy steps")
+
+
+# ----------------------------------------------------------------------
+# serving seam: pool swap poisons the donated pools
+# ----------------------------------------------------------------------
+
+def test_kv_cache_pool_swap_poisons_old_pools(armed):
+    import jax.numpy as jnp
+    from mxnet_tpu.serving.kv_cache import PagedKVCache
+    cache = PagedKVCache(num_layers=1, num_kv_heads=1, head_dim=4,
+                         num_blocks=4, block_size=2)
+    old_k, old_v = cache.k_pool, cache.v_pool
+    # swap in fresh pools — what every compiled (donated) serving step
+    # returns; the OLD pools are the donated-away buffers
+    cache.update_pools(jnp.zeros_like(old_k), jnp.zeros_like(old_v),
+                       site="InferenceEngine.decode")
+    rec = donation._POISONED.get(id(old_k))
+    assert rec is not None and rec["site"] == "InferenceEngine.decode"
+    assert id(old_v) in donation._POISONED
+    # idempotent: swapping the same object back in does not poison it
+    cur_k, cur_v = cache.k_pool, cache.v_pool
+    cache.update_pools(cur_k, cur_v)
+    assert id(cur_k) not in donation._POISONED
+
+
+# ----------------------------------------------------------------------
+# telemetry + flight recorder
+# ----------------------------------------------------------------------
+
+def test_finding_dumps_through_flight_recorder(armed, tmp_path,
+                                               monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    buf = np.arange(8.0)
+    donation.poison((buf,), site="UnitTest.dispatch")
+    with pytest.raises(donation.UseAfterDonateError):
+        donation.touch(buf, "asnumpy")
+    path = mx.telemetry.last_flight_dump()
+    assert path and os.path.exists(path)
+    with open(path) as f:
+        dump = json.load(f)
+    assert dump["reason"] == "donation:UnitTest.dispatch"
+    kinds = [e["kind"] for e in dump["events"]]
+    assert "donation.use_after_donate" in kinds
+    assert mx.telemetry.value("donation.findings") == 1
+
+
+def test_assert_clean_raises_with_context(armed):
+    donation.assert_clean("nothing yet")
+    buf = np.arange(4.0)
+    donation.poison((buf,), site="s")
+    with pytest.raises(donation.UseAfterDonateError):
+        donation.touch(buf, "getitem")
+    with pytest.raises(donation.DonationCheckError, match="after drain"):
+        donation.assert_clean("drain")
+
+
+# ----------------------------------------------------------------------
+# registry mechanics
+# ----------------------------------------------------------------------
+
+def test_leaves_flattening_and_fifo_cap(armed):
+    nested = {"a": [np.zeros(1), (np.ones(1),)], "b": None, "c": 3}
+    leaves = list(donation._leaves(nested))
+    assert sum(isinstance(x, np.ndarray) for x in leaves) == 2
+    # NDArray unwraps to its backing buffer
+    arr = nd.zeros((2,))
+    assert any(x is arr._data for x in donation._leaves([arr]))
+    # FIFO cap: the registry never exceeds _MAX_POISONED entries and
+    # evicts oldest-first
+    donation.reset()
+    donation.configure(enabled=True)
+    first = np.zeros(1)
+    donation.poison((first,), site="old")
+    bufs = [np.zeros(1) for _ in range(donation._MAX_POISONED)]
+    donation.poison(bufs, site="new")
+    assert len(donation._POISONED) == donation._MAX_POISONED
+    assert id(first) not in donation._POISONED
+    donation.touch(first, "asnumpy")     # evicted: no raise
+
+
+def test_reset_clears_state_and_rereads_env(armed):
+    buf = np.arange(2.0)
+    donation.poison((buf,), site="s")
+    with pytest.raises(donation.UseAfterDonateError):
+        donation.touch(buf, "shape")
+    assert donation.findings()
+    donation.reset()
+    assert donation.findings() == []
+    assert donation._POISONED == {}
+    assert donation.enabled() == \
+        (os.environ.get("MXTPU_DONATION_CHECK", "0") not in ("", "0"))
+
+
+# ----------------------------------------------------------------------
+# chaos gate
+# ----------------------------------------------------------------------
+
+def test_chaos_scenario_runs_under_donation_check(tmp_path):
+    """The chaos suites arm the sentinel and fold its zero-findings
+    verdict into every scenario (ISSUE 16 tentpole)."""
+    from mxnet_tpu.testing.chaos import run_scenario
+    r = run_scenario("plain", workdir=str(tmp_path))
+    assert r["donation"] is not None
+    assert r["donation"]["findings"] == 0

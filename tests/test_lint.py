@@ -1366,3 +1366,217 @@ def test_hb17_package_is_clean():
     viol, n_files = lint_paths([pkg], rules={"HB17"})
     assert viol == [], [f"{v.path}:{v.line}" for v in viol]
     assert n_files > 50
+
+
+# ---------------------------------------------------------------------------
+# HB18/HB19/HB20 — intraprocedural donation dataflow pass (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+_DFDIR = os.path.join(REPO, "tests", "fixtures", "dataflow")
+
+
+def _lint_df_fixture(name, rules):
+    from mxnet_tpu.lint.analyzer import lint_file
+    return lint_file(os.path.join(_DFDIR, name), rules=rules)
+
+
+def test_hb18_fixture_planted_bugs_caught():
+    """Seeded regression: the stale read after a local jit donation,
+    the dispatch-through helper, and the loop-wraparound read must all
+    keep firing."""
+    out = _lint_df_fixture("hb18_violation.py", rules={"HB18"})
+    assert [v.rule for v in out] == ["HB18"] * 3, \
+        [(v.line, v.message) for v in out]
+    assert {v.func for v in out} == {"plain_step", "dispatched_step",
+                                     "wraparound"}
+
+
+def test_hb18_fixture_clean_near_misses():
+    # rebind-from-result, donate opt-out, non-donated position, carry
+    # loop: all clean
+    out = _lint_df_fixture("hb18_clean.py", rules={"HB18"})
+    assert out == [], [(v.line, v.message) for v in out]
+
+
+def test_hb18_inline_aot_chain_and_rebind():
+    """AOT .lower(...).compile() executables donate like jit; rebinding
+    from the result is the clean pattern."""
+    from mxnet_tpu.lint.analyzer import lint_source
+    out = lint_source(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            ex = jax.jit(lambda p, b: p,
+                         donate_argnums=(0,)).lower(params, batch).compile()
+            out = ex(params, batch)
+            return params
+    """), path="<hb18>", rules={"HB18"})
+    assert _rules(out) == ["HB18"]
+    out = lint_source(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            ex = jax.jit(lambda p, b: p,
+                         donate_argnums=(0,)).lower(params, batch).compile()
+            params = ex(params, batch)
+            return params
+    """), path="<hb18>", rules={"HB18"})
+    assert out == []
+
+
+def test_hb19_fixture_planted_bugs_caught():
+    out = _lint_df_fixture("hb19_violation.py", rules={"HB19"})
+    assert [v.rule for v in out] == ["HB19"] * 3, \
+        [(v.line, v.message) for v in out]
+    # the off-mesh collective names the missing axis
+    assert any("no 'tp' axis" in v.message for v in out)
+
+
+def test_hb19_fixture_clean_near_misses():
+    out = _lint_df_fixture("hb19_clean.py", rules={"HB19"})
+    assert out == [], [(v.line, v.message) for v in out]
+
+
+def test_hb19_inline_unknown_axis_and_scope():
+    from mxnet_tpu.lint.analyzer import lint_source
+    out = lint_source(textwrap.dedent("""
+        from jax import lax
+        def ring(x):
+            return lax.psum(x, "sp")
+    """), path="<hb19>", rules={"HB19"})
+    assert _rules(out) == ["HB19"]
+    # canonical constant, no MeshConfig in scope: clean
+    out = lint_source(textwrap.dedent("""
+        from jax import lax
+        from mxnet_tpu.parallel.mesh import AXIS_DP
+        def ring(x):
+            return lax.psum(x, AXIS_DP)
+    """), path="<hb19>", rules={"HB19"})
+    assert out == []
+
+
+def test_hb20_fixture_planted_bugs_caught():
+    out = _lint_df_fixture("hb20_violation.py", rules={"HB20"})
+    assert [v.rule for v in out] == ["HB20"] * 3, \
+        [(v.line, v.message) for v in out]
+    msgs = " ".join(v.message for v in out)
+    assert "passed twice" in msgs and "alias outlives" in msgs
+
+
+def test_hb20_fixture_clean_near_misses():
+    out = _lint_df_fixture("hb20_clean.py", rules={"HB20"})
+    assert out == [], [(v.line, v.message) for v in out]
+
+
+def test_hb20_inline_duplicate_donated_arg():
+    from mxnet_tpu.lint.analyzer import lint_source
+    out = lint_source(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            f = jax.jit(lambda p, q, b: p, donate_argnums=(0,))
+            return f(params, params, batch)
+    """), path="<hb20>", rules={"HB20"})
+    assert _rules(out) == ["HB20"]
+
+
+def test_hb18_hb19_hb20_suppression_and_catalog():
+    from mxnet_tpu.lint.rules import RULES
+    from mxnet_tpu.lint.analyzer import lint_source
+    for rid in ("HB18", "HB19", "HB20"):
+        assert rid in RULES
+        assert RULES[rid].bad and RULES[rid].good
+    out = lint_source(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            f = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            out = f(params, batch)
+            return params  # mxlint: disable=HB18 -- CPU-only test path
+    """), path="<hb18>", rules={"HB18"})
+    assert out == []
+
+
+def test_hb18_hb19_hb20_package_is_clean():
+    """The donation-dataflow gate over the whole framework: every
+    donated buffer is rebound from its dispatch result, every axis name
+    reaching a spec/collective is canonical and constructible."""
+    from mxnet_tpu.lint.api import lint_paths
+    import mxnet_tpu.lint as lint
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(lint.__file__)))
+    viol, n_files = lint_paths([pkg],
+                               rules={"HB18", "HB19", "HB20"})
+    assert viol == [], [f"{v.path}:{v.line} {v.rule}" for v in viol]
+    assert n_files > 50
+
+
+# ---------------------------------------------------------------------------
+# SARIF output (ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+def test_cli_sarif_schema(tmp_path):
+    """--format=sarif emits a valid minimal SARIF 2.1.0 log: schema
+    pointer, versioned, one run with a rule catalog and one result per
+    violation carrying ruleId/level/message/physicalLocation."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            f = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            out = f(params, batch)
+            return params
+    """))
+    r = _run_cli(str(bad), "--format=sarif")
+    assert r.returncode == 1
+    log = json.loads(r.stdout)
+    assert log["version"] == "2.1.0"
+    assert "sarif-schema-2.1.0" in log["$schema"]
+    assert len(log["runs"]) == 1
+    run = log["runs"][0]
+    driver = run["tool"]["driver"]
+    assert driver["name"] == "mxlint"
+    rule_ids = [rule["id"] for rule in driver["rules"]]
+    assert "HB01" in rule_ids and "HB18" in rule_ids
+    assert all(rule["fullDescription"]["text"] for rule in driver["rules"])
+    (result,) = run["results"]
+    assert result["ruleId"] == "HB18"
+    assert result["level"] == "error"
+    assert result["message"]["text"]
+    assert rule_ids[result["ruleIndex"]] == "HB18"
+    loc = result["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == str(bad)
+    assert loc["region"]["startLine"] >= 1
+    assert loc["region"]["startColumn"] >= 1
+    # clean tree -> zero results, still schema-shaped
+    clean = tmp_path / "clean.py"
+    clean.write_text("x = 1\n")
+    r = _run_cli(str(clean), "--format=sarif")
+    assert r.returncode == 0
+    log = json.loads(r.stdout)
+    assert log["runs"][0]["results"] == []
+
+
+def test_cli_sarif_log_works_as_baseline(tmp_path):
+    """A stored --format=sarif scan doubles as the --baseline
+    grandfather list: same counts, same regression gating."""
+    f = tmp_path / "f.py"
+    f.write_text(textwrap.dedent("""
+        import jax
+        def step(params, batch):
+            fn = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            out = fn(params, batch)
+            return params
+    """))
+    sarif = tmp_path / "scan.sarif"
+    r = _run_cli(str(f), "--format=sarif")
+    assert r.returncode == 1
+    sarif.write_text(r.stdout)
+    # unchanged tree: grandfathered, exit 0
+    r = _run_cli(str(f), "--baseline", str(sarif))
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "grandfathered" in r.stdout
+    # a regression beyond the baselined count still gates
+    f.write_text(f.read_text() + textwrap.dedent("""
+        def step2(params, batch):
+            fn = jax.jit(lambda p, b: p, donate_argnums=(0,))
+            out = fn(params, batch)
+            return params
+    """))
+    r = _run_cli(str(f), "--baseline", str(sarif))
+    assert r.returncode == 1

@@ -145,6 +145,7 @@ def test_module_multi_device_batch_divisibility():
                  label_shapes=[("softmax_label", (4,))])
 
 
+@pytest.mark.slow
 def test_mnist_convergence_floor():
     """BASELINE correctness floor (SURVEY.md §4.5, reference
     tests/python/train/test_mlp.py): MLP on MNIST must reach >0.98

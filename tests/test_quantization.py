@@ -148,6 +148,7 @@ def test_llama_tied_embeddings_keep_fp32_head():
     assert np.isfinite(net(x).asnumpy()).all()
 
 
+@pytest.mark.slow
 def test_llama_entropy_calibration_runs():
     net = _llama(tie=True)
     qnet = quantize_net(net, calib_data=_tok_batches(),

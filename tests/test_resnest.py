@@ -1,6 +1,7 @@
 """ResNeSt (split-attention) zoo tests — GluonCV resnest.py/splat.py parity
 (the reference fork author's model family)."""
 import numpy as np
+import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, nd
@@ -39,6 +40,7 @@ def test_split_attention_hybrid_parity_and_grad():
     assert float(np.abs(x.grad.asnumpy()).sum()) > 0
 
 
+@pytest.mark.slow
 def test_resnest_tiny_end_to_end():
     net = ResNeSt([1, 1, 1, 1], classes=10)
     net.initialize()
@@ -79,6 +81,7 @@ def test_avgpool_hybridized_backward_regression():
         assert float(np.abs(g).sum()) > 0
 
 
+@pytest.mark.slow
 def test_resnext_and_se_resnet():
     """ResNeXt grouped bottleneck + SE gate (gluoncv resnext.py/senet.py)."""
     from mxnet_tpu.gluon.model_zoo.vision.resnext import (ResNeXt, SEBlock,

@@ -329,6 +329,7 @@ def test_full_request_lifecycle_slot_reuse_zero_retraces():
         eng.release(0)
 
 
+@pytest.mark.slow
 def test_continuous_beats_static_on_mixed_lengths():
     """The acceptance gate, on deterministic quantities: same request
     mix, same engine graphs — continuous batching needs FEWER decode

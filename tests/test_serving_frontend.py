@@ -433,6 +433,7 @@ def test_router_threaded_mode_racecheck_clean(net):
         racecheck.reset()
 
 
+@pytest.mark.slow
 def test_serving_chaos_scenario(tmp_path):
     """The tier-1 wiring of ``--chaos serving`` (like the elastic
     scenarios): replica kill mid-traffic, requeue, solo-exact outputs,

@@ -18,7 +18,8 @@ Rules of the diff (the PR 6 honesty discipline applies):
 - ``telemetry_schema_version`` is checked first: payloads from
   different schemas do not compare (exit 2) unless
   ``--allow-schema-drift``; the bench ``fleet`` block's
-  ``fleet_schema_version`` (ISSUE 15) is checked the same way;
+  ``fleet_schema_version`` (ISSUE 15) and the ``lint`` block's
+  ``lint_schema_version`` (ISSUE 16) are checked the same way;
 - direction comes from the metric name (``*_ms``/latency: lower is
   better; throughput/efficiency/MFU: higher is better); metrics with
   unknown direction are reported informationally and never gate;
@@ -50,6 +51,7 @@ _DOWN_SUFFIXES = ("_ms", "p99", "p50", "ttft", "bubble_frac",
 # config/provenance keys: never compared (a changed knob is not a perf
 # regression; the human reads those out of the payload directly)
 _SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
+              "lint_schema_version",
               "batch", "dtype", "data",
               "steps_per_call", "s2d_stem", "n", "rc", "cmd", "tail",
               "time", "cached_at", "dp", "buckets", "epoch",
@@ -176,6 +178,20 @@ def main(argv=None):
             and not args.allow_schema_drift:
         verdict.update(status="fleet_schema_drift", old_schema=fvo,
                        new_schema=fvn)
+        print("BENCHDIFF " + json.dumps(verdict))
+        return 2
+
+    # the lint block (ISSUE 16) is versioned the same way: its counts
+    # (rules_enabled, findings, suppressions) only compare within one
+    # schema
+    lvo = ((old.get("extra") or {}).get("lint")
+           or {}).get("lint_schema_version")
+    lvn = ((new.get("extra") or {}).get("lint")
+           or {}).get("lint_schema_version")
+    if lvo is not None and lvn is not None and lvo != lvn \
+            and not args.allow_schema_drift:
+        verdict.update(status="lint_schema_drift", old_schema=lvo,
+                       new_schema=lvn)
         print("BENCHDIFF " + json.dumps(verdict))
         return 2
 

@@ -550,6 +550,10 @@ def run_chaos(suite: str = "preempt") -> int:
     # lock-order detector (mxnet_tpu.lint.racecheck); a finding fails
     # the scenario exactly like a parity miss
     env.setdefault("MXTPU_RACECHECK", "1")
+    # ISSUE 16: and under the use-after-donate sentinel
+    # (mxnet_tpu.lint.donation) — a stale host touch of a donated
+    # buffer fails the scenario the way the first TPU round would crash
+    env.setdefault("MXTPU_DONATION_CHECK", "1")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
@@ -579,6 +583,15 @@ def run_chaos(suite: str = "preempt") -> int:
         if raced:
             _log(f"chaos smoke: FAILED — racecheck findings in "
                  f"scenario(s) {raced}")
+            return 1
+        # ISSUE 16: zero use-after-donate findings after every scenario
+        donated = [s.get("kind") or s.get("mode")
+                   for s in verdicts[-1].get("chaos", [])
+                   if s.get("donation") is not None
+                   and not s["donation"].get("ok")]
+        if donated:
+            _log(f"chaos smoke: FAILED — use-after-donate findings in "
+                 f"scenario(s) {donated}")
             return 1
         _log("chaos smoke: OK " + json.dumps(verdicts[-1]))
         return 0
