@@ -704,18 +704,26 @@ def _bench_serving() -> dict:
     null-when-unmeasured (the PR 6 honesty rule; the CPU-scale policy
     comparison lives in the tier-1-gated ``serve_loadgen --smoke``).
     On TPU the ~0.5B-class mix measures for real."""
+    import os
     import jax
     from mxnet_tpu.serving import serving_block
+    spec = os.environ.get("MXTPU_SPEC_DECODE", "0") not in ("", "0")
+    paged = os.environ.get("MXTPU_PAGED_ATTN", "0") not in ("", "0")
     if jax.devices()[0].platform == "cpu":
+        # config rides (speculative/paged_attn are routing knobs, real
+        # either way); the measured fields — including the ISSUE 17
+        # spec_accept_rate / tokens_per_dispatch — stay null
         blk = serving_block(max_batch=8, block_size=16,
                             buckets=(16, 32, 64, 128, 256, 512),
-                            continuous=True)
+                            continuous=True, speculative=spec,
+                            paged_attn=paged)
         blk["note"] = ("not measured on CPU; tools/serve_loadgen.py "
                       "--smoke carries the CPU policy comparison")
         return blk
     from tools.serve_loadgen import run_loadgen
     payload = run_loadgen(n_requests=32, max_batch=8, block_size=16,
-                          max_context=512, mode="both", smoke=False)
+                          max_context=512, mode="both", smoke=False,
+                          speculative=spec)
     blk = payload["serving"]
     blk["vs_static"] = payload.get("continuous_vs_static")
     return blk

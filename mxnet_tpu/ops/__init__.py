@@ -8,6 +8,8 @@ from .flash_attention import flash_attention
 from .blocked_cross_entropy import fused_linear_cross_entropy
 from .fused_layernorm import fused_layer_norm
 from .fused_update import fused_bucket_rule
+from .paged_attention import paged_decode_attention
 
 __all__ = ["flash_attention", "fused_linear_cross_entropy",
-           "fused_layer_norm", "fused_bucket_rule"]
+           "fused_layer_norm", "fused_bucket_rule",
+           "paged_decode_attention"]

@@ -1,0 +1,162 @@
+"""Paged decode attention: block-table gather kernel for serving.
+
+The serving decode step attends one query token per sequence against a
+block-table paged KV cache (``serving.kv_cache.PagedKVCache``).  The
+engine's original formulation gathers the sequence's blocks into a
+dense ``(B, L, KVH, D)`` view with a jnp fancy-index and runs the
+shared ``llama._cache_attention`` math — correct, but on TPU the
+gather materializes the full context width per step in HBM traffic.
+
+This module packages that step as one op with two interchangeable
+bodies (the ``ops.flash_attention`` discipline):
+
+- **Pallas path** (TPU only): a ``PrefetchScalarGridSpec`` kernel whose
+  K/V BlockSpec index maps read the BLOCK TABLE itself — grid step
+  ``(b, j)`` DMAs physical block ``table[b, j]`` straight from the pool
+  into VMEM and folds it into a per-sequence online-softmax
+  accumulator.  Only the sequence's own blocks ever move; there is no
+  dense gather.  Blocks wholly past ``pos`` are masked per-position
+  (write-ahead garbage and table padding contribute exactly 0).
+- **XLA fallback** (CPU, or any geometry the kernel declines): the
+  engine's original gather + ``_cache_attention``, op-for-op — so on
+  the fallback path this function is BITWISE the inline formulation it
+  replaces (the parity gate in tests/test_paged_attention.py), and
+  ``MXTPU_PAGED_ATTN`` is a bitwise-inert routing knob on CPU hosts.
+
+The Pallas body compiles only on TPU backends (``_use_pallas`` gate,
+like flash); structure tests assert its shape and skip execution
+elsewhere.  TPU-vs-fallback numerics are gated by the TPU round's
+bench_diff, not claimed here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["paged_decode_attention"]
+
+_NEG_INF = -1e30
+
+
+def _use_pallas(block_size, kv_heads, head_dim):
+    """Pallas only on TPU backends, and only for geometries Mosaic
+    tiles well (lane dim = head_dim multiple of 64, sublane = block
+    rows multiple of 8).  Anything else: the bitwise fallback."""
+    if jax.default_backend() != "tpu":
+        return False
+    return head_dim % 64 == 0 and block_size % 8 == 0
+
+
+def _fallback(q, k_pool, v_pool, block_tables, pos, scale):
+    """The engine's original decode attention, verbatim: dense gather
+    through the block table, then the shared single-block
+    online-softmax (one source with the full forward, so decode parity
+    cannot drift — llama._cache_attention)."""
+    from ..gluon.model_zoo.nlp.llama import _cache_attention
+    B = q.shape[0]
+    nbl = block_tables.shape[1]
+    bs, kvh, d = k_pool.shape[1:]
+    L = nbl * bs
+    ck = k_pool[block_tables].reshape(B, L, kvh, d).transpose(0, 2, 1, 3)
+    cv = v_pool[block_tables].reshape(B, L, kvh, d).transpose(0, 2, 1, 3)
+    valid = jnp.arange(L)[None, :] <= pos[:, None]
+    return _cache_attention(q, ck, cv, valid, scale)
+
+
+def _pallas_paged(q, k_pool, v_pool, block_tables, pos, scale):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, h, d = q.shape
+    bs, kvh, _d = k_pool.shape[1:]
+    nbl = block_tables.shape[1]
+    rep = h // kvh
+
+    def kernel(bt_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+               acc, m_i, l_i):
+        b = pl.program_id(0)
+        j = pl.program_id(1)
+
+        @pl.when(j == 0)
+        def _init():
+            m_i[:] = jnp.full_like(m_i, _NEG_INF)
+            l_i[:] = jnp.zeros_like(l_i)
+            acc[:] = jnp.zeros_like(acc)
+
+        p = pos_ref[b]
+        # a block wholly past the query position contributes nothing —
+        # skip its compute (table padding points at the null block and
+        # lands here too, since padded indices start past pos)
+        @pl.when(j * bs <= p)
+        def _step():
+            qg = q_ref[0].reshape(kvh, rep, d)        # grouped queries
+            kb = k_ref[0]                             # (bs, kvh, d)
+            vb = v_ref[0]
+            s = jnp.einsum("grd,tgd->grt", qg, kb,
+                           preferred_element_type=jnp.float32) * scale
+            kpos = j * bs + lax.broadcasted_iota(
+                jnp.int32, (kvh, rep, bs), 2)
+            s = jnp.where(kpos <= p, s, _NEG_INF)
+            m_new = jnp.maximum(m_i[:], jnp.max(s, axis=-1,
+                                                keepdims=True))
+            pr = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_i[:] - m_new)
+            l_i[:] = l_i[:] * alpha + jnp.sum(pr, axis=-1, keepdims=True)
+            acc[:] = acc[:] * alpha + jnp.einsum(
+                "grt,tgd->grd", pr.astype(vb.dtype), vb,
+                preferred_element_type=jnp.float32)
+            m_i[:] = m_new
+
+        @pl.when(j == nbl - 1)
+        def _fin():
+            out = acc[:] / jnp.maximum(l_i[:], 1e-30)
+            o_ref[0] = out.reshape(h, d).astype(o_ref.dtype)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,          # block tables + positions
+        grid=(B, nbl),
+        in_specs=[
+            pl.BlockSpec((1, h, d), lambda b, j, bt, ps: (b, 0, 0)),
+            # gather-by-block-table: the index map reads the prefetched
+            # table, so grid step (b, j) DMAs physical block bt[b, j]
+            pl.BlockSpec((1, bs, kvh, d),
+                         lambda b, j, bt, ps: (bt[b, j], 0, 0, 0)),
+            pl.BlockSpec((1, bs, kvh, d),
+                         lambda b, j, bt, ps: (bt[b, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, h, d),
+                               lambda b, j, bt, ps: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((kvh, rep, d), jnp.float32),
+            pltpu.VMEM((kvh, rep, 1), jnp.float32),
+            pltpu.VMEM((kvh, rep, 1), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        kernel, grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, h, d), q.dtype),
+    )(block_tables, pos, q, k_pool, v_pool)
+    return out.reshape(B, h * d)
+
+
+def paged_decode_attention(q, k_pool, v_pool, block_tables, pos, scale):
+    """One decode step of attention against a paged KV cache.
+
+    q : (B, H, D) current-position queries, already rotated.
+    k_pool / v_pool : (num_blocks, block_size, KVH, D) — ONE layer's
+        slice of the engine's pool.
+    block_tables : (B, n_blocks_bucket) int32 physical block ids per
+        sequence (null-block padded).
+    pos : (B,) int32 position being written this step; cache positions
+        ``<= pos`` participate, everything later (write-ahead garbage,
+        padding) is masked.
+    scale : softmax scale (1/sqrt(D)).
+
+    Returns (B, H*D).  Traced inside the engine's compiled decode /
+    verify graphs — both bodies are pure jnp/pallas on jax arrays.
+    """
+    bs, kvh, d = k_pool.shape[1:]
+    if _use_pallas(bs, kvh, d):
+        return _pallas_paged(q, k_pool, v_pool, block_tables, pos, scale)
+    return _fallback(q, k_pool, v_pool, block_tables, pos, scale)

@@ -20,6 +20,11 @@ The serving vertical the ROADMAP's "millions of users" north star needs:
   :class:`Router` (least-loaded admission over N replicas, epoch-fenced
   membership, drain-and-requeue on death, one shared warmup compile
   cache).
+- :class:`DraftSource` + the engine's ``verify`` graph family — ISSUE
+  17 speculative decoding: model-free drafts (prefix-cache trie walk /
+  prompt-lookup n-gram) scored K-at-a-time in one dispatch, greedy
+  acceptance bitwise the plain decode stream; ``MXTPU_PAGED_ATTN``
+  routes decode/verify attention through the Pallas paged kernel.
 
 See docs/SERVING.md for the architecture and the bucket/compile-cache
 math; ``tools/serve_loadgen.py`` is the load-generator benchmark.
@@ -29,11 +34,13 @@ from __future__ import annotations
 from .engine import InferenceEngine, next_bucket
 from .kv_cache import PagedKVCache, DoubleFreeError
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
+from .draft import DraftSource
 from .frontend import PrefixCache, Router, AdmissionShed
 
 __all__ = ["InferenceEngine", "PagedKVCache", "DoubleFreeError",
            "ContinuousBatcher", "StaticBatcher", "Request", "next_bucket",
-           "serving_block", "PrefixCache", "Router", "AdmissionShed"]
+           "serving_block", "PrefixCache", "Router", "AdmissionShed",
+           "DraftSource"]
 
 
 def _r(x, nd=3):
@@ -46,7 +53,9 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
                   occupancy=None, tokens_per_step=None,
                   compiles_after_warmup=None, cache_utilization=None,
                   chunked_prefill=False, router_replicas=0,
-                  prefix_hit_rate=None, router_p99_ms=None):
+                  prefix_hit_rate=None, router_p99_ms=None,
+                  speculative=False, paged_attn=False,
+                  spec_accept_rate=None, tokens_per_dispatch=None):
     """The bench.py ``serving`` observability block (the `comm` block
     discipline from PR 3/PR 5): static serving config is always real;
     MEASURED fields default to ``None`` — null-when-unmeasured, so a CPU
@@ -54,7 +63,9 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
     (the PR 6 honesty rule, tests/test_bench_line.py).  ISSUE 12 grows
     the front-end fields: ``chunked_prefill``/``router_replicas`` are
     config (always real), ``prefix_hit_rate``/``router_p99_ms`` are
-    measured (null until a run actually measured them)."""
+    measured (null until a run actually measured them).  ISSUE 17 adds
+    ``speculative``/``paged_attn`` (config) and
+    ``spec_accept_rate``/``tokens_per_dispatch`` (measured)."""
     return {
         "max_batch": int(max_batch),
         "block_size": int(block_size),
@@ -74,4 +85,8 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
         "router_replicas": int(router_replicas),
         "prefix_hit_rate": _r(prefix_hit_rate, 4),
         "router_p99_ms": _r(router_p99_ms),
+        "speculative": bool(speculative),
+        "paged_attn": bool(paged_attn),
+        "spec_accept_rate": _r(spec_accept_rate, 4),
+        "tokens_per_dispatch": _r(tokens_per_dispatch, 3),
     }

@@ -52,6 +52,31 @@ Both are compiled at warmup like the rest; ``compiles_after_warmup``
 still gates zero retraces.  Replicas behind one
 :class:`~.frontend.Router` pass a shared ``compile_cache`` so the
 fleet pays each graph compile once.
+
+ISSUE 17 adds the SPECULATIVE graph family:
+
+- ``verify[(k, n_blocks)]``: ``k`` (power-of-two bucket) decode steps
+  UNROLLED inside one dispatch — step ``w`` feeds the row's ``w``-th
+  token (the last committed token, then the draft continuation) at
+  position ``pos + w``, writes its K/V through the block table, and
+  argmaxes the next token; the functional kp/vp threading makes step
+  ``w``'s writes visible to step ``w+1``.  Each unrolled step is the
+  ``decode`` body op-for-op (same projections, same
+  ``_cache_attention``/paged-attention routing, same scatter), so the
+  greedy token at every ACCEPTED position is bitwise the plain decode
+  path's — the acceptance gate is exact token equality, never a
+  tolerance (the PR 7 decode-parity contract extended through the
+  multi-step seam, device-resident like PR 6's ``step_multi``).
+  Rows with fewer real tokens than the bucket mask their dead steps
+  into the null block; a row with ONE token is exactly a plain decode
+  row, which is how mixed draft/no-draft batches share the dispatch.
+  Speculation is greedy-only (temperature 0) — acceptance compares
+  argmaxes, so sampled decoding keeps the plain path.
+
+``MXTPU_PAGED_ATTN=1`` reroutes the decode/verify cache attention
+through ``ops.paged_attention.paged_decode_attention`` — whose XLA
+fallback is the inline gather + ``_cache_attention`` verbatim (bitwise
+on CPU; the Pallas gather-by-block-table kernel engages on TPU hosts).
 """
 from __future__ import annotations
 
@@ -111,13 +136,26 @@ class InferenceEngine:
     compile_cache : dict shared across replicas of a ``frontend.Router``
         so the fleet pays each graph compile once (signatures carry the
         pool geometry, so mismatched engines never collide).
+    spec_decode : True compiles the speculative ``verify`` graph family
+        at warmup (greedy-only — requires temperature 0); None reads
+        ``MXTPU_SPEC_DECODE`` (default off: no extra warmup compiles,
+        bitwise the PR 7 engine).
+    spec_k : max draft tokens scored per verify dispatch (>= 1); the
+        compiled widths are the power-of-two buckets covering
+        ``spec_k + 1`` fed tokens.  None reads ``MXTPU_SPEC_K``
+        (default 4).
+    paged_attn : True routes decode/verify cache attention through
+        ``ops.paged_attention`` (Pallas gather-by-block-table on TPU;
+        bitwise-identical XLA fallback elsewhere); None reads
+        ``MXTPU_PAGED_ATTN`` (default off = the inline gather).
     """
 
     def __init__(self, net, max_batch=None, block_size=None,
                  num_blocks=None, max_context=None, temperature=0.0,
                  top_k=0, seed=0, quantize=None, calib_data=None,
                  num_calib_batches=10, mesh=None, prefill_chunk=None,
-                 prefix_cache=None, compile_cache=None):
+                 prefix_cache=None, compile_cache=None,
+                 spec_decode=None, spec_k=None, paged_attn=None):
         import jax
         import jax.numpy as jnp
         from ..parallel.mesh import MeshConfig
@@ -202,10 +240,33 @@ class InferenceEngine:
             self.prefix_cache = PrefixCache(self.cache)
         else:
             self.prefix_cache = prefix_cache or None
+        # speculative decoding (ISSUE 17): kill switch default-off so
+        # the cold engine compiles nothing extra and is bitwise PR 7's
+        if spec_decode is None:
+            spec_decode = os.environ.get(
+                "MXTPU_SPEC_DECODE", "0") not in ("", "0")
+        self.spec_decode = bool(spec_decode)
+        self.spec_k = _env_int("MXTPU_SPEC_K", 4) if spec_k is None \
+            else int(spec_k)
+        if self.spec_k < 1:
+            raise MXNetError(f"spec_k {self.spec_k} must be >= 1")
+        if self.spec_decode and self.temperature != 0.0:
+            raise NotSupportedError(
+                "speculative decoding is greedy-only (acceptance "
+                "compares argmaxes bitwise); serve temperature > 0 "
+                "with MXTPU_SPEC_DECODE=0")
+        # paged decode-attention kernel routing (ISSUE 17): default off
+        # keeps the inline gather; on CPU the op's fallback is that
+        # gather verbatim, so the knob is bitwise-inert off-TPU
+        if paged_attn is None:
+            paged_attn = os.environ.get(
+                "MXTPU_PAGED_ATTN", "0") not in ("", "0")
+        self.paged_attn = bool(paged_attn)
         self.stats = {"compiles": 0, "compiles_after_warmup": 0,
                       "prefill_calls": 0, "decode_calls": 0,
                       "chunk_prefill_calls": 0,
-                      "prompt_tokens_computed": 0}
+                      "prompt_tokens_computed": 0,
+                      "verify_calls": 0, "draft_tokens_scored": 0}
 
     # -- weights ---------------------------------------------------------
 
@@ -359,9 +420,18 @@ class InferenceEngine:
 
         return run
 
-    def _build_decode(self, nbl):
-        """One-token decode for the fixed batch against ``nbl`` gathered
-        blocks per sequence (context bucket = nbl * block_size)."""
+    def _decode_body(self, params, kp, vp, toks, pos, bts, blk, nbl):
+        """One decode step's layer stack, shared by the ``decode`` graph
+        and every unrolled ``verify`` step (one source so speculative
+        parity cannot drift): embed ``toks`` (B,), rotate at ``pos``,
+        scatter K/V into ``blk``/offset, attend through the block
+        table, and return (last-norm logits, kp, vp).
+
+        The cache attention routes through
+        ``ops.paged_attention.paged_decode_attention`` when
+        ``paged_attn`` is set (whose XLA fallback is the inline gather
+        below, verbatim) and stays inline otherwise — the kill switch
+        compiles the exact PR 7 graph."""
         import jax
         import jax.numpy as jnp
         from ..gluon.model_zoo.nlp.llama import (_cache_attention, _rms,
@@ -373,39 +443,88 @@ class InferenceEngine:
         B = self.max_batch
         L = nbl * bs
         scale = 1.0 / math.sqrt(d)
-
-        def run(params, kp, vp, toks, pos, bts, active, key):
-            x = jnp.take(params["embed"], toks, axis=0)      # (B, hid)
-            freqs = theta ** (-jnp.arange(0, d, 2) / d)
-            ang = pos[:, None] * freqs[None, :]              # (B, d/2)
-            cos, sin = jnp.cos(ang), jnp.sin(ang)
-            blk = jnp.take_along_axis(
-                bts, (pos // bs)[:, None], axis=1)[:, 0]     # (B,)
-            blk = jnp.where(active, blk, 0)                  # null block
-            off = pos % bs
-            valid = jnp.arange(L)[None, :] <= pos[:, None]   # (B, L)
-            for li, lp in enumerate(params["layers"]):
-                hh = _rms(x, lp["in_norm"], eps)
-                q = self._proj(hh, lp["q"]).reshape(B, h, d)
-                k = self._proj(hh, lp["k"]).reshape(B, kvh, d)
-                v = self._proj(hh, lp["v"]).reshape(B, kvh, d)
-                q = _rot_interleaved(q, cos[:, None, :], sin[:, None, :])
-                k = _rot_interleaved(k, cos[:, None, :], sin[:, None, :])
-                kp = kp.at[li, blk, off].set(k)
-                vp = vp.at[li, blk, off].set(v)
+        x = jnp.take(params["embed"], toks, axis=0)          # (B, hid)
+        freqs = theta ** (-jnp.arange(0, d, 2) / d)
+        ang = pos[:, None] * freqs[None, :]                  # (B, d/2)
+        cos, sin = jnp.cos(ang), jnp.sin(ang)
+        off = pos % bs
+        valid = jnp.arange(L)[None, :] <= pos[:, None]       # (B, L)
+        for li, lp in enumerate(params["layers"]):
+            hh = _rms(x, lp["in_norm"], eps)
+            q = self._proj(hh, lp["q"]).reshape(B, h, d)
+            k = self._proj(hh, lp["k"]).reshape(B, kvh, d)
+            v = self._proj(hh, lp["v"]).reshape(B, kvh, d)
+            q = _rot_interleaved(q, cos[:, None, :], sin[:, None, :])
+            k = _rot_interleaved(k, cos[:, None, :], sin[:, None, :])
+            kp = kp.at[li, blk, off].set(k)
+            vp = vp.at[li, blk, off].set(v)
+            if self.paged_attn:
+                from ..ops.paged_attention import paged_decode_attention
+                o = paged_decode_attention(q, kp[li], vp[li], bts, pos,
+                                           scale)
+            else:
                 ck = kp[li][bts].reshape(B, L, kvh, d) \
                     .transpose(0, 2, 1, 3)                   # (B,kvh,L,d)
                 cv = vp[li][bts].reshape(B, L, kvh, d) \
                     .transpose(0, 2, 1, 3)
                 o = _cache_attention(q, ck, cv, valid, scale)
-                x = x + self._proj(o, lp["o"])
-                y = _rms(x, lp["post_norm"], eps)
-                x = x + self._proj(
-                    jax.nn.silu(self._proj(y, lp["gate"])) *
-                    self._proj(y, lp["up"]), lp["down"])
-            logits = self._head_logits(params, _rms(x, params["norm"],
-                                                    eps))    # (B, V)
+            x = x + self._proj(o, lp["o"])
+            y = _rms(x, lp["post_norm"], eps)
+            x = x + self._proj(
+                jax.nn.silu(self._proj(y, lp["gate"])) *
+                self._proj(y, lp["up"]), lp["down"])
+        logits = self._head_logits(params, _rms(x, params["norm"], eps))
+        return logits, kp, vp
+
+    def _build_decode(self, nbl):
+        """One-token decode for the fixed batch against ``nbl`` gathered
+        blocks per sequence (context bucket = nbl * block_size)."""
+        import jax.numpy as jnp
+        bs = self.block_size
+
+        def run(params, kp, vp, toks, pos, bts, active, key):
+            blk = jnp.take_along_axis(
+                bts, (pos // bs)[:, None], axis=1)[:, 0]     # (B,)
+            blk = jnp.where(active, blk, 0)                  # null block
+            logits, kp, vp = self._decode_body(params, kp, vp, toks,
+                                               pos, bts, blk, nbl)
             return logits, self._sample(logits, key), kp, vp
+
+        return run
+
+    def _build_verify(self, size):
+        """Speculative verify graph: ``W`` decode steps unrolled in ONE
+        dispatch (size = (W, nbl)).  Row i feeds its last committed
+        token then its draft continuation at positions
+        ``pos[i] .. pos[i] + counts[i] - 1``; step ``w`` scatters that
+        token's K/V (visible to step ``w+1`` through the functional
+        kp/vp threading) and argmaxes the next token.  Steps past a
+        row's count write to the null block and their outputs are
+        host-masked — a count-1 row is bitwise a plain decode row.
+
+        Greedy-only by construction: acceptance is exact token
+        equality against these argmaxes, so every accepted position's
+        computation is identical to the plain decode path's and the
+        committed stream is bitwise the non-speculative stream (the
+        ISSUE 17 acceptance contract)."""
+        import jax.numpy as jnp
+        W, nbl = size
+        bs = self.block_size
+
+        def run(params, kp, vp, toks, pos, bts, counts, active, key):
+            outs = []
+            for w in range(W):
+                live = active & (w < counts)                 # (B,)
+                pw = pos + w
+                blk = jnp.take_along_axis(
+                    bts, jnp.clip(pw // bs, 0, nbl - 1)[:, None],
+                    axis=1)[:, 0]
+                blk = jnp.where(live, blk, 0)                # null block
+                logits, kp, vp = self._decode_body(
+                    params, kp, vp, toks[:, w], pw, bts, blk, nbl)
+                outs.append(jnp.argmax(logits, axis=-1)
+                            .astype(jnp.int32))
+            return jnp.stack(outs, axis=1), kp, vp           # (B, W)
 
         return run
 
@@ -540,8 +659,11 @@ class InferenceEngine:
     # -- compile cache (the retrace-detector discipline) -----------------
 
     def _sig(self, kind, size):
+        # paged_attn is part of the signature: the routing changes the
+        # compiled graph body, so a SHARED cache (Router fleets) must
+        # never hand a paged executable to an inline engine or back
         return (kind, size, self.cache.num_blocks, self.max_batch,
-                self.block_size)
+                self.block_size, self.paged_attn)
 
     def _get(self, kind, size, args):
         """Compile-cache lookup keyed by (kind, shape-signature); every
@@ -561,6 +683,7 @@ class InferenceEngine:
             build = {"prefill": self._build_prefill,
                      "decode": self._build_decode,
                      "chunk": self._build_chunk_prefill,
+                     "verify": self._build_verify,
                      "cow": self._build_cow}[kind](size)
             donate = (0, 1) if kind == "cow" else (1, 2)
             fn = jax.jit(build, donate_argnums=donate) \
@@ -568,11 +691,14 @@ class InferenceEngine:
             self._compiled[sig] = fn
             self.stats["compiles"] += 1
             _telem.inc("serving.compiles")
+            # verify sizes are (width, n_blocks) tuples; keep ints for
+            # the scalar families (existing telemetry schema)
+            sz = int(size) if isinstance(size, int) else str(size)
             if tc0 is not None:
                 # compiles on the request timeline: a warmup-miss that
                 # stalls traffic is visible exactly where it hurt
                 _trace.record("engine.compile", tc0, _trace.clock(),
-                              kind=kind, size=int(size))
+                              kind=kind, size=sz)
             if self._warmed:
                 # the tier-1 zero-retrace assertion reads the engine's
                 # own counter; the registry twin is what a live scrape
@@ -580,8 +706,21 @@ class InferenceEngine:
                 self.stats["compiles_after_warmup"] += 1
                 _telem.inc("serving.compiles_after_warmup")
                 _telem.event("serving.compile_after_warmup",
-                             kind=kind, size=int(size))
+                             kind=kind, size=sz)
         return fn
+
+    def _verify_widths(self):
+        """Compiled verify widths: the power-of-two buckets covering up
+        to ``spec_k + 1`` fed tokens (last committed + drafts), floor 2
+        — a 1-token boundary uses the plain decode graph instead."""
+        top = 2
+        while top < self.spec_k + 1:
+            top *= 2
+        out, w = [], 2
+        while w <= top:
+            out.append(w)
+            w *= 2
+        return out
 
     def warmup(self):
         """AOT-compile every (prefill, decode[, chunk, cow]) bucket
@@ -636,6 +775,27 @@ class InferenceEngine:
                 _l, _t, kp, vp = self._get("chunk", nb, args)(*args)
                 self.cache.update_pools(kp, vp,
                                         site="InferenceEngine.warmup(chunk)")
+        if self.spec_decode:
+            # the speculative verify family: one graph per (width,
+            # context bucket), warmed all-inactive like the chunk family
+            # (dead rows write the null block — no pool allocation)
+            B = self.max_batch
+            for W in self._verify_widths():
+                for bucket in self.buckets:
+                    nb = bucket // self.block_size
+                    if self._sig("verify", (W, nb)) in self._compiled:
+                        continue
+                    args = (self.params, self.cache.k_pool,
+                            self.cache.v_pool,
+                            _np.zeros((B, W), _np.int32),
+                            _np.zeros((B,), _np.int32),
+                            _np.zeros((B, nb), _np.int32),
+                            _np.zeros((B,), _np.int32),
+                            _np.zeros((B,), bool), dummy_key)
+                    _o, kp, vp = self._get("verify", (W, nb),
+                                           args)(*args)
+                    self.cache.update_pools(
+                        kp, vp, site="InferenceEngine.warmup(verify)")
         if self.prefill_chunk or self.prefix_cache is not None:
             if self._sig("cow", 0) not in self._compiled:
                 # the copy-on-write block copy (src=dst=0 copies the
@@ -816,25 +976,30 @@ class InferenceEngine:
                 _telem.set_gauge("serving.prefix_hit_rate",
                                  round(hr, 4))
 
-    def reserve(self, slot, pos):
-        """Grow ``slot``'s block table to cover ``pos`` before a decode
-        step, copy-on-write-forking the written block if a prefix chain
-        still shares it.  Under pool pressure, LRU prefix chains are
-        evicted first (only chains — never a block a live sequence
-        holds); False when the pool is exhausted even then."""
+    def reserve(self, slot, pos, n=1):
+        """Grow ``slot``'s block table to cover positions
+        ``[pos, pos + n)`` before a decode/verify step,
+        copy-on-write-forking written blocks a prefix chain still
+        shares.  ``n > 1`` is the speculative write-ahead: the verify
+        graph scatters the whole draft window before acceptance is
+        known (rejected positions stay garbage until ``trim``).  Under
+        pool pressure, LRU prefix chains are evicted first (only chains
+        — never a block a live sequence holds); False when the pool is
+        exhausted even then."""
         pc = self.prefix_cache
-        if not self.cache.ensure(slot, pos):
-            need = self.cache.blocks_for(pos + 1) - \
+        last = pos + n - 1
+        if not self.cache.ensure(slot, last):
+            need = self.cache.blocks_for(last + 1) - \
                 len(self.cache.table(slot))
             if pc is None or not pc.evict(blocks_needed=need):
                 return False
-            if not self.cache.ensure(slot, pos):
+            if not self.cache.ensure(slot, last):
                 return False
-        copies = self.cache.prepare_write(slot, pos, pos + 1)
+        copies = self.cache.prepare_write(slot, pos, pos + n)
         if copies is None:
             if pc is None or not pc.evict(blocks_needed=1):
                 return False
-            copies = self.cache.prepare_write(slot, pos, pos + 1)
+            copies = self.cache.prepare_write(slot, pos, pos + n)
             if copies is None:
                 return False
         self._apply_cow(copies)
@@ -885,6 +1050,72 @@ class InferenceEngine:
             self._publish_cache_gauges()
         nxt = _np.asarray(nxt)[:n]
         return nxt, _np.asarray(logits)[:n]
+
+    def verify(self, entries):
+        """One speculative verify dispatch (ISSUE 17).
+
+        entries: list of ``(slot, tokens, position)`` — ``tokens`` is
+        the row's last committed token followed by its draft
+        continuation (1 <= len <= spec_k + 1), fed at positions
+        ``position .. position + len - 1``.  The caller must have
+        :meth:`reserve`\\ d that whole range.  Returns ``out``
+        (n_active, W) np.int32 where ``out[i, j]`` is the greedy token
+        after absorbing ``tokens[i][:j+1]`` — the caller commits the
+        prefix of drafts that match and trims the write-ahead past the
+        committed length (see ContinuousBatcher._decode_spec)."""
+        import jax
+        if not entries:
+            raise MXNetError("verify: empty batch")
+        if self.temperature != 0.0:
+            raise NotSupportedError(
+                "verify is greedy-only; sampled decoding keeps the "
+                "plain decode path")
+        n = len(entries)
+        if n > self.max_batch:
+            raise MXNetError(f"verify batch {n} > max_batch")
+        wmax = max(len(t) for _, t, _ in entries)
+        if wmax < 1:
+            raise MXNetError("verify: empty token row")
+        if wmax > self.spec_k + 1:
+            raise MXNetError(f"verify row of {wmax} tokens vs spec_k "
+                             f"{self.spec_k} (+1 committed)")
+        W = next_bucket(max(wmax, 2), self._verify_widths())
+        end_max = max(p + len(t) for _, t, p in entries)
+        bucket = next_bucket(end_max, self.buckets)
+        if bucket is None:
+            raise MXNetError(f"verify end {end_max} exceeds "
+                             f"max_context {self.max_context}")
+        nbl = bucket // self.block_size
+        slots = [s for s, _, _ in entries] + \
+            [None] * (self.max_batch - n)
+        toks = _np.zeros((self.max_batch, W), _np.int32)
+        pos = _np.zeros((self.max_batch,), _np.int32)
+        counts = _np.zeros((self.max_batch,), _np.int32)
+        active = _np.zeros((self.max_batch,), bool)
+        for i, (slot, tk, p) in enumerate(entries):
+            tk = _np.asarray(tk, _np.int32).reshape(-1)
+            toks[i, :tk.shape[0]] = tk
+            pos[i], counts[i], active[i] = p, tk.shape[0], True
+            # write-ahead length; the scheduler trims back to the
+            # committed length after acceptance
+            self.cache.set_len(slot, p + tk.shape[0])
+        bts = self.cache.table_array(slots, nbl)
+        key = jax.random.fold_in(self._base_key,
+                                 (1 << 28) + self.stats["verify_calls"])
+        args = (self.params, self.cache.k_pool, self.cache.v_pool,
+                toks, pos, bts, counts, active, key)
+        t0 = _telem.clock() if _telem.enabled() else None
+        out, kp, vp = self._get("verify", (W, nbl), args)(*args)
+        self.cache.update_pools(kp, vp, site="InferenceEngine.verify")
+        self.stats["verify_calls"] += 1
+        self.stats["draft_tokens_scored"] += \
+            int(sum(len(t) - 1 for _, t, _ in entries))
+        if t0 is not None:
+            _telem.inc("serving.verify_calls")
+            _telem.observe("serving.verify_ms",
+                           (_telem.clock() - t0) * 1e3)
+            self._publish_cache_gauges()
+        return _np.asarray(out)[:n]
 
     def release(self, slot):
         """Finished sequence: drop its hold on its blocks (a block a

@@ -65,6 +65,10 @@ class PrefixCache:
         self.cache = cache
         self.max_nodes = int(max_nodes)
         self._nodes = {}      # key -> _Node
+        # parent_key -> set of child keys: the downward index the
+        # draft-source trie walk needs (lookup/attach only ever descend
+        # by KNOWN tokens; a draft asks "what comes next?")
+        self._childmap = {}
         self._tick = 0        # deterministic LRU clock (no wall time)
         self.lookups = 0
         self.hits = 0
@@ -126,6 +130,50 @@ class PrefixCache:
         self._publish()
         return n, blocks
 
+    def continuation(self, tokens, k):
+        """Draft-source trie walk (ISSUE 17): the cached continuation of
+        the FULL sequence ``tokens``, up to ``k`` tokens — what some
+        earlier request generated/prompted AFTER this exact prefix.
+
+        Refcount-NEUTRAL by contract: a draft is a guess for the verify
+        step, not an adoption — no references are taken, no LRU ticks
+        are spent, no hit accounting moves.  The returned tokens stay
+        valid even if the chain is evicted before the verify dispatch
+        (they are plain ints; a wrong guess just fails acceptance)."""
+        bs = self.cache.block_size
+        toks = [int(t) for t in tokens]
+        n = 0
+        parent_key = None
+        # descend the full-block chain covering ``tokens`` exactly
+        while n + bs <= len(toks):
+            key = self._key(parent_key, toks[n:n + bs])
+            node = self._nodes.get(key)
+            if node is None or node.partial:
+                break
+            n += bs
+            parent_key = key
+        rem = tuple(toks[n:])
+        out = []
+        while len(out) < int(k):
+            nxt = None
+            # deterministic: smallest token tuple among matching children
+            for key in sorted(self._childmap.get(parent_key, ()),
+                              key=lambda kk: kk[1]):
+                bt = key[1]
+                if len(bt) > len(rem) and bt[:len(rem)] == rem:
+                    node = self._nodes.get(key)
+                    if node is not None:
+                        nxt = (key, node, bt)
+                        break
+            if nxt is None:
+                break
+            key, node, bt = nxt
+            out.extend(bt[len(rem):])
+            if node.partial:
+                break             # partial tail: the chain ends here
+            parent_key, rem = key, ()
+        return out[:int(k)]
+
     def attach(self, slot, tokens):
         """Adopt the longest cached chain into ``slot`` (one ref per
         block) and return the cached position count (0 = miss; the
@@ -175,6 +223,7 @@ class PrefixCache:
         self._tick += 1
         node = _Node(key, parent, block, n_tokens, partial, self._tick)
         self._nodes[key] = node
+        self._childmap.setdefault(key[0], set()).add(key)
         if parent is not None:
             parent.children += 1
         return node
@@ -197,6 +246,11 @@ class PrefixCache:
                 break
             victim = min(leaves, key=lambda nd: nd.tick)
             del self._nodes[victim.key]
+            sibs = self._childmap.get(victim.key[0])
+            if sibs is not None:
+                sibs.discard(victim.key)
+                if not sibs:
+                    del self._childmap[victim.key[0]]
             if victim.parent is not None:
                 victim.parent.children -= 1
             self.cache.unref(victim.block)
@@ -210,6 +264,7 @@ class PrefixCache:
         for node in self._nodes.values():
             self.cache.unref(node.block)
         self._nodes.clear()
+        self._childmap.clear()
 
     # -- stats -----------------------------------------------------------
 

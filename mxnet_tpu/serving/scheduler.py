@@ -25,6 +25,21 @@ the one-per-boundary policy on any mixed queue — the deterministic gate
 in tests/test_serving_frontend.py.  A long prompt still delays the
 running batch by at most one chunk per boundary.
 
+Speculative decoding (ISSUE 17): with the engine's ``spec_decode`` set,
+the decode boundary becomes draft -> verify -> accept.  A model-free
+:class:`~.draft.DraftSource` proposes up to ``spec_k`` continuation
+tokens per row (prefix-cache trie walk, then prompt-lookup n-gram); ONE
+``engine.verify`` dispatch scores every row's last committed token plus
+its drafts; the greedy-matching draft prefix is committed (1..K+1
+tokens per boundary from one dispatch) and the paged-KV write-ahead
+past the committed length is trimmed.  Acceptance is exact token
+equality against the verify argmaxes, so the committed stream is
+BITWISE the non-speculative greedy stream — speculation changes
+dispatch count, never output (tests/test_spec_decode.py).  A sequence
+whose drafts keep missing stops drafting for a cooldown window
+(per-sequence fallback — it rides the same dispatch as a plain 1-token
+row), and a boundary where no row drafts runs the plain decode graph.
+
 Everything here is host-side policy: per-token device work is exactly
 one compiled decode step; the only host pull per boundary is the sampled
 token vector (needed to detect EOS and admit/evict — the serving
@@ -40,6 +55,7 @@ from ..base import MXNetError
 from .. import telemetry as _telem
 from ..telemetry import tracing as _trace
 from ..telemetry import watchdog as _watchdog
+from .draft import DraftSource
 
 __all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
 
@@ -94,6 +110,10 @@ class _BatcherBase:
         self.occupancy_samples = []
         self.decode_steps = 0
         self.tokens_generated = 0
+        # speculative accounting (stays zero on non-speculative runs)
+        self.verify_steps = 0
+        self.spec_drafted = 0
+        self.spec_accepted = 0
 
     def submit(self, request):
         request.submit_t = time.perf_counter()
@@ -211,6 +231,13 @@ class _BatcherBase:
         return {"requests": len(self.finished),
                 "tokens_generated": self.tokens_generated,
                 "decode_steps": self.decode_steps,
+                "verify_steps": self.verify_steps,
+                "spec_accept_rate": (
+                    round(self.spec_accepted / self.spec_drafted, 4)
+                    if self.spec_drafted else None),
+                "tokens_per_dispatch": (
+                    round(self.tokens_generated / self.decode_steps, 4)
+                    if self.decode_steps else None),
                 "occupancy": (round(self.occupancy(), 4)
                               if self.occupancy() is not None else None),
                 "p50_latency_s": pct(0.50), "p99_latency_s": pct(0.99),
@@ -236,12 +263,32 @@ class ContinuousBatcher(_BatcherBase):
     ``prefill_chunk`` set, admission packs chunks from several prompts
     into one dispatch per boundary (ISSUE 12 chunked prefill)."""
 
-    def __init__(self, engine, prefills_per_step=1):
+    # boundaries a sequence sits out after its drafts stop landing
+    # (deterministic host counter; re-probes when it expires)
+    _spec_cooldown = 8
+    _spec_miss_limit = 2
+
+    def __init__(self, engine, prefills_per_step=1, speculative=None,
+                 spec_k=None):
         super().__init__(engine)
         self.prefills_per_step = int(prefills_per_step)
         self.active = {}          # slot -> Request
         self.prefilling = {}      # slot -> _PrefillState (chunked only)
         self._free_slots = list(range(engine.max_batch - 1, -1, -1))
+        # speculative decoding (ISSUE 17): defaults follow the engine
+        # (which reads MXTPU_SPEC_DECODE / MXTPU_SPEC_K)
+        self.speculative = engine.spec_decode if speculative is None \
+            else bool(speculative)
+        if self.speculative and not engine.spec_decode:
+            raise MXNetError(
+                "speculative batching needs an engine built with "
+                "spec_decode=True (the verify graphs compile at warmup)")
+        self.spec_k = engine.spec_k if spec_k is None else int(spec_k)
+        if not 1 <= self.spec_k <= engine.spec_k:
+            raise MXNetError(f"spec_k {self.spec_k} outside the "
+                             f"engine's compiled [1, {engine.spec_k}]")
+        self.draft = DraftSource(prefix_cache=engine.prefix_cache)
+        self._spec_state = {}     # req.id -> [misses, cooldown]
 
     def step(self):
         """One scheduling boundary: admit queued requests (one packed
@@ -256,10 +303,118 @@ class ContinuousBatcher(_BatcherBase):
         if not self.active:
             return admitted
         before = set(self.active)
-        self._decode_active(self.active)
+        if self.speculative:
+            self._decode_spec(self.active)
+        else:
+            self._decode_active(self.active)
         for slot in before - set(self.active):
             self._free_slots.append(slot)
         return admitted + len(before)
+
+    def _decode_spec(self, active):
+        """One speculative boundary: draft, verify in ONE dispatch,
+        commit the greedy-matching prefix, trim the write-ahead.
+
+        Bitwise contract: a committed token is either a verify argmax
+        (computed by the decode body op-for-op) or a draft that EQUALED
+        one — so the generated stream is exactly the plain greedy
+        stream, only produced in fewer dispatches.  A boundary where no
+        row drafts (cold caches, cooldowns, length caps) delegates to
+        the plain decode graph."""
+        eng = self.engine
+        drafts = {}
+        any_draft = False
+        for slot, req in active.items():
+            pos = len(req.tokens) + len(req.generated) - 1
+            st = self._spec_state.get(req.id)
+            if st is not None and st[1] > 0:
+                st[1] -= 1        # cooling down: ride as a plain row
+                drafts[slot] = []
+                continue
+            # a draft may commit up to cap+1 tokens and write K/V up to
+            # pos+cap; both the length budget and the context ceiling
+            # (next boundary writes pos+committed) bound the window
+            cap = min(self.spec_k,
+                      req.max_new_tokens - len(req.generated) - 1,
+                      eng.max_context - 2 - pos)
+            d = self.draft.propose(req.tokens + req.generated, cap) \
+                if cap > 0 else []
+            drafts[slot] = d
+            if d:
+                any_draft = True
+        if not any_draft:
+            return self._decode_active(active)
+        td0 = _trace.clock() if _trace.enabled() else None
+        entries = []
+        for slot, req in active.items():
+            pos = len(req.tokens) + len(req.generated) - 1
+            toks = [req.generated[-1]] + drafts[slot]
+            if len(toks) > 1 and not eng.reserve(slot, pos, len(toks)):
+                toks = toks[:1]   # pool pressure: shed the write-ahead
+            if len(toks) == 1 and not eng.reserve(slot, pos):
+                raise MXNetError("KV pool exhausted mid-decode; raise "
+                                 "num_blocks or lower max_batch")
+            entries.append((slot, toks, pos))
+        out = eng.verify(entries)
+        self.decode_steps += 1
+        self.verify_steps += 1
+        self.occupancy_samples.append(len(entries) / eng.max_batch)
+        td1 = _trace.clock() if td0 is not None else None
+        for i, (slot, toks, pos) in enumerate(entries):
+            req = active[slot]
+            D = len(toks) - 1
+            # out[i, j] = greedy token after absorbing toks[:j+1]; the
+            # drafts matching their predecessor's argmax are accepted,
+            # each match's own argmax rides along as the next commit
+            committed = [int(out[i, 0])]
+            j = 1
+            while j <= D and int(toks[j]) == int(out[i, j - 1]):
+                committed.append(int(out[i, j]))
+                j += 1
+            accepted = j - 1
+            self.spec_drafted += D
+            self.spec_accepted += accepted
+            if D:
+                st = self._spec_state.setdefault(req.id, [0, 0])
+                if accepted == 0:
+                    st[0] += 1
+                    if st[0] >= self._spec_miss_limit:
+                        st[0], st[1] = 0, self._spec_cooldown
+                else:
+                    st[0] = 0
+            if td0 is not None:
+                _trace.record("verify", td0, td1, parent=req.trace,
+                              pos=pos, drafted=D, accepted=accepted)
+            for tok in committed:
+                if req.done:
+                    break         # EOS inside the window: rest is moot
+                self._append_token(req, slot, tok)
+            if req.done:
+                self._spec_state.pop(req.id, None)
+            else:
+                # roll back the write-ahead: K/V past the committed
+                # length is rejected-draft garbage — drop the length
+                # and any blocks only the garbage covered
+                n = pos + 1 + accepted
+                eng.cache.trim(slot, n)
+                eng.cache.set_len(slot, n)
+        if _telem.enabled():
+            _telem.set_gauge("serving.queue_depth", len(self.queue))
+            _telem.observe("serving.batch_occupancy",
+                           len(entries) / eng.max_batch,
+                           edges=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75,
+                                  0.875, 1.0))
+            _telem.inc("serving.decode_steps")
+            if self.spec_drafted:
+                _telem.set_gauge(
+                    "serving.spec_accept_rate",
+                    round(self.spec_accepted / self.spec_drafted, 4))
+        if _watchdog.enabled():
+            _watchdog.on_serving_boundary(
+                queue_depth=len(self.queue),
+                kv_blocks_in_use=eng.cache.blocks_in_use)
+        for slot in [s for s, r in active.items() if r.done]:
+            del active[slot]
 
     def _admit_serial(self):
         admitted = 0

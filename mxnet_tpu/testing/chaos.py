@@ -616,15 +616,22 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     sys_prompt = rng.randint(0, 64, (12,)).tolist()
     prompts = [sys_prompt + rng.randint(0, 64, (3 + i % 4,)).tolist()
                for i in range(n_requests)]
+    speculative = os.environ.get(
+        "MXTPU_SPEC_DECODE", "0") not in ("", "0")
     result = {"kind": "serving", "replicas": replicas,
               "requests": n_requests, "kill_rid": kill_rid,
-              "kill_at_boundary": kill_at_boundary}
+              "kill_at_boundary": kill_at_boundary,
+              "speculative": speculative}
 
     # solo cold-path references: one fresh single-replica engine per
     # prompt, full-prompt prefill, greedy decode — the stream every
-    # routed request must reproduce bit-for-bit
+    # routed request must reproduce bit-for-bit.  spec_decode is
+    # FORCED OFF here regardless of env: the reference is the plain
+    # path, so under MXTPU_SPEC_DECODE=1 the outputs_match_solo gate
+    # is exactly the speculative-bitwise acceptance criterion (and a
+    # drain/requeue mid-draft must land on the same stream)
     ref_eng = InferenceEngine(net, max_batch=2, block_size=8,
-                              max_context=32)
+                              max_context=32, spec_decode=False)
     ref_eng.warmup()
     refs = []
     for p in prompts:
@@ -666,6 +673,18 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     result["prefix_hits"] = sum(
         (pr["prefix"] or {}).get("hits", 0)
         for pr in st["per_replica"])
+    if speculative:
+        # speculative accounting across surviving replicas (evidence,
+        # not a gate — acceptance may legitimately be 0 on this mix;
+        # the gate is outputs_match_solo staying bitwise)
+        drafted = sum(r.batcher.spec_drafted for r in router.replicas
+                      if r.alive)
+        accepted = sum(r.batcher.spec_accepted for r in router.replicas
+                       if r.alive)
+        result["spec_drafted"] = drafted
+        result["spec_accepted"] = accepted
+        result["spec_accept_rate"] = (
+            round(accepted / drafted, 4) if drafted else None)
     # the injected kill must have left a parseable flight dump whose
     # last event is the fault trip (ISSUE 9 discipline)
     result["flight_dump"] = _flight_check(expect_kind="fault.trip")

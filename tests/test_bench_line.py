@@ -319,6 +319,9 @@ _SERVING_KEYS = {
     # ISSUE 12 front-end fields
     "chunked_prefill", "router_replicas", "prefix_hit_rate",
     "router_p99_ms",
+    # ISSUE 17 speculative-decoding fields
+    "speculative", "paged_attn", "spec_accept_rate",
+    "tokens_per_dispatch",
 }
 
 
@@ -330,16 +333,22 @@ def test_serving_block_schema_is_stable():
     for k in ("p50_ms", "p99_ms", "ttft_p50_ms", "tokens_s",
               "tokens_s_chip", "occupancy", "tokens_per_step",
               "compiles_after_warmup", "cache_utilization",
-              "prefix_hit_rate", "router_p99_ms"):
+              "prefix_hit_rate", "router_p99_ms", "spec_accept_rate",
+              "tokens_per_dispatch"):
         assert blk[k] is None, k
     # CONFIG fields are always real (front-end off by default)
     assert blk["chunked_prefill"] is False
     assert blk["router_replicas"] == 0
+    assert blk["speculative"] is False
+    assert blk["paged_attn"] is False
     # measured values round-trip, rounded
     blk2 = serving_block(p99_ms=12.3456, tokens_s_chip=901.239,
                          occupancy=0.87654, compiles_after_warmup=0,
                          chunked_prefill=True, router_replicas=4,
-                         prefix_hit_rate=0.98765, router_p99_ms=77.7777)
+                         prefix_hit_rate=0.98765, router_p99_ms=77.7777,
+                         speculative=True, paged_attn=True,
+                         spec_accept_rate=0.61239,
+                         tokens_per_dispatch=2.71828)
     assert blk2["p99_ms"] == 12.346
     assert blk2["tokens_s_chip"] == 901.2
     assert blk2["occupancy"] == 0.8765
@@ -348,6 +357,10 @@ def test_serving_block_schema_is_stable():
     assert blk2["router_replicas"] == 4
     assert blk2["prefix_hit_rate"] == 0.9877
     assert blk2["router_p99_ms"] == 77.778
+    assert blk2["speculative"] is True
+    assert blk2["paged_attn"] is True
+    assert blk2["spec_accept_rate"] == 0.6124
+    assert blk2["tokens_per_dispatch"] == 2.718
     assert json.loads(json.dumps(blk)) == blk
 
 
@@ -359,7 +372,8 @@ def test_bench_serving_on_cpu_is_nulls_not_zeros():
     if jax.devices()[0].platform != "cpu":
         return
     blk = bench._bench_serving()
-    for k in ("p50_ms", "p99_ms", "tokens_s_chip", "occupancy"):
+    for k in ("p50_ms", "p99_ms", "tokens_s_chip", "occupancy",
+              "spec_accept_rate", "tokens_per_dispatch"):
         assert blk[k] is None, k
     assert blk["max_batch"] > 0 and blk["block_size"] > 0
     assert "note" in blk

@@ -45,7 +45,8 @@ _UP_SUFFIXES = ("value", "mfu", "tflops_delivered", "samples_s",
                 "overlap_efficiency", "speedup", "per_key_speedup",
                 "occupancy", "vs_baseline", "weak_scaling_efficiency",
                 "projected_efficiency", "proj_eff_8", "proj_eff_256",
-                "tokens_per_step_ratio")
+                "tokens_per_step_ratio", "tokens_per_dispatch",
+                "spec_accept_rate")
 _DOWN_SUFFIXES = ("_ms", "p99", "p50", "ttft", "bubble_frac",
                   "pp_bubble_frac", "exposed_ms")
 # config/provenance keys: never compared (a changed knob is not a perf

@@ -16,6 +16,9 @@ Usage:
       shared-system-prompt mix through N replicas (prefix cache +
       chunked prefill on), reporting prefix hit rate and per-replica
       occupancy (ISSUE 12)
+  python tools/serve_loadgen.py --smoke --speculative  # draft/verify
+      decoding on the continuous policy (outputs bitwise unchanged;
+      reports acceptance rate + tokens per dispatch, ISSUE 17)
 """
 from __future__ import annotations
 
@@ -155,10 +158,13 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
 
 def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                 mode="both", smoke=True, quantize=None, seed=0,
-                replicas=0):
+                replicas=0, speculative=False):
     """Run the mix through the chosen scheduling policy(ies); returns
     the bench `serving` payload.  ``replicas >= 1`` switches to the
-    router fleet benchmark (:func:`run_router_loadgen`)."""
+    router fleet benchmark (:func:`run_router_loadgen`).
+    ``speculative`` turns on draft/verify decoding for the CONTINUOUS
+    policy (greedy acceptance is bitwise, so the comparison still
+    measures scheduling, now in tokens-per-dispatch)."""
     from mxnet_tpu import telemetry
     from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
                                    StaticBatcher, serving_block)
@@ -168,6 +174,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
             block_size=block_size, max_context=max_context,
             smoke=smoke, replicas=replicas, seed=seed)
     results = {}
+    paged = False
     for policy in (("continuous", "static") if mode == "both"
                    else (mode,)):
         net, cfg = _build_net(smoke)
@@ -180,9 +187,16 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                   "calib_data": [mx.nd.array(
                       rng.randint(0, cfg.vocab_size, (2, 16)),
                       dtype="int32") for _ in range(2)]}
+        # the static baseline never drafts (its decode loop is the
+        # policy under comparison), so its engine skips the verify
+        # graph compiles
         engine = InferenceEngine(net, max_batch=max_batch,
                                  block_size=block_size,
-                                 max_context=max_context, **kw)
+                                 max_context=max_context,
+                                 spec_decode=(speculative and
+                                              policy == "continuous"),
+                                 **kw)
+        paged = engine.paged_attn
         engine.warmup()
         cls = (ContinuousBatcher if policy == "continuous"
                else StaticBatcher)
@@ -241,7 +255,10 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         occupancy=cont.get("occupancy"),
         tokens_per_step=cont.get("tokens_per_step"),
         compiles_after_warmup=cont.get("compiles_after_warmup"),
-        cache_utilization=cont.get("cache_utilization"))
+        cache_utilization=cont.get("cache_utilization"),
+        speculative=bool(speculative), paged_attn=paged,
+        spec_accept_rate=cont.get("spec_accept_rate"),
+        tokens_per_dispatch=cont.get("tokens_per_dispatch"))
     payload = {"metric": "serve_loadgen", "mode": mode,
                "smoke": bool(smoke), "serving": blk,
                "policies": {k: {kk: vv for kk, vv in v.items()
@@ -290,6 +307,10 @@ def main(argv=None):
                     help="N>=1: router fleet benchmark with a shared-"
                          "system-prompt mix (prefix cache + chunked "
                          "prefill); 0 = single-engine policy comparison")
+    ap.add_argument("--speculative", action="store_true",
+                    help="draft/verify decoding on the continuous "
+                         "policy (greedy outputs unchanged; reports "
+                         "acceptance rate + tokens per dispatch)")
     args = ap.parse_args(argv)
     smoke = args.smoke
     n = args.requests if args.requests is not None else (12 if smoke
@@ -300,7 +321,7 @@ def main(argv=None):
         max_context=args.max_context or (64 if smoke else 512),
         mode=args.mode, smoke=smoke,
         quantize="int8" if args.int8 else None,
-        replicas=args.replicas)
+        replicas=args.replicas, speculative=args.speculative)
     out = json.dumps(payload)
     if len(out) > 1800:      # the driver tail-window contract
         slim = dict(payload)

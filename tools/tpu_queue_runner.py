@@ -554,6 +554,14 @@ def run_chaos(suite: str = "preempt") -> int:
     # (mxnet_tpu.lint.donation) — a stale host touch of a donated
     # buffer fails the scenario the way the first TPU round would crash
     env.setdefault("MXTPU_DONATION_CHECK", "1")
+    # ISSUE 17: serving scenarios run SPECULATIVE — the replica kill
+    # lands mid-draft and the outputs_match_solo gate proves the
+    # drain/requeue loses zero requests and re-verifies onto the exact
+    # plain-path stream.  spec_k=2 bounds the verify-graph warmup
+    # compiles on the CPU mesh (widths {2, 4} only).
+    if suite in ("serving", "autoscale", "all"):
+        env.setdefault("MXTPU_SPEC_DECODE", "1")
+        env.setdefault("MXTPU_SPEC_K", "2")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
