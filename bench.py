@@ -709,21 +709,28 @@ def _bench_serving() -> dict:
     from mxnet_tpu.serving import serving_block
     spec = os.environ.get("MXTPU_SPEC_DECODE", "0") not in ("", "0")
     paged = os.environ.get("MXTPU_PAGED_ATTN", "0") not in ("", "0")
+    tp = int(os.environ.get("MXTPU_SERVE_TP", "0") or 0)
+    disagg = os.environ.get("MXTPU_SERVE_DISAGG", "0") not in ("", "0")
     if jax.devices()[0].platform == "cpu":
-        # config rides (speculative/paged_attn are routing knobs, real
-        # either way); the measured fields — including the ISSUE 17
-        # spec_accept_rate / tokens_per_dispatch — stay null
+        # config rides (speculative/paged_attn/tp_shards/disaggregated
+        # are routing knobs, real either way); the measured fields —
+        # including the ISSUE 18 handoff_ms / pool occupancies — stay
+        # null
         blk = serving_block(max_batch=8, block_size=16,
                             buckets=(16, 32, 64, 128, 256, 512),
                             continuous=True, speculative=spec,
-                            paged_attn=paged)
+                            paged_attn=paged,
+                            tp_shards=(tp if tp > 1 else 0),
+                            disaggregated=disagg)
         blk["note"] = ("not measured on CPU; tools/serve_loadgen.py "
                       "--smoke carries the CPU policy comparison")
         return blk
     from tools.serve_loadgen import run_loadgen
     payload = run_loadgen(n_requests=32, max_batch=8, block_size=16,
                           max_context=512, mode="both", smoke=False,
-                          speculative=spec)
+                          speculative=spec, tp=tp,
+                          replicas=(4 if disagg else 0),
+                          disaggregated=disagg)
     blk = payload["serving"]
     blk["vs_static"] = payload.get("continuous_vs_static")
     return blk
@@ -1152,6 +1159,9 @@ def _compact_line(result: dict, budget: int = _HEADLINE_BUDGET) -> str:
         ("serve_occupancy", ("serving", "occupancy")),
         ("serve_prefix_hit", ("serving", "prefix_hit_rate")),
         ("router_p99_ms", ("serving", "router_p99_ms")),
+        ("serve_handoff_ms", ("serving", "handoff_ms")),
+        ("serve_prefill_occ", ("serving", "prefill_pool_occupancy")),
+        ("serve_decode_occ", ("serving", "decode_pool_occupancy")),
         ("elastic_reshard_ms", ("elastic", "reshard_ms")),
         ("elastic_pause_ms", ("elastic", "pause_ms")),
         ("elastic_epoch", ("elastic", "membership_epoch")),

@@ -22,12 +22,15 @@ detection rate in a couple hundred steps on CPU.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
 import numpy as np
 
-import mxnet_tpu as mx
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import mxnet_tpu as mx                                    # noqa: E402
 from mxnet_tpu import autograd, gluon
 from mxnet_tpu.gluon import nn
 from mxnet_tpu.gluon.model_zoo.vision.ssd import SSD

@@ -69,18 +69,25 @@ class ScalingRule:
 
     ``signal``: registry metric name (for serving signals the
     Autoscaler aggregates ``serving.replica<i>.<suffix>`` with max —
-    pass e.g. ``"serving.queue_depth"``).  ``high``/``low``: breach
+    pass e.g. ``"serving.queue_depth"``; role-scoped signals
+    ``serving.prefill.<suffix>`` / ``serving.decode.<suffix>``
+    aggregate only that pool's replicas).  ``high``/``low``: breach
     thresholds (either may be None for one-sided rules).  ``domain``:
-    ``"train"`` (dp) or ``"serving"`` (replicas).  The verdict is
-    ``"grow"`` only after the value stays ``> high`` for ``window_s``
-    continuous seconds, ``"shrink"`` after ``< low`` for the same —
-    one spike never moves capacity."""
+    ``"train"`` (dp), ``"serving"`` (replicas), or — against a
+    DISAGGREGATED router — ``"serving:prefill"`` / ``"serving:decode"``
+    to scale one pool independently (TTFT pressure grows the prefill
+    pool, TPOT pressure the decode pool; each pool runs its own
+    cooldown).  The verdict is ``"grow"`` only after the value stays
+    ``> high`` for ``window_s`` continuous seconds, ``"shrink"`` after
+    ``< low`` for the same — one spike never moves capacity."""
 
     def __init__(self, signal, high=None, low=None, domain="train",
                  window_s=30.0):
-        if domain not in ("train", "serving"):
+        if domain not in ("train", "serving", "serving:prefill",
+                          "serving:decode"):
             raise MXNetError(f"ScalingRule domain {domain!r}: expected "
-                             f"'train' or 'serving'")
+                             f"'train', 'serving', 'serving:prefill' "
+                             f"or 'serving:decode'")
         if high is None and low is None:
             raise MXNetError(f"ScalingRule {signal!r}: need high and/or "
                              f"low threshold")
@@ -182,13 +189,27 @@ class Autoscaler:
     def _serving_signal(self, suffix):
         """Max over the live replicas' published per-replica gauges
         (the fleet is as loaded as its hottest replica), falling back
-        to direct reads when the registry is off."""
+        to direct reads when the registry is off.  A ``prefill.`` /
+        ``decode.`` prefix scopes the aggregation to that role's pool
+        (the disaggregated fleet's independent scaling signals)."""
         if self._router is None:
             return None
+        role = None
+        for r in ("prefill", "decode"):
+            if suffix.startswith(r + "."):
+                role, suffix = r, suffix[len(r) + 1:]
+                break
         vals = []
         for rep in self._router.live_replicas():
+            if role is not None and \
+                    getattr(rep, "role", "combined") != role:
+                continue
             v = _telem.value(f"serving.replica{rep.rid}.{suffix}")
-            if v is None:
+            if v is None and suffix == "tpot_ms":
+                recent = rep.tpots[-8:]
+                v = (sorted(recent)[len(recent) // 2] * 1e3
+                     if recent else None)
+            elif v is None:
                 v = rep.load_signals().get(suffix)
             if v is not None:
                 vals.append(float(v))
@@ -237,9 +258,13 @@ class Autoscaler:
             if last is not None and now - last < self._policy.cooldown_s:
                 self.skipped["cooldown"] += 1
                 continue
-            d = (self._apply_train(verdict, signals, now, step)
-                 if domain == "train"
-                 else self._apply_serving(verdict, signals, now, step))
+            if domain == "train":
+                d = self._apply_train(verdict, signals, now, step)
+            else:
+                role = (domain.split(":", 1)[1] if ":" in domain
+                        else None)
+                d = self._apply_serving(verdict, signals, now, step,
+                                        role=role, domain=domain)
             if d is not None:
                 self._last_decision_t[domain] = now
                 issued.append(d)
@@ -278,28 +303,38 @@ class Autoscaler:
                              "to": target, "step": step,
                              "signals": dict(signals)})
 
-    def _apply_serving(self, verdict, signals, now, step):
+    def _apply_serving(self, verdict, signals, now, step, role=None,
+                       domain="serving"):
         if self._router is None:
             return None
-        live = self._router.live_replicas()
+        if role is not None and \
+                not getattr(self._router, "disaggregated", False):
+            # a pool-scoped rule against a combined fleet: nothing to
+            # scale by role — the rule is inert, not an error
+            self.skipped["bounds"] += 1
+            return None
+        live = [r for r in self._router.live_replicas()
+                if role is None or r.role == role]
         cur = len(live)
         if verdict == "grow":
             if self._policy.max_replicas is not None and \
                     cur + 1 > self._policy.max_replicas:
                 self.skipped["bounds"] += 1
                 return None
-            rep = self._router.add_replica()
+            rep = self._router.add_replica(role=role) \
+                if role is not None else self._router.add_replica()
             to = rep.rid
         else:
             if cur - 1 < self._policy.min_replicas:
                 self.skipped["bounds"] += 1
                 return None
-            # drain the highest-rid live replica: the newest capacity
-            # leaves first (LIFO keeps replica 0's warm caches longest)
+            # drain the highest-rid live replica (of the pool): the
+            # newest capacity leaves first (LIFO keeps replica 0's
+            # warm caches longest)
             victim = max(live, key=lambda r: r.rid)
             self._router.drain_replica(victim.rid, reason="autoscale")
             to = victim.rid
-        return self._record({"t": now, "domain": "serving",
+        return self._record({"t": now, "domain": domain,
                              "verdict": verdict, "from": cur,
                              "to": cur + (1 if verdict == "grow" else -1),
                              "rid": to, "step": step,

@@ -21,7 +21,7 @@ from .mesh import AXIS_TP
 
 __all__ = ["tp_spec_for_param", "shard_params_tp", "ParallelDense",
            "ParallelEmbedding", "llama_tp_rules", "bert_tp_rules",
-           "shard_model_tp"]
+           "llama_engine_specs", "shard_model_tp"]
 
 
 def tp_spec_for_param(name, shape, kind="auto"):
@@ -97,6 +97,21 @@ def llama_tp_rules():
     return {"q_proj": col, "k_proj": col, "v_proj": col,
             "gate_proj": col, "up_proj": col,
             "o_proj": row, "down_proj": row}
+
+
+def llama_engine_specs():
+    """The :func:`llama_tp_rules` table re-keyed on the serving
+    engine's extracted-weight names (ISSUE 18 sharded serving):
+    ``InferenceEngine._extract_weights`` flattens each decoder layer to
+    ``{q, k, v, o, gate, up, down}`` projection dicts, and this is the
+    one spec source both the structural sharder and the engine's
+    at-rest ``device_put`` placement read — the megatron layout cannot
+    drift between training and serving."""
+    rules = llama_tp_rules()
+    return {"q": rules["q_proj"], "k": rules["k_proj"],
+            "v": rules["v_proj"], "o": rules["o_proj"],
+            "gate": rules["gate_proj"], "up": rules["up_proj"],
+            "down": rules["down_proj"]}
 
 
 def bert_tp_rules():

@@ -32,15 +32,15 @@ math; ``tools/serve_loadgen.py`` is the load-generator benchmark.
 from __future__ import annotations
 
 from .engine import InferenceEngine, next_bucket
-from .kv_cache import PagedKVCache, DoubleFreeError
+from .kv_cache import PagedKVCache, DoubleFreeError, HandoffError
 from .scheduler import ContinuousBatcher, Request, StaticBatcher
 from .draft import DraftSource
 from .frontend import PrefixCache, Router, AdmissionShed
 
 __all__ = ["InferenceEngine", "PagedKVCache", "DoubleFreeError",
-           "ContinuousBatcher", "StaticBatcher", "Request", "next_bucket",
-           "serving_block", "PrefixCache", "Router", "AdmissionShed",
-           "DraftSource"]
+           "HandoffError", "ContinuousBatcher", "StaticBatcher",
+           "Request", "next_bucket", "serving_block", "PrefixCache",
+           "Router", "AdmissionShed", "DraftSource"]
 
 
 def _r(x, nd=3):
@@ -55,7 +55,10 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
                   chunked_prefill=False, router_replicas=0,
                   prefix_hit_rate=None, router_p99_ms=None,
                   speculative=False, paged_attn=False,
-                  spec_accept_rate=None, tokens_per_dispatch=None):
+                  spec_accept_rate=None, tokens_per_dispatch=None,
+                  tp_shards=0, disaggregated=False, handoff_ms=None,
+                  prefill_pool_occupancy=None,
+                  decode_pool_occupancy=None):
     """The bench.py ``serving`` observability block (the `comm` block
     discipline from PR 3/PR 5): static serving config is always real;
     MEASURED fields default to ``None`` — null-when-unmeasured, so a CPU
@@ -65,7 +68,10 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
     config (always real), ``prefix_hit_rate``/``router_p99_ms`` are
     measured (null until a run actually measured them).  ISSUE 17 adds
     ``speculative``/``paged_attn`` (config) and
-    ``spec_accept_rate``/``tokens_per_dispatch`` (measured)."""
+    ``spec_accept_rate``/``tokens_per_dispatch`` (measured).  ISSUE 18
+    adds ``tp_shards``/``disaggregated`` (config) and ``handoff_ms``/
+    ``prefill_pool_occupancy``/``decode_pool_occupancy`` (measured —
+    null unless a disaggregated run actually measured them)."""
     return {
         "max_batch": int(max_batch),
         "block_size": int(block_size),
@@ -89,4 +95,9 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
         "paged_attn": bool(paged_attn),
         "spec_accept_rate": _r(spec_accept_rate, 4),
         "tokens_per_dispatch": _r(tokens_per_dispatch, 3),
+        "tp_shards": int(tp_shards),
+        "disaggregated": bool(disaggregated),
+        "handoff_ms": _r(handoff_ms),
+        "prefill_pool_occupancy": _r(prefill_pool_occupancy, 4),
+        "decode_pool_occupancy": _r(decode_pool_occupancy, 4),
     }

@@ -125,9 +125,21 @@ class InferenceEngine:
     temperature / top_k / seed : in-graph sampling config (greedy at
         temperature 0; otherwise top-k categorical when top_k > 0, full
         categorical when 0).
-    mesh : a ``parallel.MeshConfig`` (or spec string) RECORDED on the
-        engine and carried by the router manifest; tp/pp > 1 raise the
-        typed ``NotSupportedError`` until ROADMAP item 2 lands.
+    mesh : a ``parallel.MeshConfig`` (or spec string).  tp > 1 serves
+        the model SHARDED over a tp submesh (ISSUE 18): extracted
+        weights are placed at rest with the
+        ``tensor_parallel.llama_engine_specs`` megatron table, the
+        paged KV pools are sharded on the kv-head axis, and every
+        graph family compiles against the sharded layouts (the mesh
+        spec rides in the compile-cache signature).  pp > 1 still
+        raises the typed ``NotSupportedError``.  None reads
+        ``MXTPU_SERVE_TP`` (default unset = the single-chip engine,
+        bitwise-inert).
+    kv_cache : an existing ``PagedKVCache`` to ADOPT instead of
+        building one (ISSUE 18 disaggregated serving: prefill and
+        decode replicas share one physical pool so a block handoff
+        transfers ownership, not bytes).  Geometry must match this
+        engine's net and ``block_size``.
     prefill_chunk : chunk bucket in tokens (multiple of block_size) for
         the packed continuation-prefill family; 0/None reads
         ``MXTPU_PREFILL_CHUNK`` (default off).
@@ -155,31 +167,56 @@ class InferenceEngine:
                  top_k=0, seed=0, quantize=None, calib_data=None,
                  num_calib_batches=10, mesh=None, prefill_chunk=None,
                  prefix_cache=None, compile_cache=None,
-                 spec_decode=None, spec_k=None, paged_attn=None):
+                 spec_decode=None, spec_k=None, paged_attn=None,
+                 kv_cache=None):
         import jax
         import jax.numpy as jnp
         from ..parallel.mesh import MeshConfig
         cfg = net.cfg
         if cfg.tensor_parallel:
             raise NotSupportedError(
-                "InferenceEngine drives the single-chip decode path; "
-                "TP-sharded serving over the named-axis mesh is the "
-                "ROADMAP item-2 follow-up — until it lands, serve "
-                "tensor_parallel nets via forward()")
+                "InferenceEngine extracts and places its own weights "
+                "(pass mesh=MeshConfig(tp=N) for sharded serving); "
+                "structurally tensor_parallel nets serve via forward()")
         if quantize not in (None, "int8"):
             raise MXNetError(f"quantize={quantize!r}: only int8 weight "
                              "quantization is supported")
-        # the mesh this engine serves on is RECORDED (the router
-        # manifest carries it so a fleet's placement is inspectable)
-        # even though only dp=1 is runnable today
+        if mesh is None:
+            tp_env = _env_int("MXTPU_SERVE_TP", 0)
+            if tp_env > 1:
+                mesh = MeshConfig(tp=tp_env)
         if isinstance(mesh, str):
             mesh = MeshConfig.from_spec(mesh)
         self.mesh_config = mesh if mesh is not None else MeshConfig()
-        if self.mesh_config.tp > 1 or self.mesh_config.pp > 1:
+        if self.mesh_config.pp > 1:
             raise NotSupportedError(
                 f"mesh {self.mesh_config.describe()!r}: serving over "
-                "tp/pp axes is the ROADMAP item-2 follow-up; only "
-                "dp-replicated engines (frontend.Router) run today")
+                "the pp axis is still unsupported (tp submeshes serve "
+                "since ISSUE 18; pipeline-staged serving is a later "
+                "follow-up)")
+        self.tp = self.mesh_config.tp
+        self._mesh = None
+        if self.tp > 1:
+            if quantize is not None:
+                raise NotSupportedError(
+                    "int8 serving on a tp submesh is not supported; "
+                    "serve quantized nets on single-chip replicas")
+            need = self.mesh_config.dp * self.tp * self.mesh_config.pp
+            ndev = len(jax.devices())
+            if need > ndev:
+                raise MXNetError(
+                    f"mesh {self.mesh_config.describe()!r} needs "
+                    f"{need} devices; only {ndev} visible")
+            if cfg.num_heads % self.tp or cfg.num_kv_heads % self.tp:
+                raise MXNetError(
+                    f"tp={self.tp} must divide num_heads "
+                    f"{cfg.num_heads} and num_kv_heads "
+                    f"{cfg.num_kv_heads}")
+            if cfg.intermediate_size % self.tp:
+                raise MXNetError(
+                    f"tp={self.tp} must divide intermediate_size "
+                    f"{cfg.intermediate_size}")
+            self._mesh = self.mesh_config.build()
         self.net = net
         self.cfg = cfg
         self.max_batch = max(2, _env_int("MXTPU_SERVE_MAX_BATCH", 4)
@@ -207,11 +244,44 @@ class InferenceEngine:
         if quantize == "int8":
             self._quantize_in_place(net, calib_data, num_calib_batches)
         self.params = self._extract_weights(net)
-        self.cache = PagedKVCache(
-            cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
-            num_blocks=num_blocks, block_size=bs,
-            max_batch=self.max_batch,
-            dtype=self.params["embed"].dtype)
+        if self._mesh is not None:
+            self.params = self._shard_params(self.params)
+        if kv_cache is not None:
+            # disaggregated serving (ISSUE 18): prefill and decode
+            # replicas ADOPT one physical pool — the block handoff is
+            # an ownership transfer through the CoW refcounts, never a
+            # copy.  Geometry must match or the compiled graphs would
+            # gather garbage.
+            if (kv_cache.num_layers != cfg.num_layers
+                    or kv_cache.num_kv_heads != cfg.num_kv_heads
+                    or kv_cache.head_dim != cfg.head_dim
+                    or kv_cache.block_size != bs
+                    or kv_cache.dtype != self.params["embed"].dtype):
+                raise MXNetError(
+                    "kv_cache geometry mismatch: shared pool is "
+                    f"(layers={kv_cache.num_layers}, "
+                    f"kvh={kv_cache.num_kv_heads}, "
+                    f"hd={kv_cache.head_dim}, "
+                    f"bs={kv_cache.block_size}) vs this engine's "
+                    f"(layers={cfg.num_layers}, kvh={cfg.num_kv_heads},"
+                    f" hd={cfg.head_dim}, bs={bs})")
+            self.cache = kv_cache
+            self.cache_shared = True
+        else:
+            pool_sharding = None
+            if self._mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                from ..parallel.mesh import AXIS_TP
+                pool_sharding = NamedSharding(
+                    self._mesh,
+                    PartitionSpec(None, None, None, AXIS_TP, None))
+            self.cache = PagedKVCache(
+                cfg.num_layers, cfg.num_kv_heads, cfg.head_dim,
+                num_blocks=num_blocks, block_size=bs,
+                max_batch=self.max_batch,
+                dtype=self.params["embed"].dtype,
+                sharding=pool_sharding)
+            self.cache_shared = False
         self.temperature = float(temperature)
         self.top_k = int(top_k)
         self._base_key = jax.random.key(seed)
@@ -332,6 +402,115 @@ class InferenceEngine:
             params["head"] = self._proj_params(net.lm_head)
         return params
 
+    # -- tp sharding (ISSUE 18) ------------------------------------------
+
+    def _shard_params(self, params):
+        """Place the extracted weights on the tp submesh AT REST:
+        column-parallel projections (q/k/v/gate/up) shard their output
+        features, row-parallel ones (o/down) their input features —
+        the ``tensor_parallel.llama_engine_specs`` megatron table —
+        and embeddings/norms/head replicate.  Placement happens once
+        here; the AOT-lowered executables bake these input shardings
+        in, so a drifted layout fails loudly instead of resharding
+        silently per dispatch."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.tensor_parallel import llama_engine_specs
+        mesh = self._mesh
+        specs = llama_engine_specs()
+
+        def put(w, spec):
+            return jax.device_put(w, NamedSharding(mesh, spec))
+
+        layers = []
+        for lp in params["layers"]:
+            out = {"in_norm": put(lp["in_norm"], P(None)),
+                   "post_norm": put(lp["post_norm"], P(None))}
+            for name in ("q", "k", "v", "o", "gate", "up", "down"):
+                out[name] = {"w": put(lp[name]["w"], specs[name])}
+            layers.append(out)
+        sharded = {"embed": put(params["embed"], P(None, None)),
+                   "norm": put(params["norm"], P(None)),
+                   "layers": layers}
+        if "head" in params:
+            sharded["head"] = {"w": put(params["head"]["w"],
+                                        P(None, None))}
+        return sharded
+
+    def _row_proj(self, x, p):
+        """The o_proj/down_proj matmul on a tp submesh.  The incoming
+        activation is sharded on its feature axis (it is the paired
+        column-parallel outputs); plain megatron would contract the
+        SPLIT axis per shard and all-reduce the partials — but that
+        reassociates the fp32 K-sum and is measurably not bitwise the
+        unsharded gemm on this mesh.  Instead both the activation and
+        the (in-features-sharded) row weight are constrained replicated
+        IN-GRAPH: XLA's sharding algebra inserts all-gathers (pure
+        data movement, bit-preserving) and the gemm contracts the full
+        K axis exactly like the single-chip engine — the decode-parity
+        contract survives sharding bit-for-bit while the weights stay
+        sharded at rest (the HBM win) and every upstream matmul stays
+        genuinely column-parallel."""
+        if self._mesh is None:
+            return self._proj(x, p)
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep_x = NamedSharding(self._mesh, P(*([None] * x.ndim)))
+        rep_w = NamedSharding(self._mesh, P(None, None))
+        x = jax.lax.with_sharding_constraint(x, rep_x)
+        w = jax.lax.with_sharding_constraint(p["w"], rep_w)
+        return jnp.matmul(x, w.T)
+
+    def _gather_layer(self, lp):
+        """Replicate one decode layer's projection weights in-graph.
+        Prefill's big gemms stay genuinely column-parallel (full-K
+        contractions per output column are bitwise-safe), but decode's
+        (B, hid) gemvs are small enough that the partitioner regroups
+        them — so the decode/verify graphs gather weights instead
+        (decode is bandwidth-bound; the all-gather is bit-preserving
+        data movement and the gemv then matches the single-chip
+        engine exactly).  Weights stay sharded at rest either way."""
+        if self._mesh is None:
+            return lp
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self._mesh, P(None, None))
+        out = dict(lp)
+        for name in ("q", "k", "v", "o", "gate", "up", "down"):
+            p = dict(lp[name])
+            p["w"] = jax.lax.with_sharding_constraint(p["w"], rep)
+            out[name] = p
+        return out
+
+    def _gather_cache(self, ck, cv):
+        """Replicate the cache slices a decode step attends over.
+        ``_cache_attention`` merges the (sharded) head axis into a
+        flat batch axis; left sharded, the partitioner's regrouping of
+        that contraction drifts ~1e-7 from the single-chip recurrence.
+        An in-graph all-gather is pure data movement, so the attention
+        math stays bitwise the unsharded engine's."""
+        if self._mesh is None:
+            return ck, cv
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self._mesh, P(*([None] * ck.ndim)))
+        return (jax.lax.with_sharding_constraint(ck, rep),
+                jax.lax.with_sharding_constraint(cv, rep))
+
+    def _shard_pools(self, kp, vp):
+        """Constrain returned pools back to the at-rest kv-head
+        sharding so the donated round-trip hands the next dispatch the
+        layout its executable was lowered against."""
+        if self._mesh is None:
+            return kp, vp
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from ..parallel.mesh import AXIS_TP
+        s = NamedSharding(self._mesh, P(None, None, None, AXIS_TP, None))
+        return (jax.lax.with_sharding_constraint(kp, s),
+                jax.lax.with_sharding_constraint(vp, s))
+
     # -- graph building --------------------------------------------------
 
     @staticmethod
@@ -402,9 +581,9 @@ class InferenceEngine:
                 vr = jnp.repeat(v, rep, axis=1)
                 o = flash_attention(q, kr, vr, causal=True)
                 o = o.transpose(0, 2, 1, 3).reshape(1, L, h * d)
-                x = x + self._proj(o, lp["o"])
+                x = x + self._row_proj(o, lp["o"])
                 y = _rms(x, lp["post_norm"], eps)
-                x = x + self._proj(
+                x = x + self._row_proj(
                     jax.nn.silu(self._proj(y, lp["gate"])) *
                     self._proj(y, lp["up"]), lp["down"])
             x = _rms(x, params["norm"], eps)
@@ -416,6 +595,7 @@ class InferenceEngine:
             logits = self._head_logits(params, xs)[0]        # (_QPAD, V)
             last = jnp.take(logits, valid - 1 - start, axis=0)
             tok = self._sample(last[None, :], key)[0]
+            kp, vp = self._shard_pools(kp, vp)
             return last, tok, kp, vp
 
         return run
@@ -450,6 +630,7 @@ class InferenceEngine:
         off = pos % bs
         valid = jnp.arange(L)[None, :] <= pos[:, None]       # (B, L)
         for li, lp in enumerate(params["layers"]):
+            lp = self._gather_layer(lp)
             hh = _rms(x, lp["in_norm"], eps)
             q = self._proj(hh, lp["q"]).reshape(B, h, d)
             k = self._proj(hh, lp["k"]).reshape(B, kvh, d)
@@ -460,20 +641,23 @@ class InferenceEngine:
             vp = vp.at[li, blk, off].set(v)
             if self.paged_attn:
                 from ..ops.paged_attention import paged_decode_attention
-                o = paged_decode_attention(q, kp[li], vp[li], bts, pos,
+                kpl, vpl = self._gather_cache(kp[li], vp[li])
+                o = paged_decode_attention(q, kpl, vpl, bts, pos,
                                            scale)
             else:
                 ck = kp[li][bts].reshape(B, L, kvh, d) \
                     .transpose(0, 2, 1, 3)                   # (B,kvh,L,d)
                 cv = vp[li][bts].reshape(B, L, kvh, d) \
                     .transpose(0, 2, 1, 3)
+                ck, cv = self._gather_cache(ck, cv)
                 o = _cache_attention(q, ck, cv, valid, scale)
-            x = x + self._proj(o, lp["o"])
+            x = x + self._row_proj(o, lp["o"])
             y = _rms(x, lp["post_norm"], eps)
-            x = x + self._proj(
+            x = x + self._row_proj(
                 jax.nn.silu(self._proj(y, lp["gate"])) *
                 self._proj(y, lp["up"]), lp["down"])
         logits = self._head_logits(params, _rms(x, params["norm"], eps))
+        kp, vp = self._shard_pools(kp, vp)
         return logits, kp, vp
 
     def _build_decode(self, nbl):
@@ -618,9 +802,9 @@ class InferenceEngine:
                 o = attend(q.reshape(R * h, C, d), kr, vr, qpos)
                 o = o.reshape(R, h, C, d).transpose(0, 2, 1, 3) \
                     .reshape(R, C, h * d)
-                x = x + self._proj(o, lp["o"])
+                x = x + self._row_proj(o, lp["o"])
                 y = _rms(x, lp["post_norm"], eps)
-                x = x + self._proj(
+                x = x + self._row_proj(
                     jax.nn.silu(self._proj(y, lp["gate"])) *
                     self._proj(y, lp["up"]), lp["down"])
             x = _rms(x, params["norm"], eps)
@@ -628,6 +812,7 @@ class InferenceEngine:
             last = jnp.take_along_axis(
                 logits, jnp.clip(valids - 1, 0, C - 1)[:, None, None],
                 axis=1)[:, 0]                                # (R, V)
+            kp, vp = self._shard_pools(kp, vp)
             return last, self._sample(last, key), kp, vp
 
         return run
@@ -636,8 +821,8 @@ class InferenceEngine:
         """Copy-on-write block fork: duplicate one physical block's K/V
         (all layers) into a freshly allocated block, pools donated."""
         def run(kp, vp, src, dst):
-            return (kp.at[:, dst].set(kp[:, src]),
-                    vp.at[:, dst].set(vp[:, src]))
+            return self._shard_pools(kp.at[:, dst].set(kp[:, src]),
+                                     vp.at[:, dst].set(vp[:, src]))
         return run
 
     def _sample(self, logits, key):
@@ -661,9 +846,13 @@ class InferenceEngine:
     def _sig(self, kind, size):
         # paged_attn is part of the signature: the routing changes the
         # compiled graph body, so a SHARED cache (Router fleets) must
-        # never hand a paged executable to an inline engine or back
+        # never hand a paged executable to an inline engine or back.
+        # The mesh spec rides too (ISSUE 18): a tp-sharded executable
+        # bakes its input shardings in, so a shared cache must never
+        # serve it to an engine on a different submesh.
         return (kind, size, self.cache.num_blocks, self.max_batch,
-                self.block_size, self.paged_attn)
+                self.block_size, self.paged_attn,
+                self.mesh_config.describe())
 
     def _get(self, kind, size, args):
         """Compile-cache lookup keyed by (kind, shape-signature); every
@@ -871,7 +1060,10 @@ class InferenceEngine:
         cannot hold the prefix right now."""
         if self.prefix_cache is None:
             raise MXNetError("pin_prefix needs prefix_cache=True")
-        slot = ("__prefix_pin__", self.stats["prefill_calls"])
+        # id(self) namespaces the pin against OTHER engines on a shared
+        # pool (disaggregated fleet): two replicas pinning their first
+        # prefix must not collide on the same slot key
+        slot = ("__prefix_pin__", id(self), self.stats["prefill_calls"])
         if self.prefill(slot, tokens) is None:
             return False
         self.prefix_cache.insert(slot, tokens)

@@ -36,11 +36,13 @@ use.  Both modes run the same admission/death/requeue code.
 from __future__ import annotations
 
 import threading
+from collections import deque
 
-from ...base import MXNetError
+from ...base import MXNetError, NotSupportedError
 from ... import telemetry as _telem
 from ...telemetry import tracing as _trace
 from ...lint import racecheck as _racecheck
+from ..kv_cache import HandoffError
 from ..scheduler import ContinuousBatcher
 
 __all__ = ["Router", "Replica", "AdmissionShed"]
@@ -60,9 +62,9 @@ class Replica:
     (inbox hand-off, death flag)."""
 
     __slots__ = ("rid", "engine", "batcher", "alive", "inbox",
-                 "boundaries", "thread", "ttfts")
+                 "boundaries", "thread", "ttfts", "role", "tpots")
 
-    def __init__(self, rid, engine, batcher):
+    def __init__(self, rid, engine, batcher, role="combined"):
         self.rid = rid
         self.engine = engine
         self.batcher = batcher
@@ -71,6 +73,8 @@ class Replica:
         self.boundaries = 0      # scheduling boundaries stepped
         self.thread = None
         self.ttfts = []          # recent TTFTs (seconds) for scoring
+        self.role = role         # combined | prefill | decode
+        self.tpots = []          # recent TPOTs (seconds) for scaling
 
     def load_signals(self, inbox_len=0):
         """The raw admission signals, read directly off the replica —
@@ -100,15 +104,25 @@ class Router:
     engine_factory : callable(compile_cache_dict) -> InferenceEngine
         (unwarmed).  Called once per replica with the SHARED compile
         cache; the router warms each engine (replica 0 pays the
-        compiles, the rest reuse them).
+        compiles, the rest reuse them).  A DISAGGREGATED router calls
+        it as ``engine_factory(cc, kv_cache=shared_or_None)`` — the
+        first replica creates the pool, every later one must pass the
+        given ``kv_cache`` through to its ``InferenceEngine``.
     replicas : fleet size (>= 1); default ``MXTPU_SERVE_REPLICAS`` or 2.
     prefills_per_step : forwarded to each ContinuousBatcher.
     now : timestamp source for router events (FakeClock-injectable;
         never used for waiting — the router has no timeouts).
+    disaggregated : split the fleet into PREFILL-role and DECODE-role
+        replicas over ONE shared ``PagedKVCache`` (ISSUE 18): a prefill
+        replica fills a request's blocks, then hands ownership to a
+        decode replica through the CoW refcounts (adopt-then-release);
+        the autoscaler scales the pools independently (TTFT grows the
+        prefill pool, TPOT the decode pool).  Default reads
+        ``MXTPU_SERVE_DISAGG`` (unset/0 = off).  ``drive()`` only.
     """
 
     def __init__(self, engine_factory, replicas=None,
-                 prefills_per_step=1, now=None):
+                 prefills_per_step=1, now=None, disaggregated=None):
         import os
         import time
         if replicas is None:
@@ -118,6 +132,14 @@ class Router:
                 replicas = 2
         if replicas < 1:
             raise MXNetError(f"Router needs >= 1 replica, got {replicas}")
+        if disaggregated is None:
+            disaggregated = os.environ.get(
+                "MXTPU_SERVE_DISAGG", "") not in ("", "0")
+        self.disaggregated = bool(disaggregated)
+        if self.disaggregated and replicas < 2:
+            raise MXNetError(
+                "disaggregated serving needs >= 2 replicas (at least "
+                f"one prefill and one decode), got {replicas}")
         self._now = now if now is not None else time.time
         self._lock = _racecheck.make_lock("Router._lock")
         self._cond = threading.Condition(self._lock)
@@ -134,18 +156,57 @@ class Router:
         self._trace_ctx = None     # ambient span captured at start()
         self.compile_cache = {}
         self.replicas = []
+        self.handoffs = 0          # completed prefill->decode handoffs
+        self._shared_cache = None  # disagg: the fleet-wide PagedKVCache
         warm0 = None
         for rid in range(replicas):
-            eng = engine_factory(self.compile_cache)
+            role = self._role_for(rid)
+            eng = self._make_engine()
             before = eng.stats["compiles"]
             eng.warmup()
             if rid == 0:
                 warm0 = eng.stats["compiles"] - before
-            self.replicas.append(
-                Replica(rid, eng,
-                        ContinuousBatcher(eng, prefills_per_step)))
+            self.replicas.append(Replica(rid, eng,
+                                         self._make_batcher(eng, rid,
+                                                            role),
+                                         role=role))
         self.warmup_compiles = warm0 or 0
         self.warmup_compiles_shared = (replicas - 1) * (warm0 or 0)
+
+    def _role_for(self, rid):
+        """Disaggregated role placement: even rids prefill, odd rids
+        decode — every fleet of >= 2 has at least one of each, and the
+        autoscaler overrides per-pool via ``add_replica(role=...)``."""
+        if not self.disaggregated:
+            return "combined"
+        return "prefill" if rid % 2 == 0 else "decode"
+
+    def _make_engine(self):
+        """Build one replica engine through the stored factory.  In
+        disaggregated mode the factory is called with the fleet's
+        SHARED ``kv_cache`` (None for the first replica, which creates
+        the pool every later replica adopts) — block handoff is only
+        meaningful when both sides index the same pool."""
+        if not self.disaggregated:
+            return self._factory(self.compile_cache)
+        eng = self._factory(self.compile_cache,
+                            kv_cache=self._shared_cache)
+        if self._shared_cache is None:
+            self._shared_cache = eng.cache
+        elif eng.cache is not self._shared_cache:
+            raise HandoffError(
+                "disaggregated replicas must share one PagedKVCache — "
+                "the engine_factory ignored its kv_cache argument")
+        # the pool CREATOR's flag flips too: its pool outlives it (the
+        # fleet shares it), so its death must free its slots like any
+        # other disaggregated replica's
+        eng.cache_shared = True
+        return eng
+
+    def _make_batcher(self, eng, rid, role):
+        return ContinuousBatcher(
+            eng, self._prefills_per_step,
+            slot_ns=(rid if self.disaggregated else None), role=role)
 
     # -- membership ------------------------------------------------------
 
@@ -177,10 +238,26 @@ class Router:
         b = rep.batcher
         lost += list(b.queue)
         b.queue.clear()
+        # slots the dead replica still holds: with a per-replica pool
+        # they die with the engine, but a SHARED pool (disaggregated
+        # fleet) outlives the replica — every hold must be dropped or
+        # check_leaks on the survivors reports the dead replica's
+        # blocks forever
+        held_slots = (list(getattr(b, "prefilling", ()))
+                      + list(b.active)
+                      + [slot for slot, _req in
+                         getattr(b, "handoff_ready", ())])
         lost += [st.req for st in getattr(b, "prefilling", {}).values()]
         getattr(b, "prefilling", {}).clear()
         lost += list(b.active.values())
         b.active.clear()
+        lost += [req for _slot, req in getattr(b, "handoff_ready", ())]
+        getattr(b, "handoff_ready", deque()).clear()
+        if getattr(rep.engine, "cache_shared", False):
+            for slot in held_slots:
+                rep.engine.cache.free(slot)
+            if rep.engine.prefix_cache is not None:
+                rep.engine.prefix_cache.clear()
         return lost, epoch
 
     def _requeue_all(self, lost, from_rid=None):
@@ -214,6 +291,11 @@ class Router:
             raise MXNetError(
                 f"router: last replica died ({exc}); "
                 f"{len(lost)} request(s) unservable")
+        if self.disaggregated and not any(
+                r.role == rep.role for r in self.live_replicas()):
+            raise MXNetError(
+                f"router: last {rep.role}-role replica died ({exc}); "
+                f"the disaggregated fleet cannot serve without one")
         _telem.event("serving.replica_dead", rid=rep.rid,
                      epoch=epoch, requeued=len(lost))
         _telem.inc("serving.replica_deaths")
@@ -232,6 +314,13 @@ class Router:
             raise MXNetError(
                 f"router: refusing to drain replica {rid} — it is the "
                 f"last live replica (scale up or stop shedding first)")
+        if self.disaggregated and sum(
+                1 for r in self.live_replicas()
+                if r.role == rep.role) <= 1:
+            raise MXNetError(
+                f"router: refusing to drain replica {rid} — it is the "
+                f"last live {rep.role}-role replica (grow that pool "
+                "first)")
         lost, epoch = self._evacuate(rep, "replica_drained",
                                      {"reason": str(reason)})
         _telem.event("serving.replica_drained", rid=rep.rid,
@@ -241,29 +330,48 @@ class Router:
         self._requeue_all(lost, from_rid=rep.rid)
         return len(lost)
 
-    def add_replica(self):
+    def add_replica(self, role=None):
         """Grow the fleet by one replica (the autoscaler's grow path):
         built from the stored factory against the SHARED warmup compile
         cache (pool-geometry-keyed executables — the newcomer compiles
         nothing new for known shapes), epoch bump, worker thread
-        spawned when the fleet runs threaded."""
-        eng = self._factory(self.compile_cache)
+        spawned when the fleet runs threaded.  ``role`` targets a
+        disaggregated pool ("prefill" | "decode"); default grows the
+        smaller pool.  Non-disaggregated fleets reject explicit roles."""
+        if not self.disaggregated:
+            if role not in (None, "combined"):
+                raise MXNetError(
+                    f"add_replica(role={role!r}) needs a disaggregated "
+                    "router (role'd replicas share one KV pool)")
+            role = "combined"
+        elif role is None:
+            live = self.live_replicas()
+            n_pre = sum(1 for r in live if r.role == "prefill")
+            n_dec = sum(1 for r in live if r.role == "decode")
+            role = "prefill" if n_pre <= n_dec else "decode"
+        elif role not in ("prefill", "decode"):
+            raise MXNetError(
+                f"add_replica role {role!r} must be prefill|decode on "
+                "a disaggregated router")
+        eng = self._make_engine()
         eng.warmup()
         # self.replicas stays SINGLE-WRITER (the control loop that calls
         # add_replica) and append is atomic under the GIL; every
         # concurrent reader snapshots with list(self.replicas) — so the
         # list itself needs no lock, only the epoch/event bookkeeping
         rid = len(self.replicas)
-        rep = Replica(rid, eng,
-                      ContinuousBatcher(eng, self._prefills_per_step))
+        rep = Replica(rid, eng, self._make_batcher(eng, rid, role),
+                      role=role)
         threaded = any(r.thread is not None for r in self.replicas)
         self.replicas.append(rep)
         with self._lock:
             self.epoch += 1
             epoch = self.epoch
             self.events.append({"kind": "replica_added", "rid": rid,
-                                "epoch": epoch, "t": self._now()})
-        _telem.event("serving.replica_added", rid=rid, epoch=epoch)
+                                "epoch": epoch, "role": role,
+                                "t": self._now()})
+        _telem.event("serving.replica_added", rid=rid, epoch=epoch,
+                     role=role)
         _telem.inc("serving.replica_adds")
         if threaded:
             t = threading.Thread(target=self._worker, args=(rep,),
@@ -370,9 +478,11 @@ class Router:
                     "router is shedding new admissions (capacity below "
                     "the healthy target — degradation-ladder rung 1); "
                     "retry after capacity recovers")
-        live = self.live_replicas()
+        # decode-role replicas take work through block handoff, never
+        # direct admission — a fresh prompt always needs a prefill
+        live = [r for r in self.live_replicas() if r.role != "decode"]
         if not live:
-            raise MXNetError("router: no live replicas")
+            raise MXNetError("router: no live replicas that can admit")
         ta0 = _trace.clock() if _trace.enabled() else None
         sigs = [self._signals(r) for r in live]
         # null-honesty: only score on signal classes EVERY candidate
@@ -416,10 +526,15 @@ class Router:
         self._drain_inbox(rep)
         n_fin = len(rep.batcher.finished)
         moved = rep.batcher.step()
+        if rep.role == "prefill":
+            moved += self._drain_handoffs(rep)
         for req in rep.batcher.finished[n_fin:]:
             t = req.ttft()
             if t is not None:
                 rep.ttfts.append(t)
+            tp = req.tpot()
+            if tp is not None:
+                rep.tpots.append(tp)
         if tb0 is not None:
             # boundary span parents under the driver's ambient trace
             # (the worker thread activates the context captured at
@@ -440,12 +555,72 @@ class Router:
                                  round(sig["ttft_ms"], 3))
             _telem.set_gauge(pre + "kv_block_utilization",
                              round(sig["kv_block_utilization"], 4))
+            recent = rep.tpots[-8:]
+            if recent:
+                # same null-honesty as ttft_ms: absent until measured
+                _telem.set_gauge(
+                    pre + "tpot_ms",
+                    round(sorted(recent)[len(recent) // 2] * 1e3, 3))
+        return moved
+
+    def _pick_decode(self):
+        """Least-loaded live decode-role replica with a free batch
+        slot (None = the decode pool is saturated; the handoff entry
+        waits in the prefill outbox — pure backpressure, no loss)."""
+        cands = [r for r in self.live_replicas()
+                 if r.role == "decode" and r.batcher._free_slots]
+        if not cands:
+            return None
+        cands.sort(key=lambda r: (len(r.batcher.active), r.rid))
+        return cands[0]
+
+    def _drain_handoffs(self, rep):
+        """Move ``rep``'s finished prefills to decode-role replicas:
+        adopt-then-release over the SHARED pool's refcounts.  The fault
+        point fires BEFORE any mutation, so a replica killed mid-
+        handoff leaves the head entry wholly owned by the outbox — the
+        evacuation path requeues it exactly once (the chaos gate: zero
+        lost, zero duplicated).  Entries the decode pool cannot take
+        yet stay parked (retried next boundary)."""
+        from ...testing import faults
+        b = rep.batcher
+        moved = 0
+        while b.handoff_ready:
+            slot, req = b.handoff_ready[0]
+            tgt = self._pick_decode()
+            if tgt is None:
+                break
+            faults.fault_point(f"serving.replica{rep.rid}.handoff",
+                               payload=req.id)
+            t0 = _telem.clock() if _telem.enabled() else None
+            cache = rep.engine.cache
+            n = cache.seq_len(slot)
+            cache.trim(slot, n)   # drop bucket-padding past the prompt
+            dst = tgt.batcher.adopt_handoff(req, cache.table(slot), n)
+            if dst is None:
+                break
+            b.complete_handoff(slot)
+            b.handoff_ready.popleft()
+            with self._lock:
+                self._assigned[req.id] = tgt.rid
+                self.handoffs += 1
+            moved += 1
+            if t0 is not None:
+                _telem.inc("serving.handoffs")
+                _telem.observe("serving.handoff_ms",
+                               (_telem.clock() - t0) * 1e3)
+            if _trace.enabled():
+                t = _trace.clock()
+                _trace.record("handoff", t, t, parent=req.trace,
+                              from_rid=rep.rid, to_rid=tgt.rid,
+                              blocks=len(cache.table(dst)))
         return moved
 
     def _replica_idle(self, rep):
         b = rep.batcher
         return not (rep.inbox or b.queue or b.active
-                    or getattr(b, "prefilling", None))
+                    or getattr(b, "prefilling", None)
+                    or getattr(b, "handoff_ready", None))
 
     def drive(self, max_boundaries=100000):
         """Deterministic mode: round-robin every live replica until all
@@ -479,6 +654,12 @@ class Router:
         """Spawn one worker thread per replica (production shape).
         Each worker owns its replica exclusively; it sleeps on the
         router condition variable when idle (no polling)."""
+        if self.disaggregated:
+            raise NotSupportedError(
+                "threaded disaggregated serving is not supported yet: "
+                "the block handoff crosses two replicas' batchers, "
+                "which breaks the one-owner-thread-per-replica "
+                "discipline — use drive()")
         self._trace_ctx = _trace.capture()
         for rep in self.replicas:
             if rep.thread is not None:
@@ -578,9 +759,13 @@ class Router:
             epoch = self.epoch
         return {
             "epoch": epoch,
+            "disaggregated": self.disaggregated,
             "replicas": [{
                 "rid": r.rid,
                 "alive": r.alive,
+                "role": r.role,
+                "cache_shared": getattr(r.engine, "cache_shared",
+                                        False),
                 "mesh": r.engine.mesh_config.describe(),
                 "max_batch": r.engine.max_batch,
                 "block_size": r.engine.block_size,
@@ -607,11 +792,14 @@ class Router:
 
         per_replica = []
         total_caw = 0
+        pool_occ = {"prefill": [], "decode": []}
         for r in self.replicas:
             occ = r.batcher.occupancy()
             total_caw += r.engine.stats["compiles_after_warmup"]
+            if r.role in pool_occ and occ is not None:
+                pool_occ[r.role].append(occ)
             per_replica.append({
-                "rid": r.rid, "alive": r.alive,
+                "rid": r.rid, "alive": r.alive, "role": r.role,
                 "requests": len(r.batcher.finished),
                 "boundaries": r.boundaries,
                 "occupancy": round(occ, 4) if occ is not None else None,
@@ -621,11 +809,21 @@ class Router:
         with self._lock:
             epoch, requeues = self.epoch, self.requeues
             shedding = self._shedding
+            handoffs = self.handoffs
+
+        def _pool(vals):
+            # None, not 0.0, until a pool member measured something
+            return round(sum(vals) / len(vals), 4) if vals else None
+
         return {"replicas": len(self.replicas),
                 "live": len(self.live_replicas()),
                 "epoch": epoch,
+                "disaggregated": self.disaggregated,
                 "requests": len(fin),
                 "requeues": requeues,
+                "handoffs": handoffs,
+                "prefill_pool_occupancy": _pool(pool_occ["prefill"]),
+                "decode_pool_occupancy": _pool(pool_occ["decode"]),
                 "shedding": shedding,
                 "p50_latency_s": pct(0.50), "p99_latency_s": pct(0.99),
                 "compiles_after_warmup": total_caw,

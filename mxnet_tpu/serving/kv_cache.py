@@ -43,13 +43,23 @@ import numpy as _np
 from ..base import MXNetError
 from ..lint import donation as _donation
 
-__all__ = ["PagedKVCache", "DoubleFreeError"]
+__all__ = ["PagedKVCache", "DoubleFreeError", "HandoffError"]
 
 
 class DoubleFreeError(MXNetError):
     """A block refcount went below zero or a slot was freed twice —
     the host-side block accounting is corrupt and continuing would
     hand one sequence's KV memory to another."""
+
+
+class HandoffError(MXNetError):
+    """A paged-KV block handoff between replicas violated the
+    ownership protocol (ISSUE 18 disaggregated prefill/decode): the
+    adopting side must take its reference BEFORE the releasing side
+    drops its own (adopt-then-release), both sides must share one
+    physical pool, and every handed-off block must carry >= 2 holders
+    at the instant of release.  Anything else would let a decode
+    replica read blocks the free list already recycled."""
 
 
 class PagedKVCache:
@@ -63,10 +73,13 @@ class PagedKVCache:
     block_size : tokens per block (power of two; decode context buckets
         are multiples of it).
     max_batch : decode slots (sequences resident at once).
+    sharding : optional ``jax.sharding.Sharding`` the pools are placed
+        with at rest (ISSUE 18 tp serving shards the kv-head axis of
+        the engine's submesh); None keeps single-device pools.
     """
 
     def __init__(self, num_layers, num_kv_heads, head_dim, num_blocks=64,
-                 block_size=16, max_batch=4, dtype=None):
+                 block_size=16, max_batch=4, dtype=None, sharding=None):
         import jax.numpy as jnp
         if block_size < 1 or (block_size & (block_size - 1)):
             raise MXNetError("block_size must be a power of two, got "
@@ -82,8 +95,16 @@ class PagedKVCache:
         self.max_batch = max_batch
         self.dtype = dtype or jnp.float32
         shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
-        self.k_pool = jnp.zeros(shape, self.dtype)
-        self.v_pool = jnp.zeros(shape, self.dtype)
+        self.sharding = sharding
+        if sharding is not None:
+            import jax
+            self.k_pool = jax.device_put(jnp.zeros(shape, self.dtype),
+                                         sharding)
+            self.v_pool = jax.device_put(jnp.zeros(shape, self.dtype),
+                                         sharding)
+        else:
+            self.k_pool = jnp.zeros(shape, self.dtype)
+            self.v_pool = jnp.zeros(shape, self.dtype)
         # LIFO free list: freshly freed blocks are reused first (warm)
         self._free = list(range(num_blocks - 1, 0, -1))
         self._tables = {}        # slot -> [physical block ids]

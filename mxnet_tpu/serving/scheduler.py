@@ -40,6 +40,20 @@ whose drafts keep missing stops drafting for a cooldown window
 (per-sequence fallback — it rides the same dispatch as a plain 1-token
 row), and a boundary where no row drafts runs the plain decode graph.
 
+Disaggregated prefill/decode (ISSUE 18): a batcher can be built with a
+``role`` — ``"prefill"`` admits prompts and parks the finished-prefill
+requests in a ``handoff_ready`` outbox instead of decoding them;
+``"decode"`` never admits from its queue and instead ``adopt_handoff``\ s
+requests whose KV blocks were filled by a prefill-role peer over the
+SAME :class:`~.kv_cache.PagedKVCache`.  The handoff rides the CoW
+refcount machinery: the decode side refs every block FIRST (adopt), the
+prefill side releases its slot SECOND (``complete_handoff``, which
+insists every block still shows the adopter's hold) — a crash between
+the two leaves blocks over-held (requeue-able), never freed early.
+Engines over a shared pool namespace their slots (``slot_ns``) so slot
+keys cannot collide.  Protocol violations raise the typed
+:class:`~.kv_cache.HandoffError`.
+
 Everything here is host-side policy: per-token device work is exactly
 one compiled decode step; the only host pull per boundary is the sampled
 token vector (needed to detect EOS and admit/evict — the serving
@@ -56,6 +70,7 @@ from .. import telemetry as _telem
 from ..telemetry import tracing as _trace
 from ..telemetry import watchdog as _watchdog
 from .draft import DraftSource
+from .kv_cache import HandoffError
 
 __all__ = ["Request", "ContinuousBatcher", "StaticBatcher"]
 
@@ -100,10 +115,32 @@ class Request:
             return None
         return self.first_token_t - self.submit_t
 
+    def tpot(self):
+        """Time per output token AFTER the first (the decode-pool
+        latency signal the autoscaler scales on; None until a second
+        token exists to measure)."""
+        if (self.first_token_t is None or self.finish_t is None
+                or len(self.generated) < 2):
+            return None
+        return (self.finish_t - self.first_token_t) \
+            / (len(self.generated) - 1)
+
 
 class _BatcherBase:
-    def __init__(self, engine):
+    def __init__(self, engine, slot_ns=None, role="combined"):
+        if role not in ("combined", "prefill", "decode"):
+            raise MXNetError(f"batcher role {role!r} must be "
+                             "combined|prefill|decode")
         self.engine = engine
+        # slot namespace: engines sharing one PagedKVCache (the
+        # disaggregated fleet) must not collide on slot keys — slots
+        # are opaque hashables, so a namespaced slot is (ns, i)
+        self.slot_ns = slot_ns
+        self.role = role
+        # prefill-role outbox: requests whose prompt is fully cached in
+        # a slot THIS batcher still owns, awaiting block handoff to a
+        # decode-role peer (the router drains it every boundary)
+        self.handoff_ready = deque()
         self.queue = deque()
         self.finished = []
         # per-boundary occupancy samples: active slots / max_batch
@@ -269,12 +306,13 @@ class ContinuousBatcher(_BatcherBase):
     _spec_miss_limit = 2
 
     def __init__(self, engine, prefills_per_step=1, speculative=None,
-                 spec_k=None):
-        super().__init__(engine)
+                 spec_k=None, slot_ns=None, role="combined"):
+        super().__init__(engine, slot_ns=slot_ns, role=role)
         self.prefills_per_step = int(prefills_per_step)
         self.active = {}          # slot -> Request
         self.prefilling = {}      # slot -> _PrefillState (chunked only)
-        self._free_slots = list(range(engine.max_batch - 1, -1, -1))
+        self._free_slots = [self._slot(i)
+                            for i in range(engine.max_batch - 1, -1, -1)]
         # speculative decoding (ISSUE 17): defaults follow the engine
         # (which reads MXTPU_SPEC_DECODE / MXTPU_SPEC_K)
         self.speculative = engine.spec_decode if speculative is None \
@@ -290,6 +328,58 @@ class ContinuousBatcher(_BatcherBase):
         self.draft = DraftSource(prefix_cache=engine.prefix_cache)
         self._spec_state = {}     # req.id -> [misses, cooldown]
 
+    def _slot(self, i):
+        """Slot keys are opaque hashables end-to-end (engine, cache,
+        traces); a namespaced batcher mints ``(ns, i)`` so two engines
+        over one SHARED pool can never collide."""
+        return i if self.slot_ns is None else (self.slot_ns, i)
+
+    def _stage_or_activate(self, slot, req):
+        """A freshly prefilled, unfinished request either joins the
+        decode batch (combined role) or parks in the handoff outbox —
+        the slot and its blocks stay owned by THIS batcher until a
+        decode-role peer adopts them (adopt-then-release)."""
+        if self.role == "prefill":
+            self.handoff_ready.append((slot, req))
+        else:
+            self.active[slot] = req
+
+    def adopt_handoff(self, req, blocks, n_tokens):
+        """Decode-role entry seam: adopt a prefilled request whose KV
+        ``blocks`` (covering ``n_tokens`` positions) live in the SHARED
+        pool.  Each block gains a holder BEFORE the prefill side drops
+        its own (the adopt-then-release protocol — a crash between the
+        two leaves blocks over-held, never freed early).  Returns the
+        new slot, or None when no batch slot is free (backpressure:
+        the entry stays in the prefill outbox)."""
+        if self.role != "decode":
+            raise HandoffError(
+                f"adopt_handoff on a {self.role!r}-role batcher — only "
+                "decode-role replicas adopt prefill handoffs")
+        if not self._free_slots:
+            return None
+        slot = self._free_slots[-1]
+        self.engine.cache.adopt(slot, blocks, n_tokens)
+        self._free_slots.pop()
+        self.active[slot] = req
+        return slot
+
+    def complete_handoff(self, slot):
+        """Prefill-role exit seam: release ``slot`` AFTER the decode
+        side adopted its blocks.  Every block must still show the
+        adopter's hold (refcount >= 2) — releasing sole-held blocks
+        here would free live KV mid-handoff, the exact leak class the
+        typed error names."""
+        cache = self.engine.cache
+        for blk in cache.table(slot):
+            if cache.refcount(blk) < 2:
+                raise HandoffError(
+                    f"complete_handoff({slot!r}): block {blk} has "
+                    f"{cache.refcount(blk)} holder(s) — the decode side "
+                    "must adopt before the prefill side releases")
+        self.engine.release(slot)
+        self._free_slots.append(slot)
+
     def step(self):
         """One scheduling boundary: admit queued requests (one packed
         chunk dispatch when chunked, else up to ``prefills_per_step``
@@ -300,6 +390,14 @@ class ContinuousBatcher(_BatcherBase):
             admitted = self._admit_chunked()
         else:
             admitted = self._admit_serial()
+        if self.role == "prefill":
+            # the prefill pool's saturation signal: admissions this
+            # boundary + prompts mid-chunk, over the batch (TTFT
+            # pressure makes the autoscaler grow THIS pool)
+            self.occupancy_samples.append(min(
+                1.0, (admitted + len(self.prefilling))
+                / self.engine.max_batch))
+            return admitted
         if not self.active:
             return admitted
         before = set(self.active)
@@ -430,7 +528,7 @@ class ContinuousBatcher(_BatcherBase):
             if req.done:                    # finished inside prefill
                 self._free_slots.append(slot)
             else:
-                self.active[slot] = req
+                self._stage_or_activate(slot, req)
         return admitted
 
     def _admit_chunked(self):
@@ -514,11 +612,16 @@ class ContinuousBatcher(_BatcherBase):
             if req.done:
                 self._free_slots.append(slot)
             else:
-                self.active[slot] = req
+                self._stage_or_activate(slot, req)
         return admitted + len(entries)
 
     def run(self, max_steps=100000):
         """Drive until queue and batch are empty."""
+        if self.role != "combined":
+            raise MXNetError(
+                f"run() drives a combined-role batcher; a "
+                f"{self.role!r}-role batcher only makes progress under "
+                "a Router that drains its handoffs")
         steps = 0
         while self.queue or self.active or self.prefilling:
             moved = self.step()

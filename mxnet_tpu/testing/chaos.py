@@ -54,6 +54,17 @@ must leave a parseable flight-recorder dump, racecheck must report
 zero findings, and the surviving replica's KV pool must pass the leak
 sweep (prefix-chain holds accounted).
 
+``python -m mxnet_tpu.testing.chaos disagg`` runs the DISAGGREGATED
+prefill/decode scenario (ISSUE 18): a 4-replica fleet (prefill rids
+0/2, decode rids 1/3) over ONE shared ``PagedKVCache`` serves a mixed
+prompt set; a prefill replica is killed mid-handoff via the
+``serving.replica0.handoff`` fault point (between "prefill finished"
+and "decode adopted" — the worst spot for the adopt-then-release
+block-ownership protocol) and, in a second pass, a decode replica is
+killed at a scheduling boundary.  Every request must finish exactly
+once with the solo combined-role token stream, zero compiles after
+warmup, and the shared pool must pass the leak sweep on the survivors.
+
 ``python -m mxnet_tpu.testing.chaos autoscale`` (or ``tools/
 tpu_queue_runner.py --chaos autoscale``) runs the PRODUCTION-ELASTICITY
 scenario (ISSUE 13), deterministic on the CPU mesh with a FakeClock and
@@ -89,7 +100,7 @@ the rule, the merged histograms must equal the element-wise per-rank
 bucket sums bitwise, and racecheck must report zero findings on the
 collector locks.
 
-``python -m mxnet_tpu.testing.chaos all`` runs all six suites.
+``python -m mxnet_tpu.testing.chaos all`` runs all seven suites.
 """
 from __future__ import annotations
 
@@ -716,6 +727,103 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
 
 
 # ----------------------------------------------------------------------
+# Disaggregated prefill/decode scenario (ISSUE 18): paged-KV block
+# handoff over ONE shared pool survives a replica killed mid-handoff.
+# ----------------------------------------------------------------------
+
+def run_disagg_scenario(n_requests=6, kill_rid=0, kill_point="handoff",
+                        kill_at=2, workdir=None):
+    """Kill one replica of a 4-replica DISAGGREGATED fleet (prefill
+    rids 0/2, decode rids 1/3, ONE shared ``PagedKVCache``) while
+    ``n_requests`` requests are in flight.  ``kill_point="handoff"``
+    trips the ``serving.replica{rid}.handoff`` fault point — the kill
+    lands BETWEEN "prefill finished" and "decode adopted", the worst
+    spot for the adopt-then-release block-ownership protocol — and
+    ``"step"`` kills at a plain scheduling boundary (pass an odd
+    ``kill_rid`` to kill a decode-role replica).  Every request must
+    finish exactly once with the solo combined-role token stream, and
+    the SHARED pool must pass the leak sweep on the survivors (the dead
+    replica's slot holds evacuated, zero blocks stranded).
+    Deterministic: drive() mode, FakeClock, zero sleeps."""
+    from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
+                                   Request, Router)
+    from mxnet_tpu.testing import faults
+
+    rc = _racecheck_arm()
+    dc = _donation_arm()
+    clock = faults.FakeClock(5000.0)
+    net = _serving_net()
+    rng = _np.random.RandomState(18)
+    prompts = [rng.randint(0, 64, (3 + i % 5,)).tolist()
+               for i in range(n_requests)]
+    result = {"kind": "disagg", "requests": n_requests,
+              "kill_rid": kill_rid, "kill_point": kill_point,
+              "kill_at": kill_at}
+
+    # solo combined-role reference: one engine, one batcher, no fleet —
+    # the stream the disaggregated path must reproduce bit-for-bit
+    solo = ContinuousBatcher(InferenceEngine(
+        net, max_batch=2, block_size=8, num_blocks=32,
+        max_context=32).warmup())
+    solo_reqs = [solo.submit(Request(p, max_new_tokens=4))
+                 for p in prompts]
+    solo.run()
+    refs = [list(r.generated) for r in solo_reqs]
+
+    def factory(compile_cache, kv_cache=None):
+        return InferenceEngine(net, max_batch=2, block_size=8,
+                               num_blocks=32, max_context=32,
+                               compile_cache=compile_cache,
+                               kv_cache=kv_cache)
+
+    router = Router(factory, replicas=4, disaggregated=True, now=clock)
+    reqs = [Request(p, max_new_tokens=4) for p in prompts]
+    for r in reqs:
+        router.submit(r)
+    with faults.inject(f"serving.replica{kill_rid}.{kill_point}",
+                       at=kill_at):
+        router.drive()
+    fin = router.finished()
+    result["finished"] = len(fin)
+    result["epoch"] = router.epoch
+    result["requeues"] = router.requeues
+    result["handoffs"] = router.handoffs
+    result["no_lost_or_dup"] = (
+        sorted(r.id for r in fin) == sorted(r.id for r in reqs)
+        and len(fin) == len(reqs))
+    result["outputs_match_solo"] = all(
+        list(r.generated) == ref for r, ref in zip(reqs, refs))
+    st = router.stats()
+    result["compiles_after_warmup"] = st["compiles_after_warmup"]
+    result["prefill_pool_occupancy"] = st["prefill_pool_occupancy"]
+    result["decode_pool_occupancy"] = st["decode_pool_occupancy"]
+    result["flight_dump"] = _flight_check(expect_kind="fault.trip")
+    # leak sweep on the ONE shared pool: every request finished and the
+    # dead replica's holds evacuated, so zero blocks may remain (the
+    # scenario runs without prefix chains — no legitimate holders)
+    leaks_ok = True
+    try:
+        router._shared_cache.check_leaks(holders=0)
+    except Exception as e:  # noqa: BLE001 — verdict, not crash
+        leaks_ok = False
+        result["leak_error"] = f"{type(e).__name__}: {e}"
+    result["kv_leaks_clean"] = leaks_ok
+    fd = result["flight_dump"]
+    result["racecheck"] = _racecheck_verdict(rc)
+    rcv = result["racecheck"]
+    result["donation"] = _donation_verdict(dc)
+    dcv = result["donation"]
+    result["ok"] = bool(
+        result["no_lost_or_dup"] and result["outputs_match_solo"]
+        and result["epoch"] >= 1 and result["requeues"] >= 1
+        and result["handoffs"] >= 1
+        and result["compiles_after_warmup"] == 0 and leaks_ok
+        and (fd is None or fd["ok"]) and (rcv is None or rcv["ok"])
+        and (dcv is None or dcv["ok"]))
+    return result
+
+
+# ----------------------------------------------------------------------
 # Production-elasticity scenario (ISSUE 13): preemption notice -> drain
 # -> shrink under load -> notice revoked -> load-driven grow back, with
 # bitwise parity at each dp; serving replica drained by notice with
@@ -1170,6 +1278,13 @@ def main(argv=None):
                         for kind in ("shrink", "grow", "reshard_fault")]
         if suite in ("serving", "all"):
             results.append(run_serving_scenario(workdir=workdir))
+        if suite in ("disagg", "all"):
+            # prefill replica killed mid-handoff, then a decode replica
+            # killed at a plain boundary — both over the shared pool
+            results.append(run_disagg_scenario(workdir=workdir))
+            results.append(run_disagg_scenario(
+                kill_rid=1, kill_point="step", kill_at=3,
+                workdir=workdir))
         if suite in ("autoscale", "all"):
             results.append(run_autoscale_scenario(workdir=workdir))
         if suite in ("watchdog", "all"):

@@ -322,6 +322,9 @@ _SERVING_KEYS = {
     # ISSUE 17 speculative-decoding fields
     "speculative", "paged_attn", "spec_accept_rate",
     "tokens_per_dispatch",
+    # ISSUE 18 sharded/disaggregated fleet fields
+    "tp_shards", "disaggregated", "handoff_ms",
+    "prefill_pool_occupancy", "decode_pool_occupancy",
 }
 
 
@@ -334,13 +337,16 @@ def test_serving_block_schema_is_stable():
               "tokens_s_chip", "occupancy", "tokens_per_step",
               "compiles_after_warmup", "cache_utilization",
               "prefix_hit_rate", "router_p99_ms", "spec_accept_rate",
-              "tokens_per_dispatch"):
+              "tokens_per_dispatch", "handoff_ms",
+              "prefill_pool_occupancy", "decode_pool_occupancy"):
         assert blk[k] is None, k
     # CONFIG fields are always real (front-end off by default)
     assert blk["chunked_prefill"] is False
     assert blk["router_replicas"] == 0
     assert blk["speculative"] is False
     assert blk["paged_attn"] is False
+    assert blk["tp_shards"] == 0
+    assert blk["disaggregated"] is False
     # measured values round-trip, rounded
     blk2 = serving_block(p99_ms=12.3456, tokens_s_chip=901.239,
                          occupancy=0.87654, compiles_after_warmup=0,
@@ -348,7 +354,11 @@ def test_serving_block_schema_is_stable():
                          prefix_hit_rate=0.98765, router_p99_ms=77.7777,
                          speculative=True, paged_attn=True,
                          spec_accept_rate=0.61239,
-                         tokens_per_dispatch=2.71828)
+                         tokens_per_dispatch=2.71828,
+                         tp_shards=2, disaggregated=True,
+                         handoff_ms=0.12345,
+                         prefill_pool_occupancy=0.43219,
+                         decode_pool_occupancy=0.87654)
     assert blk2["p99_ms"] == 12.346
     assert blk2["tokens_s_chip"] == 901.2
     assert blk2["occupancy"] == 0.8765
@@ -361,6 +371,11 @@ def test_serving_block_schema_is_stable():
     assert blk2["paged_attn"] is True
     assert blk2["spec_accept_rate"] == 0.6124
     assert blk2["tokens_per_dispatch"] == 2.718
+    assert blk2["tp_shards"] == 2
+    assert blk2["disaggregated"] is True
+    assert blk2["handoff_ms"] == 0.123
+    assert blk2["prefill_pool_occupancy"] == 0.4322
+    assert blk2["decode_pool_occupancy"] == 0.8765
     assert json.loads(json.dumps(blk)) == blk
 
 
@@ -387,13 +402,18 @@ def test_serving_compact_keys_surface_when_measured():
         requests=32, p50_ms=41.2, p99_ms=88.7, tokens_s=9120.4,
         tokens_s_chip=9120.4, occupancy=0.91, tokens_per_step=7.3,
         compiles_after_warmup=0, chunked_prefill=True,
-        router_replicas=4, prefix_hit_rate=0.97, router_p99_ms=92.3)
+        router_replicas=4, prefix_hit_rate=0.97, router_p99_ms=92.3,
+        tp_shards=2, disaggregated=True, handoff_ms=0.42,
+        prefill_pool_occupancy=0.55, decode_pool_occupancy=0.83)
     obj = _assert_headline(bench._compact_line(p))
     assert obj["serve_tok_s"] == 9120.4
     assert obj["serve_p99_ms"] == 88.7
     assert obj["serve_occupancy"] == 0.91
     assert obj["serve_prefix_hit"] == 0.97
     assert obj["router_p99_ms"] == 92.3
+    assert obj["serve_handoff_ms"] == 0.42
+    assert obj["serve_prefill_occ"] == 0.55
+    assert obj["serve_decode_occ"] == 0.83
 
 
 def test_serving_nulls_stay_out_of_headline():
@@ -407,6 +427,9 @@ def test_serving_nulls_stay_out_of_headline():
     assert "serve_occupancy" not in obj
     assert "serve_prefix_hit" not in obj
     assert "router_p99_ms" not in obj
+    assert "serve_handoff_ms" not in obj
+    assert "serve_prefill_occ" not in obj
+    assert "serve_decode_occ" not in obj
 
 
 # ----------------------------------------------------------------------

@@ -68,6 +68,9 @@ def test_llama_causality():
     assert not np.allclose(o1[0, -1], o2[0, -1])
 
 
+# slow-marked (ISSUE 18 tier-1 headroom): tp/cp training parity stays
+# covered by test_ring_equals_flash + test_parallel/test_mesh3d
+@pytest.mark.slow
 def test_llama_tp_cp_mesh_train():
     """dp x tp x sp fused jitted step on the 8-device CPU mesh."""
     import jax
@@ -122,6 +125,9 @@ def test_gqa_head_counts():
     assert attn.k_proj.weight.shape[0] == 1 * 8
 
 
+# slow-marked (ISSUE 18 tier-1 headroom): cached-decode parity stays
+# covered by test_serving's per-bucket bitwise prefill/decode gates
+@pytest.mark.slow
 def test_generate_kv_cache_matches_full_forward():
     """KV-cache lax.scan decode must reproduce the naive greedy loop
     (full-prefix forward each step) token for token."""

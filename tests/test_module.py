@@ -117,6 +117,9 @@ def test_bucketing_module_varlen():
 
 @pytest.mark.skipif(len(__import__("jax").devices()) < 8,
                     reason="needs 8 virtual devices")
+@pytest.mark.slow   # slow-marked (ISSUE 18 tier-1 headroom): legacy
+# Module-API dp split; the gluon/parallel dp paths (test_mesh3d,
+# test_data_parallel) keep multi-device execution tier-1
 def test_module_multi_device_data_parallel():
     """ctx=[cpu(0)..cpu(7)] forms a dp mesh: params replicated, batch
     sharded — the DataParallelExecutorGroup role (reference

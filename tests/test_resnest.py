@@ -54,6 +54,9 @@ def test_resnest_tiny_end_to_end():
     assert out.shape == (2, 10)
 
 
+# slow-marked (ISSUE 18 tier-1 headroom): zoo registration/forwards
+# stay covered by the detection name sweep + resnest unit tests
+@pytest.mark.slow
 def test_resnest_zoo_registration():
     net = vision.get_model("resnest50", classes=7)
     assert isinstance(net, ResNeSt)

@@ -506,20 +506,31 @@ def test_replica_ttft_gauge_absent_until_measured(net):
 # ----------------------------------------------------------------------
 
 def test_engine_typed_tp_rejection_and_mesh_recorded(net):
+    # a STRUCTURALLY tensor-parallel net (cfg.tensor_parallel) is still
+    # typed-rejected: the engine shards plain weights itself (ISSUE 18)
     cfg = LlamaConfig(vocab_size=32, hidden_size=16, num_layers=1,
                       num_heads=2, num_kv_heads=2, intermediate_size=32,
                       tensor_parallel=True)
     with pytest.raises(NotSupportedError) as ei:
         InferenceEngine(LlamaForCausalLM(cfg))
-    assert "item-2" in str(ei.value)                 # names the follow-up
-    # a tp/pp mesh is typed-rejected too; a dp mesh is recorded
+    assert "MeshConfig" in str(ei.value)   # names the supported path
+    # a pp mesh is typed-rejected; dp AND tp meshes are recorded
     with pytest.raises(NotSupportedError):
-        InferenceEngine(net, mesh="dp1tp2")
+        InferenceEngine(net, mesh="dp1tp1pp2")
     eng = InferenceEngine(net, max_batch=3, block_size=8,
                           max_context=32, mesh="dp4",
                           compile_cache=_CC)
     assert eng.mesh_config.describe() == "dp4"
     assert eng.mesh_config.dp == 4
+    # ISSUE 18: a tp submesh is ACCEPTED — weights sharded at rest, the
+    # mesh spec in the compile-cache signature (no warmup here: init
+    # must stay compile-free)
+    eng2 = InferenceEngine(net, max_batch=2, block_size=8,
+                           max_context=32, mesh="dp1tp2",
+                           compile_cache={})
+    assert eng2.mesh_config.tp == 2 and eng2.tp == 2
+    assert eng2.mesh_config.describe() in \
+        eng2._sig("decode", 1)
 
 
 def test_lifecycle_gauges_present(net):

@@ -1,0 +1,221 @@
+"""Sharded serving on tp submeshes (ISSUE 18, tentpole A).
+
+The acceptance bar is BITWISE: an ``InferenceEngine(mesh="dp1tpN")``
+must produce the exact fp32 logits of the unsharded engine, for every
+graph family (prefill, decode, chunked prefill, speculative verify),
+at tp=2 AND tp=4, with zero compiles after warmup — the sharding is a
+placement change, not a math change.  Prefill's big gemms stay
+genuinely column-parallel (full-K contractions are bit-preserving);
+the decode/verify graphs gather weights in-graph because the
+partitioner regroups their tiny gemvs (see engine._gather_layer).
+
+Runs on the simulated 8-device CPU mesh (tests/conftest.py).
+"""
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                 LlamaForCausalLM)
+from mxnet_tpu.serving import ContinuousBatcher, InferenceEngine, Request
+
+# one net per kv-head count, built lazily and shared across the module
+# (the engines below share compile caches per (mesh, family) so every
+# graph compiles exactly once for the whole file)
+_NETS = {}
+
+
+def _net(kvh):
+    if kvh not in _NETS:
+        cfg = LlamaConfig(vocab_size=64, hidden_size=32, num_layers=2,
+                          num_heads=4, num_kv_heads=kvh,
+                          intermediate_size=64, max_seq_len=64,
+                          tie_embeddings=True)
+        net = LlamaForCausalLM(cfg)
+        net.initialize()
+        net(mx.nd.array(np.zeros((1, 8), np.int32)))
+        net.hybridize()
+        _NETS[kvh] = net
+    return _NETS[kvh]
+
+
+_ENGINES = {}
+# ONE compile cache per NET (the signature keys on engine config +
+# mesh spec, not model shape — engines over different nets must not
+# share): each distinct graph family compiles exactly once per net
+_CCS = {}
+
+
+def _pair(tp, **kw):
+    """(unsharded, tp-sharded) engine pair over the same net, warmed.
+    Cached per config so each test reuses the compiled graphs."""
+    key = (tp,) + tuple(sorted(kw.items()))
+    if key not in _ENGINES:
+        net = _net(kvh=tp)   # kv_heads must divide by tp
+        base = dict(max_batch=2, block_size=8, num_blocks=16,
+                    max_context=32,
+                    compile_cache=_CCS.setdefault(tp, {}))
+        base.update(kw)
+        ref = InferenceEngine(net, **base).warmup()
+        shd = InferenceEngine(net, mesh=f"dp1tp{tp}", **base).warmup()
+        _ENGINES[key] = (ref, shd)
+    return _ENGINES[key]
+
+
+def _drive(eng, prompt, steps):
+    """Full-prompt prefill + ``steps`` greedy decodes; returns every
+    logits array the engine produced."""
+    t, l = eng.prefill("s", prompt)
+    outs = [np.asarray(l)]
+    pos, tok = len(prompt), t
+    for _ in range(steps):
+        assert eng.reserve("s", pos)
+        nt, lg = eng.decode([("s", tok, pos)])
+        outs.append(np.asarray(lg))
+        tok, pos = int(nt[0]), pos + 1
+    eng.release("s")
+    return outs
+
+
+@pytest.mark.parametrize("tp", [2, 4])
+def test_tp_bitwise_parity_all_buckets(tp):
+    """Prefill + decode logits BITWISE vs unsharded, across prompt
+    lengths spanning every bucket, and zero compiles after warmup."""
+    ref, shd = _pair(tp)
+    rng = np.random.RandomState(0)
+    for slen in (3, 12, 20):   # one prompt per bucket (8, 16, 32)
+        prompt = rng.randint(0, 64, (slen,))
+        a = _drive(ref, prompt, steps=4)
+        b = _drive(shd, prompt, steps=4)
+        for i, (x, y) in enumerate(zip(a, b)):
+            assert np.array_equal(x, y), \
+                f"tp={tp} len={slen} out{i}: not bitwise " \
+                f"(maxdiff={np.abs(x - y).max():.3e})"
+    assert shd.stats["compiles_after_warmup"] == 0
+    assert ref.stats["compiles_after_warmup"] == 0
+
+
+def test_tp_mesh_in_compile_cache_signature():
+    """Sharded and unsharded layouts must never collide in a shared
+    compile cache: the mesh spec is part of the signature."""
+    ref, shd = _pair(2)
+    assert shd.mesh_config.describe() in shd._sig("decode", 1)
+    assert ref._sig("decode", 1) != shd._sig("decode", 1)
+
+
+def test_tp_pool_sharded_on_kv_head_axis():
+    """The paged KV pools live sharded on the kv-head axis (axis 3 of
+    (layers, blocks, block_size, kv_heads, head_dim))."""
+    from mxnet_tpu.parallel.mesh import AXIS_TP
+    _, shd = _pair(2)
+    spec = shd.cache.k_pool.sharding.spec
+    # PartitionSpec drops trailing Nones: axes 0-2 replicated, axis 3
+    # (kv_heads) on the tp axis, axis 4 (head_dim) replicated
+    assert tuple(spec) == (None, None, None, AXIS_TP)
+    assert shd.cache.v_pool.sharding.spec == spec
+
+
+# the chunked+paged config pays a warmup compile bill per engine; the
+# two tests below each warm ONE side (lazily, order-stable under
+# -p no:randomly) so neither lands over the tier-1 duration budget
+_CHUNK_OUTS = {}
+
+
+def _chunk_outputs(which):
+    if which not in _CHUNK_OUTS:
+        base = dict(max_batch=2, block_size=8, num_blocks=16,
+                    max_context=32, prefill_chunk=8, paged_attn=True,
+                    compile_cache=_CCS.setdefault(2, {}))
+        mesh = {} if which == "ref" else {"mesh": "dp1tp2"}
+        eng = InferenceEngine(_net(kvh=2), **mesh, **base).warmup()
+        rng = np.random.RandomState(1)
+        outs = [_drive(eng, rng.randint(0, 64, (slen,)), steps=3)
+                for slen in (5, 11, 20)]
+        _CHUNK_OUTS[which] = (eng, outs)
+    return _CHUNK_OUTS[which]
+
+
+def test_tp_chunked_paged_reference_stream():
+    """Unsharded half of the chunked+paged parity pair: the reference
+    streams exist and its warmup covered every dispatched graph."""
+    eng, outs = _chunk_outputs("ref")
+    assert len(outs) == 3 and all(len(o) == 4 for o in outs)
+    assert eng.stats["compiles_after_warmup"] == 0
+
+
+def test_tp_chunked_prefill_and_paged_attn_bitwise():
+    """Chunked prefill + the Pallas-path paged decode attention compose
+    with the tp submesh, still bitwise the unsharded streams."""
+    _, ref_outs = _chunk_outputs("ref")
+    shd, shd_outs = _chunk_outputs("shd")
+    for a, b in zip(ref_outs, shd_outs):
+        for x, y in zip(a, b):
+            assert np.array_equal(x, y)
+    assert shd.stats["compiles_after_warmup"] == 0
+
+
+def test_tp_speculative_verify_bitwise():
+    """The K-at-a-time verify graph (ISSUE 17) on the sharded engine:
+    bitwise the unsharded verify, zero compiles after warmup.
+    spec_k=1 keeps the warmup bill to the single W=2 bucket (the wider
+    buckets are the same graph at other shapes — tier-1 budget)."""
+    ref, shd = _pair(2, spec_decode=True, spec_k=1)
+    rng = np.random.RandomState(2)
+    prompt = rng.randint(0, 64, (9,))
+    outs = []
+    for eng in (ref, shd):
+        t, _ = eng.prefill("s", prompt)
+        assert eng.reserve("s", 9, n=2)
+        out = eng.verify([("s", [int(t), 3], 9)])
+        outs.append(np.asarray(out))
+        eng.release("s")
+    assert np.array_equal(outs[0], outs[1])
+    assert shd.stats["compiles_after_warmup"] == 0
+
+
+def test_tp_batcher_mixed_traffic_caw_zero():
+    """Continuous batching over the sharded engine: same token streams
+    as the unsharded batcher, zero compiles once warmed."""
+    ref, shd = _pair(2)
+    prompts = [list(np.random.RandomState(10 + i).randint(
+        0, 64, (3 + i % 4,))) for i in range(5)]
+    streams = []
+    for eng in (ref, shd):
+        b = ContinuousBatcher(eng)
+        reqs = [b.submit(Request(list(p), max_new_tokens=4))
+                for p in prompts]
+        b.run()
+        streams.append([list(r.generated) for r in reqs])
+    assert streams[0] == streams[1]
+    assert shd.stats["compiles_after_warmup"] == 0
+
+
+def test_serve_tp_env_knob_and_default_inert():
+    """MXTPU_SERVE_TP: unset (or <=1) leaves the engine EXACTLY on the
+    unsharded path — no mesh, same compile signature; set to N>1 it
+    builds the tp submesh without code changes."""
+    import os
+    net = _net(2)
+    kw = dict(max_batch=2, block_size=8, num_blocks=16, max_context=32,
+              compile_cache={})
+    old = os.environ.pop("MXTPU_SERVE_TP", None)
+    try:
+        eng = InferenceEngine(net, **kw)
+        assert eng.tp == 1 and eng._mesh is None
+        plain_sig = eng._sig("decode", 1)
+        os.environ["MXTPU_SERVE_TP"] = "1"
+        assert InferenceEngine(net, **kw)._sig("decode", 1) == plain_sig
+        os.environ["MXTPU_SERVE_TP"] = "2"
+        eng2 = InferenceEngine(net, **kw)
+        assert eng2.tp == 2 and eng2._mesh is not None
+        assert eng2._sig("decode", 1) != plain_sig
+        # an explicit mesh always wins over the env knob
+        eng3 = InferenceEngine(net, mesh="dp1", **kw)
+        assert eng3.tp == 1
+    finally:
+        if old is None:
+            os.environ.pop("MXTPU_SERVE_TP", None)
+        else:
+            os.environ["MXTPU_SERVE_TP"] = old

@@ -422,6 +422,42 @@ def test_bench_projection_plumbs_measured_sweep():
     assert "input_feed_cap" not in out2["inputs"]
 
 
+def test_bench_projection_host_core_slope_derates_feed_cap():
+    """ISSUE 18 satellite: the host core scale-up is de-rated by the
+    MEASURED thread-scaling slope (marginal img/s per added thread over
+    the 1-thread img/s), computed only from in-core sweep points —
+    oversubscribed points measure contention, not parallelism."""
+    import bench
+    resnet = {"batch": 128, "value": 2000.0}
+    rec = {"input_pipeline": {"host_cores": 4, "decode_thread_sweep": [
+        {"threads": 1, "img_s": 100.0}, {"threads": 2, "img_s": 190.0},
+        {"threads": 4, "img_s": 340.0}, {"threads": 8, "img_s": 360.0}]}}
+    out = bench._scaling_projection(resnet, rec)
+    assert "error" not in out
+    inp = out["inputs"]
+    # slope across in-core points (1..4): (340-100)/(4-1) = 80 img/s per
+    # thread; the 8-thread point (past the 4 cores) must NOT drag it
+    # down to (360-100)/7
+    assert inp["host_thread_slope_img_s"] == 80.0
+    assert inp["host_parallel_efficiency"] == 0.8
+    # core scale uses the cores recorded WITH the sweep, not this box's
+    assert abs(inp["host_core_scale"] - 112.0 / 4) < 1e-9
+    # supply = best * core_scale * par_eff; demand = 4 chips * 2000
+    cap = inp["input_feed_cap"]
+    assert abs(cap - min(1.0, 360.0 * 28.0 * 0.8 / 8000.0)) < 1e-6
+
+    # single in-core point (1-core host): the efficiency is unmeasurable
+    # and the projection DISCLOSES the linearity assumption instead of
+    # silently assuming it
+    rec1 = {"input_pipeline": {"host_cores": 1, "decode_thread_sweep": [
+        {"threads": 1, "img_s": 410.0}, {"threads": 4, "img_s": 500.0}]}}
+    out1 = bench._scaling_projection(resnet, rec1)
+    assert "error" not in out1
+    assert out1["inputs"]["host_parallel_efficiency"] \
+        == "unmeasured: linear core scaling ASSUMED"
+    assert "host_thread_slope_img_s" not in out1["inputs"]
+
+
 # ----------------------------------------------------------------------
 # tools/telemetry_dump.py (ISSUE 9): flight-dump/snapshot rendering +
 # the live PS-server scrape path — tier-1 smoke
@@ -522,6 +558,47 @@ def test_bench_diff_direction_awareness(tmp_path):
     faster["extra"]["serving"]["p99_ms"] = 20.0
     f = _write(tmp_path, "f.json", faster)
     assert bench_diff.main([o, f, "--fail-on-regression", "10",
+                            "--quiet"]) == 0
+
+
+def test_bench_diff_disagg_field_directions(tmp_path):
+    """ISSUE 18 serving fields: handoff_ms gates when it GROWS, pool
+    occupancies gate when they SHRINK; tp_shards is config — a resharded
+    fleet is a changed knob, never a regression."""
+    from tools import bench_diff
+    assert bench_diff.direction("extra.serving.handoff_ms") == "down"
+    assert bench_diff.direction(
+        "extra.serving.prefill_pool_occupancy") == "up"
+    assert bench_diff.direction(
+        "extra.serving.decode_pool_occupancy") == "up"
+    old = _bench_payload()
+    old["extra"]["serving"]["handoff_ms"] = 0.2
+    old["extra"]["serving"]["decode_pool_occupancy"] = 0.9
+    old["extra"]["serving"]["tp_shards"] = 2
+    o = _write(tmp_path, "o.json", old)
+    worse = _bench_payload()
+    worse["extra"]["serving"]["handoff_ms"] = 0.6
+    worse["extra"]["serving"]["decode_pool_occupancy"] = 0.9
+    worse["extra"]["serving"]["tp_shards"] = 2
+    n = _write(tmp_path, "n.json", worse)
+    # handoff latency tripled -> gates
+    assert bench_diff.main([o, n, "--fail-on-regression", "10",
+                            "--quiet"]) == 1
+    starved = _bench_payload()
+    starved["extra"]["serving"]["handoff_ms"] = 0.2
+    starved["extra"]["serving"]["decode_pool_occupancy"] = 0.4
+    starved["extra"]["serving"]["tp_shards"] = 2
+    n2 = _write(tmp_path, "n2.json", starved)
+    # decode pool idling (occupancy halved) -> gates
+    assert bench_diff.main([o, n2, "--fail-on-regression", "10",
+                            "--quiet"]) == 1
+    resharded = _bench_payload()
+    resharded["extra"]["serving"]["handoff_ms"] = 0.2
+    resharded["extra"]["serving"]["decode_pool_occupancy"] = 0.9
+    resharded["extra"]["serving"]["tp_shards"] = 8
+    n3 = _write(tmp_path, "n3.json", resharded)
+    # only the tp_shards knob changed -> clean exit
+    assert bench_diff.main([o, n3, "--fail-on-regression", "10",
                             "--quiet"]) == 0
 
 

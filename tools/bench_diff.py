@@ -57,7 +57,7 @@ _SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
               "steps_per_call", "s2d_stem", "n", "rc", "cmd", "tail",
               "time", "cached_at", "dp", "buckets", "epoch",
               "membership_epoch", "transitions", "ranks",
-              "slowest_rank"}
+              "slowest_rank", "tp_shards"}
 
 
 def direction(key):

@@ -19,6 +19,12 @@ Usage:
   python tools/serve_loadgen.py --smoke --speculative  # draft/verify
       decoding on the continuous policy (outputs bitwise unchanged;
       reports acceptance rate + tokens per dispatch, ISSUE 17)
+  python tools/serve_loadgen.py --smoke --disagg --replicas 4  # split
+      the fleet into prefill/decode pools over one shared KV pool,
+      reporting handoffs + per-pool occupancy (ISSUE 18)
+  python tools/serve_loadgen.py --smoke --replicas 2 --tp 2  # shard
+      every replica's weights + KV pool on a tp submesh (ISSUE 18;
+      outputs bitwise unchanged)
 """
 from __future__ import annotations
 
@@ -77,32 +83,53 @@ def _requests(n, vocab, seed=0):
 
 
 def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
-                       max_context=64, smoke=True, replicas=2, seed=0):
+                       max_context=64, smoke=True, replicas=2, seed=0,
+                       disaggregated=False, tp=0):
     """The ISSUE 12 fleet benchmark: a deterministic shared-system-
     prompt mix through ``replicas`` engine replicas behind one Router
     (prefix cache + chunked prefill on, shared warmup compile cache,
     deterministic drive).  Returns the bench `serving` payload with the
     front-end fields measured: prefix hit rate, per-replica occupancy,
-    router p50/p99."""
+    router p50/p99.  ISSUE 18: ``disaggregated`` splits the fleet into
+    prefill/decode pools over ONE shared KV pool (paged-block handoff);
+    ``tp > 1`` shards every replica's weights + KV pool on a tp submesh
+    (outputs bitwise unchanged either way — the benchmark measures the
+    placement, not the math)."""
     import numpy as np
+    from mxnet_tpu import telemetry
     from mxnet_tpu.serving import InferenceEngine, Request, Router, \
         serving_block
+    mesh = None
+    if tp and tp > 1:
+        from mxnet_tpu.parallel import MeshConfig
+        mesh = MeshConfig(tp=tp)
     net, cfg = _build_net(smoke)
     rng = np.random.RandomState(seed)
     sys_prompt = rng.randint(0, cfg.vocab_size,
                              (_SYS_PROMPT_LEN,)).tolist()
 
-    def factory(compile_cache):
+    # a disaggregated fleet shares ONE pool across every replica's
+    # slots (plus the prefix pins), so the creator sizes it fleet-wide;
+    # per-replica pools keep the engine's own default
+    num_blocks = (1 + replicas * (max_batch + 1)
+                  * (max_context // block_size)
+                  if disaggregated else None)
+
+    def factory(compile_cache, kv_cache=None):
         return InferenceEngine(net, max_batch=max_batch,
                                block_size=block_size,
                                max_context=max_context,
+                               num_blocks=num_blocks,
                                prefill_chunk=2 * block_size,
-                               prefix_cache=True,
-                               compile_cache=compile_cache)
+                               prefix_cache=True, mesh=mesh,
+                               compile_cache=compile_cache,
+                               kv_cache=kv_cache)
 
-    router = Router(factory, replicas=replicas)
+    router = Router(factory, replicas=replicas,
+                    disaggregated=disaggregated)
     for rep in router.replicas:
-        rep.engine.pin_prefix(sys_prompt)
+        if rep.role != "decode":   # decode-role replicas never prefill
+            rep.engine.pin_prefix(sys_prompt)
     reqs = []
     for i in range(n_requests):
         user = rng.randint(0, cfg.vocab_size,
@@ -140,17 +167,25 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
             if r["occupancy"] is not None]) else None),
         compiles_after_warmup=st["compiles_after_warmup"],
         chunked_prefill=True, router_replicas=replicas,
-        prefix_hit_rate=hit_rate, router_p99_ms=_ms(st["p99_latency_s"]))
+        prefix_hit_rate=hit_rate, router_p99_ms=_ms(st["p99_latency_s"]),
+        tp_shards=(tp if tp and tp > 1 else 0),
+        disaggregated=bool(st.get("disaggregated")),
+        handoff_ms=(telemetry.value("serving.handoff_ms")
+                    if telemetry.enabled() else None),
+        prefill_pool_occupancy=st.get("prefill_pool_occupancy"),
+        decode_pool_occupancy=st.get("decode_pool_occupancy"))
     return {"metric": "serve_loadgen", "mode": "router",
             "smoke": bool(smoke), "serving": blk,
             "router": {
                 "epoch": st["epoch"], "requeues": st["requeues"],
+                "handoffs": st.get("handoffs", 0),
                 "prompt_tokens_computed": computed,
                 "prefix_hit_tokens": hit_tokens,
                 "warmup_compiles_shared":
                     router.warmup_compiles_shared,
                 "per_replica": [
-                    {"rid": r["rid"], "requests": r["requests"],
+                    {"rid": r["rid"], "role": r.get("role"),
+                     "requests": r["requests"],
                      "occupancy": r["occupancy"]}
                     for r in st["per_replica"]],
             }}
@@ -158,21 +193,31 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
 
 def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                 mode="both", smoke=True, quantize=None, seed=0,
-                replicas=0, speculative=False):
+                replicas=0, speculative=False, disaggregated=False,
+                tp=0):
     """Run the mix through the chosen scheduling policy(ies); returns
     the bench `serving` payload.  ``replicas >= 1`` switches to the
     router fleet benchmark (:func:`run_router_loadgen`).
     ``speculative`` turns on draft/verify decoding for the CONTINUOUS
     policy (greedy acceptance is bitwise, so the comparison still
-    measures scheduling, now in tokens-per-dispatch)."""
+    measures scheduling, now in tokens-per-dispatch).
+    ``disaggregated``/``tp`` are the ISSUE 18 fleet shapes (router
+    benchmark only; ``disaggregated`` implies ``replicas >= 2``)."""
     from mxnet_tpu import telemetry
     from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
                                    StaticBatcher, serving_block)
+    if disaggregated and replicas < 2:
+        replicas = 2
     if replicas:
         return run_router_loadgen(
             n_requests=n_requests, max_batch=max_batch,
             block_size=block_size, max_context=max_context,
-            smoke=smoke, replicas=replicas, seed=seed)
+            smoke=smoke, replicas=replicas, seed=seed,
+            disaggregated=disaggregated, tp=tp)
+    mesh = None
+    if tp and tp > 1:
+        from mxnet_tpu.parallel import MeshConfig
+        mesh = MeshConfig(tp=tp)
     results = {}
     paged = False
     for policy in (("continuous", "static") if mode == "both"
@@ -192,7 +237,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         # graph compiles
         engine = InferenceEngine(net, max_batch=max_batch,
                                  block_size=block_size,
-                                 max_context=max_context,
+                                 max_context=max_context, mesh=mesh,
                                  spec_decode=(speculative and
                                               policy == "continuous"),
                                  **kw)
@@ -258,7 +303,8 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         cache_utilization=cont.get("cache_utilization"),
         speculative=bool(speculative), paged_attn=paged,
         spec_accept_rate=cont.get("spec_accept_rate"),
-        tokens_per_dispatch=cont.get("tokens_per_dispatch"))
+        tokens_per_dispatch=cont.get("tokens_per_dispatch"),
+        tp_shards=(tp if tp and tp > 1 else 0))
     payload = {"metric": "serve_loadgen", "mode": mode,
                "smoke": bool(smoke), "serving": blk,
                "policies": {k: {kk: vv for kk, vv in v.items()
@@ -311,8 +357,24 @@ def main(argv=None):
                     help="draft/verify decoding on the continuous "
                          "policy (greedy outputs unchanged; reports "
                          "acceptance rate + tokens per dispatch)")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated prefill/decode fleet: split "
+                         "the router replicas into prefill and decode "
+                         "pools over ONE shared KV pool (paged-block "
+                         "handoff; implies --replicas >= 2)")
+    ap.add_argument("--tp", type=int, default=0,
+                    help="N>1: shard weights + KV pool on a tp=N "
+                         "submesh (outputs bitwise unchanged)")
     args = ap.parse_args(argv)
     smoke = args.smoke
+    if args.tp and args.tp > 1 and smoke:
+        # standalone smoke runs need the simulated device mesh; must be
+        # set before the first jax import (all imports here are lazy)
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     n = args.requests if args.requests is not None else (12 if smoke
                                                          else 64)
     payload = run_loadgen(
@@ -321,7 +383,8 @@ def main(argv=None):
         max_context=args.max_context or (64 if smoke else 512),
         mode=args.mode, smoke=smoke,
         quantize="int8" if args.int8 else None,
-        replicas=args.replicas, speculative=args.speculative)
+        replicas=args.replicas, speculative=args.speculative,
+        disaggregated=args.disagg, tp=args.tp)
     out = json.dumps(payload)
     if len(out) > 1800:      # the driver tail-window contract
         slim = dict(payload)
