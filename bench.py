@@ -829,6 +829,48 @@ def _bench_fleet() -> dict:
         scrape_dead=len(snap.get("dead") or []))
 
 
+MULTIPROC_SCHEMA_VERSION = 1
+
+
+def _bench_multiproc() -> dict:
+    """Multi-process pod evidence (ISSUE 19): the process-level runtime
+    config plus its measured recovery costs.  The bench runs in ONE
+    process, so the measured fields (``coordinator_reinit_ms``,
+    ``sigkill_recover_ms``) ship null unless THIS process actually went
+    through a reshard (``pod.coordinator_reinit_ms`` is the gauge
+    ``_dist_init.reinit_distributed`` fills via the pod worker) — the
+    null-when-unmeasured honesty rule.  The correctness evidence lives
+    in the real-process chaos suite (``tools/tpu_queue_runner.py
+    --chaos procs``): SIGKILL mid-run, survivors at the smaller
+    ``jax.process_count()``, bitwise resume from the shared
+    checkpoint."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.kvstore.rpc import RetryPolicy
+    import jax
+    pol = RetryPolicy.from_env()
+    blk = {
+        "multiproc_schema_version": MULTIPROC_SCHEMA_VERSION,
+        "procs": int(os.environ.get("MXTPU_NUM_PROCESSES", "1") or 1),
+        "world_size": int(jax.process_count()),
+        "rpc_retries": pol.retries,
+        "rpc_timeout_s": pol.timeout_s,
+        "coordinator_reinit_ms": None,
+        "sigkill_recover_ms": None,
+    }
+    if telemetry.enabled():
+        v = telemetry.value("pod.coordinator_reinit_ms")
+        if v is not None:
+            blk["coordinator_reinit_ms"] = v
+        v = telemetry.value("pod.sigkill_recover_ms")
+        if v is not None:
+            blk["sigkill_recover_ms"] = v
+    if blk["procs"] <= 1:
+        blk["note"] = ("single process: recovery costs unmeasured "
+                       "in-process; correctness evidence: "
+                       "tools/tpu_queue_runner.py --chaos procs")
+    return blk
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -993,6 +1035,11 @@ def _run_bench() -> dict:
             result["extra"]["lint"] = _bench_lint()
         except Exception as e:  # noqa: BLE001
             result["extra"]["lint"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["multiproc"] = _bench_multiproc()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["multiproc"] = {
                 "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)

@@ -113,6 +113,10 @@ class ElasticController:
         #: callable(step) run sync BEFORE a notice-driven drain commits
         #: (checkpoint-then-reshard; estimator.fit wires its own saver)
         self.drain_checkpoint = drain_checkpoint
+        # ISSUE 19: process-level coordinator re-init (attach_dist_reinit)
+        self._dist_reinit = None
+        self.dist_reinits = 0
+        self.last_reinit_ms = None
         # observability (the bench `elastic` block + tests)
         self.transitions = 0
         self.drains = 0
@@ -138,6 +142,21 @@ class ElasticController:
         noticed rank is seen at a boundary, instead of waiting for
         ``PSServer._scan_dead``)."""
         self._notices = board
+        return self
+
+    def attach_dist_reinit(self, fn):
+        """ISSUE 19: the process-level coordinator re-init seam.  When
+        attached, a COMMITTED membership change (epoch bump) makes
+        ``resync`` call ``fn(epoch, ranks)`` BEFORE rebuilding the mesh
+        — the hook tears down and re-initializes the JAX coordination
+        service at the new world size (see
+        ``_dist_init.reinit_distributed``; a real death changes
+        ``jax.process_count()``) and returns the new device list (or
+        None to keep the current one).  Every live device buffer dies
+        with the old backend, so the transition is forced onto the
+        checkpoint-restore reshard path — the peer transfer has nothing
+        left to read."""
+        self._dist_reinit = fn
         return self
 
     def attach_ladder(self, ladder):
@@ -278,6 +297,31 @@ class ElasticController:
         from ..parallel.mesh import AXIS_DP as _AXIS_DP
         t_pause = time.perf_counter()
         joiner = self._membership.pending_join
+        force_ckpt = False
+        if self._dist_reinit is not None \
+                and self._membership.epoch != self._applied_epoch:
+            # ISSUE 19: a REAL membership change — tear down + re-init
+            # the JAX coordination service at the new world size before
+            # any mesh math (jax.process_count() and the device pool
+            # both change under us).  The old backend's buffers are
+            # gone, so the peer-transfer reshard has nothing to read:
+            # force the checkpoint-restore path.
+            t_r = time.perf_counter()
+            devices = self._dist_reinit(self._membership.epoch,
+                                        sorted(self._membership.ranks))
+            self.last_reinit_ms = round(
+                (time.perf_counter() - t_r) * 1e3, 3)
+            self.dist_reinits += 1
+            if devices is not None:
+                self._devices = list(devices)
+            force_ckpt = True
+            if _telem.enabled():
+                _telem.inc("elastic.dist_reinits")
+                _telem.set_gauge("elastic.coordinator_reinit_ms",
+                                 self.last_reinit_ms)
+                _telem.event("elastic.dist_reinit",
+                             epoch=self._membership.epoch,
+                             reinit_ms=self.last_reinit_ms)
         capacity = self.target_dp()
         new_dp = capacity if self._requested_dp is None \
             else max(1, min(self._requested_dp, capacity))
@@ -330,7 +374,7 @@ class ElasticController:
         t0 = time.perf_counter()
         info = None
         last_err = None
-        for attempt in range(1 + self._max_retries):
+        for attempt in range(0 if force_ckpt else 1 + self._max_retries):
             try:
                 info = _ckpt.reshard_in_place(trainer, mesh,
                                               params=params or self._net,
@@ -351,9 +395,11 @@ class ElasticController:
                     trainer, mesh, params=params or self._net,
                     manager=self._manager)
             except MXNetError as e:
+                peer = ("skipped (dist reinit: buffers died with the "
+                        "old backend)" if force_ckpt else last_err)
                 raise MXNetError(
                     f"elastic reshard failed on both paths — peer: "
-                    f"{last_err}; checkpoint: {e}") from e
+                    f"{peer}; checkpoint: {e}") from e
         if joiner is not None and \
                 self._membership.pending_join == joiner:
             # state transfer done: commit the join (epoch bump)
@@ -429,5 +475,7 @@ class ElasticController:
                 "pause_ms": self.last_pause_ms,
                 "drain_ms": self.last_drain_ms,
                 "drains": self.drains,
+                "dist_reinits": self.dist_reinits,
+                "coordinator_reinit_ms": self.last_reinit_ms,
                 "pending_notices": (len(self._notices.pending())
                                     if self._notices is not None else 0)}

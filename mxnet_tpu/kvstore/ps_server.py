@@ -596,29 +596,53 @@ class PSClient:
     """Worker-side connection to ONE server (the ps::KVWorker role; the
     kvstore owns one client per server and routes by key_to_server)."""
 
-    def __init__(self, host, port, retries=60):
+    def __init__(self, host, port, retries=60, policy=None):
+        from .rpc import RetryPolicy, PeerUnreachable, report_failure
+        self._policy = policy if policy is not None \
+            else RetryPolicy.from_env()
+        self._addr = (host, port)
+        self._lock = _racecheck.make_lock("PSClient._lock")
+        self._hb_stop = None      # threading.Event while beating
         last = None
         for _ in range(retries):
             try:
-                self._sock = socket.create_connection((host, port),
-                                                      timeout=120)
-                # connect timeout must NOT become the RPC timeout: async
-                # workers legitimately block in barrier()/pull() for as
-                # long as the slowest worker takes (reference ps-lite
-                # blocks indefinitely; liveness is the launcher's job)
-                self._sock.settimeout(None)
+                self._connect(self._policy.timeout_s or 120)
                 break
             except OSError as e:     # server thread may start a bit later
                 last = e
                 time.sleep(0.25)
         else:
-            raise ConnectionError(f"cannot reach PS at {host}:{port}: "
-                                  f"{last}")
-        self._lock = _racecheck.make_lock("PSClient._lock")
-        self._addr = (host, port)
-        self._hb_stop = None      # threading.Event while beating
+            err = PeerUnreachable(
+                f"cannot reach PS at {host}:{port}: {last}",
+                peer=f"{host}:{port}", op="connect", attempts=retries)
+            report_failure(err)
+            raise err
 
-    def _rpc(self, payload):
+    def _connect(self, timeout_s):
+        """(Re)open the RPC socket.  The connect deadline must NOT
+        become a standing RPC timeout: async workers legitimately block
+        in barrier()/pull() for as long as the slowest worker takes
+        (reference ps-lite blocks indefinitely) — per-call deadlines are
+        applied around each exchange in :meth:`_rpc` instead.  The
+        blocking connect runs OUTSIDE the client lock (a slow peer must
+        not stall other threads); only the socket swap itself is locked
+        — the socket IS the locked RPC channel, and a reconnect racing
+        another thread's in-flight exchange would otherwise swap it out
+        from under a half-read frame."""
+        new = socket.create_connection(self._addr,
+                                       timeout=timeout_s or 120)
+        new.settimeout(None)
+        with self._lock:
+            old = getattr(self, "_sock", None)
+            self._sock = new
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+
+    def _rpc(self, payload, blocking=False):
+        op_name = _OP_NAMES.get(payload[0], f"op{payload[0]}")
         # cross-worker trace stitching (ISSUE 15): when this thread has
         # an ambient span, prefix its (trace, span) ids so the server's
         # handling span discloses the remote parent — a push/pushpull/
@@ -629,13 +653,41 @@ class PSClient:
         if sp is not None and sp.span is not None:
             payload = bytes([_OP_CTX]) + struct.pack(
                 "<qq", int(sp.trace), int(sp.span)) + payload
-        # the lock IS the RPC channel: one request/response pair in
-        # flight per socket, so the wire round necessarily happens with
-        # it held — callers that must not stall (heartbeats) use their
-        # own socket (start_heartbeat), exactly because of this
-        with self._lock:
-            _send_frame(self._sock, payload)  # mxlint: disable=HB16 -- the lock serializes this socket; see above
-            resp = _recv_frame(self._sock)
+
+        def _attempt(timeout_s):
+            # the lock IS the RPC channel: one request/response pair in
+            # flight per socket, so the wire round necessarily happens
+            # with it held — callers that must not stall (heartbeats)
+            # use their own socket (start_heartbeat), exactly because of
+            # this
+            with self._lock:
+                try:
+                    self._sock.settimeout(None if blocking else timeout_s)
+                    _send_frame(self._sock, payload)  # mxlint: disable=HB16 -- the lock serializes this socket; see above
+                    return _recv_frame(self._sock)
+                finally:
+                    try:
+                        self._sock.settimeout(None)
+                    except OSError:
+                        pass
+
+        if blocking:
+            # barrier() blocks for as long as the slowest worker takes
+            # (reference ps-lite semantics) and is NOT idempotent — a
+            # resent arrival would double-count at the server — so it
+            # runs single-attempt with no deadline; a dead peer there is
+            # the heartbeat detector's job (barriers abort typed on a
+            # declared-dead rank).
+            from .rpc import classify as _classify
+            try:
+                resp = _attempt(None)
+            except (ConnectionError, EOFError, OSError) as e:
+                raise _classify(e, peer="%s:%s" % self._addr,
+                                op=op_name, attempts=1) from e
+        else:
+            resp = self._policy.run(
+                _attempt, peer="%s:%s" % self._addr, op=op_name,
+                reconnect=self._connect)
         op = resp[0]
         if op == _OP_OK:
             return None
@@ -676,7 +728,7 @@ class PSClient:
         return self._rpc(bytes([_OP_CMDLOG]))
 
     def barrier(self):
-        return self._rpc(bytes([_OP_BARRIER]))
+        return self._rpc(bytes([_OP_BARRIER]), blocking=True)
 
     def join(self, rank, epoch):
         """Announce this worker as a joiner carrying the newest
@@ -715,11 +767,21 @@ class PSClient:
         :meth:`start_heartbeat` thread).  Honors the
         ``ps.heartbeat.drop`` fault point — an armed drop simulates a
         silent worker without killing anything.  Returns False when the
-        beat was dropped."""
+        beat was dropped, or when the transport failed transiently — a
+        missed beat is the heartbeat DETECTOR's job to judge, not a
+        reason to crash the worker (ISSUE 19), so typed transport errors
+        are swallowed and counted (``rpc.heartbeat.dropped``)."""
         from ..testing import faults as _faults
+        from .rpc import RPCError
         if _faults.fault_point("ps.heartbeat.drop", rank) == "drop":
             return False
-        self._rpc(bytes([_OP_HEARTBEAT]) + struct.pack("<i", int(rank)))
+        try:
+            self._rpc(bytes([_OP_HEARTBEAT]) + struct.pack("<i",
+                                                           int(rank)))
+        except RPCError:
+            from .. import telemetry as _telemetry
+            _telemetry.inc("rpc.heartbeat.dropped")
+            return False
         return True
 
     def start_heartbeat(self, rank, interval=None):
@@ -779,7 +841,11 @@ class PSClient:
             self._hb_stop.set()
             self._hb_stop = None
         try:
-            self._sock.close()
+            # deliberately lock-free: close() must be able to interrupt
+            # an exchange blocked under the lock (barrier can block for
+            # minutes); closing the fd wakes the blocked recv with a
+            # typed error instead of deadlocking behind it
+            self._sock.close()  # mxlint: disable=HB14 -- out-of-band interrupt; see above
         except OSError:
             pass
 

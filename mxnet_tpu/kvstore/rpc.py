@@ -1,0 +1,195 @@
+"""Deadline-bounded retry policy + typed errors for socket transports.
+
+Until ISSUE 19 every socket path in the repo (``PSClient._rpc``, the
+``FleetCollector`` scrape transport) either blocked forever on a silent
+peer or surfaced raw ``ConnectionRefusedError``/``socket.timeout`` to
+callers.  That was survivable while "distributed" meant threads in one
+process; against real processes a hung RPC wedges the whole worker and a
+raw ``OSError`` loses the peer/op context the heartbeat-death and
+scrape-dead rules need.
+
+This module is the ONE retry/deadline policy those transports share:
+
+- typed errors: :class:`RPCTimeout` (deadline elapsed mid-call) and
+  :class:`PeerUnreachable` (connect refused / peer reset), both carrying
+  ``peer`` and ``op`` so the existing rules can name the offender.  Both
+  subclass :class:`RPCError` which subclasses :class:`ConnectionError`,
+  so every pre-existing ``except (ConnectionError, OSError)`` transport
+  guard keeps working unchanged.
+- bounded exponential backoff with deterministic seeded jitter, clocks
+  injectable (``now``/``sleep``) so tier-1 gates the whole policy under
+  FakeClock with zero real sleeps.
+- telemetry: every retry increments ``rpc.retries`` (and
+  ``rpc.retries.<op>``); timeouts/refusals count under ``rpc.timeouts``
+  / ``rpc.unreachable``; the FINAL failure fires a flight dump
+  (``reason="rpc_failure:<op>"``) so a dead peer leaves evidence.
+
+Env knobs (read per-policy at construction, see ``RetryPolicy.from_env``):
+
+- ``MXTPU_RPC_TIMEOUT_S`` — per-attempt connect/read deadline
+  (default 5.0; 0 disables the deadline: block forever, pre-19 behavior).
+- ``MXTPU_RPC_RETRIES`` — attempts AFTER the first (default 2).
+  ``0`` is the kill switch: single attempt, no backoff — exactly the
+  pre-19 single-shot behavior, but still typed.
+- ``MXTPU_RPC_BACKOFF_S`` / ``MXTPU_RPC_BACKOFF_MAX_S`` — initial and
+  cap of the exponential backoff (defaults 0.05 / 2.0).
+- ``MXTPU_RPC_DEADLINE_S`` — optional TOTAL deadline across all
+  attempts+backoffs; elapsed ⇒ :class:`RPCTimeout` even with retry
+  budget left (default: unbounded; the per-attempt timeout still binds).
+"""
+from __future__ import annotations
+
+import os
+import random
+import socket
+import time
+
+
+class RPCError(ConnectionError):
+    """Base of the typed transport errors; carries peer + op name."""
+
+    def __init__(self, message, peer=None, op=None, attempts=None):
+        super().__init__(message)
+        self.peer = peer
+        self.op = op
+        self.attempts = attempts
+
+
+class RPCTimeout(RPCError):
+    """The per-attempt or total deadline elapsed before a reply."""
+
+
+class PeerUnreachable(RPCError):
+    """Connect refused, peer reset, or the socket died mid-exchange."""
+
+
+#: raw exception types each typed error wraps.  ``socket.timeout`` is an
+#: alias of ``TimeoutError`` on py3.10+ but kept explicit for intent.
+_TIMEOUT_EXCS = (socket.timeout, TimeoutError)
+_UNREACHABLE_EXCS = (ConnectionError, EOFError, OSError)
+
+
+def classify(exc, peer=None, op=None, attempts=None):
+    """Wrap a raw transport exception into the matching typed error."""
+    if isinstance(exc, RPCError):
+        return exc
+    cls = RPCTimeout if isinstance(exc, _TIMEOUT_EXCS) else PeerUnreachable
+    return cls(f"{op or 'rpc'} to {peer}: {exc!r}", peer=peer, op=op,
+               attempts=attempts)
+
+
+class RetryPolicy:
+    """Bounded exponential backoff with jitter around ONE callable.
+
+    ``run(attempt_fn)`` calls ``attempt_fn(timeout_s)`` up to
+    ``1 + retries`` times.  The callable does the actual socket work
+    with the given per-attempt deadline (None = block forever) and must
+    raise on failure; between attempts the policy sleeps
+    ``min(backoff_max_s, backoff_s * 2**i)`` plus up to 10% seeded
+    jitter.  Clocks are injectable so tests never sleep for real.
+    """
+
+    def __init__(self, retries=2, timeout_s=5.0, backoff_s=0.05,
+                 backoff_max_s=2.0, deadline_s=None, seed=0,
+                 now=time.monotonic, sleep=time.sleep):
+        self.retries = max(0, int(retries))
+        self.timeout_s = None if not timeout_s or timeout_s <= 0 \
+            else float(timeout_s)
+        self.backoff_s = float(backoff_s)
+        self.backoff_max_s = float(backoff_max_s)
+        self.deadline_s = None if not deadline_s or deadline_s <= 0 \
+            else float(deadline_s)
+        self._rng = random.Random(seed)
+        self._now = now
+        self._sleep = sleep
+
+    @classmethod
+    def from_env(cls, env=None, **overrides):
+        env = os.environ if env is None else env
+
+        def _f(name, default):
+            try:
+                return float(env.get(name, "") or default)
+            except ValueError:
+                return default
+        kw = dict(retries=int(_f("MXTPU_RPC_RETRIES", 2)),
+                  timeout_s=_f("MXTPU_RPC_TIMEOUT_S", 5.0),
+                  backoff_s=_f("MXTPU_RPC_BACKOFF_S", 0.05),
+                  backoff_max_s=_f("MXTPU_RPC_BACKOFF_MAX_S", 2.0),
+                  deadline_s=_f("MXTPU_RPC_DEADLINE_S", 0.0))
+        kw.update(overrides)
+        return cls(**kw)
+
+    def backoff(self, attempt):
+        """Deterministic (per seeded rng state) backoff for attempt i."""
+        base = min(self.backoff_max_s, self.backoff_s * (2.0 ** attempt))
+        return base * (1.0 + 0.1 * self._rng.random())
+
+    def run(self, attempt_fn, peer=None, op=None, reconnect=None,
+            on_failure=None):
+        """Run ``attempt_fn(timeout_s)`` under the policy.
+
+        ``reconnect()`` (optional) is called before every RE-attempt —
+        a half-read length-prefixed stream is poisoned, so retrying on
+        the same socket would desync framing.  ``on_failure(exc)``
+        (optional) runs once when the budget is exhausted, before the
+        typed error propagates.  Telemetry and the final flight dump
+        are emitted here so every transport shares one evidence shape.
+        """
+        from .. import telemetry as _telemetry
+        start = self._now()
+        attempts = 1 + self.retries
+        last = None
+        for i in range(attempts):
+            if i > 0:
+                _telemetry.inc("rpc.retries")
+                if op:
+                    _telemetry.inc(f"rpc.retries.{op}")
+                self._sleep(self.backoff(i - 1))
+                if reconnect is not None:
+                    try:
+                        reconnect(self.timeout_s)
+                    except Exception as e:  # noqa: BLE001 — typed below
+                        last = classify(e, peer=peer, op=op, attempts=i + 1)
+                        _telemetry.inc("rpc.unreachable")
+                        continue
+            if self.deadline_s is not None \
+                    and self._now() - start >= self.deadline_s:
+                last = RPCTimeout(
+                    f"{op or 'rpc'} to {peer}: total deadline "
+                    f"{self.deadline_s}s elapsed after {i} attempts",
+                    peer=peer, op=op, attempts=i)
+                break
+            try:
+                return attempt_fn(self.timeout_s)
+            except Exception as e:  # noqa: BLE001 — typed + re-raised
+                if not isinstance(e, _TIMEOUT_EXCS + _UNREACHABLE_EXCS):
+                    raise       # not a transport error (e.g. MXNetError)
+                last = classify(e, peer=peer, op=op, attempts=i + 1)
+                _telemetry.inc("rpc.timeouts"
+                               if isinstance(last, RPCTimeout)
+                               else "rpc.unreachable")
+        report_failure(last, on_failure=on_failure)
+        raise last
+
+
+def report_failure(err, on_failure=None):
+    """Final-failure evidence shared by every transport: counters, a
+    typed event, and a flight dump whose reason names the op — so a
+    dead peer leaves the same trail whether the call died at connect
+    (``PSClient.__init__``) or mid-exchange (``RetryPolicy.run``)."""
+    from .. import telemetry as _telemetry
+    op = getattr(err, "op", None)
+    _telemetry.inc("rpc.failures")
+    _telemetry.event("rpc.failed", peer=str(getattr(err, "peer", None)),
+                     op=op or "", attempts=getattr(err, "attempts", None),
+                     error=type(err).__name__)
+    if on_failure is not None:
+        try:
+            on_failure(err)
+        except Exception:  # noqa: BLE001 — evidence must not mask
+            pass
+    try:
+        _telemetry.dump_flight(reason=f"rpc_failure:{op or 'rpc'}")
+    except Exception:  # noqa: BLE001 — flight dump is best-effort
+        pass

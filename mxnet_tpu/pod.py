@@ -1,0 +1,355 @@
+"""Process-level pod runtime: spawn, supervise, commit membership.
+
+Everything "distributed" built through PR 18 — PS heartbeats, elastic
+membership, drains, fleet scrapes, the router — ran as threads under
+FakeClock in ONE process.  This module is the process-level half of
+ISSUE 19: a :class:`PodLauncher` that forks N REAL worker processes
+over ``jax.distributed`` (the ``_dist_init`` env seam), supervises
+them, and on a real death commits a membership change the survivors
+act on by tearing down and re-initializing the JAX coordination
+service at the smaller world size (``_dist_init.reinit_distributed``).
+
+Control plane = one directory of atomically-renamed files (the same
+medium the checkpoint manager already trusts), so it works with zero
+extra sockets and survives any worker death mid-write:
+
+- ``membership.json`` — the committed view ``{epoch, coordinator,
+  world, ranks: {orig_rank: new_rank}, dead: [...]}``.  The launcher is
+  the ONLY writer; workers poll it at step boundaries.  A new epoch
+  carries a FRESH coordinator port: the old coordination service dies
+  with the old world (its barrier state is sized to it).
+- ``ready.<epoch>.<step>.<orig_rank>`` / ``go.<epoch>.<step>`` — the
+  step gate.  Workers report at every step boundary and wait for the
+  launcher's approval; the launcher approves a step only while every
+  live member is present, so a death is drained at a boundary (exactly
+  the elastic controller's drain-at-step-boundary contract) instead of
+  wedging survivors inside a collective that is missing a peer.
+- ``queue/{pending,inflight,done}`` — the file-lease serving queue.
+  Workers claim requests by atomic rename into ``inflight`` (one
+  winner per request), write the result into ``done``, then release
+  the lease.  On a death the launcher requeues the dead rank's
+  unfinished leases back to ``pending`` — completed-but-unreleased
+  leases are detected by their ``done`` file and NOT requeued, which
+  is what makes the ledger exactly-once.
+- ``status.<orig_rank>.json`` / ``digests.<orig_rank>.jsonl`` —
+  worker-reported state (pid, epoch, ``jax.process_count()``, step)
+  and the per-step parameter digests the chaos gate compares bitwise.
+
+The default worker is ``mxnet_tpu.testing.pod_worker`` (deterministic
+dp training over ``process_allgather`` + checkpoint + the queue);
+``tools/launch.py --supervise`` drives arbitrary commands through the
+same launcher.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+__all__ = ["PodLauncher", "read_membership", "write_membership",
+           "queue_dirs", "submit_request", "free_port"]
+
+MEMBERSHIP_FILE = "membership.json"
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def write_json_atomic(path, obj):
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(obj, f)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def read_json(path):
+    try:
+        with open(path, encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def write_membership(pod_dir, epoch, coordinator, ranks, dead=()):
+    """Commit a membership view (launcher-only).  ``ranks`` maps
+    ORIGINAL rank -> new contiguous rank (0..world-1)."""
+    write_json_atomic(os.path.join(pod_dir, MEMBERSHIP_FILE), {
+        "epoch": int(epoch), "coordinator": str(coordinator),
+        "world": len(ranks),
+        "ranks": {str(k): int(v) for k, v in ranks.items()},
+        "dead": sorted(int(r) for r in dead)})
+
+
+def read_membership(pod_dir):
+    return read_json(os.path.join(pod_dir, MEMBERSHIP_FILE))
+
+
+# -- file-lease serving queue ------------------------------------------
+
+def queue_dirs(pod_dir):
+    root = os.path.join(pod_dir, "queue")
+    dirs = {k: os.path.join(root, k)
+            for k in ("pending", "inflight", "done")}
+    for d in dirs.values():
+        os.makedirs(d, exist_ok=True)
+    return dirs
+
+
+def submit_request(pod_dir, req_id, payload):
+    dirs = queue_dirs(pod_dir)
+    write_json_atomic(os.path.join(dirs["pending"], f"{req_id}.json"),
+                      {"id": str(req_id), "payload": payload})
+
+
+def queue_ledger(pod_dir):
+    """{state: [request ids]} — the exactly-once evidence."""
+    dirs = queue_dirs(pod_dir)
+    out = {}
+    for state, d in dirs.items():
+        ids = []
+        for name in os.listdir(d):
+            stem = name.split(".lease.")[0]   # inflight: id.json.lease.R
+            if stem.endswith(".json"):
+                ids.append(stem[:-5])
+        out[state] = sorted(ids)
+    return out
+
+
+class PodLauncher:
+    """Spawn + supervise N real worker processes (one pod on one box).
+
+    ``argv`` is the worker command (default: the deterministic
+    ``pod_worker``); every worker gets the ``MXTPU_COORDINATOR`` /
+    ``MXTPU_PROCESS_ID`` / ``MXTPU_NUM_PROCESSES`` rendezvous env the
+    ``_dist_init`` seam consumes, plus ``MXTPU_POD_DIR`` for the
+    control plane.  ``supervise()`` runs the gate + death protocol;
+    ``kill(rank)`` SIGKILLs a worker (the chaos hook).
+    """
+
+    def __init__(self, nprocs, pod_dir, argv=None, env=None,
+                 steps=8, ckpt_every=3, devices_per_proc=1):
+        self.nprocs = int(nprocs)
+        self.pod_dir = os.path.abspath(pod_dir)
+        os.makedirs(self.pod_dir, exist_ok=True)
+        queue_dirs(self.pod_dir)
+        self.argv = list(argv) if argv else [
+            sys.executable, "-m", "mxnet_tpu.testing.pod_worker"]
+        self.extra_env = dict(env or {})
+        self.steps = int(steps)
+        self.ckpt_every = int(ckpt_every)
+        self.devices_per_proc = int(devices_per_proc)
+        self.epoch = 0
+        self.coordinator = None
+        self.procs = {}          # orig_rank -> Popen (live or reaped)
+        self.dead = set()        # orig ranks declared dead
+        self.done = set()        # orig ranks that exited clean (rc 0)
+        self.ps_ports = {r: free_port() for r in range(self.nprocs)}
+        self.reinit_events = []  # [{epoch, world, dead}] per commit
+        # chaos hook: while set, the gate withholds approval for steps
+        # >= hold_step — every live worker parks at the gate (between
+        # collectives), giving a deterministic SIGKILL window
+        self.hold_step = None
+
+    # -- membership ----------------------------------------------------
+    def _live(self):
+        return [r for r in self.procs
+                if r not in self.dead and r not in self.done]
+
+    def _commit(self):
+        """Commit the current live set as a new epoch with a fresh
+        coordinator. Survivors re-rank contiguously in orig-rank order
+        (deterministic, so the resumed run is bitwise reproducible)."""
+        self.epoch += 1
+        self.coordinator = f"127.0.0.1:{free_port()}"
+        live = sorted(self._live()) or list(range(self.nprocs))
+        ranks = {orig: new for new, orig in enumerate(live)}
+        write_membership(self.pod_dir, self.epoch, self.coordinator,
+                         ranks, dead=self.dead)
+        self.reinit_events.append({"epoch": self.epoch,
+                                   "world": len(ranks),
+                                   "dead": sorted(self.dead)})
+        return ranks
+
+    # -- spawn ----------------------------------------------------------
+    def _worker_env(self, orig_rank, new_rank, world):
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(
+            __file__)))
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH")
+            else "")
+        env.update({
+            "MXTPU_COORDINATOR": self.coordinator,
+            "MXTPU_NUM_PROCESSES": str(world),
+            "MXTPU_PROCESS_ID": str(new_rank),
+            "MXTPU_POD_DIR": self.pod_dir,
+            "MXTPU_POD_RANK": str(orig_rank),
+            "MXTPU_POD_EPOCH": str(self.epoch),
+            "MXTPU_POD_STEPS": str(self.steps),
+            "MXTPU_POD_CKPT_EVERY": str(self.ckpt_every),
+            "MXTPU_POD_PS_PORT": str(self.ps_ports[orig_rank]),
+            "JAX_PLATFORMS": "cpu",
+            # the parent test/bench process often forces 8 virtual CPU
+            # devices; a pod worker is ONE host with its own devices
+            "XLA_FLAGS": "--xla_force_host_platform_device_count="
+                         f"{self.devices_per_proc}",
+        })
+        return env
+
+    def start(self):
+        ranks = self._commit()     # epoch 1: everyone, identity ranks
+        for orig, new in ranks.items():
+            self.procs[orig] = subprocess.Popen(
+                self.argv, env=self._worker_env(orig, new, len(ranks)),
+                cwd=self.pod_dir)
+        return self
+
+    # -- chaos hook ------------------------------------------------------
+    def kill(self, orig_rank, sig=signal.SIGKILL):
+        p = self.procs[orig_rank]
+        if p.poll() is None:
+            p.send_signal(sig)
+            p.wait()
+
+    # -- the gate + death protocol --------------------------------------
+    def _requeue_leases(self, dead_ranks):
+        """Return a dead rank's unfinished leases to ``pending``; a
+        lease whose result already landed in ``done`` is completed
+        work — release it instead of requeueing (exactly-once)."""
+        dirs = queue_dirs(self.pod_dir)
+        requeued = []
+        for name in os.listdir(dirs["inflight"]):
+            stem, _, owner = name.rpartition(".lease.")
+            if not stem or int(owner or -1) not in dead_ranks:
+                continue
+            src = os.path.join(dirs["inflight"], name)
+            if os.path.exists(os.path.join(dirs["done"], stem)):
+                os.unlink(src)
+                continue
+            os.replace(src, os.path.join(dirs["pending"], stem))
+            requeued.append(stem.rsplit(".json", 1)[0])
+        return requeued
+
+    def _reap(self):
+        """Newly-dead orig ranks (unexpected exit).  rc==0 is a clean
+        completion, not a death."""
+        newly = []
+        for r, p in self.procs.items():
+            if r in self.dead or r in self.done:
+                continue
+            rc = p.poll()
+            if rc is None:
+                continue
+            if rc == 0:
+                self.done.add(r)
+            else:
+                newly.append(r)
+        return newly
+
+    def _gate_scan(self):
+        """Approve any step for which EVERY live member has reported
+        ready at the current epoch."""
+        live = self._live()
+        if not live:
+            return
+        counts = {}
+        for name in os.listdir(self.pod_dir):
+            if not name.startswith(f"ready.{self.epoch}."):
+                continue
+            _, _, step, rank = name.split(".")
+            if int(rank) in self.dead:
+                continue
+            counts.setdefault(int(step), set()).add(int(rank))
+        for step, ranks in sorted(counts.items()):
+            if self.hold_step is not None and step >= self.hold_step:
+                continue
+            go = os.path.join(self.pod_dir, f"go.{self.epoch}.{step}")
+            if ranks >= set(live) and not os.path.exists(go):
+                write_json_atomic(go, {"step": step})
+
+    def ready_ranks(self, step, epoch=None):
+        """Orig ranks currently parked at the gate for ``step``."""
+        epoch = self.epoch if epoch is None else epoch
+        out = set()
+        prefix = f"ready.{epoch}.{step}."
+        for name in os.listdir(self.pod_dir):
+            if name.startswith(prefix):
+                out.add(int(name[len(prefix):]))
+        return out
+
+    def supervise(self, poll_s=0.02, timeout_s=120.0, on_death=None):
+        """Run the pod to completion: drive the step gate, and on a
+        death requeue its leases and commit a shrunk membership (the
+        survivors reinit + restore at the next gate poll).  Returns a
+        summary dict.  ``on_death(orig_rank, epoch)`` is the chaos
+        observation hook."""
+        deadline = time.monotonic() + timeout_s
+        requeued = []
+        while self._live():
+            if time.monotonic() > deadline:
+                for r in self._live():
+                    self.kill(r, signal.SIGKILL)
+                raise TimeoutError(
+                    f"pod did not finish within {timeout_s}s "
+                    f"(live={self._live()})")
+            newly = self._reap()
+            if newly:
+                self.dead.update(newly)
+                requeued += self._requeue_leases(set(newly))
+                self._commit()
+                for r in newly:
+                    if on_death is not None:
+                        on_death(r, self.epoch)
+            self._gate_scan()
+            time.sleep(poll_s)
+        return {"epoch": self.epoch, "dead": sorted(self.dead),
+                "done": sorted(self.done), "requeued": requeued,
+                "reinits": list(self.reinit_events)}
+
+    def shutdown(self):
+        for r, p in self.procs.items():
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        t0 = time.monotonic()
+        for p in self.procs.values():
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, 5 - (time.monotonic() - t0)))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+
+    # -- evidence --------------------------------------------------------
+    def statuses(self):
+        out = {}
+        for r in range(self.nprocs):
+            st = read_json(os.path.join(self.pod_dir,
+                                        f"status.{r}.json"))
+            if st is not None:
+                out[r] = st
+        return out
+
+    def digests(self, orig_rank):
+        path = os.path.join(self.pod_dir, f"digests.{orig_rank}.jsonl")
+        rows = []
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        rows.append(json.loads(line))
+        except OSError:
+            pass
+        return rows

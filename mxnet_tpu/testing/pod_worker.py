@@ -1,0 +1,229 @@
+"""Deterministic pod worker: real process, real collectives, real death.
+
+``python -m mxnet_tpu.testing.pod_worker`` is the default workload
+:class:`mxnet_tpu.pod.PodLauncher` spawns — one REAL process per rank
+that rendezvouses over ``jax.distributed`` (the ``_dist_init`` env
+seam fires at package import), then loops deterministic data-parallel
+steps whose cross-process gradient sum runs through
+``multihost_utils.process_allgather`` — a real collective over the
+coordination service, so a wrong world size or a stale backend cannot
+produce the right parameter digests.
+
+Per step, gated by the launcher's ready/go files (the drain boundary):
+
+1. serve: claim pending requests from the file-lease queue (atomic
+   rename = one winner), write results to ``done``, release the lease.
+   ``MXTPU_POD_HOLD_RANK`` makes that orig rank claim one lease and
+   SIT on it — the workload shaping that guarantees the chaos kill
+   lands on a lease holder; a surviving holder drains it before exit
+   so fault-free runs stay exactly-once.
+2. train: ``g_local = f(w, step, rank, world)`` (w-dependent, so any
+   divergence compounds), allgather, host-side sum in rank order
+   (deterministic), update, append the sha256 parameter digest.
+3. checkpoint every ``MXTPU_POD_CKPT_EVERY`` steps (new-rank 0 writes,
+   atomic rename; every rank holds identical w).
+
+On a committed membership change (epoch bump in ``membership.json``,
+observed while waiting at the gate) a survivor tears down and re-inits
+the coordination service via ``_dist_init.reinit_distributed`` at the
+new world size, restores w from the checkpoint, and resumes — which is
+why its post-reshard digests must be BITWISE those of a fresh pod
+restored from the same checkpoint at the same world size (the chaos
+gate's core assertion).  Evidence lands in ``status.<orig>.json``
+(pid, epoch, ``jax.process_count()``, reinit ms) and
+``digests.<orig>.jsonl``; a per-worker ``PSServer`` on
+``MXTPU_POD_PS_PORT`` is the fleet scrape endpoint.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import time
+
+import numpy as _np
+
+
+def _env_int(name, default):
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def _init_weights(dim):
+    return _np.random.RandomState(1234).standard_normal(dim).astype(
+        _np.float32)
+
+
+def _local_grad(w, step, rank, world, dim):
+    """Deterministic rank shard: depends on w (divergence compounds)
+    and on (step, rank) but not on wall clock or pids."""
+    rs = _np.random.RandomState(100_003 * step + 101 * rank + 7)
+    batch = rs.standard_normal(dim).astype(_np.float32)
+    return (_np.float32(0.01) * w * _np.float32(rank + 1)
+            + batch / _np.float32(world))
+
+
+def _save_ckpt(pod_dir, w, step):
+    tmp = os.path.join(pod_dir, f"ckpt.tmp.{os.getpid()}.npz")
+    _np.savez(tmp, w=w, step=_np.int64(step))
+    os.replace(tmp, os.path.join(pod_dir, "ckpt.npz"))
+
+
+def _load_ckpt(path):
+    with _np.load(path) as z:
+        return z["w"].astype(_np.float32), int(z["step"])
+
+
+def main():
+    pod_dir = os.environ["MXTPU_POD_DIR"]
+    orig_rank = _env_int("MXTPU_POD_RANK", 0)
+    epoch = _env_int("MXTPU_POD_EPOCH", 1)
+    steps = _env_int("MXTPU_POD_STEPS", 8)
+    ckpt_every = _env_int("MXTPU_POD_CKPT_EVERY", 3)
+    ps_port = _env_int("MXTPU_POD_PS_PORT", 0)
+    dim = _env_int("MXTPU_POD_DIM", 64)
+    hold_rank = _env_int("MXTPU_POD_HOLD_RANK", -1)
+    serve_per_step = _env_int("MXTPU_POD_SERVE_PER_STEP", 2)
+    gate_timeout = float(os.environ.get("MXTPU_POD_GATE_TIMEOUT_S",
+                                        "120"))
+
+    import mxnet_tpu  # noqa: F401 — fires maybe_init_distributed
+    from mxnet_tpu import pod as _pod
+    from mxnet_tpu import telemetry as _telemetry
+    from mxnet_tpu._dist_init import reinit_distributed
+    import jax
+    from jax.experimental import multihost_utils
+
+    m = _pod.read_membership(pod_dir) or {
+        "epoch": epoch, "world": 1, "ranks": {str(orig_rank): 0}}
+    rank = int(m["ranks"][str(orig_rank)])
+    world = int(m["world"])
+    dirs = _pod.queue_dirs(pod_dir)
+    if ps_port:
+        from mxnet_tpu.kvstore.ps_server import PSServer
+        PSServer("127.0.0.1", ps_port, 1)
+
+    restore = os.environ.get("MXTPU_POD_RESTORE", "")
+    ckpt_path = restore or os.path.join(pod_dir, "ckpt.npz")
+    if restore or os.path.exists(ckpt_path):
+        w, step0 = _load_ckpt(ckpt_path)
+    else:
+        w, step0 = _init_weights(dim), 0
+    step = step0 + 1
+    held = None          # (inflight_path, done_name, req) while holding
+    reinit_ms = None
+
+    def status(phase):
+        _pod.write_json_atomic(
+            os.path.join(pod_dir, f"status.{orig_rank}.json"),
+            {"pid": os.getpid(), "orig_rank": orig_rank, "rank": rank,
+             "epoch": epoch, "world": int(jax.process_count()),
+             "step": step, "phase": phase, "ps_port": ps_port,
+             "reinit_ms": reinit_ms})
+
+    def serve_one(name, release=True):
+        src = os.path.join(dirs["pending"], name)
+        dst = os.path.join(dirs["inflight"],
+                           f"{name}.lease.{orig_rank}")
+        try:
+            os.rename(src, dst)        # atomic claim: one winner
+        except OSError:
+            return None                # another rank won the race
+        req = _pod.read_json(dst) or {}
+        if not release:
+            return (dst, name, req)
+        _pod.write_json_atomic(
+            os.path.join(dirs["done"], name),
+            {"id": req.get("id"), "payload": req.get("payload"),
+             "by": orig_rank, "epoch": epoch})
+        os.unlink(dst)
+        _telemetry.inc("pod.requests_served")
+        return None
+
+    def serve(limit):
+        nonlocal held
+        for name in sorted(os.listdir(dirs["pending"]))[:limit]:
+            if held is None and orig_rank == hold_rank:
+                held = serve_one(name, release=False)
+                continue
+            serve_one(name)
+
+    def wait_gate():
+        """Report ready; block for go or a newer membership epoch."""
+        open(os.path.join(pod_dir,
+                          f"ready.{epoch}.{step}.{orig_rank}"),
+             "w").close()
+        go = os.path.join(pod_dir, f"go.{epoch}.{step}")
+        deadline = time.monotonic() + gate_timeout
+        while True:
+            if os.path.exists(go):
+                return None
+            mm = _pod.read_membership(pod_dir)
+            if mm and int(mm["epoch"]) > epoch:
+                return mm
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"rank {orig_rank}: no go/{epoch}/{step} within "
+                    f"{gate_timeout}s")
+            time.sleep(0.005)
+
+    status("start")
+    while step <= steps:
+        status("gate")
+        mm = wait_gate()
+        if mm is not None:
+            # committed membership change: drain here (the gate IS the
+            # step boundary), reinit the coordination service at the
+            # new world, restore from the shared checkpoint, resume
+            if str(orig_rank) not in mm["ranks"]:
+                return 3               # evicted (launcher saw us dead)
+            epoch = int(mm["epoch"])
+            rank = int(mm["ranks"][str(orig_rank)])
+            world = int(mm["world"])
+            reinit_ms = round(reinit_distributed(
+                mm["coordinator"], world, rank) * 1e3, 3)
+            _telemetry.inc("pod.reinits")
+            _telemetry.set_gauge("pod.coordinator_reinit_ms", reinit_ms)
+            _telemetry.set_gauge("elastic.epoch", epoch)
+            _telemetry.event("pod.reinit", epoch=epoch, world=world,
+                             rank=rank, dead=mm.get("dead"))
+            if os.path.exists(ckpt_path):
+                w, step0 = _load_ckpt(ckpt_path)
+                step = step0 + 1
+            status("reinit")
+            continue
+        serve(serve_per_step)
+        g_local = _local_grad(w, step, rank, world, dim)
+        gathered = _np.asarray(    # one allgather per STEP (the whole
+            # update in one call), not per key — no O(n_keys) cliff
+            multihost_utils.process_allgather(g_local))  # mxlint: disable=HB07 -- per-step, not per-key; see above
+        g = gathered.sum(axis=0, dtype=_np.float32)
+        w = (w - _np.float32(0.05) * g).astype(_np.float32)
+        digest = hashlib.sha256(w.tobytes()).hexdigest()
+        with open(os.path.join(pod_dir,
+                               f"digests.{orig_rank}.jsonl"),
+                  "a", encoding="utf-8") as f:
+            f.write(json.dumps({"step": step, "epoch": epoch,
+                                "rank": rank, "world": world,
+                                "digest": digest}) + "\n")
+        _telemetry.inc("pod.steps")
+        _telemetry.observe("train.step_ms", 1.0)
+        if rank == 0 and step % ckpt_every == 0:
+            _save_ckpt(pod_dir, w, step)
+        step += 1
+    if held is not None:
+        dst, name, req = held
+        _pod.write_json_atomic(
+            os.path.join(dirs["done"], name),
+            {"id": req.get("id"), "payload": req.get("payload"),
+             "by": orig_rank, "epoch": epoch})
+        os.unlink(dst)
+    status("done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -828,3 +828,92 @@ def test_bench_diff_gates_lint_schema_drift(tmp_path, capsys):
     # same lint schema compares fine
     b.write_text(json.dumps(base))
     assert bench_diff.main([str(a), str(b), "--quiet"]) == 0
+
+
+# ----------------------------------------------------------------------
+# the `multiproc` block schema (ISSUE 19): pod/RPC config always real,
+# recovery costs (coordinator_reinit_ms, sigkill_recover_ms) null unless
+# THIS process actually went through a reshard — an in-process bench
+# can't pass off "never killed anything" as "0 ms recovery"
+# ----------------------------------------------------------------------
+
+_MULTIPROC_KEYS = {
+    "multiproc_schema_version", "procs", "world_size", "rpc_retries",
+    "rpc_timeout_s", "coordinator_reinit_ms", "sigkill_recover_ms",
+}
+
+
+def test_multiproc_block_schema_is_stable(monkeypatch):
+    monkeypatch.delenv("MXTPU_NUM_PROCESSES", raising=False)
+    monkeypatch.delenv("MXTPU_RPC_RETRIES", raising=False)
+    blk = bench._bench_multiproc()
+    assert set(blk) - {"note"} == _MULTIPROC_KEYS
+    assert blk["multiproc_schema_version"] == bench.MULTIPROC_SCHEMA_VERSION
+    assert blk["procs"] == 1 and blk["world_size"] == 1
+    assert blk["rpc_retries"] == 2 and blk["rpc_timeout_s"] == 5.0
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_bench_multiproc_single_process_is_nulls_not_zeros(monkeypatch):
+    """bench.py's multiproc block in one process: nothing was killed and
+    nothing re-initialized, so the recovery costs are null — the
+    correctness evidence lives in the real-process chaos suite
+    (tools/tpu_queue_runner.py --chaos procs)."""
+    monkeypatch.delenv("MXTPU_NUM_PROCESSES", raising=False)
+    blk = bench._bench_multiproc()
+    assert blk["coordinator_reinit_ms"] is None
+    assert blk["sigkill_recover_ms"] is None
+    assert "note" in blk and "--chaos procs" in blk["note"]
+
+
+def test_multiproc_compact_keys_surface_when_measured():
+    """The generic extras sweep surfaces the block's scalars as
+    multiproc.<key> once measured; nulls never reach the headline."""
+    p = _success_payload()
+    p["extra"]["multiproc"] = {
+        "multiproc_schema_version": bench.MULTIPROC_SCHEMA_VERSION,
+        "procs": 4, "world_size": 4, "rpc_retries": 2,
+        "rpc_timeout_s": 5.0,
+        "coordinator_reinit_ms": 21.9, "sigkill_recover_ms": 830.0}
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["multiproc.coordinator_reinit_ms"] == 21.9
+    assert obj["multiproc.sigkill_recover_ms"] == 830.0
+    p["extra"]["multiproc"]["coordinator_reinit_ms"] = None
+    p["extra"]["multiproc"]["sigkill_recover_ms"] = None
+    obj = json.loads(bench._compact_line(p))
+    assert "multiproc.coordinator_reinit_ms" not in obj
+    assert "multiproc.sigkill_recover_ms" not in obj
+
+
+def test_bench_diff_gates_multiproc_schema_drift(tmp_path, capsys):
+    """tools/bench_diff.py refuses (exit 2) to compare payloads whose
+    multiproc blocks carry different schema versions, and never treats
+    the block's config keys (procs/world_size/rpc_retries) as
+    metrics."""
+    from tools import bench_diff
+    blk = {"multiproc_schema_version": 1, "procs": 4, "world_size": 4,
+           "rpc_retries": 2, "rpc_timeout_s": 5.0,
+           "coordinator_reinit_ms": 21.9, "sigkill_recover_ms": None}
+    base = {"metric": "m", "value": 1.0, "platform": "cpu",
+            "telemetry_schema_version": 1,
+            "extra": {"multiproc": blk}}
+    drift = json.loads(json.dumps(base))
+    drift["extra"]["multiproc"]["multiproc_schema_version"] += 1
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(drift))
+    rc = bench_diff.main([str(a), str(b), "--quiet"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "multiproc_schema_drift" in out
+    # same schema compares fine, and config keys are skipped: only the
+    # measured *_ms field (direction "down") is a comparable metric
+    flat = bench_diff.flatten(base)
+    assert "extra.multiproc.procs" not in flat
+    assert "extra.multiproc.world_size" not in flat
+    assert "extra.multiproc.rpc_retries" not in flat
+    assert "extra.multiproc.coordinator_reinit_ms" in flat
+    assert bench_diff.direction(
+        "extra.multiproc.coordinator_reinit_ms") == "down"
+    b.write_text(json.dumps(base))
+    assert bench_diff.main([str(a), str(b), "--quiet"]) == 0

@@ -1,0 +1,307 @@
+"""RPC retry/backoff policy + typed transport errors (ISSUE 19).
+
+The whole policy is gated under FakeClock with ZERO real sleeps: the
+injectable ``now``/``sleep`` seams exist exactly so tier-1 can assert
+deadlines, deterministic seeded backoff, retry telemetry and the final
+flight dump without waiting out a single real timeout.
+"""
+import socket
+import threading
+
+import pytest
+
+from mxnet_tpu import telemetry
+from mxnet_tpu.kvstore.rpc import (PeerUnreachable, RetryPolicy,
+                                   RPCError, RPCTimeout, classify)
+from mxnet_tpu.testing.faults import FakeClock
+
+
+def _policy(clock, **kw):
+    """A policy whose sleeps advance the FakeClock instead of blocking."""
+    kw.setdefault("now", clock)
+    kw.setdefault("sleep", clock.advance)
+    return RetryPolicy(**kw)
+
+
+def _counters():
+    return telemetry.snapshot().get("counters", {})
+
+
+# ----------------------------------------------------------------------
+# typed wrapping
+# ----------------------------------------------------------------------
+
+def test_classify_wraps_raw_errors_with_peer_and_op():
+    e = classify(ConnectionRefusedError("refused"), peer="h:1",
+                 op="pull", attempts=3)
+    assert isinstance(e, PeerUnreachable)
+    assert isinstance(e, ConnectionError)   # pre-19 guards keep working
+    assert e.peer == "h:1" and e.op == "pull" and e.attempts == 3
+    assert "pull" in str(e) and "h:1" in str(e)
+
+    t = classify(socket.timeout("slow"), peer="h:2", op="push")
+    assert isinstance(t, RPCTimeout)
+    assert t.peer == "h:2" and t.op == "push"
+
+
+def test_classify_passes_through_already_typed():
+    orig = RPCTimeout("x", peer="p", op="barrier")
+    assert classify(orig, peer="other") is orig
+
+
+# ----------------------------------------------------------------------
+# backoff: bounded, exponential, deterministic under a seed
+# ----------------------------------------------------------------------
+
+def test_backoff_deterministic_and_bounded():
+    a = RetryPolicy(backoff_s=0.1, backoff_max_s=0.5, seed=7)
+    b = RetryPolicy(backoff_s=0.1, backoff_max_s=0.5, seed=7)
+    seq_a = [a.backoff(i) for i in range(6)]
+    seq_b = [b.backoff(i) for i in range(6)]
+    assert seq_a == seq_b                       # same seed, same schedule
+    for i, v in enumerate(seq_a):
+        base = min(0.5, 0.1 * 2 ** i)
+        assert base <= v <= base * 1.1 + 1e-12  # jitter is additive, <=10%
+    assert RetryPolicy(seed=8).backoff(0) != a.backoff(0)
+
+
+def test_run_sleeps_exactly_the_seeded_schedule():
+    clock = FakeClock(50.0)
+    slept = []
+    pol = _policy(clock, retries=3, timeout_s=1.0, backoff_s=0.1,
+                  backoff_max_s=2.0, seed=3)
+    pol._sleep = slept.append      # record instead of advancing
+    calls = []
+
+    def attempt(timeout_s):
+        calls.append(timeout_s)
+        if len(calls) < 3:
+            raise ConnectionResetError("flaky")
+        return "ok"
+
+    assert pol.run(attempt, peer="h:9", op="pull") == "ok"
+    assert calls == [1.0, 1.0, 1.0]            # per-attempt deadline set
+    twin = RetryPolicy(backoff_s=0.1, backoff_max_s=2.0, seed=3)
+    assert slept == [twin.backoff(0), twin.backoff(1)]
+
+
+# ----------------------------------------------------------------------
+# run(): retries, counters, final flight dump
+# ----------------------------------------------------------------------
+
+def test_run_retries_then_succeeds_counts_retries():
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    clock = FakeClock(10.0)
+    pol = _policy(clock, retries=2, timeout_s=0.5)
+    seen = {"n": 0}
+
+    def attempt(timeout_s):
+        seen["n"] += 1
+        if seen["n"] == 1:
+            raise ConnectionRefusedError("first one fails")
+        return 42
+
+    assert pol.run(attempt, peer="h:1", op="pull") == 42
+    c = _counters()
+    assert c.get("rpc.retries") == 1
+    assert c.get("rpc.retries.pull") == 1
+    assert c.get("rpc.unreachable") == 1
+    assert not c.get("rpc.failures")           # it recovered
+
+
+def test_run_exhausted_raises_typed_and_dumps_flight(tmp_path,
+                                                     monkeypatch):
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    clock = FakeClock(10.0)
+    pol = _policy(clock, retries=2, timeout_s=0.5, seed=1)
+
+    def attempt(timeout_s):
+        raise socket.timeout("dead peer")
+
+    with pytest.raises(RPCTimeout) as ei:
+        pol.run(attempt, peer="h:7", op="push")
+    assert ei.value.peer == "h:7" and ei.value.op == "push"
+    assert ei.value.attempts == 3              # 1 + retries, all spent
+    c = _counters()
+    assert c.get("rpc.retries") == 2
+    assert c.get("rpc.timeouts") == 3
+    assert c.get("rpc.failures") == 1
+    evs = [e for e in telemetry.events() if e["kind"] == "rpc.failed"]
+    assert evs and evs[-1]["data"]["op"] == "push"
+    assert evs[-1]["data"]["error"] == "RPCTimeout"
+    # the final failure left a flight dump naming the op
+    import json
+    path = telemetry.last_flight_dump()
+    assert path and str(tmp_path) in path
+    with open(path) as f:
+        assert json.load(f)["reason"] == "rpc_failure:push"
+
+
+def test_total_deadline_beats_remaining_retry_budget():
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    clock = FakeClock(100.0)
+    # each failed attempt "takes" 1s of fake time; the total deadline
+    # (2.5s) must cut the run short even though 9 retries remain
+    pol = _policy(clock, retries=9, timeout_s=5.0, backoff_s=0.01,
+                  deadline_s=2.5)
+    calls = []
+
+    def attempt(timeout_s):
+        calls.append(timeout_s)
+        clock.advance(1.0)
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(RPCTimeout) as ei:
+        pol.run(attempt, peer="h:3", op="pull")
+    assert "deadline" in str(ei.value)
+    assert len(calls) < 10                    # budget NOT exhausted
+
+
+def test_reconnect_runs_before_every_reattempt():
+    clock = FakeClock(5.0)
+    pol = _policy(clock, retries=2, timeout_s=1.0)
+    order = []
+
+    def attempt(timeout_s):
+        order.append("attempt")
+        if order.count("attempt") < 3:
+            raise BrokenPipeError("poisoned framing")
+        return "ok"
+
+    def reconnect(timeout_s):
+        order.append("reconnect")
+
+    assert pol.run(attempt, reconnect=reconnect, peer="h", op="pull") \
+        == "ok"
+    # never before the FIRST attempt; always before a re-attempt
+    assert order == ["attempt", "reconnect", "attempt", "reconnect",
+                     "attempt"]
+
+
+def test_failed_reconnect_consumes_the_attempt():
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    clock = FakeClock(5.0)
+    pol = _policy(clock, retries=1, timeout_s=1.0)
+    attempts = []
+
+    def attempt(timeout_s):
+        attempts.append(1)
+        raise ConnectionResetError("reset")
+
+    def reconnect(timeout_s):
+        raise ConnectionRefusedError("still down")
+
+    with pytest.raises(PeerUnreachable):
+        pol.run(attempt, reconnect=reconnect, peer="h:2", op="push")
+    assert len(attempts) == 1   # the re-attempt died inside reconnect
+
+
+def test_non_transport_errors_are_not_retried():
+    clock = FakeClock(5.0)
+    pol = _policy(clock, retries=5, timeout_s=1.0)
+    calls = []
+
+    def attempt(timeout_s):
+        calls.append(1)
+        raise ValueError("a server-side typed rejection, not transport")
+
+    with pytest.raises(ValueError):
+        pol.run(attempt, peer="h", op="join")
+    assert len(calls) == 1
+
+
+# ----------------------------------------------------------------------
+# env knobs
+# ----------------------------------------------------------------------
+
+def test_from_env_kill_switch_single_attempt():
+    pol = RetryPolicy.from_env(env={"MXTPU_RPC_RETRIES": "0"})
+    assert pol.retries == 0
+    slept = []
+    pol._sleep = slept.append
+    calls = []
+
+    def attempt(timeout_s):
+        calls.append(1)
+        raise ConnectionRefusedError("down")
+
+    with pytest.raises(PeerUnreachable):
+        pol.run(attempt, peer="h", op="pull")
+    assert len(calls) == 1 and slept == []    # exactly pre-19 one-shot
+
+
+def test_from_env_defaults_and_zero_timeout_blocks_forever():
+    pol = RetryPolicy.from_env(env={})
+    assert pol.retries == 2
+    assert pol.timeout_s == 5.0
+    assert pol.deadline_s is None
+    # 0 disables the per-attempt deadline (block forever, pre-19)
+    nolimit = RetryPolicy.from_env(env={"MXTPU_RPC_TIMEOUT_S": "0"})
+    assert nolimit.timeout_s is None
+    # garbage values fall back instead of crashing the transport
+    junk = RetryPolicy.from_env(env={"MXTPU_RPC_RETRIES": "lots"})
+    assert junk.retries == 2
+
+
+def test_from_env_overrides_win():
+    pol = RetryPolicy.from_env(env={"MXTPU_RPC_RETRIES": "9"},
+                               retries=1, deadline_s=3.0)
+    assert pol.retries == 1 and pol.deadline_s == 3.0
+
+
+# ----------------------------------------------------------------------
+# PSClient integration: typed connect failure, heartbeat swallow
+# ----------------------------------------------------------------------
+
+def _dead_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()                   # nothing listens here anymore
+    return port
+
+
+def test_psclient_connect_failure_is_typed_with_evidence(tmp_path,
+                                                         monkeypatch):
+    from mxnet_tpu.kvstore.ps_server import PSClient
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    port = _dead_port()
+    pol = RetryPolicy(retries=0, timeout_s=0.5)
+    with pytest.raises(PeerUnreachable) as ei:
+        PSClient("127.0.0.1", port, retries=1, policy=pol)
+    assert ei.value.op == "connect"
+    assert ei.value.peer == f"127.0.0.1:{port}"
+    assert _counters().get("rpc.failures") == 1
+    assert telemetry.last_flight_dump()       # connect death left a dump
+
+
+def test_beat_once_swallows_transport_errors_and_counts():
+    """A missed beat is the heartbeat DETECTOR's job to judge: the
+    beating worker must never crash on a transport error (ISSUE 19)."""
+    from mxnet_tpu.kvstore.ps_server import PSClient
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    client = PSClient.__new__(PSClient)       # skip the connect loop
+    client._policy = RetryPolicy(retries=0, timeout_s=0.2)
+    client._addr = ("127.0.0.1", _dead_port())
+    client._lock = threading.Lock()
+    client._hb_stop = None
+    sock = socket.socket()
+    sock.close()                              # every op fails typed
+    client._sock = sock
+    assert client.beat_once(0) is False
+    assert _counters().get("rpc.heartbeat.dropped") == 1
+    client.close()
+
+
+def test_rpc_error_hierarchy():
+    assert issubclass(RPCTimeout, RPCError)
+    assert issubclass(PeerUnreachable, RPCError)
+    assert issubclass(RPCError, ConnectionError)

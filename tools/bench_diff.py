@@ -52,12 +52,13 @@ _DOWN_SUFFIXES = ("_ms", "p99", "p50", "ttft", "bubble_frac",
 # config/provenance keys: never compared (a changed knob is not a perf
 # regression; the human reads those out of the payload directly)
 _SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
-              "lint_schema_version",
+              "lint_schema_version", "multiproc_schema_version",
               "batch", "dtype", "data",
               "steps_per_call", "s2d_stem", "n", "rc", "cmd", "tail",
               "time", "cached_at", "dp", "buckets", "epoch",
               "membership_epoch", "transitions", "ranks",
-              "slowest_rank", "tp_shards"}
+              "slowest_rank", "tp_shards",
+              "procs", "world_size", "rpc_retries", "rpc_timeout_s"}
 
 
 def direction(key):
@@ -193,6 +194,20 @@ def main(argv=None):
             and not args.allow_schema_drift:
         verdict.update(status="lint_schema_drift", old_schema=lvo,
                        new_schema=lvn)
+        print("BENCHDIFF " + json.dumps(verdict))
+        return 2
+
+    # the multiproc block (ISSUE 19) is versioned the same way: its
+    # recovery costs (coordinator_reinit_ms, sigkill_recover_ms) only
+    # compare within one schema
+    mvo = ((old.get("extra") or {}).get("multiproc")
+           or {}).get("multiproc_schema_version")
+    mvn = ((new.get("extra") or {}).get("multiproc")
+           or {}).get("multiproc_schema_version")
+    if mvo is not None and mvn is not None and mvo != mvn \
+            and not args.allow_schema_drift:
+        verdict.update(status="multiproc_schema_drift", old_schema=mvo,
+                       new_schema=mvn)
         print("BENCHDIFF " + json.dumps(verdict))
         return 2
 

@@ -15,15 +15,30 @@ each gets JAX_PLATFORMS=cpu and a private coordinator port so the whole
 flow (rendezvous, psum over processes, barrier) runs on one box.
 ``--launcher ssh`` emits the per-host command lines (zero-egress images
 cannot ssh; print instead of exec so the operator's scheduler runs them).
+
+``--supervise`` (ISSUE 19) upgrades the local mode into the real pod
+launcher built on :class:`mxnet_tpu.pod.PodLauncher`: children are
+watched, a worker death is COMMITTED as a membership change (atomic
+``membership.json`` with a fresh coordinator port), and the survivors
+tear down + re-init the JAX coordination service at the smaller world
+size (``_dist_init.reinit_distributed``) and resume from the shared
+checkpoint — a real death changes ``jax.process_count()``.  With no
+command given it runs the deterministic ``mxnet_tpu.testing.pod_worker``
+workload; the final stdout line is one JSON summary (epoch, dead ranks,
+requeued requests).
+
+    python tools/launch.py -n 4 --supervise --pod-dir /tmp/pod --steps 8
 """
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 
 
 def _free_port():
@@ -32,6 +47,31 @@ def _free_port():
     port = s.getsockname()[1]
     s.close()
     return port
+
+
+def supervise(args):
+    """The pod mode: spawn + supervise through mxnet_tpu.pod, print one
+    JSON summary line (what tools/tpu_queue_runner.py parses)."""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from mxnet_tpu.pod import PodLauncher
+    pod_dir = args.pod_dir or tempfile.mkdtemp(prefix="mxtpu_pod_")
+    env = {}
+    for kv in args.env:
+        k, _, v = kv.partition("=")
+        env[k] = v
+    launcher = PodLauncher(args.num_workers, pod_dir,
+                           argv=args.command or None, env=env,
+                           steps=args.steps,
+                           ckpt_every=args.ckpt_every)
+    launcher.start()
+    try:
+        summary = launcher.supervise(timeout_s=args.timeout)
+    finally:
+        launcher.shutdown()
+    summary["pod_dir"] = pod_dir
+    print("PODLAUNCH " + json.dumps(summary))
+    return 0 if set(summary["done"]) else 1
 
 
 def main():
@@ -48,8 +88,23 @@ def main():
                     help="one host per line (ssh launcher)")
     ap.add_argument("--env", action="append", default=[],
                     help="extra KEY=VALUE env for workers")
+    ap.add_argument("--supervise", action="store_true",
+                    help="pod mode (ISSUE 19): watch children, commit "
+                         "membership changes on death, survivors "
+                         "re-init jax.distributed at the new world")
+    ap.add_argument("--pod-dir", default=None,
+                    help="control-plane directory for --supervise "
+                         "(default: a fresh temp dir)")
+    ap.add_argument("--steps", type=int, default=8,
+                    help="pod_worker training steps (--supervise)")
+    ap.add_argument("--ckpt-every", type=int, default=3,
+                    help="pod_worker checkpoint cadence (--supervise)")
+    ap.add_argument("--timeout", type=float, default=300.0,
+                    help="supervision deadline in seconds")
     ap.add_argument("command", nargs=argparse.REMAINDER)
     args = ap.parse_args()
+    if args.supervise:
+        return supervise(args)
     if not args.command:
         ap.error("no command given")
     cmd = args.command

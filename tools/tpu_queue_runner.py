@@ -515,9 +515,9 @@ def step_bert128(st: dict) -> None:
 
 
 def run_chaos(suite: str = "preempt") -> int:
-    """``--chaos [elastic|serving|autoscale|watchdog|fleet|all]``: the
-    fault-tolerance smoke (mxnet_tpu.testing.chaos) in a child process
-    on the simulated
+    """``--chaos [elastic|serving|autoscale|watchdog|fleet|procs|all]``:
+    the fault-tolerance smoke (mxnet_tpu.testing.chaos) in a child
+    process on the simulated
     CPU mesh.  Default suite: kill the checkpoint writer, preempt at
     step K, corrupt the newest checkpoint, auto-resume, bitwise parity.
     ``elastic`` (ISSUE 8): kill worker 1 at step K via silent
@@ -542,7 +542,14 @@ def run_chaos(suite: str = "preempt") -> int:
     straggler and one scrape-dead rank — the FleetCollector must name
     both BY RANK in typed ``fleet.*`` events with matching flight
     dumps, merged histograms must equal per-rank bucket sums bitwise,
-    racecheck zero on the collector locks.  Needs no
+    racecheck zero on the collector locks.  ``procs`` (ISSUE 19): the
+    one suite with REAL processes — a 4-process ``jax.distributed`` pod
+    (mxnet_tpu.pod.PodLauncher), one worker SIGKILLed at a step gate;
+    survivors must re-init the coordination service at
+    ``jax.process_count()==3`` and resume BITWISE a fresh 3-process pod
+    restored from the same checkpoint, with the serving ledger
+    exactly-once and a real fleet scrape naming the dead rank typed.
+    Needs no
     TPU and takes no queue lock: safe to run any time, including while
     the measurement queue owns the chip."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
