@@ -31,6 +31,7 @@ from __future__ import annotations
 import threading
 import time
 import queue as _queue
+import weakref
 
 import numpy as _np
 
@@ -530,18 +531,30 @@ class DevicePrefetcher:
 # AsyncDecodeIter
 # ---------------------------------------------------------------------------
 
-#: idents of decode-pool worker threads whose owning pool's ``close()``
-#: HAS run (work cancelled, shutdown signalled) but which may still be
-#: finishing one in-flight sample decode.  The tests' thread-leak guard
-#: reads this through :func:`closing_thread_idents` to tell
-#: "mid-shutdown with a closer" (longer grace) from a genuine leak
-#: (no closer ever ran).
-_CLOSING_THREADS = set()
+#: weakrefs to decode-pool worker threads whose owning pool's
+#: ``close()`` HAS run (work cancelled, shutdown signalled) but which
+#: may still be finishing one in-flight sample decode.  The tests'
+#: thread-leak guard reads this through :func:`closing_thread_idents`
+#: to tell "mid-shutdown with a closer" (longer grace) from a genuine
+#: leak (no closer ever ran).  Weakrefs, not idents: OS thread idents
+#: are REUSED, so a bare-ident set would let a later genuinely-leaked
+#: thread inherit a stale entry's grace — and grow forever.
+_CLOSING_THREADS = []
 
 
 def closing_thread_idents():
-    """Snapshot of thread idents registered by a pool ``close()``."""
-    return set(_CLOSING_THREADS)
+    """Idents of still-alive threads registered by a pool ``close()``.
+    Exited (or collected) threads are pruned on every read, so the
+    registry stays bounded and a reused ident never matches."""
+    alive, out = [], set()
+    for ref in _CLOSING_THREADS:
+        t = ref()
+        if t is not None and t.is_alive():
+            alive.append(ref)
+            if t.ident is not None:
+                out.add(t.ident)
+    _CLOSING_THREADS[:] = alive
+    return out
 
 
 class AsyncDecodeIter:
@@ -637,8 +650,7 @@ class AsyncDecodeIter:
         threads = [t for t in getattr(self._pool, "_threads", ())
                    if t is not None]
         for t in threads:
-            if t.ident is not None:
-                _CLOSING_THREADS.add(t.ident)
+            _CLOSING_THREADS.append(weakref.ref(t))
         deadline = time.monotonic() + max(0.0, float(timeout_s))
         for t in threads:
             t.join(timeout=max(0.0, deadline - time.monotonic()))

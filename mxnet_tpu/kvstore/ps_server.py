@@ -596,6 +596,10 @@ class PSClient:
     """Worker-side connection to ONE server (the ps::KVWorker role; the
     kvstore owns one client per server and routes by key_to_server)."""
 
+    #: class-level default so a half-built client (tests construct via
+    #: ``__new__``) still answers the closed check
+    _closed = False
+
     def __init__(self, host, port, retries=60, policy=None):
         from .rpc import RetryPolicy, PeerUnreachable, report_failure
         self._policy = policy if policy is not None \
@@ -603,6 +607,7 @@ class PSClient:
         self._addr = (host, port)
         self._lock = _racecheck.make_lock("PSClient._lock")
         self._hb_stop = None      # threading.Event while beating
+        self._closed = False
         last = None
         for _ in range(retries):
             try:
@@ -629,6 +634,15 @@ class PSClient:
         — the socket IS the locked RPC channel, and a reconnect racing
         another thread's in-flight exchange would otherwise swap it out
         from under a half-read frame."""
+        if self._closed:
+            # close() is lock-free so it can interrupt a blocked
+            # exchange; a retry racing it must NOT resurrect the socket
+            # (the owner believes the client is closed — a reconnect
+            # here would leak a live fd nobody will ever close)
+            from .rpc import PeerUnreachable
+            raise PeerUnreachable(
+                "PSClient to %s:%s is closed" % self._addr,
+                peer="%s:%s" % self._addr, op="connect")
         new = socket.create_connection(self._addr,
                                        timeout=timeout_s or 120)
         new.settimeout(None)
@@ -641,8 +655,13 @@ class PSClient:
             except OSError:
                 pass
 
-    def _rpc(self, payload, blocking=False):
+    def _rpc(self, payload, blocking=False, idempotent=False):
         op_name = _OP_NAMES.get(payload[0], f"op{payload[0]}")
+        if self._closed:
+            from .rpc import PeerUnreachable
+            raise PeerUnreachable(
+                "PSClient to %s:%s is closed" % self._addr,
+                peer="%s:%s" % self._addr, op=op_name)
         # cross-worker trace stitching (ISSUE 15): when this thread has
         # an ambient span, prefix its (trace, span) ids so the server's
         # handling span discloses the remote parent — a push/pushpull/
@@ -685,7 +704,17 @@ class PSClient:
                 raise _classify(e, peer="%s:%s" % self._addr,
                                 op=op_name, attempts=1) from e
         else:
-            resp = self._policy.run(
+            # the retry budget is reserved for ops the server can
+            # safely see TWICE (reads, heartbeats).  Mutating ops
+            # (push is `w += grad` / an optimizer apply, cmd appends
+            # to the command log, join announces) share barrier's
+            # double-apply hazard: a reply lost AFTER the server
+            # processed the request would make a blind resend apply it
+            # again — so they run one typed, deadline-bounded attempt
+            # and leave recovery to the caller, who knows whether the
+            # op landed (e.g. via pull/stats).
+            policy = self._policy if idempotent else self._policy.once()
+            resp = policy.run(
                 _attempt, peer="%s:%s" % self._addr, op=op_name,
                 reconnect=self._connect)
         op = resp[0]
@@ -710,14 +739,15 @@ class PSClient:
                          + _pack_tensor(_np.asarray(grad)))
 
     def pull(self, key):
-        return self._rpc(bytes([_OP_PULL]) + _pack_key(key))
+        return self._rpc(bytes([_OP_PULL]) + _pack_key(key),
+                         idempotent=True)
 
     def set_optimizer(self, optimizer):
         return self._rpc(bytes([_OP_SET_OPT]) + _pack_text(
             _serialize_optimizer_conf(optimizer)))
 
     def stats(self):
-        return self._rpc(bytes([_OP_STATS]))
+        return self._rpc(bytes([_OP_STATS]), idempotent=True)
 
     def send_command(self, head, body):
         return self._rpc(bytes([_OP_CMD]) + struct.pack("<i", int(head))
@@ -725,7 +755,7 @@ class PSClient:
 
     def command_log(self):
         """Recent (head, body) controller messages this server received."""
-        return self._rpc(bytes([_OP_CMDLOG]))
+        return self._rpc(bytes([_OP_CMDLOG]), idempotent=True)
 
     def barrier(self):
         return self._rpc(bytes([_OP_BARRIER]), blocking=True)
@@ -743,12 +773,12 @@ class PSClient:
     def membership(self):
         """The server's membership view: {epoch, ranks, state, pending}
         (epoch None when the server runs without elastic membership)."""
-        return self._rpc(bytes([_OP_MEMBERSHIP]))
+        return self._rpc(bytes([_OP_MEMBERSHIP]), idempotent=True)
 
     def health(self):
         """Server's liveness view: {alive: {rank: age_s}, dead: [ranks],
         heartbeat_timeout, num_workers}."""
-        return self._rpc(bytes([_OP_HEALTH]))
+        return self._rpc(bytes([_OP_HEALTH]), idempotent=True)
 
     def telemetry(self, fmt="json"):
         """Scrape the server process's ``mx.telemetry`` state (ISSUE 9):
@@ -759,7 +789,7 @@ class PSClient:
         ``{"snapshot", "spans", "dropped_spans"}`` — the payload
         ``telemetry.fleet.FleetCollector`` merges and stitches."""
         code = {"prom": 1, "fleet": 2}.get(fmt, 0)
-        return self._rpc(bytes([_OP_TELEMETRY, code]))
+        return self._rpc(bytes([_OP_TELEMETRY, code]), idempotent=True)
 
     def beat_once(self, rank):
         """Send ONE heartbeat for ``rank`` synchronously over the RPC
@@ -776,8 +806,11 @@ class PSClient:
         if _faults.fault_point("ps.heartbeat.drop", rank) == "drop":
             return False
         try:
+            # a repeated beat only refreshes last-seen: idempotent,
+            # safe to retry
             self._rpc(bytes([_OP_HEARTBEAT]) + struct.pack("<i",
-                                                           int(rank)))
+                                                           int(rank)),
+                      idempotent=True)
         except RPCError:
             from .. import telemetry as _telemetry
             _telemetry.inc("rpc.heartbeat.dropped")
@@ -837,6 +870,10 @@ class PSClient:
         threading.Thread(target=_beat, daemon=True).start()
 
     def close(self):
+        # the flag first: _connect/_rpc check it, so a concurrent retry
+        # observing the dying socket fails typed (PeerUnreachable)
+        # instead of reconnecting a client the owner believes is closed
+        self._closed = True
         if self._hb_stop is not None:
             self._hb_stop.set()
             self._hb_stop = None

@@ -30,7 +30,10 @@ Env knobs (read per-policy at construction, see ``RetryPolicy.from_env``):
   (default 5.0; 0 disables the deadline: block forever, pre-19 behavior).
 - ``MXTPU_RPC_RETRIES`` — attempts AFTER the first (default 2).
   ``0`` is the kill switch: single attempt, no backoff — exactly the
-  pre-19 single-shot behavior, but still typed.
+  pre-19 single-shot behavior, but still typed.  The budget applies to
+  IDEMPOTENT ops only (reads, heartbeats): mutating ops (push/init/
+  cmd/...) always run single-attempt via :meth:`RetryPolicy.once`,
+  because a resend after a lost reply could double-apply server-side.
 - ``MXTPU_RPC_BACKOFF_S`` / ``MXTPU_RPC_BACKOFF_MAX_S`` — initial and
   cap of the exponential backoff (defaults 0.05 / 2.0).
 - ``MXTPU_RPC_DEADLINE_S`` — optional TOTAL deadline across all
@@ -124,6 +127,20 @@ class RetryPolicy:
         """Deterministic (per seeded rng state) backoff for attempt i."""
         base = min(self.backoff_max_s, self.backoff_s * (2.0 ** attempt))
         return base * (1.0 + 0.1 * self._rng.random())
+
+    def once(self):
+        """A single-attempt twin sharing this policy's deadlines and
+        clocks — for NON-idempotent ops.  A reply lost after the server
+        already applied the op (per-attempt timeout, connection reset
+        before the OK is read) would make a blind resend apply it
+        TWICE (push is ``w += grad`` server-side), so such ops get one
+        typed, deadline-bounded attempt: the same evidence trail as
+        ``run``, just no retry loop."""
+        return RetryPolicy(retries=0, timeout_s=self.timeout_s or 0,
+                           backoff_s=self.backoff_s,
+                           backoff_max_s=self.backoff_max_s,
+                           deadline_s=self.deadline_s or 0,
+                           now=self._now, sleep=self._sleep)
 
     def run(self, attempt_fn, peer=None, op=None, reconnect=None,
             on_failure=None):

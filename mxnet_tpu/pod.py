@@ -21,9 +21,17 @@ extra sockets and survives any worker death mid-write:
 - ``ready.<epoch>.<step>.<orig_rank>`` / ``go.<epoch>.<step>`` — the
   step gate.  Workers report at every step boundary and wait for the
   launcher's approval; the launcher approves a step only while every
-  live member is present, so a death is drained at a boundary (exactly
-  the elastic controller's drain-at-step-boundary contract) instead of
-  wedging survivors inside a collective that is missing a peer.
+  live member is present, so a death observed while workers are PARKED
+  at the gate is drained at that boundary (exactly the elastic
+  controller's drain-at-step-boundary contract).  The gate cannot
+  retract an approval already granted: a kill landing after the go
+  file, with survivors inside the step's collective, leaves them
+  blocked on the missing peer (the coordination-service heartbeat
+  budget is deliberately huge and its callback benign — see
+  ``_dist_init``) until ``supervise()``'s pod-level ``timeout_s`` kills
+  the pod.  Deterministic mid-step recovery therefore requires the kill
+  to land in a parked window — which is what the ``hold_step`` chaos
+  hook arranges, and why the chaos scenario kills at a hold.
 - ``queue/{pending,inflight,done}`` — the file-lease serving queue.
   Workers claim requests by atomic rename into ``inflight`` (one
   winner per request), write the result into ``done``, then release
@@ -232,7 +240,11 @@ class PodLauncher:
         requeued = []
         for name in os.listdir(dirs["inflight"]):
             stem, _, owner = name.rpartition(".lease.")
-            if not stem or int(owner or -1) not in dead_ranks:
+            # a name without a numeric owner suffix is not a lease we
+            # wrote — skip it rather than crashing supervise() mid
+            # death-handling over one corrupt/foreign file
+            if not stem or not owner.isdigit() \
+                    or int(owner) not in dead_ranks:
                 continue
             src = os.path.join(dirs["inflight"], name)
             if os.path.exists(os.path.join(dirs["done"], stem)):
@@ -294,7 +306,14 @@ class PodLauncher:
         death requeue its leases and commit a shrunk membership (the
         survivors reinit + restore at the next gate poll).  Returns a
         summary dict.  ``on_death(orig_rank, epoch)`` is the chaos
-        observation hook."""
+        observation hook.
+
+        Recovery is deterministic only for deaths drained at a gate
+        (survivors parked, e.g. under ``hold_step``).  A kill landing
+        mid-step can leave survivors blocked inside a collective on the
+        missing peer; nothing interrupts that (see the module
+        docstring), so the only backstop is ``timeout_s``: the whole
+        pod is killed and a :class:`TimeoutError` raised."""
         deadline = time.monotonic() + timeout_s
         requeued = []
         while self._live():

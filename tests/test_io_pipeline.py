@@ -220,6 +220,23 @@ def test_async_decode_iter_close_joins_pool_threads():
     it.close()                                    # idempotent
 
 
+def test_closing_thread_registry_prunes_dead_threads():
+    """OS thread idents are reused: an ident left registered after its
+    thread exited could hand the conftest leak guard's long grace to a
+    LATER genuinely-leaked thread (and the registry would grow without
+    bound).  closing_thread_idents() must prune exited threads."""
+    from mxnet_tpu.io.prefetch import closing_thread_idents
+
+    it = AsyncDecodeIter(lambda i: i, range(8), batch_size=4,
+                         n_workers=2, lookahead=1)
+    next(it)
+    pool_threads = list(it._pool._threads)
+    it.close()                     # registers, then joins the workers
+    assert all(not t.is_alive() for t in pool_threads)
+    dead_idents = {t.ident for t in pool_threads}
+    assert not closing_thread_idents() & dead_idents
+
+
 # ----------------------------------------------------------------------
 # ImageRecordIter preprocess_threads plumbing (pure-Python decode path)
 # ----------------------------------------------------------------------

@@ -66,6 +66,23 @@ def test_requeue_returns_unfinished_only(tmp_path):
     assert led["inflight"] == [] and led["done"] == ["b"]
 
 
+def test_requeue_skips_junk_lease_names(tmp_path):
+    """_requeue_leases runs inside supervise()'s death handling: a
+    corrupt/foreign inflight name with a non-numeric owner suffix must
+    be skipped, not crash the whole pod run with ValueError."""
+    d = str(tmp_path)
+    submit_request(d, "a", {})
+    q = os.path.join(d, "queue")
+    os.replace(os.path.join(q, "pending", "a.json"),
+               os.path.join(q, "inflight", "a.json.lease.1"))
+    for junk in ("b.json.lease.", "b.json.lease.abc", "noise.tmp"):
+        open(os.path.join(q, "inflight", junk), "w").close()
+    launcher = PodLauncher.__new__(PodLauncher)
+    launcher.pod_dir = d
+    assert launcher._requeue_leases({1}) == ["a"]
+    assert queue_ledger(d)["pending"] == ["a"]
+
+
 def test_gate_hold_withholds_approval(tmp_path):
     launcher = PodLauncher(2, str(tmp_path))
     launcher.epoch = 1

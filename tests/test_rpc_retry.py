@@ -254,6 +254,15 @@ def test_from_env_overrides_win():
     assert pol.retries == 1 and pol.deadline_s == 3.0
 
 
+def test_once_is_single_attempt_with_same_deadlines():
+    pol = RetryPolicy(retries=5, timeout_s=2.0, deadline_s=9.0)
+    one = pol.once()
+    assert one.retries == 0
+    assert one.timeout_s == 2.0 and one.deadline_s == 9.0
+    # "block forever" (timeout 0/None) survives the copy
+    assert RetryPolicy(retries=3, timeout_s=0).once().timeout_s is None
+
+
 # ----------------------------------------------------------------------
 # PSClient integration: typed connect failure, heartbeat swallow
 # ----------------------------------------------------------------------
@@ -264,6 +273,60 @@ def _dead_port():
     port = s.getsockname()[1]
     s.close()                   # nothing listens here anymore
     return port
+
+
+def _offline_client(policy):
+    """A PSClient wired to a closed socket + dead port: every attempt
+    and every reconnect fails fast and typed, no server needed."""
+    from mxnet_tpu.kvstore.ps_server import PSClient
+    client = PSClient.__new__(PSClient)       # skip the connect loop
+    client._policy = policy
+    client._addr = ("127.0.0.1", _dead_port())
+    client._lock = threading.Lock()
+    client._hb_stop = None
+    sock = socket.socket()
+    sock.close()                              # every op fails typed
+    client._sock = sock
+    return client
+
+
+def test_mutating_ops_single_attempt_reads_keep_the_budget(tmp_path,
+                                                           monkeypatch):
+    """push applies ``w += grad`` server-side: a reply lost AFTER the
+    server processed it would make a blind resend apply the gradient
+    twice — so mutating ops must never burn the retry budget, while
+    read-only pull keeps it (ISSUE 19 review)."""
+    import numpy as np
+    monkeypatch.setenv("MXTPU_FLIGHT_DIR", str(tmp_path))
+    telemetry.configure(enabled=True)
+    telemetry.reset()
+    client = _offline_client(RetryPolicy(retries=3, timeout_s=0.2,
+                                         sleep=lambda s: None))
+    for call in (lambda: client.push("w", np.zeros(1, np.float32)),
+                 lambda: client.init("w", np.zeros(1, np.float32)),
+                 lambda: client.send_command(0, "lr:0.1")):
+        with pytest.raises(RPCError) as ei:
+            call()
+        assert ei.value.attempts == 1         # exactly one shot
+    assert not _counters().get("rpc.retries")  # no resend ever happened
+    with pytest.raises(RPCError) as ei:
+        client.pull("w")
+    assert ei.value.attempts == 4             # 1 + retries, all spent
+    assert _counters().get("rpc.retries") == 3
+    client.close()
+
+
+def test_closed_client_fails_fast_and_never_reconnects():
+    """close() is lock-free so it can interrupt a blocked exchange; a
+    retry racing it must fail typed, not reconnect a fresh socket on a
+    client the owner believes is closed (ISSUE 19 review)."""
+    client = _offline_client(RetryPolicy(retries=2, timeout_s=0.2,
+                                         sleep=lambda s: None))
+    client.close()
+    with pytest.raises(PeerUnreachable):
+        client.pull("w")
+    with pytest.raises(PeerUnreachable):      # the reconnect seam itself
+        client._connect(0.1)
 
 
 def test_psclient_connect_failure_is_typed_with_evidence(tmp_path,
