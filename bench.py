@@ -871,6 +871,54 @@ def _bench_multiproc() -> dict:
     return blk
 
 
+QUANT_SCHEMA_VERSION = 1
+
+
+def _bench_quant() -> dict:
+    """Low-precision compute evidence (ISSUE 20): the two env knobs'
+    config (``MXTPU_COMPUTE_DTYPE`` / ``MXTPU_KV_DTYPE``, real on any
+    host) plus the fp8-KV capacity arithmetic.  ``kv_capacity_ratio``
+    is pool MATH, not a device measurement — allocatable blocks at
+    equal HBM bytes, fp8 codes + per-row scale overhead vs f32 — so it
+    ships real everywhere.  The device-measured fields
+    (``kv_decode_drift`` from a serving run under fp8 KV,
+    ``quant_train_mfu`` from a quantized training step on TPU) ship
+    null unless THIS run filled their telemetry gauges — the
+    null-when-unmeasured honesty rule."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.ops.quant_kv import (kv_blocks_in_budget,
+                                        resolve_kv_dtype)
+    from mxnet_tpu.ops.quant_matmul import resolve_compute_dtype
+    # a ~0.5B-class serving geometry; the ratio is budget-invariant
+    # past integer rounding
+    geom = dict(num_layers=24, num_kv_heads=8, head_dim=128,
+                block_size=16)
+    budget = 8 << 30
+    f32_blocks = kv_blocks_in_budget(budget, **geom)
+    fp8_blocks = kv_blocks_in_budget(budget, kv_dtype="fp8", **geom)
+    blk = {
+        "quant_schema_version": QUANT_SCHEMA_VERSION,
+        "compute_dtype": resolve_compute_dtype() or "fp32",
+        "kv_dtype": resolve_kv_dtype() or "fp32",
+        "kv_capacity_ratio": round(fp8_blocks / f32_blocks, 3),
+        "kv_decode_drift": None,
+        "quant_train_mfu": None,
+    }
+    if telemetry.enabled():
+        v = telemetry.value("serving.kv_decode_drift")
+        if v is not None:
+            blk["kv_decode_drift"] = v
+        v = telemetry.value("quant.train_mfu")
+        if v is not None:
+            blk["quant_train_mfu"] = v
+    if blk["kv_decode_drift"] is None and blk["quant_train_mfu"] is None:
+        blk["note"] = ("drift/MFU unmeasured this run (nulls, not "
+                       "zeros); drift evidence: tools/serve_loadgen.py "
+                       "--kv-dtype fp8 and tools/tpu_queue_runner.py "
+                       "--chaos serving under MXTPU_KV_DTYPE=fp8")
+    return blk
+
+
 _RESNET50_GRAD_BYTES = 25_557_032 * 2   # param count x bf16
 
 
@@ -1040,6 +1088,11 @@ def _run_bench() -> dict:
             result["extra"]["multiproc"] = _bench_multiproc()
         except Exception as e:  # noqa: BLE001
             result["extra"]["multiproc"] = {
+                "error": f"{type(e).__name__}: {e}"}
+        try:
+            result["extra"]["quant"] = _bench_quant()
+        except Exception as e:  # noqa: BLE001
+            result["extra"]["quant"] = {
                 "error": f"{type(e).__name__}: {e}"}
         result["extra"]["scaling_projection"] = _scaling_projection(
             result, rec)

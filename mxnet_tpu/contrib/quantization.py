@@ -28,9 +28,10 @@ __all__ = ["quantize_net", "quantize_model", "calib_thresholds", "QuantizedDense
 def _quant_params_symmetric(w, axis=None):
     """Per-channel symmetric int8 scale for weights: s = max|w| / 127."""
     import jax.numpy as jnp
+    from ..ops.quant_matmul import quantize_rtn_int8
     amax = jnp.max(jnp.abs(w), axis=axis, keepdims=axis is not None)
     scale = jnp.maximum(amax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(w / scale), -127, 127).astype(jnp.int8)
+    q = quantize_rtn_int8(w, scale)
     return q, scale
 
 
@@ -162,8 +163,8 @@ class QuantizedDense(HybridBlock):
             lead = d.shape[:1] if flatten else d.shape[:-1]
             flat = d.reshape(d.shape[0], -1) if flatten \
                 else d.reshape(-1, d.shape[-1])
-            qx = jnp.clip(jnp.round(flat / a_scale), -127, 127) \
-                .astype(jnp.int8)
+            from ..ops.quant_matmul import quantize_rtn_int8
+            qx = quantize_rtn_int8(flat, a_scale)
             acc = lax.dot_general(
                 qx, qw, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.int32)
@@ -205,8 +206,8 @@ class QuantizedConv2D(HybridBlock):
         groups = self._groups
 
         def fn(d):
-            qx = jnp.clip(jnp.round(d / a_scale), -127, 127) \
-                .astype(jnp.int8)
+            from ..ops.quant_matmul import quantize_rtn_int8
+            qx = quantize_rtn_int8(d, a_scale)
             dn = lax.conv_dimension_numbers(qx.shape, qw.shape,
                                             ("NCHW", "OIHW", "NCHW"))
             acc = lax.conv_general_dilated(

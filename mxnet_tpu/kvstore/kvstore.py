@@ -1040,7 +1040,8 @@ class Int8GradientCompression:
         blocks = flat.reshape(-1, b)
         scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
         scale = jnp.maximum(scale, 1e-30)
-        q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+        from ..ops.quant_matmul import quantize_rtn_int8
+        q = quantize_rtn_int8(blocks, scale)
         deq = (q.astype(jnp.float32) * scale).reshape(-1)
         deq = deq[:g.size].reshape(g.shape).astype(grad.dtype)
         self._residuals[key] = g - deq

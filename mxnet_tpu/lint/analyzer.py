@@ -1241,6 +1241,92 @@ class _MeshAxisLiteralScanner(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+_HB21_LOWP_ATTRS = frozenset({
+    "int8", "bfloat16",
+    "float8_e4m3fn", "float8_e5m2", "float8_e4m3", "float8_e4m3fnuz",
+    "float8_e5m2fnuz",
+})
+_HB21_LOWP_STRINGS = frozenset(_HB21_LOWP_ATTRS)
+# the scaled-cast helpers live here; casts inside them ARE the pattern
+_HB21_EXEMPT_SUFFIXES = ("ops/quant_matmul.py", "ops/quant_kv.py")
+
+
+class _LowPrecisionCastScanner(ast.NodeVisitor):
+    """HB21: a raw ``.astype(int8/fp8/bf16)`` (or
+    ``lax.convert_element_type`` to one of those dtypes) anywhere
+    outside the ``ops/quant_*`` scaled helpers.  Narrow formats clip:
+    int8 saturates at ±127 and fp8-e4m3 at ±448, so a cast whose
+    operand wasn't divided by an amax-derived scale silently flushes
+    the tensor's tails — loss spikes on TPU that CPU tier-1 (running
+    the same cast on the same small values) never sees.  Route the
+    cast through ``ops.quant_matmul`` (``quantize_rtn_int8`` /
+    ``quantize_sr_int8`` / ``quant_matmul``) or ``ops.quant_kv``
+    (``kv_quantize_fp8`` / ``kv_cast``) so a scale always rides with
+    the narrowed bits."""
+
+    def __init__(self, collector, path):
+        self.c = collector
+        self.path = path
+        self.func_stack = ["<module>"]
+        norm = path.replace("\\", "/")
+        self.exempt = norm.endswith(_HB21_EXEMPT_SUFFIXES)
+
+    def visit_FunctionDef(self, node):
+        self.func_stack.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    @staticmethod
+    def _lowp_name(expr):
+        """The low-precision dtype a cast-argument expression names, or
+        None.  Matches ``jnp.int8``-style attributes, bare ``int8``
+        names, and ``"int8"``-style dtype strings — anywhere inside the
+        argument (covers conditional dtype picks)."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Attribute) and n.attr in _HB21_LOWP_ATTRS:
+                return n.attr
+            if isinstance(n, ast.Name) and n.id in _HB21_LOWP_ATTRS:
+                return n.id
+            if isinstance(n, ast.Constant) and \
+                    isinstance(n.value, str) and \
+                    n.value in _HB21_LOWP_STRINGS:
+                return n.value
+        return None
+
+    def _add(self, node, dtype_name, callee):
+        self.c.add(Violation(
+            rule="HB21", path=self.path, line=node.lineno,
+            col=getattr(node, "col_offset", 0),
+            message=f"raw `{callee}` cast to {dtype_name}: narrow "
+                    "formats clip (int8 ±127, fp8-e4m3 ±448), so an "
+                    "unscaled cast silently flushes the tensor's tails"
+                    " — use the scaled helpers in ops.quant_matmul "
+                    "(quantize_rtn_int8 / quantize_sr_int8) or "
+                    "ops.quant_kv (kv_quantize_fp8 / kv_cast) so an "
+                    "amax scale rides with the narrowed bits",
+            block="", func=self.func_stack[-1]))
+
+    def visit_Call(self, node):
+        if not self.exempt:
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "astype" \
+                    and node.args:
+                dt = self._lowp_name(node.args[0])
+                if dt is not None:
+                    self._add(node, dt, "astype")
+            elif isinstance(f, ast.Attribute) and \
+                    f.attr == "convert_element_type" and \
+                    len(node.args) >= 2:
+                dt = self._lowp_name(node.args[1])
+                if dt is not None:
+                    self._add(node, dt, "lax.convert_element_type")
+        self.generic_visit(node)
+
+
 class _Collector:
     def __init__(self, index, path):
         self.index = index
@@ -1384,6 +1470,8 @@ def lint_source(source, path="<string>", only_classes=None, rules=None):
         _DecodeLoopPullScanner(collector, path).visit(tree)
         _UnsyncedTimingScanner(collector, path).visit(tree)
         _MeshAxisLiteralScanner(collector, path).visit(tree)
+        # HB21: unscaled low-precision casts (ISSUE 20)
+        _LowPrecisionCastScanner(collector, path).visit(tree)
         # HB14/HB15/HB16: the interprocedural concurrency pass (per-class
         # lock + field-access + call-graph model; concurrency.py)
         run_concurrency_pass(collector, tree, path, src_lines)

@@ -335,6 +335,28 @@ RULES = {
         "new = step(params)\n"
         "self._snapshot = new             # alias the RESULT, which\n"
         "                                 # nobody donates"),
+    "HB21": Rule(
+        "HB21", "unscaled-lowp-cast",
+        "A raw `.astype(...)` (or `lax.convert_element_type`) to a "
+        "narrow format — int8, fp8 (float8_e4m3fn / float8_e5m2), or "
+        "bf16 — outside the ops/quant_* scaled helpers.  Narrow "
+        "formats clip: int8 saturates at ±127, fp8-e4m3 at ±448, so a "
+        "cast whose operand was never divided by an amax-derived "
+        "scale silently flushes the tensor's tails to the format "
+        "ceiling.  CPU tier-1 runs the same cast on the same tame "
+        "values and passes; the loss spike fires on the first real "
+        "TPU round with production magnitudes (ISSUE 20).  Route the "
+        "cast through ops.quant_matmul (quantize_rtn_int8 / "
+        "quantize_sr_int8 / quant_matmul) or ops.quant_kv "
+        "(kv_quantize_fp8 / kv_cast) so a scale always rides with the "
+        "narrowed bits; genuinely scale-free casts (bf16 keeps f32's "
+        "exponent range on a comms wire) carry a per-line disable "
+        "with the justification.",
+        "q = x.astype(jnp.int8)            # |x|>127 saturates\n"
+        "k = keys.astype(jnp.float8_e4m3fn)  # tails flushed at 448",
+        "from mxnet_tpu.ops.quant_matmul import quantize_rtn_int8\n"
+        "q = quantize_rtn_int8(x, scale)   # scale rides with the cast\n"
+        "codes, s = kv_quantize_fp8(keys)  # per-row amax scales"),
 }
 
 ALL_RULE_IDS = tuple(sorted(RULES))

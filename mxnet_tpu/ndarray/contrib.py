@@ -522,7 +522,10 @@ def quantize(data, min_range, max_range, out_type="uint8"):
             qmin, qmax = -127.0, 127.0
         scale = (qmax - qmin) / jnp.maximum(hi - lo, 1e-12)
         q = jnp.clip(jnp.round((d - lo) * scale + qmin), qmin, qmax)
-        return q.astype(jnp.uint8 if out_type == "uint8" else jnp.int8)
+        # affine (min/max-range) cast: the scale was applied the line
+        # above; the symmetric ops.quant_matmul helpers don't fit
+        return q.astype(  # mxlint: disable=HB21
+            jnp.uint8 if out_type == "uint8" else jnp.int8)
     return apply_nary(fn, [data, min_range, max_range], name="quantize")
 
 

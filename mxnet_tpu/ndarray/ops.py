@@ -744,11 +744,22 @@ def topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False,
 def FullyConnected(data, weight, bias=None, num_hidden=None, no_bias=False,
                    flatten=True):
     """Reference: src/operator/nn/fully_connected.cc. weight is (out, in) —
-    MXNet layout; the matmul hits the MXU as data @ weight.T."""
+    MXNet layout; the matmul hits the MXU as data @ weight.T.
+
+    MXTPU_COMPUTE_DTYPE=int8|fp8 (ISSUE 20) reroutes the matmul through
+    ops.quant_matmul — amax-scaled low-precision operands, f32
+    accumulation, custom VJP with quantized grad-side matmuls — making
+    this the single seam every Dense/projection in the trainer crosses.
+    Resolved at trace time: unset, the op is BITWISE the plain matmul."""
     inputs = [data, weight] + ([] if no_bias or bias is None else [bias])
+    from ..ops.quant_matmul import quant_matmul, resolve_compute_dtype
+    cd = resolve_compute_dtype()
     def fn(d, w, *b):
         x = d.reshape(d.shape[0], -1) if flatten and d.ndim > 2 else d
-        y = jnp.matmul(x, w.T)
+        if cd is not None:
+            y = quant_matmul(x, w.T, compute_dtype=cd, tag="fc")
+        else:
+            y = jnp.matmul(x, w.T)
         if b:
             y = y + b[0]
         return y

@@ -9,7 +9,10 @@ from .blocked_cross_entropy import fused_linear_cross_entropy
 from .fused_layernorm import fused_layer_norm
 from .fused_update import fused_bucket_rule
 from .paged_attention import paged_decode_attention
+from .quant_matmul import quant_matmul, resolve_compute_dtype
+from .quant_kv import resolve_kv_dtype
 
 __all__ = ["flash_attention", "fused_linear_cross_entropy",
            "fused_layer_norm", "fused_bucket_rule",
-           "paged_decode_attention"]
+           "paged_decode_attention", "quant_matmul",
+           "resolve_compute_dtype", "resolve_kv_dtype"]

@@ -1,0 +1,137 @@
+"""Low-precision KV-cache storage helpers (ISSUE 20).
+
+The serving KV pools are pure STORAGE: every graph family writes
+freshly-computed f32 K/V rows into pool blocks and reads them back for
+attention.  Storing those rows in 8 bits doubles (4x, with fp8) the
+sequences one HBM budget holds — the capacity lever behind
+``MXTPU_KV_DTYPE`` — at the price of a bounded decode drift, since the
+attention math itself stays f32 (quantize-on-write / dequantize-in-
+attention; prefill attends over the fresh K/V and is untouched).
+
+Scaling scheme (``fp8``, the interesting mode):
+
+- codes are ``float8_e4m3fn`` (max normal 448);
+- ONE f32 amax scale per written token row — amax over that row's
+  (kv_heads, head_dim) values — stored in ``(layers, num_blocks,
+  block_size)`` scale arrays riding alongside the pools.  Per-row
+  scales make partial block writes exact: a decode step scattering one
+  row never needs to requantize its neighbours (a per-block scalar
+  would, the moment a new row raised the block amax).  Overhead is
+  ``4 / (kv_heads * head_dim)`` of the fp8 pool bytes — accounted, not
+  ignored, in :func:`kv_block_bytes`.
+- quantization is round-to-nearest (``astype`` to fp8); dequantization
+  multiplies the row scale back in f32 before any attention math.
+
+``bf16`` stores plain bfloat16 codes with NO scales (bf16 keeps f32's
+exponent range, so amax scaling buys nothing); ``fp32`` — and an unset
+``MXTPU_KV_DTYPE`` — is today's engine, bitwise (resolves to ``None``:
+no cast, no scales, no graph change).
+
+These helpers are the ONLY sanctioned home for raw low-precision
+``astype`` on KV tensors — mxlint HB21 (``unscaled-lowp-cast``) flags
+the pattern everywhere outside ``ops/quant*``.
+"""
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+
+from ..base import MXNetError
+
+__all__ = ["resolve_kv_dtype", "kv_pool_dtype", "kv_has_scales",
+           "kv_cast", "kv_quantize_fp8", "kv_dequantize",
+           "kv_block_bytes", "kv_blocks_in_budget", "FP8_MAX"]
+
+#: max normal magnitude of float8_e4m3fn — the fp8 amax scaling target.
+FP8_MAX = 448.0
+
+_CANON = {"fp8": "fp8", "float8": "fp8", "float8_e4m3fn": "fp8",
+          "bf16": "bf16", "bfloat16": "bf16",
+          "fp32": None, "float32": None}
+
+
+def resolve_kv_dtype(value=None):
+    """Canonical KV storage mode: ``"fp8"``, ``"bf16"``, or ``None``
+    (= f32, today's engine).  ``None`` input reads ``MXTPU_KV_DTYPE``;
+    unset/empty/``0``/``off``/``fp32`` all resolve to ``None`` so the
+    kill switch is bitwise-inert.  Unknown values raise (a typo must
+    not silently serve full-width)."""
+    if value is None:
+        value = os.environ.get("MXTPU_KV_DTYPE", "")
+    v = str(value).strip().lower()
+    if v in ("", "0", "off", "none"):
+        return None
+    if v not in _CANON:
+        raise MXNetError(
+            f"MXTPU_KV_DTYPE={value!r}: expected fp8|bf16|fp32")
+    return _CANON[v]
+
+
+def kv_pool_dtype(kv_dtype):
+    """The pool storage dtype for a resolved mode."""
+    if kv_dtype == "fp8":
+        return jnp.float8_e4m3fn
+    if kv_dtype == "bf16":
+        return jnp.bfloat16
+    return jnp.float32
+
+
+def kv_has_scales(kv_dtype):
+    """Only fp8 carries per-row amax scale arrays."""
+    return kv_dtype == "fp8"
+
+
+def kv_cast(x, dtype):
+    """Storage cast for the scale-free modes.  Identity (the SAME
+    traced array, so the unset path stays bitwise) when the dtype
+    already matches; otherwise the sanctioned bf16 storage cast."""
+    if x.dtype == dtype:
+        return x
+    return x.astype(dtype)
+
+
+def kv_quantize_fp8(x):
+    """Quantize K or V rows ``x`` (..., kv_heads, head_dim) f32 to
+    fp8 codes + per-row scales: amax over each row's (kvh, hd) values,
+    scale = amax / 448 (clamped away from 0 so all-zero rows — warmup,
+    null block — quantize to exact zeros), codes = round-to-nearest
+    fp8 of x / scale.  Returns ``(codes x.shape fp8, scales
+    x.shape[:-2] f32)``."""
+    amax = jnp.max(jnp.abs(x), axis=(-2, -1))
+    scale = jnp.maximum(amax / FP8_MAX, 1e-30).astype(jnp.float32)
+    codes = (x / scale[..., None, None]).astype(jnp.float8_e4m3fn)
+    return codes, scale
+
+
+def kv_dequantize(codes, scale=None):
+    """Back to f32 for the attention math: codes * per-row scale (fp8),
+    or a plain widening cast (bf16, ``scale=None``).  ``scale`` must be
+    ``codes.shape[:-2]`` — one scalar per (kvh, hd) row."""
+    x = codes.astype(jnp.float32)
+    if scale is None:
+        return x
+    return x * scale[..., None, None]
+
+
+def kv_block_bytes(num_layers, num_kv_heads, head_dim, block_size,
+                   kv_dtype=None):
+    """Exact bytes ONE pool block pins across both (K and V) pools and
+    all layers, INCLUDING the fp8 scale rows — the honest denominator
+    for every capacity claim (a fp8 ratio quoted without its scale
+    overhead would overstate the win)."""
+    itemsize = jnp.dtype(kv_pool_dtype(kv_dtype)).itemsize
+    per = 2 * num_layers * block_size * num_kv_heads * head_dim * itemsize
+    if kv_has_scales(kv_dtype):
+        per += 2 * num_layers * block_size * 4  # f32 scale per token row
+    return per
+
+
+def kv_blocks_in_budget(budget_bytes, num_layers, num_kv_heads, head_dim,
+                        block_size, kv_dtype=None):
+    """Allocatable blocks one HBM byte budget holds at a storage mode —
+    the ISSUE 20 capacity gate compares this across modes at EQUAL
+    budget (fp8 must fit >= 2x the f32 count, scale rows included)."""
+    per = kv_block_bytes(num_layers, num_kv_heads, head_dim, block_size,
+                         kv_dtype)
+    return int(budget_bytes) // per

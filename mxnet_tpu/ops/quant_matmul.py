@@ -1,0 +1,317 @@
+"""Scaled low-precision matmul for TRAINING (ISSUE 20).
+
+PR 3 carried quantization onto the wire (int8 reduce-scatter with
+stochastic rounding) and PR 7 onto serving weights (QuantizedDense);
+this module carries it into COMPUTE: ``quant_matmul(a, b)`` runs the
+trainer's dense contractions through int8 or fp8 inputs with exact
+wide accumulation, behind ``MXTPU_COMPUTE_DTYPE`` (unset = bitwise
+``jnp.matmul``, the kill-switch contract).
+
+Scaling math, per mode:
+
+- **int8**: per-tensor amax scaling (scale = amax/127) with the PR 3
+  UNBIASED stochastic rounding — ``floor(x/scale + u)``, u ~ U[0,1) —
+  so E[dequant(quant(x))] == x and the training signal keeps no
+  systematic bias; the contraction accumulates in int32 (exact), then
+  rescales in f32.  The SR noise key is deterministic per call site
+  AND data-dependent (folded from the tensor's sum bits), so repeated
+  steps draw fresh noise while runs stay reproducible.
+- **fp8**: e4m3 inputs (max 448) with per-tensor amax scaling,
+  round-to-nearest (fp8 keeps a mantissa, so RTN is already unbiased
+  to first order; SR is the int8 story), f32 accumulation via
+  ``preferred_element_type``.
+
+Gradients (``jax.custom_vjp``): the straight-through estimator for the
+rounding itself, with the grad-side matmuls ALSO quantized —
+``da = dy @ b.T`` and ``db = a.T @ dy`` run through the same machinery
+(e5m2 for fp8 grads: gradients need e5m2's range, not e4m3's
+precision).  Plain autodiff would differentiate ``floor`` to zero;
+the custom VJP is load-bearing, not cosmetic.
+
+Scale selection is **current** (amax of this step's tensor, in-graph)
+on the trainer wiring; the **delayed** variant — amax history window,
+scale from the running max, the FP8-LM recipe — is the functional
+threaded-state API (:func:`init_delayed_state` /
+:func:`quant_matmul_delayed`), forward-only (no custom VJP; thread it
+where grads are not taken, or wire its scales into ``quant_matmul``).
+
+Numerically fragile call sites opt OUT per tag: a tag in
+:func:`bf16_fallback_tags` (``MXTPU_QUANT_BF16_ALLOW`` + defaults)
+computes in bf16 with f32 accumulation instead of 8-bit.
+
+Telemetry: with the registry enabled at trace time, every quantized
+site publishes ``quant.amax.<tag>.{a,w}`` and
+``quant.overflow_pct.<tag>`` gauges (saturation fraction — nonzero
+means a stale/clipped scale) through a ``jax.debug.callback``; off by
+default, so the hot path carries zero host syncs (HB10 discipline).
+
+This module (``ops/quant*``) is the sanctioned home for raw
+low-precision ``astype`` — mxlint HB21 flags the pattern elsewhere.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..base import MXNetError
+from .. import telemetry as _telem
+
+__all__ = ["quant_matmul", "resolve_compute_dtype",
+           "quantize_sr_int8", "dequantize_int8", "quantize_rtn_int8",
+           "bf16_fallback_tags", "init_delayed_state",
+           "quant_matmul_delayed", "INT8_MAX", "FP8_MAX",
+           "FP8_GRAD_MAX"]
+
+INT8_MAX = 127.0
+FP8_MAX = 448.0        # float8_e4m3fn max normal (forward inputs)
+FP8_GRAD_MAX = 57344.0  # float8_e5m2 max normal (grad-side range)
+
+#: call-site tags that always fall back to bf16 (numerically fragile
+#: contractions: logit heads and normalization-adjacent matmuls keep
+#: more mantissa than 8 bits).  MXTPU_QUANT_BF16_ALLOW extends this.
+_DEFAULT_BF16_TAGS = frozenset({"head", "logits"})
+
+_BASE_KEY = None
+
+
+def resolve_compute_dtype(value=None):
+    """Canonical training compute mode: ``"int8"``, ``"fp8"``, or
+    ``None`` (= f32 ``jnp.matmul``, today's trainer).  ``None`` input
+    reads ``MXTPU_COMPUTE_DTYPE``; unset/empty/``0``/``off``/``fp32``
+    resolve to ``None`` (bitwise-inert kill switch).  Unknown values
+    raise — a typo must not silently train full-width."""
+    if value is None:
+        value = os.environ.get("MXTPU_COMPUTE_DTYPE", "")
+    v = str(value).strip().lower()
+    if v in ("", "0", "off", "none", "fp32", "float32"):
+        return None
+    if v in ("int8", "i8"):
+        return "int8"
+    if v in ("fp8", "float8", "float8_e4m3fn"):
+        return "fp8"
+    raise MXNetError(
+        f"MXTPU_COMPUTE_DTYPE={value!r}: expected int8|fp8|fp32")
+
+
+def bf16_fallback_tags():
+    """Tags whose matmuls compute in bf16 instead of 8-bit: the
+    defaults plus ``MXTPU_QUANT_BF16_ALLOW`` (comma-separated)."""
+    raw = os.environ.get("MXTPU_QUANT_BF16_ALLOW", "")
+    extra = {t.strip() for t in raw.split(",") if t.strip()}
+    return frozenset(_DEFAULT_BF16_TAGS | extra)
+
+
+# ---------------------------------------------------------------------------
+# int8 stochastic rounding — the PR 3 wire-quantization core, moved
+# here so the wire (parallel/zero.py) and compute paths share ONE
+# rounding implementation (zero.py re-exports these names).
+# ---------------------------------------------------------------------------
+
+def _sr_cast_int8(v, key):
+    """Unbiased stochastic round of pre-scaled values to int8 codes:
+    floor(v + u), u ~ U[0,1) — E[result] == v before the clip."""
+    u = jax.random.uniform(key, v.shape, jnp.float32)
+    return jnp.clip(jnp.floor(v + u), -127, 127).astype(jnp.int8)
+
+
+def quantize_sr_int8(flat, key):
+    """(codes int8, scale f32 scalar): stochastic-rounding blockwise
+    quantization at per-tensor amax scale.  Unbiased:
+    E[dequant(quant(x))] == x, so a cross-chip mean (the EQuARX wire
+    use) and a training matmul (this module) keep no systematic
+    error."""
+    scale = jnp.maximum(jnp.max(jnp.abs(flat)) / INT8_MAX, 1e-30)
+    return _sr_cast_int8(flat / scale, key), scale
+
+
+def dequantize_int8(codes, scale):
+    return codes.astype(jnp.float32) * scale
+
+
+def quantize_rtn_int8(x, scale):
+    """Round-to-nearest int8 at a FIXED (calibrated) scale — the PR 7
+    serving activation quantization (QuantizedDense), op-for-op, so
+    the engine's decode-parity contract survives the refactor
+    bit-for-bit."""
+    return jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+
+
+def _sr_key(x, salt):
+    """Deterministic, data-dependent SR noise key: a fixed base key
+    folded with a static per-operand salt and the bits of the
+    tensor's f32 sum — different steps see different data, hence
+    fresh noise; identical runs draw identical noise."""
+    global _BASE_KEY
+    if _BASE_KEY is None:
+        _BASE_KEY = jax.random.key(20)
+    bits = lax.bitcast_convert_type(
+        jnp.sum(x, dtype=jnp.float32), jnp.uint32)
+    return jax.random.fold_in(jax.random.fold_in(_BASE_KEY, salt), bits)
+
+
+# ---------------------------------------------------------------------------
+# the quantized 2D contraction (forward + quantized grad-side)
+# ---------------------------------------------------------------------------
+
+def _amax_scale(x, qmax):
+    return jnp.maximum(jnp.max(jnp.abs(x)) / qmax, 1e-30) \
+        .astype(jnp.float32)
+
+
+def _qmm_impl(a, b, mode, tag, grad_side=False):
+    """One quantized (M,K)@(K,N) contraction in f32-equivalent space:
+    quantize both operands at per-tensor amax scale, contract in wide
+    accumulation, rescale.  ``grad_side`` switches fp8 to e5m2 (range
+    over precision for gradients)."""
+    if mode == "int8":
+        sa, sb = _amax_scale(a, INT8_MAX), _amax_scale(b, INT8_MAX)
+        qa = _sr_cast_int8(a / sa, _sr_key(a, 0))
+        qb = _sr_cast_int8(b / sb, _sr_key(b, 1))
+        acc = lax.dot_general(qa, qb, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        out = acc.astype(jnp.float32) * (sa * sb)
+        sat = jnp.mean((jnp.abs(qa) >= 127).astype(jnp.float32))
+    else:
+        qmax = FP8_GRAD_MAX if grad_side else FP8_MAX
+        fp8 = jnp.float8_e5m2 if grad_side else jnp.float8_e4m3fn
+        sa, sb = _amax_scale(a, qmax), _amax_scale(b, qmax)
+        qa = jnp.clip(a / sa, -qmax, qmax).astype(fp8)
+        qb = jnp.clip(b / sb, -qmax, qmax).astype(fp8)
+        acc = lax.dot_general(qa, qb, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        out = acc * (sa * sb)
+        sat = jnp.mean(
+            (jnp.abs(qa.astype(jnp.float32)) >= qmax)
+            .astype(jnp.float32))
+    if not grad_side and _telem.enabled():
+        # amax/saturation gauges ride an async debug callback —
+        # published only when the registry is on at TRACE time, so the
+        # default hot path stays host-sync-free
+        jax.debug.callback(
+            partial(_publish_stats, tag, mode),
+            sa * (INT8_MAX if mode == "int8" else FP8_MAX),
+            sb * (INT8_MAX if mode == "int8" else FP8_MAX), sat)
+    return out
+
+
+def _publish_stats(tag, mode, amax_a, amax_w, sat):
+    _telem.set_gauge(f"quant.amax.{tag}.a", round(float(amax_a), 6))
+    _telem.set_gauge(f"quant.amax.{tag}.w", round(float(amax_w), 6))
+    _telem.set_gauge(f"quant.overflow_pct.{tag}",
+                     round(float(sat) * 100.0, 4))
+    _telem.inc(f"quant.matmuls.{mode}")
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _qmm(a, b, mode, tag):
+    return _qmm_impl(a, b, mode, tag)
+
+
+def _qmm_fwd(a, b, mode, tag):
+    return _qmm_impl(a, b, mode, tag), (a, b)
+
+
+def _qmm_bwd(mode, tag, res, dy):
+    # straight-through for the rounding; the grad matmuls themselves
+    # are quantized (the tentpole contract: low-precision compute on
+    # BOTH sides of the step, not just the forward)
+    a, b = res
+    da = _qmm_impl(dy, b.T, mode, tag, grad_side=True)
+    db = _qmm_impl(a.T, dy, mode, tag, grad_side=True)
+    return da, db
+
+
+_qmm.defvjp(_qmm_fwd, _qmm_bwd)
+
+
+def quant_matmul(a, b, compute_dtype=None, tag="mm"):
+    """``a @ b`` through the scaled low-precision path.
+
+    a : (..., K) activations (leading dims flattened for the 2D
+        contraction and restored after — per-tensor scales make the
+        reshape exact).
+    b : (K, N) weight-side operand.
+    compute_dtype : ``"int8"`` / ``"fp8"`` / None; None reads
+        ``MXTPU_COMPUTE_DTYPE`` and falls back to the EXACT
+        ``jnp.matmul`` when unset (bitwise kill switch).
+    tag : call-site label for telemetry and the bf16 fallback
+        allowlist."""
+    mode = resolve_compute_dtype(compute_dtype)
+    if mode is None:
+        return jnp.matmul(a, b)
+    if b.ndim != 2:
+        raise MXNetError(f"quant_matmul: b must be 2D (K, N), got "
+                         f"{b.shape}")
+    lead = a.shape[:-1]
+    flat = a.reshape(-1, a.shape[-1])
+    if tag in bf16_fallback_tags():
+        # numerically fragile site: bf16 operands, f32 accumulation —
+        # plain autodiff (casts are linear; no rounding to estimate
+        # through)
+        y = lax.dot_general(flat.astype(jnp.bfloat16),
+                            b.astype(jnp.bfloat16),
+                            (((1,), (0,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+    else:
+        y = _qmm(flat, b, mode, tag)
+    return y.reshape(lead + (b.shape[1],))
+
+
+# ---------------------------------------------------------------------------
+# delayed (amax-history) scaling — the threaded-state variant
+# ---------------------------------------------------------------------------
+
+def init_delayed_state(history=16):
+    """Fresh amax-history state for ONE quant_matmul_delayed site:
+    a rolling window per operand, zeros = "no history yet" (the first
+    step falls back to current scaling)."""
+    if history < 1:
+        raise MXNetError(f"history {history} must be >= 1")
+    return {"a": jnp.zeros((history,), jnp.float32),
+            "b": jnp.zeros((history,), jnp.float32)}
+
+
+def _delayed_scale(hist, cur_amax, qmax):
+    h = jnp.max(hist)
+    amax = jnp.where(h > 0, h, cur_amax)  # cold start: current scaling
+    return jnp.maximum(amax / qmax, 1e-30)
+
+
+def quant_matmul_delayed(a, b, state, compute_dtype=None, tag="mm"):
+    """``(y, new_state)``: the delayed-scaling variant — scales come
+    from the amax HISTORY (max over the window), not this step's
+    tensor, so the scale is known before the tensor exists (the FP8-LM
+    recipe; on real hardware this removes the amax reduction from the
+    critical path).  A stale scale CLIPS — watch
+    ``quant.overflow_pct``.  Forward-only (no custom VJP): thread it
+    where gradients are not taken, or feed its scales to
+    :func:`quant_matmul`."""
+    mode = resolve_compute_dtype(compute_dtype)
+    if mode is None:
+        return jnp.matmul(a, b), state
+    if a.ndim != 2 or b.ndim != 2:
+        raise MXNetError("quant_matmul_delayed operates on 2D operands")
+    qmax = INT8_MAX if mode == "int8" else FP8_MAX
+    cur_a = jnp.max(jnp.abs(a))
+    cur_b = jnp.max(jnp.abs(b))
+    sa = _delayed_scale(state["a"], cur_a, qmax)
+    sb = _delayed_scale(state["b"], cur_b, qmax)
+    if mode == "int8":
+        qa = _sr_cast_int8(a / sa, _sr_key(a, 0))
+        qb = _sr_cast_int8(b / sb, _sr_key(b, 1))
+        acc = lax.dot_general(qa, qb, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+        y = acc.astype(jnp.float32) * (sa * sb)
+    else:
+        qa = jnp.clip(a / sa, -qmax, qmax).astype(jnp.float8_e4m3fn)
+        qb = jnp.clip(b / sb, -qmax, qmax).astype(jnp.float8_e4m3fn)
+        acc = lax.dot_general(qa, qb, (((1,), (0,)), ((), ())),
+                              preferred_element_type=jnp.float32)
+        y = acc * (sa * sb)
+    new_state = {"a": jnp.roll(state["a"], 1).at[0].set(cur_a),
+                 "b": jnp.roll(state["b"], 1).at[0].set(cur_b)}
+    return y, new_state

@@ -210,20 +210,11 @@ class BucketPlan:
 # quantization (int8, stochastic rounding, one scale per chip x bucket)
 # ---------------------------------------------------------------------------
 
-def quantize_int8(flat, key):
-    """(codes int8, scale f32 scalar): stochastic-rounding blockwise
-    quantization of one chip's bucket contribution. Unbiased:
-    E[dequant(quant(x))] == x, so the cross-chip mean keeps no
-    systematic error (the EQuARX requirement for quantized AllReduce)."""
-    scale = jnp.maximum(jnp.max(jnp.abs(flat)) / 127.0, 1e-30)
-    v = flat / scale
-    u = jax.random.uniform(key, flat.shape, jnp.float32)
-    q = jnp.clip(jnp.floor(v + u), -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def dequantize_int8(codes, scale):
-    return codes.astype(jnp.float32) * scale
+# the SR core moved to ops/quant_matmul (ISSUE 20): the wire (this
+# module) and the training-compute path share ONE unbiased rounding
+# implementation; these names stay importable here for PR 3 callers.
+from ..ops.quant_matmul import (quantize_sr_int8 as quantize_int8,  # noqa: E402,F401
+                                dequantize_int8)
 
 
 def int8_roundtrip_error(flat, key):
@@ -252,8 +243,11 @@ def reduce_scatter_bucket(flat, key, dp, mode="fp32",
     if mode == "fp32":
         return lax.psum_scatter(flat, axis, tiled=True) / dp
     if mode == "bf16":
-        shard = lax.psum_scatter(flat.astype(jnp.bfloat16), axis,
-                                 tiled=True)
+        # bf16 keeps f32's exponent range, so the wire cast needs no
+        # amax scale — exempt from the HB21 scaled-cast discipline
+        shard = lax.psum_scatter(
+            flat.astype(jnp.bfloat16),  # mxlint: disable=HB21
+            axis, tiled=True)
         return shard.astype(jnp.float32) / dp
     if mode == "int8":
         q, scale = quantize_int8(flat, key)

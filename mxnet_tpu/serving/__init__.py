@@ -58,7 +58,8 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
                   spec_accept_rate=None, tokens_per_dispatch=None,
                   tp_shards=0, disaggregated=False, handoff_ms=None,
                   prefill_pool_occupancy=None,
-                  decode_pool_occupancy=None):
+                  decode_pool_occupancy=None, kv_dtype="fp32",
+                  kv_capacity_ratio=None, kv_decode_drift=None):
     """The bench.py ``serving`` observability block (the `comm` block
     discipline from PR 3/PR 5): static serving config is always real;
     MEASURED fields default to ``None`` — null-when-unmeasured, so a CPU
@@ -71,7 +72,11 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
     ``spec_accept_rate``/``tokens_per_dispatch`` (measured).  ISSUE 18
     adds ``tp_shards``/``disaggregated`` (config) and ``handoff_ms``/
     ``prefill_pool_occupancy``/``decode_pool_occupancy`` (measured —
-    null unless a disaggregated run actually measured them)."""
+    null unless a disaggregated run actually measured them).  ISSUE 20
+    adds ``kv_dtype`` (config: the resolved KV storage mode) and
+    ``kv_capacity_ratio``/``kv_decode_drift`` (measured — the blocks
+    an equal byte budget holds vs f32, and the max |logit| drift of an
+    fp8-KV decode vs the f32-KV engine)."""
     return {
         "max_batch": int(max_batch),
         "block_size": int(block_size),
@@ -100,4 +105,8 @@ def serving_block(max_batch=0, block_size=0, buckets=(), quantized=False,
         "handoff_ms": _r(handoff_ms),
         "prefill_pool_occupancy": _r(prefill_pool_occupancy, 4),
         "decode_pool_occupancy": _r(decode_pool_occupancy, 4),
+        "kv_dtype": str(kv_dtype or "fp32"),
+        "kv_capacity_ratio": _r(kv_capacity_ratio),
+        "kv_decode_drift": (None if kv_decode_drift is None
+                            else float(kv_decode_drift)),
     }

@@ -160,6 +160,15 @@ class InferenceEngine:
         ``ops.paged_attention`` (Pallas gather-by-block-table on TPU;
         bitwise-identical XLA fallback elsewhere); None reads
         ``MXTPU_PAGED_ATTN`` (default off = the inline gather).
+    kv_dtype : KV-cache STORAGE precision (ISSUE 20): ``"fp8"`` stores
+        e4m3 codes with per-token-row amax scales (quantize-on-write /
+        dequantize-in-attention threaded through every graph family —
+        the attention math itself stays f32, so drift is bounded by
+        the storage rounding alone); ``"bf16"`` stores bfloat16;
+        ``"fp32"``/None-resolved-empty is today's engine, bitwise.
+        None reads ``MXTPU_KV_DTYPE`` (default unset).  Prefill's OWN
+        attention reads the fresh f32 K/V, so the first generated
+        token never drifts; decode/verify/chunk read the pool.
     """
 
     def __init__(self, net, max_batch=None, block_size=None,
@@ -168,9 +177,10 @@ class InferenceEngine:
                  num_calib_batches=10, mesh=None, prefill_chunk=None,
                  prefix_cache=None, compile_cache=None,
                  spec_decode=None, spec_k=None, paged_attn=None,
-                 kv_cache=None):
+                 kv_cache=None, kv_dtype=None):
         import jax
         import jax.numpy as jnp
+        from ..ops import quant_kv as _qkv
         from ..parallel.mesh import MeshConfig
         cfg = net.cfg
         if cfg.tensor_parallel:
@@ -243,6 +253,11 @@ class InferenceEngine:
         self.quantized = False
         if quantize == "int8":
             self._quantize_in_place(net, calib_data, num_calib_batches)
+        # KV storage precision (ISSUE 20): resolved ONCE here; every
+        # graph builder branches on it at trace time, so an unset knob
+        # compiles exactly today's graphs (the bitwise kill switch)
+        self.kv_dtype = _qkv.resolve_kv_dtype(kv_dtype)
+        self._kv_fp8 = _qkv.kv_has_scales(self.kv_dtype)
         self.params = self._extract_weights(net)
         if self._mesh is not None:
             self.params = self._shard_params(self.params)
@@ -256,15 +271,19 @@ class InferenceEngine:
                     or kv_cache.num_kv_heads != cfg.num_kv_heads
                     or kv_cache.head_dim != cfg.head_dim
                     or kv_cache.block_size != bs
-                    or kv_cache.dtype != self.params["embed"].dtype):
+                    or kv_cache.kv_dtype != self.kv_dtype
+                    or (self.kv_dtype is None and
+                        kv_cache.dtype != self.params["embed"].dtype)):
                 raise MXNetError(
                     "kv_cache geometry mismatch: shared pool is "
                     f"(layers={kv_cache.num_layers}, "
                     f"kvh={kv_cache.num_kv_heads}, "
                     f"hd={kv_cache.head_dim}, "
-                    f"bs={kv_cache.block_size}) vs this engine's "
-                    f"(layers={cfg.num_layers}, kvh={cfg.num_kv_heads},"
-                    f" hd={cfg.head_dim}, bs={bs})")
+                    f"bs={kv_cache.block_size}, "
+                    f"kv_dtype={kv_cache.kv_dtype or 'fp32'}) vs this "
+                    f"engine's (layers={cfg.num_layers}, "
+                    f"kvh={cfg.num_kv_heads}, hd={cfg.head_dim}, "
+                    f"bs={bs}, kv_dtype={self.kv_dtype or 'fp32'})")
             self.cache = kv_cache
             self.cache_shared = True
         else:
@@ -280,7 +299,8 @@ class InferenceEngine:
                 num_blocks=num_blocks, block_size=bs,
                 max_batch=self.max_batch,
                 dtype=self.params["embed"].dtype,
-                sharding=pool_sharding)
+                sharding=pool_sharding,
+                kv_dtype=self.kv_dtype or "fp32")
             self.cache_shared = False
         self.temperature = float(temperature)
         self.top_k = int(top_k)
@@ -511,6 +531,17 @@ class InferenceEngine:
         return (jax.lax.with_sharding_constraint(kp, s),
                 jax.lax.with_sharding_constraint(vp, s))
 
+    def _shard_scales(self, ks, vs):
+        """fp8 scale rows have no kv-head axis (one scalar per token
+        row, shared across heads) — they replicate on the submesh."""
+        if self._mesh is None:
+            return ks, vs
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        s = NamedSharding(self._mesh, P(None, None, None))
+        return (jax.lax.with_sharding_constraint(ks, s),
+                jax.lax.with_sharding_constraint(vs, s))
+
     # -- graph building --------------------------------------------------
 
     @staticmethod
@@ -521,10 +552,10 @@ class InferenceEngine:
         import jax.numpy as jnp
         from jax import lax
         if "qw" in p:
+            from ..ops.quant_matmul import quantize_rtn_int8
             lead = x.shape[:-1]
             flat = x.reshape(-1, x.shape[-1])
-            qx = jnp.clip(jnp.round(flat / p["as"]), -127, 127) \
-                .astype(jnp.int8)
+            qx = quantize_rtn_int8(flat, p["as"])
             acc = lax.dot_general(qx, p["qw"], (((1,), (1,)), ((), ())),
                                   preferred_element_type=jnp.int32)
             out = acc.astype(jnp.float32) * (p["as"] *
@@ -548,6 +579,7 @@ class InferenceEngine:
         from jax import lax
         from ..gluon.model_zoo.nlp.llama import (_QPAD, _rms,
                                                  _rot_interleaved)
+        from ..ops import quant_kv as _qkv
         from ..ops.flash_attention import flash_attention
         cfg = self.cfg
         h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
@@ -556,7 +588,7 @@ class InferenceEngine:
         nb = bucket // bs
         L = bucket
 
-        def run(params, kp, vp, toks, valid, bt, key):
+        def body(params, kp, vp, ks, vs, toks, valid, bt, key):
             x = jnp.take(params["embed"], toks, axis=0)      # (1, L, hid)
             pos = jnp.arange(L)
             freqs = theta ** (-jnp.arange(0, d, 2) / d)
@@ -573,10 +605,20 @@ class InferenceEngine:
                 q = _rot_interleaved(q, cos, sin)
                 k = _rot_interleaved(k, cos, sin)
                 # unrepeated K/V into the pool blocks: (L, kvh, d) rows
-                kp = kp.at[li, bt].set(
-                    k[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d))
-                vp = vp.at[li, bt].set(
-                    v[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d))
+                krows = k[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d)
+                vrows = v[0].transpose(1, 0, 2).reshape(nb, bs, kvh, d)
+                if self._kv_fp8:
+                    kq, ksc = _qkv.kv_quantize_fp8(krows)
+                    vq, vsc = _qkv.kv_quantize_fp8(vrows)
+                    kp = kp.at[li, bt].set(kq)
+                    vp = vp.at[li, bt].set(vq)
+                    ks = ks.at[li, bt].set(ksc)
+                    vs = vs.at[li, bt].set(vsc)
+                else:
+                    kp = kp.at[li, bt].set(_qkv.kv_cast(krows, kp.dtype))
+                    vp = vp.at[li, bt].set(_qkv.kv_cast(vrows, vp.dtype))
+                # prefill's OWN attention reads the fresh f32 K/V —
+                # quantization touches storage, never this math
                 kr = jnp.repeat(k, rep, axis=1)
                 vr = jnp.repeat(v, rep, axis=1)
                 o = flash_attention(q, kr, vr, causal=True)
@@ -596,26 +638,43 @@ class InferenceEngine:
             last = jnp.take(logits, valid - 1 - start, axis=0)
             tok = self._sample(last[None, :], key)[0]
             kp, vp = self._shard_pools(kp, vp)
+            if self._kv_fp8:
+                ks, vs = self._shard_scales(ks, vs)
+            return last, tok, kp, vp, ks, vs
+
+        if self._kv_fp8:
+            return body
+
+        def run(params, kp, vp, toks, valid, bt, key):
+            last, tok, kp, vp, _ks, _vs = body(
+                params, kp, vp, None, None, toks, valid, bt, key)
             return last, tok, kp, vp
 
         return run
 
-    def _decode_body(self, params, kp, vp, toks, pos, bts, blk, nbl):
+    def _decode_body(self, params, kp, vp, ks, vs, toks, pos, bts, blk,
+                     nbl):
         """One decode step's layer stack, shared by the ``decode`` graph
         and every unrolled ``verify`` step (one source so speculative
         parity cannot drift): embed ``toks`` (B,), rotate at ``pos``,
         scatter K/V into ``blk``/offset, attend through the block
-        table, and return (last-norm logits, kp, vp).
+        table, and return (last-norm logits, kp, vp, ks, vs).
 
         The cache attention routes through
         ``ops.paged_attention.paged_decode_attention`` when
         ``paged_attn`` is set (whose XLA fallback is the inline gather
         below, verbatim) and stays inline otherwise — the kill switch
-        compiles the exact PR 7 graph."""
+        compiles the exact PR 7 graph.
+
+        Under fp8 KV storage (ISSUE 20) the scatter quantizes each
+        row (amax scale into ``ks``/``vs``) and the gather dequantizes
+        before the f32 attention math — the only drift source is the
+        storage rounding of PAST tokens' K/V."""
         import jax
         import jax.numpy as jnp
         from ..gluon.model_zoo.nlp.llama import (_cache_attention, _rms,
                                                  _rot_interleaved)
+        from ..ops import quant_kv as _qkv
         cfg = self.cfg
         h, kvh, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
         eps, theta = cfg.rms_eps, cfg.rope_theta
@@ -637,18 +696,39 @@ class InferenceEngine:
             v = self._proj(hh, lp["v"]).reshape(B, kvh, d)
             q = _rot_interleaved(q, cos[:, None, :], sin[:, None, :])
             k = _rot_interleaved(k, cos[:, None, :], sin[:, None, :])
-            kp = kp.at[li, blk, off].set(k)
-            vp = vp.at[li, blk, off].set(v)
+            if self._kv_fp8:
+                kq, ksc = _qkv.kv_quantize_fp8(k)
+                vq, vsc = _qkv.kv_quantize_fp8(v)
+                kp = kp.at[li, blk, off].set(kq)
+                vp = vp.at[li, blk, off].set(vq)
+                ks = ks.at[li, blk, off].set(ksc)
+                vs = vs.at[li, blk, off].set(vsc)
+            else:
+                kp = kp.at[li, blk, off].set(_qkv.kv_cast(k, kp.dtype))
+                vp = vp.at[li, blk, off].set(_qkv.kv_cast(v, vp.dtype))
             if self.paged_attn:
                 from ..ops.paged_attention import paged_decode_attention
                 kpl, vpl = self._gather_cache(kp[li], vp[li])
-                o = paged_decode_attention(q, kpl, vpl, bts, pos,
-                                           scale)
+                if self._kv_fp8:
+                    o = paged_decode_attention(q, kpl, vpl, bts, pos,
+                                               scale, k_scale=ks[li],
+                                               v_scale=vs[li])
+                else:
+                    o = paged_decode_attention(q, kpl, vpl, bts, pos,
+                                               scale)
             else:
-                ck = kp[li][bts].reshape(B, L, kvh, d) \
-                    .transpose(0, 2, 1, 3)                   # (B,kvh,L,d)
-                cv = vp[li][bts].reshape(B, L, kvh, d) \
-                    .transpose(0, 2, 1, 3)
+                ck = kp[li][bts].reshape(B, L, kvh, d)
+                cv = vp[li][bts].reshape(B, L, kvh, d)
+                if self._kv_fp8:
+                    ck = _qkv.kv_dequantize(
+                        ck, ks[li][bts].reshape(B, L))
+                    cv = _qkv.kv_dequantize(
+                        cv, vs[li][bts].reshape(B, L))
+                elif self.kv_dtype is not None:
+                    ck = _qkv.kv_dequantize(ck)
+                    cv = _qkv.kv_dequantize(cv)
+                ck = ck.transpose(0, 2, 1, 3)                # (B,kvh,L,d)
+                cv = cv.transpose(0, 2, 1, 3)
                 ck, cv = self._gather_cache(ck, cv)
                 o = _cache_attention(q, ck, cv, valid, scale)
             x = x + self._row_proj(o, lp["o"])
@@ -658,7 +738,9 @@ class InferenceEngine:
                 self._proj(y, lp["up"]), lp["down"])
         logits = self._head_logits(params, _rms(x, params["norm"], eps))
         kp, vp = self._shard_pools(kp, vp)
-        return logits, kp, vp
+        if self._kv_fp8:
+            ks, vs = self._shard_scales(ks, vs)
+        return logits, kp, vp, ks, vs
 
     def _build_decode(self, nbl):
         """One-token decode for the fixed batch against ``nbl`` gathered
@@ -666,13 +748,21 @@ class InferenceEngine:
         import jax.numpy as jnp
         bs = self.block_size
 
-        def run(params, kp, vp, toks, pos, bts, active, key):
+        def body(params, kp, vp, ks, vs, toks, pos, bts, active, key):
             blk = jnp.take_along_axis(
                 bts, (pos // bs)[:, None], axis=1)[:, 0]     # (B,)
             blk = jnp.where(active, blk, 0)                  # null block
-            logits, kp, vp = self._decode_body(params, kp, vp, toks,
-                                               pos, bts, blk, nbl)
-            return logits, self._sample(logits, key), kp, vp
+            logits, kp, vp, ks, vs = self._decode_body(
+                params, kp, vp, ks, vs, toks, pos, bts, blk, nbl)
+            return logits, self._sample(logits, key), kp, vp, ks, vs
+
+        if self._kv_fp8:
+            return body
+
+        def run(params, kp, vp, toks, pos, bts, active, key):
+            logits, tok, kp, vp, _ks, _vs = body(
+                params, kp, vp, None, None, toks, pos, bts, active, key)
+            return logits, tok, kp, vp
 
         return run
 
@@ -695,7 +785,8 @@ class InferenceEngine:
         W, nbl = size
         bs = self.block_size
 
-        def run(params, kp, vp, toks, pos, bts, counts, active, key):
+        def body(params, kp, vp, ks, vs, toks, pos, bts, counts, active,
+                 key):
             outs = []
             for w in range(W):
                 live = active & (w < counts)                 # (B,)
@@ -704,11 +795,21 @@ class InferenceEngine:
                     bts, jnp.clip(pw // bs, 0, nbl - 1)[:, None],
                     axis=1)[:, 0]
                 blk = jnp.where(live, blk, 0)                # null block
-                logits, kp, vp = self._decode_body(
-                    params, kp, vp, toks[:, w], pw, bts, blk, nbl)
+                logits, kp, vp, ks, vs = self._decode_body(
+                    params, kp, vp, ks, vs, toks[:, w], pw, bts, blk,
+                    nbl)
                 outs.append(jnp.argmax(logits, axis=-1)
                             .astype(jnp.int32))
-            return jnp.stack(outs, axis=1), kp, vp           # (B, W)
+            return jnp.stack(outs, axis=1), kp, vp, ks, vs   # (B, W)
+
+        if self._kv_fp8:
+            return body
+
+        def run(params, kp, vp, toks, pos, bts, counts, active, key):
+            out, kp, vp, _ks, _vs = body(params, kp, vp, None, None,
+                                         toks, pos, bts, counts, active,
+                                         key)
+            return out, kp, vp
 
         return run
 
@@ -769,7 +870,9 @@ class InferenceEngine:
             (acc, m_i, l_i, _), _ = lax.scan(step, init, (kb, vb))
             return (acc / jnp.maximum(l_i, 1e-30)).astype(q.dtype)
 
-        def run(params, kp, vp, toks, starts, valids, bts, active, key):
+        def body(params, kp, vp, ks, vs, toks, starts, valids, bts,
+                 active, key):
+            from ..ops import quant_kv as _qkv
             x = jnp.take(params["embed"], toks, axis=0)      # (R, C, hid)
             cidx = jnp.arange(C)
             abs_pos = starts[:, None] + cidx[None, :]        # (R, C)
@@ -791,12 +894,31 @@ class InferenceEngine:
                 v = self._proj(hh, lp["v"]).reshape(R, C, kvh, d)
                 q = _rot_interleaved(q, cos[:, None], sin[:, None])
                 k = _rot_interleaved(k, cos[:, None], sin[:, None])
-                kp = kp.at[li, blk, off].set(k.transpose(0, 2, 1, 3))
-                vp = vp.at[li, blk, off].set(v)
-                ck = kp[li][bts].reshape(R, L, kvh, d) \
-                    .transpose(0, 2, 1, 3)                   # (R,kvh,L,d)
-                cv = vp[li][bts].reshape(R, L, kvh, d) \
-                    .transpose(0, 2, 1, 3)
+                krows = k.transpose(0, 2, 1, 3)              # (R,C,kvh,d)
+                if self._kv_fp8:
+                    kq, ksc = _qkv.kv_quantize_fp8(krows)
+                    vq, vsc = _qkv.kv_quantize_fp8(v)
+                    kp = kp.at[li, blk, off].set(kq)
+                    vp = vp.at[li, blk, off].set(vq)
+                    ks = ks.at[li, blk, off].set(ksc)
+                    vs = vs.at[li, blk, off].set(vsc)
+                else:
+                    kp = kp.at[li, blk, off].set(
+                        _qkv.kv_cast(krows, kp.dtype))
+                    vp = vp.at[li, blk, off].set(
+                        _qkv.kv_cast(v, vp.dtype))
+                ck = kp[li][bts].reshape(R, L, kvh, d)
+                cv = vp[li][bts].reshape(R, L, kvh, d)
+                if self._kv_fp8:
+                    ck = _qkv.kv_dequantize(
+                        ck, ks[li][bts].reshape(R, L))
+                    cv = _qkv.kv_dequantize(
+                        cv, vs[li][bts].reshape(R, L))
+                elif self.kv_dtype is not None:
+                    ck = _qkv.kv_dequantize(ck)
+                    cv = _qkv.kv_dequantize(cv)
+                ck = ck.transpose(0, 2, 1, 3)                # (R,kvh,L,d)
+                cv = cv.transpose(0, 2, 1, 3)
                 kr = jnp.repeat(ck, rep, axis=1).reshape(R * h, L, d)
                 vr = jnp.repeat(cv, rep, axis=1).reshape(R * h, L, d)
                 o = attend(q.reshape(R * h, C, d), kr, vr, qpos)
@@ -813,13 +935,37 @@ class InferenceEngine:
                 logits, jnp.clip(valids - 1, 0, C - 1)[:, None, None],
                 axis=1)[:, 0]                                # (R, V)
             kp, vp = self._shard_pools(kp, vp)
-            return last, self._sample(last, key), kp, vp
+            if self._kv_fp8:
+                ks, vs = self._shard_scales(ks, vs)
+            return last, self._sample(last, key), kp, vp, ks, vs
+
+        if self._kv_fp8:
+            return body
+
+        def run(params, kp, vp, toks, starts, valids, bts, active, key):
+            last, nxt, kp, vp, _ks, _vs = body(
+                params, kp, vp, None, None, toks, starts, valids, bts,
+                active, key)
+            return last, nxt, kp, vp
 
         return run
 
     def _build_cow(self, _size):
         """Copy-on-write block fork: duplicate one physical block's K/V
-        (all layers) into a freshly allocated block, pools donated."""
+        (all layers) into a freshly allocated block, pools donated.
+        Under fp8 KV the per-row amax scales ride along — a forked
+        block must dequantize identically to its source."""
+        if self._kv_fp8:
+            def run_fp8(kp, vp, ks, vs, src, dst):
+                kp, vp = self._shard_pools(
+                    kp.at[:, dst].set(kp[:, src]),
+                    vp.at[:, dst].set(vp[:, src]))
+                ks, vs = self._shard_scales(
+                    ks.at[:, dst].set(ks[:, src]),
+                    vs.at[:, dst].set(vs[:, src]))
+                return kp, vp, ks, vs
+            return run_fp8
+
         def run(kp, vp, src, dst):
             return self._shard_pools(kp.at[:, dst].set(kp[:, src]),
                                      vp.at[:, dst].set(vp[:, src]))
@@ -850,9 +996,12 @@ class InferenceEngine:
         # The mesh spec rides too (ISSUE 18): a tp-sharded executable
         # bakes its input shardings in, so a shared cache must never
         # serve it to an engine on a different submesh.
+        # kv_dtype rides too (ISSUE 20): the fp8 graphs take the scale
+        # planes as extra donated args, so a shared cache must never
+        # hand an fp8 executable to a full-precision engine or back.
         return (kind, size, self.cache.num_blocks, self.max_batch,
                 self.block_size, self.paged_attn,
-                self.mesh_config.describe())
+                self.mesh_config.describe(), self.kv_dtype)
 
     def _get(self, kind, size, args):
         """Compile-cache lookup keyed by (kind, shape-signature); every
@@ -874,7 +1023,13 @@ class InferenceEngine:
                      "chunk": self._build_chunk_prefill,
                      "verify": self._build_verify,
                      "cow": self._build_cow}[kind](size)
-            donate = (0, 1) if kind == "cow" else (1, 2)
+            if self._kv_fp8:
+                # scale planes are donated alongside the pools: fp8 cow
+                # is run(kp, vp, ks, vs, src, dst); the other families
+                # take (params, kp, vp, ks, vs, ...)
+                donate = (0, 1, 2, 3) if kind == "cow" else (1, 2, 3, 4)
+            else:
+                donate = (0, 1) if kind == "cow" else (1, 2)
             fn = jax.jit(build, donate_argnums=donate) \
                 .lower(*args).compile()
             self._compiled[sig] = fn
@@ -931,20 +1086,20 @@ class InferenceEngine:
                                  f"{bucket}; raise num_blocks")
             bt = _np.asarray(self.cache.table("__warmup__"), _np.int32)
             toks = _np.zeros((1, bucket), _np.int32)
-            args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                    toks, _np.int32(1), bt, dummy_key)
-            last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
-            self.cache.update_pools(kp, vp,
-                                    site="InferenceEngine.warmup(prefill)")
+            args = (self.params,) + self.cache.pool_args() + \
+                (toks, _np.int32(1), bt, dummy_key)
+            out = self._get("prefill", bucket, args)(*args)
+            self.cache.update_pools(
+                *out[2:], site="InferenceEngine.warmup(prefill)")
             bts = self.cache.table_array(
                 ["__warmup__"] + [None] * (self.max_batch - 1), nb)
-            args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                    _np.zeros((self.max_batch,), _np.int32),
-                    _np.zeros((self.max_batch,), _np.int32), bts,
-                    _np.zeros((self.max_batch,), bool), dummy_key)
-            logits, nxt, kp, vp = self._get("decode", nb, args)(*args)
-            self.cache.update_pools(kp, vp,
-                                    site="InferenceEngine.warmup(decode)")
+            args = (self.params,) + self.cache.pool_args() + \
+                (_np.zeros((self.max_batch,), _np.int32),
+                 _np.zeros((self.max_batch,), _np.int32), bts,
+                 _np.zeros((self.max_batch,), bool), dummy_key)
+            out = self._get("decode", nb, args)(*args)
+            self.cache.update_pools(
+                *out[2:], site="InferenceEngine.warmup(decode)")
             self.cache.free("__warmup__")
         if self.prefill_chunk:
             # the packed-chunk family: one graph per context bucket,
@@ -955,15 +1110,15 @@ class InferenceEngine:
                 nb = bucket // self.block_size
                 if self._sig("chunk", nb) in self._compiled:
                     continue
-                args = (self.params, self.cache.k_pool,
-                        self.cache.v_pool, _np.zeros((R, C), _np.int32),
-                        _np.zeros((R,), _np.int32),
-                        _np.zeros((R,), _np.int32),
-                        _np.zeros((R, nb), _np.int32),
-                        _np.zeros((R,), bool), dummy_key)
-                _l, _t, kp, vp = self._get("chunk", nb, args)(*args)
-                self.cache.update_pools(kp, vp,
-                                        site="InferenceEngine.warmup(chunk)")
+                args = (self.params,) + self.cache.pool_args() + \
+                    (_np.zeros((R, C), _np.int32),
+                     _np.zeros((R,), _np.int32),
+                     _np.zeros((R,), _np.int32),
+                     _np.zeros((R, nb), _np.int32),
+                     _np.zeros((R,), bool), dummy_key)
+                out = self._get("chunk", nb, args)(*args)
+                self.cache.update_pools(
+                    *out[2:], site="InferenceEngine.warmup(chunk)")
         if self.spec_decode:
             # the speculative verify family: one graph per (width,
             # context bucket), warmed all-inactive like the chunk family
@@ -974,26 +1129,24 @@ class InferenceEngine:
                     nb = bucket // self.block_size
                     if self._sig("verify", (W, nb)) in self._compiled:
                         continue
-                    args = (self.params, self.cache.k_pool,
-                            self.cache.v_pool,
-                            _np.zeros((B, W), _np.int32),
-                            _np.zeros((B,), _np.int32),
-                            _np.zeros((B, nb), _np.int32),
-                            _np.zeros((B,), _np.int32),
-                            _np.zeros((B,), bool), dummy_key)
-                    _o, kp, vp = self._get("verify", (W, nb),
-                                           args)(*args)
+                    args = (self.params,) + self.cache.pool_args() + \
+                        (_np.zeros((B, W), _np.int32),
+                         _np.zeros((B,), _np.int32),
+                         _np.zeros((B, nb), _np.int32),
+                         _np.zeros((B,), _np.int32),
+                         _np.zeros((B,), bool), dummy_key)
+                    out = self._get("verify", (W, nb), args)(*args)
                     self.cache.update_pools(
-                        kp, vp, site="InferenceEngine.warmup(verify)")
+                        *out[1:], site="InferenceEngine.warmup(verify)")
         if self.prefill_chunk or self.prefix_cache is not None:
             if self._sig("cow", 0) not in self._compiled:
                 # the copy-on-write block copy (src=dst=0 copies the
                 # null block onto itself — garbage by design)
-                args = (self.cache.k_pool, self.cache.v_pool,
-                        _np.int32(0), _np.int32(0))
-                kp, vp = self._get("cow", 0, args)(*args)
-                self.cache.update_pools(kp, vp,
-                                        site="InferenceEngine.warmup(cow)")
+                args = self.cache.pool_args() + \
+                    (_np.int32(0), _np.int32(0))
+                out = self._get("cow", 0, args)(*args)
+                self.cache.update_pools(
+                    *out, site="InferenceEngine.warmup(cow)")
         self._warmed = True
         return self
 
@@ -1020,11 +1173,12 @@ class InferenceEngine:
         bt = _np.asarray(self.cache.table(slot), _np.int32)
         key = jax.random.fold_in(self._base_key,
                                  (1 << 30) + self.stats["prefill_calls"])
-        args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                padded, _np.int32(t), bt, key)
+        args = (self.params,) + self.cache.pool_args() + \
+            (padded, _np.int32(t), bt, key)
         t0 = _telem.clock() if _telem.enabled() else None
-        last, tok, kp, vp = self._get("prefill", bucket, args)(*args)
-        self.cache.update_pools(kp, vp, site="InferenceEngine.prefill")
+        out = self._get("prefill", bucket, args)(*args)
+        last, tok = out[0], out[1]
+        self.cache.update_pools(*out[2:], site="InferenceEngine.prefill")
         self.cache.trim(slot, t)
         self.cache.set_len(slot, t)
         self.stats["prefill_calls"] += 1
@@ -1123,11 +1277,12 @@ class InferenceEngine:
         key = jax.random.fold_in(self._base_key,
                                  (1 << 29) +
                                  self.stats["chunk_prefill_calls"])
-        args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                toks, starts, valids, bts, active, key)
+        args = (self.params,) + self.cache.pool_args() + \
+            (toks, starts, valids, bts, active, key)
         t0 = _telem.clock() if _telem.enabled() else None
-        last, nxt, kp, vp = self._get("chunk", nbl, args)(*args)
-        self.cache.update_pools(kp, vp,
+        out = self._get("chunk", nbl, args)(*args)
+        last, nxt = out[0], out[1]
+        self.cache.update_pools(*out[2:],
                                 site="InferenceEngine.chunk_prefill")
         for slot, chunk, start in entries:
             self.cache.set_len(slot, start + len(chunk))
@@ -1146,10 +1301,10 @@ class InferenceEngine:
         the cache planned: the new block must carry the shared block's
         bits before the caller's write lands."""
         for src, dst in copies:
-            args = (self.cache.k_pool, self.cache.v_pool,
-                    _np.int32(src), _np.int32(dst))
-            kp, vp = self._get("cow", 0, args)(*args)
-            self.cache.update_pools(kp, vp,
+            args = self.cache.pool_args() + \
+                (_np.int32(src), _np.int32(dst))
+            out = self._get("cow", 0, args)(*args)
+            self.cache.update_pools(*out,
                                     site="InferenceEngine._apply_cow")
 
     def _publish_cache_gauges(self):
@@ -1229,11 +1384,12 @@ class InferenceEngine:
         bts = self.cache.table_array(slots, nbl)
         key = jax.random.fold_in(self._base_key,
                                  self.stats["decode_calls"])
-        args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                toks, pos, bts, active, key)
+        args = (self.params,) + self.cache.pool_args() + \
+            (toks, pos, bts, active, key)
         t0 = _telem.clock() if _telem.enabled() else None
-        logits, nxt, kp, vp = self._get("decode", nbl, args)(*args)
-        self.cache.update_pools(kp, vp, site="InferenceEngine.decode")
+        out = self._get("decode", nbl, args)(*args)
+        logits, nxt = out[0], out[1]
+        self.cache.update_pools(*out[2:], site="InferenceEngine.decode")
         self.stats["decode_calls"] += 1
         if t0 is not None:
             _telem.inc("serving.decode_calls")
@@ -1294,11 +1450,12 @@ class InferenceEngine:
         bts = self.cache.table_array(slots, nbl)
         key = jax.random.fold_in(self._base_key,
                                  (1 << 28) + self.stats["verify_calls"])
-        args = (self.params, self.cache.k_pool, self.cache.v_pool,
-                toks, pos, bts, counts, active, key)
+        args = (self.params,) + self.cache.pool_args() + \
+            (toks, pos, bts, counts, active, key)
         t0 = _telem.clock() if _telem.enabled() else None
-        out, kp, vp = self._get("verify", (W, nbl), args)(*args)
-        self.cache.update_pools(kp, vp, site="InferenceEngine.verify")
+        res = self._get("verify", (W, nbl), args)(*args)
+        out = res[0]
+        self.cache.update_pools(*res[1:], site="InferenceEngine.verify")
         self.stats["verify_calls"] += 1
         self.stats["draft_tokens_scored"] += \
             int(sum(len(t) - 1 for _, t, _ in entries))

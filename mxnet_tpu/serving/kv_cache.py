@@ -76,11 +76,21 @@ class PagedKVCache:
     sharding : optional ``jax.sharding.Sharding`` the pools are placed
         with at rest (ISSUE 18 tp serving shards the kv-head axis of
         the engine's submesh); None keeps single-device pools.
+    kv_dtype : low-precision STORAGE mode (ISSUE 20): ``"fp8"`` stores
+        float8_e4m3fn codes plus per-token-row amax scale arrays
+        ``k_scale``/``v_scale`` of shape ``(layers, num_blocks,
+        block_size)`` riding alongside the pools; ``"bf16"`` stores
+        bfloat16 codes (no scales); ``"fp32"``/unset is today's f32
+        pool, bitwise.  ``None`` reads ``MXTPU_KV_DTYPE``.  The HOST
+        accounting (refcounts, CoW, handoff) is dtype-blind — only the
+        device arrays change.
     """
 
     def __init__(self, num_layers, num_kv_heads, head_dim, num_blocks=64,
-                 block_size=16, max_batch=4, dtype=None, sharding=None):
+                 block_size=16, max_batch=4, dtype=None, sharding=None,
+                 kv_dtype=None):
         import jax.numpy as jnp
+        from ..ops import quant_kv as _qkv
         if block_size < 1 or (block_size & (block_size - 1)):
             raise MXNetError("block_size must be a power of two, got "
                              f"{block_size}")
@@ -93,7 +103,11 @@ class PagedKVCache:
         self.num_blocks = num_blocks
         self.block_size = block_size
         self.max_batch = max_batch
-        self.dtype = dtype or jnp.float32
+        self.kv_dtype = _qkv.resolve_kv_dtype(kv_dtype)
+        if self.kv_dtype is not None:
+            self.dtype = _qkv.kv_pool_dtype(self.kv_dtype)
+        else:
+            self.dtype = dtype or jnp.float32
         shape = (num_layers, num_blocks, block_size, num_kv_heads, head_dim)
         self.sharding = sharding
         if sharding is not None:
@@ -105,6 +119,22 @@ class PagedKVCache:
         else:
             self.k_pool = jnp.zeros(shape, self.dtype)
             self.v_pool = jnp.zeros(shape, self.dtype)
+        # fp8 scale rows: ONE f32 amax scale per written token row,
+        # indexed exactly like the pools' (layer, block, offset) —
+        # scales ride the same donate/update_pools round-trip
+        self.k_scale = self.v_scale = None
+        if _qkv.kv_has_scales(self.kv_dtype):
+            sshape = (num_layers, num_blocks, block_size)
+            self.k_scale = jnp.zeros(sshape, jnp.float32)
+            self.v_scale = jnp.zeros(sshape, jnp.float32)
+            if sharding is not None:
+                import jax
+                from jax.sharding import NamedSharding, PartitionSpec
+                if isinstance(sharding, NamedSharding):
+                    rep = NamedSharding(sharding.mesh,
+                                        PartitionSpec(None, None, None))
+                    self.k_scale = jax.device_put(self.k_scale, rep)
+                    self.v_scale = jax.device_put(self.v_scale, rep)
         # LIFO free list: freshly freed blocks are reused first (warm)
         self._free = list(range(num_blocks - 1, 0, -1))
         self._tables = {}        # slot -> [physical block ids]
@@ -160,10 +190,15 @@ class PagedKVCache:
     @property
     def block_nbytes(self):
         """Exact bytes ONE block pins across both pools and all layers
+        — INCLUDING the fp8 per-row scale arrays (ISSUE 20): a
+        capacity claim that ignored its own scale overhead would lie
         — the flight recorder's memory block multiplies this by
         ``blocks_in_use`` (ISSUE 15 memory honesty)."""
         layers, _, bs, kvh, hd = self.k_pool.shape
-        return 2 * layers * bs * kvh * hd * self.k_pool.dtype.itemsize
+        n = 2 * layers * bs * kvh * hd * self.k_pool.dtype.itemsize
+        if self.k_scale is not None:
+            n += 2 * layers * bs * self.k_scale.dtype.itemsize
+        return n
 
     def blocks_for(self, n_tokens):
         """Blocks needed to hold ``n_tokens`` positions."""
@@ -328,20 +363,38 @@ class PagedKVCache:
             out[i, :len(t)] = t[:width]
         return out
 
-    def update_pools(self, k_pool, v_pool, site="InferenceEngine.dispatch"):
-        """Swap in the pools returned by a compiled (donated) step.
-        With the use-after-donate sentinel armed (MXTPU_DONATION_CHECK,
-        ISSUE 16) the OLD pools are poisoned at the swap: the donated
-        executables consumed them, so any host touch of a stale pool
-        reference after this point raises naming ``site``."""
+    def update_pools(self, k_pool, v_pool, k_scale=None, v_scale=None,
+                     site="InferenceEngine.dispatch"):
+        """Swap in the pools returned by a compiled (donated) step —
+        and, under fp8 storage, the scale arrays that rode the same
+        donated round-trip.  With the use-after-donate sentinel armed
+        (MXTPU_DONATION_CHECK, ISSUE 16) the OLD arrays are poisoned at
+        the swap: the donated executables consumed them, so any host
+        touch of a stale reference after this point raises naming
+        ``site``."""
         if _donation._ENABLED and self.k_pool is not k_pool:
-            _donation.poison((self.k_pool, self.v_pool), site=site)
+            old = (self.k_pool, self.v_pool)
+            if k_scale is not None and self.k_scale is not None:
+                old += (self.k_scale, self.v_scale)
+            _donation.poison(old, site=site)
         self.k_pool = k_pool
         self.v_pool = v_pool
+        if k_scale is not None:
+            self.k_scale = k_scale
+            self.v_scale = v_scale
+
+    def pool_args(self):
+        """The device arrays a compiled graph takes (and returns,
+        donated): ``(k_pool, v_pool)`` — plus the fp8 scale arrays
+        when this cache stores scaled codes."""
+        if self.k_scale is not None:
+            return (self.k_pool, self.v_pool, self.k_scale, self.v_scale)
+        return (self.k_pool, self.v_pool)
 
     def stats(self):
         shared = sum(1 for r in self._refs.values() if r > 1)
         return {"num_blocks": self.num_blocks,
+                "kv_dtype": self.kv_dtype or "fp32",
                 "block_size": self.block_size,
                 "blocks_in_use": self.blocks_in_use,
                 "utilization": round(self.utilization(), 4),

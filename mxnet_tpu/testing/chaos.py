@@ -629,7 +629,17 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
     requests are in flight; the router requeues and every request must
     complete exactly once with the solo cold-path token stream.
     Deterministic: the router's drive() mode (no threads), FakeClock
-    timestamps, zero sleeps."""
+    timestamps, zero sleeps.
+
+    ISSUE 20: under ``MXTPU_KV_DTYPE=fp8`` (or ``bf16``) every engine
+    here — solo reference AND fleet — stores its KV pool quantized
+    (engines read the env at init), so ``outputs_match_solo`` stays
+    the bitwise fleet-vs-solo gate *within* the quantized mode; the
+    scenario then additionally teacher-forces the solo streams through
+    an explicit fp32-KV engine and gates the max |logit| drift
+    (``kv_drift_ok``), publishing ``serving.kv_decode_drift``."""
+    from mxnet_tpu import telemetry
+    from mxnet_tpu.ops.quant_kv import resolve_kv_dtype
     from mxnet_tpu.serving import InferenceEngine, Request, Router
     from mxnet_tpu.testing import faults
 
@@ -643,10 +653,12 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
                for i in range(n_requests)]
     speculative = os.environ.get(
         "MXTPU_SPEC_DECODE", "0") not in ("", "0")
+    kv_dtype = resolve_kv_dtype()
     result = {"kind": "serving", "replicas": replicas,
               "requests": n_requests, "kill_rid": kill_rid,
               "kill_at_boundary": kill_at_boundary,
-              "speculative": speculative}
+              "speculative": speculative,
+              "kv_dtype": kv_dtype or "fp32"}
 
     # solo cold-path references: one fresh single-replica engine per
     # prompt, full-prompt prefill, greedy decode — the stream every
@@ -659,16 +671,22 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
                               max_context=32, spec_decode=False)
     ref_eng.warmup()
     refs = []
+    ref_fed = []      # full fed token streams (for fp8 drift replay)
+    ref_logits = []   # per-step decode logits under the env kv_dtype
     for p in prompts:
         tok, _ = ref_eng.prefill(0, p)
         cur = list(p) + [int(tok)]
+        lgs = []
         for _ in range(3):
             pos = len(cur) - 1
             assert ref_eng.reserve(0, pos)
-            nxt, _lg = ref_eng.decode([(0, cur[-1], pos)])
+            nxt, lg = ref_eng.decode([(0, cur[-1], pos)])
+            lgs.append(_np.asarray(lg[0], _np.float32))
             cur.append(int(nxt[0]))
         ref_eng.release(0)
         refs.append(cur[len(p):])
+        ref_fed.append(cur)
+        ref_logits.append(lgs)
 
     def factory(compile_cache):
         return InferenceEngine(net, max_batch=2, block_size=8,
@@ -710,6 +728,31 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
         result["spec_accepted"] = accepted
         result["spec_accept_rate"] = (
             round(accepted / drafted, 4) if drafted else None)
+    if kv_dtype is not None:
+        # ISSUE 20 drift oracle: teacher-force the SAME token streams
+        # the quantized solo reference committed through an explicit
+        # fp32-KV engine and bound the max |logit| gap.  The bitwise
+        # fleet-vs-solo gate above already ran within the quantized
+        # mode; this bounds how far the quantized store sits from full
+        # precision on identical inputs.
+        f32_eng = InferenceEngine(net, max_batch=2, block_size=8,
+                                  max_context=32, spec_decode=False,
+                                  kv_dtype="fp32")
+        f32_eng.warmup()
+        drift = 0.0
+        for p, fed, lgs in zip(prompts, ref_fed, ref_logits):
+            f32_eng.prefill(0, p)
+            for j, ref_lg in enumerate(lgs):
+                pos = len(p) + j
+                assert f32_eng.reserve(0, pos)
+                _, lg = f32_eng.decode([(0, fed[pos], pos)])
+                drift = max(drift, float(_np.max(_np.abs(
+                    _np.asarray(lg[0], _np.float32) - ref_lg))))
+            f32_eng.release(0)
+        result["kv_decode_drift"] = round(drift, 6)
+        result["kv_drift_ok"] = drift <= 0.25
+        if telemetry.enabled():
+            telemetry.set_gauge("serving.kv_decode_drift", drift)
     # the injected kill must have left a parseable flight dump whose
     # last event is the fault trip (ISSUE 9 discipline)
     result["flight_dump"] = _flight_check(expect_kind="fault.trip")
@@ -735,6 +778,7 @@ def run_serving_scenario(replicas=2, n_requests=6, kill_rid=1,
         result["no_lost_or_dup"] and result["outputs_match_solo"]
         and result["epoch"] >= 1 and result["requeues"] >= 1
         and result["compiles_after_warmup"] == 0 and leaks_ok
+        and result.get("kv_drift_ok", True)
         and (fd is None or fd["ok"]) and (rcv is None or rcv["ok"])
         and (dcv is None or dcv["ok"]))
     return result

@@ -1,0 +1,32 @@
+# HB21 fixture — near-misses that must NOT fire:
+#   - casts to wide dtypes (f32/i32/u8)
+#   - dtype names in non-cast positions (zeros/full construction)
+#   - the scaled-helper route itself
+#   - a justified per-line suppression
+import jax.numpy as jnp
+from jax import lax
+
+from mxnet_tpu.ops.quant_matmul import quantize_rtn_int8
+
+
+def widen(x):
+    return x.astype(jnp.float32)          # widening: no clip risk
+
+
+def counters(x):
+    return x.astype(jnp.int32)            # wide int: fine
+
+
+def fresh_pool(n):
+    # CONSTRUCTION at a narrow dtype is not a cast of live values
+    return jnp.zeros((n, 4), dtype=jnp.int8)
+
+
+def scaled(x, scale):
+    return quantize_rtn_int8(x, scale)    # the sanctioned route
+
+
+def wire(x):
+    # bf16 keeps f32's exponent range — scale-free by design here
+    y = x.astype(jnp.bfloat16)  # mxlint: disable=HB21 -- comms wire
+    return lax.psum(y, "i")

@@ -1,0 +1,24 @@
+# HB21 fixture — unscaled low-precision casts, four planted bugs
+# (line order):
+#   1. raw astype to int8 (no amax scale anywhere near the cast)
+#   2. raw astype to fp8-e4m3 codes
+#   3. string-dtype form of the same bug
+#   4. lax.convert_element_type to bf16 mid-graph
+import jax.numpy as jnp
+from jax import lax
+
+
+def pack_grads(g):
+    return g.astype(jnp.int8)  # BUG: |g| > 127 saturates silently
+
+
+def cache_keys(k):
+    return k.astype(jnp.float8_e4m3fn)  # BUG: tails flushed at 448
+
+
+def wire_codes(x):
+    return x.astype("int8")  # BUG: string-dtype form, same clip
+
+
+def narrow_activations(x):
+    return lax.convert_element_type(x, jnp.bfloat16)  # BUG: raw cast

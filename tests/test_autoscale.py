@@ -474,6 +474,7 @@ def test_estimator_drain_deadline_takes_emergency_exit(tmp_path):
 # --chaos autoscale)
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow   # the queue runner re-runs this exact scenario
 def test_chaos_autoscale_scenario(tmp_path):
     from mxnet_tpu.testing.chaos import run_autoscale_scenario
     r = run_autoscale_scenario(workdir=str(tmp_path))

@@ -325,6 +325,8 @@ _SERVING_KEYS = {
     # ISSUE 18 sharded/disaggregated fleet fields
     "tp_shards", "disaggregated", "handoff_ms",
     "prefill_pool_occupancy", "decode_pool_occupancy",
+    # ISSUE 20 low-precision KV fields
+    "kv_dtype", "kv_capacity_ratio", "kv_decode_drift",
 }
 
 
@@ -338,7 +340,8 @@ def test_serving_block_schema_is_stable():
               "compiles_after_warmup", "cache_utilization",
               "prefix_hit_rate", "router_p99_ms", "spec_accept_rate",
               "tokens_per_dispatch", "handoff_ms",
-              "prefill_pool_occupancy", "decode_pool_occupancy"):
+              "prefill_pool_occupancy", "decode_pool_occupancy",
+              "kv_capacity_ratio", "kv_decode_drift"):
         assert blk[k] is None, k
     # CONFIG fields are always real (front-end off by default)
     assert blk["chunked_prefill"] is False
@@ -347,6 +350,7 @@ def test_serving_block_schema_is_stable():
     assert blk["paged_attn"] is False
     assert blk["tp_shards"] == 0
     assert blk["disaggregated"] is False
+    assert blk["kv_dtype"] == "fp32"
     # measured values round-trip, rounded
     blk2 = serving_block(p99_ms=12.3456, tokens_s_chip=901.239,
                          occupancy=0.87654, compiles_after_warmup=0,
@@ -650,6 +654,8 @@ def test_telemetry_schema_version_stamped():
     assert obj["telemetry_schema_version"] == 1
 
 
+@pytest.mark.slow   # builds two engines; the telemetry read-through
+# discipline is gated fast in test_telemetry.py
 def test_loadgen_compiles_counter_reads_through_telemetry():
     """The loadgen's compiles_after_warmup is a before/after DELTA off
     the process registry (one source of truth), so a second engine in
@@ -915,5 +921,101 @@ def test_bench_diff_gates_multiproc_schema_drift(tmp_path, capsys):
     assert "extra.multiproc.coordinator_reinit_ms" in flat
     assert bench_diff.direction(
         "extra.multiproc.coordinator_reinit_ms") == "down"
+    b.write_text(json.dumps(base))
+    assert bench_diff.main([str(a), str(b), "--quiet"]) == 0
+
+
+# ----------------------------------------------------------------------
+# the `quant` block schema (ISSUE 20): env-knob config + the fp8-KV
+# capacity arithmetic always real; device-measured fields (decode
+# drift, quantized-train MFU) null unless THIS run measured them
+# ----------------------------------------------------------------------
+
+_QUANT_KEYS = {
+    "quant_schema_version", "compute_dtype", "kv_dtype",
+    "kv_capacity_ratio", "kv_decode_drift", "quant_train_mfu",
+}
+
+
+def test_quant_block_schema_is_stable(monkeypatch):
+    monkeypatch.delenv("MXTPU_COMPUTE_DTYPE", raising=False)
+    monkeypatch.delenv("MXTPU_KV_DTYPE", raising=False)
+    blk = bench._bench_quant()
+    assert set(blk) - {"note"} == _QUANT_KEYS
+    assert blk["quant_schema_version"] == bench.QUANT_SCHEMA_VERSION
+    assert blk["compute_dtype"] == "fp32"
+    assert blk["kv_dtype"] == "fp32"
+    # the headline capacity claim: >= 2x blocks at equal pool bytes,
+    # fp8 scale-row overhead included (pure arithmetic, real on CPU)
+    assert blk["kv_capacity_ratio"] >= 2.0
+    assert json.loads(json.dumps(blk)) == blk
+
+
+def test_quant_block_unmeasured_is_nulls_not_zeros(monkeypatch):
+    """An in-process CPU bench never ran a fp8-KV serving drift check
+    or a quantized TPU training step — those fields are null, with the
+    note pointing at the runs that measure them."""
+    monkeypatch.delenv("MXTPU_COMPUTE_DTYPE", raising=False)
+    monkeypatch.delenv("MXTPU_KV_DTYPE", raising=False)
+    blk = bench._bench_quant()
+    assert blk["kv_decode_drift"] is None
+    assert blk["quant_train_mfu"] is None
+    assert "note" in blk and "--kv-dtype fp8" in blk["note"]
+
+
+def test_quant_block_reads_env_knobs(monkeypatch):
+    monkeypatch.setenv("MXTPU_COMPUTE_DTYPE", "int8")
+    monkeypatch.setenv("MXTPU_KV_DTYPE", "fp8")
+    blk = bench._bench_quant()
+    assert blk["compute_dtype"] == "int8"
+    assert blk["kv_dtype"] == "fp8"
+
+
+def test_quant_compact_keys_surface_when_measured():
+    """The generic extras sweep surfaces the block's scalars as
+    quant.<key> once measured; nulls never reach the headline."""
+    p = _success_payload()
+    p["extra"]["quant"] = {
+        "quant_schema_version": bench.QUANT_SCHEMA_VERSION,
+        "compute_dtype": "fp8", "kv_dtype": "fp8",
+        "kv_capacity_ratio": 3.2, "kv_decode_drift": 0.005,
+        "quant_train_mfu": 0.31}
+    obj = _assert_headline(bench._compact_line(p))
+    assert obj["quant.kv_capacity_ratio"] == 3.2
+    assert obj["quant.kv_decode_drift"] == 0.005
+    assert obj["quant.quant_train_mfu"] == 0.31
+    p["extra"]["quant"]["kv_decode_drift"] = None
+    p["extra"]["quant"]["quant_train_mfu"] = None
+    obj = json.loads(bench._compact_line(p))
+    assert "quant.kv_decode_drift" not in obj
+    assert "quant.quant_train_mfu" not in obj
+
+
+def test_bench_diff_gates_quant_schema_drift(tmp_path, capsys):
+    """tools/bench_diff.py refuses (exit 2) to compare payloads whose
+    quant blocks carry different schema versions; config strings never
+    compare, kv_capacity_ratio gates upward and kv_decode_drift
+    downward."""
+    from tools import bench_diff
+    blk = {"quant_schema_version": 1, "compute_dtype": "fp8",
+           "kv_dtype": "fp8", "kv_capacity_ratio": 3.2,
+           "kv_decode_drift": 0.005, "quant_train_mfu": None}
+    base = {"metric": "m", "value": 1.0, "platform": "cpu",
+            "telemetry_schema_version": 1,
+            "extra": {"quant": blk}}
+    drift = json.loads(json.dumps(base))
+    drift["extra"]["quant"]["quant_schema_version"] += 1
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(base))
+    b.write_text(json.dumps(drift))
+    rc = bench_diff.main([str(a), str(b), "--quiet"])
+    out = capsys.readouterr().out
+    assert rc == 2
+    assert "quant_schema_drift" in out
+    flat = bench_diff.flatten(base)
+    assert "extra.quant.quant_schema_version" not in flat
+    assert "extra.quant.kv_capacity_ratio" in flat
+    assert bench_diff.direction("extra.quant.kv_capacity_ratio") == "up"
+    assert bench_diff.direction("extra.quant.kv_decode_drift") == "down"
     b.write_text(json.dumps(base))
     assert bench_diff.main([str(a), str(b), "--quiet"]) == 0

@@ -238,6 +238,7 @@ def test_faster_rcnn_forward():
         and (r[..., 1::2] <= 128).all()
 
 
+@pytest.mark.slow   # model-zoo forward smoke, no unique op coverage
 def test_simple_pose():
     """SimplePose (gluoncv simple_pose_resnet.py): trunk -> 3 deconvs ->
     per-joint heatmaps at input/4; on-device argmax decode."""

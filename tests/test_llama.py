@@ -71,6 +71,9 @@ def test_llama_causality():
 # slow-marked (ISSUE 18 tier-1 headroom): tp/cp training parity stays
 # covered by test_ring_equals_flash + test_parallel/test_mesh3d
 @pytest.mark.slow
+@pytest.mark.slow   # dp×tp×sp composition twin: tp training is gated
+# fast in test_megatron, cp in test_ring_equals_flash/test_ulysses,
+# the fused dp step everywhere (ISSUE 20 tier-1 headroom)
 def test_llama_tp_cp_mesh_train():
     """dp x tp x sp fused jitted step on the 8-device CPU mesh."""
     import jax

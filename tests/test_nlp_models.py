@@ -30,6 +30,9 @@ def test_multihead_attention_shapes():
 # slow-marked (ISSUE 18 tier-1 headroom): BERT coverage stays via
 # test_bert_hybridize + test_transformer_forward_and_causality
 @pytest.mark.slow
+@pytest.mark.slow   # heaviest BERT build; forward parity stays tier-1
+# via test_bert_hybridize and backward via test_gluon's encoder-remat
+# test (ISSUE 20 tier-1 headroom)
 def test_bert_tiny_forward_and_grad():
     model = nlp.get_bert_model(num_layers=2, units=32, hidden_size=64,
                                num_heads=4, vocab_size=100, max_length=32)

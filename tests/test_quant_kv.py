@@ -1,0 +1,320 @@
+"""fp8 KV-cache storage (ISSUE 20): quantize-on-write / dequantize-in-
+attention across every graph family, capacity at equal bytes, and the
+bitwise kill switch.
+
+THE acceptance gates:
+
+- ``MXTPU_KV_DTYPE`` unset (or ``fp32``) is a bitwise-inert kill
+  switch: the default engine and an explicit ``kv_dtype="fp32"`` engine
+  produce identical logits (same compiled graphs, no cast, no scales);
+- at EQUAL pool byte budget, fp8 holds >= 2x the f32 block count with
+  the per-row scale overhead included in the arithmetic (the honest
+  capacity claim behind "2x serving concurrency");
+- the fp8 engine's drift vs an explicit fp32-KV engine on the SAME fed
+  token stream is small and bounded — per family: decode, packed
+  chunk prefill, and verify (speculative acceptance stays bitwise
+  WITHIN the fp8 mode, the ISSUE 17 contract under quantized storage);
+- prefix-cache adoption + CoW fork and the disaggregated paged-block
+  handoff work unchanged over fp8 pools (streams match the engine's
+  own cold path / the solo reference, leak sweep clean);
+- ``compiles_after_warmup`` stays 0 under fp8 traffic.
+
+Every engine here shares ONE compile cache; signatures carry
+``kv_dtype``, so fp8 and f32 graphs never collide.  One-layer net,
+single context bucket where possible — the multi-bucket machinery has
+its own gates in test_serving.py.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon.model_zoo.nlp.llama import (LlamaConfig,
+                                                 LlamaForCausalLM)
+from mxnet_tpu.ops.quant_kv import (FP8_MAX, kv_block_bytes,
+                                    kv_blocks_in_budget, kv_dequantize,
+                                    kv_quantize_fp8, resolve_kv_dtype)
+from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
+                               PagedKVCache, Request, Router)
+
+nd = mx.nd
+
+_VOCAB = 48
+_CC = {}      # module-wide shared compile cache (sig carries kv_dtype)
+_STATE = {}
+
+# self-repeating prompts so the prompt-lookup draft source fires in the
+# speculative test (same trick as test_spec_decode.py)
+_PROMPTS = ((1, 2, 3, 1, 2, 3, 1),
+            (5, 6, 7, 5, 6),
+            (9, 10, 9, 10, 9, 10))
+
+
+@pytest.fixture(scope="module")
+def net():
+    cfg = LlamaConfig(vocab_size=_VOCAB, hidden_size=32, num_layers=1,
+                      num_heads=4, num_kv_heads=2, intermediate_size=64,
+                      max_seq_len=64, tie_embeddings=True)
+    n = LlamaForCausalLM(cfg)
+    n.initialize()
+    n(nd.array([[1, 2, 3]], dtype="int32"))
+    n.hybridize()
+    return n
+
+
+def _engine(net, key, **kw):
+    if key not in _STATE:
+        kw.setdefault("max_batch", 3)
+        kw.setdefault("block_size", 16)
+        kw.setdefault("max_context", 16)
+        kw.setdefault("prefix_cache", False)
+        _STATE[key] = InferenceEngine(net, compile_cache=_CC,
+                                      **kw).warmup()
+    return _STATE[key]
+
+
+def _greedy(eng, slot, prompt, n_steps):
+    """Prefill + greedy decode, recording the fed stream and per-step
+    logits — the drift probes feed the SAME stream to both engines."""
+    tok, _ = eng.prefill(slot, prompt)
+    cur = list(prompt) + [int(tok)]
+    lgs = []
+    for _ in range(n_steps):
+        pos = len(cur) - 1
+        assert eng.reserve(slot, pos)
+        nxt, lg = eng.decode([(slot, cur[-1], pos)])
+        lgs.append(np.asarray(lg[0], np.float32))
+        cur.append(int(nxt[0]))
+    eng.release(slot)
+    return cur, lgs
+
+
+def _replay(eng, slot, prompt, fed, n_steps):
+    """Teacher-force ``fed`` (another engine's committed stream)
+    through ``eng``, returning its logits at the same positions."""
+    eng.prefill(slot, prompt)
+    lgs = []
+    for j in range(n_steps):
+        pos = len(prompt) + j
+        assert eng.reserve(slot, pos)
+        _, lg = eng.decode([(slot, fed[pos], pos)])
+        lgs.append(np.asarray(lg[0], np.float32))
+    eng.release(slot)
+    return lgs
+
+
+# ----------------------------------------------------------------------
+# helpers: resolution, roundtrip, capacity arithmetic
+# ----------------------------------------------------------------------
+
+def test_resolve_kill_switch_and_typo(monkeypatch):
+    monkeypatch.delenv("MXTPU_KV_DTYPE", raising=False)
+    assert resolve_kv_dtype() is None
+    for off in ("", "0", "off", "none", "fp32", "float32"):
+        assert resolve_kv_dtype(off) is None
+    assert resolve_kv_dtype("fp8") == "fp8"
+    assert resolve_kv_dtype("float8_e4m3fn") == "fp8"
+    assert resolve_kv_dtype("bf16") == "bf16"
+    with pytest.raises(MXNetError):
+        resolve_kv_dtype("int4")           # typo must not serve f32
+    monkeypatch.setenv("MXTPU_KV_DTYPE", "fp8")
+    assert resolve_kv_dtype() == "fp8"     # env fallback
+
+
+def test_fp8_roundtrip_per_row_scales():
+    rng = np.random.RandomState(0)
+    # rows with wildly different magnitudes: per-ROW scales keep each
+    # row's error proportional to ITS amax, not the batch max
+    x = rng.randn(4, 16, 2, 8).astype(np.float32)
+    x[0] *= 1e-3
+    x[1] *= 1e2
+    x[2, 5] = 0.0                          # an all-zero row
+    codes, scale = kv_quantize_fp8(x)
+    assert codes.shape == x.shape and scale.shape == x.shape[:-2]
+    deq = np.asarray(kv_dequantize(codes, scale))
+    amax = np.abs(x).max(axis=(-2, -1), keepdims=True)
+    # e4m3 floating error: 3-bit mantissa -> relative error <= 2^-4,
+    # plus one subnormal quantum (scale * 2^-9) near zero
+    assert np.all(np.abs(deq - x)
+                  <= np.abs(x) * 2.0 ** -4 + amax * 2.0 ** -9 + 1e-12)
+    assert np.all(deq[2, 5] == 0.0)        # zero rows stay exact zeros
+
+
+def test_capacity_ratio_at_equal_bytes():
+    # the bench geometry (a 24-layer GQA serving shape); the gate is
+    # the ISSUE 20 claim: equal byte budget, >= 2x the f32 blocks,
+    # per-row f32 scale overhead INCLUDED
+    geom = dict(num_layers=24, num_kv_heads=8, head_dim=128,
+                block_size=16)
+    budget = 1 << 30
+    f32 = kv_blocks_in_budget(budget, **geom)
+    fp8 = kv_blocks_in_budget(budget, kv_dtype="fp8", **geom)
+    bf16 = kv_blocks_in_budget(budget, kv_dtype="bf16", **geom)
+    assert fp8 >= 2 * f32
+    assert bf16 == 2 * f32                 # bf16: exactly half the bytes
+    # the scale rows are charged: an fp8 block costs MORE than a quarter
+    # of the f32 block
+    assert kv_block_bytes(kv_dtype="fp8", **geom) \
+        > kv_block_bytes(**geom) // 4
+
+
+def test_cache_fp8_pools_scales_and_bytes():
+    import jax.numpy as jnp
+    c = PagedKVCache(num_layers=1, num_kv_heads=2, head_dim=8,
+                     num_blocks=8, block_size=4, max_batch=2,
+                     kv_dtype="fp8")
+    assert c.k_pool.dtype == jnp.float8_e4m3fn
+    assert c.k_scale.shape == (1, 8, 4)
+    assert c.k_scale.dtype == jnp.float32
+    assert len(c.pool_args()) == 4
+    assert c.stats()["kv_dtype"] == "fp8"
+    assert c.block_nbytes == kv_block_bytes(
+        num_layers=1, num_kv_heads=2, head_dim=8, block_size=4,
+        kv_dtype="fp8")
+    # the f32 cache carries no scales and a 2-tuple pool signature
+    p = PagedKVCache(num_layers=1, num_kv_heads=2, head_dim=8,
+                     num_blocks=8, block_size=4, max_batch=2)
+    assert p.k_scale is None and len(p.pool_args()) == 2
+    assert p.stats()["kv_dtype"] == "fp32"
+
+
+# ----------------------------------------------------------------------
+# engine drift per family + the bitwise kill switch
+# ----------------------------------------------------------------------
+
+def test_kill_switch_bitwise_and_env_resolution(net, monkeypatch):
+    """Default engine (env unset) == explicit kv_dtype="fp32",
+    BITWISE, and the env knob actually reaches the engine."""
+    monkeypatch.delenv("MXTPU_KV_DTYPE", raising=False)
+    e_def = _engine(net, "default")
+    e_f32 = _engine(net, "f32", kv_dtype="fp32")
+    prompt = [7, 3, 11, 2, 9]
+    fed, lgs_def = _greedy(e_def, "a", prompt, 6)
+    lgs_f32 = _replay(e_f32, "a", prompt, fed, 6)
+    for a, b in zip(lgs_def, lgs_f32):
+        np.testing.assert_array_equal(a, b)
+    # the env knob flows into a fresh engine (shared cache stays keyed
+    # by kv_dtype, so the fp8 engine never adopts the f32 graphs)
+    monkeypatch.setenv("MXTPU_KV_DTYPE", "fp8")
+    e = InferenceEngine(net, max_batch=3, block_size=16, max_context=16,
+                        prefix_cache=False, compile_cache=_CC)
+    assert e.kv_dtype == "fp8" and e.cache.k_scale is not None
+
+
+def test_fp8_decode_drift_bounded_zero_recompiles(net):
+    e8 = _engine(net, "fp8", kv_dtype="fp8", spec_decode=True, spec_k=2)
+    ef = _engine(net, "f32", kv_dtype="fp32")
+    prompt = [7, 3, 11, 2, 9]
+    fed, lgs8 = _greedy(e8, "a", prompt, 8)
+    lgsf = _replay(ef, "a", prompt, fed, 8)
+    drift = max(float(np.max(np.abs(a - b)))
+                for a, b in zip(lgs8, lgsf))
+    assert 0.0 < drift <= 0.1              # quantized, but bounded
+    assert e8.stats["compiles_after_warmup"] == 0
+    assert e8.cache.check_leaks()
+    # fp8 writes really landed scale rows
+    assert float(np.asarray(e8.cache.k_scale).max()) > 0.0
+
+
+@pytest.mark.slow   # own chunked engine pair (heaviest build here);
+# the chunk-family fp8 write seam stays tier-1 via the prefix
+# adoption test below (prefill_chunk=8)
+def test_fp8_chunked_prefill_drift_bounded(net):
+    """The packed chunk family: later chunks attend over DEQUANTIZED
+    earlier rows (full prefill attends over fresh f32), so the fp8
+    chunk path is drift-bounded vs the fp32 chunk path on the same
+    fed tokens."""
+    kw = dict(block_size=8, max_context=32, prefill_chunk=8)
+    e8 = _engine(net, "fp8_chunk", kv_dtype="fp8", **kw)
+    ef = _engine(net, "f32_chunk", kv_dtype="fp32", **kw)
+    prompt = list(np.random.RandomState(5).randint(0, _VOCAB, (13,)))
+    outs = []
+    for eng in (e8, ef):
+        # alloc the first chunk only; chunk_prefill ensure()s growth,
+        # so the block table never outruns the chunk's context bucket
+        assert eng.cache.alloc("a", 8)
+        nxt, lg = eng.chunk_prefill([("a", prompt[:8], 0)])
+        nxt, lg = eng.chunk_prefill([("a", prompt[8:], 8)])
+        outs.append(np.asarray(lg[0], np.float32))
+        eng.release("a")
+    drift = float(np.max(np.abs(outs[0] - outs[1])))
+    assert 0.0 < drift <= 0.1
+    assert e8.stats["compiles_after_warmup"] == 0
+
+
+def test_fp8_speculative_bitwise_within_mode(net):
+    """ISSUE 17's contract under quantized storage: greedy speculative
+    acceptance is BITWISE the plain decode stream of the SAME fp8
+    engine — verify dequantizes the very rows decode would."""
+    e8 = _engine(net, "fp8", kv_dtype="fp8", spec_decode=True, spec_k=2)
+    refs = [_greedy(e8, "r", list(p), 5)[0][len(p):] for p in _PROMPTS]
+    b = ContinuousBatcher(e8)
+    reqs = [b.submit(Request(list(p), max_new_tokens=6))
+            for p in _PROMPTS]
+    b.run()
+    assert [list(r.generated) for r in reqs] == refs
+    assert b.spec_drafted > 0              # speculation actually ran
+    assert e8.stats["compiles_after_warmup"] == 0
+    assert e8.cache.check_leaks()
+
+
+def test_fp8_prefix_adoption_and_cow_fork(net):
+    """Prefix-cache adoption + CoW fork over fp8 pools: pinned-prefix
+    streams match the SAME engine's cold path (scale rows fork with
+    their blocks), refcounts clean after release."""
+    # prefix adoption rides the chunked-prefill admission path, so the
+    # engine needs prefill_chunk (the router's configuration)
+    eng = _engine(net, "fp8_prefix", kv_dtype="fp8", max_batch=2,
+                  block_size=8, max_context=32, prefix_cache=True,
+                  prefill_chunk=8)
+    rng = np.random.RandomState(11)
+    sys_prompt = list(rng.randint(0, _VOCAB, (12,)))   # partial block
+    prompts = [sys_prompt + list(rng.randint(0, _VOCAB, (3 + i,)))
+               for i in range(2)]
+    # cold references first (prefix cache empty -> plain path)
+    refs = [_greedy(eng, "c", p, 4)[0][len(p):] for p in prompts]
+    eng.pin_prefix(sys_prompt)
+    b = ContinuousBatcher(eng)
+    reqs = [b.submit(Request(list(p), max_new_tokens=5))
+            for p in prompts]
+    b.run()
+    assert [list(r.generated) for r in reqs] == refs
+    st = eng.cache.stats()
+    assert eng.prefix_cache.hits >= 2      # adoption really happened
+    assert st["cow_copies"] >= 1           # the partial block forked
+    assert eng.cache.check_leaks(
+        holders=eng.prefix_cache.held_blocks())
+    assert eng.stats["compiles_after_warmup"] == 0
+
+
+def test_fp8_disagg_handoff_bitwise_solo(net):
+    """The disaggregated paged-block handoff (ISSUE 18) over ONE shared
+    fp8 pool: prefill-role replicas hand quantized blocks (codes AND
+    scale rows) to decode-role replicas; outputs bitwise the solo fp8
+    engine, shared pool leak-clean."""
+    base = dict(max_batch=2, block_size=8, num_blocks=32,
+                max_context=32, kv_dtype="fp8")
+
+    def factory(compile_cache, kv_cache=None):
+        return InferenceEngine(net, compile_cache=_CC,
+                               kv_cache=kv_cache, **base)
+
+    rng = np.random.RandomState(3)
+    prompts = [list(rng.randint(0, _VOCAB, (3 + i % 5,)))
+               for i in range(5)]
+    solo = ContinuousBatcher(factory({}).warmup())
+    srefs = [solo.submit(Request(list(p), max_new_tokens=4))
+             for p in prompts]
+    solo.run()
+    router = Router(factory, replicas=2, disaggregated=True)
+    reqs = [Request(list(p), max_new_tokens=4) for p in prompts]
+    for r in reqs:
+        router.submit(r)
+    router.drive()
+    assert [list(r.generated) for r in reqs] \
+        == [list(r.generated) for r in srefs]
+    st = router.stats()
+    assert st["handoffs"] == len(reqs)
+    assert st["compiles_after_warmup"] == 0
+    assert router._shared_cache.kv_dtype == "fp8"
+    router._shared_cache.check_leaks(holders=0)

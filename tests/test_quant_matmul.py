@@ -1,0 +1,294 @@
+"""Scaled int8/fp8 matmul training path (ISSUE 20).
+
+THE acceptance gates:
+
+- ``MXTPU_COMPUTE_DTYPE`` unset (or ``fp32``) is a bitwise-inert kill
+  switch: ``quant_matmul(a, b)`` IS ``jnp.matmul(a, b)``;
+- int8 stochastic rounding is UNBIASED (E[dequant(quant(x))] == x, the
+  PR 3 wire contract now shared by the compute path);
+- the custom VJP delivers gradients close to the exact ones with the
+  grad-side matmuls quantized too (plain autodiff through floor would
+  return zeros — the VJP is load-bearing);
+- numerically fragile tags fall back to bf16 (defaults + the
+  ``MXTPU_QUANT_BF16_ALLOW`` env allowlist);
+- delayed scaling threads an amax history (cold start = current
+  scaling; a stale scale CLIPS, visibly);
+- the CONVERGENCE FLOOR: the real trainer (plain / accum / multi-step /
+  ZeRO-1 — the PR 2/6 ``DataParallelTrainer`` paths) under int8 and
+  fp8 compute reaches a final loss within a small margin of the fp32
+  run on the same data, and the loss actually falls;
+- quantized sites publish ``quant.amax.<tag>.*`` / overflow gauges.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.ops.quant_matmul import (FP8_MAX, INT8_MAX,
+                                        bf16_fallback_tags,
+                                        dequantize_int8,
+                                        init_delayed_state,
+                                        quant_matmul,
+                                        quant_matmul_delayed,
+                                        quantize_rtn_int8,
+                                        quantize_sr_int8,
+                                        resolve_compute_dtype)
+from mxnet_tpu.parallel.data_parallel import DataParallelTrainer
+
+nd = mx.nd
+
+needs8 = pytest.mark.skipif(len(jax.devices()) < 8,
+                            reason="needs 8 virtual devices")
+
+
+@pytest.fixture(autouse=True)
+def _unset_compute_dtype(monkeypatch):
+    # every test starts from the kill-switch default; the trainer tests
+    # opt in explicitly
+    monkeypatch.delenv("MXTPU_COMPUTE_DTYPE", raising=False)
+    monkeypatch.delenv("MXTPU_QUANT_BF16_ALLOW", raising=False)
+
+
+# ----------------------------------------------------------------------
+# resolution, kill switch, rounding primitives
+# ----------------------------------------------------------------------
+
+def test_resolve_and_kill_switch_bitwise(monkeypatch):
+    assert resolve_compute_dtype() is None
+    for off in ("", "0", "off", "fp32", "float32"):
+        assert resolve_compute_dtype(off) is None
+    assert resolve_compute_dtype("int8") == "int8"
+    assert resolve_compute_dtype("fp8") == "fp8"
+    with pytest.raises(MXNetError):
+        resolve_compute_dtype("int4")
+    monkeypatch.setenv("MXTPU_COMPUTE_DTYPE", "int8")
+    assert resolve_compute_dtype() == "int8"
+    # unset -> quant_matmul IS jnp.matmul, bitwise
+    monkeypatch.delenv("MXTPU_COMPUTE_DTYPE")
+    rng = np.random.RandomState(0)
+    a = jnp.asarray(rng.randn(5, 7).astype(np.float32))
+    b = jnp.asarray(rng.randn(7, 3).astype(np.float32))
+    np.testing.assert_array_equal(np.asarray(quant_matmul(a, b)),
+                                  np.asarray(jnp.matmul(a, b)))
+
+
+def test_sr_int8_is_unbiased():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(64).astype(np.float32) * 0.3)
+    codes, scale = quantize_sr_int8(x, jax.random.key(0))
+    assert codes.dtype == jnp.int8
+    # one draw is within a quantum of x...
+    assert float(jnp.max(jnp.abs(dequantize_int8(codes, scale) - x))) \
+        <= float(scale) + 1e-6
+    # ...and the MEAN over many draws converges on x (unbiasedness):
+    # per-element SR noise is U(-q, q)-ish with q = scale, so the mean
+    # of N draws sits within ~5 * scale / sqrt(N)
+    keys = jax.random.split(jax.random.key(7), 512)
+    deq = jax.vmap(
+        lambda k: dequantize_int8(*quantize_sr_int8(x, k)))(keys)
+    err = float(jnp.max(jnp.abs(jnp.mean(deq, axis=0) - x)))
+    assert err <= 5.0 * float(scale) / np.sqrt(512)
+
+
+def test_rtn_int8_is_the_serving_formula():
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(4, 9).astype(np.float32) * 3)
+    s = jnp.float32(0.05)
+    q = quantize_rtn_int8(x, s)
+    ref = jnp.clip(jnp.round(x / s), -127, 127)
+    assert q.dtype == jnp.int8
+    np.testing.assert_array_equal(np.asarray(q, np.float32),
+                                  np.asarray(ref))
+
+
+# ----------------------------------------------------------------------
+# the quantized contraction: accuracy + custom VJP
+# ----------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_quant_matmul_close_and_grads_flow(mode):
+    rng = np.random.RandomState(3)
+    a = jnp.asarray(rng.randn(16, 32).astype(np.float32))
+    b = jnp.asarray(rng.randn(32, 8).astype(np.float32))
+    exact = np.asarray(jnp.matmul(a, b))
+    y = np.asarray(quant_matmul(a, b, compute_dtype=mode))
+    # per-tensor 8-bit scaling: error a few percent of the output scale
+    tol = 0.08 * float(np.abs(exact).max()) * (np.sqrt(32) / 4)
+    assert 0.0 < float(np.abs(y - exact).max()) <= tol
+    # leading dims flatten and restore
+    a3 = a.reshape(4, 4, 32)
+    y3 = np.asarray(quant_matmul(a3, b, compute_dtype=mode))
+    assert y3.shape == (4, 4, 8)
+
+    # custom VJP: grads close to exact, grad-side quantized, NOT zero
+    # (autodiff through floor/round alone would kill the signal)
+    def loss(aa, bb):
+        return jnp.sum(quant_matmul(aa, bb, compute_dtype=mode) ** 2)
+
+    da, db = jax.grad(loss, argnums=(0, 1))(a, b)
+    ea, eb = jax.grad(
+        lambda aa, bb: jnp.sum(jnp.matmul(aa, bb) ** 2),
+        argnums=(0, 1))(a, b)
+    for g, e in ((da, ea), (db, eb)):
+        g, e = np.asarray(g), np.asarray(e)
+        assert np.all(np.isfinite(g)) and np.abs(g).max() > 0
+        assert np.abs(g - e).max() <= 0.2 * np.abs(e).max()
+
+
+def test_bf16_fallback_allowlist(monkeypatch):
+    rng = np.random.RandomState(4)
+    a = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    b = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    ref_bf16 = np.asarray(jax.lax.dot_general(
+        a.astype(jnp.bfloat16), b.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32))
+    assert {"head", "logits"} <= set(bf16_fallback_tags())
+    y = np.asarray(quant_matmul(a, b, compute_dtype="int8", tag="head"))
+    np.testing.assert_array_equal(y, ref_bf16)
+    # env allowlist extends the set per call site
+    monkeypatch.setenv("MXTPU_QUANT_BF16_ALLOW", "fc, router")
+    assert {"fc", "router"} <= set(bf16_fallback_tags())
+    y2 = np.asarray(quant_matmul(a, b, compute_dtype="int8", tag="fc"))
+    np.testing.assert_array_equal(y2, ref_bf16)
+    # un-listed tags stay 8-bit (SR noise: not the bf16 result)
+    y3 = np.asarray(quant_matmul(a, b, compute_dtype="int8", tag="mm"))
+    assert np.abs(y3 - ref_bf16).max() > 0
+
+
+def test_delayed_scaling_state():
+    rng = np.random.RandomState(5)
+    a = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    b = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    st = init_delayed_state(history=4)
+    with pytest.raises(MXNetError):
+        init_delayed_state(history=0)
+    # kill switch: exact matmul, state untouched
+    y0, st0 = quant_matmul_delayed(a, b, st)
+    np.testing.assert_array_equal(np.asarray(y0),
+                                  np.asarray(jnp.matmul(a, b)))
+    # cold start falls back to CURRENT scaling; the history then rolls
+    # this step's amax in
+    y1, st1 = quant_matmul_delayed(a, b, st, compute_dtype="fp8")
+    exact = np.asarray(jnp.matmul(a, b))
+    assert np.abs(np.asarray(y1) - exact).max() <= 0.1 * np.abs(exact).max()
+    assert float(st1["a"][0]) == pytest.approx(
+        float(jnp.max(jnp.abs(a))), rel=1e-6)
+    # a STALE (too small) history scale clips: feed a tensor 100x the
+    # recorded amax — the quantized output must visibly saturate
+    stale = {"a": st1["a"] * 0.01, "b": st1["b"] * 0.01}
+    y2, _ = quant_matmul_delayed(a * 100.0, b, stale,
+                                 compute_dtype="fp8")
+    big_exact = exact * 100.0
+    assert np.abs(np.asarray(y2) - big_exact).max() \
+        > 0.5 * np.abs(big_exact).max()
+
+
+def test_quant_telemetry_gauges_published():
+    if not telemetry.enabled():
+        pytest.skip("telemetry kill switch on")
+    rng = np.random.RandomState(6)
+    a = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+    b = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+    jax.block_until_ready(quant_matmul(a, b, compute_dtype="int8",
+                                       tag="probe"))
+    jax.effects_barrier()
+    amax = telemetry.value("quant.amax.probe.a")
+    assert amax is not None and amax == pytest.approx(
+        float(jnp.max(jnp.abs(a))), rel=1e-4)
+    assert telemetry.value("quant.overflow_pct.probe") is not None
+
+
+# ----------------------------------------------------------------------
+# the convergence floor: the real trainer under 8-bit compute
+# ----------------------------------------------------------------------
+
+def _build(shard=False):
+    from mxnet_tpu.gluon import block as _blk
+    _blk._GLOBAL_COUNTERS.clear()
+    mx.random.seed(11)
+    np.random.seed(11)
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(8))
+    net.initialize()
+    tr = DataParallelTrainer(
+        net, gluon.loss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 0.01}, shard_updates=shard)
+    return net, tr
+
+
+def _data(n=6, batch=16, din=12, classes=8, seed=0):
+    rs = np.random.RandomState(seed)
+    xs = [rs.randn(batch, din).astype(np.float32) for _ in range(n)]
+    ys = [rs.randint(0, classes, (batch,)) for _ in range(n)]
+    return xs, ys
+
+
+def _losses_plain(epochs=4):
+    # cycle a small FIXED batch set: random labels are memorizable, so
+    # the loss trend is a real convergence signal in a handful of steps
+    xs, ys = _data(4)
+    _, tr = _build()
+    return [float(tr.step(nd.array(x), nd.array(y)).asnumpy())
+            for _ in range(epochs) for x, y in zip(xs, ys)]
+
+
+@pytest.mark.parametrize("mode", ["int8", "fp8"])
+def test_trainer_convergence_floor(mode, monkeypatch):
+    """The tentpole gate: the SAME plain-trainer run under 8-bit
+    compute (env read at trace time, so the whole jitted step routes
+    FullyConnected through quant_matmul) must fall and land within a
+    small margin of the fp32 final loss."""
+    ref = _losses_plain()
+    monkeypatch.setenv("MXTPU_COMPUTE_DTYPE", mode)
+    got = _losses_plain()
+    assert all(np.isfinite(got))
+    assert got[-1] < got[0]                       # it trains
+    assert abs(got[-1] - ref[-1]) <= 0.15         # the floor
+    # the route is LIVE: an 8-bit forward is not the f32 forward
+    # (losses may agree to print precision on this toy problem, so
+    # probe the op seam directly)
+    x = nd.array(np.random.RandomState(1).randn(4, 12).astype(np.float32))
+    w = nd.array(np.random.RandomState(2).randn(8, 12).astype(np.float32))
+    q = nd.FullyConnected(x, w, no_bias=True, num_hidden=8).asnumpy()
+    monkeypatch.delenv("MXTPU_COMPUTE_DTYPE")
+    f = nd.FullyConnected(x, w, no_bias=True, num_hidden=8).asnumpy()
+    assert np.abs(q - f).max() > 0
+
+
+@pytest.mark.slow   # accum/multi-step twins of the convergence floor
+# (same quant seam, extra trainer graphs); plain + ZeRO-1 stay tier-1
+def test_trainer_accum_and_multi_step_int8(monkeypatch):
+    """The composed paths (PR 6): microbatch accumulation and K-steps-
+    in-one-program both run under int8 compute, stay finite, and fall."""
+    monkeypatch.setenv("MXTPU_COMPUTE_DTYPE", "int8")
+    xs, ys = _data(4)
+    _, tr = _build()
+    l_acc = [float(tr.step_accum(nd.array(x), nd.array(y),
+                                 n_micro=2).asnumpy())
+             for _ in range(3) for x, y in zip(xs, ys)]
+    assert all(np.isfinite(l_acc)) and l_acc[-1] < l_acc[0]
+    _, tr2 = _build()
+    out = []
+    for _ in range(3):
+        for i in range(0, 4, 2):
+            got = tr2.step_multi([(nd.array(xs[j]), nd.array(ys[j]))
+                                  for j in range(i, i + 2)])
+            out += list(np.asarray(got.asnumpy()).ravel())
+    assert all(np.isfinite(out)) and out[-1] < out[0]
+
+
+@needs8
+def test_trainer_zero1_int8(monkeypatch):
+    """ZeRO-1 (shard_updates): the quantized step composes with the
+    sharded optimizer path on the 8-device CPU mesh."""
+    monkeypatch.setenv("MXTPU_COMPUTE_DTYPE", "int8")
+    xs, ys = _data(4)
+    _, tr = _build(shard=True)
+    ls = [float(tr.step(nd.array(x), nd.array(y)).asnumpy())
+          for _ in range(3) for x, y in zip(xs, ys)]
+    assert all(np.isfinite(ls)) and ls[-1] < ls[0]

@@ -233,6 +233,9 @@ def test_joined_batch_rows_match_single_sequence():
 # slow-marked (ISSUE 18 tier-1 headroom): quantize_net numerics stay
 # covered by test_quantization; the engine wiring by the int8 loadgen
 @pytest.mark.slow
+@pytest.mark.slow   # int8 WEIGHT serving end-to-end; the int8 math is
+# gated fast in test_quantization and low-precision serving in
+# test_quant_kv (ISSUE 20 tier-1 headroom)
 def test_int8_engine_bitwise_vs_quantized_net_and_bounded_vs_fp32():
     """int8 serving: the engine's decode mirrors QuantizedDense
     op-for-op, so parity vs the QUANTIZED net's own (bucket-width)
@@ -424,6 +427,8 @@ def test_prompt_longer_than_max_context_rejected():
 # loadgen smoke (the tier-1 wiring of tools/serve_loadgen.py)
 # ----------------------------------------------------------------------
 
+@pytest.mark.slow   # CLI smoke; the serving_block schema itself is
+# gated fast in test_bench_line.py
 def test_serve_loadgen_smoke_cli():
     """`tools/serve_loadgen.py --smoke` runs end-to-end and prints one
     JSON line under the driver's tail-window budget."""

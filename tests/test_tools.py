@@ -122,6 +122,8 @@ def test_launch_local_dist_async(tmp_path):
     assert r.stdout.count("ASYNC_OK") == 2, r.stdout + r.stderr
 
 
+@pytest.mark.slow   # 2-process launch; the int8 wire math is gated
+# fast in test_kvstore.py
 def test_launch_local_dist_int8_compression(tmp_path):
     """2-process dist_sync with EQuARX-style int8 wire compression: the
     cross-worker sum matches within the per-block quantization bound."""

@@ -46,9 +46,10 @@ _UP_SUFFIXES = ("value", "mfu", "tflops_delivered", "samples_s",
                 "occupancy", "vs_baseline", "weak_scaling_efficiency",
                 "projected_efficiency", "proj_eff_8", "proj_eff_256",
                 "tokens_per_step_ratio", "tokens_per_dispatch",
-                "spec_accept_rate")
+                "spec_accept_rate", "kv_capacity_ratio",
+                "quant_train_mfu")
 _DOWN_SUFFIXES = ("_ms", "p99", "p50", "ttft", "bubble_frac",
-                  "pp_bubble_frac", "exposed_ms")
+                  "pp_bubble_frac", "exposed_ms", "kv_decode_drift")
 # config/provenance keys: never compared (a changed knob is not a perf
 # regression; the human reads those out of the payload directly)
 _SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
@@ -58,7 +59,8 @@ _SKIP_KEYS = {"telemetry_schema_version", "fleet_schema_version",
               "time", "cached_at", "dp", "buckets", "epoch",
               "membership_epoch", "transitions", "ranks",
               "slowest_rank", "tp_shards",
-              "procs", "world_size", "rpc_retries", "rpc_timeout_s"}
+              "procs", "world_size", "rpc_retries", "rpc_timeout_s",
+              "quant_schema_version", "compute_dtype", "kv_dtype"}
 
 
 def direction(key):
@@ -208,6 +210,19 @@ def main(argv=None):
             and not args.allow_schema_drift:
         verdict.update(status="multiproc_schema_drift", old_schema=mvo,
                        new_schema=mvn)
+        print("BENCHDIFF " + json.dumps(verdict))
+        return 2
+
+    # the quant block (ISSUE 20) is versioned the same way: its
+    # capacity/drift fields only compare within one schema
+    qvo = ((old.get("extra") or {}).get("quant")
+           or {}).get("quant_schema_version")
+    qvn = ((new.get("extra") or {}).get("quant")
+           or {}).get("quant_schema_version")
+    if qvo is not None and qvn is not None and qvo != qvn \
+            and not args.allow_schema_drift:
+        verdict.update(status="quant_schema_drift", old_schema=qvo,
+                       new_schema=qvn)
         print("BENCHDIFF " + json.dumps(verdict))
         return 2
 

@@ -25,6 +25,12 @@ Usage:
   python tools/serve_loadgen.py --smoke --replicas 2 --tp 2  # shard
       every replica's weights + KV pool on a tp submesh (ISSUE 18;
       outputs bitwise unchanged)
+  python tools/serve_loadgen.py --smoke --kv-dtype fp8  # store the
+      paged KV pool in fp8 with per-row amax scales (ISSUE 20):
+      reports kv_capacity_ratio (blocks an equal byte budget holds vs
+      f32 — pure pool arithmetic, real on CPU) and kv_decode_drift
+      (max |logit| gap of a short greedy decode vs an explicit
+      fp32-KV engine on the same weights)
 """
 from __future__ import annotations
 
@@ -82,9 +88,54 @@ def _requests(n, vocab, seed=0):
     return out
 
 
+def _kv_capacity_ratio(cfg, kv_dtype, block_size):
+    """Blocks an equal byte budget holds under ``kv_dtype`` vs f32 —
+    pure pool arithmetic (ISSUE 20), so it is REAL on a CPU run.  The
+    budget is what 256 f32 blocks of this model's KV geometry cost;
+    fp8 pays its per-row f32 scale planes out of the same budget."""
+    from mxnet_tpu.ops.quant_kv import kv_block_bytes, kv_blocks_in_budget
+    if kv_dtype is None:
+        return None
+    hd = cfg.hidden_size // cfg.num_heads
+    geom = dict(num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+                head_dim=hd, block_size=block_size)
+    budget = 256 * kv_block_bytes(**geom)
+    f32 = kv_blocks_in_budget(budget, **geom)
+    lowp = kv_blocks_in_budget(budget, kv_dtype=kv_dtype, **geom)
+    return round(lowp / f32, 3) if f32 else None
+
+
+def _kv_decode_drift(net, cfg, kv_dtype, block_size, max_context, seed):
+    """Max |logit| drift of a short greedy decode under the
+    low-precision KV store vs an explicit fp32-KV engine on the SAME
+    weights and prompt — the ISSUE 20 serving drift evidence.  Two
+    tiny single-slot engines; measured only when --kv-dtype asks."""
+    import numpy as np
+    from mxnet_tpu.serving import InferenceEngine
+    rng = np.random.RandomState(seed + 7)
+    prompt = rng.randint(0, cfg.vocab_size, (9,)).tolist()
+    per_mode = []
+    for kd in ("fp32", kv_dtype):
+        eng = InferenceEngine(net, max_batch=1, block_size=block_size,
+                              max_context=max_context, kv_dtype=kd)
+        tok, _ = eng.prefill(0, prompt)
+        cur = list(prompt) + [int(tok)]
+        rows = []
+        for _ in range(4):
+            pos = len(cur) - 1
+            assert eng.reserve(0, pos)
+            nxt, lg = eng.decode([(0, cur[-1], pos)])
+            rows.append(np.asarray(lg[0], np.float32))
+            cur.append(int(nxt[0]))
+        eng.release(0)
+        per_mode.append(rows)
+    return max(float(np.max(np.abs(a - b)))
+               for a, b in zip(*per_mode))
+
+
 def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
                        max_context=64, smoke=True, replicas=2, seed=0,
-                       disaggregated=False, tp=0):
+                       disaggregated=False, tp=0, kv_dtype=None):
     """The ISSUE 12 fleet benchmark: a deterministic shared-system-
     prompt mix through ``replicas`` engine replicas behind one Router
     (prefix cache + chunked prefill on, shared warmup compile cache,
@@ -94,11 +145,14 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
     prefill/decode pools over ONE shared KV pool (paged-block handoff);
     ``tp > 1`` shards every replica's weights + KV pool on a tp submesh
     (outputs bitwise unchanged either way — the benchmark measures the
-    placement, not the math)."""
+    placement, not the math).  ISSUE 20: ``kv_dtype="fp8"`` stores
+    every replica's KV pool quantized (capacity + drift reported)."""
     import numpy as np
     from mxnet_tpu import telemetry
+    from mxnet_tpu.ops.quant_kv import resolve_kv_dtype
     from mxnet_tpu.serving import InferenceEngine, Request, Router, \
         serving_block
+    kv_dtype = resolve_kv_dtype(kv_dtype)
     mesh = None
     if tp and tp > 1:
         from mxnet_tpu.parallel import MeshConfig
@@ -123,7 +177,8 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
                                prefill_chunk=2 * block_size,
                                prefix_cache=True, mesh=mesh,
                                compile_cache=compile_cache,
-                               kv_cache=kv_cache)
+                               kv_cache=kv_cache,
+                               kv_dtype=kv_dtype or "fp32")
 
     router = Router(factory, replicas=replicas,
                     disaggregated=disaggregated)
@@ -154,6 +209,9 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
         hit_tokens += pc.hit_tokens
         computed += rep.engine.stats["prompt_tokens_computed"]
     hit_rate = prefix_hits / prefix_lookups if prefix_lookups else None
+    drift = (None if kv_dtype is None else
+             _kv_decode_drift(net, cfg, kv_dtype, block_size,
+                              max_context, seed))
     blk = serving_block(
         max_batch=max_batch, block_size=block_size,
         buckets=_buckets(block_size, max_context),
@@ -173,7 +231,10 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
         handoff_ms=(telemetry.value("serving.handoff_ms")
                     if telemetry.enabled() else None),
         prefill_pool_occupancy=st.get("prefill_pool_occupancy"),
-        decode_pool_occupancy=st.get("decode_pool_occupancy"))
+        decode_pool_occupancy=st.get("decode_pool_occupancy"),
+        kv_dtype=kv_dtype or "fp32",
+        kv_capacity_ratio=_kv_capacity_ratio(cfg, kv_dtype, block_size),
+        kv_decode_drift=drift)
     return {"metric": "serve_loadgen", "mode": "router",
             "smoke": bool(smoke), "serving": blk,
             "router": {
@@ -194,7 +255,7 @@ def run_router_loadgen(n_requests=12, max_batch=4, block_size=8,
 def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                 mode="both", smoke=True, quantize=None, seed=0,
                 replicas=0, speculative=False, disaggregated=False,
-                tp=0):
+                tp=0, kv_dtype=None):
     """Run the mix through the chosen scheduling policy(ies); returns
     the bench `serving` payload.  ``replicas >= 1`` switches to the
     router fleet benchmark (:func:`run_router_loadgen`).
@@ -202,10 +263,16 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
     policy (greedy acceptance is bitwise, so the comparison still
     measures scheduling, now in tokens-per-dispatch).
     ``disaggregated``/``tp`` are the ISSUE 18 fleet shapes (router
-    benchmark only; ``disaggregated`` implies ``replicas >= 2``)."""
+    benchmark only; ``disaggregated`` implies ``replicas >= 2``).
+    ``kv_dtype`` (ISSUE 20) stores the paged KV pool quantized
+    (``"fp8"``/``"bf16"``): the payload gains ``kv_capacity_ratio``
+    (equal-byte-budget blocks vs f32) and ``kv_decode_drift`` (max
+    |logit| gap vs an explicit fp32-KV engine)."""
     from mxnet_tpu import telemetry
+    from mxnet_tpu.ops.quant_kv import resolve_kv_dtype
     from mxnet_tpu.serving import (ContinuousBatcher, InferenceEngine,
                                    StaticBatcher, serving_block)
+    kv_dtype = resolve_kv_dtype(kv_dtype)
     if disaggregated and replicas < 2:
         replicas = 2
     if replicas:
@@ -213,7 +280,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
             n_requests=n_requests, max_batch=max_batch,
             block_size=block_size, max_context=max_context,
             smoke=smoke, replicas=replicas, seed=seed,
-            disaggregated=disaggregated, tp=tp)
+            disaggregated=disaggregated, tp=tp, kv_dtype=kv_dtype)
     mesh = None
     if tp and tp > 1:
         from mxnet_tpu.parallel import MeshConfig
@@ -240,7 +307,7 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
                                  max_context=max_context, mesh=mesh,
                                  spec_decode=(speculative and
                                               policy == "continuous"),
-                                 **kw)
+                                 kv_dtype=kv_dtype or "fp32", **kw)
         paged = engine.paged_attn
         engine.warmup()
         cls = (ContinuousBatcher if policy == "continuous"
@@ -286,6 +353,9 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
             if r.ttft() is not None)
         results[policy] = stats
     cont = results.get("continuous") or next(iter(results.values()))
+    drift = (None if kv_dtype is None else
+             _kv_decode_drift(net, cfg, kv_dtype, block_size,
+                              max_context, seed))
     blk = serving_block(
         max_batch=max_batch, block_size=block_size,
         buckets=_buckets(block_size, max_context),
@@ -304,7 +374,10 @@ def run_loadgen(n_requests=12, max_batch=4, block_size=8, max_context=64,
         speculative=bool(speculative), paged_attn=paged,
         spec_accept_rate=cont.get("spec_accept_rate"),
         tokens_per_dispatch=cont.get("tokens_per_dispatch"),
-        tp_shards=(tp if tp and tp > 1 else 0))
+        tp_shards=(tp if tp and tp > 1 else 0),
+        kv_dtype=kv_dtype or "fp32",
+        kv_capacity_ratio=_kv_capacity_ratio(cfg, kv_dtype, block_size),
+        kv_decode_drift=drift)
     payload = {"metric": "serve_loadgen", "mode": mode,
                "smoke": bool(smoke), "serving": blk,
                "policies": {k: {kk: vv for kk, vv in v.items()
@@ -365,6 +438,14 @@ def main(argv=None):
     ap.add_argument("--tp", type=int, default=0,
                     help="N>1: shard weights + KV pool on a tp=N "
                          "submesh (outputs bitwise unchanged)")
+    ap.add_argument("--kv-dtype", choices=("fp32", "bf16", "fp8"),
+                    default=None,
+                    help="KV-cache storage precision (ISSUE 20): fp8 "
+                         "stores per-row amax-scaled codes and reports "
+                         "kv_capacity_ratio (equal-byte blocks vs f32) "
+                         "+ kv_decode_drift (max |logit| gap vs an "
+                         "fp32-KV engine); default follows "
+                         "MXTPU_KV_DTYPE")
     args = ap.parse_args(argv)
     smoke = args.smoke
     if args.tp and args.tp > 1 and smoke:
@@ -384,7 +465,8 @@ def main(argv=None):
         mode=args.mode, smoke=smoke,
         quantize="int8" if args.int8 else None,
         replicas=args.replicas, speculative=args.speculative,
-        disaggregated=args.disagg, tp=args.tp)
+        disaggregated=args.disagg, tp=args.tp,
+        kv_dtype=args.kv_dtype)
     out = json.dumps(payload)
     if len(out) > 1800:      # the driver tail-window contract
         slim = dict(payload)

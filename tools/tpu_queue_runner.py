@@ -526,7 +526,9 @@ def run_chaos(suite: str = "preempt") -> int:
     process restored from the same state.  ``serving`` (ISSUE 12): kill
     a serving-router replica mid-traffic — the router must requeue with
     zero lost/duplicated requests and every output must match the solo
-    cold-path stream exactly.  ``autoscale`` (ISSUE 13): a preemption
+    cold-path stream exactly; runs under ``MXTPU_KV_DTYPE=fp8``
+    (ISSUE 20), so the bitwise gate holds within the quantized mode
+    and a teacher-forced fp32 drift bound rides along.  ``autoscale`` (ISSUE 13): a preemption
     NOTICE drains worker 1 at a boundary ahead of the heartbeat
     timeout (checkpoint-then-reshard 8->4, serving admissions shed),
     the notice is revoked and the load-based autoscaler grows back
@@ -569,6 +571,13 @@ def run_chaos(suite: str = "preempt") -> int:
     if suite in ("serving", "autoscale", "all"):
         env.setdefault("MXTPU_SPEC_DECODE", "1")
         env.setdefault("MXTPU_SPEC_K", "2")
+    # ISSUE 20: the serving scenario stores every KV pool in fp8 — the
+    # bitwise fleet-vs-solo gate then runs WITHIN the quantized mode
+    # (replica kill + requeue must land on the fp8 solo stream), and
+    # the scenario adds the teacher-forced fp32 drift bound
+    # (kv_drift_ok) on top.
+    if suite in ("serving", "all"):
+        env.setdefault("MXTPU_KV_DTYPE", "fp8")
     flags = env.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
         env["XLA_FLAGS"] = (
